@@ -1,0 +1,10 @@
+# CMake generated Testfile for 
+# Source directory: /root/repo/native
+# Build directory: /root/repo/native/build
+# 
+# This file includes the relevant testing commands required for 
+# testing this directory and lists subdirectories to be tested as well.
+add_test(quorum_test "/root/repo/native/build/quorum_test")
+set_tests_properties(quorum_test PROPERTIES  _BACKTRACE_TRIPLES "/root/repo/native/CMakeLists.txt;40;add_test;/root/repo/native/CMakeLists.txt;0;")
+add_test(coordination_e2e_test "/root/repo/native/build/coordination_e2e_test")
+set_tests_properties(coordination_e2e_test PROPERTIES  _BACKTRACE_TRIPLES "/root/repo/native/CMakeLists.txt;40;add_test;/root/repo/native/CMakeLists.txt;0;")

@@ -1,0 +1,113 @@
+// C ABI for Python (ctypes) bindings — torchft_tpu/_native.py.
+//
+// The role the reference fills with pyo3 (/root/reference/src/lib.rs):
+// embed the Lighthouse and ManagerServer in Python processes. Clients
+// (ManagerClient / LighthouseClient) live in Python and speak the framed
+// protocol directly, so only server lifecycles cross this boundary.
+
+#include <cstring>
+#include <memory>
+#include <string>
+
+#include "lighthouse.h"
+#include "manager.h"
+
+using tpuft::Lighthouse;
+using tpuft::LighthouseOptions;
+using tpuft::ManagerOptions;
+using tpuft::ManagerServer;
+
+namespace {
+
+thread_local std::string g_last_error;
+
+void set_error(const std::string& msg) { g_last_error = msg; }
+
+}  // namespace
+
+extern "C" {
+
+const char* tpuft_last_error() { return g_last_error.c_str(); }
+
+// ---------- Lighthouse ----------
+
+void* tpuft_lighthouse_new(const char* bind, uint64_t min_replicas, uint64_t join_timeout_ms,
+                           uint64_t quorum_tick_ms, uint64_t heartbeat_timeout_ms) {
+  try {
+    LighthouseOptions opt;
+    opt.bind = bind ? bind : "[::]:0";
+    opt.min_replicas = min_replicas;
+    opt.join_timeout_ms = join_timeout_ms;
+    opt.quorum_tick_ms = quorum_tick_ms;
+    opt.heartbeat_timeout_ms = heartbeat_timeout_ms;
+    auto lh = std::make_unique<Lighthouse>(opt);
+    lh->start();
+    return lh.release();
+  } catch (const std::exception& e) {
+    set_error(e.what());
+    return nullptr;
+  }
+}
+
+// Writes "host:port" into buf (nul-terminated); returns needed length.
+int tpuft_lighthouse_address(void* handle, char* buf, int buf_len) {
+  auto* lh = static_cast<Lighthouse*>(handle);
+  std::string addr = lh->address();
+  if (buf != nullptr && buf_len > 0) {
+    std::strncpy(buf, addr.c_str(), buf_len - 1);
+    buf[buf_len - 1] = '\0';
+  }
+  return static_cast<int>(addr.size());
+}
+
+void tpuft_lighthouse_shutdown(void* handle) {
+  static_cast<Lighthouse*>(handle)->shutdown();
+}
+
+void tpuft_lighthouse_free(void* handle) { delete static_cast<Lighthouse*>(handle); }
+
+// ---------- ManagerServer ----------
+
+void* tpuft_manager_new(const char* replica_id, const char* lighthouse_addr,
+                        const char* hostname, const char* bind, const char* store_addr,
+                        uint64_t world_size, uint64_t heartbeat_interval_ms,
+                        uint64_t connect_timeout_ms, int64_t quorum_retries,
+                        int exit_on_kill) {
+  try {
+    ManagerOptions opt;
+    opt.replica_id = replica_id ? replica_id : "";
+    opt.lighthouse_addr = lighthouse_addr ? lighthouse_addr : "";
+    opt.hostname = hostname ? hostname : "";
+    opt.bind = bind ? bind : "[::]:0";
+    opt.store_addr = store_addr ? store_addr : "";
+    opt.world_size = world_size;
+    opt.heartbeat_interval_ms = heartbeat_interval_ms;
+    opt.connect_timeout_ms = connect_timeout_ms;
+    opt.quorum_retries = quorum_retries;
+    opt.exit_on_kill = exit_on_kill != 0;
+    auto mgr = std::make_unique<ManagerServer>(opt);
+    mgr->start();
+    return mgr.release();
+  } catch (const std::exception& e) {
+    set_error(e.what());
+    return nullptr;
+  }
+}
+
+int tpuft_manager_address(void* handle, char* buf, int buf_len) {
+  auto* mgr = static_cast<ManagerServer*>(handle);
+  std::string addr = mgr->address();
+  if (buf != nullptr && buf_len > 0) {
+    std::strncpy(buf, addr.c_str(), buf_len - 1);
+    buf[buf_len - 1] = '\0';
+  }
+  return static_cast<int>(addr.size());
+}
+
+void tpuft_manager_shutdown(void* handle) {
+  static_cast<ManagerServer*>(handle)->shutdown();
+}
+
+void tpuft_manager_free(void* handle) { delete static_cast<ManagerServer*>(handle); }
+
+}  // extern "C"

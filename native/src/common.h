@@ -1,0 +1,66 @@
+// Shared helpers for the tpuft native coordination plane.
+#pragma once
+
+#include <chrono>
+#include <cstdarg>
+#include <cstdio>
+#include <cstdlib>
+#include <ctime>
+#include <mutex>
+#include <string>
+
+namespace tpuft {
+
+using Clock = std::chrono::steady_clock;
+using Instant = Clock::time_point;
+using DurationMs = std::chrono::milliseconds;
+
+inline int64_t ms_between(Instant a, Instant b) {
+  return std::chrono::duration_cast<DurationMs>(b - a).count();
+}
+
+inline int64_t unix_nanos_now() {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::system_clock::now().time_since_epoch())
+      .count();
+}
+
+// Leveled stderr logger, enabled via TPUFT_LOG={debug,info,warn,error}.
+// Default level: info.
+enum class LogLevel { kDebug = 0, kInfo = 1, kWarn = 2, kError = 3 };
+
+inline LogLevel log_threshold() {
+  static LogLevel level = [] {
+    const char* env = std::getenv("TPUFT_LOG");
+    if (env == nullptr) return LogLevel::kInfo;
+    std::string v(env);
+    if (v == "debug") return LogLevel::kDebug;
+    if (v == "warn") return LogLevel::kWarn;
+    if (v == "error") return LogLevel::kError;
+    if (v == "off") return static_cast<LogLevel>(99);
+    return LogLevel::kInfo;
+  }();
+  return level;
+}
+
+inline void log_at(LogLevel level, const char* tag, const char* fmt, ...) {
+  if (level < log_threshold()) return;
+  static std::mutex mu;
+  std::lock_guard<std::mutex> lock(mu);
+  std::time_t t = std::time(nullptr);
+  char ts[32];
+  std::strftime(ts, sizeof(ts), "%H:%M:%S", std::localtime(&t));
+  std::fprintf(stderr, "[%s %s tpuft] ", ts, tag);
+  va_list args;
+  va_start(args, fmt);
+  std::vfprintf(stderr, fmt, args);
+  va_end(args);
+  std::fprintf(stderr, "\n");
+}
+
+#define TPUFT_DEBUG(...) ::tpuft::log_at(::tpuft::LogLevel::kDebug, "DBG", __VA_ARGS__)
+#define TPUFT_INFO(...) ::tpuft::log_at(::tpuft::LogLevel::kInfo, "INF", __VA_ARGS__)
+#define TPUFT_WARN(...) ::tpuft::log_at(::tpuft::LogLevel::kWarn, "WRN", __VA_ARGS__)
+#define TPUFT_ERROR(...) ::tpuft::log_at(::tpuft::LogLevel::kError, "ERR", __VA_ARGS__)
+
+}  // namespace tpuft

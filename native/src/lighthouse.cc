@@ -1,0 +1,260 @@
+#include "lighthouse.h"
+
+#include <algorithm>
+#include <set>
+#include <sstream>
+
+namespace tpuft {
+
+Lighthouse::Lighthouse(LighthouseOptions opt) : opt_(std::move(opt)) {
+  server_ = std::make_unique<RpcServer>(
+      opt_.bind,
+      [this](uint8_t method, const std::string& payload) { return handle(method, payload); },
+      [this](const std::string& path) { return handle_http(path); });
+}
+
+Lighthouse::~Lighthouse() { shutdown(); }
+
+void Lighthouse::start() {
+  server_->start();
+  tick_thread_ = std::thread([this] { tick_loop(); });
+  TPUFT_INFO("Lighthouse listening on %s (min_replicas=%llu join_timeout_ms=%llu)",
+             server_->address().c_str(), (unsigned long long)opt_.min_replicas,
+             (unsigned long long)opt_.join_timeout_ms);
+}
+
+void Lighthouse::shutdown() {
+  if (stop_.exchange(true)) return;
+  {
+    // Lock before notifying so a handler between its stop_ check and
+    // cv.wait cannot miss the wakeup.
+    std::lock_guard<std::mutex> lock(mu_);
+    quorum_cv_.notify_all();
+  }
+  if (tick_thread_.joinable()) tick_thread_.join();
+  if (server_) server_->shutdown();
+}
+
+void Lighthouse::tick_loop() {
+  while (!stop_.load()) {
+    {
+      std::unique_lock<std::mutex> lock(mu_);
+      quorum_tick();
+    }
+    std::this_thread::sleep_for(DurationMs(opt_.quorum_tick_ms));
+  }
+}
+
+void Lighthouse::quorum_tick() {
+  QuorumDecision decision = quorum_compute(Clock::now(), state_, opt_);
+  if (decision.reason != last_change_reason_) {
+    TPUFT_INFO("Quorum status: %s", decision.reason.c_str());
+    last_change_reason_ = decision.reason;
+  }
+  if (!decision.participants.has_value()) return;
+
+  auto& participants = *decision.participants;
+
+  bool membership_changed =
+      !state_.prev_quorum.has_value() ||
+      quorum_changed(participants,
+                     {state_.prev_quorum->participants().begin(),
+                      state_.prev_quorum->participants().end()});
+  bool commit_failures = std::any_of(
+      participants.begin(), participants.end(),
+      [](const tpuft::QuorumMember& m) { return m.commit_failures() > 0; });
+
+  if (membership_changed) {
+    state_.quorum_id += 1;
+    TPUFT_INFO("Detected quorum change, bumping quorum_id to %lld",
+               (long long)state_.quorum_id);
+  } else if (commit_failures) {
+    state_.quorum_id += 1;
+    TPUFT_INFO("Detected commit failures, bumping quorum_id to %lld",
+               (long long)state_.quorum_id);
+  }
+
+  tpuft::Quorum quorum;
+  quorum.set_quorum_id(state_.quorum_id);
+  for (auto& p : participants) *quorum.add_participants() = p;
+  quorum.mutable_created()->set_unix_nanos(unix_nanos_now());
+
+  state_.prev_quorum = quorum;
+  state_.participants.clear();
+  latest_quorum_ = std::move(quorum);
+  quorum_seq_ += 1;
+  quorum_cv_.notify_all();
+}
+
+RpcResult Lighthouse::handle(uint8_t method, const std::string& payload) {
+  switch (method) {
+    case kLighthouseQuorum:
+      return handle_quorum(payload);
+    case kLighthouseHeartbeat:
+      return handle_heartbeat(payload);
+    case kLighthouseStatus:
+      return handle_status(payload);
+    case kLighthouseKillReplica:
+      return handle_kill(payload);
+    default:
+      return {RpcStatus::kBadMethod, "unknown lighthouse method"};
+  }
+}
+
+RpcResult Lighthouse::handle_quorum(const std::string& payload) {
+  tpuft::LighthouseQuorumRequest req;
+  if (!req.ParseFromString(payload)) {
+    return {RpcStatus::kError, "malformed LighthouseQuorumRequest"};
+  }
+  if (!req.has_requester() || req.requester().replica_id().empty()) {
+    return {RpcStatus::kError, "missing requester"};
+  }
+  const std::string replica_id = req.requester().replica_id();
+  int64_t timeout_ms = req.timeout_ms() > 0 ? req.timeout_ms() : 60000;
+  Instant deadline = Clock::now() + DurationMs(timeout_ms);
+
+  TPUFT_DEBUG("quorum request from replica %s (step=%lld)", replica_id.c_str(),
+              (long long)req.requester().step());
+
+  std::unique_lock<std::mutex> lock(mu_);
+  // Joining the quorum is an implicit heartbeat.
+  state_.heartbeats[replica_id] = Clock::now();
+  state_.participants[replica_id] = ParticipantDetails{Clock::now(), req.requester()};
+  uint64_t seen_seq = quorum_seq_;
+  // Proactive tick so a completing quorum resolves immediately instead of on
+  // the next 100ms tick (fast-quorum latency path).
+  quorum_tick();
+
+  for (;;) {
+    if (quorum_seq_ != seen_seq && latest_quorum_.has_value()) {
+      seen_seq = quorum_seq_;
+      const auto& q = *latest_quorum_;
+      bool in_quorum = std::any_of(
+          q.participants().begin(), q.participants().end(),
+          [&](const tpuft::QuorumMember& m) { return m.replica_id() == replica_id; });
+      if (in_quorum) {
+        tpuft::LighthouseQuorumResponse resp;
+        *resp.mutable_quorum() = q;
+        return {RpcStatus::kOk, resp.SerializeAsString()};
+      }
+      // A quorum formed without us (e.g. we joined during shrink_only):
+      // re-register and keep waiting, as the reference does.
+      TPUFT_INFO("Replica %s not in quorum, retrying", replica_id.c_str());
+      state_.participants[replica_id] = ParticipantDetails{Clock::now(), req.requester()};
+    }
+    if (stop_.load()) return {RpcStatus::kError, "lighthouse shutting down"};
+    if (quorum_cv_.wait_until(lock, deadline) == std::cv_status::timeout) {
+      return {RpcStatus::kTimeout, "quorum deadline exceeded for " + replica_id};
+    }
+  }
+}
+
+RpcResult Lighthouse::handle_heartbeat(const std::string& payload) {
+  tpuft::LighthouseHeartbeatRequest req;
+  if (!req.ParseFromString(payload)) {
+    return {RpcStatus::kError, "malformed LighthouseHeartbeatRequest"};
+  }
+  {
+    std::lock_guard<std::mutex> lock(mu_);
+    state_.heartbeats[req.replica_id()] = Clock::now();
+  }
+  tpuft::LighthouseHeartbeatResponse resp;
+  return {RpcStatus::kOk, resp.SerializeAsString()};
+}
+
+RpcResult Lighthouse::handle_status(const std::string&) {
+  std::lock_guard<std::mutex> lock(mu_);
+  tpuft::LighthouseStatusResponse resp;
+  resp.set_quorum_id(state_.quorum_id);
+  resp.set_has_quorum(state_.prev_quorum.has_value());
+  resp.set_change_log(last_change_reason_);
+  Instant now = Clock::now();
+  std::set<std::string> seen;
+  if (state_.prev_quorum.has_value()) {
+    for (const auto& m : state_.prev_quorum->participants()) {
+      auto* ms = resp.add_members();
+      *ms->mutable_member() = m;
+      auto hb = state_.heartbeats.find(m.replica_id());
+      ms->set_heartbeat_age_ms(hb == state_.heartbeats.end()
+                                   ? -1.0
+                                   : static_cast<double>(ms_between(hb->second, now)));
+      seen.insert(m.replica_id());
+    }
+  }
+  for (const auto& [replica_id, details] : state_.participants) {
+    if (seen.count(replica_id)) continue;
+    auto* ms = resp.add_members();
+    *ms->mutable_member() = details.member;
+    auto hb = state_.heartbeats.find(replica_id);
+    ms->set_heartbeat_age_ms(hb == state_.heartbeats.end()
+                                 ? -1.0
+                                 : static_cast<double>(ms_between(hb->second, now)));
+    ms->set_joining(true);
+  }
+  return {RpcStatus::kOk, resp.SerializeAsString()};
+}
+
+RpcResult Lighthouse::handle_kill(const std::string& payload) {
+  tpuft::KillRequest req;
+  if (!req.ParseFromString(payload)) {
+    return {RpcStatus::kError, "malformed KillRequest"};
+  }
+  std::string addr;
+  {
+    std::lock_guard<std::mutex> lock(mu_);
+    if (!state_.prev_quorum.has_value()) {
+      return {RpcStatus::kNotFound, "no quorum; cannot resolve replica"};
+    }
+    for (const auto& m : state_.prev_quorum->participants()) {
+      if (m.replica_id() == req.replica_id()) {
+        addr = m.address();
+        break;
+      }
+    }
+  }
+  if (addr.empty()) {
+    return {RpcStatus::kNotFound, "replica " + req.replica_id() + " not in quorum"};
+  }
+  RpcClient client(addr, /*connect_timeout_ms=*/10000);
+  RpcResult result = client.call(kManagerKill, "", /*timeout_ms=*/10000);
+  if (result.status != RpcStatus::kOk) {
+    // The victim exits before replying; treat connection loss as success.
+    TPUFT_INFO("kill of %s: manager reply status=%d (%s)", req.replica_id().c_str(),
+               (int)result.status, result.payload.c_str());
+  }
+  tpuft::KillResponse resp;
+  return {RpcStatus::kOk, resp.SerializeAsString()};
+}
+
+std::string Lighthouse::handle_http(const std::string& path) {
+  // Minimal dashboard (parity with the reference's "/" + "/status" routes).
+  if (path != "/" && path.rfind("/status", 0) != 0) return "";
+  std::lock_guard<std::mutex> lock(mu_);
+  std::ostringstream html;
+  html << "<html><head><title>tpuft lighthouse</title>"
+       << "<style>body{font-family:monospace;margin:2em}table{border-collapse:collapse}"
+       << "td,th{border:1px solid #888;padding:4px 8px}.stale{color:#b00}</style></head><body>"
+       << "<h1>tpuft lighthouse</h1>"
+       << "<p>quorum_id: " << state_.quorum_id << "</p>"
+       << "<p>status: " << last_change_reason_ << "</p>";
+  if (state_.prev_quorum.has_value()) {
+    html << "<table><tr><th>replica</th><th>step</th><th>address</th><th>store</th>"
+         << "<th>heartbeat age (ms)</th></tr>";
+    Instant now = Clock::now();
+    for (const auto& m : state_.prev_quorum->participants()) {
+      auto hb = state_.heartbeats.find(m.replica_id());
+      int64_t age = hb == state_.heartbeats.end() ? -1 : ms_between(hb->second, now);
+      bool stale = age < 0 || age > static_cast<int64_t>(opt_.heartbeat_timeout_ms);
+      html << "<tr" << (stale ? " class=stale" : "") << "><td>" << m.replica_id() << "</td><td>"
+           << m.step() << "</td><td>" << m.address() << "</td><td>" << m.store_address()
+           << "</td><td>" << age << "</td></tr>";
+    }
+    html << "</table>";
+  } else {
+    html << "<p>no quorum formed yet</p>";
+  }
+  html << "</body></html>";
+  return html.str();
+}
+
+}  // namespace tpuft

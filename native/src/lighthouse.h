@@ -1,0 +1,61 @@
+// tpuft Lighthouse: global quorum server (one per job).
+//
+// Role-equivalent of the reference's Rust Lighthouse
+// (/root/reference/src/lighthouse.rs): replica groups long-poll Quorum with
+// their membership info, heartbeat periodically, and the tick loop publishes a
+// new quorum whenever quorum_compute says one is valid. The quorum_id bumps on
+// membership change or when any member reports commit failures, which forces
+// downstream comm-layer reconfiguration.
+#pragma once
+
+#include <condition_variable>
+#include <memory>
+#include <mutex>
+#include <thread>
+
+#include "quorum.h"
+#include "rpc.h"
+
+namespace tpuft {
+
+class Lighthouse {
+ public:
+  explicit Lighthouse(LighthouseOptions opt);
+  ~Lighthouse();
+
+  // Binds + starts the RPC server and the quorum tick thread.
+  void start();
+  void shutdown();
+
+  std::string address() const { return server_->address(); }
+  int port() const { return server_->port(); }
+
+ private:
+  RpcResult handle(uint8_t method, const std::string& payload);
+  RpcResult handle_quorum(const std::string& payload);
+  RpcResult handle_heartbeat(const std::string& payload);
+  RpcResult handle_status(const std::string& payload);
+  RpcResult handle_kill(const std::string& payload);
+  std::string handle_http(const std::string& path);
+
+  // Runs quorum_compute over current state and, if a quorum forms, applies the
+  // quorum_id bump rules, records it as prev_quorum, clears participants and
+  // wakes all parked Quorum RPCs. Caller holds mu_.
+  void quorum_tick();
+  void tick_loop();
+
+  LighthouseOptions opt_;
+  std::unique_ptr<RpcServer> server_;
+
+  std::mutex mu_;
+  std::condition_variable quorum_cv_;
+  LighthouseState state_;
+  uint64_t quorum_seq_ = 0;  // bumped every published quorum; wakes waiters
+  std::optional<tpuft::Quorum> latest_quorum_;
+  std::string last_change_reason_;
+
+  std::atomic<bool> stop_{false};
+  std::thread tick_thread_;
+};
+
+}  // namespace tpuft

@@ -1,0 +1,267 @@
+#include "manager.h"
+
+#include <unistd.h>
+
+#include <algorithm>
+#include <cstdlib>
+
+namespace tpuft {
+
+ManagerServer::ManagerServer(ManagerOptions opt) : opt_(std::move(opt)) {
+  if (opt_.hostname.empty()) {
+    char hostname[256];
+    gethostname(hostname, sizeof(hostname));
+    opt_.hostname = hostname;
+  }
+  server_ = std::make_unique<RpcServer>(opt_.bind, [this](uint8_t method,
+                                                          const std::string& payload) {
+    return handle(method, payload);
+  });
+}
+
+ManagerServer::~ManagerServer() { shutdown(); }
+
+void ManagerServer::start() {
+  server_->start();
+  heartbeat_thread_ = std::thread([this] { heartbeat_loop(); });
+  quorum_worker_ = std::thread([this] { quorum_worker_loop(); });
+  TPUFT_INFO("[Replica %s] Manager listening on %s", opt_.replica_id.c_str(),
+             address().c_str());
+}
+
+void ManagerServer::shutdown() {
+  if (stop_.exchange(true)) return;
+  {
+    // Lock before notifying so a handler between its stop_ check and
+    // cv.wait cannot miss the wakeup.
+    std::lock_guard<std::mutex> lock(mu_);
+    cv_.notify_all();
+  }
+  if (heartbeat_thread_.joinable()) heartbeat_thread_.join();
+  if (quorum_worker_.joinable()) quorum_worker_.join();
+  if (server_) server_->shutdown();
+}
+
+std::string ManagerServer::address() const {
+  return opt_.hostname + ":" + std::to_string(server_->port());
+}
+
+void ManagerServer::heartbeat_loop() {
+  RpcClient client(opt_.lighthouse_addr, opt_.connect_timeout_ms);
+  while (!stop_.load()) {
+    tpuft::LighthouseHeartbeatRequest req;
+    req.set_replica_id(opt_.replica_id);
+    RpcResult result =
+        client.call(kLighthouseHeartbeat, req.SerializeAsString(), opt_.connect_timeout_ms);
+    if (result.status != RpcStatus::kOk) {
+      TPUFT_INFO("[Replica %s] Failed to send heartbeat to lighthouse: %s",
+                 opt_.replica_id.c_str(), result.payload.c_str());
+      client.reset();
+    }
+    // Sleep in small slices so shutdown stays responsive.
+    Instant until = Clock::now() + DurationMs(opt_.heartbeat_interval_ms);
+    while (!stop_.load() && Clock::now() < until) {
+      std::this_thread::sleep_for(DurationMs(
+          std::min<int64_t>(20, static_cast<int64_t>(opt_.heartbeat_interval_ms))));
+    }
+  }
+}
+
+RpcResult ManagerServer::handle(uint8_t method, const std::string& payload) {
+  switch (method) {
+    case kManagerQuorum:
+      return handle_quorum(payload);
+    case kManagerCheckpointMetadata:
+      return handle_checkpoint_metadata(payload);
+    case kManagerShouldCommit:
+      return handle_should_commit(payload);
+    case kManagerKill:
+      return handle_kill(payload);
+    default:
+      return {RpcStatus::kBadMethod, "unknown manager method"};
+  }
+}
+
+void ManagerServer::quorum_worker_loop() {
+  std::unique_lock<std::mutex> lock(mu_);
+  while (!stop_.load()) {
+    cv_.wait_for(lock, DurationMs(50),
+                 [this] { return stop_.load() || pending_quorum_req_.has_value(); });
+    if (stop_.load()) return;
+    if (!pending_quorum_req_.has_value()) continue;
+    auto [member, timeout_ms] = *pending_quorum_req_;
+    pending_quorum_req_.reset();
+    lock.unlock();
+    run_lighthouse_quorum(member, timeout_ms);
+    lock.lock();
+  }
+}
+
+void ManagerServer::run_lighthouse_quorum(const tpuft::QuorumMember& member,
+                                          int64_t timeout_ms) {
+  TPUFT_INFO("[Replica %s] All workers joined - starting quorum", opt_.replica_id.c_str());
+
+  tpuft::LighthouseQuorumRequest req;
+  *req.mutable_requester() = member;
+  req.set_timeout_ms(timeout_ms);
+  std::string payload = req.SerializeAsString();
+
+  // Retry loop: evenly divide the deadline across attempts, recreating the
+  // client between tries in case the lighthouse restarted.
+  Instant deadline = Clock::now() + DurationMs(timeout_ms);
+  int64_t attempts = std::max<int64_t>(opt_.quorum_retries + 1, 1);
+  RpcResult result{RpcStatus::kError, "no attempt"};
+  for (int64_t attempt = 0; attempt < attempts; ++attempt) {
+    RpcClient client(opt_.lighthouse_addr, opt_.connect_timeout_ms);
+    int64_t remain = ms_between(Clock::now(), deadline);
+    if (remain <= 0) {
+      result = {RpcStatus::kTimeout, "quorum deadline exceeded"};
+      break;
+    }
+    int64_t slice = attempts > 1 ? std::max<int64_t>(remain / (attempts - attempt), 100) : remain;
+    result = client.call(kLighthouseQuorum, payload, slice);
+    if (result.status == RpcStatus::kOk) break;
+    TPUFT_INFO("[Replica %s] lighthouse quorum failed (attempt %lld): %s",
+               opt_.replica_id.c_str(), (long long)attempt, result.payload.c_str());
+    if (attempt + 1 < attempts) {
+      std::this_thread::sleep_for(DurationMs(100));
+    }
+  }
+
+  std::lock_guard<std::mutex> lock(mu_);
+  if (result.status == RpcStatus::kOk) {
+    tpuft::LighthouseQuorumResponse resp;
+    if (resp.ParseFromString(result.payload) && resp.has_quorum()) {
+      latest_quorum_ = resp.quorum();
+      quorum_error_.clear();
+    } else {
+      quorum_error_ = "malformed lighthouse quorum response";
+    }
+  } else {
+    quorum_error_ = "lighthouse quorum failed after " +
+                    std::to_string(attempts) + " attempt(s): " + result.payload;
+  }
+  quorum_round_ += 1;
+  cv_.notify_all();
+}
+
+RpcResult ManagerServer::handle_quorum(const std::string& payload) {
+  tpuft::ManagerQuorumRequest req;
+  if (!req.ParseFromString(payload)) {
+    return {RpcStatus::kError, "malformed ManagerQuorumRequest"};
+  }
+  int64_t timeout_ms = req.timeout_ms() > 0 ? req.timeout_ms() : 60000;
+  Instant deadline = Clock::now() + DurationMs(timeout_ms);
+
+  TPUFT_DEBUG("[Replica %s] Start quorum for group_rank %lld", opt_.replica_id.c_str(),
+              (long long)req.group_rank());
+
+  std::unique_lock<std::mutex> lock(mu_);
+  checkpoint_metadata_[req.group_rank()] = req.checkpoint_metadata();
+
+  tpuft::QuorumMember member;
+  member.set_replica_id(opt_.replica_id);
+  member.set_address(address());
+  member.set_store_address(opt_.store_addr);
+  member.set_step(req.step());
+  member.set_world_size(opt_.world_size);
+  member.set_shrink_only(req.shrink_only());
+  member.set_commit_failures(req.commit_failures());
+
+  participants_[req.group_rank()] = member;
+  uint64_t seen_round = quorum_round_;
+
+  if (participants_.size() == opt_.world_size) {
+    participants_.clear();
+    pending_quorum_req_ = std::make_pair(member, timeout_ms);
+    cv_.notify_all();
+  }
+
+  while (quorum_round_ == seen_round) {
+    if (stop_.load()) return {RpcStatus::kError, "manager shutting down"};
+    if (cv_.wait_until(lock, deadline) == std::cv_status::timeout) {
+      return {RpcStatus::kTimeout,
+              "quorum deadline exceeded for group_rank " + std::to_string(req.group_rank())};
+    }
+  }
+  if (!quorum_error_.empty()) {
+    return {RpcStatus::kError, quorum_error_};
+  }
+
+  std::string error;
+  auto resp = compute_quorum_results(opt_.replica_id, req.group_rank(), *latest_quorum_,
+                                     req.init_sync(), &error);
+  if (!resp.has_value()) {
+    return {RpcStatus::kNotFound, error};
+  }
+  TPUFT_DEBUG("[Replica %s] Finished quorum for group_rank %lld", opt_.replica_id.c_str(),
+              (long long)req.group_rank());
+  return {RpcStatus::kOk, resp->SerializeAsString()};
+}
+
+RpcResult ManagerServer::handle_checkpoint_metadata(const std::string& payload) {
+  tpuft::CheckpointMetadataRequest req;
+  if (!req.ParseFromString(payload)) {
+    return {RpcStatus::kError, "malformed CheckpointMetadataRequest"};
+  }
+  std::lock_guard<std::mutex> lock(mu_);
+  auto it = checkpoint_metadata_.find(req.group_rank());
+  if (it == checkpoint_metadata_.end()) {
+    return {RpcStatus::kNotFound,
+            "no checkpoint metadata for group_rank " + std::to_string(req.group_rank())};
+  }
+  tpuft::CheckpointMetadataResponse resp;
+  resp.set_checkpoint_metadata(it->second);
+  return {RpcStatus::kOk, resp.SerializeAsString()};
+}
+
+RpcResult ManagerServer::handle_should_commit(const std::string& payload) {
+  tpuft::ShouldCommitRequest req;
+  if (!req.ParseFromString(payload)) {
+    return {RpcStatus::kError, "malformed ShouldCommitRequest"};
+  }
+  int64_t timeout_ms = req.timeout_ms() > 0 ? req.timeout_ms() : 60000;
+  Instant deadline = Clock::now() + DurationMs(timeout_ms);
+
+  TPUFT_DEBUG("[Replica %s] should_commit from rank %lld vote=%d", opt_.replica_id.c_str(),
+              (long long)req.group_rank(), (int)req.should_commit());
+
+  std::unique_lock<std::mutex> lock(mu_);
+  if (!req.should_commit()) {
+    commit_failures_.insert(req.group_rank());
+  }
+  commit_votes_.insert(req.group_rank());
+  uint64_t seen_round = commit_round_;
+
+  if (commit_votes_.size() == opt_.world_size) {
+    commit_decision_ = commit_failures_.empty();
+    TPUFT_INFO("[Replica %s] should_commit completed should_commit=%d",
+               opt_.replica_id.c_str(), (int)commit_decision_);
+    commit_votes_.clear();
+    commit_failures_.clear();
+    commit_round_ += 1;
+    cv_.notify_all();
+  } else {
+    while (commit_round_ == seen_round) {
+      if (stop_.load()) return {RpcStatus::kError, "manager shutting down"};
+      if (cv_.wait_until(lock, deadline) == std::cv_status::timeout) {
+        return {RpcStatus::kTimeout, "should_commit deadline exceeded for group_rank " +
+                                         std::to_string(req.group_rank())};
+      }
+    }
+  }
+
+  tpuft::ShouldCommitResponse resp;
+  resp.set_should_commit(commit_decision_);
+  return {RpcStatus::kOk, resp.SerializeAsString()};
+}
+
+RpcResult ManagerServer::handle_kill(const std::string&) {
+  TPUFT_WARN("[Replica %s] got kill request", opt_.replica_id.c_str());
+  if (opt_.exit_on_kill) {
+    std::exit(1);
+  }
+  return {RpcStatus::kOk, ""};
+}
+
+}  // namespace tpuft

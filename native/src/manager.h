@@ -1,0 +1,98 @@
+// tpuft ManagerServer: per-replica-group quorum arbiter.
+//
+// Role-equivalent of the reference's Rust Manager (/root/reference/src/
+// manager.rs). Runs inside (or next to) the group's rank-0 trainer process.
+// Responsibilities:
+//  - gather ManagerQuorumRequests from all `world_size` local ranks; when the
+//    last arrives, forward one LighthouseQuorumRequest upstream (with retries
+//    + client re-creation on failure) and fan the resulting per-rank recovery
+//    plans back out;
+//  - should_commit: all-local-rank AND barrier over commit votes;
+//  - store checkpoint metadata per local rank for healing peers to fetch;
+//  - heartbeat the lighthouse every heartbeat_interval;
+//  - Kill RPC: exit(1), used by the dashboard/chaos tooling.
+#pragma once
+
+#include <condition_variable>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <set>
+#include <thread>
+
+#include "quorum.h"
+#include "rpc.h"
+
+namespace tpuft {
+
+struct ManagerOptions {
+  std::string replica_id;
+  std::string lighthouse_addr;
+  std::string hostname;         // advertised host; defaults to gethostname
+  std::string bind = "[::]:0";  // rpc bind
+  std::string store_addr;       // advertised rendezvous store
+  uint64_t world_size = 1;
+  uint64_t heartbeat_interval_ms = 100;
+  uint64_t connect_timeout_ms = 10000;
+  int64_t quorum_retries = 0;
+  // Test hook: when false, the Kill RPC reports instead of exiting.
+  bool exit_on_kill = true;
+};
+
+class ManagerServer {
+ public:
+  explicit ManagerServer(ManagerOptions opt);
+  ~ManagerServer();
+
+  void start();
+  void shutdown();
+
+  std::string address() const;
+
+ private:
+  RpcResult handle(uint8_t method, const std::string& payload);
+  RpcResult handle_quorum(const std::string& payload);
+  RpcResult handle_checkpoint_metadata(const std::string& payload);
+  RpcResult handle_should_commit(const std::string& payload);
+  RpcResult handle_kill(const std::string& payload);
+
+  // Forwards one gathered request upstream; publishes the quorum (or the
+  // error) to the parked local ranks.
+  void run_lighthouse_quorum(const tpuft::QuorumMember& member, int64_t timeout_ms);
+
+  // Long-lived worker that performs lighthouse round trips so RPC handler
+  // threads stay parked on cv_ (only one gather round is in flight at once).
+  void quorum_worker_loop();
+
+  void heartbeat_loop();
+
+  ManagerOptions opt_;
+  std::unique_ptr<RpcServer> server_;
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+
+  // Quorum gather state.
+  std::map<int64_t, tpuft::QuorumMember> participants_;  // group_rank -> member
+  uint64_t quorum_round_ = 0;     // bumped when a lighthouse quorum resolves
+  std::optional<tpuft::Quorum> latest_quorum_;
+  std::string quorum_error_;      // non-empty => latest round failed
+
+  // Slot handed to the quorum worker when the last local rank arrives.
+  std::optional<std::pair<tpuft::QuorumMember, int64_t>> pending_quorum_req_;
+
+  // Checkpoint metadata per local rank.
+  std::map<int64_t, std::string> checkpoint_metadata_;
+
+  // should_commit barrier state.
+  std::set<int64_t> commit_votes_;
+  std::set<int64_t> commit_failures_;
+  uint64_t commit_round_ = 0;
+  bool commit_decision_ = false;
+
+  std::atomic<bool> stop_{false};
+  std::thread heartbeat_thread_;
+  std::thread quorum_worker_;
+};
+
+}  // namespace tpuft

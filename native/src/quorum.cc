@@ -1,0 +1,228 @@
+#include "quorum.h"
+
+#include <algorithm>
+#include <set>
+#include <sstream>
+#include <unordered_map>
+#include <unordered_set>
+
+namespace tpuft {
+
+QuorumDecision quorum_compute(Instant now, const LighthouseState& state,
+                              const LighthouseOptions& opt) {
+  // 1. Health filter: a replica counts as alive while its last heartbeat is
+  // younger than the timeout.
+  std::set<std::string> healthy_replicas;
+  for (const auto& [replica_id, last_beat] : state.heartbeats) {
+    if (ms_between(last_beat, now) < static_cast<int64_t>(opt.heartbeat_timeout_ms)) {
+      healthy_replicas.insert(replica_id);
+    }
+  }
+
+  std::vector<const ParticipantDetails*> healthy_participants;
+  for (const auto& [replica_id, details] : state.participants) {
+    if (healthy_replicas.count(replica_id)) {
+      healthy_participants.push_back(&details);
+    }
+  }
+
+  // 2. Deterministic candidate order (std::map already iterates sorted by
+  // replica_id, which is the ordering contract).
+  std::vector<tpuft::QuorumMember> candidates;
+  candidates.reserve(healthy_participants.size());
+  for (const auto* details : healthy_participants) {
+    candidates.push_back(details->member);
+  }
+
+  bool shrink_only = std::any_of(
+      healthy_participants.begin(), healthy_participants.end(),
+      [](const ParticipantDetails* d) { return d->member.shrink_only(); });
+
+  std::ostringstream meta;
+  meta << "[" << healthy_participants.size() << "/" << state.participants.size()
+       << " participants healthy][" << healthy_replicas.size()
+       << " heartbeating][shrink_only=" << (shrink_only ? "true" : "false") << "]";
+
+  if (state.prev_quorum.has_value()) {
+    const auto& prev = *state.prev_quorum;
+    std::unordered_set<std::string> prev_ids;
+    for (const auto& member : prev.participants()) {
+      prev_ids.insert(member.replica_id());
+    }
+
+    // 3. A shrink-only quorum may lose members but never add them.
+    if (shrink_only) {
+      candidates.erase(std::remove_if(candidates.begin(), candidates.end(),
+                                      [&](const tpuft::QuorumMember& m) {
+                                        return prev_ids.count(m.replica_id()) == 0;
+                                      }),
+                       candidates.end());
+    }
+
+    // 4. Fast quorum: every previous member is still healthy and
+    // participating, so no need to wait out the join timeout.
+    bool is_fast_quorum = std::all_of(
+        prev.participants().begin(), prev.participants().end(),
+        [&](const tpuft::QuorumMember& prev_member) {
+          return std::any_of(healthy_participants.begin(), healthy_participants.end(),
+                             [&](const ParticipantDetails* d) {
+                               return d->member.replica_id() == prev_member.replica_id();
+                             });
+        });
+    if (is_fast_quorum) {
+      return {std::move(candidates), "Fast quorum found! " + meta.str()};
+    }
+  }
+
+  // 5. Floor on quorum size.
+  if (healthy_participants.size() < opt.min_replicas) {
+    std::ostringstream reason;
+    reason << "New quorum not ready, only have " << healthy_participants.size()
+           << " participants, need min_replicas " << opt.min_replicas << " " << meta.str();
+    return {std::nullopt, reason.str()};
+  }
+
+  // 6. Split-brain guard: require a strict majority of every replica that is
+  // currently heartbeating (participating or not).
+  if (healthy_participants.size() <= healthy_replicas.size() / 2) {
+    std::ostringstream reason;
+    reason << "New quorum not ready, only have " << healthy_participants.size()
+           << " participants, need at least half of " << healthy_replicas.size()
+           << " healthy workers " << meta.str();
+    return {std::nullopt, reason.str()};
+  }
+
+  // 7. Straggler wait: quorum is valid, but give heartbeating non-participants
+  // up to join_timeout_ms (measured from the earliest participant's join) to
+  // make the request themselves.
+  bool all_healthy_joined = healthy_participants.size() == healthy_replicas.size();
+  Instant first_joined = now;
+  for (const auto* details : healthy_participants) {
+    first_joined = std::min(first_joined, details->joined);
+  }
+  if (!all_healthy_joined &&
+      ms_between(first_joined, now) < static_cast<int64_t>(opt.join_timeout_ms)) {
+    std::ostringstream reason;
+    reason << "Valid quorum with " << healthy_participants.size() << " participants, waiting for "
+           << (healthy_replicas.size() - healthy_participants.size())
+           << " healthy but not participating stragglers due to join timeout " << meta.str();
+    return {std::nullopt, reason.str()};
+  }
+
+  return {std::move(candidates), "Valid quorum found " + meta.str()};
+}
+
+bool quorum_changed(const std::vector<tpuft::QuorumMember>& a,
+                    const std::vector<tpuft::QuorumMember>& b) {
+  if (a.size() != b.size()) return true;
+  for (size_t i = 0; i < a.size(); ++i) {
+    if (a[i].replica_id() != b[i].replica_id()) return true;
+  }
+  return false;
+}
+
+std::optional<tpuft::ManagerQuorumResponse> compute_quorum_results(
+    const std::string& replica_id, int64_t group_rank, const tpuft::Quorum& quorum,
+    bool init_sync, std::string* error) {
+  std::vector<tpuft::QuorumMember> participants(quorum.participants().begin(),
+                                                quorum.participants().end());
+  std::sort(participants.begin(), participants.end(),
+            [](const tpuft::QuorumMember& a, const tpuft::QuorumMember& b) {
+              return a.replica_id() < b.replica_id();
+            });
+
+  // Our rank among quorum members (sorted by replica_id).
+  int64_t replica_rank = -1;
+  for (size_t i = 0; i < participants.size(); ++i) {
+    if (participants[i].replica_id() == replica_id) {
+      replica_rank = static_cast<int64_t>(i);
+      break;
+    }
+  }
+  if (replica_rank < 0) {
+    if (error) *error = "replica " + replica_id + " not participating in returned quorum";
+    return std::nullopt;
+  }
+
+  // The max-step cohort: replicas whose state is the freshest and can serve
+  // as recovery sources / primary store.
+  int64_t max_step = 0;
+  for (const auto& p : participants) max_step = std::max(max_step, p.step());
+  std::vector<int64_t> max_cohort;  // indices into participants
+  for (size_t i = 0; i < participants.size(); ++i) {
+    if (participants[i].step() == max_step) max_cohort.push_back(static_cast<int64_t>(i));
+  }
+  std::optional<int64_t> max_replica_rank;
+  for (size_t i = 0; i < max_cohort.size(); ++i) {
+    if (participants[max_cohort[i]].replica_id() == replica_id) {
+      max_replica_rank = static_cast<int64_t>(i);
+      break;
+    }
+  }
+
+  // Primary rendezvous store: spread local ranks over the max-step cohort.
+  const auto& primary =
+      participants[max_cohort[static_cast<size_t>(group_rank) % max_cohort.size()]];
+
+  // Recovery destinations: behind the max step, or (when init_sync requests a
+  // uniform start and nobody has stepped yet) everyone but the primary.
+  bool force_recover = init_sync && max_step == 0;
+  std::vector<int64_t> recover_dst;  // indices into participants
+  for (size_t i = 0; i < participants.size(); ++i) {
+    const auto& p = participants[i];
+    if (p.step() != max_step ||
+        (force_recover && primary.replica_id() != p.replica_id())) {
+      recover_dst.push_back(static_cast<int64_t>(i));
+    }
+  }
+  std::unordered_set<int64_t> recover_dst_set(recover_dst.begin(), recover_dst.end());
+  std::vector<int64_t> up_to_date;
+  for (size_t i = 0; i < participants.size(); ++i) {
+    if (!recover_dst_set.count(static_cast<int64_t>(i))) {
+      up_to_date.push_back(static_cast<int64_t>(i));
+    }
+  }
+
+  // Round-robin recovering replicas over up-to-date sources, rotated by
+  // group_rank so different local ranks pull from different donors.
+  std::unordered_map<int64_t, std::vector<int64_t>> assignments;  // src -> dsts
+  std::optional<int64_t> recover_src_replica_rank;
+  for (size_t i = 0; i < recover_dst.size(); ++i) {
+    int64_t src = up_to_date[(i + static_cast<size_t>(group_rank)) % up_to_date.size()];
+    assignments[src].push_back(recover_dst[i]);
+    if (recover_dst[i] == replica_rank) {
+      recover_src_replica_rank = src;
+    }
+  }
+
+  bool heal = recover_src_replica_rank.has_value();
+
+  tpuft::ManagerQuorumResponse resp;
+  resp.set_quorum_id(quorum.quorum_id());
+  *resp.mutable_quorum() = quorum;
+  resp.set_replica_rank(replica_rank);
+  resp.set_replica_world_size(static_cast<int64_t>(participants.size()));
+  if (recover_src_replica_rank.has_value()) {
+    resp.set_recover_src_replica_rank(*recover_src_replica_rank);
+    resp.set_recover_src_manager_address(
+        participants[static_cast<size_t>(*recover_src_replica_rank)].address());
+  }
+  auto it = assignments.find(replica_rank);
+  if (it != assignments.end()) {
+    std::sort(it->second.begin(), it->second.end());
+    for (int64_t dst : it->second) resp.add_recover_dst_replica_ranks(dst);
+  }
+  resp.set_store_address(primary.store_address());
+  resp.set_max_step(max_step);
+  if (max_replica_rank.has_value()) resp.set_max_replica_rank(*max_replica_rank);
+  resp.set_max_world_size(static_cast<int64_t>(max_cohort.size()));
+  resp.set_heal(heal);
+  uint64_t max_commit_failures = 0;
+  for (const auto& p : participants) {
+    max_commit_failures = std::max(max_commit_failures, p.commit_failures());
+  }
+  resp.set_commit_failures(max_commit_failures);
+  return resp;
+}
+
+}  // namespace tpuft

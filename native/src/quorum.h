@@ -1,0 +1,77 @@
+// Pure quorum logic for the tpuft Lighthouse and Manager servers.
+//
+// Behavioral contract matches the reference coordination plane:
+//   quorum_compute        <- /root/reference/src/lighthouse.rs:141-269
+//   quorum_id bump rules  <- /root/reference/src/lighthouse.rs:292-343
+//   compute_quorum_results<- /root/reference/src/manager.rs:489-624
+// Both are pure functions over explicit state so the unit tests
+// (native/tests/quorum_test.cc) can drive them directly, the same way the
+// reference's in-file Rust tests do.
+#pragma once
+
+#include <map>
+#include <optional>
+#include <string>
+#include <vector>
+
+#include "common.h"
+#include "tpuft.pb.h"
+
+namespace tpuft {
+
+struct ParticipantDetails {
+  Instant joined;
+  tpuft::QuorumMember member;
+};
+
+// Mutable lighthouse state, guarded by the server's mutex.
+struct LighthouseState {
+  std::map<std::string, ParticipantDetails> participants;  // replica_id -> details
+  std::map<std::string, Instant> heartbeats;               // replica_id -> last beat
+  std::optional<tpuft::Quorum> prev_quorum;
+  int64_t quorum_id = 0;
+};
+
+struct LighthouseOptions {
+  std::string bind = "[::]:29510";
+  uint64_t min_replicas = 1;
+  uint64_t join_timeout_ms = 60000;
+  uint64_t quorum_tick_ms = 100;
+  uint64_t heartbeat_timeout_ms = 5000;
+};
+
+struct QuorumDecision {
+  // Set iff a valid quorum exists right now.
+  std::optional<std::vector<tpuft::QuorumMember>> participants;
+  // Human-readable explanation (surfaced on the status page / change log).
+  std::string reason;
+};
+
+// Evaluates quorum membership at `now`:
+//  1. health-filter participants by heartbeat age < heartbeat_timeout_ms;
+//  2. sort candidates by replica_id for a deterministic order;
+//  3. if any healthy member set shrink_only, restrict to prev-quorum members;
+//  4. fast quorum: all prev members healthy => immediate quorum;
+//  5. min_replicas floor;
+//  6. split-brain guard: healthy participants must exceed half of all
+//     currently-heartbeating replicas;
+//  7. join timeout: if some heartbeating replicas have not requested quorum,
+//     wait up to join_timeout_ms from the earliest joiner.
+QuorumDecision quorum_compute(Instant now, const LighthouseState& state,
+                              const LighthouseOptions& opt);
+
+// True when the two member lists name different replica sets (order-sensitive
+// on the sorted lists, so any membership change trips it).
+bool quorum_changed(const std::vector<tpuft::QuorumMember>& a,
+                    const std::vector<tpuft::QuorumMember>& b);
+
+// Per-rank recovery plan derived from a fresh quorum: replica ranks in sorted
+// order, max-step cohort, primary store selection (group_rank modulo cohort
+// size), round-robin assignment of behind/fresh replicas onto up-to-date ones,
+// init_sync/force_recover semantics, heal flag. Returns nullopt + error when
+// the replica is not in the quorum.
+std::optional<tpuft::ManagerQuorumResponse> compute_quorum_results(
+    const std::string& replica_id, int64_t group_rank, const tpuft::Quorum& quorum,
+    bool init_sync, std::string* error);
+
+}  // namespace tpuft

@@ -1,0 +1,437 @@
+#include "rpc.h"
+
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cerrno>
+#include <cstring>
+#include <random>
+#include <stdexcept>
+
+namespace tpuft {
+
+namespace {
+
+constexpr uint8_t kReqMagic = 'T';
+constexpr uint8_t kRespMagic = 'R';
+constexpr uint32_t kMaxFrame = 64u << 20;  // control-plane frames are small
+
+void set_common_sockopts(int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  setsockopt(fd, SOL_SOCKET, SO_KEEPALIVE, &one, sizeof(one));
+}
+
+// Splits "host:port" / "[v6]:port"; returns false on malformed input.
+bool split_host_port(const std::string& addr, std::string* host, std::string* port) {
+  if (!addr.empty() && addr[0] == '[') {
+    auto close = addr.find(']');
+    if (close == std::string::npos || close + 1 >= addr.size() || addr[close + 1] != ':') {
+      return false;
+    }
+    *host = addr.substr(1, close - 1);
+    *port = addr.substr(close + 2);
+    return true;
+  }
+  auto colon = addr.rfind(':');
+  if (colon == std::string::npos) return false;
+  *host = addr.substr(0, colon);
+  *port = addr.substr(colon + 1);
+  return true;
+}
+
+bool wait_io(int fd, short events, Instant deadline) {
+  for (;;) {
+    int64_t remain = ms_between(Clock::now(), deadline);
+    if (remain <= 0) return false;
+    struct pollfd pfd{fd, events, 0};
+    int rc = poll(&pfd, 1, static_cast<int>(std::min<int64_t>(remain, 1000)));
+    if (rc > 0) return true;
+    if (rc < 0 && errno != EINTR) return false;
+  }
+}
+
+}  // namespace
+
+int tcp_connect(const std::string& addr, int64_t timeout_ms, std::string* err) {
+  std::string host, port;
+  if (!split_host_port(addr, &host, &port)) {
+    if (err) *err = "malformed address: " + addr;
+    return -1;
+  }
+  struct addrinfo hints{};
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  struct addrinfo* res = nullptr;
+  int rc = getaddrinfo(host.empty() ? "::" : host.c_str(), port.c_str(), &hints, &res);
+  if (rc != 0) {
+    if (err) *err = std::string("getaddrinfo: ") + gai_strerror(rc);
+    return -1;
+  }
+  Instant deadline = Clock::now() + DurationMs(timeout_ms);
+  int fd = -1;
+  std::string last_err = "no addresses";
+  for (struct addrinfo* ai = res; ai != nullptr; ai = ai->ai_next) {
+    fd = socket(ai->ai_family, SOCK_STREAM | SOCK_NONBLOCK, 0);
+    if (fd < 0) {
+      last_err = std::string("socket: ") + strerror(errno);
+      continue;
+    }
+    rc = connect(fd, ai->ai_addr, ai->ai_addrlen);
+    if (rc != 0 && errno == EINPROGRESS) {
+      if (wait_io(fd, POLLOUT, deadline)) {
+        int soerr = 0;
+        socklen_t slen = sizeof(soerr);
+        getsockopt(fd, SOL_SOCKET, SO_ERROR, &soerr, &slen);
+        rc = soerr == 0 ? 0 : -1;
+        if (rc != 0) last_err = std::string("connect: ") + strerror(soerr);
+      } else {
+        rc = -1;
+        last_err = "connect timeout";
+      }
+    } else if (rc != 0) {
+      last_err = std::string("connect: ") + strerror(errno);
+    }
+    if (rc == 0) {
+      set_common_sockopts(fd);
+      break;
+    }
+    close(fd);
+    fd = -1;
+  }
+  freeaddrinfo(res);
+  if (fd < 0 && err) *err = last_err + " (" + addr + ")";
+  return fd;
+}
+
+bool read_exact(int fd, void* buf, size_t n, Instant deadline) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t rc = recv(fd, p, n, MSG_DONTWAIT);
+    if (rc > 0) {
+      p += rc;
+      n -= static_cast<size_t>(rc);
+      continue;
+    }
+    if (rc == 0) return false;  // peer closed
+    if (errno == EAGAIN || errno == EWOULDBLOCK) {
+      if (!wait_io(fd, POLLIN, deadline)) return false;
+      continue;
+    }
+    if (errno == EINTR) continue;
+    return false;
+  }
+  return true;
+}
+
+bool write_all(int fd, const void* buf, size_t n, Instant deadline) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t rc = send(fd, p, n, MSG_DONTWAIT | MSG_NOSIGNAL);
+    if (rc > 0) {
+      p += rc;
+      n -= static_cast<size_t>(rc);
+      continue;
+    }
+    if (rc < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+      if (!wait_io(fd, POLLOUT, deadline)) return false;
+      continue;
+    }
+    if (rc < 0 && errno == EINTR) continue;
+    return false;
+  }
+  return true;
+}
+
+namespace {
+
+bool write_frame(int fd, uint8_t magic, uint8_t code, const std::string& payload,
+                 Instant deadline) {
+  uint8_t header[6];
+  header[0] = magic;
+  header[1] = code;
+  uint32_t len = htonl(static_cast<uint32_t>(payload.size()));
+  memcpy(header + 2, &len, 4);
+  if (!write_all(fd, header, sizeof(header), deadline)) return false;
+  return payload.empty() || write_all(fd, payload.data(), payload.size(), deadline);
+}
+
+// Returns false on io error/close. On success fills code + payload. If
+// header_out is given, the raw 6 header bytes are copied there (so a caller
+// can recover a non-frame preamble, e.g. an HTTP request line).
+bool read_frame(int fd, uint8_t expect_magic, uint8_t* code, std::string* payload,
+                Instant deadline, uint8_t* header_out = nullptr) {
+  uint8_t header[6] = {0};
+  bool got_header = read_exact(fd, header, sizeof(header), deadline);
+  if (header_out) memcpy(header_out, header, sizeof(header));
+  if (!got_header) return false;
+  if (header[0] != expect_magic) return false;
+  *code = header[1];
+  uint32_t len;
+  memcpy(&len, header + 2, 4);
+  len = ntohl(len);
+  if (len > kMaxFrame) return false;
+  payload->resize(len);
+  return len == 0 || read_exact(fd, payload->data(), len, deadline);
+}
+
+}  // namespace
+
+// ---------- RpcServer ----------
+
+RpcServer::RpcServer(const std::string& bind, RpcHandler handler, HttpHandler http)
+    : bind_(bind), handler_(std::move(handler)), http_(std::move(http)) {}
+
+RpcServer::~RpcServer() { shutdown(); }
+
+void RpcServer::start() {
+  std::string host, port;
+  if (!split_host_port(bind_, &host, &port)) {
+    throw std::runtime_error("malformed bind address: " + bind_);
+  }
+  struct addrinfo hints{};
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  hints.ai_flags = AI_PASSIVE;
+  struct addrinfo* res = nullptr;
+  int rc = getaddrinfo(host.empty() ? nullptr : host.c_str(), port.c_str(), &hints, &res);
+  if (rc != 0) {
+    throw std::runtime_error(std::string("getaddrinfo: ") + gai_strerror(rc));
+  }
+  int fd = -1;
+  for (struct addrinfo* ai = res; ai != nullptr; ai = ai->ai_next) {
+    fd = socket(ai->ai_family, SOCK_STREAM, 0);
+    if (fd < 0) continue;
+    int one = 1;
+    setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    if (::bind(fd, ai->ai_addr, ai->ai_addrlen) == 0 && listen(fd, 128) == 0) break;
+    close(fd);
+    fd = -1;
+  }
+  freeaddrinfo(res);
+  if (fd < 0) throw std::runtime_error("failed to bind " + bind_);
+  listen_fd_ = fd;
+
+  struct sockaddr_storage ss{};
+  socklen_t slen = sizeof(ss);
+  getsockname(fd, reinterpret_cast<struct sockaddr*>(&ss), &slen);
+  if (ss.ss_family == AF_INET) {
+    port_ = ntohs(reinterpret_cast<struct sockaddr_in*>(&ss)->sin_port);
+  } else {
+    port_ = ntohs(reinterpret_cast<struct sockaddr_in6*>(&ss)->sin6_port);
+  }
+  char hostname[256];
+  gethostname(hostname, sizeof(hostname));
+  host_ = (host.empty() || host == "::" || host == "0.0.0.0") ? hostname : host;
+
+  accept_thread_ = std::thread([this] { accept_loop(); });
+}
+
+std::string RpcServer::address() const { return host_ + ":" + std::to_string(port_); }
+
+void RpcServer::shutdown() {
+  if (stop_.exchange(true)) return;
+  if (listen_fd_ >= 0) {
+    ::shutdown(listen_fd_, SHUT_RDWR);
+    close(listen_fd_);
+  }
+  if (accept_thread_.joinable()) accept_thread_.join();
+  // Wake any connection thread parked in a read, then join them all.
+  std::map<uint64_t, std::thread> threads;
+  {
+    std::lock_guard<std::mutex> lock(conns_mu_);
+    for (int fd : conn_fds_) ::shutdown(fd, SHUT_RDWR);
+    threads.swap(conn_threads_);
+  }
+  for (auto& [id, t] : threads) {
+    if (t.joinable()) t.join();
+  }
+}
+
+void RpcServer::reap_finished() {
+  std::map<uint64_t, std::thread> done;
+  {
+    std::lock_guard<std::mutex> lock(conns_mu_);
+    for (uint64_t id : finished_ids_) {
+      auto it = conn_threads_.find(id);
+      if (it != conn_threads_.end()) {
+        done.emplace(id, std::move(it->second));
+        conn_threads_.erase(it);
+      }
+    }
+    finished_ids_.clear();
+  }
+  for (auto& [id, t] : done) {
+    if (t.joinable()) t.join();
+  }
+}
+
+void RpcServer::accept_loop() {
+  while (!stop_.load()) {
+    int fd = accept(listen_fd_, nullptr, nullptr);
+    if (fd < 0) {
+      if (stop_.load()) return;
+      // Transient conditions (client reset mid-accept, fd pressure) must not
+      // kill the accept loop — the server would look alive but stop serving.
+      if (errno == EINTR || errno == ECONNABORTED || errno == EMFILE ||
+          errno == ENFILE || errno == ENOBUFS || errno == ENOMEM) {
+        if (errno != EINTR && errno != ECONNABORTED) {
+          TPUFT_WARN("accept transient failure: %s", strerror(errno));
+          std::this_thread::sleep_for(DurationMs(50));
+        }
+        continue;
+      }
+      TPUFT_ERROR("accept failed fatally: %s", strerror(errno));
+      return;
+    }
+    set_common_sockopts(fd);
+    reap_finished();
+    std::lock_guard<std::mutex> lock(conns_mu_);
+    uint64_t conn_id = next_conn_id_++;
+    conn_fds_.push_back(fd);
+    conn_threads_.emplace(conn_id,
+                          std::thread([this, fd, conn_id] { serve_conn(fd, conn_id); }));
+  }
+}
+
+void RpcServer::serve_conn(int fd, uint64_t conn_id) {
+  // Connections stay open across many sequential requests; a half-day idle
+  // deadline per frame keeps dead peers from leaking threads forever.
+  const auto frame_deadline = [] { return Clock::now() + DurationMs(12 * 3600 * 1000LL); };
+  for (;;) {
+    if (stop_.load()) break;
+    uint8_t method = 0;
+    uint8_t header[6] = {0};
+    std::string payload;
+    if (!read_frame(fd, kReqMagic, &method, &payload, frame_deadline(), header)) {
+      // Dashboard parity: a browser speaking HTTP GET gets the status page.
+      if (header[0] == 'G' && http_) {
+        std::string req(reinterpret_cast<char*>(header), sizeof(header));
+        std::string rest;
+        rest.resize(4096);
+        // The rest of the request line usually follows immediately; a short
+        // poll tolerates a slow client.
+        if (wait_io(fd, POLLIN, Clock::now() + DurationMs(1000))) {
+          ssize_t n = recv(fd, rest.data(), rest.size(), MSG_DONTWAIT);
+          rest.resize(n > 0 ? static_cast<size_t>(n) : 0);
+          req += rest;
+        }
+        std::string path = "/";
+        auto slash = req.find('/');
+        if (slash != std::string::npos) {
+          auto end = req.find_first_of(" \r\n", slash);
+          path = req.substr(slash, end == std::string::npos ? std::string::npos : end - slash);
+        }
+        std::string body = http_(path);
+        std::string status_line = body.empty() ? "HTTP/1.1 404 Not Found\r\n" : "HTTP/1.1 200 OK\r\n";
+        if (body.empty()) body = "not found";
+        std::string resp = status_line +
+                           "Content-Type: text/html; charset=utf-8\r\nContent-Length: " +
+                           std::to_string(body.size()) + "\r\nConnection: close\r\n\r\n" + body;
+        write_all(fd, resp.data(), resp.size(), Clock::now() + DurationMs(5000));
+      }
+      break;
+    }
+    RpcResult result;
+    try {
+      result = handler_(method, payload);
+    } catch (const std::exception& e) {
+      result = {RpcStatus::kError, std::string("handler exception: ") + e.what()};
+    }
+    if (!write_frame(fd, kRespMagic, static_cast<uint8_t>(result.status), result.payload,
+                     Clock::now() + DurationMs(60000))) {
+      break;
+    }
+  }
+  close(fd);
+  std::lock_guard<std::mutex> lock(conns_mu_);
+  conn_fds_.erase(std::remove(conn_fds_.begin(), conn_fds_.end(), fd), conn_fds_.end());
+  finished_ids_.push_back(conn_id);
+}
+
+// ---------- RpcClient ----------
+
+RpcClient::RpcClient(std::string addr, int64_t connect_timeout_ms)
+    : addr_(std::move(addr)), connect_timeout_ms_(connect_timeout_ms) {}
+
+RpcClient::~RpcClient() { reset(); }
+
+void RpcClient::reset() {
+  if (fd_ >= 0) {
+    close(fd_);
+    fd_ = -1;
+  }
+}
+
+bool RpcClient::ensure_connected(std::string* err) {
+  if (fd_ >= 0) return true;
+  fd_ = tcp_connect(addr_, connect_timeout_ms_, err);
+  return fd_ >= 0;
+}
+
+RpcResult RpcClient::call(uint8_t method, const std::string& payload, int64_t timeout_ms) {
+  Instant deadline = Clock::now() + DurationMs(timeout_ms);
+  std::string err;
+  if (!ensure_connected(&err)) {
+    return {RpcStatus::kError, "connect failed: " + err};
+  }
+  if (!write_frame(fd_, kReqMagic, method, payload, deadline)) {
+    // Stale connection (server restarted): redial once.
+    reset();
+    if (!ensure_connected(&err)) {
+      return {RpcStatus::kError, "reconnect failed: " + err};
+    }
+    if (!write_frame(fd_, kReqMagic, method, payload, deadline)) {
+      reset();
+      return {RpcStatus::kError, "send failed to " + addr_};
+    }
+  }
+  uint8_t status = 0;
+  std::string resp;
+  if (!read_frame(fd_, kRespMagic, &status, &resp, deadline)) {
+    reset();
+    bool timed_out = Clock::now() >= deadline;
+    return {timed_out ? RpcStatus::kTimeout : RpcStatus::kError,
+            timed_out ? "deadline exceeded waiting on " + addr_
+                      : "connection lost to " + addr_};
+  }
+  return {static_cast<RpcStatus>(status), std::move(resp)};
+}
+
+RpcResult call_with_backoff(RpcClient& client, uint8_t method, const std::string& payload,
+                            int64_t total_timeout_ms) {
+  Instant deadline = Clock::now() + DurationMs(total_timeout_ms);
+  std::mt19937_64 rng{std::random_device{}()};
+  double backoff_ms = 100.0;
+  RpcResult last{RpcStatus::kError, "not attempted"};
+  for (;;) {
+    int64_t remain = ms_between(Clock::now(), deadline);
+    if (remain <= 0) {
+      if (last.status == RpcStatus::kError && last.payload == "not attempted") {
+        last = {RpcStatus::kTimeout, "deadline exceeded before first attempt"};
+      }
+      return last;
+    }
+    last = client.call(method, payload, remain);
+    if (last.status == RpcStatus::kOk || last.status == RpcStatus::kBadMethod ||
+        last.status == RpcStatus::kNotFound) {
+      return last;
+    }
+    remain = ms_between(Clock::now(), deadline);
+    if (remain <= 0) return last;
+    std::uniform_real_distribution<double> jitter(0.8, 1.2);
+    int64_t sleep_ms = std::min<int64_t>(static_cast<int64_t>(backoff_ms * jitter(rng)), remain);
+    std::this_thread::sleep_for(DurationMs(sleep_ms));
+    backoff_ms = std::min(backoff_ms * 1.5, 10000.0);
+    client.reset();
+  }
+}
+
+}  // namespace tpuft

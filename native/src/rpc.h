@@ -1,0 +1,149 @@
+// Minimal framed-RPC transport for the tpuft control plane.
+//
+// The reference coordination plane (/root/reference/src/net.rs, lib.rs) speaks
+// gRPC/tonic; this environment has no C++ gRPC, so tpuft uses a deliberately
+// tiny protocol with the same operational properties (deadlines, retries,
+// persistent connections, long-poll friendly):
+//
+//   request  frame: 'T' | u8 method | u32(be) len | payload (protobuf)
+//   response frame: 'R' | u8 status | u32(be) len | payload (protobuf | error)
+//
+// One in-flight request per connection; connections are persistent and
+// re-established by clients on failure with exponential backoff. Servers run
+// a thread per connection (control-plane fan-in is tiny: world_size for a
+// manager, num replica groups for the lighthouse). An HTTP GET on the same
+// port receives a minimal status page (dashboard parity with the reference's
+// axum routes).
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <functional>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "common.h"
+
+namespace tpuft {
+
+// Method ids (u8 on the wire).
+enum Method : uint8_t {
+  kLighthouseQuorum = 1,
+  kLighthouseHeartbeat = 2,
+  kLighthouseStatus = 3,
+  kLighthouseKillReplica = 4,
+  kManagerQuorum = 16,
+  kManagerCheckpointMetadata = 17,
+  kManagerShouldCommit = 18,
+  kManagerKill = 19,
+};
+
+// Response status codes (u8 on the wire).
+enum class RpcStatus : uint8_t {
+  kOk = 0,
+  kError = 1,
+  kTimeout = 2,
+  kBadMethod = 3,
+  kNotFound = 4,
+};
+
+struct RpcResult {
+  RpcStatus status = RpcStatus::kError;
+  std::string payload;  // protobuf bytes on kOk, else utf-8 error message
+};
+
+// ---------- low-level socket helpers ----------
+
+// Parses "host:port" (or "[v6]:port") and opens a connected socket with a
+// deadline; returns fd or -1 (errno-style message in *err).
+int tcp_connect(const std::string& addr, int64_t timeout_ms, std::string* err);
+
+// Reads/writes exactly n bytes honoring an absolute deadline. false on
+// error/deadline.
+bool read_exact(int fd, void* buf, size_t n, Instant deadline);
+bool write_all(int fd, const void* buf, size_t n, Instant deadline);
+
+// ---------- server ----------
+
+// A handler receives the method + request payload and fills the result. It may
+// block (long-poll) but should honor any deadline encoded in the request.
+using RpcHandler = std::function<RpcResult(uint8_t method, const std::string& payload)>;
+
+// Optional plain-HTTP handler: given the request path, return full HTML body
+// (empty => 404).
+using HttpHandler = std::function<std::string(const std::string& path)>;
+
+class RpcServer {
+ public:
+  // bind: "host:port" ("port 0" picks an ephemeral port).
+  RpcServer(const std::string& bind, RpcHandler handler, HttpHandler http = nullptr);
+  ~RpcServer();
+
+  // Starts the accept loop; throws std::runtime_error on bind failure.
+  void start();
+  void shutdown();
+
+  int port() const { return port_; }
+  const std::string& host() const { return host_; }
+  std::string address() const;  // "host:port" resolved for clients
+
+ private:
+  void accept_loop();
+  void serve_conn(int fd, uint64_t conn_id);
+  // Joins connection threads that have signalled completion (cheap: they are
+  // already exiting). Called per accept so long-lived servers don't
+  // accumulate dead joinable threads across client reconnect churn.
+  void reap_finished();
+
+  std::string bind_;
+  std::string host_;
+  int port_ = 0;
+  int listen_fd_ = -1;
+  RpcHandler handler_;
+  HttpHandler http_;
+  std::atomic<bool> stop_{false};
+  std::thread accept_thread_;
+  std::mutex conns_mu_;
+  std::map<uint64_t, std::thread> conn_threads_;  // live connection threads
+  std::vector<uint64_t> finished_ids_;            // exited, pending join
+  std::vector<int> conn_fds_;  // open connection sockets, for shutdown wakeup
+  uint64_t next_conn_id_ = 0;
+};
+
+// ---------- client ----------
+
+// Persistent-connection client with reconnect-on-failure. Thread-compatible:
+// callers must serialize calls per client (matches control-plane usage).
+class RpcClient {
+ public:
+  RpcClient(std::string addr, int64_t connect_timeout_ms);
+  ~RpcClient();
+
+  // One round trip. Reconnects (with the configured connect timeout) if the
+  // connection is missing or the send fails fresh.
+  RpcResult call(uint8_t method, const std::string& payload, int64_t timeout_ms);
+
+  // Drops the cached connection so the next call() redials.
+  void reset();
+
+  const std::string& addr() const { return addr_; }
+
+ private:
+  bool ensure_connected(std::string* err);
+
+  std::string addr_;
+  int64_t connect_timeout_ms_;
+  int fd_ = -1;
+};
+
+// Retries fn() with exponential backoff (100ms * 1.5^k, cap 10s, jittered)
+// until it returns kOk or the deadline passes. Mirrors the reference's
+// retry_backoff (/root/reference/src/retry.rs:16-43).
+RpcResult call_with_backoff(RpcClient& client, uint8_t method, const std::string& payload,
+                            int64_t total_timeout_ms);
+
+}  // namespace tpuft

@@ -1,0 +1,282 @@
+// End-to-end tests of the native coordination plane: a real Lighthouse plus
+// ManagerServers on ephemeral ports, exercised through the framed RPC
+// protocol exactly as the Python clients do. Mirrors the server e2e tests in
+// the reference (/root/reference/src/lighthouse.rs:978, manager.rs:626-880).
+
+#include <future>
+#include <thread>
+
+#include "lighthouse.h"
+#include "manager.h"
+#include "test_util.h"
+
+using namespace tpuft;
+
+namespace {
+
+LighthouseOptions test_lighthouse_opt(uint64_t min_replicas, uint64_t join_timeout_ms = 100) {
+  LighthouseOptions opt;
+  opt.bind = "[::]:0";
+  opt.min_replicas = min_replicas;
+  opt.join_timeout_ms = join_timeout_ms;
+  opt.quorum_tick_ms = 10;
+  opt.heartbeat_timeout_ms = 5000;
+  return opt;
+}
+
+ManagerOptions test_manager_opt(const std::string& replica_id, const std::string& lighthouse_addr,
+                                uint64_t world_size) {
+  ManagerOptions opt;
+  opt.replica_id = replica_id;
+  opt.lighthouse_addr = lighthouse_addr;
+  opt.bind = "[::]:0";
+  opt.store_addr = "store:" + replica_id;
+  opt.world_size = world_size;
+  opt.heartbeat_interval_ms = 50;
+  opt.connect_timeout_ms = 2000;
+  opt.quorum_retries = 0;
+  opt.exit_on_kill = false;
+  return opt;
+}
+
+tpuft::ManagerQuorumResponse manager_quorum(const std::string& addr, int64_t group_rank,
+                                            int64_t step, int64_t timeout_ms = 5000,
+                                            bool init_sync = true) {
+  RpcClient client(addr, 2000);
+  tpuft::ManagerQuorumRequest req;
+  req.set_group_rank(group_rank);
+  req.set_step(step);
+  req.set_checkpoint_metadata("meta:" + std::to_string(group_rank));
+  req.set_init_sync(init_sync);
+  req.set_timeout_ms(timeout_ms);
+  RpcResult result = client.call(kManagerQuorum, req.SerializeAsString(), timeout_ms + 1000);
+  EXPECT_EQ((int)result.status, (int)RpcStatus::kOk);
+  tpuft::ManagerQuorumResponse resp;
+  EXPECT_TRUE(resp.ParseFromString(result.payload));
+  return resp;
+}
+
+bool manager_should_commit(const std::string& addr, int64_t group_rank, bool vote,
+                           int64_t timeout_ms = 5000) {
+  RpcClient client(addr, 2000);
+  tpuft::ShouldCommitRequest req;
+  req.set_group_rank(group_rank);
+  req.set_should_commit(vote);
+  req.set_timeout_ms(timeout_ms);
+  RpcResult result = client.call(kManagerShouldCommit, req.SerializeAsString(), timeout_ms + 1000);
+  EXPECT_EQ((int)result.status, (int)RpcStatus::kOk);
+  tpuft::ShouldCommitResponse resp;
+  EXPECT_TRUE(resp.ParseFromString(result.payload));
+  return resp.should_commit();
+}
+
+}  // namespace
+
+TPUFT_TEST(lighthouse_heartbeat_roundtrip) {
+  Lighthouse lighthouse(test_lighthouse_opt(1));
+  lighthouse.start();
+
+  RpcClient client(lighthouse.address(), 2000);
+  tpuft::LighthouseHeartbeatRequest req;
+  req.set_replica_id("r0");
+  RpcResult result = client.call(kLighthouseHeartbeat, req.SerializeAsString(), 2000);
+  EXPECT_EQ((int)result.status, (int)RpcStatus::kOk);
+
+  // Status reflects the beat (as a joining member once it participates).
+  tpuft::LighthouseStatusRequest sreq;
+  result = client.call(kLighthouseStatus, sreq.SerializeAsString(), 2000);
+  EXPECT_EQ((int)result.status, (int)RpcStatus::kOk);
+  lighthouse.shutdown();
+}
+
+TPUFT_TEST(lighthouse_direct_quorum_two_replicas) {
+  Lighthouse lighthouse(test_lighthouse_opt(2));
+  lighthouse.start();
+
+  auto request_quorum = [&](const std::string& replica_id) {
+    RpcClient client(lighthouse.address(), 2000);
+    tpuft::LighthouseQuorumRequest req;
+    auto* m = req.mutable_requester();
+    m->set_replica_id(replica_id);
+    m->set_address("addr:" + replica_id);
+    m->set_store_address("store:" + replica_id);
+    m->set_step(1);
+    m->set_world_size(1);
+    req.set_timeout_ms(5000);
+    RpcResult result = client.call(kLighthouseQuorum, req.SerializeAsString(), 6000);
+    EXPECT_EQ((int)result.status, (int)RpcStatus::kOk);
+    tpuft::LighthouseQuorumResponse resp;
+    EXPECT_TRUE(resp.ParseFromString(result.payload));
+    return resp.quorum();
+  };
+
+  auto fut_a = std::async(std::launch::async, request_quorum, "a");
+  auto fut_b = std::async(std::launch::async, request_quorum, "b");
+  tpuft::Quorum qa = fut_a.get();
+  tpuft::Quorum qb = fut_b.get();
+  EXPECT_EQ(qa.quorum_id(), qb.quorum_id());
+  EXPECT_EQ(qa.participants_size(), 2);
+  EXPECT_EQ(qa.participants(0).replica_id(), std::string("a"));
+  EXPECT_EQ(qa.participants(1).replica_id(), std::string("b"));
+  lighthouse.shutdown();
+}
+
+TPUFT_TEST(lighthouse_quorum_timeout_is_clean) {
+  Lighthouse lighthouse(test_lighthouse_opt(2));
+  lighthouse.start();
+
+  RpcClient client(lighthouse.address(), 2000);
+  tpuft::LighthouseQuorumRequest req;
+  req.mutable_requester()->set_replica_id("only");
+  req.set_timeout_ms(200);
+  Instant start = Clock::now();
+  RpcResult result = client.call(kLighthouseQuorum, req.SerializeAsString(), 3000);
+  EXPECT_EQ((int)result.status, (int)RpcStatus::kTimeout);
+  EXPECT_TRUE(ms_between(start, Clock::now()) < 1000);
+  lighthouse.shutdown();
+}
+
+TPUFT_TEST(manager_single_rank_quorum_and_commit) {
+  Lighthouse lighthouse(test_lighthouse_opt(1));
+  lighthouse.start();
+
+  ManagerServer manager(test_manager_opt("r0", lighthouse.address(), 1));
+  manager.start();
+
+  auto resp = manager_quorum(manager.address(), 0, /*step=*/0);
+  EXPECT_EQ(resp.replica_rank(), int64_t{0});
+  EXPECT_EQ(resp.replica_world_size(), int64_t{1});
+  EXPECT_FALSE(resp.heal());
+  EXPECT_EQ(resp.store_address(), std::string("store:r0"));
+
+  EXPECT_TRUE(manager_should_commit(manager.address(), 0, true));
+  EXPECT_FALSE(manager_should_commit(manager.address(), 0, false));
+  manager.shutdown();
+  lighthouse.shutdown();
+}
+
+TPUFT_TEST(manager_two_replica_groups_heal_assignment) {
+  Lighthouse lighthouse(test_lighthouse_opt(2));
+  lighthouse.start();
+
+  ManagerServer mgr_a(test_manager_opt("a", lighthouse.address(), 1));
+  ManagerServer mgr_b(test_manager_opt("b", lighthouse.address(), 1));
+  mgr_a.start();
+  mgr_b.start();
+
+  // a is ahead at step 5; b is behind at step 0 and must heal from a.
+  auto fut_a = std::async(std::launch::async,
+                          [&] { return manager_quorum(mgr_a.address(), 0, 5); });
+  auto fut_b = std::async(std::launch::async,
+                          [&] { return manager_quorum(mgr_b.address(), 0, 0); });
+  auto resp_a = fut_a.get();
+  auto resp_b = fut_b.get();
+
+  EXPECT_EQ(resp_a.quorum_id(), resp_b.quorum_id());
+  EXPECT_EQ(resp_a.replica_rank(), int64_t{0});
+  EXPECT_EQ(resp_b.replica_rank(), int64_t{1});
+  EXPECT_FALSE(resp_a.heal());
+  EXPECT_TRUE(resp_b.heal());
+  EXPECT_EQ(resp_b.recover_src_replica_rank(), int64_t{0});
+  EXPECT_EQ(resp_b.recover_src_manager_address(), mgr_a.address());
+  EXPECT_EQ(resp_a.recover_dst_replica_ranks_size(), 1);
+  EXPECT_EQ(resp_a.recover_dst_replica_ranks(0), int64_t{1});
+  EXPECT_EQ(resp_b.max_step(), int64_t{5});
+  // Both use the up-to-date member's store.
+  EXPECT_EQ(resp_a.store_address(), std::string("store:a"));
+  EXPECT_EQ(resp_b.store_address(), std::string("store:a"));
+
+  // The donor can serve b's checkpoint metadata.
+  RpcClient client(mgr_a.address(), 2000);
+  tpuft::CheckpointMetadataRequest creq;
+  creq.set_group_rank(0);
+  creq.set_timeout_ms(2000);
+  RpcResult result = client.call(kManagerCheckpointMetadata, creq.SerializeAsString(), 2000);
+  EXPECT_EQ((int)result.status, (int)RpcStatus::kOk);
+  tpuft::CheckpointMetadataResponse cresp;
+  EXPECT_TRUE(cresp.ParseFromString(result.payload));
+  EXPECT_EQ(cresp.checkpoint_metadata(), std::string("meta:0"));
+
+  mgr_a.shutdown();
+  mgr_b.shutdown();
+  lighthouse.shutdown();
+}
+
+TPUFT_TEST(manager_multi_rank_commit_barrier_ands_votes) {
+  Lighthouse lighthouse(test_lighthouse_opt(1));
+  lighthouse.start();
+
+  ManagerServer manager(test_manager_opt("r0", lighthouse.address(), 2));
+  manager.start();
+
+  // Round 1: one rank votes false => everyone gets false.
+  auto fut0 = std::async(std::launch::async,
+                         [&] { return manager_should_commit(manager.address(), 0, true); });
+  auto fut1 = std::async(std::launch::async,
+                         [&] { return manager_should_commit(manager.address(), 1, false); });
+  EXPECT_FALSE(fut0.get());
+  EXPECT_FALSE(fut1.get());
+
+  // Round 2: both true => true (barrier state reset between rounds).
+  fut0 = std::async(std::launch::async,
+                    [&] { return manager_should_commit(manager.address(), 0, true); });
+  fut1 = std::async(std::launch::async,
+                    [&] { return manager_should_commit(manager.address(), 1, true); });
+  EXPECT_TRUE(fut0.get());
+  EXPECT_TRUE(fut1.get());
+
+  manager.shutdown();
+  lighthouse.shutdown();
+}
+
+TPUFT_TEST(manager_multi_rank_quorum_gathers_all_ranks) {
+  Lighthouse lighthouse(test_lighthouse_opt(1));
+  lighthouse.start();
+
+  ManagerServer manager(test_manager_opt("r0", lighthouse.address(), 2));
+  manager.start();
+
+  auto fut0 = std::async(std::launch::async,
+                         [&] { return manager_quorum(manager.address(), 0, 3); });
+  auto fut1 = std::async(std::launch::async,
+                         [&] { return manager_quorum(manager.address(), 1, 3); });
+  auto resp0 = fut0.get();
+  auto resp1 = fut1.get();
+  EXPECT_EQ(resp0.quorum_id(), resp1.quorum_id());
+  EXPECT_EQ(resp0.replica_world_size(), int64_t{1});
+  EXPECT_EQ(resp0.quorum().participants(0).world_size(), uint64_t{2});
+  manager.shutdown();
+  lighthouse.shutdown();
+}
+
+TPUFT_TEST(quorum_shrinks_after_replica_stops_heartbeating) {
+  LighthouseOptions opt = test_lighthouse_opt(1, /*join_timeout_ms=*/100);
+  opt.heartbeat_timeout_ms = 300;
+  Lighthouse lighthouse(opt);
+  lighthouse.start();
+
+  {
+    ManagerServer mgr_a(test_manager_opt("a", lighthouse.address(), 1));
+    ManagerServer mgr_b(test_manager_opt("b", lighthouse.address(), 1));
+    mgr_a.start();
+    mgr_b.start();
+    auto fut_a = std::async(std::launch::async,
+                            [&] { return manager_quorum(mgr_a.address(), 0, 1); });
+    auto fut_b = std::async(std::launch::async,
+                            [&] { return manager_quorum(mgr_b.address(), 0, 1); });
+    EXPECT_EQ(fut_a.get().replica_world_size(), int64_t{2});
+    EXPECT_EQ(fut_b.get().replica_world_size(), int64_t{2});
+
+    // b dies (server + heartbeats stop).
+    mgr_b.shutdown();
+    std::this_thread::sleep_for(DurationMs(400));  // heartbeat expiry
+
+    auto resp = manager_quorum(mgr_a.address(), 0, /*step=*/2, /*timeout_ms=*/5000);
+    EXPECT_EQ(resp.replica_world_size(), int64_t{1});
+    EXPECT_EQ(resp.quorum().participants(0).replica_id(), std::string("a"));
+    mgr_a.shutdown();
+  }
+  lighthouse.shutdown();
+}
+
+TPUFT_TEST_MAIN()

@@ -1,0 +1,290 @@
+// Unit tests for the pure quorum functions, porting the behavioral contract of
+// the reference's in-file Rust tests (/root/reference/src/lighthouse.rs:612-
+// 1298 and /root/reference/src/manager.rs:881-1072).
+
+#include "quorum.h"
+#include "test_util.h"
+
+using namespace tpuft;
+
+namespace {
+
+tpuft::QuorumMember make_member(const std::string& id, int64_t step = 0,
+                                bool shrink_only = false, uint64_t commit_failures = 0) {
+  tpuft::QuorumMember m;
+  m.set_replica_id(id);
+  m.set_address("addr:" + id);
+  m.set_store_address("store:" + id);
+  m.set_step(step);
+  m.set_world_size(1);
+  m.set_shrink_only(shrink_only);
+  m.set_commit_failures(commit_failures);
+  return m;
+}
+
+// Registers `id` as a live participant that joined at `joined`.
+void add_participant(LighthouseState* state, const std::string& id, Instant joined,
+                     int64_t step = 0, bool shrink_only = false) {
+  state->participants[id] = ParticipantDetails{joined, make_member(id, step, shrink_only)};
+  state->heartbeats[id] = joined;
+}
+
+tpuft::Quorum make_quorum(int64_t quorum_id, const std::vector<tpuft::QuorumMember>& members) {
+  tpuft::Quorum q;
+  q.set_quorum_id(quorum_id);
+  for (const auto& m : members) *q.add_participants() = m;
+  return q;
+}
+
+LighthouseOptions default_opt() {
+  LighthouseOptions opt;
+  opt.min_replicas = 1;
+  opt.join_timeout_ms = 60000;
+  opt.heartbeat_timeout_ms = 5000;
+  return opt;
+}
+
+}  // namespace
+
+TPUFT_TEST(min_replicas_floor) {
+  LighthouseOptions opt = default_opt();
+  opt.min_replicas = 2;
+  LighthouseState state;
+  Instant now = Clock::now();
+  add_participant(&state, "a", now);
+  auto decision = quorum_compute(now, state, opt);
+  EXPECT_FALSE(decision.participants.has_value());
+
+  add_participant(&state, "b", now);
+  decision = quorum_compute(now, state, opt);
+  EXPECT_TRUE(decision.participants.has_value());
+  EXPECT_EQ(decision.participants->size(), size_t{2});
+}
+
+TPUFT_TEST(join_timeout_waits_for_heartbeating_stragglers) {
+  LighthouseOptions opt = default_opt();
+  LighthouseState state;
+  Instant t0 = Clock::now();
+  add_participant(&state, "a", t0);
+  // "b" heartbeats but has not requested quorum.
+  state.heartbeats["b"] = t0;
+
+  // Within the join timeout: wait for b.
+  auto decision = quorum_compute(t0 + DurationMs(1000), state, opt);
+  EXPECT_FALSE(decision.participants.has_value());
+
+  // After the join timeout (from a's join): quorum forms without b.
+  decision = quorum_compute(t0 + DurationMs(61000), state, opt);
+  // ... but by then a's heartbeat has also expired; refresh it.
+  state.heartbeats["a"] = t0 + DurationMs(60500);
+  decision = quorum_compute(t0 + DurationMs(61000), state, opt);
+  EXPECT_TRUE(decision.participants.has_value());
+  EXPECT_EQ(decision.participants->size(), size_t{1});
+  EXPECT_EQ((*decision.participants)[0].replica_id(), std::string("a"));
+}
+
+TPUFT_TEST(heartbeat_expiry_excludes_participant) {
+  LighthouseOptions opt = default_opt();
+  LighthouseState state;
+  Instant t0 = Clock::now();
+  add_participant(&state, "a", t0);
+  add_participant(&state, "b", t0);
+
+  // Both healthy: quorum of 2 (all healthy joined, no straggler wait).
+  auto decision = quorum_compute(t0 + DurationMs(100), state, opt);
+  EXPECT_TRUE(decision.participants.has_value());
+  EXPECT_EQ(decision.participants->size(), size_t{2});
+
+  // b's heartbeat goes stale: only a remains.
+  Instant later = t0 + DurationMs(6000);
+  state.heartbeats["a"] = later;
+  decision = quorum_compute(later, state, opt);
+  EXPECT_TRUE(decision.participants.has_value());
+  EXPECT_EQ(decision.participants->size(), size_t{1});
+  EXPECT_EQ((*decision.participants)[0].replica_id(), std::string("a"));
+}
+
+TPUFT_TEST(fast_quorum_skips_join_timeout) {
+  LighthouseOptions opt = default_opt();
+  LighthouseState state;
+  Instant t0 = Clock::now();
+  add_participant(&state, "a", t0);
+  add_participant(&state, "b", t0);
+  state.prev_quorum = make_quorum(1, {make_member("a"), make_member("b")});
+  // "c" heartbeats but is not a participant — without a prev quorum this
+  // would wait on the join timeout; fast quorum proceeds immediately.
+  state.heartbeats["c"] = t0;
+
+  auto decision = quorum_compute(t0 + DurationMs(10), state, opt);
+  EXPECT_TRUE(decision.participants.has_value());
+  EXPECT_EQ(decision.participants->size(), size_t{2});
+}
+
+TPUFT_TEST(fast_quorum_includes_new_joiner) {
+  // All prev members healthy + a new joiner: fast quorum includes the joiner.
+  LighthouseOptions opt = default_opt();
+  LighthouseState state;
+  Instant t0 = Clock::now();
+  add_participant(&state, "a", t0);
+  add_participant(&state, "b", t0);
+  add_participant(&state, "c", t0);
+  state.prev_quorum = make_quorum(1, {make_member("a"), make_member("b")});
+
+  auto decision = quorum_compute(t0 + DurationMs(10), state, opt);
+  EXPECT_TRUE(decision.participants.has_value());
+  EXPECT_EQ(decision.participants->size(), size_t{3});
+}
+
+TPUFT_TEST(shrink_only_restricts_to_prev_members) {
+  LighthouseOptions opt = default_opt();
+  LighthouseState state;
+  Instant t0 = Clock::now();
+  add_participant(&state, "a", t0);
+  add_participant(&state, "b", t0, /*step=*/0, /*shrink_only=*/true);
+  add_participant(&state, "c", t0);  // new joiner, must be excluded
+  state.prev_quorum = make_quorum(1, {make_member("a"), make_member("b")});
+
+  auto decision = quorum_compute(t0 + DurationMs(10), state, opt);
+  EXPECT_TRUE(decision.participants.has_value());
+  EXPECT_EQ(decision.participants->size(), size_t{2});
+  EXPECT_EQ((*decision.participants)[0].replica_id(), std::string("a"));
+  EXPECT_EQ((*decision.participants)[1].replica_id(), std::string("b"));
+}
+
+TPUFT_TEST(split_brain_requires_majority_of_heartbeating) {
+  LighthouseOptions opt = default_opt();
+  LighthouseState state;
+  Instant t0 = Clock::now();
+  add_participant(&state, "a", t0);
+  add_participant(&state, "b", t0);
+  // Five total replicas heartbeat; only 2 participate => 2 <= 5/2 => no quorum
+  // even after the join timeout.
+  state.heartbeats["c"] = t0;
+  state.heartbeats["d"] = t0;
+  state.heartbeats["e"] = t0;
+
+  Instant now = t0 + DurationMs(1000);
+  auto decision = quorum_compute(now, state, opt);
+  EXPECT_FALSE(decision.participants.has_value());
+
+  // A third participant tips the majority: 3 > 5/2, but still inside the join
+  // timeout with stragglers d, e.
+  add_participant(&state, "c", now);
+  state.heartbeats["c"] = now;
+  decision = quorum_compute(now, state, opt);
+  EXPECT_FALSE(decision.participants.has_value());
+
+  // After the join timeout the 3-member quorum forms.
+  Instant late = t0 + DurationMs(61000);
+  state.heartbeats["a"] = late;
+  state.heartbeats["b"] = late;
+  state.heartbeats["c"] = late;
+  state.heartbeats["d"] = late;
+  state.heartbeats["e"] = late;
+  decision = quorum_compute(late, state, opt);
+  EXPECT_TRUE(decision.participants.has_value());
+  EXPECT_EQ(decision.participants->size(), size_t{3});
+}
+
+TPUFT_TEST(quorum_changed_detects_membership_delta) {
+  std::vector<tpuft::QuorumMember> a = {make_member("a"), make_member("b")};
+  std::vector<tpuft::QuorumMember> same = {make_member("a", /*step=*/7), make_member("b")};
+  std::vector<tpuft::QuorumMember> shrunk = {make_member("a")};
+  EXPECT_FALSE(quorum_changed(a, same));  // step delta is not membership delta
+  EXPECT_TRUE(quorum_changed(a, shrunk));
+}
+
+// ---- compute_quorum_results ----
+
+TPUFT_TEST(results_no_heal_when_all_at_max_step) {
+  auto quorum = make_quorum(7, {make_member("a", 10), make_member("b", 10)});
+  std::string err;
+  auto resp = compute_quorum_results("a", 0, quorum, /*init_sync=*/true, &err);
+  EXPECT_TRUE(resp.has_value());
+  EXPECT_EQ(resp->quorum_id(), int64_t{7});
+  EXPECT_EQ(resp->replica_rank(), int64_t{0});
+  EXPECT_EQ(resp->replica_world_size(), int64_t{2});
+  EXPECT_EQ(resp->max_step(), int64_t{10});
+  EXPECT_EQ(resp->max_world_size(), int64_t{2});
+  EXPECT_FALSE(resp->heal());
+  EXPECT_EQ(resp->recover_dst_replica_ranks_size(), 0);
+  // group_rank 0 -> primary is max_cohort[0] = "a".
+  EXPECT_EQ(resp->store_address(), std::string("store:a"));
+
+  // group_rank 1 spreads the store load to the next cohort member.
+  resp = compute_quorum_results("a", 1, quorum, true, &err);
+  EXPECT_EQ(resp->store_address(), std::string("store:b"));
+}
+
+TPUFT_TEST(results_behind_replica_heals_from_up_to_date) {
+  auto quorum = make_quorum(3, {make_member("a", 10), make_member("b", 4)});
+  std::string err;
+
+  // The behind replica (b, rank 1) must heal from a (rank 0).
+  auto resp_b = compute_quorum_results("b", 0, quorum, true, &err);
+  EXPECT_TRUE(resp_b.has_value());
+  EXPECT_TRUE(resp_b->heal());
+  EXPECT_EQ(resp_b->recover_src_replica_rank(), int64_t{0});
+  EXPECT_EQ(resp_b->recover_src_manager_address(), std::string("addr:a"));
+  EXPECT_EQ(resp_b->max_step(), int64_t{10});
+  EXPECT_FALSE(resp_b->has_max_replica_rank());
+
+  // The donor (a) is told to serve rank 1.
+  auto resp_a = compute_quorum_results("a", 0, quorum, true, &err);
+  EXPECT_FALSE(resp_a->heal());
+  EXPECT_EQ(resp_a->recover_dst_replica_ranks_size(), 1);
+  EXPECT_EQ(resp_a->recover_dst_replica_ranks(0), int64_t{1});
+  EXPECT_EQ(resp_a->max_replica_rank(), int64_t{0});
+}
+
+TPUFT_TEST(results_init_sync_forces_recovery_at_step_zero) {
+  auto quorum = make_quorum(1, {make_member("a", 0), make_member("b", 0), make_member("c", 0)});
+  std::string err;
+
+  // With init_sync, everyone except the primary recovers from it.
+  auto resp_b = compute_quorum_results("b", 0, quorum, /*init_sync=*/true, &err);
+  EXPECT_TRUE(resp_b->heal());
+  EXPECT_EQ(resp_b->recover_src_replica_rank(), int64_t{0});
+
+  // Without init_sync nobody recovers at a uniform step 0.
+  resp_b = compute_quorum_results("b", 0, quorum, /*init_sync=*/false, &err);
+  EXPECT_FALSE(resp_b->heal());
+  EXPECT_EQ(resp_b->recover_dst_replica_ranks_size(), 0);
+}
+
+TPUFT_TEST(results_round_robin_recovery_assignment) {
+  // Two up-to-date (a, c), two behind (b, d): round-robin spreads donors.
+  auto quorum = make_quorum(2, {make_member("a", 10), make_member("b", 5),
+                                make_member("c", 10), make_member("d", 6)});
+  std::string err;
+  // Sorted order: a(0) b(1) c(2) d(3); up_to_date = [0, 2]; recovering = [1, 3].
+  // group_rank 0: b <- up_to_date[0]=a, d <- up_to_date[1]=c.
+  auto resp_b = compute_quorum_results("b", 0, quorum, true, &err);
+  EXPECT_EQ(resp_b->recover_src_replica_rank(), int64_t{0});
+  auto resp_d = compute_quorum_results("d", 0, quorum, true, &err);
+  EXPECT_EQ(resp_d->recover_src_replica_rank(), int64_t{2});
+  auto resp_a = compute_quorum_results("a", 0, quorum, true, &err);
+  EXPECT_EQ(resp_a->recover_dst_replica_ranks_size(), 1);
+  EXPECT_EQ(resp_a->recover_dst_replica_ranks(0), int64_t{1});
+
+  // group_rank 1 rotates the assignment: b <- c, d <- a.
+  resp_b = compute_quorum_results("b", 1, quorum, true, &err);
+  EXPECT_EQ(resp_b->recover_src_replica_rank(), int64_t{2});
+}
+
+TPUFT_TEST(results_replica_not_in_quorum_is_error) {
+  auto quorum = make_quorum(1, {make_member("a", 0)});
+  std::string err;
+  auto resp = compute_quorum_results("ghost", 0, quorum, true, &err);
+  EXPECT_FALSE(resp.has_value());
+  EXPECT_TRUE(err.find("ghost") != std::string::npos);
+}
+
+TPUFT_TEST(results_commit_failures_max_propagates) {
+  auto quorum = make_quorum(1, {make_member("a", 5, false, 2), make_member("b", 5, false, 0)});
+  std::string err;
+  auto resp = compute_quorum_results("b", 0, quorum, true, &err);
+  EXPECT_EQ(resp->commit_failures(), uint64_t{2});
+}
+
+TPUFT_TEST_MAIN()

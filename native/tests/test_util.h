@@ -1,0 +1,66 @@
+// Minimal assert-based test harness for the native plane (no gtest in image).
+#pragma once
+
+#include <cstdio>
+#include <cstdlib>
+#include <functional>
+#include <string>
+#include <vector>
+
+namespace tpuft_test {
+
+struct TestCase {
+  std::string name;
+  std::function<void()> fn;
+};
+
+inline std::vector<TestCase>& registry() {
+  static std::vector<TestCase> tests;
+  return tests;
+}
+
+struct Registrar {
+  Registrar(const std::string& name, std::function<void()> fn) {
+    registry().push_back({name, std::move(fn)});
+  }
+};
+
+#define TPUFT_TEST(name)                                        \
+  static void test_##name();                                    \
+  static ::tpuft_test::Registrar registrar_##name(#name, test_##name); \
+  static void test_##name()
+
+#define EXPECT_TRUE(cond)                                                      \
+  do {                                                                         \
+    if (!(cond)) {                                                             \
+      std::fprintf(stderr, "  FAIL %s:%d: expected %s\n", __FILE__, __LINE__, #cond); \
+      std::exit(1);                                                            \
+    }                                                                          \
+  } while (0)
+
+#define EXPECT_FALSE(cond) EXPECT_TRUE(!(cond))
+
+#define EXPECT_EQ(a, b)                                                        \
+  do {                                                                         \
+    auto va = (a);                                                             \
+    auto vb = (b);                                                             \
+    if (!(va == vb)) {                                                         \
+      std::fprintf(stderr, "  FAIL %s:%d: %s != %s\n", __FILE__, __LINE__, #a, #b); \
+      std::exit(1);                                                            \
+    }                                                                          \
+  } while (0)
+
+inline int run_all() {
+  for (auto& test : registry()) {
+    std::fprintf(stderr, "RUN  %s\n", test.name.c_str());
+    test.fn();
+    std::fprintf(stderr, "  OK %s\n", test.name.c_str());
+  }
+  std::fprintf(stderr, "PASSED %zu tests\n", registry().size());
+  return 0;
+}
+
+}  // namespace tpuft_test
+
+#define TPUFT_TEST_MAIN() \
+  int main() { return ::tpuft_test::run_all(); }

@@ -1,0 +1,171 @@
+"""Python-side tests of the native coordination plane.
+
+Parity targets: the reference's lighthouse_test.py and the client-facing parts
+of its Rust e2e tests (join timeout, heartbeat round trip, manager quorum +
+should_commit over real sockets).
+"""
+
+import threading
+
+import pytest
+
+from torchft_tpu.coordination import (
+    LighthouseClient,
+    LighthouseServer,
+    ManagerClient,
+    ManagerServer,
+    QuorumMember,
+)
+
+
+def test_lighthouse_start_stop() -> None:
+    server = LighthouseServer(min_replicas=1)
+    addr = server.address()
+    assert ":" in addr
+    server.shutdown()
+    # Idempotent.
+    server.shutdown()
+
+
+def test_lighthouse_heartbeat_and_status() -> None:
+    server = LighthouseServer(min_replicas=1)
+    try:
+        client = LighthouseClient(server.address())
+        client.heartbeat("replica_0")
+        status = client.status()
+        assert not status.has_quorum
+        client.close()
+    finally:
+        server.shutdown()
+
+
+def test_lighthouse_quorum_two_members() -> None:
+    server = LighthouseServer(min_replicas=2, join_timeout_ms=100)
+    try:
+        results = {}
+
+        def request(replica_id: str) -> None:
+            client = LighthouseClient(server.address())
+            quorum = client.quorum(
+                QuorumMember(replica_id=replica_id, step=1), timeout=10.0
+            )
+            results[replica_id] = quorum
+            client.close()
+
+        threads = [
+            threading.Thread(target=request, args=(f"replica_{i}",)) for i in range(2)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=15)
+        assert set(results) == {"replica_0", "replica_1"}
+        q0, q1 = results["replica_0"], results["replica_1"]
+        assert q0.quorum_id == q1.quorum_id
+        assert [m.replica_id for m in q0.participants] == ["replica_0", "replica_1"]
+    finally:
+        server.shutdown()
+
+
+def test_lighthouse_quorum_timeout() -> None:
+    server = LighthouseServer(min_replicas=2)
+    try:
+        client = LighthouseClient(server.address())
+        with pytest.raises(TimeoutError):
+            client.quorum(QuorumMember(replica_id="lonely"), timeout=0.2)
+        client.close()
+    finally:
+        server.shutdown()
+
+
+def test_manager_quorum_and_should_commit() -> None:
+    lighthouse = LighthouseServer(min_replicas=1, join_timeout_ms=100)
+    manager = None
+    try:
+        manager = ManagerServer(
+            replica_id="train_ft:0",
+            lighthouse_addr=lighthouse.address(),
+            store_addr="store:0",
+            world_size=1,
+            exit_on_kill=False,
+        )
+        client = ManagerClient(manager.address())
+        result = client._quorum(
+            group_rank=0,
+            step=0,
+            checkpoint_metadata="http://ckpt/0",
+            shrink_only=False,
+            init_sync=True,
+            commit_failures=0,
+            timeout=10.0,
+        )
+        assert result.replica_rank == 0
+        assert result.replica_world_size == 1
+        assert not result.heal
+        assert result.store_address == "store:0"
+        assert result.quorum is not None
+        assert result.quorum.participants[0].replica_id == "train_ft:0"
+
+        assert client._checkpoint_metadata(0, timeout=5.0) == "http://ckpt/0"
+        assert client.should_commit(0, 0, True, timeout=5.0)
+        assert not client.should_commit(0, 0, False, timeout=5.0)
+        client.close()
+    finally:
+        if manager is not None:
+            manager.shutdown()
+        lighthouse.shutdown()
+
+
+def test_manager_two_groups_heal_plan() -> None:
+    lighthouse = LighthouseServer(min_replicas=2, join_timeout_ms=100)
+    managers = []
+    try:
+        for i, step in [(0, 5), (1, 0)]:
+            managers.append(
+                ManagerServer(
+                    replica_id=f"group_{i}",
+                    lighthouse_addr=lighthouse.address(),
+                    store_addr=f"store:{i}",
+                    world_size=1,
+                    exit_on_kill=False,
+                )
+            )
+        results = {}
+
+        def request(idx: int, step: int) -> None:
+            client = ManagerClient(managers[idx].address())
+            results[idx] = client._quorum(
+                group_rank=0,
+                step=step,
+                checkpoint_metadata=f"ckpt:{idx}",
+                shrink_only=False,
+                init_sync=True,
+                commit_failures=0,
+                timeout=10.0,
+            )
+            client.close()
+
+        threads = [
+            threading.Thread(target=request, args=(0, 5)),
+            threading.Thread(target=request, args=(1, 0)),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=15)
+
+        healthy, behind = results[0], results[1]
+        assert not healthy.heal
+        assert behind.heal
+        assert behind.recover_src_replica_rank == healthy.replica_rank
+        assert behind.recover_src_manager_address == managers[0].address()
+        assert healthy.recover_dst_replica_ranks == [behind.replica_rank]
+        assert behind.max_step == 5
+        # The donor serves its checkpoint metadata to the joiner.
+        donor = ManagerClient(behind.recover_src_manager_address)
+        assert donor._checkpoint_metadata(0, timeout=5.0) == "ckpt:0"
+        donor.close()
+    finally:
+        for m in managers:
+            m.shutdown()
+        lighthouse.shutdown()

@@ -1,0 +1,11 @@
+"""torchft_tpu — TPU-native per-step fault tolerance for JAX training.
+
+A ground-up rebuild of the capabilities of pytorch/torchft for TPU:
+a native (C++) Lighthouse computes dynamic quorums of replica groups via
+heartbeats; a native per-replica-group ManagerServer arbitrates quorum,
+recovery assignments, and commit votes; the Python :class:`Manager` embeds in
+the train loop, resizes the replica axis on membership changes, and live-heals
+joining replicas by streaming parameter pytrees from a healthy peer.
+"""
+
+__version__ = "0.1.0"

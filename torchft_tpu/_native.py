@@ -1,0 +1,113 @@
+"""ctypes loader for the native coordination plane (libtpuft.so).
+
+Role-equivalent of the reference's pyo3 module ``torchft._torchft``
+(/root/reference/src/lib.rs): embeds the C++ Lighthouse and ManagerServer in
+Python processes. Only server lifecycles cross the C ABI; clients speak the
+framed RPC protocol directly from Python (see torchft_tpu/coordination.py).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+
+_REPO_ROOT = Path(__file__).resolve().parent.parent
+_NATIVE_DIR = _REPO_ROOT / "native"
+_BUILD_DIR = _NATIVE_DIR / "build"
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _candidate_paths() -> list[Path]:
+    paths = []
+    env = os.environ.get("TPUFT_NATIVE_LIB")
+    if env:
+        paths.append(Path(env))
+    paths.append(Path(__file__).resolve().parent / "libtpuft.so")
+    paths.append(_BUILD_DIR / "libtpuft.so")
+    return paths
+
+
+def ensure_built() -> Path:
+    """Returns the path to libtpuft.so, building it if necessary."""
+    for path in _candidate_paths():
+        if path.exists():
+            return path
+    # Build from source (dev / CI path).
+    _BUILD_DIR.mkdir(parents=True, exist_ok=True)
+    if not (_BUILD_DIR / "build.ninja").exists():
+        subprocess.run(
+            ["cmake", "-B", str(_BUILD_DIR), "-G", "Ninja", str(_NATIVE_DIR)],
+            check=True,
+            capture_output=True,
+        )
+    subprocess.run(
+        ["ninja", "-C", str(_BUILD_DIR), "tpuft"], check=True, capture_output=True
+    )
+    lib_path = _BUILD_DIR / "libtpuft.so"
+    if not lib_path.exists():
+        raise RuntimeError(f"native build succeeded but {lib_path} is missing")
+    return lib_path
+
+
+def load() -> ctypes.CDLL:
+    """Loads (building if needed) and configures the native library."""
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        lib = ctypes.CDLL(str(ensure_built()))
+
+        lib.tpuft_last_error.restype = ctypes.c_char_p
+
+        lib.tpuft_lighthouse_new.restype = ctypes.c_void_p
+        lib.tpuft_lighthouse_new.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_uint64,
+            ctypes.c_uint64,
+            ctypes.c_uint64,
+            ctypes.c_uint64,
+        ]
+        lib.tpuft_lighthouse_address.restype = ctypes.c_int
+        lib.tpuft_lighthouse_address.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_int,
+        ]
+        lib.tpuft_lighthouse_shutdown.argtypes = [ctypes.c_void_p]
+        lib.tpuft_lighthouse_free.argtypes = [ctypes.c_void_p]
+
+        lib.tpuft_manager_new.restype = ctypes.c_void_p
+        lib.tpuft_manager_new.argtypes = [
+            ctypes.c_char_p,  # replica_id
+            ctypes.c_char_p,  # lighthouse_addr
+            ctypes.c_char_p,  # hostname
+            ctypes.c_char_p,  # bind
+            ctypes.c_char_p,  # store_addr
+            ctypes.c_uint64,  # world_size
+            ctypes.c_uint64,  # heartbeat_interval_ms
+            ctypes.c_uint64,  # connect_timeout_ms
+            ctypes.c_int64,  # quorum_retries
+            ctypes.c_int,  # exit_on_kill
+        ]
+        lib.tpuft_manager_address.restype = ctypes.c_int
+        lib.tpuft_manager_address.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_int,
+        ]
+        lib.tpuft_manager_shutdown.argtypes = [ctypes.c_void_p]
+        lib.tpuft_manager_free.argtypes = [ctypes.c_void_p]
+
+        _lib = lib
+        return _lib
+
+
+def last_error() -> str:
+    lib = load()
+    err = lib.tpuft_last_error()
+    return err.decode() if err else "unknown native error"
