@@ -23,6 +23,16 @@ thread_local std::string g_last_error;
 
 void set_error(const std::string& msg) { g_last_error = msg; }
 
+// Shared "write address string into caller buffer" helper: nul-terminates,
+// returns the full length so callers can detect truncation.
+int write_address(const std::string& addr, char* buf, int buf_len) {
+  if (buf != nullptr && buf_len > 0) {
+    std::strncpy(buf, addr.c_str(), buf_len - 1);
+    buf[buf_len - 1] = '\0';
+  }
+  return static_cast<int>(addr.size());
+}
+
 }  // namespace
 
 extern "C" {
@@ -51,13 +61,7 @@ void* tpuft_lighthouse_new(const char* bind, uint64_t min_replicas, uint64_t joi
 
 // Writes "host:port" into buf (nul-terminated); returns needed length.
 int tpuft_lighthouse_address(void* handle, char* buf, int buf_len) {
-  auto* lh = static_cast<Lighthouse*>(handle);
-  std::string addr = lh->address();
-  if (buf != nullptr && buf_len > 0) {
-    std::strncpy(buf, addr.c_str(), buf_len - 1);
-    buf[buf_len - 1] = '\0';
-  }
-  return static_cast<int>(addr.size());
+  return write_address(static_cast<Lighthouse*>(handle)->address(), buf, buf_len);
 }
 
 void tpuft_lighthouse_shutdown(void* handle) {
@@ -95,13 +99,7 @@ void* tpuft_manager_new(const char* replica_id, const char* lighthouse_addr,
 }
 
 int tpuft_manager_address(void* handle, char* buf, int buf_len) {
-  auto* mgr = static_cast<ManagerServer*>(handle);
-  std::string addr = mgr->address();
-  if (buf != nullptr && buf_len > 0) {
-    std::strncpy(buf, addr.c_str(), buf_len - 1);
-    buf[buf_len - 1] = '\0';
-  }
-  return static_cast<int>(addr.size());
+  return write_address(static_cast<ManagerServer*>(handle)->address(), buf, buf_len);
 }
 
 void tpuft_manager_shutdown(void* handle) {
@@ -109,5 +107,34 @@ void tpuft_manager_shutdown(void* handle) {
 }
 
 void tpuft_manager_free(void* handle) { delete static_cast<ManagerServer*>(handle); }
+
+}  // extern "C"
+
+// ---------- StoreServer ----------
+
+#include "store.h"
+
+extern "C" {
+
+void* tpuft_store_new(const char* bind) {
+  try {
+    auto store = std::make_unique<tpuft::StoreServer>(bind ? bind : "[::]:0");
+    store->start();
+    return store.release();
+  } catch (const std::exception& e) {
+    set_error(e.what());
+    return nullptr;
+  }
+}
+
+int tpuft_store_address(void* handle, char* buf, int buf_len) {
+  return write_address(static_cast<tpuft::StoreServer*>(handle)->address(), buf, buf_len);
+}
+
+void tpuft_store_shutdown(void* handle) {
+  static_cast<tpuft::StoreServer*>(handle)->shutdown();
+}
+
+void tpuft_store_free(void* handle) { delete static_cast<tpuft::StoreServer*>(handle); }
 
 }  // extern "C"

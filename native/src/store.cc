@@ -1,0 +1,111 @@
+#include "store.h"
+
+#include "tpuft.pb.h"
+
+namespace tpuft {
+
+StoreServer::StoreServer(const std::string& bind) {
+  server_ = std::make_unique<RpcServer>(bind, [this](uint8_t method, const std::string& payload) {
+    return handle(method, payload);
+  });
+}
+
+StoreServer::~StoreServer() { shutdown(); }
+
+void StoreServer::start() {
+  server_->start();
+  TPUFT_INFO("Store listening on %s", server_->address().c_str());
+}
+
+void StoreServer::shutdown() {
+  if (stop_.exchange(true)) return;
+  {
+    std::lock_guard<std::mutex> lock(mu_);
+    cv_.notify_all();
+  }
+  if (server_) server_->shutdown();
+}
+
+RpcResult StoreServer::handle(uint8_t method, const std::string& payload) {
+  switch (method) {
+    case kStoreSet: {
+      tpuft::StoreSetRequest req;
+      if (!req.ParseFromString(payload)) return {RpcStatus::kError, "malformed StoreSetRequest"};
+      {
+        std::lock_guard<std::mutex> lock(mu_);
+        data_[req.key()] = req.value();
+        cv_.notify_all();
+      }
+      tpuft::StoreSetResponse resp;
+      return {RpcStatus::kOk, resp.SerializeAsString()};
+    }
+    case kStoreGet: {
+      tpuft::StoreGetRequest req;
+      if (!req.ParseFromString(payload)) return {RpcStatus::kError, "malformed StoreGetRequest"};
+      int64_t timeout_ms = req.timeout_ms() > 0 ? req.timeout_ms() : 60000;
+      Instant deadline = Clock::now() + DurationMs(timeout_ms);
+      std::unique_lock<std::mutex> lock(mu_);
+      for (;;) {
+        auto it = data_.find(req.key());
+        if (it != data_.end()) {
+          tpuft::StoreGetResponse resp;
+          resp.set_found(true);
+          resp.set_value(it->second);
+          return {RpcStatus::kOk, resp.SerializeAsString()};
+        }
+        if (!req.wait()) {
+          tpuft::StoreGetResponse resp;
+          resp.set_found(false);
+          return {RpcStatus::kOk, resp.SerializeAsString()};
+        }
+        if (stop_.load()) return {RpcStatus::kError, "store shutting down"};
+        if (cv_.wait_until(lock, deadline) == std::cv_status::timeout) {
+          return {RpcStatus::kTimeout, "store wait timed out for key " + req.key()};
+        }
+      }
+    }
+    case kStoreAdd: {
+      tpuft::StoreAddRequest req;
+      if (!req.ParseFromString(payload)) return {RpcStatus::kError, "malformed StoreAddRequest"};
+      // TCPStore semantics: counters share the keyspace with values (stored
+      // as decimal strings), so get/wait on a counter key observes it.
+      int64_t value;
+      {
+        std::lock_guard<std::mutex> lock(mu_);
+        auto it = data_.find(req.key());
+        int64_t current = 0;
+        if (it != data_.end()) {
+          try {
+            current = std::stoll(it->second);
+          } catch (const std::exception&) {
+            return {RpcStatus::kError, "StoreAdd on non-integer key " + req.key()};
+          }
+        }
+        value = current + req.delta();
+        data_[req.key()] = std::to_string(value);
+        cv_.notify_all();
+      }
+      tpuft::StoreAddResponse resp;
+      resp.set_value(value);
+      return {RpcStatus::kOk, resp.SerializeAsString()};
+    }
+    case kStoreDelete: {
+      tpuft::StoreDeleteRequest req;
+      if (!req.ParseFromString(payload)) {
+        return {RpcStatus::kError, "malformed StoreDeleteRequest"};
+      }
+      bool deleted;
+      {
+        std::lock_guard<std::mutex> lock(mu_);
+        deleted = data_.erase(req.key()) > 0;
+      }
+      tpuft::StoreDeleteResponse resp;
+      resp.set_deleted(deleted);
+      return {RpcStatus::kOk, resp.SerializeAsString()};
+    }
+    default:
+      return {RpcStatus::kBadMethod, "unknown store method"};
+  }
+}
+
+}  // namespace tpuft

@@ -1,0 +1,49 @@
+// tpuft Store: in-memory KV server for rendezvous/config.
+//
+// Fills the role torch's TCPStore plays in the reference (one per replica
+// group; prefixed per quorum — /root/reference/torchft/process_group.py:
+// 111-130, manager.py:670-674): comm-layer endpoints rendezvous under
+// store prefixes, the manager address is bootstrapped through it, and atomic
+// counters back barrier-style coordination.
+#pragma once
+
+#include <condition_variable>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+
+#include "rpc.h"
+
+namespace tpuft {
+
+// Additional method ids (continues the rpc.h enum space).
+enum StoreMethod : uint8_t {
+  kStoreSet = 32,
+  kStoreGet = 33,
+  kStoreAdd = 34,
+  kStoreDelete = 35,
+};
+
+class StoreServer {
+ public:
+  explicit StoreServer(const std::string& bind = "[::]:0");
+  ~StoreServer();
+
+  void start();
+  void shutdown();
+  std::string address() const { return server_->address(); }
+  int port() const { return server_->port(); }
+
+ private:
+  RpcResult handle(uint8_t method, const std::string& payload);
+
+  std::unique_ptr<RpcServer> server_;
+  std::mutex mu_;
+  std::condition_variable cv_;  // wakes Get(wait=true) parkers
+  // Counters share this keyspace as decimal strings (TCPStore semantics).
+  std::map<std::string, std::string> data_;
+  std::atomic<bool> stop_{false};
+};
+
+}  // namespace tpuft

@@ -1,0 +1,226 @@
+"""Threads-as-replicas integration harness.
+
+Parity target: the reference's manager_integ_test.py Runner/EventInjector
+(:83-249): each replica group is a thread (with an inner pool for its local
+ranks), owns its own rendezvous store, and retries its train loop on
+injected failures to simulate supervised restarts. Faults are scheduled
+deterministically by (replica_group, step).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from torchft_tpu.coordination import LighthouseServer
+from torchft_tpu.ddp import ft_allreduce_gradients
+from torchft_tpu.manager import Manager
+from torchft_tpu.optim import Optimizer
+from torchft_tpu.parallel.process_group import (
+    FakeProcessGroupWrapper,
+    ProcessGroupTCP,
+)
+from torchft_tpu.parallel.store import StoreClient, StoreServer
+
+logger = logging.getLogger(__name__)
+
+
+class InjectedFailure(Exception):
+    pass
+
+
+class EventInjector:
+    """Deterministic fault schedule keyed (replica_group, step)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._fail_at: Dict[tuple, bool] = {}
+        self._fail_allreduce_at: Dict[tuple, bool] = {}
+        self.count = 0
+
+    def fail_at(self, group: int, step: int) -> "EventInjector":
+        self._fail_at[(group, step)] = False
+        return self
+
+    def fail_allreduce_at(self, group: int, step: int) -> "EventInjector":
+        self._fail_allreduce_at[(group, step)] = False
+        return self
+
+    def check(self, group: int, step: int, pg: FakeProcessGroupWrapper) -> None:
+        with self._lock:
+            key = (group, step)
+            if key in self._fail_at and not self._fail_at[key]:
+                self._fail_at[key] = True
+                self.count += 1
+                logger.info("injecting failure %s", key)
+                raise InjectedFailure(f"injected failure at {key}")
+            if key in self._fail_allreduce_at and not self._fail_allreduce_at[key]:
+                self._fail_allreduce_at[key] = True
+                self.count += 1
+                logger.info("injecting allreduce failure %s", key)
+                pg.report_future_error(InjectedFailure(f"injected allreduce at {key}"))
+
+
+@dataclass
+class Runner:
+    """One replica group: runs ``train_loop`` on ``world_size`` rank threads,
+    retrying up to ``attempts`` times on InjectedFailure (simulating
+    torchelastic restarts)."""
+
+    replica_group: int
+    lighthouse_addr: str
+    train_loop: Callable[..., Any]
+    num_steps: int = 4
+    world_size: int = 1
+    attempts: int = 3
+    use_async_quorum: bool = True
+    injector: Optional[EventInjector] = None
+    manager_args: Dict[str, Any] = field(default_factory=dict)
+    train_loop_args: Dict[str, Any] = field(default_factory=dict)
+
+    def run_replica(self) -> List[Any]:
+        for attempt in range(self.attempts):
+            store = StoreServer()
+            try:
+                with ThreadPoolExecutor(
+                    max_workers=self.world_size,
+                    thread_name_prefix=f"replica{self.replica_group}",
+                ) as pool:
+                    futures = [
+                        pool.submit(self._run_rank, store, rank)
+                        for rank in range(self.world_size)
+                    ]
+                    results = []
+                    for fut in futures:
+                        results.append(fut.result())
+                    return results
+            except InjectedFailure:
+                logger.info(
+                    "replica %d attempt %d died (injected); restarting",
+                    self.replica_group,
+                    attempt,
+                )
+                time.sleep(0.2)
+                continue
+            finally:
+                store.shutdown()
+        raise RuntimeError(
+            f"replica {self.replica_group} exhausted {self.attempts} attempts"
+        )
+
+    def _run_rank(self, store: StoreServer, rank: int) -> Any:
+        client = StoreClient(store.address(), prefix=f"grp{self.replica_group}")
+        return self.train_loop(
+            runner=self,
+            rank=rank,
+            store_client=client,
+            store_addr=store.address() + f"/grp{self.replica_group}",
+            **self.train_loop_args,
+        )
+
+
+def run_replica_groups(runners: List[Runner], timeout: float = 120.0) -> List[List[Any]]:
+    """Runs all replica groups concurrently; returns per-group results."""
+    with ThreadPoolExecutor(
+        max_workers=len(runners), thread_name_prefix="group"
+    ) as pool:
+        futures = [pool.submit(r.run_replica) for r in runners]
+        return [f.result(timeout=timeout) for f in futures]
+
+
+# ---------------------------------------------------------------------------
+# The v0 DDP train loop (reference train_ddp.py analogue, sized for tests)
+# ---------------------------------------------------------------------------
+
+
+def _init_model_params(seed: int = 0) -> Any:
+    """Tiny deterministic 2-layer MLP, identical on every replica."""
+    k1, k2 = jax.random.split(jax.random.PRNGKey(seed))
+    return {
+        "w1": jax.random.normal(k1, (8, 16), dtype=jnp.float32) * 0.1,
+        "b1": jnp.zeros((16,), dtype=jnp.float32),
+        "w2": jax.random.normal(k2, (16, 4), dtype=jnp.float32) * 0.1,
+        "b2": jnp.zeros((4,), dtype=jnp.float32),
+    }
+
+
+@jax.jit
+def _loss_fn(params: Any, x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    h = jnp.tanh(x @ params["w1"] + params["b1"])
+    logits = h @ params["w2"] + params["b2"]
+    return jnp.mean((logits - y) ** 2)
+
+
+_grad_fn = jax.jit(jax.grad(_loss_fn))
+
+
+def _batch_for(step: int, replica_group: int) -> tuple:
+    """Deterministic per-(step, group) synthetic batch so gradients differ
+    across groups and averaging is observable."""
+    key = jax.random.PRNGKey(1000 * replica_group + step)
+    kx, ky = jax.random.split(key)
+    x = jax.random.normal(kx, (4, 8), dtype=jnp.float32)
+    y = jax.random.normal(ky, (4, 4), dtype=jnp.float32)
+    return x, y
+
+
+def ddp_train_loop(
+    runner: Runner,
+    rank: int,
+    store_client: StoreClient,
+    store_addr: str,
+    min_replica_size: int = 1,
+    init_sync: bool = True,
+) -> Dict[str, Any]:
+    """Returns {"state_dict": final state, "history": {step: params}}."""
+    pg = FakeProcessGroupWrapper(ProcessGroupTCP(timeout=10.0))
+    manager = Manager(
+        pg=pg,
+        min_replica_size=min_replica_size,
+        store=store_client,
+        store_addr=store_addr,
+        use_async_quorum=runner.use_async_quorum,
+        group_rank=rank,
+        group_world_size=runner.world_size,
+        lighthouse_addr=runner.lighthouse_addr,
+        replica_id=f"ddp_{runner.replica_group}",
+        heartbeat_interval=0.05,
+        timeout=10.0,
+        quorum_timeout=20.0,
+        init_sync=init_sync,
+        **runner.manager_args,
+    )
+    opt = Optimizer(manager, optax.sgd(0.05), _init_model_params())
+
+    history: Dict[int, Any] = {}
+    try:
+        while manager.current_step() < runner.num_steps:
+            step = manager.current_step()
+            if runner.injector is not None:
+                runner.injector.check(runner.replica_group, step, pg)
+
+            opt.begin_step()
+            x, y = _batch_for(step, runner.replica_group)
+            grads = _grad_fn(opt.params, x, y)
+            avg_grads = ft_allreduce_gradients(manager, grads)
+            committed = opt.step(avg_grads)
+            if committed:
+                history[manager.current_step()] = jax.tree_util.tree_map(
+                    lambda a: jnp.array(a), opt.params
+                )
+        return {
+            "state_dict": {"params": opt.params, "opt_state": opt.opt_state},
+            "history": history,
+            "manager_state": manager.state_dict(),
+        }
+    finally:
+        manager.shutdown(wait=False)
+        pg.shutdown()

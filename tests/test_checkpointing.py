@@ -1,0 +1,195 @@
+"""Checkpoint transport + lock component tests (parity targets:
+http_transport_test.py, pg_transport_test.py, rwlock_test.py)."""
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from torchft_tpu.checkpointing import HTTPTransport, PGTransport
+from torchft_tpu.checkpointing._rwlock import RWLock
+from torchft_tpu.checkpointing import _serialization
+from torchft_tpu.parallel.store import StoreServer
+
+
+def sample_state() -> dict:
+    import jax.numpy as jnp
+
+    return {
+        "user": {
+            "model": {
+                "w": np.arange(12, dtype=np.float32).reshape(3, 4),
+                "b": jnp.ones(4, dtype=jnp.bfloat16),
+            },
+            "opt": {"count": 7, "name": "adam"},
+        },
+        "tpuft": {"step": 3, "batches_committed": 6},
+    }
+
+
+def assert_state_equal(a: dict, b: dict) -> None:
+    import jax
+
+    leaves_a, tree_a = jax.tree_util.tree_flatten(a)
+    leaves_b, tree_b = jax.tree_util.tree_flatten(b)
+    assert tree_a == tree_b
+    for la, lb in zip(leaves_a, leaves_b):
+        if hasattr(la, "shape"):
+            np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+        else:
+            assert la == lb
+
+
+# -- serialization ----------------------------------------------------------
+
+
+def test_serialization_roundtrip() -> None:
+    state = sample_state()
+    data = _serialization.dumps(state)
+    restored = _serialization.loads(data)
+    assert_state_equal(state, restored)
+    # bfloat16 dtype survives.
+    import ml_dtypes
+
+    assert restored["user"]["model"]["b"].dtype == np.dtype(ml_dtypes.bfloat16)
+
+
+def test_serialization_truncated_raises() -> None:
+    data = _serialization.dumps(sample_state())
+    with pytest.raises(EOFError):
+        _serialization.loads(data[:-10])
+
+
+# -- rwlock -----------------------------------------------------------------
+
+
+def test_rwlock_readers_shared_writer_exclusive() -> None:
+    lock = RWLock()
+    with lock.r_lock():
+        assert lock.r_acquire(timeout=0.1)
+        lock.r_release()
+        assert not lock.w_acquire(timeout=0.1)
+    with lock.w_lock():
+        assert not lock.r_acquire(timeout=0.1)
+        assert not lock.w_acquire(timeout=0.1)
+    with lock.r_lock():
+        pass
+
+
+def test_rwlock_writer_blocks_new_readers() -> None:
+    lock = RWLock()
+    lock.r_acquire()
+    state = {}
+
+    def writer() -> None:
+        state["w_start"] = time.monotonic()
+        lock.w_acquire()
+        state["w_got"] = time.monotonic()
+        lock.w_release()
+
+    t = threading.Thread(target=writer)
+    t.start()
+    time.sleep(0.1)
+    # A waiting writer blocks fresh readers.
+    assert not lock.r_acquire(timeout=0.1)
+    lock.r_release()
+    t.join(5)
+    assert "w_got" in state
+
+
+# -- HTTP transport ---------------------------------------------------------
+
+
+@pytest.mark.parametrize("num_chunks", [0, 3])
+def test_http_transport_roundtrip(num_chunks: int) -> None:
+    donor = HTTPTransport(num_chunks=num_chunks)
+    joiner = HTTPTransport()
+    try:
+        state = sample_state()
+        donor.send_checkpoint([1], step=3, state_dict=state, timeout=10)
+        restored = joiner.recv_checkpoint(
+            src_rank=0, metadata=donor.metadata(), step=3, timeout=10
+        )
+        assert_state_equal(state, restored)
+    finally:
+        donor.shutdown()
+        joiner.shutdown()
+
+
+def test_http_transport_wrong_step_404s() -> None:
+    # Short serve-gate timeout so the wrong-step fetches fail fast instead of
+    # parking for the full default window.
+    donor = HTTPTransport(timeout=1.0)
+    try:
+        donor.send_checkpoint([1], step=3, state_dict={"x": np.ones(1)}, timeout=10)
+        with pytest.raises(Exception):
+            donor.recv_checkpoint(0, donor.metadata(), step=99, timeout=5)
+        # disallow stops serving entirely.
+        donor.disallow_checkpoint()
+        with pytest.raises(Exception):
+            donor.recv_checkpoint(0, donor.metadata(), step=3, timeout=5)
+    finally:
+        donor.shutdown()
+
+
+# -- PG transport -----------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def store_server():
+    server = StoreServer()
+    yield server
+    server.shutdown()
+
+
+def _configured_pair(store_server, timeout=10.0):
+    from torchft_tpu.parallel.process_group import ProcessGroupTCP
+
+    pgs = [ProcessGroupTCP(timeout=timeout) for _ in range(2)]
+    with ThreadPoolExecutor(max_workers=2) as pool:
+        list(
+            pool.map(
+                lambda i: pgs[i].configure(
+                    f"{store_server.address()}/pgt/{id(pgs[0])}", f"r{i}", i, 2
+                ),
+                range(2),
+            )
+        )
+    return pgs
+
+
+@pytest.mark.parametrize("inplace", [False, True])
+def test_pg_transport_roundtrip(store_server, inplace: bool) -> None:
+    pgs = _configured_pair(store_server)
+    try:
+        state = sample_state()
+        template = None
+        if inplace:
+            import jax
+
+            template = lambda: jax.tree_util.tree_map(  # noqa: E731
+                lambda x: np.zeros_like(np.asarray(x)) if hasattr(x, "shape") else x,
+                state,
+            )
+        donor = PGTransport(pgs[0])
+        joiner = PGTransport(pgs[1], state_dict_template=template)
+
+        result = {}
+
+        def send() -> None:
+            donor.send_checkpoint([1], step=3, state_dict=state, timeout=10)
+
+        def recv() -> None:
+            result["state"] = joiner.recv_checkpoint(0, "<pg>", step=3, timeout=10)
+
+        threads = [threading.Thread(target=send), threading.Thread(target=recv)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(15)
+        assert_state_equal(state, result["state"])
+    finally:
+        for pg in pgs:
+            pg.shutdown()

@@ -1,0 +1,337 @@
+"""Manager state-machine tests against mocked coordination clients.
+
+Parity target: the reference's manager_test.py — each test scripts a
+QuorumResult on a mocked ManagerClient and asserts the per-step state
+machine: configure-on-quorum-change, participation math, healing sync/async,
+error funnel, commit/max_retries, FIXED_WITH_SPARES.
+"""
+
+from typing import Optional
+from unittest.mock import MagicMock, create_autospec, patch
+
+import numpy as np
+import pytest
+
+from torchft_tpu.checkpointing.transport import CheckpointTransport
+from torchft_tpu.coordination import QuorumResult
+from torchft_tpu.manager import ExceptionWithTraceback, Manager, WorldSizeMode
+from torchft_tpu.parallel.process_group import ProcessGroup, ProcessGroupDummy
+from torchft_tpu.work import _DummyWork
+
+
+class _FakeStore:
+    def __init__(self) -> None:
+        self.data = {
+            "manager_addr": b"fake:1234",
+            "replica_id": b"test_replica:uuid",
+        }
+
+    def get(self, key: str, timeout: float = 0, wait: bool = True):
+        return self.data.get(key)
+
+    def set(self, key: str, value: bytes, timeout: float = 0) -> None:
+        self.data[key] = value
+
+
+def make_quorum(
+    quorum_id: int = 1,
+    replica_rank: int = 0,
+    replica_world_size: int = 2,
+    heal: bool = False,
+    max_step: int = 0,
+    max_rank: Optional[int] = None,
+    max_world_size: int = 2,
+    recover_src_manager_address: str = "",
+    recover_src_replica_rank: Optional[int] = None,
+    recover_dst_replica_ranks=(),
+) -> QuorumResult:
+    if max_rank is None and not heal:
+        max_rank = replica_rank
+    return QuorumResult(
+        quorum_id=quorum_id,
+        replica_rank=replica_rank,
+        replica_world_size=replica_world_size,
+        recover_src_manager_address=recover_src_manager_address,
+        recover_src_replica_rank=recover_src_replica_rank,
+        recover_dst_replica_ranks=list(recover_dst_replica_ranks),
+        store_address="store:0",
+        max_step=max_step,
+        max_rank=max_rank,
+        max_world_size=max_world_size,
+        heal=heal,
+    )
+
+
+def make_manager(
+    pg=None,
+    use_async_quorum: bool = False,
+    min_replica_size: int = 2,
+    world_size_mode: WorldSizeMode = WorldSizeMode.DYNAMIC,
+    max_retries: Optional[int] = None,
+    **kwargs,
+):
+    pg = pg if pg is not None else create_autospec(ProcessGroup, instance=True)
+    transport = create_autospec(CheckpointTransport, instance=True)
+    transport.metadata.return_value = "http://fake:0"
+    with patch("torchft_tpu.manager.ManagerClient", autospec=True) as client_cls:
+        manager = Manager(
+            pg=pg,
+            min_replica_size=min_replica_size,
+            store=_FakeStore(),
+            store_addr="store:0",
+            use_async_quorum=use_async_quorum,
+            group_rank=1,  # avoid spawning a native ManagerServer
+            group_world_size=2,
+            world_size_mode=world_size_mode,
+            checkpoint_transport=transport,
+            max_retries=max_retries,
+            timeout=5.0,
+            quorum_timeout=5.0,
+            **kwargs,
+        )
+    manager.register_state_dict_fn(
+        "model",
+        load_state_dict=MagicMock(),
+        state_dict=lambda: {"w": np.ones(2)},
+    )
+    return manager, manager._client, pg, transport
+
+
+def test_quorum_configures_pg_and_tracks_participation() -> None:
+    manager, client, pg, transport = make_manager()
+    client._quorum.return_value = make_quorum(
+        quorum_id=7, replica_rank=1, replica_world_size=3, max_rank=1, max_world_size=3
+    )
+    pg.errored.return_value = None
+
+    manager.start_quorum()
+    pg.configure.assert_called_once()
+    store_addr, replica_id, rank, world = pg.configure.call_args[0]
+    assert store_addr == "store:0/tpuft/7/1"
+    assert rank == 1 and world == 3
+    assert manager.num_participants() == 3
+    assert manager.participating_rank() == 1
+    assert manager.is_participating()
+
+    # Same quorum id next step: no reconfigure.
+    manager.start_quorum()
+    assert pg.configure.call_count == 1
+
+
+def test_allreduce_averages_by_participants() -> None:
+    manager, client, _, _ = make_manager(pg=ProcessGroupDummy())
+    client._quorum.return_value = make_quorum(replica_world_size=2, max_world_size=2)
+    client.should_commit.return_value = True
+    manager.start_quorum()
+
+    # Dummy PG echoes the input, so AVG == input / num_participants.
+    out = manager.allreduce(np.array([4.0, 8.0])).wait()
+    np.testing.assert_array_equal(out, np.array([2.0, 4.0]))
+
+    tree = {"a": np.array([2.0]), "b": [np.array([6.0])]}
+    out_tree = manager.allreduce_pytree(tree).wait()
+    np.testing.assert_array_equal(out_tree["a"], np.array([1.0]))
+    np.testing.assert_array_equal(out_tree["b"][0], np.array([3.0]))
+
+
+def test_allreduce_after_error_is_noop() -> None:
+    manager, client, _, _ = make_manager(pg=ProcessGroupDummy())
+    client._quorum.return_value = make_quorum()
+    manager.start_quorum()
+    manager.report_error(RuntimeError("boom"))
+    work = manager.allreduce(np.array([1.0]))
+    assert isinstance(work, _DummyWork)
+    np.testing.assert_array_equal(work.wait(), np.array([1.0]))
+
+
+def test_allreduce_error_reports_and_returns_default() -> None:
+    pg = create_autospec(ProcessGroup, instance=True)
+    pg.errored.return_value = None
+    pg.allreduce.side_effect = RuntimeError("collective failed")
+    manager, client, _, _ = make_manager(pg=pg)
+    client._quorum.return_value = make_quorum()
+    manager.start_quorum()
+    work = manager.allreduce(np.array([1.0, 2.0]))
+    np.testing.assert_array_equal(work.wait(), np.array([1.0, 2.0]))
+    assert manager.errored() is not None
+
+
+def test_healing_async_skips_participation_and_zeroes_grads() -> None:
+    manager, client, pg, transport = make_manager(
+        pg=ProcessGroupDummy(), use_async_quorum=True
+    )
+    client._quorum.return_value = make_quorum(
+        quorum_id=2,
+        replica_rank=1,
+        replica_world_size=2,
+        heal=True,
+        max_step=5,
+        max_rank=None,
+        max_world_size=1,
+        recover_src_manager_address="donor:1",
+        recover_src_replica_rank=0,
+    )
+    client._checkpoint_metadata.return_value = "http://donor:0"
+    client.should_commit.return_value = True
+    transport.recv_checkpoint.return_value = {
+        "user": {"model": {"w": np.full(2, 9.0)}},
+        "tpuft": {"step": 5, "batches_committed": 10},
+    }
+
+    with patch("torchft_tpu.manager.ManagerClient", autospec=True) as primary_cls:
+        primary_cls.return_value._checkpoint_metadata.return_value = "http://donor:0"
+        manager.start_quorum()
+        manager.wait_quorum()
+
+    assert manager._healing
+    assert not manager.is_participating()
+    assert manager.num_participants() == 1
+    # Healing replica contributes zeros.
+    out = manager.allreduce(np.array([3.0, 3.0])).wait()
+    np.testing.assert_array_equal(out, np.zeros(2))
+    # Manager accounting restored from the donor.
+    assert manager.current_step() == 5
+
+    # should_commit applies the pending user state dict.
+    load_fn = manager._load_state_dict_fns["model"]
+    assert manager.should_commit()
+    load_fn.assert_called_once()
+    np.testing.assert_array_equal(load_fn.call_args[0][0]["w"], np.full(2, 9.0))
+    assert manager.current_step() == 6
+
+
+def test_healing_sync_applies_before_return() -> None:
+    manager, client, pg, transport = make_manager(
+        pg=ProcessGroupDummy(), use_async_quorum=False
+    )
+    client._quorum.return_value = make_quorum(
+        quorum_id=3,
+        replica_rank=1,
+        replica_world_size=2,
+        heal=True,
+        max_step=2,
+        recover_src_manager_address="donor:1",
+        recover_src_replica_rank=0,
+    )
+    transport.recv_checkpoint.return_value = {
+        "user": {"model": {"w": np.zeros(2)}},
+        "tpuft": {"step": 2, "batches_committed": 4},
+    }
+    with patch("torchft_tpu.manager.ManagerClient", autospec=True):
+        manager.start_quorum()
+    # Sync mode: state applied eagerly, replica participates this step.
+    assert not manager._healing
+    load_fn = manager._load_state_dict_fns["model"]
+    load_fn.assert_called_once()
+    assert manager.is_participating()
+
+
+def test_donor_sends_checkpoint() -> None:
+    manager, client, pg, transport = make_manager(pg=ProcessGroupDummy())
+    client._quorum.return_value = make_quorum(recover_dst_replica_ranks=[1])
+    manager.start_quorum()
+    manager.wait_quorum()
+    transport.send_checkpoint.assert_called_once()
+    kwargs = transport.send_checkpoint.call_args[1]
+    assert kwargs["dst_ranks"] == [1]
+    assert "user" in kwargs["state_dict"] and "tpuft" in kwargs["state_dict"]
+
+
+def test_should_commit_false_without_enough_replicas() -> None:
+    manager, client, _, _ = make_manager(pg=ProcessGroupDummy(), min_replica_size=2)
+    client._quorum.return_value = make_quorum(
+        replica_world_size=1, max_world_size=1, replica_rank=0, max_rank=0
+    )
+    client.should_commit.side_effect = lambda rank, step, vote, timeout: vote
+    manager.start_quorum()
+    assert not manager.should_commit()
+    assert manager.current_step() == 0
+
+
+def test_pg_errored_blocks_commit() -> None:
+    pg = ProcessGroupDummy()
+    pg._errored = RuntimeError("pg broke")
+    manager, client, _, _ = make_manager(pg=pg)
+    client._quorum.return_value = make_quorum()
+    client.should_commit.side_effect = lambda rank, step, vote, timeout: vote
+    manager.start_quorum()
+    assert not manager.should_commit()
+    assert manager.errored() is not None
+
+
+def test_commit_success_advances_step_and_batches() -> None:
+    manager, client, _, _ = make_manager(pg=ProcessGroupDummy())
+    client._quorum.return_value = make_quorum(replica_world_size=2, max_world_size=2)
+    client.should_commit.side_effect = lambda rank, step, vote, timeout: vote
+    manager.start_quorum()
+    assert manager.should_commit()
+    assert manager.current_step() == 1
+    assert manager.batches_committed() == 2
+
+
+def test_max_retries_raises_after_consecutive_failures() -> None:
+    manager, client, _, _ = make_manager(pg=ProcessGroupDummy(), max_retries=1)
+    client._quorum.return_value = make_quorum()
+    client.should_commit.return_value = False
+    manager.start_quorum()
+    assert not manager.should_commit()  # failure 1
+    manager.start_quorum()
+    with pytest.raises(RuntimeError, match="max_retries"):
+        manager.should_commit()  # failure 2 > max_retries=1
+
+
+def test_fixed_with_spares_zeroes_spare() -> None:
+    manager, client, _, _ = make_manager(
+        pg=ProcessGroupDummy(),
+        min_replica_size=2,
+        world_size_mode=WorldSizeMode.FIXED_WITH_SPARES,
+    )
+    # This replica is rank 2 of 3 with min size 2: it is a spare.
+    client._quorum.return_value = make_quorum(
+        replica_rank=2, replica_world_size=3, max_rank=2, max_world_size=3
+    )
+    manager.start_quorum()
+    assert manager.num_participants() == 2
+    assert manager.participating_rank() is None
+    assert not manager.is_participating()
+    out = manager.allreduce(np.array([5.0, 5.0])).wait()
+    # Spare contributes zeros (dummy echoes), averaged by 2.
+    np.testing.assert_array_equal(out, np.zeros(2))
+
+
+def test_wrap_work_swallows_error_into_default() -> None:
+    manager, client, _, _ = make_manager(pg=ProcessGroupDummy())
+    client._quorum.return_value = make_quorum()
+    manager.start_quorum()
+    from concurrent.futures import Future
+
+    from torchft_tpu.work import Work
+
+    fut: Future = Future()
+    wrapped = manager.wrap_work(Work(fut), default="fallback")
+    fut.set_exception(RuntimeError("inner"))
+    assert wrapped.wait(5) == "fallback"
+    assert isinstance(manager.errored(), ExceptionWithTraceback)
+
+
+def test_wrap_work_timeout() -> None:
+    manager, client, _, _ = make_manager(pg=ProcessGroupDummy())
+    client._quorum.return_value = make_quorum()
+    manager.start_quorum()
+    from concurrent.futures import Future
+
+    from torchft_tpu.work import Work
+
+    fut: Future = Future()  # never resolves
+    wrapped = manager.wrap_work(Work(fut), default="timed-out", timeout=0.1)
+    assert wrapped.wait(5) == "timed-out"
+    assert manager.errored() is not None
+
+
+def test_state_dict_roundtrip() -> None:
+    manager, client, _, _ = make_manager(pg=ProcessGroupDummy())
+    sd = manager.state_dict()
+    assert sd == {"step": 0, "batches_committed": 0}
+    manager.load_state_dict({"step": 42, "batches_committed": 84})
+    assert manager.current_step() == 42
+    assert manager.batches_committed() == 84

@@ -1,0 +1,122 @@
+"""Manager integration tests: multi-replica-group training with injected
+faults, asserting the master invariant — bitwise state equality across
+replica groups after recovery (parity: manager_integ_test.py:334-421)."""
+
+import numpy as np
+import jax
+import pytest
+
+from torchft_tpu.coordination import LighthouseServer
+
+from ft_harness import (
+    EventInjector,
+    Runner,
+    ddp_train_loop,
+    run_replica_groups,
+)
+
+
+@pytest.fixture()
+def lighthouse():
+    # join_timeout must exceed worst-case step skew (GIL scheduling on the
+    # 1-core CI box) so a slow-but-alive group is waited for instead of being
+    # dropped — dropping it forks the gradient history, which is exactly what
+    # the bitwise-equality invariant exists to catch. Dead replicas still
+    # leave fast via the 1s heartbeat expiry.
+    server = LighthouseServer(
+        min_replicas=1,
+        join_timeout_ms=10000,
+        heartbeat_timeout_ms=1000,
+        quorum_tick_ms=20,
+    )
+    yield server
+    server.shutdown()
+
+
+def assert_pytree_equal(a, b) -> None:
+    leaves_a, tree_a = jax.tree_util.tree_flatten(a)
+    leaves_b, tree_b = jax.tree_util.tree_flatten(b)
+    assert tree_a == tree_b
+    for la, lb in zip(leaves_a, leaves_b):
+        if hasattr(la, "shape"):
+            assert np.asarray(la).tobytes() == np.asarray(lb).tobytes(), "pytree leaves differ"
+        else:
+            assert la == lb
+
+
+def assert_groups_converged(results, num_steps: int) -> None:
+    """All replica groups reached num_steps with bitwise-identical params."""
+    reference = results[0][0]["state_dict"]["params"]
+    for group_result in results:
+        rank_result = group_result[0]
+        assert rank_result["manager_state"]["step"] == num_steps
+        assert_pytree_equal(rank_result["state_dict"]["params"], reference)
+
+
+@pytest.mark.parametrize("use_async_quorum", [True, False])
+def test_ddp_two_groups_healthy(lighthouse, use_async_quorum) -> None:
+    runners = [
+        Runner(
+            replica_group=i,
+            lighthouse_addr=lighthouse.address(),
+            train_loop=ddp_train_loop,
+            num_steps=3,
+            use_async_quorum=use_async_quorum,
+        )
+        for i in range(2)
+    ]
+    results = run_replica_groups(runners)
+    assert_groups_converged(results, 3)
+
+
+def test_ddp_recovery_after_replica_kill(lighthouse) -> None:
+    injector = EventInjector().fail_at(group=1, step=1)
+    runners = [
+        Runner(
+            replica_group=i,
+            lighthouse_addr=lighthouse.address(),
+            train_loop=ddp_train_loop,
+            num_steps=4,
+            injector=injector,
+        )
+        for i in range(2)
+    ]
+    results = run_replica_groups(runners, timeout=180)
+    assert injector.count == 1
+    assert_groups_converged(results, 4)
+
+
+def test_ddp_recovery_after_allreduce_failure(lighthouse) -> None:
+    injector = EventInjector().fail_allreduce_at(group=0, step=1)
+    runners = [
+        Runner(
+            replica_group=i,
+            lighthouse_addr=lighthouse.address(),
+            train_loop=ddp_train_loop,
+            num_steps=4,
+            injector=injector,
+        )
+        for i in range(2)
+    ]
+    results = run_replica_groups(runners, timeout=180)
+    assert injector.count == 1
+    assert_groups_converged(results, 4)
+
+
+def test_ddp_three_groups_two_failures(lighthouse) -> None:
+    injector = (
+        EventInjector().fail_at(group=0, step=1).fail_allreduce_at(group=2, step=2)
+    )
+    runners = [
+        Runner(
+            replica_group=i,
+            lighthouse_addr=lighthouse.address(),
+            train_loop=ddp_train_loop,
+            num_steps=5,
+            injector=injector,
+        )
+        for i in range(3)
+    ]
+    results = run_replica_groups(runners, timeout=240)
+    assert injector.count == 2
+    assert_groups_converged(results, 5)

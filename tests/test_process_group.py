@@ -1,0 +1,367 @@
+"""ProcessGroup conformance + resiliency matrix.
+
+Parity target: the reference's process_group_test.py — per-backend collective
+smoke tests, a threads-as-replicas multi-PG harness over one store, and the
+kill-one-rank / survivors-error / reconfigure-and-recover drill.
+"""
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, List
+
+import numpy as np
+import pytest
+
+from torchft_tpu.parallel.process_group import (
+    ErrorSwallowingProcessGroupWrapper,
+    FakeProcessGroupWrapper,
+    ProcessGroup,
+    ProcessGroupDummy,
+    ProcessGroupTCP,
+    ReduceOp,
+)
+from torchft_tpu.parallel.store import StoreClient, StoreServer, create_store_client
+
+
+@pytest.fixture(scope="module")
+def store_server():
+    server = StoreServer()
+    yield server
+    server.shutdown()
+
+
+_prefix_counter = [0]
+
+
+def fresh_prefix() -> str:
+    _prefix_counter[0] += 1
+    return f"test/{_prefix_counter[0]}"
+
+
+def make_group(
+    store_server: StoreServer, world_size: int, timeout: float = 10.0
+) -> List[ProcessGroupTCP]:
+    """Configures ``world_size`` ProcessGroupTCPs on threads over one store."""
+    prefix = fresh_prefix()
+    pgs = [ProcessGroupTCP(timeout=timeout) for _ in range(world_size)]
+    with ThreadPoolExecutor(max_workers=world_size) as pool:
+        futures = [
+            pool.submit(
+                pg.configure,
+                f"{store_server.address()}/{prefix}",
+                f"replica_{i}",
+                i,
+                world_size,
+            )
+            for i, pg in enumerate(pgs)
+        ]
+        for f in futures:
+            f.result(timeout=30)
+    return pgs
+
+
+def run_on_all(pgs: List[ProcessGroup], fn: Callable[[ProcessGroup, int], object]) -> list:
+    with ThreadPoolExecutor(max_workers=len(pgs)) as pool:
+        futures = [pool.submit(fn, pg, i) for i, pg in enumerate(pgs)]
+        return [f.result(timeout=30) for f in futures]
+
+
+# ---------------------------------------------------------------------------
+# store
+# ---------------------------------------------------------------------------
+
+
+def test_store_set_get_add(store_server) -> None:
+    client = StoreClient(store_server.address(), prefix=fresh_prefix())
+    client.set("k", b"v")
+    assert client.get("k") == b"v"
+    assert client.get("missing", wait=False) is None
+    assert client.add("ctr") == 1
+    assert client.add("ctr", 2) == 3
+    assert client.delete("k")
+    assert client.get("k", wait=False) is None
+    client.close()
+
+
+def test_store_blocking_get(store_server) -> None:
+    client = StoreClient(store_server.address(), prefix=fresh_prefix())
+    writer = StoreClient(store_server.address(), prefix=client._prefix)
+
+    def write_later() -> None:
+        time.sleep(0.2)
+        writer.set("late", b"arrived")
+
+    t = threading.Thread(target=write_later)
+    t.start()
+    assert client.get("late", timeout=5.0) == b"arrived"
+    t.join()
+    with pytest.raises(TimeoutError):
+        client.get("never", timeout=0.2)
+    client.close()
+    writer.close()
+
+
+def test_store_prefix_isolation(store_server) -> None:
+    a = create_store_client(store_server.address() + "/jobA")
+    b = create_store_client(store_server.address() + "/jobB")
+    a.set("k", b"a")
+    assert b.get("k", wait=False) is None
+    a.close()
+    b.close()
+
+
+# ---------------------------------------------------------------------------
+# collectives conformance (2 and 4 ranks)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("world_size", [2, 4])
+def test_allreduce_sum_avg(store_server, world_size) -> None:
+    pgs = make_group(store_server, world_size)
+    try:
+        results = run_on_all(
+            pgs,
+            lambda pg, i: pg.allreduce(
+                [np.full((4, 3), float(i + 1), dtype=np.float32)], ReduceOp.SUM
+            ).wait(),
+        )
+        expected = sum(range(1, world_size + 1))
+        for r in results:
+            np.testing.assert_array_equal(r[0], np.full((4, 3), expected, np.float32))
+        # All ranks bitwise identical.
+        assert all(r[0].tobytes() == results[0][0].tobytes() for r in results)
+
+        results = run_on_all(
+            pgs,
+            lambda pg, i: pg.allreduce(
+                [np.full(5, float(i), dtype=np.float32)], ReduceOp.AVG
+            ).wait(),
+        )
+        mean = sum(range(world_size)) / world_size
+        for r in results:
+            np.testing.assert_allclose(r[0], np.full(5, mean, np.float32))
+    finally:
+        for pg in pgs:
+            pg.shutdown()
+
+
+def test_allreduce_bfloat16(store_server) -> None:
+    import ml_dtypes
+
+    pgs = make_group(store_server, 2)
+    try:
+        results = run_on_all(
+            pgs,
+            lambda pg, i: pg.allreduce(
+                [np.full(8, 1.5 + i, dtype=ml_dtypes.bfloat16)], ReduceOp.SUM
+            ).wait(),
+        )
+        for r in results:
+            assert r[0].dtype == ml_dtypes.bfloat16
+            np.testing.assert_allclose(r[0].astype(np.float32), np.full(8, 4.0))
+    finally:
+        for pg in pgs:
+            pg.shutdown()
+
+
+def test_allgather_broadcast(store_server) -> None:
+    pgs = make_group(store_server, 3)
+    try:
+        gathered = run_on_all(
+            pgs, lambda pg, i: pg.allgather([np.array([i, i * 10])]).wait()
+        )
+        for per_rank in gathered:
+            assert len(per_rank) == 3
+            for i, arrays in enumerate(per_rank):
+                np.testing.assert_array_equal(arrays[0], np.array([i, i * 10]))
+
+        broadcasted = run_on_all(
+            pgs,
+            lambda pg, i: pg.broadcast([np.array([i, 7])], root=1).wait(),
+        )
+        for r in broadcasted:
+            np.testing.assert_array_equal(r[0], np.array([1, 7]))
+    finally:
+        for pg in pgs:
+            pg.shutdown()
+
+
+def test_reduce_scatter_alltoall(store_server) -> None:
+    pgs = make_group(store_server, 2)
+    try:
+        scattered = run_on_all(
+            pgs,
+            lambda pg, i: pg.reduce_scatter(
+                [np.arange(4, dtype=np.float32) + i], ReduceOp.SUM
+            ).wait(),
+        )
+        # total = [1, 3, 5, 7]; rank 0 gets [1, 3], rank 1 gets [5, 7]
+        np.testing.assert_array_equal(scattered[0][0], np.array([1.0, 3.0]))
+        np.testing.assert_array_equal(scattered[1][0], np.array([5.0, 7.0]))
+
+        exchanged = run_on_all(
+            pgs,
+            lambda pg, i: pg.alltoall(
+                [np.array([i * 10 + j]) for j in range(2)]
+            ).wait(),
+        )
+        # result[j] on rank i came from rank j and is j*10 + i
+        for i, per_rank in enumerate(exchanged):
+            for j, arr in enumerate(per_rank):
+                np.testing.assert_array_equal(arr, np.array([j * 10 + i]))
+    finally:
+        for pg in pgs:
+            pg.shutdown()
+
+
+def test_send_recv_barrier(store_server) -> None:
+    pgs = make_group(store_server, 2)
+    try:
+
+        def exchange(pg: ProcessGroup, i: int):
+            if i == 0:
+                pg.send([np.array([42.0])], dst=1).wait()
+                return None
+            return pg.recv([np.empty(1)], src=0).wait()
+
+        results = run_on_all(pgs, exchange)
+        np.testing.assert_array_equal(results[1][0], np.array([42.0]))
+        run_on_all(pgs, lambda pg, i: pg.barrier().wait())
+    finally:
+        for pg in pgs:
+            pg.shutdown()
+
+
+def test_collectives_overlap_in_order(store_server) -> None:
+    """Multiple outstanding ops complete in submission order."""
+    pgs = make_group(store_server, 2)
+    try:
+
+        def submit_many(pg: ProcessGroup, i: int):
+            works = [
+                pg.allreduce([np.full(2, float(k * (i + 1)))], ReduceOp.SUM)
+                for k in range(5)
+            ]
+            return [w.wait()[0] for w in works]
+
+        results = run_on_all(pgs, submit_many)
+        for r in results:
+            for k in range(5):
+                np.testing.assert_array_equal(r[k], np.full(2, float(k * 1 + k * 2)))
+    finally:
+        for pg in pgs:
+            pg.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# resiliency: kill a rank, survivors error, reconfigure, recover
+# ---------------------------------------------------------------------------
+
+
+def test_resiliency_kill_and_reconfigure(store_server) -> None:
+    world_size = 3
+    pgs = make_group(store_server, world_size, timeout=2.0)
+    try:
+        # Baseline round works.
+        run_on_all(pgs, lambda pg, i: pg.allreduce([np.ones(2)], ReduceOp.SUM).wait())
+
+        # Kill the last rank mid-flight; survivors' next collective fails.
+        pgs[-1].shutdown()
+
+        def survivor_round(pg: ProcessGroup, i: int):
+            if i == world_size - 1:
+                return None
+            with pytest.raises(Exception):
+                pg.allreduce([np.ones(2)], ReduceOp.SUM).wait(timeout=10)
+            return pg.errored()
+
+        errors = run_on_all(pgs[:-1], survivor_round)
+        assert all(e is not None for e in errors)
+
+        # Reconfigure the survivors under a fresh prefix; collective recovers.
+        prefix = fresh_prefix()
+        run_on_all(
+            pgs[:-1],
+            lambda pg, i: pg.configure(
+                f"{store_server.address()}/{prefix}", f"replica_{i}", i, world_size - 1
+            ),
+        )
+        assert all(pg.errored() is None for pg in pgs[:-1])
+        results = run_on_all(
+            pgs[:-1], lambda pg, i: pg.allreduce([np.ones(2)], ReduceOp.SUM).wait()
+        )
+        for r in results:
+            np.testing.assert_array_equal(r[0], np.full(2, 2.0))
+    finally:
+        for pg in pgs:
+            pg.shutdown()
+
+
+def test_abort_poisons_until_reconfigure(store_server) -> None:
+    pgs = make_group(store_server, 2)
+    try:
+        pgs[0].abort()
+        assert pgs[0].errored() is not None
+        with pytest.raises(RuntimeError, match="error state"):
+            pgs[0].allreduce([np.ones(1)])
+    finally:
+        for pg in pgs:
+            pg.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# dummy + wrappers
+# ---------------------------------------------------------------------------
+
+
+def test_dummy_pg_counts_and_loopback() -> None:
+    pg = ProcessGroupDummy()
+    out = pg.allreduce([np.array([1.0, 2.0])]).wait()
+    np.testing.assert_array_equal(out[0], np.array([1.0, 2.0]))
+    pg.barrier().wait()
+    assert pg.op_counts == {"allreduce": 1, "barrier": 1}
+
+
+def test_error_swallowing_wrapper() -> None:
+    inner = ProcessGroupDummy()
+    pg = ErrorSwallowingProcessGroupWrapper(inner)
+    assert pg.errored() is None
+    out = pg.allreduce([np.ones(2)]).wait()
+    np.testing.assert_array_equal(out[0], np.ones(2))
+
+    pg.report_error(RuntimeError("injected"))
+    assert pg.errored() is not None
+    # Ops after the error become dummies returning the input.
+    out = pg.allreduce([np.full(2, 5.0)]).wait()
+    np.testing.assert_array_equal(out[0], np.full(2, 5.0))
+    # Reconfigure clears it.
+    pg.configure("ignored:0/x", "r", 0, 1)
+    assert pg.errored() is None
+
+
+def test_fake_wrapper_injects_future_error() -> None:
+    inner = ProcessGroupDummy()
+    pg = FakeProcessGroupWrapper(inner)
+    pg.report_future_error(RuntimeError("boom"))
+    work = pg.allreduce([np.ones(1)])
+    with pytest.raises(RuntimeError, match="boom"):
+        work.wait()
+    assert pg.errored() is not None
+    # Only the next op was poisoned.
+    pg.configure("ignored:0/x", "r", 0, 1)
+    assert pg.errored() is None
+    pg.allreduce([np.ones(1)]).wait()
+
+
+def test_store_add_shares_keyspace_with_get(store_server) -> None:
+    """TCPStore semantics: counters are visible to get/wait as decimal strings."""
+    client = StoreClient(store_server.address(), prefix=fresh_prefix())
+    assert client.add("ready") == 1
+    assert client.get("ready", wait=False) == b"1"
+    waiter = StoreClient(store_server.address(), prefix=client._prefix)
+    assert waiter.get("ready", timeout=2.0) == b"1"
+    client.set("ready", b"41")
+    assert client.add("ready") == 42
+    client.close()
+    waiter.close()

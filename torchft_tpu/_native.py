@@ -103,6 +103,17 @@ def load() -> ctypes.CDLL:
         lib.tpuft_manager_shutdown.argtypes = [ctypes.c_void_p]
         lib.tpuft_manager_free.argtypes = [ctypes.c_void_p]
 
+        lib.tpuft_store_new.restype = ctypes.c_void_p
+        lib.tpuft_store_new.argtypes = [ctypes.c_char_p]
+        lib.tpuft_store_address.restype = ctypes.c_int
+        lib.tpuft_store_address.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_int,
+        ]
+        lib.tpuft_store_shutdown.argtypes = [ctypes.c_void_p]
+        lib.tpuft_store_free.argtypes = [ctypes.c_void_p]
+
         _lib = lib
         return _lib
 

@@ -1,0 +1,14 @@
+"""Checkpoint transports: live peer-to-peer healing of parameter pytrees.
+
+Two axes (reference: SURVEY.md §5 checkpoint/resume):
+ (a) live healing via :class:`CheckpointTransport` — peer-to-peer, never
+     touches disk;
+ (b) user periodic checkpoints — persist model/optim *and* the manager
+     state_dict (step/batches_committed), e.g. with orbax.
+"""
+
+from torchft_tpu.checkpointing.http_transport import HTTPTransport
+from torchft_tpu.checkpointing.pg_transport import PGTransport
+from torchft_tpu.checkpointing.transport import CheckpointTransport
+
+__all__ = ["CheckpointTransport", "HTTPTransport", "PGTransport"]

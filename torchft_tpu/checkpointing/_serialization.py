@@ -1,0 +1,116 @@
+"""Streaming (de)serialization of state pytrees.
+
+Wire format (role of the reference's ``_streaming_save/_load``,
+checkpointing/_serialization.py): a pickled header describing the pytree
+structure and per-leaf array metadata, followed by the raw array buffers in
+order. Array leaves stream as raw bytes (no pickle copy of the payload);
+non-array leaves ride in the header. jax arrays are staged device→host and
+come back as numpy — the caller is responsible for any device_put.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+import struct
+from dataclasses import dataclass
+from typing import Any, BinaryIO, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["save_state_dict", "load_state_dict", "state_dict_meta", "ArrayMeta"]
+
+_LEN = struct.Struct("!Q")
+_MAGIC = b"TPFT1\n"
+
+
+@dataclass
+class ArrayMeta:
+    shape: Tuple[int, ...]
+    dtype: str  # np.dtype name (ml_dtypes names resolve via registry)
+    nbytes: int
+
+
+def _to_host(leaf: Any) -> Any:
+    """Stages array-like leaves to host numpy; passes others through."""
+    if isinstance(leaf, np.ndarray):
+        return leaf
+    # jax.Array without importing jax at module load.
+    if hasattr(leaf, "__array__") and hasattr(leaf, "dtype") and hasattr(leaf, "shape"):
+        return np.asarray(leaf)
+    return leaf
+
+
+def _flatten(state_dict: Any) -> Tuple[List[Any], Any]:
+    import jax
+
+    return jax.tree_util.tree_flatten(state_dict)
+
+
+def state_dict_meta(state_dict: Any) -> Tuple[Any, List[Optional[ArrayMeta]], List[Any]]:
+    """Returns (treedef, per-leaf ArrayMeta-or-None, host leaves)."""
+    leaves, treedef = _flatten(state_dict)
+    leaves = [_to_host(leaf) for leaf in leaves]
+    metas: List[Optional[ArrayMeta]] = []
+    for leaf in leaves:
+        if isinstance(leaf, np.ndarray):
+            leaf_c = np.ascontiguousarray(leaf)
+            metas.append(ArrayMeta(leaf_c.shape, leaf_c.dtype.name, leaf_c.nbytes))
+        else:
+            metas.append(None)
+    return treedef, metas, leaves
+
+
+def save_state_dict(state_dict: Any, stream: BinaryIO) -> None:
+    treedef, metas, leaves = state_dict_meta(state_dict)
+    non_array = [leaf for leaf, meta in zip(leaves, metas) if meta is None]
+    header = pickle.dumps((treedef, metas, non_array))
+    stream.write(_MAGIC)
+    stream.write(_LEN.pack(len(header)))
+    stream.write(header)
+    for leaf, meta in zip(leaves, metas):
+        if meta is not None:
+            stream.write(np.ascontiguousarray(leaf).tobytes())
+
+
+def _resolve_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def load_state_dict(stream: BinaryIO) -> Any:
+    import jax
+
+    magic = stream.read(len(_MAGIC))
+    if magic != _MAGIC:
+        raise ValueError("bad checkpoint stream magic")
+    (header_len,) = _LEN.unpack(stream.read(_LEN.size))
+    treedef, metas, non_array = pickle.loads(stream.read(header_len))
+    non_array_iter = iter(non_array)
+    leaves = []
+    for meta in metas:
+        if meta is None:
+            leaves.append(next(non_array_iter))
+        else:
+            dtype = _resolve_dtype(meta.dtype)
+            buf = stream.read(meta.nbytes)
+            if len(buf) != meta.nbytes:
+                raise EOFError(
+                    f"truncated checkpoint stream: wanted {meta.nbytes} bytes, got {len(buf)}"
+                )
+            leaves.append(np.frombuffer(buf, dtype=dtype).reshape(meta.shape).copy())
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def dumps(state_dict: Any) -> bytes:
+    buf = io.BytesIO()
+    save_state_dict(state_dict, buf)
+    return buf.getvalue()
+
+
+def loads(data: bytes) -> Any:
+    return load_state_dict(io.BytesIO(data))

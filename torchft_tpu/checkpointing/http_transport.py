@@ -1,0 +1,173 @@
+"""HTTP checkpoint transport.
+
+Role-equivalent of the reference's ``HTTPTransport``
+(checkpointing/http_transport.py:39-299): a threaded HTTP server streams the
+staged state pytree to healing peers; an RWLock-style gate keeps the staged
+data immutable while serving and blocks serving while the trainer mutates
+state. Chunked mode splits flattened pytree leaves round-robin into N
+independently-fetchable chunks pulled in parallel.
+
+Routes: ``/checkpoint/{step}/meta``, ``/checkpoint/{step}/full``,
+``/checkpoint/{step}/{chunk_index}``.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import threading
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+
+from torchft_tpu.checkpointing import _serialization
+from torchft_tpu.checkpointing.transport import CheckpointTransport
+
+__all__ = ["HTTPTransport"]
+
+
+class _Staged:
+    def __init__(self, step: int, chunks: List[bytes], treedef: Any) -> None:
+        self.step = step
+        self.chunks = chunks
+        self.treedef = treedef
+
+
+class HTTPTransport(CheckpointTransport[Any]):
+    """Serves the staged checkpoint over HTTP; IPv6 dual-stack like the
+    reference so it works across heterogeneous TPU pods."""
+
+    def __init__(self, timeout: float = 60.0, num_chunks: int = 0) -> None:
+        self._timeout = timeout
+        self._num_chunks = num_chunks
+        # Condition gates serving: a GET for step S parks until the trainer
+        # stages S (send_checkpoint) — the reference's RWLock allow/disallow
+        # gate (http_transport.py:182-242). Without this the joiner's fetch
+        # races the donor's staging inside the same quorum round.
+        self._cond = threading.Condition()
+        self._staged: Optional[_Staged] = None
+        self._served_event = threading.Event()
+
+        transport = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt: str, *args: Any) -> None:  # silence
+                pass
+
+            def do_GET(self) -> None:
+                parts = self.path.strip("/").split("/")
+                if len(parts) != 3 or parts[0] != "checkpoint":
+                    self.send_error(404, "unknown route")
+                    return
+                try:
+                    step = int(parts[1])
+                except ValueError:
+                    self.send_error(400, "bad step")
+                    return
+                with transport._cond:
+                    transport._cond.wait_for(
+                        lambda: transport._staged is not None
+                        and transport._staged.step == step,
+                        timeout=transport._timeout,
+                    )
+                    staged = transport._staged
+                if staged is None or staged.step != step:
+                    self.send_error(
+                        404,
+                        f"no checkpoint staged for step {step}"
+                        + (f" (have {staged.step})" if staged else ""),
+                    )
+                    return
+                if parts[2] == "meta":
+                    body = pickle.dumps((len(staged.chunks), staged.treedef))
+                elif parts[2] == "full":
+                    body = b"".join(
+                        len(c).to_bytes(8, "big") + c for c in staged.chunks
+                    )
+                else:
+                    try:
+                        index = int(parts[2])
+                        body = staged.chunks[index]
+                    except (ValueError, IndexError):
+                        self.send_error(400, "bad chunk index")
+                        return
+                self.send_response(200)
+                self.send_header("Content-Type", "application/octet-stream")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                transport._served_event.set()
+
+        class DualStackServer(ThreadingHTTPServer):
+            address_family = socket.AF_INET6
+            daemon_threads = True
+
+        self._server = DualStackServer(("::", 0), Handler)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True, name="tpuft-http-ckpt"
+        )
+        self._thread.start()
+
+    # -- CheckpointTransport -----------------------------------------------
+
+    def metadata(self) -> str:
+        host = socket.gethostname()
+        port = self._server.server_address[1]
+        return f"http://{host}:{port}"
+
+    def send_checkpoint(
+        self, dst_ranks: List[int], step: int, state_dict: Any, timeout: float
+    ) -> None:
+        """Stages host copies of the state and starts serving them for
+        ``step``. Serving continues until :meth:`disallow_checkpoint`."""
+        leaves, treedef = jax.tree_util.tree_flatten(state_dict)
+        leaves = [_serialization._to_host(leaf) for leaf in leaves]
+        n = self._num_chunks if self._num_chunks > 0 else 1
+        n = min(n, max(len(leaves), 1))
+        chunk_dicts: List[Dict[int, Any]] = [dict() for _ in range(n)]
+        for i, leaf in enumerate(leaves):
+            chunk_dicts[i % n][i] = leaf
+        chunks = [_serialization.dumps(chunk) for chunk in chunk_dicts]
+        with self._cond:
+            self._staged = _Staged(step, chunks, treedef)
+            self._cond.notify_all()
+
+    def disallow_checkpoint(self) -> None:
+        with self._cond:
+            self._staged = None
+
+    def recv_checkpoint(
+        self, src_rank: int, metadata: str, step: int, timeout: float
+    ) -> Any:
+        base = f"{metadata}/checkpoint/{step}"
+        num_chunks, treedef = pickle.loads(_fetch(f"{base}/meta", timeout))
+        if num_chunks == 1:
+            payloads = [_fetch(f"{base}/0", timeout)]
+        else:
+            with ThreadPoolExecutor(max_workers=min(num_chunks, 8)) as pool:
+                payloads = list(
+                    pool.map(
+                        lambda i: _fetch(f"{base}/{i}", timeout), range(num_chunks)
+                    )
+                )
+        merged: Dict[int, Any] = {}
+        for payload in payloads:
+            merged.update(_serialization.loads(payload))
+        leaves = [merged[i] for i in range(len(merged))]
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+    def shutdown(self, wait: bool = True) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if wait:
+            self._thread.join(timeout=5)
+
+
+def _fetch(url: str, timeout: float) -> bytes:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read()

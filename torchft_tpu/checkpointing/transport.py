@@ -1,0 +1,39 @@
+"""CheckpointTransport ABC (reference: checkpointing/transport.py:14-68)."""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Any, Generic, List, TypeVar
+
+T = TypeVar("T")
+
+__all__ = ["CheckpointTransport"]
+
+
+class CheckpointTransport(ABC, Generic[T]):
+    """Live peer-to-peer state transfer used for healing joining replicas.
+
+    The donor stages its state and serves it without pausing training; the
+    joiner fetches and applies it before its first committed step.
+    """
+
+    @abstractmethod
+    def metadata(self) -> str:
+        """Transport metadata handed to peers via the manager (e.g. the
+        donor's serving address)."""
+
+    @abstractmethod
+    def send_checkpoint(
+        self, dst_ranks: List[int], step: int, state_dict: T, timeout: float
+    ) -> None:
+        """Stages/sends ``state_dict`` for ``dst_ranks`` at ``step``."""
+
+    @abstractmethod
+    def recv_checkpoint(self, src_rank: int, metadata: str, step: int, timeout: float) -> T:
+        """Fetches the state for ``step`` from ``src_rank``."""
+
+    def disallow_checkpoint(self) -> None:
+        """Stops serving the staged checkpoint (called at commit)."""
+
+    def shutdown(self, wait: bool = True) -> None:
+        """Tears the transport down."""

@@ -1,0 +1,77 @@
+"""Data sharding across replica groups.
+
+Role-equivalent of the reference's ``DistributedSampler``
+(/root/reference/torchft/data.py:24-77): shards a dataset over
+``num_replica_groups x num_replicas`` workers by computing a global rank
+``group_rank + num_replicas * replica_rank``. As in the reference, this is
+a best-effort scheme — on membership change the dataset offsets shift, so
+some samples may repeat or be skipped (documented lossiness, data.py:33-37);
+exact accounting belongs to a stateful loader checkpointed per replica.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+import numpy as np
+
+__all__ = ["DistributedSampler"]
+
+
+class DistributedSampler:
+    """Deterministic, shardable index sampler.
+
+    Args:
+        dataset_size: total examples.
+        replica_rank: which replica group this worker belongs to.
+        num_replica_groups: total replica groups in the job.
+        group_rank: this worker's rank within its replica group.
+        num_replicas: workers per replica group.
+        shuffle: permute indices per epoch (seeded by epoch for determinism).
+        seed: base RNG seed shared by all workers.
+    """
+
+    def __init__(
+        self,
+        dataset_size: int,
+        replica_rank: int,
+        num_replica_groups: int,
+        group_rank: int = 0,
+        num_replicas: int = 1,
+        shuffle: bool = True,
+        seed: int = 0,
+        batch_size: Optional[int] = None,
+    ) -> None:
+        self.dataset_size = dataset_size
+        self.global_rank = group_rank + num_replicas * replica_rank
+        self.global_world_size = num_replicas * num_replica_groups
+        self.shuffle = shuffle
+        self.seed = seed
+        self.batch_size = batch_size
+        self.epoch = 0
+        self.num_samples = dataset_size // self.global_world_size
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def __len__(self) -> int:
+        return self.num_samples
+
+    def __iter__(self) -> Iterator[int]:
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            order = rng.permutation(self.dataset_size)
+        else:
+            order = np.arange(self.dataset_size)
+        shard = order[self.global_rank :: self.global_world_size][: self.num_samples]
+        return iter(shard.tolist())
+
+    def batches(self) -> Iterator[np.ndarray]:
+        """Yields index batches of ``batch_size`` (requires batch_size)."""
+        assert self.batch_size is not None, "batch_size not set"
+        batch = []
+        for index in self:
+            batch.append(index)
+            if len(batch) == self.batch_size:
+                yield np.array(batch)
+                batch = []

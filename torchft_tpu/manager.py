@@ -1,0 +1,672 @@
+"""Manager: the per-rank fault-tolerance state machine.
+
+Role-equivalent of the reference's ``torchft/manager.py:137`` — the heart of
+the library. Embedded in the train loop, it:
+
+- computes quorums (async, overlapped with the forward pass) via the native
+  ManagerServer/Lighthouse plane;
+- reconfigures the replica-axis process group when membership changes
+  (``configure`` under a fresh store prefix keyed by quorum_id);
+- runs fault-tolerant gradient allreduces: zeros contributions from
+  non-participating replicas, converts AVG to SUM + divide by the live
+  participant count so numerics stay N-independent, and swallows collective
+  errors into a sticky per-step error state;
+- live-heals joining replicas by streaming the state pytree from a healthy
+  donor via a :class:`CheckpointTransport`;
+- arbitrates per-step commits via the all-local-rank AND barrier
+  (``should_commit``), incrementing the step only on quorum-wide success.
+
+Step protocol (see also optim.OptimizerWrapper)::
+
+    manager.start_quorum()          # before forward
+    grads = grad_fn(params, batch)  # forward/backward
+    work = manager.allreduce_pytree(grads)
+    grads = work.wait()
+    if manager.should_commit():     # commit barrier
+        params = apply_update(params, grads)
+
+On TPU the collectives here ride host DCN between replica groups
+(parallel/process_group.py); intra-slice collectives stay inside the jitted
+step as XLA psums over the device mesh (parallel/mesh.py).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import logging
+import os
+import socket
+import threading
+import traceback
+import uuid
+from datetime import timedelta
+from enum import Enum
+from typing import Any, Callable, Dict, List, Optional, TypeVar, cast
+
+import numpy as np
+
+from torchft_tpu.checkpointing import CheckpointTransport, HTTPTransport
+from torchft_tpu.checkpointing._rwlock import RWLock
+from torchft_tpu.coordination import ManagerClient, ManagerServer
+from torchft_tpu.parallel.process_group import ProcessGroup, ReduceOp
+from torchft_tpu.parallel.store import StoreClient
+from torchft_tpu.telemetry import commits_logger, errors_logger, quorums_logger
+from torchft_tpu.work import Work, _DummyWork
+
+T = TypeVar("T")
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["Manager", "WorldSizeMode", "ExceptionWithTraceback"]
+
+# Env overrides (reference: manager.py:82-89).
+TIMEOUT_SEC_ENV = "TPUFT_TIMEOUT_SEC"
+QUORUM_TIMEOUT_SEC_ENV = "TPUFT_QUORUM_TIMEOUT_SEC"
+CONNECT_TIMEOUT_SEC_ENV = "TPUFT_CONNECT_TIMEOUT_SEC"
+QUORUM_RETRIES_ENV = "TPUFT_QUORUM_RETRIES"
+LIGHTHOUSE_ENV = "TPUFT_LIGHTHOUSE"
+MANAGER_PORT_ENV = "TPUFT_MANAGER_PORT"
+
+
+def _env_timeout(env: str, default: float) -> float:
+    value = os.environ.get(env)
+    return float(value) if value is not None else default
+
+
+class WorldSizeMode(Enum):
+    """Numerics policy when more than ``min_replica_size`` replicas are live
+    (reference: manager.py:112-127).
+
+    DYNAMIC: world size grows to all available replicas; gradients are
+        normalized by the live count.
+    FIXED_WITH_SPARES: exactly ``min_replica_size`` replicas participate;
+        spares contribute zero gradients and are normalized away.
+    """
+
+    DYNAMIC = 0
+    FIXED_WITH_SPARES = 1
+
+
+class ExceptionWithTraceback(Exception):
+    def __init__(self, e: Exception) -> None:
+        self.original_exception = e
+        self.stack_trace: str = traceback.format_exc()
+        super().__init__(f"{e}\n{self.stack_trace}")
+
+
+class Manager:
+    """Fault tolerance manager for one rank of one replica group.
+
+    Args:
+        pg: the replica-axis process group (reconfigured on quorum change).
+        min_replica_size: minimum replicas for a step to commit.
+        store: rendezvous store client for this replica group (local-rank
+            coordination + advertised to peers for PG rendezvous).
+        store_addr: the group store's "host:port" advertised to other groups.
+        load_state_dict/state_dict: legacy single-key state registration;
+            prefer :meth:`register_state_dict_fn`.
+        use_async_quorum: overlap quorum with the forward pass; the joining
+            replica skips participation for one step instead of blocking all.
+        replica_id: stable prefix for this group's identity; a uuid suffix is
+            appended per process lifetime.
+        group_rank/group_world_size: this process's coordinates inside the
+            replica group (host index / hosts per group).
+    """
+
+    def __init__(
+        self,
+        pg: ProcessGroup,
+        min_replica_size: int,
+        store: StoreClient,
+        store_addr: str,
+        load_state_dict: Optional[Callable[[T], None]] = None,
+        state_dict: Optional[Callable[[], T]] = None,
+        use_async_quorum: bool = True,
+        timeout: float = 60.0,
+        quorum_timeout: float = 60.0,
+        connect_timeout: float = 10.0,
+        group_rank: Optional[int] = None,
+        group_world_size: Optional[int] = None,
+        world_size_mode: WorldSizeMode = WorldSizeMode.DYNAMIC,
+        lighthouse_addr: Optional[str] = None,
+        replica_id: Optional[str] = None,
+        manager_bind: str = "[::]:0",
+        hostname: str = "",
+        heartbeat_interval: float = 0.1,
+        checkpoint_transport: Optional[CheckpointTransport] = None,
+        init_sync: bool = True,
+        max_retries: Optional[int] = None,
+        quorum_retries: int = 0,
+    ) -> None:
+        self._pg = pg
+        self._min_replica_size = min_replica_size
+        self._timeout = _env_timeout(TIMEOUT_SEC_ENV, timeout)
+        self._quorum_timeout = _env_timeout(QUORUM_TIMEOUT_SEC_ENV, quorum_timeout)
+        self._connect_timeout = _env_timeout(CONNECT_TIMEOUT_SEC_ENV, connect_timeout)
+        self._quorum_retries = int(
+            os.environ.get(QUORUM_RETRIES_ENV, str(quorum_retries))
+        )
+        self._use_async_quorum = use_async_quorum
+        self._replica_world_size_mode = world_size_mode
+        self._init_sync = init_sync
+        self._max_retries = max_retries
+
+        self._group_rank: int = (
+            group_rank if group_rank is not None else int(os.environ.get("GROUP_RANK", "0"))
+        )
+        self._group_world_size: int = (
+            group_world_size
+            if group_world_size is not None
+            else int(os.environ.get("GROUP_WORLD_SIZE", "1"))
+        )
+
+        self._store = store
+        self._checkpoint_transport: CheckpointTransport = (
+            checkpoint_transport
+            if checkpoint_transport is not None
+            else HTTPTransport(timeout=self._timeout)
+        )
+
+        # State-dict function registry under a readers-writer lock: readers
+        # are checkpoint serves, the writer is the optimizer step
+        # (reference: manager.py:229, :341-366).
+        self._state_dict_lock = RWLock()
+        self._load_state_dict_fns: Dict[str, Callable[[Any], None]] = {}
+        self._user_state_dicts: Dict[str, Callable[[], Any]] = {}
+        if load_state_dict is not None and state_dict is not None:
+            self.register_state_dict_fn("default", load_state_dict, state_dict)
+
+        # Step/commit accounting.
+        self._step = 0
+        self._batches_committed = 0
+        self._commit_failures = 0
+
+        # Per-step error/heal state.
+        self._errored: Optional[ExceptionWithTraceback] = None
+        self._healing = False
+        self._pending_state_dict: Optional[Dict[str, Any]] = None
+
+        # Quorum state.
+        self._quorum_id = -1
+        self._quorum_future: Optional[concurrent.futures.Future] = None
+        self._participating_replica_rank: Optional[int] = None
+        self._participating_replica_world_size: int = 0
+        self._executor = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="tpuft_quorum"
+        )
+
+        # Rank 0 embeds the native ManagerServer; other local ranks discover
+        # its address through the group store (reference: manager.py:293-325).
+        self._manager: Optional[ManagerServer] = None
+        hostname = hostname or socket.gethostname()
+        if self._group_rank == 0:
+            lighthouse = lighthouse_addr or os.environ.get(LIGHTHOUSE_ENV)
+            if lighthouse is None:
+                raise ValueError(
+                    f"rank 0 requires lighthouse_addr or ${LIGHTHOUSE_ENV}"
+                )
+            bind = manager_bind
+            port_env = os.environ.get(MANAGER_PORT_ENV)
+            if port_env is not None and bind == "[::]:0":
+                bind = f"[::]:{port_env}"
+            replica_id = (replica_id or "") + ":" + str(uuid.uuid4())
+            self._manager = ManagerServer(
+                replica_id=replica_id,
+                lighthouse_addr=lighthouse,
+                address=hostname,
+                bind=bind,
+                store_addr=store_addr,
+                world_size=self._group_world_size,
+                heartbeat_interval=heartbeat_interval,
+                connect_timeout=self._connect_timeout,
+                quorum_retries=self._quorum_retries,
+            )
+            self._store.set("manager_addr", self._manager.address().encode())
+            self._store.set("replica_id", replica_id.encode())
+
+        addr = self._store.get("manager_addr", timeout=self._connect_timeout)
+        assert addr is not None
+        replica_id_bytes = self._store.get("replica_id", timeout=self._connect_timeout)
+        assert replica_id_bytes is not None
+        self._replica_id = replica_id_bytes.decode()
+        self._client = ManagerClient(addr.decode(), connect_timeout=self._connect_timeout)
+
+        self._logger = _ManagerLogger(self, self._replica_id, self._group_rank)
+
+    # ------------------------------------------------------------------
+    # state dict registry
+    # ------------------------------------------------------------------
+
+    def register_state_dict_fn(
+        self,
+        key: str,
+        load_state_dict: Callable[[T], None],
+        state_dict: Callable[[], T],
+    ) -> None:
+        assert key not in self._load_state_dict_fns, f"duplicate state dict key {key}"
+        self._load_state_dict_fns[key] = cast(Callable[[Any], None], load_state_dict)
+        self._user_state_dicts[key] = state_dict
+
+    def shutdown(self, wait: bool = True) -> None:
+        self._checkpoint_transport.shutdown(wait=wait)
+        if self._manager is not None:
+            self._manager.shutdown()
+        self._executor.shutdown(wait=wait)
+        self._client.close()
+
+    # ------------------------------------------------------------------
+    # allreduce
+    # ------------------------------------------------------------------
+
+    def allreduce(
+        self,
+        tensor: Any,
+        should_quantize: bool = False,
+        reduce_op: ReduceOp = ReduceOp.AVG,
+    ) -> Work:
+        """Fault-tolerant allreduce (reference: manager.py:385-467).
+
+        Stages ``tensor`` to host, averages it across participating replica
+        groups, and returns a :class:`Work` resolving to the result (numpy).
+        On error the work resolves to the *input* tensor and the error is
+        tracked via :meth:`errored` — the step will not commit.
+
+        AVG runs as SUM + divide by ``num_participants()`` so the math is
+        world-size independent; non-participating replicas contribute zeros.
+        """
+        if self.errored():
+            return _DummyWork(tensor)
+
+        self.wait_quorum()
+        num_participants = self.num_participants()
+
+        array = np.asarray(tensor)
+        if not self.is_participating():
+            array = np.zeros_like(array)
+
+        pg_reduce_op = reduce_op
+        if reduce_op == ReduceOp.AVG:
+            # kind "V" covers ml_dtypes custom floats (bfloat16, fp8).
+            if array.dtype.kind not in ("f", "V"):
+                raise ValueError("average reduce op requires floating point tensors")
+            pg_reduce_op = ReduceOp.SUM
+
+        try:
+            if should_quantize:
+                from torchft_tpu.parallel.collectives import allreduce_quantized
+
+                work = allreduce_quantized([array], pg_reduce_op, self._pg)
+            else:
+                work = self._pg.allreduce([array], pg_reduce_op)
+
+            def callback(result: List[np.ndarray]) -> np.ndarray:
+                out = result[0]
+                if reduce_op == ReduceOp.AVG:
+                    out = (out / num_participants).astype(out.dtype)
+                return out
+
+            return self.wrap_work(work.then(callback), default=array)
+        except Exception as e:  # noqa: BLE001
+            self._logger.exception(f"got exception in all reduce -- skipping remaining: {e}")
+            self.report_error(e)
+            return _DummyWork(tensor)
+
+    def allreduce_pytree(self, pytree: Any, should_quantize: bool = False) -> Work:
+        """Averages every array leaf of ``pytree`` across replicas; resolves
+        to a pytree of the same structure (numpy leaves)."""
+        import jax
+
+        leaves, treedef = jax.tree_util.tree_flatten(pytree)
+        if self.errored():
+            return _DummyWork(pytree)
+        self.wait_quorum()
+        num_participants = self.num_participants()
+        arrays = [np.asarray(leaf) for leaf in leaves]
+        if not self.is_participating():
+            arrays = [np.zeros_like(a) for a in arrays]
+        try:
+            if should_quantize:
+                from torchft_tpu.parallel.collectives import allreduce_quantized
+
+                work = allreduce_quantized(arrays, ReduceOp.SUM, self._pg)
+            else:
+                work = self._pg.allreduce(arrays, ReduceOp.SUM)
+
+            def callback(result: List[np.ndarray]) -> Any:
+                averaged = [
+                    (a / num_participants).astype(a.dtype) if a.dtype.kind in ("f", "V") else a // num_participants
+                    for a in result
+                ]
+                return jax.tree_util.tree_unflatten(treedef, averaged)
+
+            return self.wrap_work(work.then(callback), default=pytree)
+        except Exception as e:  # noqa: BLE001
+            self._logger.exception(f"got exception in all reduce -- skipping remaining: {e}")
+            self.report_error(e)
+            return _DummyWork(pytree)
+
+    # ------------------------------------------------------------------
+    # error tracking
+    # ------------------------------------------------------------------
+
+    def report_error(self, e: Exception) -> None:
+        """Records an error for this step: the step will not commit and the
+        comm layer is reconfigured on the next quorum."""
+        self._errored = ExceptionWithTraceback(e)
+        errors_logger.info(
+            "error",
+            extra={
+                "job_id": os.environ.get("JOB_ID", "unknown"),
+                "replica_id": self._replica_id,
+                "rank": self._group_rank,
+                "quorum_id": self._quorum_id,
+                "step": self._step,
+                "error": str(e),
+            },
+        )
+
+    def errored(self) -> Optional[ExceptionWithTraceback]:
+        return self._errored
+
+    def wrap_work(self, work: Work, default: Any, timeout: Optional[float] = None) -> Work:
+        """Bounds ``work`` with a deadline and swallows its errors into
+        :meth:`report_error`, resolving to ``default`` instead (reference
+        ``wrap_future``, manager.py:491-532)."""
+        from torchft_tpu.futures import future_timeout
+
+        timed = Work(future_timeout(work._future, timeout or self._timeout))
+
+        def handler(e: Exception) -> None:
+            self._logger.exception(f"got exception in future -- skipping remaining: {e}")
+            self.report_error(e)
+
+        return timed.with_error_handler(handler, default)
+
+    # Alias matching the reference name.
+    wrap_future = wrap_work
+
+    # ------------------------------------------------------------------
+    # quorum
+    # ------------------------------------------------------------------
+
+    def start_quorum(
+        self,
+        allow_heal: bool = True,
+        shrink_only: bool = False,
+        timeout: Optional[float] = None,
+    ) -> None:
+        """Starts a (possibly async) quorum and readies the manager for a new
+        step (reference: manager.py:534-589). Call before the forward pass."""
+        if self._quorum_future is not None:
+            self._quorum_future.result()
+
+        self._errored = None
+        self._healing = False
+
+        self._quorum_future = self._executor.submit(
+            self._async_quorum,
+            allow_heal=allow_heal,
+            shrink_only=shrink_only,
+            quorum_timeout=timeout or self._quorum_timeout,
+        )
+        if not self._use_async_quorum:
+            self.wait_quorum()
+            if self._healing:
+                # Eagerly apply the pending state dict so the forward pass
+                # runs against recovered parameters.
+                self._apply_pending_state_dict()
+                self._healing = False
+
+    def wait_quorum(self) -> None:
+        """Blocks until the quorum completes; the PG is healthy after."""
+        assert self._quorum_future is not None, "must call start_quorum before wait_quorum"
+        self._quorum_future.result()
+
+    def _async_quorum(
+        self, allow_heal: bool, shrink_only: bool, quorum_timeout: float
+    ) -> None:
+        quorum = self._client._quorum(
+            group_rank=self._group_rank,
+            step=self._step,
+            checkpoint_metadata=self._checkpoint_transport.metadata(),
+            shrink_only=shrink_only,
+            init_sync=self._init_sync,
+            commit_failures=self._commit_failures,
+            timeout=quorum_timeout,
+        )
+
+        # Participation bookkeeping: async quorum means a healing replica
+        # sits out this step (max-step cohort participates); sync quorum
+        # means everyone participates post-heal (reference: manager.py:
+        # 636-657).
+        if self._use_async_quorum or not allow_heal:
+            self._participating_replica_rank = quorum.max_rank
+            self._participating_replica_world_size = quorum.max_world_size
+        else:
+            self._participating_replica_rank = quorum.replica_rank
+            self._participating_replica_world_size = quorum.replica_world_size
+
+        if self._replica_world_size_mode == WorldSizeMode.FIXED_WITH_SPARES:
+            self._participating_replica_world_size = min(
+                self._participating_replica_world_size, self._min_replica_size
+            )
+            if (
+                self._participating_replica_rank is not None
+                and self._participating_replica_rank >= self._min_replica_size
+            ):
+                self._participating_replica_rank = None
+
+        if quorum.quorum_id != self._quorum_id:
+            quorums_logger.info(
+                "quorum",
+                extra={
+                    "job_id": os.environ.get("JOB_ID", "unknown"),
+                    "replica_id": self._replica_id,
+                    "rank": self._group_rank,
+                    "quorum_id": quorum.quorum_id,
+                    "step": quorum.max_step,
+                },
+            )
+            store_prefixed_addr = (
+                f"{quorum.store_address}/tpuft/{quorum.quorum_id}/{self._group_rank}"
+            )
+            self._logger.info(
+                f"reconfiguring for quorum_id={quorum.quorum_id} {store_prefixed_addr=}"
+            )
+            try:
+                self._pg.configure(
+                    store_prefixed_addr,
+                    self._replica_id,
+                    quorum.replica_rank,
+                    quorum.replica_world_size,
+                )
+                self._quorum_id = quorum.quorum_id
+            except Exception as e:  # noqa: BLE001
+                self._logger.exception(f"got exception in pg configure: {e}")
+                self.report_error(e)
+                return
+
+        if allow_heal:
+            try:
+                if quorum.recover_dst_replica_ranks:
+                    self._logger.info(
+                        f"peers need recovery from us {quorum.recover_dst_replica_ranks}"
+                    )
+                    self._checkpoint_transport.send_checkpoint(
+                        dst_ranks=quorum.recover_dst_replica_ranks,
+                        step=quorum.max_step,
+                        state_dict=self._manager_state_dict(),
+                        timeout=self._timeout,
+                    )
+
+                if quorum.heal:
+                    self._healing = True
+                    self._logger.info(
+                        "healing required, fetching checkpoint metadata from "
+                        f"{quorum.recover_src_manager_address} max_step={quorum.max_step}"
+                    )
+                    primary_client = ManagerClient(
+                        quorum.recover_src_manager_address,
+                        connect_timeout=self._connect_timeout,
+                    )
+                    checkpoint_metadata = primary_client._checkpoint_metadata(
+                        self._group_rank, timeout=self._timeout
+                    )
+                    primary_client.close()
+                    assert (
+                        quorum.recover_src_replica_rank is not None
+                    ), "must have a recover rank when healing"
+                    self._pending_state_dict = self._checkpoint_transport.recv_checkpoint(
+                        src_rank=quorum.recover_src_replica_rank,
+                        metadata=checkpoint_metadata,
+                        step=quorum.max_step,
+                        timeout=self._timeout,
+                    )
+                    # Restore manager accounting immediately; user state is
+                    # applied from the main thread when safe.
+                    self.load_state_dict(self._pending_state_dict["tpuft"])
+                    self._step = quorum.max_step
+            except Exception as e:  # noqa: BLE001
+                self._logger.exception(f"got exception in recovery: {e}")
+                self.report_error(e)
+
+    def _apply_pending_state_dict(self) -> None:
+        assert self._healing, "must be in healing state"
+        assert self._quorum_future is not None, "must call start_quorum first"
+        self._quorum_future.result()
+
+        if self._pending_state_dict is None:
+            assert self.errored(), "checkpoint was not staged and no error occurred"
+            return
+        self._logger.info("applying pending state dict")
+        assert self._load_state_dict_fns, "user load_state_dict is not initialized"
+        pending_user = cast(Dict[str, Any], self._pending_state_dict["user"])
+        for key, load_fn in self._load_state_dict_fns.items():
+            load_fn(pending_user[key])
+        self._pending_state_dict = None
+        self._logger.info("Loaded state dict.")
+
+    # ------------------------------------------------------------------
+    # commit
+    # ------------------------------------------------------------------
+
+    def should_commit(self, timeout: Optional[float] = None) -> bool:
+        """All-local-rank commit barrier (reference: manager.py:790-878).
+
+        Call after the step's math is complete (``jax.block_until_ready`` on
+        the outputs) and step the optimizer only when this returns True.
+        """
+        if err := self._pg.errored():
+            self.report_error(err)
+
+        if self._healing:
+            self._apply_pending_state_dict()
+
+        enough_replicas = self.num_participants() >= self._min_replica_size
+        local_should_commit = enough_replicas and self._errored is None
+        should_commit = self._client.should_commit(
+            self._group_rank,
+            self._step,
+            local_should_commit,
+            timeout=timeout or self._timeout,
+        )
+        self._logger.info(
+            f"should_commit={should_commit} enough_replicas={enough_replicas}, "
+            f"errored={self._errored}"
+        )
+        commits_logger.info(
+            "commit",
+            extra={
+                "job_id": os.environ.get("JOB_ID", "unknown"),
+                "replica_id": self._replica_id,
+                "rank": self._group_rank,
+                "quorum_id": self._quorum_id,
+                "step": self._step,
+                "commit_result": should_commit,
+            },
+        )
+
+        self._checkpoint_transport.disallow_checkpoint()
+
+        if should_commit:
+            self._step += 1
+            self._batches_committed += self.num_participants()
+            self._commit_failures = 0
+        else:
+            self._commit_failures += 1
+            if self._max_retries is not None and self._commit_failures > self._max_retries:
+                msg = (
+                    f"should_commit failed {self._commit_failures} times consecutively, "
+                    f"exceeding max_retries={self._max_retries}"
+                )
+                self._logger.exception(msg)
+                raise RuntimeError(msg)
+        return should_commit
+
+    # ------------------------------------------------------------------
+    # state dict / accounting
+    # ------------------------------------------------------------------
+
+    def load_state_dict(self, state_dict: Dict[str, int]) -> None:
+        self._step = state_dict["step"]
+        self._batches_committed = state_dict["batches_committed"]
+
+    def _manager_state_dict(self) -> Dict[str, Any]:
+        with self._state_dict_lock.r_lock(timeout=self._timeout):
+            assert self._user_state_dicts, "user state_dict is not initialized"
+            return {
+                "user": {key: fn() for key, fn in self._user_state_dicts.items()},
+                "tpuft": self.state_dict(),
+            }
+
+    def state_dict(self) -> Dict[str, int]:
+        """Manager accounting for user checkpoints: persist alongside model
+        state and restore via :meth:`load_state_dict`."""
+        return {"step": self._step, "batches_committed": self._batches_committed}
+
+    def current_step(self) -> int:
+        return self._step
+
+    def batches_committed(self) -> int:
+        return self._batches_committed
+
+    def participating_rank(self) -> Optional[int]:
+        if self._quorum_future is None:
+            return None
+        self.wait_quorum()
+        return self._participating_replica_rank
+
+    def num_participants(self) -> int:
+        if self._quorum_future is None:
+            return 0
+        self.wait_quorum()
+        assert self._participating_replica_world_size >= 0, "internal error"
+        return self._participating_replica_world_size
+
+    def is_participating(self) -> bool:
+        if self._participating_replica_rank is None:
+            return False
+        if self._healing:
+            assert self._use_async_quorum
+            return False
+        return True
+
+
+class _ManagerLogger:
+    def __init__(self, manager: Manager, replica_id: str, group_rank: int) -> None:
+        self._logger = logging.getLogger("torchft_tpu.manager")
+        self._replica_id = replica_id
+        self._group_rank = group_rank
+        self._manager = manager
+
+    def _prefix(self) -> str:
+        return f"[{self._replica_id}/{self._group_rank} - step {self._manager.current_step()}]"
+
+    def info(self, msg: str) -> None:
+        self._logger.info(f"{self._prefix()} {msg}")
+
+    def warn(self, msg: str) -> None:
+        self._logger.warning(f"{self._prefix()} {msg}")
+
+    def exception(self, msg: str) -> None:
+        self._logger.exception(f"{self._prefix()} {msg}")

@@ -1,0 +1,1 @@
+"""Model zoo: demo models plus the transformer family used for benchmarks."""

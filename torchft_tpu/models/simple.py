@@ -1,0 +1,63 @@
+"""Demo models for the fault-tolerance examples.
+
+Parity targets: the reference's CIFAR-10 CNN (+ an optional dummy embedding
+that inflates the gradient payload to lengthen the communication window for
+fault injection, train_ddp.py:126-131) and the 2-layer MLP used by the DiLoCo
+demo (train_diloco.py:118-119).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+__all__ = ["DemoCNN", "DemoMLP"]
+
+
+class DemoCNN(nn.Module):
+    """Small conv net for 32x32 images (CIFAR-shaped inputs).
+
+    ``padding_mb``: adds an unused embedding table of roughly that many
+    megabytes so gradient allreduces move real bytes — fault-injection demos
+    want a wide communication window.
+    """
+
+    num_classes: int = 10
+    padding_mb: int = 0
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        if self.padding_mb > 0:
+            rows = (self.padding_mb * 1024 * 1024) // (4 * 128)
+            padding = self.param(
+                "comm_padding", nn.initializers.zeros, (rows, 128), jnp.float32
+            )
+            # Touch the padding so it receives (zero) gradients and rides the
+            # allreduce, like the reference's dummy embedding.
+            x = x + jnp.sum(padding) * 0.0
+        x = nn.Conv(32, (3, 3))(x)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (3, 3))(x)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(128)(x)
+        x = nn.relu(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+class DemoMLP(nn.Module):
+    """2-layer MLP (DiLoCo demo model)."""
+
+    hidden: int = 128
+    out: int = 10
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(self.hidden)(x)
+        x = nn.relu(x)
+        return nn.Dense(self.out)(x)

@@ -1,0 +1,100 @@
+"""Fault-tolerant optimizer wrapper (optax).
+
+The canonical step protocol of the reference's ``OptimizerWrapper``
+(/root/reference/torchft/optim.py:24-63) — ``zero_grad()`` starts the quorum,
+``step()`` commits — adapted for JAX/optax:
+
+    opt = Optimizer(manager, optax.adamw(3e-4), params)
+    for batch in data:
+        opt.begin_step()                        # zero_grad() analogue
+        grads = grad_fn(opt.params, batch)
+        avg = manager.allreduce_pytree(grads).wait()
+        committed = opt.step(avg)
+        # opt.params / opt.opt_state hold the live state
+
+The wrapper *owns* ``params``/``opt_state`` and registers them with the
+manager under the key ``"optimizer"`` — this is load-bearing for healing:
+``should_commit()`` may replace the state with a donor's checkpoint
+mid-call, and the gradient update must apply to the *healed* state, exactly
+as torch's in-place ``load_state_dict`` + ``optimizer.step()`` sequence
+does. A functional step that captured params before the commit barrier
+would silently clobber the heal (the bug class this design avoids).
+
+For custom state management, call ``manager.should_commit()`` directly and
+re-read any registered state *after* it returns.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+
+from torchft_tpu.manager import Manager
+
+__all__ = ["Optimizer", "OptimizerWrapper"]
+
+
+def _as_device_tree(tree: Any) -> Any:
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(
+        lambda x: jnp.asarray(x) if hasattr(x, "shape") else x, tree
+    )
+
+
+class Optimizer:
+    """Owns (params, opt_state); steps only on quorum-wide commit."""
+
+    def __init__(
+        self,
+        manager: Manager,
+        tx: Any,
+        params: Any,
+        register_key: str = "optimizer",
+    ) -> None:
+        self.manager = manager
+        self.tx = tx
+        self.params = params
+        self.opt_state = tx.init(params)
+        manager.register_state_dict_fn(
+            register_key, self._load_state_dict, self._state_dict
+        )
+
+    def _state_dict(self) -> Any:
+        return {"params": self.params, "opt_state": self.opt_state}
+
+    def _load_state_dict(self, state: Any) -> None:
+        self.params = _as_device_tree(state["params"])
+        self.opt_state = _as_device_tree(state["opt_state"])
+
+    def begin_step(
+        self, timeout: Optional[float] = None, shrink_only: bool = False
+    ) -> None:
+        """Starts the (async) quorum for this step; call before the forward
+        pass so quorum latency overlaps compute."""
+        self.manager.start_quorum(shrink_only=shrink_only, timeout=timeout)
+
+    # torch-API alias: the reference starts quorum in zero_grad().
+    zero_grad = begin_step
+
+    def step(self, grads: Any, timeout: Optional[float] = None) -> bool:
+        """Commits the step; on success applies ``grads`` to the (possibly
+        just-healed) owned state. Returns whether the step committed."""
+        import optax
+
+        # Bound the device work before voting: a replica whose math never
+        # finished must not vote to commit (the stream-sync analogue of
+        # reference manager.py:816-827).
+        grads = jax.block_until_ready(grads)
+        # NOTE: should_commit may invoke _load_state_dict (healing); use
+        # self.params/opt_state only after it returns.
+        if not self.manager.should_commit(timeout=timeout):
+            return False
+        updates, self.opt_state = self.tx.update(grads, self.opt_state, self.params)
+        self.params = optax.apply_updates(self.params, updates)
+        return True
+
+
+# Name parity with the reference export.
+OptimizerWrapper = Optimizer

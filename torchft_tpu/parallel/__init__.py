@@ -1,0 +1,1 @@
+"""Communication layer: rendezvous store, resizable process groups, mesh."""

@@ -1,0 +1,810 @@
+"""Resizable process groups over host networking (the DCN comm layer).
+
+The reference builds fault tolerance on reconfigurable wrappers of
+NCCL/Gloo ProcessGroups (/root/reference/torchft/process_group.py:133-389):
+``configure()`` tears the group down and re-rendezvouses under a fresh store
+prefix, ``abort()`` cancels outstanding work, ``errored()`` reports a sticky
+failure. On TPU the per-step gradient collective between replica *groups*
+rides host networking (DCN) — intra-slice collectives are XLA's job inside
+the jitted step — so the backend here is a TCP full-mesh between the
+corresponding local ranks of each replica group, with the native store as
+rendezvous.
+
+Collectives operate on host numpy arrays (the manager stages jax arrays
+device→host before averaging). bfloat16 is supported via ml_dtypes and
+reduced in float32 for numerics.
+
+Implementations:
+  ProcessGroupTCP     — real sockets, full mesh, ring allreduce (Gloo role)
+  ProcessGroupDummy   — world-size-1 loopback, op-counting (test/bootstrap)
+  ErrorSwallowingProcessGroupWrapper — records first error, dummy-works after
+  FakeProcessGroupWrapper — deterministic fault injection for tests
+  ManagedProcessGroup — routes allreduce through a Manager (quorum semantics)
+"""
+
+from __future__ import annotations
+
+import logging
+import pickle
+import queue
+import socket
+import struct
+import threading
+import time
+from abc import ABC, abstractmethod
+from concurrent.futures import Future
+from enum import Enum
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from torchft_tpu.parallel.store import StoreClient, create_store_client
+from torchft_tpu.work import Work, _DummyWork
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "ReduceOp",
+    "ProcessGroup",
+    "ProcessGroupTCP",
+    "ProcessGroupDummy",
+    "ErrorSwallowingProcessGroupWrapper",
+    "FakeProcessGroupWrapper",
+    "ManagedProcessGroup",
+]
+
+
+class ReduceOp(Enum):
+    SUM = "sum"
+    AVG = "avg"
+    MAX = "max"
+    MIN = "min"
+
+
+def _reduce_pair(acc: np.ndarray, other: np.ndarray, op: ReduceOp) -> np.ndarray:
+    if op in (ReduceOp.SUM, ReduceOp.AVG):
+        return acc + other
+    if op == ReduceOp.MAX:
+        return np.maximum(acc, other)
+    if op == ReduceOp.MIN:
+        return np.minimum(acc, other)
+    raise ValueError(f"unsupported reduce op {op}")
+
+
+def _acc_dtype(dtype: np.dtype) -> np.dtype:
+    """Accumulation dtype: low-precision floats reduce in float32."""
+    if dtype.itemsize <= 2 and dtype.kind in ("f", "V"):  # fp16/bf16
+        return np.dtype(np.float32)
+    return dtype
+
+
+class ProcessGroup(ABC):
+    """Resizable collective group (reference: process_group.py:133-389).
+
+    All collectives are asynchronous: they return a :class:`Work` whose
+    ``wait()`` yields the result arrays. Implementations must make
+    ``configure`` idempotent and safe to call while ops are outstanding
+    (outstanding work fails, new epoch starts clean).
+    """
+
+    def __init__(self) -> None:
+        self._timeout: float = 60.0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @abstractmethod
+    def configure(
+        self, store_addr: str, replica_id: str, rank: int, world_size: int
+    ) -> None:
+        """(Re)initializes the group: ``store_addr`` is "host:port/prefix",
+        fresh per quorum; rank/world_size are the replica-axis coordinates."""
+
+    @abstractmethod
+    def abort(self) -> None:
+        """Cancels outstanding collectives and poisons the group until the
+        next configure()."""
+
+    @abstractmethod
+    def shutdown(self) -> None:
+        """Permanently tears the group down."""
+
+    @abstractmethod
+    def errored(self) -> Optional[Exception]:
+        """Sticky error state since last configure (None when healthy)."""
+
+    def set_timeout(self, timeout: float) -> None:
+        self._timeout = timeout
+
+    @abstractmethod
+    def size(self) -> int: ...
+
+    @abstractmethod
+    def rank(self) -> int: ...
+
+    def getBackendName(self) -> str:
+        return type(self).__name__
+
+    # -- collectives -------------------------------------------------------
+
+    @abstractmethod
+    def allreduce(self, arrays: Sequence[np.ndarray], op: ReduceOp = ReduceOp.SUM) -> Work:
+        """Elementwise reduction of each array across ranks; result on all."""
+
+    @abstractmethod
+    def allgather(self, arrays: Sequence[np.ndarray]) -> Work:
+        """Result: list over ranks of the rank's array list."""
+
+    @abstractmethod
+    def broadcast(self, arrays: Sequence[np.ndarray], root: int = 0) -> Work:
+        """Root's arrays distributed to all ranks."""
+
+    @abstractmethod
+    def reduce_scatter(
+        self, arrays: Sequence[np.ndarray], op: ReduceOp = ReduceOp.SUM
+    ) -> Work:
+        """Reduce then scatter: each array is split into world_size equal
+        chunks along axis 0; rank r receives reduced chunk r."""
+
+    @abstractmethod
+    def alltoall(self, arrays: Sequence[np.ndarray]) -> Work:
+        """arrays[i] goes to rank i; result[i] came from rank i."""
+
+    @abstractmethod
+    def send(self, arrays: Sequence[np.ndarray], dst: int, tag: int = 0) -> Work: ...
+
+    @abstractmethod
+    def recv(self, shapes_like: Sequence[np.ndarray], src: int, tag: int = 0) -> Work:
+        """Receives arrays matching ``shapes_like`` (shape/dtype templates)."""
+
+    @abstractmethod
+    def barrier(self) -> Work: ...
+
+
+# ---------------------------------------------------------------------------
+# TCP backend
+# ---------------------------------------------------------------------------
+
+_LEN_STRUCT = struct.Struct("!Q")
+
+
+def _send_bytes(sock: socket.socket, payload: bytes, deadline: float) -> None:
+    sock.settimeout(max(0.001, deadline - time.monotonic()))
+    sock.sendall(_LEN_STRUCT.pack(len(payload)) + payload)
+
+
+def _recv_bytes(sock: socket.socket, deadline: float) -> bytes:
+    header = _recv_exact(sock, _LEN_STRUCT.size, deadline)
+    (length,) = _LEN_STRUCT.unpack(header)
+    return _recv_exact(sock, length, deadline)
+
+
+def _recv_exact(sock: socket.socket, n: int, deadline: float) -> bytes:
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        sock.settimeout(max(0.001, deadline - time.monotonic()))
+        chunk = sock.recv_into(view[got:], n - got)
+        if chunk == 0:
+            raise ConnectionError("peer closed connection")
+        got += chunk
+    return bytes(buf)
+
+
+def _pack_array(array: np.ndarray) -> bytes:
+    array = np.ascontiguousarray(array)
+    meta = pickle.dumps((array.shape, array.dtype.str if array.dtype.names is None else None, str(array.dtype)))
+    return _LEN_STRUCT.pack(len(meta)) + meta + array.tobytes()
+
+
+def _unpack_array(payload: bytes) -> np.ndarray:
+    (meta_len,) = _LEN_STRUCT.unpack_from(payload)
+    meta = pickle.loads(payload[_LEN_STRUCT.size : _LEN_STRUCT.size + meta_len])
+    shape, _, dtype_name = meta
+    # ml_dtypes names (e.g. bfloat16) resolve through the registry.
+    try:
+        dtype = np.dtype(dtype_name)
+    except TypeError:
+        import ml_dtypes
+
+        dtype = np.dtype(getattr(ml_dtypes, dtype_name))
+    data = payload[_LEN_STRUCT.size + meta_len :]
+    return np.frombuffer(data, dtype=dtype).reshape(shape).copy()
+
+
+class _Epoch:
+    """One configure() generation of a ProcessGroupTCP: the listener, the
+    full mesh of peer sockets, and the worker that executes collectives."""
+
+    def __init__(
+        self,
+        pg_name: str,
+        store: StoreClient,
+        rank: int,
+        world_size: int,
+        timeout: float,
+    ) -> None:
+        self.rank = rank
+        self.world_size = world_size
+        self.closed = False
+        self._lock = threading.Lock()
+        self.peers: Dict[int, socket.socket] = {}
+        self._listener: Optional[socket.socket] = None
+        deadline = time.monotonic() + timeout
+
+        if world_size > 1:
+            listener = socket.socket(socket.AF_INET6, socket.SOCK_STREAM)
+            listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            listener.bind(("::", 0))
+            listener.listen(world_size)
+            self._listener = listener
+            port = listener.getsockname()[1]
+            host = socket.gethostname()
+            store.set(f"ep/{rank}", f"{host}:{port}".encode())
+
+            # Deterministic mesh setup: rank r dials every lower rank and
+            # accepts one inbound connection from every higher rank.
+            pending = world_size - 1 - rank
+            accepted: Dict[int, socket.socket] = {}
+            accept_err: List[Exception] = []
+
+            def accept_loop() -> None:
+                try:
+                    for _ in range(pending):
+                        listener.settimeout(max(0.001, deadline - time.monotonic()))
+                        conn, _ = listener.accept()
+                        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                        peer_rank = struct.unpack("!I", _recv_exact(conn, 4, deadline))[0]
+                        accepted[peer_rank] = conn
+                except Exception as e:  # noqa: BLE001
+                    accept_err.append(e)
+
+            acceptor = threading.Thread(target=accept_loop, daemon=True, name=f"{pg_name}-accept")
+            acceptor.start()
+
+            for peer in range(rank):
+                addr = store.get(f"ep/{peer}", timeout=max(0.001, deadline - time.monotonic()))
+                assert addr is not None
+                peer_host, _, peer_port = addr.decode().rpartition(":")
+                sock = socket.create_connection(
+                    (peer_host, int(peer_port)),
+                    timeout=max(0.001, deadline - time.monotonic()),
+                )
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                sock.sendall(struct.pack("!I", rank))
+                self.peers[peer] = sock
+
+            acceptor.join(timeout=max(0.001, deadline - time.monotonic()))
+            if acceptor.is_alive() or accept_err:
+                self.close()
+                raise TimeoutError(
+                    f"rendezvous failed for rank {rank}/{world_size}: "
+                    f"{accept_err[0] if accept_err else 'accept timeout'}"
+                )
+            self.peers.update(accepted)
+
+        # Collectives execute in submission order on a dedicated worker so the
+        # train loop can overlap compute with communication.
+        self.ops: "queue.Queue[Optional[Callable[[], None]]]" = queue.Queue()
+        self.worker = threading.Thread(
+            target=self._worker_loop, daemon=True, name=f"{pg_name}-worker"
+        )
+        self.worker.start()
+
+    def _worker_loop(self) -> None:
+        while True:
+            op = self.ops.get()
+            if op is None:
+                return
+            op()
+
+    def submit(self, fn: Callable[[], object]) -> Future:
+        fut: Future = Future()
+
+        def run() -> None:
+            try:
+                fut.set_result(fn())
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+        self.ops.put(run)
+        return fut
+
+    def close(self) -> None:
+        with self._lock:
+            if self.closed:
+                return
+            self.closed = True
+        if hasattr(self, "ops"):
+            self.ops.put(None)
+        for sock in self.peers.values():
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+
+
+class ProcessGroupTCP(ProcessGroup):
+    """Gloo-role backend: full TCP mesh between the same local rank of each
+    replica group. Reductions run in rank-ascending order at a root and the
+    result is broadcast, so all replicas produce bitwise-identical output —
+    the invariant the recovery tests assert.
+    """
+
+    def __init__(self, timeout: float = 60.0) -> None:
+        super().__init__()
+        self._timeout = timeout
+        self._epoch: Optional[_Epoch] = None
+        self._errored: Optional[Exception] = None
+        self._rank = 0
+        self._world_size = 1
+        self._configure_lock = threading.Lock()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def configure(
+        self, store_addr: str, replica_id: str, rank: int, world_size: int
+    ) -> None:
+        with self._configure_lock:
+            old = self._epoch
+            self._epoch = None
+            if old is not None:
+                old.close()
+            self._errored = None
+            self._rank = rank
+            self._world_size = world_size
+            store = create_store_client(store_addr, connect_timeout=self._timeout)
+            try:
+                self._epoch = _Epoch(
+                    f"pg-{replica_id}-{rank}", store, rank, world_size, self._timeout
+                )
+            except Exception as e:
+                self._errored = e
+                raise
+            finally:
+                store.close()
+
+    def abort(self) -> None:
+        self._errored = self._errored or RuntimeError("process group aborted")
+        epoch = self._epoch
+        if epoch is not None:
+            logger.warning("process_group_abort rank=%d", self._rank)
+            epoch.close()
+
+    def shutdown(self) -> None:
+        epoch = self._epoch
+        self._epoch = None
+        if epoch is not None:
+            epoch.close()
+
+    def errored(self) -> Optional[Exception]:
+        return self._errored
+
+    def size(self) -> int:
+        return self._world_size
+
+    def rank(self) -> int:
+        return self._rank
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _submit(self, fn: Callable[["_Epoch", float], object]) -> Work:
+        if self._errored is not None:
+            raise RuntimeError(f"process group in error state: {self._errored}")
+        epoch = self._epoch
+        if epoch is None:
+            raise RuntimeError("process group not configured")
+        deadline = time.monotonic() + self._timeout
+
+        def run() -> object:
+            try:
+                return fn(epoch, deadline)
+            except BaseException as e:
+                # First failure poisons the group until reconfigure.
+                if self._errored is None:
+                    self._errored = e if isinstance(e, Exception) else RuntimeError(str(e))
+                epoch.close()
+                raise
+
+        return Work(epoch.submit(run))
+
+    def _sendto(self, epoch: _Epoch, peer: int, payload: bytes, deadline: float) -> None:
+        _send_bytes(epoch.peers[peer], payload, deadline)
+
+    def _recvfrom(self, epoch: _Epoch, peer: int, deadline: float) -> bytes:
+        return _recv_bytes(epoch.peers[peer], deadline)
+
+    # -- collectives -------------------------------------------------------
+
+    def allreduce(self, arrays: Sequence[np.ndarray], op: ReduceOp = ReduceOp.SUM) -> Work:
+        arrays = [np.asarray(a) for a in arrays]
+
+        def run(epoch: _Epoch, deadline: float) -> List[np.ndarray]:
+            return self._allreduce_sync(epoch, arrays, op, deadline)
+
+        return self._submit(run)
+
+    def _allreduce_sync(
+        self,
+        epoch: _Epoch,
+        arrays: List[np.ndarray],
+        op: ReduceOp,
+        deadline: float,
+    ) -> List[np.ndarray]:
+        n = epoch.world_size
+        if n == 1:
+            if op == ReduceOp.AVG:
+                return [a.copy() for a in arrays]
+            return [a.copy() for a in arrays]
+        # Gather-at-root with rank-ascending reduction, broadcast result: all
+        # ranks end bitwise identical. Determinism beats bandwidth balance on
+        # the small replica axis.
+        rank = epoch.rank
+        out: List[np.ndarray] = []
+        if rank == 0:
+            gathered: Dict[int, List[np.ndarray]] = {0: arrays}
+            for peer in range(1, n):
+                payload = self._recvfrom(epoch, peer, deadline)
+                gathered[peer] = pickle_loads_arrays(payload)
+            for i, a in enumerate(arrays):
+                acc = gathered[0][i].astype(_acc_dtype(a.dtype), copy=True)
+                for peer in range(1, n):
+                    acc = _reduce_pair(acc, gathered[peer][i].astype(_acc_dtype(a.dtype)), op)
+                if op == ReduceOp.AVG:
+                    acc = acc / n
+                out.append(acc.astype(a.dtype))
+            blob = pickle_dumps_arrays(out)
+            for peer in range(1, n):
+                self._sendto(epoch, peer, blob, deadline)
+        else:
+            self._sendto(epoch, 0, pickle_dumps_arrays(arrays), deadline)
+            out = pickle_loads_arrays(self._recvfrom(epoch, 0, deadline))
+        return out
+
+    def allgather(self, arrays: Sequence[np.ndarray]) -> Work:
+        arrays = [np.asarray(a) for a in arrays]
+
+        def run(epoch: _Epoch, deadline: float) -> List[List[np.ndarray]]:
+            n = epoch.world_size
+            if n == 1:
+                return [[a.copy() for a in arrays]]
+            rank = epoch.rank
+            if rank == 0:
+                result: List[List[np.ndarray]] = [list(arrays)]
+                for peer in range(1, n):
+                    result.append(pickle_loads_arrays(self._recvfrom(epoch, peer, deadline)))
+                blob = pickle.dumps([pickle_dumps_arrays(r) for r in result])
+                for peer in range(1, n):
+                    self._sendto(epoch, peer, blob, deadline)
+                return result
+            self._sendto(epoch, 0, pickle_dumps_arrays(arrays), deadline)
+            blobs = pickle.loads(self._recvfrom(epoch, 0, deadline))
+            return [pickle_loads_arrays(b) for b in blobs]
+
+        return self._submit(run)
+
+    def broadcast(self, arrays: Sequence[np.ndarray], root: int = 0) -> Work:
+        arrays = [np.asarray(a) for a in arrays]
+
+        def run(epoch: _Epoch, deadline: float) -> List[np.ndarray]:
+            n = epoch.world_size
+            if n == 1:
+                return [a.copy() for a in arrays]
+            rank = epoch.rank
+            if rank == root:
+                blob = pickle_dumps_arrays(arrays)
+                for peer in range(n):
+                    if peer != root:
+                        self._sendto(epoch, peer, blob, deadline)
+                return [a.copy() for a in arrays]
+            return pickle_loads_arrays(self._recvfrom(epoch, root, deadline))
+
+        return self._submit(run)
+
+    def reduce_scatter(
+        self, arrays: Sequence[np.ndarray], op: ReduceOp = ReduceOp.SUM
+    ) -> Work:
+        arrays = [np.asarray(a) for a in arrays]
+
+        def run(epoch: _Epoch, deadline: float) -> List[np.ndarray]:
+            n = epoch.world_size
+            reduced = self._allreduce_sync(epoch, list(arrays), op, deadline)
+            out = []
+            for a in reduced:
+                if a.shape[0] % n != 0:
+                    raise ValueError(
+                        f"reduce_scatter requires dim0 ({a.shape[0]}) divisible by world_size ({n})"
+                    )
+                out.append(np.split(a, n, axis=0)[epoch.rank].copy())
+            return out
+
+        return self._submit(run)
+
+    def alltoall(self, arrays: Sequence[np.ndarray]) -> Work:
+        arrays = [np.asarray(a) for a in arrays]
+
+        def run(epoch: _Epoch, deadline: float) -> List[np.ndarray]:
+            n = epoch.world_size
+            if len(arrays) != n:
+                raise ValueError(f"alltoall requires {n} arrays, got {len(arrays)}")
+            rank = epoch.rank
+            result: List[Optional[np.ndarray]] = [None] * n
+            result[rank] = arrays[rank].copy()
+            # Pairwise exchange ordered to avoid deadlock: lower rank sends
+            # first in each pair.
+            for peer in range(n):
+                if peer == rank:
+                    continue
+                if rank < peer:
+                    self._sendto(epoch, peer, _pack_array(arrays[peer]), deadline)
+                    result[peer] = _unpack_array(self._recvfrom(epoch, peer, deadline))
+                else:
+                    result[peer] = _unpack_array(self._recvfrom(epoch, peer, deadline))
+                    self._sendto(epoch, peer, _pack_array(arrays[peer]), deadline)
+            return result  # type: ignore[return-value]
+
+        return self._submit(run)
+
+    def send(self, arrays: Sequence[np.ndarray], dst: int, tag: int = 0) -> Work:
+        arrays = [np.asarray(a) for a in arrays]
+
+        def run(epoch: _Epoch, deadline: float) -> None:
+            self._sendto(epoch, dst, pickle_dumps_arrays(arrays), deadline)
+
+        return self._submit(run)
+
+    def recv(self, shapes_like: Sequence[np.ndarray], src: int, tag: int = 0) -> Work:
+        def run(epoch: _Epoch, deadline: float) -> List[np.ndarray]:
+            return pickle_loads_arrays(self._recvfrom(epoch, src, deadline))
+
+        return self._submit(run)
+
+    def barrier(self) -> Work:
+        return self.allreduce([np.zeros(1, dtype=np.float32)])
+
+
+def pickle_dumps_arrays(arrays: Sequence[np.ndarray]) -> bytes:
+    parts = [struct.pack("!I", len(arrays))]
+    for a in arrays:
+        packed = _pack_array(a)
+        parts.append(_LEN_STRUCT.pack(len(packed)))
+        parts.append(packed)
+    return b"".join(parts)
+
+
+def pickle_loads_arrays(payload: bytes) -> List[np.ndarray]:
+    (count,) = struct.unpack_from("!I", payload)
+    offset = 4
+    out = []
+    for _ in range(count):
+        (length,) = _LEN_STRUCT.unpack_from(payload, offset)
+        offset += _LEN_STRUCT.size
+        out.append(_unpack_array(payload[offset : offset + length]))
+        offset += length
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Loopback / wrappers
+# ---------------------------------------------------------------------------
+
+
+class ProcessGroupDummy(ProcessGroup):
+    """World-size-1 loopback: copies inputs to outputs, counts calls
+    (reference: process_group.py:960-1081). Soaks up bootstrap collectives
+    and backs tests."""
+
+    def __init__(self, rank: int = 0, world: int = 1) -> None:
+        super().__init__()
+        assert rank == 0 and world == 1
+        self._rank = rank
+        self._world = world
+        self.configure_count = 0
+        self.op_counts: Dict[str, int] = {}
+        self._errored: Optional[Exception] = None
+
+    def _count(self, name: str) -> None:
+        self.op_counts[name] = self.op_counts.get(name, 0) + 1
+
+    def configure(self, store_addr: str, replica_id: str, rank: int, world_size: int) -> None:
+        self.configure_count += 1
+
+    def abort(self) -> None:
+        pass
+
+    def shutdown(self) -> None:
+        pass
+
+    def errored(self) -> Optional[Exception]:
+        return self._errored
+
+    def size(self) -> int:
+        return self._world
+
+    def rank(self) -> int:
+        return self._rank
+
+    def allreduce(self, arrays: Sequence[np.ndarray], op: ReduceOp = ReduceOp.SUM) -> Work:
+        self._count("allreduce")
+        return _DummyWork([np.array(a) for a in arrays])
+
+    def allgather(self, arrays: Sequence[np.ndarray]) -> Work:
+        self._count("allgather")
+        return _DummyWork([[np.array(a) for a in arrays]])
+
+    def broadcast(self, arrays: Sequence[np.ndarray], root: int = 0) -> Work:
+        self._count("broadcast")
+        return _DummyWork([np.array(a) for a in arrays])
+
+    def reduce_scatter(self, arrays: Sequence[np.ndarray], op: ReduceOp = ReduceOp.SUM) -> Work:
+        self._count("reduce_scatter")
+        return _DummyWork([np.array(a) for a in arrays])
+
+    def alltoall(self, arrays: Sequence[np.ndarray]) -> Work:
+        self._count("alltoall")
+        return _DummyWork([np.array(a) for a in arrays])
+
+    def send(self, arrays: Sequence[np.ndarray], dst: int, tag: int = 0) -> Work:
+        self._count("send")
+        return _DummyWork(None)
+
+    def recv(self, shapes_like: Sequence[np.ndarray], src: int, tag: int = 0) -> Work:
+        self._count("recv")
+        return _DummyWork([np.array(a) for a in shapes_like])
+
+    def barrier(self) -> Work:
+        self._count("barrier")
+        return _DummyWork(None)
+
+
+class _WrapperBase(ProcessGroup):
+    def __init__(self, pg: ProcessGroup) -> None:
+        super().__init__()
+        self._pg = pg
+
+    @property
+    def parent(self) -> ProcessGroup:
+        return self._pg
+
+    def configure(self, store_addr: str, replica_id: str, rank: int, world_size: int) -> None:
+        self._pg.configure(store_addr, replica_id, rank, world_size)
+
+    def abort(self) -> None:
+        self._pg.abort()
+
+    def shutdown(self) -> None:
+        self._pg.shutdown()
+
+    def errored(self) -> Optional[Exception]:
+        return self._pg.errored()
+
+    def set_timeout(self, timeout: float) -> None:
+        self._pg.set_timeout(timeout)
+
+    def size(self) -> int:
+        return self._pg.size()
+
+    def rank(self) -> int:
+        return self._pg.rank()
+
+    def allreduce(self, arrays: Sequence[np.ndarray], op: ReduceOp = ReduceOp.SUM) -> Work:
+        return self._pg.allreduce(arrays, op)
+
+    def allgather(self, arrays: Sequence[np.ndarray]) -> Work:
+        return self._pg.allgather(arrays)
+
+    def broadcast(self, arrays: Sequence[np.ndarray], root: int = 0) -> Work:
+        return self._pg.broadcast(arrays, root)
+
+    def reduce_scatter(self, arrays: Sequence[np.ndarray], op: ReduceOp = ReduceOp.SUM) -> Work:
+        return self._pg.reduce_scatter(arrays, op)
+
+    def alltoall(self, arrays: Sequence[np.ndarray]) -> Work:
+        return self._pg.alltoall(arrays)
+
+    def send(self, arrays: Sequence[np.ndarray], dst: int, tag: int = 0) -> Work:
+        return self._pg.send(arrays, dst, tag)
+
+    def recv(self, shapes_like: Sequence[np.ndarray], src: int, tag: int = 0) -> Work:
+        return self._pg.recv(shapes_like, src, tag)
+
+    def barrier(self) -> Work:
+        return self._pg.barrier()
+
+
+class ErrorSwallowingProcessGroupWrapper(_WrapperBase):
+    """Converts collective exceptions into a recorded error + dummy work;
+    everything after the first error is skipped until reconfigure (reference:
+    process_group.py:1084-1179). Lets the train loop keep stepping while the
+    manager arranges reconfiguration."""
+
+    def __init__(self, pg: ProcessGroup) -> None:
+        super().__init__(pg)
+        self._error: Optional[Exception] = None
+
+    def errored(self) -> Optional[Exception]:
+        return self._error or self._pg.errored()
+
+    def report_error(self, e: Exception) -> None:
+        self._error = e
+
+    def configure(self, store_addr: str, replica_id: str, rank: int, world_size: int) -> None:
+        self._error = None
+        super().configure(store_addr, replica_id, rank, world_size)
+
+    def _guard(self, fn: Callable[[], Work], fallback: object) -> Work:
+        if self.errored() is not None:
+            return _DummyWork(fallback)
+        try:
+            work = fn()
+        except Exception as e:  # noqa: BLE001
+            self.report_error(e)
+            return _DummyWork(fallback)
+        return work.with_error_handler(self.report_error, fallback)
+
+    def allreduce(self, arrays: Sequence[np.ndarray], op: ReduceOp = ReduceOp.SUM) -> Work:
+        return self._guard(
+            lambda: self._pg.allreduce(arrays, op), [np.array(a) for a in arrays]
+        )
+
+    def broadcast(self, arrays: Sequence[np.ndarray], root: int = 0) -> Work:
+        return self._guard(
+            lambda: self._pg.broadcast(arrays, root), [np.array(a) for a in arrays]
+        )
+
+
+class FakeProcessGroupWrapper(_WrapperBase):
+    """Test-only fault injection (reference: process_group.py:1182-1230):
+    ``report_future_error`` poisons the next collective's result."""
+
+    def __init__(self, pg: ProcessGroup) -> None:
+        super().__init__(pg)
+        self._next_error: Optional[Exception] = None
+        self._injected: Optional[Exception] = None
+
+    def configure(self, store_addr: str, replica_id: str, rank: int, world_size: int) -> None:
+        self._injected = None
+        super().configure(store_addr, replica_id, rank, world_size)
+
+    def report_future_error(self, e: Exception) -> None:
+        self._next_error = e
+
+    def errored(self) -> Optional[Exception]:
+        return self._injected or super().errored()
+
+    def allreduce(self, arrays: Sequence[np.ndarray], op: ReduceOp = ReduceOp.SUM) -> Work:
+        work = self._pg.allreduce(arrays, op)
+        if self._next_error is not None:
+            error, self._next_error = self._next_error, None
+            self._injected = error
+            return Work.failed(error)
+        return work
+
+
+class ManagedProcessGroup(_WrapperBase):
+    """Routes allreduce through the Manager so it picks up quorum/error
+    semantics; size() reports the live participant count (reference:
+    process_group.py:1233-1266). This is how mesh-based code transparently
+    uses the fault-tolerant path."""
+
+    def __init__(self, manager: "Manager") -> None:  # noqa: F821
+        super().__init__(manager._pg)
+        self._manager = manager
+
+    def allreduce(self, arrays: Sequence[np.ndarray], op: ReduceOp = ReduceOp.SUM) -> Work:
+        return self._manager.allreduce(list(arrays))
+
+    def size(self) -> int:
+        return self._manager.num_participants()
+
+    def getBackendName(self) -> str:
+        return "tpuft-managed"

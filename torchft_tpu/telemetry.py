@@ -1,0 +1,79 @@
+"""Structured event telemetry.
+
+Role-equivalent of the reference's ``torchft/otel.py``: named event loggers
+``tpuft_quorums`` / ``tpuft_commits`` / ``tpuft_errors`` receive one record
+per quorum change, commit decision, and error, each carrying
+job_id/replica_id/rank/quorum_id/step fields in ``record.__dict__``.
+
+Export is opt-in via ``TPUFT_TELEMETRY``:
+  - ``console``: JSON lines to stderr
+  - ``file:<path>``: JSON lines appended to <path>
+  - unset: records flow to whatever handlers the application configures
+    (opentelemetry's LoggingHandler attaches cleanly to these loggers when
+    available — it is not bundled in this environment).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import time
+from typing import Any, Dict
+
+__all__ = ["quorums_logger", "commits_logger", "errors_logger", "configure_telemetry"]
+
+quorums_logger = logging.getLogger("tpuft_quorums")
+commits_logger = logging.getLogger("tpuft_commits")
+errors_logger = logging.getLogger("tpuft_errors")
+
+_EVENT_FIELDS = (
+    "job_id",
+    "replica_id",
+    "rank",
+    "quorum_id",
+    "step",
+    "commit_result",
+    "error",
+)
+
+
+class _JsonLinesHandler(logging.Handler):
+    def __init__(self, stream: Any) -> None:
+        super().__init__()
+        self._stream = stream
+
+    def emit(self, record: logging.LogRecord) -> None:
+        event: Dict[str, Any] = {
+            "ts": time.time(),
+            "event": record.name,
+            "message": record.getMessage(),
+        }
+        for field in _EVENT_FIELDS:
+            if hasattr(record, field):
+                event[field] = getattr(record, field)
+        try:
+            self._stream.write(json.dumps(event) + "\n")
+            self._stream.flush()
+        except Exception:  # noqa: BLE001
+            self.handleError(record)
+
+
+def configure_telemetry(mode: str | None = None) -> None:
+    """Attaches exporters per ``mode`` (defaults to $TPUFT_TELEMETRY)."""
+    mode = mode if mode is not None else os.environ.get("TPUFT_TELEMETRY", "")
+    if not mode:
+        return
+    if mode == "console":
+        handler: logging.Handler = _JsonLinesHandler(sys.stderr)
+    elif mode.startswith("file:"):
+        handler = _JsonLinesHandler(open(mode[len("file:") :], "a"))
+    else:
+        raise ValueError(f"unknown TPUFT_TELEMETRY mode: {mode}")
+    for event_logger in (quorums_logger, commits_logger, errors_logger):
+        event_logger.addHandler(handler)
+        event_logger.setLevel(logging.INFO)
+
+
+configure_telemetry()
