@@ -10,13 +10,15 @@ import os
 import sys
 from pathlib import Path
 
-# Must be set before jax import anywhere in the test process.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# Force the CPU platform with 8 virtual devices. Env vars are NOT enough
+# here: the machine's sitecustomize registers the axon TPU plugin and
+# rewrites jax_platforms to "axon,cpu" on interpreter start, so we override
+# the jax config directly before any backend initialization.
+os.environ["JAX_PLATFORMS"] = "cpu"  # belt and suspenders for subprocesses
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 
 REPO_ROOT = Path(__file__).resolve().parent.parent
 if str(REPO_ROOT) not in sys.path:

@@ -18,6 +18,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 
 from torchft_tpu.coordination import LighthouseServer
@@ -219,6 +220,77 @@ def ddp_train_loop(
         return {
             "state_dict": {"params": opt.params, "opt_state": opt.opt_state},
             "history": history,
+            "manager_state": manager.state_dict(),
+        }
+    finally:
+        manager.shutdown(wait=False)
+        pg.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# DiLoCo train loop (reference train_diloco.py analogue, sized for tests)
+# ---------------------------------------------------------------------------
+
+
+def diloco_train_loop(
+    runner: Runner,
+    rank: int,
+    store_client: StoreClient,
+    store_addr: str,
+    num_syncs: int = 3,
+    sync_every: int = 4,
+    n_fragments: int = 2,
+    fragment_sync_delay: int = 0,
+) -> Dict[str, Any]:
+    """Streaming DiLoCo across replica groups; returns the per-fragment
+    global state for cross-group equality assertions."""
+    from torchft_tpu.local_sgd import DiLoCo
+
+    pg = FakeProcessGroupWrapper(ProcessGroupTCP(timeout=10.0))
+    manager = Manager(
+        pg=pg,
+        min_replica_size=1,
+        store=store_client,
+        store_addr=store_addr,
+        use_async_quorum=False,
+        group_rank=rank,
+        group_world_size=runner.world_size,
+        lighthouse_addr=runner.lighthouse_addr,
+        replica_id=f"diloco_{runner.replica_group}",
+        heartbeat_interval=0.05,
+        timeout=10.0,
+        quorum_timeout=20.0,
+        **runner.manager_args,
+    )
+    try:
+        algo = DiLoCo(
+            manager,
+            inner_tx=optax.sgd(0.05),
+            outer_tx=optax.sgd(0.7, momentum=0.9, nesterov=True),
+            params=_init_model_params(),
+            sync_every=sync_every,
+            n_fragments=n_fragments,
+            fragment_sync_delay=fragment_sync_delay,
+        )
+        inner_iter = 0
+        while manager.current_step() < num_syncs:
+            if runner.injector is not None:
+                runner.injector.check(runner.replica_group, manager.current_step(), pg)
+            x, y = _batch_for(1000 + inner_iter, runner.replica_group)
+            grads = _grad_fn(algo.params, x, y)
+            algo.step(grads)
+            inner_iter += 1
+        return {
+            "global_state": [
+                {
+                    "backup": [np.array(b) for b in frag.backup],
+                    "outer_opt": jax.tree_util.tree_map(
+                        lambda v: np.asarray(v) if hasattr(v, "shape") else v,
+                        frag.outer_opt_state,
+                    ),
+                }
+                for frag in algo._fragments
+            ],
             "manager_state": manager.state_dict(),
         }
     finally:

@@ -1,0 +1,202 @@
+"""LocalSGD / DiLoCo unit tests against mocked coordination (parity:
+local_sgd_test.py) plus golden-file numerics regression (parity:
+diloco_regression_test.py)."""
+
+import json
+import os
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from test_manager import make_manager, make_quorum
+
+from torchft_tpu.local_sgd import DiLoCo, LocalSGD
+from torchft_tpu.parallel.process_group import ProcessGroupDummy
+
+FIXTURES = Path(__file__).parent / "fixtures"
+
+
+def make_params():
+    return {
+        "w1": jnp.array([1.0, 2.0], dtype=jnp.float32),
+        "w2": jnp.array([[3.0], [4.0]], dtype=jnp.float32),
+        "b": jnp.array([0.5], dtype=jnp.float32),
+    }
+
+
+def fixed_grads(step: int):
+    return {
+        "w1": jnp.full(2, 0.1 * (step + 1), dtype=jnp.float32),
+        "w2": jnp.full((2, 1), 0.2, dtype=jnp.float32),
+        "b": jnp.array([0.05], dtype=jnp.float32),
+    }
+
+
+def scripted_manager(**kwargs):
+    kwargs.setdefault("min_replica_size", 1)
+    manager, client, pg, transport = make_manager(pg=ProcessGroupDummy(), **kwargs)
+    client._quorum.return_value = make_quorum(replica_world_size=1, max_world_size=1)
+    client.should_commit.side_effect = lambda rank, step, vote, timeout: vote
+    return manager
+
+
+# -- LocalSGD ---------------------------------------------------------------
+
+
+def test_local_sgd_syncs_every_n_steps() -> None:
+    manager = scripted_manager()
+    algo = LocalSGD(manager, optax.sgd(0.1), make_params(), sync_every=2)
+
+    assert not algo.step(fixed_grads(0))  # local only
+    assert algo.step(fixed_grads(1))  # sync round commits
+    # With a single participant averaging is identity: params equal plain SGD.
+    expected = make_params()
+    opt_state = optax.sgd(0.1).init(expected)
+    for s in range(2):
+        updates, opt_state = optax.sgd(0.1).update(fixed_grads(s), opt_state, expected)
+        expected = optax.apply_updates(expected, updates)
+    for key in expected:
+        np.testing.assert_allclose(algo.params[key], expected[key], rtol=1e-6)
+
+
+def test_local_sgd_failed_commit_keeps_local_params() -> None:
+    manager = scripted_manager()
+    manager._client.should_commit.side_effect = None
+    manager._client.should_commit.return_value = False
+    algo = LocalSGD(manager, optax.sgd(0.1), make_params(), sync_every=1)
+    committed = algo.step(fixed_grads(0))
+    assert not committed
+    # Local inner step still applied.
+    assert not np.allclose(algo.params["w1"], make_params()["w1"])
+
+
+# -- DiLoCo -----------------------------------------------------------------
+
+
+def test_diloco_requires_sync_quorum() -> None:
+    manager = scripted_manager(use_async_quorum=True)
+    with pytest.raises(ValueError, match="synchronous quorum"):
+        DiLoCo(manager, optax.sgd(0.1), optax.sgd(1.0), make_params(), sync_every=2)
+
+
+def test_diloco_validations() -> None:
+    manager = scripted_manager(use_async_quorum=False)
+    with pytest.raises(ValueError, match="multiple"):
+        DiLoCo(
+            manager, optax.sgd(0.1), optax.sgd(1.0), make_params(),
+            sync_every=3, n_fragments=2,
+        )
+    with pytest.raises(ValueError, match="synced before"):
+        DiLoCo(
+            manager, optax.sgd(0.1), optax.sgd(1.0), make_params(),
+            sync_every=2, n_fragments=1, fragment_sync_delay=5,
+        )
+
+
+def test_diloco_outer_step_applies_averaged_pseudogradient() -> None:
+    manager = scripted_manager(use_async_quorum=False)
+    inner = optax.sgd(0.1)
+    outer = optax.sgd(1.0)  # lr=1: global = backup - avg pseudograd exactly
+    algo = DiLoCo(manager, inner, outer, make_params(), sync_every=2)
+
+    p0 = make_params()
+    assert not algo.step(fixed_grads(0))
+    assert algo.step(fixed_grads(1))
+
+    # Single participant: avg pseudograd == backup - local. Outer SGD(lr=1)
+    # on the backup gives exactly the local params; alpha=0 takes the global.
+    inner_state = inner.init(p0)
+    local = p0
+    for s in range(2):
+        updates, inner_state = inner.update(fixed_grads(s), inner_state, local)
+        local = optax.apply_updates(local, updates)
+    for key in local:
+        np.testing.assert_allclose(algo.params[key], local[key], rtol=1e-6)
+
+
+def test_diloco_failed_commit_restores_global_params() -> None:
+    manager = scripted_manager(use_async_quorum=False)
+    manager._client.should_commit.side_effect = None
+    manager._client.should_commit.return_value = False
+    p0 = make_params()
+    algo = DiLoCo(manager, optax.sgd(0.1), optax.sgd(0.7), p0, sync_every=1)
+    committed = algo.step(fixed_grads(0))
+    assert not committed
+    # Failed sync resets the fragment to the last global state (= init).
+    for key in p0:
+        np.testing.assert_allclose(algo.params[key], p0[key], rtol=1e-6)
+
+
+def test_diloco_fragments_rotate_and_cover_all_leaves() -> None:
+    manager = scripted_manager(use_async_quorum=False)
+    algo = DiLoCo(
+        manager, optax.sgd(0.1), optax.sgd(1.0), make_params(),
+        sync_every=2, n_fragments=2,
+    )
+    covered = sorted(i for frag in algo._fragments for i in frag.leaf_indices)
+    assert covered == list(range(3))
+    # Fragment choice keyed by manager step.
+    assert algo._current_fragment() == 0
+    manager._step = 1
+    assert algo._current_fragment() == 1
+
+
+def test_diloco_update_alpha_mixes_local_and_global() -> None:
+    manager = scripted_manager(use_async_quorum=False)
+    p0 = make_params()
+    algo = DiLoCo(
+        manager, optax.sgd(0.1), optax.sgd(1.0), p0, sync_every=1,
+        fragment_update_alpha=1.0,  # keep local entirely
+    )
+    inner = optax.sgd(0.1)
+    inner_state = inner.init(p0)
+    updates, _ = inner.update(fixed_grads(0), inner_state, p0)
+    local = optax.apply_updates(p0, updates)
+    algo.step(fixed_grads(0))
+    for key in local:
+        np.testing.assert_allclose(algo.params[key], local[key], rtol=1e-6)
+
+
+# -- golden-file regression (parity: diloco_regression_test.py) -------------
+
+
+@pytest.mark.parametrize(
+    "n_fragments,sync_delay,alpha",
+    [(1, 0, 0.0), (2, 0, 0.0), (2, 1, 0.0), (2, 0, 0.5)],
+)
+def test_diloco_golden_history(n_fragments, sync_delay, alpha) -> None:
+    manager = scripted_manager(use_async_quorum=False)
+    algo = DiLoCo(
+        manager,
+        optax.sgd(0.1),
+        optax.sgd(0.7, momentum=0.9, nesterov=True),
+        make_params(),
+        sync_every=4,
+        n_fragments=n_fragments,
+        fragment_sync_delay=sync_delay,
+        fragment_update_alpha=alpha,
+    )
+    history = []
+    for step in range(12):
+        algo.step(fixed_grads(step))
+        history.append(
+            {k: np.asarray(v).tolist() for k, v in sorted(algo.params.items())}
+        )
+
+    name = f"diloco_f{n_fragments}_d{sync_delay}_a{alpha}.json"
+    path = FIXTURES / name
+    if os.environ.get("TPUFT_REGEN_FIXTURES") == "1":
+        FIXTURES.mkdir(exist_ok=True)
+        path.write_text(json.dumps(history, indent=1))
+        pytest.skip("regenerated fixture")
+    assert path.exists(), f"fixture {name} missing; run with TPUFT_REGEN_FIXTURES=1"
+    golden = json.loads(path.read_text())
+    for step, (got, want) in enumerate(zip(history, golden)):
+        for key in want:
+            np.testing.assert_allclose(
+                got[key], want[key], rtol=1e-6, err_msg=f"step {step} key {key}"
+            )

@@ -1,0 +1,154 @@
+"""fp8 quantization + quantized collective tests (parity targets:
+quantization_test.py + collectives_test.py)."""
+
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from test_process_group import fresh_prefix, make_group, run_on_all, store_server  # noqa: F401
+
+from torchft_tpu.ops import quantization as q
+from torchft_tpu.parallel.collectives import (
+    allreduce_quantized,
+    reduce_scatter_quantized,
+)
+from torchft_tpu.parallel.process_group import ReduceOp
+
+
+# -- kernels (numpy reference) ------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "shape", [(7,), (256,), (1000,), (33, 17), (4, 4, 4)]
+)
+def test_quantize_roundtrip_accuracy(shape) -> None:
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=shape).astype(np.float32) * 10
+    payload, scales = q.quantize_blocks(x)
+    restored = q.dequantize_blocks(payload, scales, x.shape, x.dtype)
+    # fp8 e4m3 has ~2 decimal digits; blockwise scales keep relative error low.
+    np.testing.assert_allclose(restored, x, rtol=0.07, atol=0.1)
+
+
+def test_quantize_zero_block() -> None:
+    x = np.zeros(512, dtype=np.float32)
+    payload, scales = q.quantize_blocks(x)
+    restored = q.dequantize_blocks(payload, scales, x.shape, x.dtype)
+    np.testing.assert_array_equal(restored, x)
+
+
+def test_reduce_quantized_matches_float_sum() -> None:
+    rng = np.random.default_rng(1)
+    chunks = [rng.normal(size=(4, q.BLOCK)).astype(np.float32) for _ in range(3)]
+    quantized = [q.quantize_blocks(c) for c in chunks]
+    out_payload, out_scales = q.reduce_quantized(
+        [p for p, _ in quantized], [s for _, s in quantized]
+    )
+    total = sum(
+        p.astype(np.float32) * s[:, None] for p, s in quantized
+    )
+    restored = out_payload.astype(np.float32) * out_scales[:, None]
+    np.testing.assert_allclose(restored, total, rtol=0.07, atol=0.1)
+
+
+def test_pack_unpack_roundtrip() -> None:
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(5, q.BLOCK)).astype(np.float32)
+    payload, scales = q.quantize_blocks(x.reshape(-1))
+    buf = q.pack_arrays(payload, scales)
+    payload2, scales2 = q.unpack_arrays(buf, payload.shape[0])
+    np.testing.assert_array_equal(payload.view(np.uint8), payload2.view(np.uint8))
+    np.testing.assert_array_equal(scales, scales2)
+
+
+# -- pallas kernels (interpret mode on CPU) -----------------------------------
+
+
+def test_pallas_quantize_matches_numpy() -> None:
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(8, q.BLOCK)).astype(np.float32) * 5
+    payload_np, scales_np = q.quantize_blocks(x.reshape(-1))
+    payload_pl, scales_pl = q.quantize_blocks_pallas(jnp.asarray(x), interpret=True)
+    np.testing.assert_allclose(scales_pl, scales_np, rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(payload_pl).astype(np.float32),
+        payload_np.astype(np.float32),
+        atol=1e-6,
+    )
+    restored = q.dequantize_blocks_pallas(payload_pl, scales_pl, interpret=True)
+    np.testing.assert_allclose(np.asarray(restored), x, rtol=0.07, atol=0.1)
+
+
+# -- quantized collectives over a real PG -------------------------------------
+
+
+@pytest.mark.parametrize("world_size", [2, 4])
+def test_allreduce_quantized_sum_avg(store_server, world_size) -> None:
+    pgs = make_group(store_server, world_size)
+    rng = np.random.default_rng(4)
+    inputs = [
+        [rng.normal(size=(40, 13)).astype(np.float32), rng.normal(size=300).astype(np.float32)]
+        for _ in range(world_size)
+    ]
+    try:
+        for op in (ReduceOp.SUM, ReduceOp.AVG):
+            results = run_on_all(
+                pgs, lambda pg, i: allreduce_quantized(inputs[i], op, pg).wait()
+            )
+            expected = [
+                sum(inputs[r][idx] for r in range(world_size)) for idx in range(2)
+            ]
+            if op == ReduceOp.AVG:
+                expected = [e / world_size for e in expected]
+            for r in results:
+                for idx in range(2):
+                    assert r[idx].shape == expected[idx].shape
+                    assert r[idx].dtype == expected[idx].dtype
+                    # Two quantization passes: tolerance ~ 2x single pass.
+                    np.testing.assert_allclose(
+                        r[idx], expected[idx], rtol=0.2, atol=0.3
+                    )
+            # Bitwise identical across ranks.
+            for idx in range(2):
+                assert all(
+                    r[idx].tobytes() == results[0][idx].tobytes() for r in results
+                )
+    finally:
+        for pg in pgs:
+            pg.shutdown()
+
+
+def test_reduce_scatter_quantized(store_server) -> None:
+    pgs = make_group(store_server, 2)
+    rng = np.random.default_rng(5)
+    inputs = [[rng.normal(size=1024).astype(np.float32)] for _ in range(2)]
+    try:
+        results = run_on_all(
+            pgs,
+            lambda pg, i: reduce_scatter_quantized(inputs[i], ReduceOp.SUM, pg).wait(),
+        )
+        total = inputs[0][0] + inputs[1][0]
+        blocks = total.reshape(-1, q.BLOCK)
+        # rank 0 gets blocks [0:2], rank 1 gets [2:4]
+        for rank, result in enumerate(results):
+            expected = blocks[rank * 2 : (rank + 1) * 2].reshape(-1)
+            np.testing.assert_allclose(result[0], expected, rtol=0.2, atol=0.3)
+    finally:
+        for pg in pgs:
+            pg.shutdown()
+
+
+def test_manager_allreduce_quantized_path() -> None:
+    """manager.allreduce(should_quantize=True) routes through the fp8 path."""
+    from test_manager import make_manager, make_quorum
+    from torchft_tpu.parallel.process_group import ProcessGroupDummy
+
+    manager, client, _, _ = make_manager(pg=ProcessGroupDummy(), min_replica_size=1)
+    client._quorum.return_value = make_quorum(replica_world_size=1, max_world_size=1)
+    manager.start_quorum()
+    x = np.linspace(-3, 3, 512, dtype=np.float32)
+    out = manager.allreduce(x, should_quantize=True).wait()
+    np.testing.assert_allclose(out, x, rtol=0.1, atol=0.1)

@@ -1,0 +1,368 @@
+"""LocalSGD and (Streaming) DiLoCo: semi-synchronous training.
+
+Role-equivalent of the reference's ``torchft/local_sgd.py``. Both algorithms
+run ``sync_every`` cheap local steps between expensive cross-replica syncs —
+the natural fit for the TPU replica axis riding DCN between slices:
+
+- :class:`LocalSGD` (reference :46-173): every ``sync_every`` steps, average
+  the *parameters* across replica groups and commit.
+- :class:`DiLoCo` (reference :570-797, DiLoCo https://arxiv.org/pdf/2311.08105,
+  Streaming DiLoCo https://arxiv.org/pdf/2501.18512): keep a backup of the
+  last-synced "global" parameters; every cycle, average the *pseudogradient*
+  (global − local) for one model fragment and apply it with an outer
+  optimizer (typically Nesterov SGD). Fragments rotate by manager step so all
+  replicas reduce the same fragment (cross-replica deadlock avoidance,
+  reference :753-764); ``fragment_sync_delay`` overlaps the allreduce with
+  further local steps.
+
+Both own (params, inner_opt_state) like :class:`torchft_tpu.optim.Optimizer`
+and register their state with the manager for live healing.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from torchft_tpu.manager import Manager
+from torchft_tpu.work import Work
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["LocalSGD", "DiLoCo"]
+
+
+def _to_host_leaves(leaves: Sequence[Any]) -> List[np.ndarray]:
+    return [np.asarray(leaf) for leaf in leaves]
+
+
+def _to_device_like(host: np.ndarray, like: Any) -> Any:
+    import jax.numpy as jnp
+
+    if isinstance(like, jax.Array):
+        return jax.device_put(host, like.sharding)
+    return jnp.asarray(host)
+
+
+class LocalSGD:
+    """Parameter-averaging semi-sync training (reference local_sgd.py:46-173).
+
+    Runs the inner optimizer every step; every ``sync_every`` steps averages
+    the parameters across replica groups and commits. A failed commit keeps
+    the local parameters and retries at the next sync point.
+    """
+
+    def __init__(
+        self,
+        manager: Manager,
+        inner_tx: Any,
+        params: Any,
+        sync_every: int,
+        register_key: str = "local_sgd",
+    ) -> None:
+        assert sync_every >= 1
+        self._manager = manager
+        self._inner_tx = inner_tx
+        self.params = params
+        self.opt_state = inner_tx.init(params)
+        self._sync_every = sync_every
+        self._local_step = 0
+        manager.register_state_dict_fn(register_key, self._load_state, self._save_state)
+
+    def _save_state(self) -> Dict[str, Any]:
+        return {"params": self.params, "opt_state": self.opt_state}
+
+    def _load_state(self, state: Dict[str, Any]) -> None:
+        import jax.numpy as jnp
+
+        self.params = jax.tree_util.tree_map(jnp.asarray, state["params"])
+        self.opt_state = jax.tree_util.tree_map(
+            lambda x: jnp.asarray(x) if hasattr(x, "shape") else x, state["opt_state"]
+        )
+
+    def step(self, grads: Any) -> bool:
+        """One inner step; returns whether a sync round committed."""
+        import optax
+
+        # Write-lock mutations so checkpoint captures never see a torn state
+        # (reference step pre/post hooks, local_sgd.py:112-128).
+        self._manager.disallow_state_dict_read()
+        try:
+            updates, self.opt_state = self._inner_tx.update(
+                grads, self.opt_state, self.params
+            )
+            self.params = optax.apply_updates(self.params, updates)
+        finally:
+            self._manager.allow_state_dict_read()
+        self._local_step += 1
+        if self._local_step < self._sync_every:
+            return False
+        self._local_step = 0
+        return self._sync()
+
+    def _sync(self) -> bool:
+        self._manager.start_quorum()
+        leaves, treedef = jax.tree_util.tree_flatten(self.params)
+        work = self._manager.allreduce_pytree(_to_host_leaves(leaves))
+        averaged = work.wait()
+        if self._manager.should_commit():
+            self._manager.disallow_state_dict_read()
+            try:
+                self.params = jax.tree_util.tree_unflatten(
+                    treedef,
+                    [_to_device_like(avg, leaf) for avg, leaf in zip(averaged, leaves)],
+                )
+            finally:
+                self._manager.allow_state_dict_read()
+            return True
+        return False
+
+
+class _Fragment:
+    """One model fragment's DiLoCo state: the host backup of the last-synced
+    global parameters, the outer optimizer state, and the in-flight
+    pseudogradient allreduce (reference _StreamingDiLoCoFragment:176-568)."""
+
+    def __init__(
+        self,
+        manager: Manager,
+        fragment_id: int,
+        leaf_indices: List[int],
+        outer_tx: Any,
+        initial_leaves: List[Any],
+        should_quantize: bool,
+        fragment_update_alpha: float,
+    ) -> None:
+        self._manager = manager
+        self._fragment_id = fragment_id
+        self.leaf_indices = leaf_indices
+        self._outer_tx = outer_tx
+        self._should_quantize = should_quantize
+        self._alpha = fragment_update_alpha
+        # Host ("CPU-pinned" analogue) backup of the global params.
+        self.backup: List[np.ndarray] = [np.array(x, copy=True) for x in initial_leaves]
+        self.outer_opt_state = outer_tx.init(self.backup)
+        self._work: Optional[Work] = None
+        manager.register_state_dict_fn(
+            f"StreamingDiLoCoFragment_{fragment_id}", self._load_state, self._save_state
+        )
+
+    def _save_state(self) -> Dict[str, Any]:
+        return {
+            "original_parameters": [np.array(b) for b in self.backup],
+            "outer_optimizer": self.outer_opt_state,
+        }
+
+    def _load_state(self, state: Dict[str, Any]) -> None:
+        self.backup = [np.array(b) for b in state["original_parameters"]]
+        self.outer_opt_state = jax.tree_util.tree_map(
+            lambda x: np.asarray(x) if hasattr(x, "shape") else x,
+            state["outer_optimizer"],
+        )
+
+    def prepare_sync(self, local_leaves: List[Any]) -> None:
+        """Computes pseudogradients (backup − local) and launches their
+        averaging; does not wait (reference :402-421)."""
+        assert self._work is None, "fragment already has an allreduce in flight"
+        pseudograds = [
+            backup - np.asarray(local_leaves[i])
+            for backup, i in zip(self.backup, self.leaf_indices)
+        ]
+        self._work = self._manager.allreduce_pytree(
+            pseudograds, should_quantize=self._should_quantize
+        )
+
+    def perform_sync(self, local_leaves: List[Any]) -> bool:
+        """Waits for the allreduce, restores globals, commits, and on success
+        applies the outer step + local/global merge (reference :423-476)."""
+        import optax
+
+        assert self._work is not None, "perform_sync before prepare_sync"
+        averaged = self._work.wait()
+        self._work = None
+
+        local_copy = [np.asarray(local_leaves[i]) for i in self.leaf_indices]
+        # Restore to the last global state before voting: on a failed commit
+        # the fragment resets rather than over-training on a divergent copy.
+        self._manager.disallow_state_dict_read()
+        try:
+            for slot, backup in enumerate(self.backup):
+                local_leaves[self.leaf_indices[slot]] = _to_device_like(
+                    backup, local_leaves[self.leaf_indices[slot]]
+                )
+        finally:
+            self._manager.allow_state_dict_read()
+
+        # The commit barrier must run unlocked: it can apply a healing state
+        # dict and peers' serve threads need the read lock meanwhile.
+        if not self._manager.should_commit():
+            return False
+
+        self._manager.disallow_state_dict_read()
+        try:
+            updates, self.outer_opt_state = self._outer_tx.update(
+                averaged, self.outer_opt_state, self.backup
+            )
+            new_global = optax.apply_updates(self.backup, updates)
+            new_global = [np.asarray(g) for g in new_global]
+            self.backup = [np.array(g, copy=True) for g in new_global]
+            for slot, i in enumerate(self.leaf_indices):
+                merged = (
+                    new_global[slot] * (1.0 - self._alpha) + local_copy[slot] * self._alpha
+                )
+                local_leaves[i] = _to_device_like(
+                    merged.astype(local_copy[slot].dtype), local_leaves[i]
+                )
+        finally:
+            self._manager.allow_state_dict_read()
+        return True
+
+
+class DiLoCo:
+    """(Streaming) DiLoCo over the fault-tolerant replica axis.
+
+    Args:
+        manager: must use synchronous quorum (``use_async_quorum=False``).
+        inner_tx / outer_tx: optax transforms for the local and global steps.
+            ``outer_tx`` may be a list, one per fragment. The canonical outer
+            optimizer is SGD with Nesterov momentum.
+        params: initial parameters (owned by this object, like Optimizer).
+        sync_every: inner steps per full round of fragment syncs; must be a
+            multiple of ``n_fragments``.
+        n_fragments: number of streaming fragments (leaf-partitioned).
+        fragment_fn: optional override partitioning flattened leaf indices
+            into fragments; defaults to contiguous chunks.
+        fragment_sync_delay: inner steps between a fragment's allreduce
+            launch and its blocking sync (tau in the Streaming DiLoCo paper).
+        fragment_update_alpha: local/global mix after a sync (0 = take the
+            global params, 1 = keep local).
+    """
+
+    def __init__(
+        self,
+        manager: Manager,
+        inner_tx: Any,
+        outer_tx: Any,
+        params: Any,
+        sync_every: int,
+        n_fragments: int = 1,
+        fragment_fn: Optional[Callable[[int], List[List[int]]]] = None,
+        should_quantize: bool = False,
+        fragment_sync_delay: int = 0,
+        fragment_update_alpha: float = 0.0,
+    ) -> None:
+        if manager._use_async_quorum:
+            raise ValueError(
+                "DiLoCo requires synchronous quorum: construct the Manager "
+                "with use_async_quorum=False"
+            )
+        if sync_every < n_fragments:
+            raise ValueError("Only 1 fragment can be synchronized at a time")
+        if sync_every % n_fragments != 0:
+            raise ValueError("sync_every must be a multiple of n_fragments")
+        self._sync_every = sync_every // n_fragments
+        if fragment_sync_delay >= self._sync_every:
+            raise ValueError("Fragment must be synced before it is reduced again")
+        if not 0.0 <= fragment_update_alpha <= 1.0:
+            raise ValueError("fragment_update_alpha must be between 0 and 1")
+
+        self._manager = manager
+        self._inner_tx = inner_tx
+        self._fragment_sync_delay = fragment_sync_delay
+        self._local_step = 0
+
+        leaves, self._treedef = jax.tree_util.tree_flatten(params)
+        self._leaves = list(leaves)
+        self.inner_opt_state = inner_tx.init(params)
+        manager.register_state_dict_fn(
+            "diloco_inner", self._load_inner, self._save_inner
+        )
+
+        if fragment_fn is not None:
+            partitions = fragment_fn(len(self._leaves))
+        else:
+            # Contiguous leaf chunks (the analogue of layer-group fragments).
+            partitions = [
+                [int(j) for j in part]
+                for part in np.array_split(np.arange(len(self._leaves)), n_fragments)
+            ]
+        assert len(partitions) == n_fragments
+        outer_txs = outer_tx if isinstance(outer_tx, list) else [outer_tx] * n_fragments
+        assert len(outer_txs) == n_fragments
+        self._fragments = [
+            _Fragment(
+                manager,
+                i,
+                part,
+                outer_txs[i],
+                [self._leaves[j] for j in part],
+                should_quantize,
+                fragment_update_alpha,
+            )
+            for i, part in enumerate(partitions)
+        ]
+
+    # -- state -------------------------------------------------------------
+
+    @property
+    def params(self) -> Any:
+        return jax.tree_util.tree_unflatten(self._treedef, self._leaves)
+
+    def _save_inner(self) -> Dict[str, Any]:
+        return {"leaves": list(self._leaves), "opt_state": self.inner_opt_state}
+
+    def _load_inner(self, state: Dict[str, Any]) -> None:
+        import jax.numpy as jnp
+
+        self._leaves = [jnp.asarray(x) for x in state["leaves"]]
+        self.inner_opt_state = jax.tree_util.tree_map(
+            lambda x: jnp.asarray(x) if hasattr(x, "shape") else x, state["opt_state"]
+        )
+
+    def _current_fragment(self) -> int:
+        """All replicas must reduce the same fragment per round; keyed by the
+        committed manager step (reference :739-744)."""
+        return self._manager.current_step() % len(self._fragments)
+
+    # -- step --------------------------------------------------------------
+
+    def step(self, grads: Any) -> bool:
+        """One inner step; drives the fragment prepare/sync schedule.
+        Returns whether a fragment sync committed this step."""
+        import optax
+
+        # Write-lock the inner mutation (reference step pre/post hooks).
+        self._manager.disallow_state_dict_read()
+        try:
+            params = self.params
+            updates, self.inner_opt_state = self._inner_tx.update(
+                grads, self.inner_opt_state, params
+            )
+            self._leaves = list(
+                jax.tree_util.tree_flatten(optax.apply_updates(params, updates))[0]
+            )
+        finally:
+            self._manager.allow_state_dict_read()
+        self._local_step += 1
+        committed = False
+
+        if self._local_step == self._sync_every - self._fragment_sync_delay:
+            self._manager.start_quorum()
+            fragment = self._current_fragment()
+            logger.info("Preparing fragment=%d step=%d", fragment, self._local_step)
+            self._fragments[fragment].prepare_sync(self._leaves)
+
+        if self._local_step == self._sync_every:
+            fragment = self._current_fragment()
+            logger.info(
+                "Syncing fragment=%d step=%d manager_step=%d",
+                fragment,
+                self._local_step,
+                self._manager.current_step(),
+            )
+            committed = self._fragments[fragment].perform_sync(self._leaves)
+            self._local_step = 0
+        return committed

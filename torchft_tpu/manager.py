@@ -247,6 +247,17 @@ class Manager:
         self._load_state_dict_fns[key] = cast(Callable[[Any], None], load_state_dict)
         self._user_state_dicts[key] = state_dict
 
+    def disallow_state_dict_read(self, timeout: Optional[float] = None) -> None:
+        """Takes the state-dict write lock: blocks checkpoint serves while the
+        optimizer mutates registered state (reference: allow/disallow pair
+        used by LocalSGD/DiLoCo step hooks, local_sgd.py:112-128)."""
+        effective = self._timeout if timeout is None else timeout
+        if not self._state_dict_lock.w_acquire(effective):
+            raise TimeoutError("state dict write lock not acquired")
+
+    def allow_state_dict_read(self) -> None:
+        self._state_dict_lock.w_release()
+
     def shutdown(self, wait: bool = True) -> None:
         self._checkpoint_transport.shutdown(wait=wait)
         if self._manager is not None:

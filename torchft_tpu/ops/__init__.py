@@ -1,0 +1,1 @@
+"""Device kernels: quantization, attention, and other hot ops."""

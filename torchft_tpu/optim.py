@@ -91,8 +91,14 @@ class Optimizer:
         # self.params/opt_state only after it returns.
         if not self.manager.should_commit(timeout=timeout):
             return False
-        updates, self.opt_state = self.tx.update(grads, self.opt_state, self.params)
-        self.params = optax.apply_updates(self.params, updates)
+        # Write-lock the mutation so a concurrent checkpoint capture (donor
+        # staging on the quorum thread) never reads a torn params/opt pair.
+        self.manager.disallow_state_dict_read()
+        try:
+            updates, self.opt_state = self.tx.update(grads, self.opt_state, self.params)
+            self.params = optax.apply_updates(self.params, updates)
+        finally:
+            self.manager.allow_state_dict_read()
         return True
 
 
