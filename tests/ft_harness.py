@@ -241,6 +241,7 @@ def diloco_train_loop(
     sync_every: int = 4,
     n_fragments: int = 2,
     fragment_sync_delay: int = 0,
+    should_quantize: bool = False,
 ) -> Dict[str, Any]:
     """Streaming DiLoCo across replica groups; returns the per-fragment
     global state for cross-group equality assertions."""
@@ -271,6 +272,7 @@ def diloco_train_loop(
             sync_every=sync_every,
             n_fragments=n_fragments,
             fragment_sync_delay=fragment_sync_delay,
+            should_quantize=should_quantize,
         )
         inner_iter = 0
         while manager.current_step() < num_syncs:

@@ -81,3 +81,27 @@ def test_diloco_recovery_after_kill(lighthouse) -> None:
     for group_result in results:
         assert group_result[0]["manager_state"]["step"] == 4
     assert_equal_global_state(results)
+
+
+def test_diloco_quantized_two_groups(lighthouse) -> None:
+    """The fp8 device pipeline: pseudograds quantized on device, only fp8 on
+    the wire; global state must still converge bitwise across groups."""
+    runners = [
+        Runner(
+            replica_group=i,
+            lighthouse_addr=lighthouse.address(),
+            train_loop=diloco_train_loop,
+            use_async_quorum=False,
+            train_loop_args={
+                "num_syncs": 3,
+                "sync_every": 2,
+                "n_fragments": 1,
+                "should_quantize": True,
+            },
+        )
+        for i in range(2)
+    ]
+    results = run_replica_groups(runners, timeout=180)
+    for group_result in results:
+        assert group_result[0]["manager_state"]["step"] == 3
+    assert_equal_global_state(results)

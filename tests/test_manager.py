@@ -335,3 +335,32 @@ def test_state_dict_roundtrip() -> None:
     manager.load_state_dict({"step": 42, "batches_committed": 84})
     assert manager.current_step() == 42
     assert manager.batches_committed() == 84
+
+
+def test_allreduce_prequantized_zeroes_spare_contribution() -> None:
+    """FIXED_WITH_SPARES: a spare's prequantized payload must contribute
+    nothing (scales zeroed) and errors must short-circuit to None."""
+    import jax.numpy as jnp
+
+    from torchft_tpu.ops import quantization as q
+
+    manager, client, _, _ = make_manager(
+        pg=ProcessGroupDummy(),
+        min_replica_size=2,
+        world_size_mode=WorldSizeMode.FIXED_WITH_SPARES,
+    )
+    client._quorum.return_value = make_quorum(
+        replica_rank=2, replica_world_size=3, max_rank=2, max_world_size=3
+    )
+    manager.start_quorum()
+    assert not manager.is_participating()
+
+    payload, scales = q.quantize_blocks(np.linspace(-2, 2, 512, dtype=np.float32))
+    result = manager.allreduce_prequantized(jnp.asarray(payload), jnp.asarray(scales)).wait()
+    out_payload, out_scales = result
+    # Spare contribution fully zeroed via scales.
+    assert np.all(np.asarray(out_scales) == 0)
+
+    # Errored manager: immediate None without touching the PG.
+    manager.report_error(RuntimeError("boom"))
+    assert manager.allreduce_prequantized(payload, scales).wait() is None

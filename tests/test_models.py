@@ -1,0 +1,145 @@
+"""Model family + long-context tests: llama forward/grad, sharding plan on
+the virtual 8-device mesh, ring attention vs dense reference."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from torchft_tpu.models.llama import (
+    CONFIGS,
+    Llama,
+    LlamaConfig,
+    apply_sharding_plan,
+    causal_attention,
+    cross_entropy_loss,
+    sharding_plan,
+)
+from torchft_tpu.ops.ring_attention import ring_attention_sharded
+
+
+def test_llama_tiny_forward_and_grad() -> None:
+    cfg = CONFIGS["tiny"]
+    model = Llama(cfg)
+    tokens = jnp.arange(32, dtype=jnp.int32).reshape(2, 16) % cfg.vocab_size
+    params = model.init(jax.random.PRNGKey(0), tokens)
+    logits = jax.jit(model.apply)(params, tokens)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+
+    def loss(p):
+        return cross_entropy_loss(model.apply(p, tokens), tokens)
+
+    value, grads = jax.jit(jax.value_and_grad(loss))(params)
+    assert np.isfinite(float(value))
+    # Every param gets a finite gradient.
+    for leaf in jax.tree_util.tree_leaves(grads):
+        assert np.all(np.isfinite(np.asarray(leaf, dtype=np.float32)))
+
+
+def test_llama_causal_masking() -> None:
+    """Changing future tokens must not change past logits."""
+    cfg = CONFIGS["tiny"]
+    model = Llama(cfg)
+    tokens = jnp.ones((1, 8), dtype=jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)
+    logits_a = model.apply(params, tokens)
+    tokens_b = tokens.at[0, 6].set(3)
+    logits_b = model.apply(params, tokens_b)
+    np.testing.assert_allclose(
+        np.asarray(logits_a[0, :6]), np.asarray(logits_b[0, :6]), rtol=1e-5
+    )
+    assert not np.allclose(np.asarray(logits_a[0, 6:]), np.asarray(logits_b[0, 6:]))
+
+
+def test_gqa_grouping() -> None:
+    b, s, h, kv, d = 2, 8, 4, 2, 16
+    key = jax.random.PRNGKey(1)
+    kq, kk, kv_ = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, s, h, d), jnp.float32)
+    k = jax.random.normal(kk, (b, s, kv, d), jnp.float32)
+    v = jax.random.normal(kv_, (b, s, kv, d), jnp.float32)
+    out = causal_attention(q, k, v, d**-0.5)
+    assert out.shape == (b, s, h, d)
+    # Heads 0,1 share kv head 0: with identical q rows they'd match; with
+    # distinct q they must differ from heads 2,3 (kv head 1).
+    q_same = jnp.broadcast_to(q[:, :, :1], q.shape)
+    out_same = causal_attention(q_same, k, v, d**-0.5)
+    np.testing.assert_allclose(out_same[:, :, 0], out_same[:, :, 1], rtol=1e-5)
+    assert not np.allclose(out_same[:, :, 0], out_same[:, :, 2])
+
+
+def test_sharding_plan_applies_on_mesh() -> None:
+    cfg = LlamaConfig(
+        vocab_size=512, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        ffn_hidden=128, max_seq_len=64, dtype=jnp.float32,
+    )
+    model = Llama(cfg)
+    tokens = jnp.zeros((1, 16), dtype=jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)
+    mesh = Mesh(np.array(jax.devices()).reshape(4, 2), ("fsdp", "tp"))
+    sharded = apply_sharding_plan(params, mesh, sharding_plan())
+    flat = jax.tree_util.tree_flatten_with_path(sharded)[0]
+    specs = {
+        "/".join(str(getattr(k, "key", k)) for k in path): leaf.sharding.spec
+        for path, leaf in flat
+    }
+    # Column-parallel qkv kernels sharded (fsdp, tp, None).
+    wq = next(spec for name, spec in specs.items() if "wq/kernel" in name)
+    assert wq == P("fsdp", "tp", None)
+    # Norm scales replicated.
+    norm = next(spec for name, spec in specs.items() if "scale" in name)
+    assert norm == P()
+    # Forward still runs under jit with sharded params.
+    with mesh:
+        logits = jax.jit(model.apply)(sharded, tokens)
+    assert logits.shape == (1, 16, cfg.vocab_size)
+
+
+@pytest.mark.parametrize("sp_size", [2, 4])
+def test_ring_attention_matches_dense(sp_size: int) -> None:
+    b, s, h, kv, d = 2, 32, 4, 2, 16
+    key = jax.random.PRNGKey(2)
+    kq, kk, kvk = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, s, h, d), jnp.float32)
+    k = jax.random.normal(kk, (b, s, kv, d), jnp.float32)
+    v = jax.random.normal(kvk, (b, s, kv, d), jnp.float32)
+
+    dense = causal_attention(q, k, v, d**-0.5)
+
+    mesh = Mesh(np.array(jax.devices()[:sp_size]), ("sp",))
+    ring = ring_attention_sharded(q, k, v, mesh, axis_name="sp", scale=d**-0.5)
+    np.testing.assert_allclose(np.asarray(ring), np.asarray(dense), rtol=2e-4, atol=2e-5)
+
+
+def test_llama_auto_ring_attention_under_sp_mesh() -> None:
+    """With an sp axis in the mesh, the model's attention goes through the
+    ring path and matches the dense single-device result."""
+    cfg = LlamaConfig(
+        vocab_size=128, dim=32, n_layers=1, n_heads=4, n_kv_heads=2,
+        ffn_hidden=64, max_seq_len=64, dtype=jnp.float32,
+    )
+    model = Llama(cfg)
+    tokens = (jnp.arange(32, dtype=jnp.int32) % cfg.vocab_size).reshape(1, 32)
+    params = model.init(jax.random.PRNGKey(0), tokens)
+    dense_logits = model.apply(params, tokens)
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("sp",))
+    from jax import shard_map
+
+    def fwd(p, t, pos):
+        return model.apply(p, t, pos)
+
+    positions = jnp.broadcast_to(jnp.arange(32), (1, 32))
+    sharded_fwd = shard_map(
+        fwd,
+        mesh=mesh,
+        in_specs=(P(), P(None, "sp"), P(None, "sp")),
+        out_specs=P(None, "sp"),
+            )
+    with mesh:
+        ring_logits = sharded_fwd(params, tokens, positions)
+    np.testing.assert_allclose(
+        np.asarray(ring_logits), np.asarray(dense_logits), rtol=2e-4, atol=2e-4
+    )

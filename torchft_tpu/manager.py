@@ -356,6 +356,32 @@ class Manager:
             self.report_error(e)
             return _DummyWork(pytree)
 
+    def allreduce_prequantized(self, payload: Any, scales: Any) -> Work:
+        """Averages device-prequantized data (fp8 payload + f32 block scales,
+        ops/quantization.py layout) across participating replicas with the
+        same semantics as :meth:`allreduce_pytree`: non-participants zero
+        their contribution (by zeroing scales — free), errors resolve the
+        work to None and poison the step. Resolves to (payload, scales) of
+        the average for device-side dequantization."""
+        from torchft_tpu.parallel.collectives import allreduce_quantized_wire
+
+        if self.errored():
+            return _DummyWork(None)
+        self.wait_quorum()
+        num_participants = self.num_participants()
+        if not self.is_participating():
+            scales = scales * 0
+        try:
+            work = allreduce_quantized_wire(payload, scales, ReduceOp.SUM, self._pg)
+            return self.wrap_work(
+                work.then(lambda ps: (ps[0], ps[1] / max(num_participants, 1))),
+                default=None,
+            )
+        except Exception as e:  # noqa: BLE001
+            self._logger.exception(f"got exception in all reduce -- skipping remaining: {e}")
+            self.report_error(e)
+            return _DummyWork(None)
+
     # ------------------------------------------------------------------
     # error tracking
     # ------------------------------------------------------------------

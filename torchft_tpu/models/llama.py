@@ -1,0 +1,286 @@
+"""Llama-family transformer, TPU-first.
+
+The flagship model family for the fault-tolerant training stack — the
+reference composes with torchtitan's Llama 3 configs for its production
+story (BASELINE.md: FT-DDP Llama-3 8B, FT-HSDP 70B, DiLoCo 8B), so this
+module provides the same family natively: RMSNorm, rotary embeddings, GQA
+attention, SwiGLU MLP, tied-or-untied output head.
+
+TPU-first choices:
+- bfloat16 activations/weights by default, float32 RMSNorm accumulation and
+  logits — keeps matmuls on the MXU at full tile rate;
+- static shapes everywhere; the causal mask is computed inline (no python
+  control flow under jit);
+- attention dispatches to ring attention (ops/ring_attention.py) when a
+  sequence-parallel axis is present in the ambient mesh, enabling context
+  lengths sharded across devices;
+- :func:`sharding_plan` gives PartitionSpecs for fsdp/tp axes (megatron
+  layout: column-parallel qkv/up, row-parallel out/down) consumed by
+  ``jax.jit`` via NamedSharding.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+__all__ = [
+    "LlamaConfig",
+    "Llama",
+    "CONFIGS",
+    "sharding_plan",
+    "cross_entropy_loss",
+]
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    ffn_hidden: int = 14336
+    max_seq_len: int = 8192
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    tie_embeddings: bool = False
+    # "auto": ring attention iff an 'sp' axis is in the ambient mesh.
+    attention_impl: str = "auto"
+    sp_axis: str = "sp"
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+
+CONFIGS: Dict[str, LlamaConfig] = {
+    # Test/bench-sized models.
+    "tiny": LlamaConfig(
+        vocab_size=512, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        ffn_hidden=128, max_seq_len=256, dtype=jnp.float32,
+    ),
+    "small": LlamaConfig(
+        vocab_size=8192, dim=512, n_layers=6, n_heads=8, n_kv_heads=4,
+        ffn_hidden=1536, max_seq_len=2048,
+    ),
+    # Llama-3 family shapes (parity with the reference's torchtitan configs).
+    "1b": LlamaConfig(
+        vocab_size=128256, dim=2048, n_layers=16, n_heads=32, n_kv_heads=8,
+        ffn_hidden=8192, max_seq_len=8192,
+    ),
+    "8b": LlamaConfig(
+        vocab_size=128256, dim=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+        ffn_hidden=14336, max_seq_len=8192,
+    ),
+    "70b": LlamaConfig(
+        vocab_size=128256, dim=8192, n_layers=80, n_heads=64, n_kv_heads=8,
+        ffn_hidden=28672, max_seq_len=8192,
+    ),
+}
+
+
+def _rope_freqs(head_dim: int, theta: float) -> jnp.ndarray:
+    return 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+
+
+def apply_rope(x: jnp.ndarray, positions: jnp.ndarray, theta: float) -> jnp.ndarray:
+    """x: (batch, seq, heads, head_dim); positions: (batch, seq)."""
+    freqs = _rope_freqs(x.shape[-1], theta)  # (head_dim/2,)
+    angles = positions[..., None].astype(jnp.float32) * freqs  # (b, s, hd/2)
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],), jnp.float32)
+        x32 = x.astype(jnp.float32)
+        normed = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.eps)
+        return (normed * scale).astype(self.dtype)
+
+
+def _sp_axis_in_mesh(axis: str) -> bool:
+    """True when running under a mesh (shard_map/jit) that has `axis`."""
+    try:
+        env = jax.interpreters.pxla.thread_resources.env
+        return axis in env.physical_mesh.axis_names and env.physical_mesh.shape[axis] > 1
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def causal_attention(
+    q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, scale: float
+) -> jnp.ndarray:
+    """Grouped-query causal attention; fp32 softmax on the VPU, matmuls in
+    the input dtype on the MXU. Shapes: q (b,s,h,d); k,v (b,s,kv,d)."""
+    b, s, h, d = q.shape
+    kv_heads = k.shape[2]
+    group = h // kv_heads
+    q = q.reshape(b, s, kv_heads, group, d)
+    scores = jnp.einsum("bskgd,btkd->bkgst", q, k).astype(jnp.float32) * scale
+    mask = jnp.tril(jnp.ones((s, s), dtype=bool))
+    scores = jnp.where(mask[None, None, None, :, :], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bkgst,btkd->bskgd", probs, v)
+    return out.reshape(b, s, h, d)
+
+
+class Attention(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, positions: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.config
+        dense = partial(
+            nn.DenseGeneral, use_bias=False, dtype=cfg.dtype, param_dtype=cfg.dtype
+        )
+        q = dense(features=(cfg.n_heads, cfg.head_dim), name="wq")(x)
+        k = dense(features=(cfg.n_kv_heads, cfg.head_dim), name="wk")(x)
+        v = dense(features=(cfg.n_kv_heads, cfg.head_dim), name="wv")(x)
+        q = apply_rope(q, positions, cfg.rope_theta)
+        k = apply_rope(k, positions, cfg.rope_theta)
+
+        scale = cfg.head_dim**-0.5
+        use_ring = cfg.attention_impl == "ring" or (
+            cfg.attention_impl == "auto" and _sp_axis_in_mesh(cfg.sp_axis)
+        )
+        if use_ring:
+            from torchft_tpu.ops.ring_attention import ring_attention
+
+            out = ring_attention(q, k, v, axis_name=cfg.sp_axis, scale=scale)
+        else:
+            out = causal_attention(q, k, v, scale)
+        return dense(features=cfg.dim, axis=(-2, -1), name="wo")(out)
+
+
+class MLP(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.config
+        dense = partial(nn.Dense, use_bias=False, dtype=cfg.dtype, param_dtype=cfg.dtype)
+        gate = dense(cfg.ffn_hidden, name="w_gate")(x)
+        up = dense(cfg.ffn_hidden, name="w_up")(x)
+        return dense(cfg.dim, name="w_down")(nn.silu(gate) * up)
+
+
+class Block(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, positions: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.config
+        x = x + Attention(cfg, name="attn")(
+            RMSNorm(cfg.norm_eps, cfg.dtype, name="attn_norm")(x), positions
+        )
+        x = x + MLP(cfg, name="mlp")(RMSNorm(cfg.norm_eps, cfg.dtype, name="mlp_norm")(x))
+        return x
+
+
+class Llama(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(
+        self, tokens: jnp.ndarray, positions: Optional[jnp.ndarray] = None
+    ) -> jnp.ndarray:
+        cfg = self.config
+        if positions is None:
+            positions = jnp.broadcast_to(
+                jnp.arange(tokens.shape[1]), tokens.shape
+            )
+        embed = nn.Embed(
+            cfg.vocab_size, cfg.dim, dtype=cfg.dtype, param_dtype=cfg.dtype,
+            name="tok_embed",
+        )
+        x = embed(tokens)
+        for layer in range(cfg.n_layers):
+            x = Block(cfg, name=f"layer_{layer}")(x, positions)
+        x = RMSNorm(cfg.norm_eps, cfg.dtype, name="final_norm")(x)
+        if cfg.tie_embeddings:
+            logits = embed.attend(x)
+        else:
+            logits = nn.Dense(
+                cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+                param_dtype=cfg.dtype, name="lm_head",
+            )(x)
+        return logits.astype(jnp.float32)
+
+
+def cross_entropy_loss(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    token_logp = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(token_logp)
+
+
+def sharding_plan(
+    fsdp_axis: Optional[str] = "fsdp", tp_axis: Optional[str] = "tp"
+) -> Dict[str, Any]:
+    """Regex -> PartitionSpec map for Llama params (megatron layout:
+    column-parallel wq/wk/wv/w_gate/w_up, row-parallel wo/w_down; embeddings
+    vocab-sharded on tp; everything else fsdp-sharded on dim 0)."""
+    f, t = fsdp_axis, tp_axis
+    return {
+        r".*tok_embed/embedding": P(t, f),
+        r".*lm_head/kernel": P(f, t),
+        r".*(wq|wk|wv)/kernel": P(f, t, None),
+        r".*wo/kernel": P(t, None, f),
+        r".*(w_gate|w_up)/kernel": P(f, t),
+        r".*w_down/kernel": P(t, f),
+        r".*scale": P(),
+    }
+
+
+def apply_sharding_plan(params: Any, mesh: Any, plan: Dict[str, Any]) -> Any:
+    """Maps each param leaf (by its flattened path) to a NamedSharding from
+    the plan; unmatched leaves replicate."""
+    import re
+
+    from jax.sharding import NamedSharding
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+
+    def path_str(path: Tuple) -> str:
+        return "/".join(
+            str(getattr(k, "key", getattr(k, "idx", k))) for k in path
+        )
+
+    out = []
+    for path, leaf in flat:
+        name = path_str(path)
+        spec = P()
+        for pattern, candidate in plan.items():
+            if re.fullmatch(pattern, name):
+                spec = candidate
+                break
+        # Drop spec axes that don't divide the leaf's dims.
+        fixed = []
+        for dim, entry in enumerate(spec):
+            if entry is None:
+                fixed.append(None)
+                continue
+            axes = entry if isinstance(entry, tuple) else (entry,)
+            size = 1
+            for axis in axes:
+                size *= mesh.shape.get(axis, 1)
+            fixed.append(entry if leaf.shape[dim] % size == 0 else None)
+        out.append(jax.device_put(leaf, NamedSharding(mesh, P(*fixed))))
+    return jax.tree_util.tree_unflatten(treedef, out)

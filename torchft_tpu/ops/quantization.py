@@ -170,3 +170,35 @@ def dequantize_blocks_pallas(payload, scales, interpret: bool = False):
         out_shape=jax.ShapeDtypeStruct((n_blocks, block), jnp.float32),
         interpret=interpret,
     )(payload, scales.reshape(n_blocks, 1))
+
+
+def quantize_blocks_device(x, block: int = BLOCK):
+    """Device-side quantization of a flat array: pads to a block multiple,
+    returns (payload fp8 (n_blocks, block), scales f32 (n_blocks,)). Uses the
+    Pallas kernel on TPU, a jitted jnp path elsewhere."""
+    import jax
+    import jax.numpy as jnp
+
+    flat = x.reshape(-1)
+    pad = (-flat.size) % block
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros(pad, dtype=flat.dtype)])
+    blocks = flat.reshape(-1, block).astype(jnp.float32)
+    if jax.devices()[0].platform == "tpu":
+        return quantize_blocks_pallas(blocks, block)
+    maxabs = jnp.max(jnp.abs(blocks), axis=1)
+    scales = jnp.where(maxabs > 0, maxabs / FP8_MAX, 1.0).astype(jnp.float32)
+    payload = (blocks / scales[:, None]).astype(jnp.float8_e4m3fn)
+    return payload, scales
+
+
+def dequantize_blocks_device(payload, scales):
+    """Device-side dequantization to a flat f32 array (padding retained)."""
+    import jax
+    import jax.numpy as jnp
+
+    if jax.devices()[0].platform == "tpu":
+        out = dequantize_blocks_pallas(payload, scales)
+    else:
+        out = payload.astype(jnp.float32) * scales[:, None]
+    return out.reshape(-1)

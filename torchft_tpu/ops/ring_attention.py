@@ -1,0 +1,154 @@
+"""Ring attention: causal attention over a sequence-parallel mesh axis.
+
+Long-context is first-class in this framework even though the reference has
+no context-parallel code (SURVEY.md §2.7: absent; the FT replica axis stays
+orthogonal so a CP/ring axis fits inside the slice). Design follows the
+blockwise/ring attention literature (Liu et al., https://arxiv.org/abs/2310.01889):
+
+Each device in the ``sp`` axis holds one sequence shard of Q, K, V. K/V
+blocks rotate around the ring via ``jax.lax.ppermute`` while every device
+accumulates attention for its local Q block with an **online softmax**
+(running max + normalizer, flash-attention style), so the full sequence
+never materializes on one chip. Causality is enforced per ring step by
+comparing global position ids — a shard attends to a rotated KV block only
+where q_pos >= k_pos, which also makes the code correct for any sequence
+layout (contiguous shards being the standard one).
+
+Use inside shard_map/jit over a mesh with the ``sp`` axis, activations
+sharded (batch, seq/sp, heads, head_dim). Compute rides the MXU per block;
+ICI traffic is one KV block per step, overlapped by XLA with the block
+matmuls.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["ring_attention", "ring_attention_sharded"]
+
+_NEG_INF = -1e30
+
+
+def _block_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    q_pos: jnp.ndarray,
+    k_pos: jnp.ndarray,
+    scale: float,
+    acc: jnp.ndarray,
+    row_max: jnp.ndarray,
+    row_sum: jnp.ndarray,
+):
+    """One flash-style block update.
+
+    q: (b, sq, kv, g, d); k/v: (b, sk, kv, d); positions (b, sq)/(b, sk).
+    acc: (b, sq, kv, g, d) f32; row_max/row_sum: (b, sq, kv, g) f32.
+    """
+    scores = jnp.einsum("bskgd,btkd->bskgt", q, k).astype(jnp.float32) * scale
+    causal = q_pos[:, :, None, None, None] >= k_pos[:, None, None, None, :]
+    scores = jnp.where(causal, scores, _NEG_INF)
+
+    block_max = jnp.max(scores, axis=-1)
+    new_max = jnp.maximum(row_max, block_max)
+    # Rescale the old accumulator to the new max.
+    correction = jnp.exp(row_max - new_max)
+    probs = jnp.exp(scores - new_max[..., None])
+    new_sum = row_sum * correction + jnp.sum(probs, axis=-1)
+    block_out = jnp.einsum("bskgt,btkd->bskgd", probs.astype(v.dtype), v).astype(
+        jnp.float32
+    )
+    new_acc = acc * correction[..., None] + block_out
+    return new_acc, new_max, new_sum
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str,
+    scale: Optional[float] = None,
+    q_positions: Optional[jnp.ndarray] = None,
+    k_positions: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Causal GQA attention with K/V rotating over ``axis_name``.
+
+    Call from inside shard_map (or jit-with-sharding) where the seq dim of
+    q/k/v is the per-device shard. Shapes: q (b, s_local, h, d);
+    k/v (b, s_local, kv_heads, d). Positions default to contiguous shards
+    ordered by the device's axis index.
+    """
+    axis_size = jax.lax.psum(1, axis_name)
+    axis_index = jax.lax.axis_index(axis_name)
+    b, s_local, h, d = q.shape
+    kv_heads = k.shape[2]
+    group = h // kv_heads
+    if scale is None:
+        scale = d**-0.5
+
+    if q_positions is None:
+        base = axis_index * s_local
+        q_positions = jnp.broadcast_to(base + jnp.arange(s_local), (b, s_local))
+    if k_positions is None:
+        k_positions = q_positions
+
+    qg = q.reshape(b, s_local, kv_heads, group, d)
+    acc = jnp.zeros((b, s_local, kv_heads, group, d), dtype=jnp.float32)
+    row_max = jnp.full((b, s_local, kv_heads, group), _NEG_INF, dtype=jnp.float32)
+    row_sum = jnp.zeros((b, s_local, kv_heads, group), dtype=jnp.float32)
+    # The constant-initialized carries must be marked varying over the ring
+    # axis or the fori_loop carry types mismatch under shard_map's
+    # varying-manual-axes checking.
+    if hasattr(jax.lax, "pcast"):
+        acc, row_max, row_sum = (
+            jax.lax.pcast(x, (axis_name,), to="varying")
+            for x in (acc, row_max, row_sum)
+        )
+
+    def ring_step(step, carry):
+        acc, row_max, row_sum, k_blk, v_blk, k_pos = carry
+        acc, row_max, row_sum = _block_attention(
+            qg, k_blk, v_blk, q_positions, k_pos, scale, acc, row_max, row_sum
+        )
+        # Rotate KV to the next ring position (skip the final, unused hop is
+        # fine to keep: the loop is static and XLA overlaps it).
+        perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        k_pos = jax.lax.ppermute(k_pos, axis_name, perm)
+        return acc, row_max, row_sum, k_blk, v_blk, k_pos
+
+    carry = (acc, row_max, row_sum, k, v, k_positions)
+    carry = jax.lax.fori_loop(0, axis_size, ring_step, carry)
+    acc, row_max, row_sum = carry[:3]
+
+    # Fully-masked rows (can't occur with causal self-attention, but keep the
+    # math safe) divide by 1 instead of 0.
+    out = acc / jnp.maximum(row_sum[..., None], 1e-30)
+    return out.reshape(b, s_local, h, d).astype(q.dtype)
+
+
+def ring_attention_sharded(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: jax.sharding.Mesh,
+    axis_name: str = "sp",
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Convenience wrapper: shard_map ring_attention over ``mesh`` with the
+    sequence dim split on ``axis_name`` (other dims replicated)."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(None, axis_name, None, None)
+
+    def inner(q_, k_, v_):
+        return ring_attention(q_, k_, v_, axis_name=axis_name, scale=scale)
+
+    return shard_map(
+        inner, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )(q, k, v)

@@ -32,7 +32,21 @@ import jax
 
 from torchft_tpu.manager import Manager
 
-__all__ = ["Optimizer", "OptimizerWrapper"]
+__all__ = ["Optimizer", "OptimizerWrapper", "make_jit_update"]
+
+
+def make_jit_update(tx: Any):
+    """One fused-dispatch optax update: (grads, opt_state, params) ->
+    (new_params, new_opt_state). Shared by Optimizer/LocalSGD/DiLoCo —
+    unjitted optax updates issue hundreds of tiny device ops, which dominates
+    on high-latency device links."""
+    import optax
+
+    def _update(grads: Any, opt_state: Any, params: Any):
+        updates, new_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), new_state
+
+    return jax.jit(_update)
 
 
 def _as_device_tree(tree: Any) -> Any:
@@ -60,6 +74,8 @@ class Optimizer:
         manager.register_state_dict_fn(
             register_key, self._load_state_dict, self._state_dict
         )
+
+        self._jit_update = make_jit_update(tx)
 
     def _state_dict(self) -> Any:
         return {"params": self.params, "opt_state": self.opt_state}
@@ -95,8 +111,9 @@ class Optimizer:
         # staging on the quorum thread) never reads a torn params/opt pair.
         self.manager.disallow_state_dict_read()
         try:
-            updates, self.opt_state = self.tx.update(grads, self.opt_state, self.params)
-            self.params = optax.apply_updates(self.params, updates)
+            self.params, self.opt_state = self._jit_update(
+                grads, self.opt_state, self.params
+            )
         finally:
             self.manager.allow_state_dict_read()
         return True

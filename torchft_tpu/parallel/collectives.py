@@ -23,7 +23,11 @@ from torchft_tpu.ops import quantization as q
 from torchft_tpu.parallel.process_group import ProcessGroup, ReduceOp
 from torchft_tpu.work import Work
 
-__all__ = ["allreduce_quantized", "reduce_scatter_quantized"]
+__all__ = [
+    "allreduce_quantized",
+    "reduce_scatter_quantized",
+    "allreduce_quantized_wire",
+]
 
 # Multi-stage pipelines (alltoall -> reduce -> allgather) must not block the
 # PG's single op-worker thread waiting on ops they themselves enqueue, so
@@ -160,5 +164,72 @@ def reduce_scatter_quantized(
             chunk = out_payload.astype(np.float32) * out_scales[:, None]
             outputs.append(chunk.reshape(-1))
         return outputs
+
+    return Work(_PIPELINE_POOL.submit(pipeline))
+
+
+def allreduce_quantized_wire(
+    payload: np.ndarray,
+    scales: np.ndarray,
+    reduce_op: ReduceOp,
+    pg: ProcessGroup,
+) -> Work:
+    """Allreduce of ALREADY-quantized data, staying quantized end to end.
+
+    The caller quantized on device (Pallas) and ships only fp8 payload +
+    f32 block scales across the host boundary; this exchanges the chunks
+    (alltoall), does the fused dequant-reduce-requant per chunk, allgathers,
+    and resolves to the reduced (payload, scales) pair for device-side
+    dequantization. AVG folds into the scales (free).
+    """
+    if reduce_op not in (ReduceOp.SUM, ReduceOp.AVG):
+        raise ValueError(f"unsupported reduce op: {reduce_op}")
+    world_size = pg.size()
+
+    def pipeline():
+        # The device->host fetch happens HERE, on the pipeline thread, so a
+        # streaming caller (fragment_sync_delay > 0) overlaps the transfer
+        # with further inner steps.
+        payload_h = np.asarray(payload)
+        scales_h = np.asarray(scales, dtype=np.float32)
+        n_blocks = payload_h.shape[0]
+
+        if world_size == 1:
+            out_scales = scales_h / world_size if reduce_op == ReduceOp.AVG else scales_h
+            return payload_h.copy(), out_scales.astype(np.float32)
+
+        pad = (-n_blocks) % world_size
+        if pad:
+            payload_p = np.concatenate(
+                [payload_h, np.zeros((pad, payload_h.shape[1]), dtype=payload_h.dtype)]
+            )
+            scales_p = np.concatenate([scales_h, np.ones(pad, dtype=scales_h.dtype)])
+        else:
+            payload_p, scales_p = payload_h, scales_h
+        blocks_per_rank = payload_p.shape[0] // world_size
+        wire = [
+            q.pack_arrays(
+                payload_p[r * blocks_per_rank : (r + 1) * blocks_per_rank],
+                scales_p[r * blocks_per_rank : (r + 1) * blocks_per_rank],
+            )
+            for r in range(world_size)
+        ]
+        received = pg.alltoall(wire).wait()
+        payloads, chunk_scales = zip(
+            *(q.unpack_arrays(buf, blocks_per_rank) for buf in received)
+        )
+        out_payload, out_scales = q.reduce_quantized(list(payloads), list(chunk_scales))
+        if reduce_op == ReduceOp.AVG:
+            out_scales = (out_scales / world_size).astype(np.float32)
+        gathered = pg.allgather([q.pack_arrays(out_payload, out_scales)]).wait()
+        full_payloads = []
+        full_scales = []
+        for bufs in gathered:
+            p_chunk, s_chunk = q.unpack_arrays(bufs[0], blocks_per_rank)
+            full_payloads.append(p_chunk)
+            full_scales.append(s_chunk)
+        payload_out = np.concatenate(full_payloads)[:n_blocks]
+        scales_out = np.concatenate(full_scales)[:n_blocks]
+        return payload_out, scales_out
 
     return Work(_PIPELINE_POOL.submit(pipeline))
