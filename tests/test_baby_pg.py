@@ -1,0 +1,125 @@
+"""ProcessGroupBaby: subprocess isolation tests (parity: the baby_gloo rows
+of process_group_test.py + multiprocessing_test.py pipe timeouts)."""
+
+import multiprocessing as mp
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from torchft_tpu.parallel.baby import ProcessGroupBaby
+from torchft_tpu.parallel.multiprocessing import _MonitoredPipe
+from torchft_tpu.parallel.store import StoreServer
+
+
+@pytest.fixture(scope="module")
+def store_server():
+    server = StoreServer()
+    yield server
+    server.shutdown()
+
+
+def test_monitored_pipe_timeout_and_exception() -> None:
+    parent, child = mp.Pipe()
+    pipe = _MonitoredPipe(parent)
+    with pytest.raises(TimeoutError):
+        pipe.recv(timeout=0.1)
+    child.send(RuntimeError("from peer"))
+    with pytest.raises(RuntimeError, match="from peer"):
+        pipe.recv(timeout=1.0)
+    child.send({"ok": 1})
+    assert pipe.recv(timeout=1.0) == {"ok": 1}
+    pipe.close()
+    child.close()
+
+
+def _configure_pair(store_server, prefix: str, timeout: float = 20.0):
+    pgs = [ProcessGroupBaby(timeout=timeout) for _ in range(2)]
+    with ThreadPoolExecutor(max_workers=2) as pool:
+        list(
+            pool.map(
+                lambda i: pgs[i].configure(
+                    f"{store_server.address()}/{prefix}", f"baby_{i}", i, 2
+                ),
+                range(2),
+            )
+        )
+    return pgs
+
+
+def test_baby_allreduce_and_broadcast(store_server) -> None:
+    pgs = _configure_pair(store_server, "b1")
+    try:
+        with ThreadPoolExecutor(max_workers=2) as pool:
+            results = list(
+                pool.map(
+                    lambda i: pgs[i].allreduce([np.full(4, float(i + 1))]).wait(30),
+                    range(2),
+                )
+            )
+        for r in results:
+            np.testing.assert_array_equal(r[0], np.full(4, 3.0))
+        assert pgs[0].num_active_work() == 0
+
+        with ThreadPoolExecutor(max_workers=2) as pool:
+            results = list(
+                pool.map(
+                    lambda i: pgs[i].broadcast([np.array([i * 1.0])], 1).wait(30),
+                    range(2),
+                )
+            )
+        for r in results:
+            np.testing.assert_array_equal(r[0], np.array([1.0]))
+    finally:
+        for pg in pgs:
+            pg.shutdown()
+
+
+def test_baby_survives_child_kill(store_server) -> None:
+    """SIGKILLing the child (the hang cure) fails outstanding work but the
+    parent process lives and can reconfigure."""
+    pgs = _configure_pair(store_server, "b2", timeout=5.0)
+    try:
+        # Kill rank 1's child mid-setup of a collective.
+        assert pgs[1]._proc is not None
+        pgs[1]._proc.kill()
+        time.sleep(0.2)
+        with pytest.raises(RuntimeError, match="dead|error state|torn down"):
+            pgs[1].allreduce([np.ones(2)]).wait(10)
+
+        # Survivor's collective fails (peer gone) without hanging forever.
+        work = pgs[0].allreduce([np.ones(2)])
+        with pytest.raises(Exception):
+            work.wait(20)
+
+        # Both reconfigure under a fresh prefix and work again.
+        pgs2 = _configure_pair(store_server, "b3")
+        try:
+            with ThreadPoolExecutor(max_workers=2) as pool:
+                results = list(
+                    pool.map(
+                        lambda i: pgs2[i].allreduce([np.ones(2)]).wait(30), range(2)
+                    )
+                )
+            np.testing.assert_array_equal(results[0][0], np.full(2, 2.0))
+        finally:
+            for pg in pgs2:
+                pg.shutdown()
+    finally:
+        for pg in pgs:
+            pg.shutdown()
+
+
+def test_baby_abort_fails_pending(store_server) -> None:
+    pgs = _configure_pair(store_server, "b4", timeout=5.0)
+    try:
+        # One-sided collective never completes; abort must fail it promptly.
+        work = pgs[0].allreduce([np.ones(2)])
+        pgs[0].abort()
+        with pytest.raises(Exception):
+            work.wait(10)
+        assert pgs[0].errored() is not None
+    finally:
+        for pg in pgs:
+            pg.shutdown()

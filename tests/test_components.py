@@ -1,0 +1,219 @@
+"""Component parity tests: parameter server, data sampler, futures/watchdog,
+optimizer protocol call counts, launcher supervision, punisher.
+
+Parity targets: parameter_server_test.py, data_test.py, futures_test.py,
+optim_test.py, and the slurm runner/punisher behavior.
+"""
+
+import sys
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+from torchft_tpu import futures as ft_futures
+from torchft_tpu.data import DistributedSampler
+from torchft_tpu.parameter_server import ParameterServer
+
+
+# -- parameter server --------------------------------------------------------
+
+
+class _DoublingPS(ParameterServer):
+    def forward(self, session_id, pg) -> None:
+        (req,) = pg.recv([np.empty(4, dtype=np.float32)], src=1).wait(self.timeout)
+        pg.send([req * 2.0], dst=1).wait(self.timeout)
+
+
+def test_parameter_server_sessions() -> None:
+    server = _DoublingPS(timeout=10.0)
+    try:
+        # Two independent sessions, each with its own 2-rank PG.
+        for i in range(2):
+            pg = ParameterServer.connect(server.address(), timeout=10.0)
+            try:
+                pg.send([np.full(4, float(i + 1), dtype=np.float32)], dst=0).wait(10)
+                (result,) = pg.recv([np.empty(4, dtype=np.float32)], src=0).wait(10)
+                np.testing.assert_array_equal(result, np.full(4, (i + 1) * 2.0))
+            finally:
+                pg.shutdown()
+    finally:
+        server.shutdown()
+
+
+# -- data sampler ------------------------------------------------------------
+
+
+def test_sampler_shards_partition_dataset() -> None:
+    """All (replica, rank) shards are disjoint and cover ~the dataset."""
+    seen = []
+    for replica in range(2):
+        for rank in range(2):
+            sampler = DistributedSampler(
+                dataset_size=100,
+                replica_rank=replica,
+                num_replica_groups=2,
+                group_rank=rank,
+                num_replicas=2,
+                shuffle=True,
+                seed=7,
+            )
+            assert len(sampler) == 25
+            seen.append(list(sampler))
+    flat = [i for shard in seen for i in shard]
+    assert len(flat) == len(set(flat)) == 100
+
+
+def test_sampler_epoch_changes_order_deterministically() -> None:
+    sampler = DistributedSampler(50, 0, 1, shuffle=True, seed=3)
+    first = list(sampler)
+    sampler.set_epoch(1)
+    second = list(sampler)
+    assert first != second
+    sampler.set_epoch(0)
+    assert list(sampler) == first
+
+
+def test_sampler_batches() -> None:
+    sampler = DistributedSampler(64, 0, 2, batch_size=4, shuffle=False)
+    batches = list(sampler.batches())
+    assert all(len(b) == 4 for b in batches)
+    assert len(batches) == 8  # 32 samples / 4
+
+
+# -- futures / watchdog ------------------------------------------------------
+
+
+def test_future_timeout_fires() -> None:
+    fut: Future = Future()
+    timed = ft_futures.future_timeout(fut, 0.1)
+    with pytest.raises(TimeoutError):
+        timed.result(timeout=5)
+
+
+def test_future_timeout_passthrough() -> None:
+    fut: Future = Future()
+    timed = ft_futures.future_timeout(fut, 5.0)
+    fut.set_result(42)
+    assert timed.result(timeout=1) == 42
+
+    fut2: Future = Future()
+    timed2 = ft_futures.future_timeout(fut2, 5.0)
+    fut2.set_exception(ValueError("inner"))
+    with pytest.raises(ValueError, match="inner"):
+        timed2.result(timeout=1)
+
+
+def test_context_timeout_triggers_callback() -> None:
+    fired = threading.Event()
+    with ft_futures.context_timeout(fired.set, 0.1):
+        time.sleep(0.3)
+    assert fired.is_set()
+
+    fired2 = threading.Event()
+    with ft_futures.context_timeout(fired2.set, 5.0):
+        pass
+    time.sleep(0.05)
+    assert not fired2.is_set()
+
+
+def test_watchdog_exits_on_stalled_scheduler(monkeypatch) -> None:
+    """Parity with the reference's watchdog sys.exit test (futures_test.py:97):
+    a stalled scheduler loop must trigger the exit hook."""
+    manager = ft_futures._TimeoutManager()
+    exited = threading.Event()
+    monkeypatch.setattr(manager, "_exit", lambda code: exited.set())
+    monkeypatch.setattr(ft_futures, "WATCHDOG_TIMEOUT_SEC", 0.2)
+    manager._ensure_started()
+    # Simulate a wedged scheduler: freeze its last-tick far in the past.
+    manager._last_tick = time.monotonic() - 100
+    manager._watchdog_enabled = True
+
+    # Watchdog polls at WATCHDOG/4... but it captured module constant at
+    # thread start; instead call the check logic via a short wait.
+    deadline = time.monotonic() + 10
+    while not exited.is_set() and time.monotonic() < deadline:
+        manager._last_tick = time.monotonic() - 100
+        time.sleep(0.1)
+    assert exited.is_set()
+
+
+# -- optimizer protocol ------------------------------------------------------
+
+
+def test_optimizer_calls_quorum_and_commit() -> None:
+    """optim_test.py parity: begin_step -> start_quorum; step -> should_commit
+    exactly once, update applied only on commit."""
+    import jax.numpy as jnp
+    import optax
+
+    from test_manager import make_manager, make_quorum
+    from torchft_tpu.optim import Optimizer
+    from torchft_tpu.parallel.process_group import ProcessGroupDummy
+
+    manager, client, _, _ = make_manager(pg=ProcessGroupDummy(), min_replica_size=1)
+    client._quorum.return_value = make_quorum(replica_world_size=1, max_world_size=1)
+    client.should_commit.side_effect = lambda rank, step, vote, timeout: vote
+
+    params = {"w": jnp.ones(3)}
+    opt = Optimizer(manager, optax.sgd(0.5), params)
+    opt.begin_step()
+    assert client._quorum.call_count == 1
+    grads = {"w": jnp.full(3, 2.0)}
+    assert opt.step(grads)
+    assert client.should_commit.call_count == 1
+    np.testing.assert_allclose(np.asarray(opt.params["w"]), np.zeros(3))
+
+    # Failed commit: no update.
+    client.should_commit.side_effect = None
+    client.should_commit.return_value = False
+    opt.begin_step()
+    before = np.asarray(opt.params["w"]).copy()
+    assert not opt.step(grads)
+    np.testing.assert_array_equal(np.asarray(opt.params["w"]), before)
+
+
+# -- launcher ----------------------------------------------------------------
+
+
+def test_launch_supervises_and_restarts(tmp_path) -> None:
+    """A group that dies once is relaunched; all groups finish -> exit 0."""
+    from torchft_tpu.launch import supervise
+
+    marker = tmp_path / "died_once"
+    script = tmp_path / "job.py"
+    script.write_text(
+        "import os, sys, pathlib\n"
+        f"marker = pathlib.Path({str(marker)!r})\n"
+        "group = os.environ['REPLICA_GROUP_ID']\n"
+        "assert 'TPUFT_LIGHTHOUSE' in os.environ\n"
+        "assert os.environ['NUM_REPLICA_GROUPS'] == '2'\n"
+        "if group == '1' and not marker.exists():\n"
+        "    marker.write_text('x')\n"
+        "    sys.exit(3)\n"
+        "print('group', group, 'ok')\n"
+    )
+    code = supervise(
+        [sys.executable, str(script)],
+        num_replica_groups=2,
+        relaunch_interval=0.2,
+        max_restarts=2,
+    )
+    assert code == 0
+    assert marker.exists()
+
+
+def test_launch_gives_up_after_max_restarts(tmp_path) -> None:
+    from torchft_tpu.launch import supervise
+
+    script = tmp_path / "always_dies.py"
+    script.write_text("import sys; sys.exit(7)\n")
+    code = supervise(
+        [sys.executable, str(script)],
+        num_replica_groups=1,
+        relaunch_interval=0.1,
+        max_restarts=1,
+    )
+    assert code == 1

@@ -6,6 +6,44 @@ heartbeats; a native per-replica-group ManagerServer arbitrates quorum,
 recovery assignments, and commit votes; the Python :class:`Manager` embeds in
 the train loop, resizes the replica axis on membership changes, and live-heals
 joining replicas by streaming parameter pytrees from a healthy peer.
+
+Public surface (parity with the reference's ``torchft/__init__.py``)::
+
+    from torchft_tpu import (
+        Manager, Optimizer, DistributedSampler,
+        ProcessGroupTCP, ProcessGroupBaby, ProcessGroupDummy,
+    )
+
+Heavier pieces import from their modules: ``torchft_tpu.local_sgd`` (LocalSGD,
+DiLoCo), ``torchft_tpu.parallel.mesh`` (FTMesh/HSDP), ``torchft_tpu.models``,
+``torchft_tpu.checkpointing``, ``torchft_tpu.ops``.
 """
 
+from torchft_tpu.data import DistributedSampler
+from torchft_tpu.ddp import DistributedDataParallel, ft_allreduce_gradients
+from torchft_tpu.manager import Manager, WorldSizeMode
+from torchft_tpu.optim import Optimizer, OptimizerWrapper
+from torchft_tpu.parallel.baby import ProcessGroupBaby
+from torchft_tpu.parallel.process_group import (
+    ProcessGroup,
+    ProcessGroupDummy,
+    ProcessGroupTCP,
+    ReduceOp,
+)
+
 __version__ = "0.1.0"
+
+__all__ = [
+    "Manager",
+    "WorldSizeMode",
+    "Optimizer",
+    "OptimizerWrapper",
+    "DistributedDataParallel",
+    "ft_allreduce_gradients",
+    "DistributedSampler",
+    "ProcessGroup",
+    "ProcessGroupTCP",
+    "ProcessGroupBaby",
+    "ProcessGroupDummy",
+    "ReduceOp",
+]

@@ -1,0 +1,133 @@
+"""Job launcher: replica-group supervision for one or many hosts.
+
+Role-equivalent of the reference's launch tooling — ``torchft/torchx.py``
+(per-replica-group roles with REPLICA_GROUP_ID / NUM_REPLICA_GROUPS /
+lighthouse env wiring) and ``examples/slurm/runner.py`` (a supervision loop
+that relaunches dead replica groups).
+
+    python -m torchft_tpu.launch --num-replica-groups 4 -- \
+        python examples/train_ddp.py --steps 100
+
+Each replica group becomes a supervised subprocess with:
+  REPLICA_GROUP_ID, NUM_REPLICA_GROUPS, TPUFT_LIGHTHOUSE
+plus any TPUFT_* timeouts passed through. Dead groups are relaunched every
+``--relaunch-interval`` seconds up to ``--max-restarts``, mirroring the
+torchelastic max_restarts contract the reference delegates to.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+from torchft_tpu.coordination import LighthouseServer
+
+__all__ = ["supervise", "main"]
+
+
+def supervise(
+    command: List[str],
+    num_replica_groups: int,
+    lighthouse_addr: Optional[str] = None,
+    relaunch_interval: float = 10.0,
+    max_restarts: int = 100,
+    extra_env: Optional[Dict[str, str]] = None,
+) -> int:
+    """Runs ``command`` once per replica group, relaunching dead groups.
+    Returns 0 when every group has exited cleanly."""
+    own_lighthouse: Optional[LighthouseServer] = None
+    if lighthouse_addr is None:
+        own_lighthouse = LighthouseServer(
+            min_replicas=1, join_timeout_ms=10000, heartbeat_timeout_ms=5000
+        )
+        lighthouse_addr = own_lighthouse.address()
+        print(f"[launch] embedded lighthouse at {lighthouse_addr}", flush=True)
+
+    def spawn(group: int) -> subprocess.Popen:
+        env = {
+            **os.environ,
+            **(extra_env or {}),
+            "REPLICA_GROUP_ID": str(group),
+            "NUM_REPLICA_GROUPS": str(num_replica_groups),
+            "TPUFT_LIGHTHOUSE": lighthouse_addr,
+        }
+        print(f"[launch] starting replica group {group}: {' '.join(command)}", flush=True)
+        return subprocess.Popen(command, env=env)
+
+    procs = {g: spawn(g) for g in range(num_replica_groups)}
+    restarts = {g: 0 for g in range(num_replica_groups)}
+    done: Dict[int, int] = {}
+    try:
+        while len(done) < num_replica_groups:
+            time.sleep(min(relaunch_interval, 1.0))
+            for group, proc in list(procs.items()):
+                if group in done:
+                    continue
+                code = proc.poll()
+                if code is None:
+                    continue
+                if code == 0:
+                    print(f"[launch] group {group} finished", flush=True)
+                    done[group] = 0
+                elif restarts[group] < max_restarts:
+                    restarts[group] += 1
+                    print(
+                        f"[launch] group {group} died (exit {code}); "
+                        f"relaunch {restarts[group]}/{max_restarts} "
+                        f"in {relaunch_interval}s",
+                        flush=True,
+                    )
+                    time.sleep(relaunch_interval)
+                    procs[group] = spawn(group)
+                else:
+                    print(
+                        f"[launch] group {group} exhausted restarts (exit {code})",
+                        flush=True,
+                    )
+                    done[group] = code
+        return 0 if all(code == 0 for code in done.values()) else 1
+    finally:
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+        deadline = time.monotonic() + 5
+        for proc in procs.values():
+            try:
+                proc.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        if own_lighthouse is not None:
+            own_lighthouse.shutdown()
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--num-replica-groups", type=int, required=True)
+    parser.add_argument("--lighthouse", default=os.environ.get("TPUFT_LIGHTHOUSE"))
+    parser.add_argument("--relaunch-interval", type=float, default=10.0)
+    parser.add_argument("--max-restarts", type=int, default=100)
+    parser.add_argument("command", nargs=argparse.REMAINDER, help="-- cmd args...")
+    args = parser.parse_args()
+    command = args.command
+    if command and command[0] == "--":
+        command = command[1:]
+    if not command:
+        parser.error("missing command (after --)")
+    sys.exit(
+        supervise(
+            command,
+            num_replica_groups=args.num_replica_groups,
+            lighthouse_addr=args.lighthouse,
+            relaunch_interval=args.relaunch_interval,
+            max_restarts=args.max_restarts,
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

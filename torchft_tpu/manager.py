@@ -51,6 +51,7 @@ from torchft_tpu.coordination import ManagerClient, ManagerServer
 from torchft_tpu.parallel.process_group import ProcessGroup, ReduceOp
 from torchft_tpu.parallel.store import StoreClient
 from torchft_tpu.telemetry import commits_logger, errors_logger, quorums_logger
+from torchft_tpu.utils.profiling import trace_span
 from torchft_tpu.work import Work, _DummyWork
 
 T = TypeVar("T")
@@ -288,6 +289,12 @@ class Manager:
         if self.errored():
             return _DummyWork(tensor)
 
+        with trace_span("tpuft::manager::allreduce"):
+            return self._allreduce_impl(tensor, should_quantize, reduce_op)
+
+    def _allreduce_impl(
+        self, tensor: Any, should_quantize: bool, reduce_op: ReduceOp
+    ) -> Work:
         self.wait_quorum()
         num_participants = self.num_participants()
 
@@ -330,9 +337,10 @@ class Manager:
         leaves, treedef = jax.tree_util.tree_flatten(pytree)
         if self.errored():
             return _DummyWork(pytree)
-        self.wait_quorum()
-        num_participants = self.num_participants()
-        arrays = [np.asarray(leaf) for leaf in leaves]
+        with trace_span("tpuft::manager::allreduce_pytree"):
+            self.wait_quorum()
+            num_participants = self.num_participants()
+            arrays = [np.asarray(leaf) for leaf in leaves]
         if not self.is_participating():
             arrays = [np.zeros_like(a) for a in arrays]
         try:
@@ -367,8 +375,9 @@ class Manager:
 
         if self.errored():
             return _DummyWork(None)
-        self.wait_quorum()
-        num_participants = self.num_participants()
+        with trace_span("tpuft::manager::allreduce_prequantized"):
+            self.wait_quorum()
+            num_participants = self.num_participants()
         if not self.is_participating():
             scales = scales * 0
         try:
@@ -457,20 +466,22 @@ class Manager:
     def wait_quorum(self) -> None:
         """Blocks until the quorum completes; the PG is healthy after."""
         assert self._quorum_future is not None, "must call start_quorum before wait_quorum"
-        self._quorum_future.result()
+        with trace_span("tpuft::manager::wait_quorum"):
+            self._quorum_future.result()
 
     def _async_quorum(
         self, allow_heal: bool, shrink_only: bool, quorum_timeout: float
     ) -> None:
-        quorum = self._client._quorum(
-            group_rank=self._group_rank,
-            step=self._step,
-            checkpoint_metadata=self._checkpoint_transport.metadata(),
-            shrink_only=shrink_only,
-            init_sync=self._init_sync,
-            commit_failures=self._commit_failures,
-            timeout=quorum_timeout,
-        )
+        with trace_span("tpuft::manager::_client::_quorum"):
+            quorum = self._client._quorum(
+                group_rank=self._group_rank,
+                step=self._step,
+                checkpoint_metadata=self._checkpoint_transport.metadata(),
+                shrink_only=shrink_only,
+                init_sync=self._init_sync,
+                commit_failures=self._commit_failures,
+                timeout=quorum_timeout,
+            )
 
         # Participation bookkeeping: async quorum means a healing replica
         # sits out this step (max-step cohort participates); sync quorum
@@ -511,12 +522,13 @@ class Manager:
                 f"reconfiguring for quorum_id={quorum.quorum_id} {store_prefixed_addr=}"
             )
             try:
-                self._pg.configure(
-                    store_prefixed_addr,
-                    self._replica_id,
-                    quorum.replica_rank,
-                    quorum.replica_world_size,
-                )
+                with trace_span("tpuft::manager::_pg::configure"):
+                    self._pg.configure(
+                        store_prefixed_addr,
+                        self._replica_id,
+                        quorum.replica_rank,
+                        quorum.replica_world_size,
+                    )
                 self._quorum_id = quorum.quorum_id
             except Exception as e:  # noqa: BLE001
                 self._logger.exception(f"got exception in pg configure: {e}")
@@ -529,12 +541,15 @@ class Manager:
                     self._logger.info(
                         f"peers need recovery from us {quorum.recover_dst_replica_ranks}"
                     )
-                    self._checkpoint_transport.send_checkpoint(
-                        dst_ranks=quorum.recover_dst_replica_ranks,
-                        step=quorum.max_step,
-                        state_dict=self._manager_state_dict(),
-                        timeout=self._timeout,
-                    )
+                    with trace_span(
+                        "tpuft::manager::_checkpoint_transport::send_checkpoint"
+                    ):
+                        self._checkpoint_transport.send_checkpoint(
+                            dst_ranks=quorum.recover_dst_replica_ranks,
+                            step=quorum.max_step,
+                            state_dict=self._manager_state_dict(),
+                            timeout=self._timeout,
+                        )
 
                 if quorum.heal:
                     self._healing = True
@@ -553,12 +568,15 @@ class Manager:
                     assert (
                         quorum.recover_src_replica_rank is not None
                     ), "must have a recover rank when healing"
-                    self._pending_state_dict = self._checkpoint_transport.recv_checkpoint(
-                        src_rank=quorum.recover_src_replica_rank,
-                        metadata=checkpoint_metadata,
-                        step=quorum.max_step,
-                        timeout=self._timeout,
-                    )
+                    with trace_span(
+                        "tpuft::manager::_checkpoint_transport::recv_checkpoint"
+                    ):
+                        self._pending_state_dict = self._checkpoint_transport.recv_checkpoint(
+                            src_rank=quorum.recover_src_replica_rank,
+                            metadata=checkpoint_metadata,
+                            step=quorum.max_step,
+                            timeout=self._timeout,
+                        )
                     # Restore manager accounting immediately; user state is
                     # applied from the main thread when safe.
                     self.load_state_dict(self._pending_state_dict["tpuft"])
@@ -601,12 +619,13 @@ class Manager:
 
         enough_replicas = self.num_participants() >= self._min_replica_size
         local_should_commit = enough_replicas and self._errored is None
-        should_commit = self._client.should_commit(
-            self._group_rank,
-            self._step,
-            local_should_commit,
-            timeout=timeout or self._timeout,
-        )
+        with trace_span("tpuft::manager::should_commit"):
+            should_commit = self._client.should_commit(
+                self._group_rank,
+                self._step,
+                local_should_commit,
+                timeout=timeout or self._timeout,
+            )
         self._logger.info(
             f"should_commit={should_commit} enough_replicas={enough_replicas}, "
             f"errored={self._errored}"

@@ -1,0 +1,245 @@
+"""Subprocess-isolated process group ("Baby" PG).
+
+Role-equivalent of the reference's ``ProcessGroupBaby*``
+(/root/reference/torchft/process_group.py:1358-1983): the real comm backend
+runs in a **spawned child process**, so a wedged transfer — the failure NCCL
+abort exists for on GPU, and a stuck DCN socket here — can be killed with
+SIGKILL without taking down the trainer or the accelerator runtime. The
+parent proxies collectives over a request pipe; the child executes them on
+an inner :class:`ProcessGroupTCP` and streams results (or exceptions) back
+over a response pipe drained by a parent-side future-handler thread.
+
+The reference needs shared-memory tensors + CUDA event gymnastics for this;
+here host arrays pickle through the pipe — correctness first, zero-copy via
+shared memory is a later optimization. The child deliberately imports only
+numpy-level deps (no jax), keeping spawn latency low.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import threading
+from concurrent.futures import Future
+from typing import Any, Dict, Optional, Sequence
+
+import numpy as np
+
+from torchft_tpu.parallel.multiprocessing import _MonitoredPipe
+from torchft_tpu.parallel.process_group import ProcessGroup, ReduceOp
+from torchft_tpu.work import Work, _DummyWork
+
+__all__ = ["ProcessGroupBaby"]
+
+
+def _baby_main(req_conn, resp_conn, store_addr, replica_id, rank, world_size, timeout):
+    """Child entry: owns a real ProcessGroupTCP and replays parent ops."""
+    from torchft_tpu.parallel.process_group import ProcessGroupTCP
+
+    req = _MonitoredPipe(req_conn)
+    resp = _MonitoredPipe(resp_conn)
+    pg = ProcessGroupTCP(timeout=timeout)
+    try:
+        pg.configure(store_addr, replica_id, rank, world_size)
+        resp.send(("ready", None))
+    except Exception as e:  # noqa: BLE001
+        resp.send(("ready", RuntimeError(f"baby configure failed: {e}")))
+        return
+    try:
+        while True:
+            try:
+                cmd = req.recv(timeout=3600.0)
+            except (EOFError, OSError):
+                return
+            if cmd[0] == "shutdown":
+                return
+            assert cmd[0] == "func"
+            _, op_id, name, args, kwargs = cmd
+            try:
+                work = getattr(pg, name)(*args, **kwargs)
+
+                def on_done(fut, op_id=op_id) -> None:
+                    err = fut.exception()
+                    try:
+                        if err is None:
+                            resp.send(("result", op_id, fut.result()))
+                        else:
+                            resp.send(("error", op_id, RuntimeError(str(err))))
+                    except (OSError, BrokenPipeError):
+                        pass
+
+                work.add_done_callback(on_done)
+            except Exception as e:  # noqa: BLE001
+                resp.send(("error", op_id, RuntimeError(str(e))))
+    finally:
+        pg.shutdown()
+
+
+class ProcessGroupBaby(ProcessGroup):
+    """Runs the real PG in a spawned subprocess; a hang is cured by SIGKILL
+    on the child rather than process death for the trainer."""
+
+    def __init__(self, timeout: float = 60.0) -> None:
+        super().__init__()
+        self._timeout = timeout
+        self._rank = 0
+        self._world_size = 1
+        self._proc: Optional[mp.process.BaseProcess] = None
+        self._req: Optional[_MonitoredPipe] = None
+        self._resp: Optional[_MonitoredPipe] = None
+        self._errored: Optional[Exception] = None
+        self._pending: Dict[int, Future] = {}
+        self._pending_lock = threading.Lock()
+        self._next_op_id = 0
+        self._handler: Optional[threading.Thread] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def configure(
+        self, store_addr: str, replica_id: str, rank: int, world_size: int
+    ) -> None:
+        self._teardown_child(graceful=False)
+        self._errored = None
+        self._rank = rank
+        self._world_size = world_size
+
+        ctx = mp.get_context("spawn")
+        req_parent, req_child = ctx.Pipe()
+        resp_parent, resp_child = ctx.Pipe()
+        proc = ctx.Process(
+            target=_baby_main,
+            args=(
+                req_child,
+                resp_child,
+                store_addr,
+                replica_id,
+                rank,
+                world_size,
+                self._timeout,
+            ),
+            daemon=True,
+            name=f"tpuft-baby-{replica_id}-{rank}",
+        )
+        proc.start()
+        req_child.close()
+        resp_child.close()
+        self._proc = proc
+        self._req = _MonitoredPipe(req_parent)
+        self._resp = _MonitoredPipe(resp_parent)
+        kind, err = self._resp.recv(timeout=self._timeout + 30)
+        assert kind == "ready"
+        if err is not None:
+            self._errored = err
+            raise err
+        self._handler = threading.Thread(
+            target=self._future_handler, daemon=True, name="tpuft-baby-futures"
+        )
+        self._handler.start()
+
+    def _future_handler(self) -> None:
+        resp = self._resp
+        assert resp is not None
+        while True:
+            try:
+                msg = resp.recv(timeout=3600.0)
+            except (EOFError, OSError, TimeoutError):
+                return
+            kind, op_id, payload = msg
+            with self._pending_lock:
+                fut = self._pending.pop(op_id, None)
+            if fut is None:
+                continue
+            if kind == "result":
+                fut.set_result(payload)
+            else:
+                if self._errored is None:
+                    self._errored = payload
+                fut.set_exception(payload)
+
+    def _teardown_child(self, graceful: bool) -> None:
+        proc, req = self._proc, self._req
+        self._proc = None
+        if req is not None:
+            if graceful:
+                try:
+                    req.send(("shutdown",))
+                except (OSError, BrokenPipeError):
+                    pass
+            req.close()
+        if self._resp is not None:
+            self._resp.close()
+        if proc is not None:
+            proc.join(timeout=1.0 if graceful else 0.0)
+            if proc.is_alive():
+                proc.kill()  # SIGKILL: the whole point of the subprocess
+                proc.join(timeout=5.0)
+        # Fail any outstanding work.
+        with self._pending_lock:
+            pending, self._pending = self._pending, {}
+        for fut in pending.values():
+            if not fut.done():
+                fut.set_exception(RuntimeError("baby process group torn down"))
+
+    def abort(self) -> None:
+        self._errored = self._errored or RuntimeError("process group aborted")
+        self._teardown_child(graceful=False)
+
+    def shutdown(self) -> None:
+        self._teardown_child(graceful=True)
+
+    def errored(self) -> Optional[Exception]:
+        return self._errored
+
+    def size(self) -> int:
+        return self._world_size
+
+    def rank(self) -> int:
+        return self._rank
+
+    def num_active_work(self) -> int:
+        with self._pending_lock:
+            return len(self._pending)
+
+    # -- op proxying -------------------------------------------------------
+
+    def _run_func(self, name: str, *args: Any, **kwargs: Any) -> Work:
+        if self._errored is not None:
+            raise RuntimeError(f"process group in error state: {self._errored}")
+        if self._req is None or self._proc is None or not self._proc.is_alive():
+            raise RuntimeError("baby process group not configured / child dead")
+        fut: Future = Future()
+        with self._pending_lock:
+            op_id = self._next_op_id
+            self._next_op_id += 1
+            self._pending[op_id] = fut
+        try:
+            self._req.send(("func", op_id, name, args, kwargs))
+        except (OSError, BrokenPipeError) as e:
+            with self._pending_lock:
+                self._pending.pop(op_id, None)
+            self._errored = RuntimeError(f"baby pipe broken: {e}")
+            raise self._errored from e
+        return Work(fut)
+
+    def allreduce(self, arrays: Sequence[np.ndarray], op: ReduceOp = ReduceOp.SUM) -> Work:
+        return self._run_func("allreduce", [np.asarray(a) for a in arrays], op)
+
+    def allgather(self, arrays: Sequence[np.ndarray]) -> Work:
+        return self._run_func("allgather", [np.asarray(a) for a in arrays])
+
+    def broadcast(self, arrays: Sequence[np.ndarray], root: int = 0) -> Work:
+        return self._run_func("broadcast", [np.asarray(a) for a in arrays], root)
+
+    def reduce_scatter(self, arrays: Sequence[np.ndarray], op: ReduceOp = ReduceOp.SUM) -> Work:
+        return self._run_func("reduce_scatter", [np.asarray(a) for a in arrays], op)
+
+    def alltoall(self, arrays: Sequence[np.ndarray]) -> Work:
+        return self._run_func("alltoall", [np.asarray(a) for a in arrays])
+
+    def send(self, arrays: Sequence[np.ndarray], dst: int, tag: int = 0) -> Work:
+        return self._run_func("send", [np.asarray(a) for a in arrays], dst, tag)
+
+    def recv(self, shapes_like: Sequence[np.ndarray], src: int, tag: int = 0) -> Work:
+        return self._run_func("recv", [np.asarray(a) for a in shapes_like], src, tag)
+
+    def barrier(self) -> Work:
+        return self._run_func("barrier")

@@ -1,0 +1,41 @@
+"""Monitored pipe for subprocess process groups.
+
+Role-equivalent of the reference's ``torchft/multiprocessing.py``: a
+Connection wrapper whose ``recv`` enforces a timeout and re-raises
+exceptions received from the peer, so a wedged child can never silently
+hang the parent.
+"""
+
+from __future__ import annotations
+
+from multiprocessing.connection import Connection
+from typing import Any
+
+__all__ = ["_MonitoredPipe"]
+
+
+class _MonitoredPipe:
+    def __init__(self, pipe: "Connection") -> None:
+        self._pipe = pipe
+
+    def send(self, obj: Any) -> None:
+        self._pipe.send(obj)
+
+    def recv(self, timeout: float) -> Any:
+        """Receives one message; raises TimeoutError on silence past
+        ``timeout`` and re-raises Exception payloads from the peer."""
+        if not self._pipe.poll(timeout):
+            raise TimeoutError(f"pipe recv timed out after {timeout}s")
+        item = self._pipe.recv()
+        if isinstance(item, Exception):
+            raise item
+        return item
+
+    def close(self) -> None:
+        try:
+            self._pipe.close()
+        except OSError:
+            pass
+
+    def closed(self) -> bool:
+        return self._pipe.closed

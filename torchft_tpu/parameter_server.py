@@ -1,0 +1,125 @@
+"""Fault-tolerant parameter server prototype.
+
+Role-equivalent of the reference's ``torchft/parameter_server.py:31-195``:
+a lighthouse-free pattern built directly on reconfigurable process groups.
+The server exposes an HTTP ``/new_session`` endpoint that hands out a fresh
+store prefix + session id; each session gets its own 2-rank process group
+(server rank 0, client rank 1) serviced by a handler thread running the
+user's :meth:`forward`. Because every session has an isolated PG, a dead or
+wedged client only costs its own session.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import urllib.request
+import uuid
+from abc import ABC, abstractmethod
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional
+
+from torchft_tpu.parallel.process_group import ProcessGroup, ProcessGroupTCP
+from torchft_tpu.parallel.store import StoreServer
+
+__all__ = ["ParameterServer"]
+
+
+class ParameterServer(ABC):
+    """Subclass and implement :meth:`forward`; run one per serving host.
+
+    Example::
+
+        class EchoPS(ParameterServer):
+            def forward(self, session_id, pg):
+                (req,) = pg.recv([np.empty(4)], src=1).wait(self.timeout)
+                pg.send([req * 2], dst=1).wait(self.timeout)
+    """
+
+    def __init__(self, bind_port: int = 0, timeout: float = 60.0) -> None:
+        self.timeout = timeout
+        self._store = StoreServer()
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt: str, *args: Any) -> None:
+                pass
+
+            def do_POST(self) -> None:
+                if self.path != "/new_session":
+                    self.send_error(404)
+                    return
+                session_id = str(uuid.uuid4())
+                body = json.dumps(
+                    {
+                        "session_id": session_id,
+                        "store_addr": f"{server._store.address()}/session/{session_id}",
+                    }
+                ).encode()
+                # Service thread joins the session PG as rank 0.
+                threading.Thread(
+                    target=server._serve_session,
+                    args=(session_id,),
+                    daemon=True,
+                    name=f"ps-session-{session_id[:8]}",
+                ).start()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        class DualStack(ThreadingHTTPServer):
+            address_family = socket.AF_INET6
+            daemon_threads = True
+
+        self._http = DualStack(("::", bind_port), Handler)
+        self._http_thread = threading.Thread(
+            target=self._http.serve_forever, daemon=True, name="tpuft-ps-http"
+        )
+        self._http_thread.start()
+
+    def address(self) -> str:
+        return f"http://{socket.gethostname()}:{self._http.server_address[1]}"
+
+    def _serve_session(self, session_id: str) -> None:
+        pg = ProcessGroupTCP(timeout=self.timeout)
+        try:
+            pg.configure(
+                f"{self._store.address()}/session/{session_id}",
+                f"ps-server-{session_id}",
+                rank=0,
+                world_size=2,
+            )
+            self.forward(session_id, pg)
+        except Exception:  # noqa: BLE001  — a broken session only kills itself
+            pass
+        finally:
+            pg.shutdown()
+
+    @abstractmethod
+    def forward(self, session_id: str, pg: ProcessGroup) -> None:
+        """Services one client session over its dedicated 2-rank group."""
+
+    @classmethod
+    def connect(cls, address: str, timeout: float = 60.0) -> ProcessGroup:
+        """Client side: requests a session and joins its PG as rank 1."""
+        req = urllib.request.Request(f"{address}/new_session", method="POST")
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            session = json.loads(resp.read())
+        pg = ProcessGroupTCP(timeout=timeout)
+        pg.configure(
+            session["store_addr"],
+            f"ps-client-{session['session_id']}",
+            rank=1,
+            world_size=2,
+        )
+        return pg
+
+    def shutdown(self) -> None:
+        self._http.shutdown()
+        self._http.server_close()
+        self._store.shutdown()

@@ -1,0 +1,86 @@
+"""Chaos tool: kill replica groups of a live job.
+
+Role-equivalent of the reference's ``examples/slurm/punisher.py`` kill_one/
+kill_all/kill_loop CLI: resolves the current quorum from the lighthouse and
+fires Kill RPCs at member managers (which ``exit(1)``, exactly as the
+dashboard's kill button does).
+
+    python -m torchft_tpu.punisher --lighthouse host:29510 kill_one
+    python -m torchft_tpu.punisher --lighthouse host:29510 kill_loop --mtbf 60
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import time
+
+from torchft_tpu.coordination import LighthouseClient
+
+__all__ = ["kill_one", "kill_all", "kill_loop", "main"]
+
+
+def _members(client: LighthouseClient):
+    status = client.status()
+    return [m.member.replica_id for m in status.members if not m.joining]
+
+
+def kill_one(client: LighthouseClient, rng: random.Random) -> None:
+    members = _members(client)
+    if not members:
+        print("[punisher] no quorum members to kill")
+        return
+    victim = rng.choice(members)
+    print(f"[punisher] killing {victim}")
+    try:
+        client.kill(victim)
+    except Exception as e:  # noqa: BLE001  — victim may die before replying
+        print(f"[punisher] kill rpc ended with: {e}")
+
+
+def kill_all(client: LighthouseClient, rng: random.Random) -> None:
+    for victim in _members(client):
+        print(f"[punisher] killing {victim}")
+        try:
+            client.kill(victim)
+        except Exception as e:  # noqa: BLE001
+            print(f"[punisher] kill rpc ended with: {e}")
+
+
+def kill_loop(client: LighthouseClient, rng: random.Random, mtbf: float) -> None:
+    """Poisson-ish kill schedule with mean time between failures ``mtbf``."""
+    while True:
+        delay = rng.expovariate(1.0 / mtbf) if mtbf > 0 else 1.0
+        print(f"[punisher] next kill in {delay:.1f}s")
+        time.sleep(delay)
+        kill_one(client, rng)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--lighthouse",
+        default=os.environ.get("TPUFT_LIGHTHOUSE"),
+        required=os.environ.get("TPUFT_LIGHTHOUSE") is None,
+    )
+    parser.add_argument("--seed", type=int, default=None)
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("kill_one")
+    sub.add_parser("kill_all")
+    loop = sub.add_parser("kill_loop")
+    loop.add_argument("--mtbf", type=float, default=60.0, help="mean seconds between kills")
+    args = parser.parse_args()
+
+    rng = random.Random(args.seed)
+    client = LighthouseClient(args.lighthouse)
+    if args.cmd == "kill_one":
+        kill_one(client, rng)
+    elif args.cmd == "kill_all":
+        kill_all(client, rng)
+    else:
+        kill_loop(client, rng, args.mtbf)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,53 @@
+"""Tracing/profiling spans.
+
+Role-equivalent of the reference's ``torch.profiler.record_function`` spans
+on every manager phase (manager.py:385-827) and the ``_time``/``_timeit``
+transfer logs (http_transport.py:31-36): here spans emit
+``jax.profiler.TraceAnnotation`` markers, which show up on the TensorBoard
+trace viewer timeline when a ``jax.profiler.trace`` capture is active, and
+optionally log wall time when ``TPUFT_TRACE_LOG`` is set.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from contextlib import contextmanager
+from typing import Generator, Iterator
+
+logger = logging.getLogger("torchft_tpu.trace")
+
+_LOG_SPANS = os.environ.get("TPUFT_TRACE_LOG", "") == "1"
+
+
+@contextmanager
+def trace_span(name: str) -> Generator[None, None, None]:
+    """Marks a region on the jax profiler timeline (no-op cost when no
+    capture is active)."""
+    try:
+        import jax.profiler
+
+        annotation = jax.profiler.TraceAnnotation(name)
+    except Exception:  # noqa: BLE001  — profiling must never break training
+        annotation = None
+    start = time.monotonic() if _LOG_SPANS else 0.0
+    if annotation is not None:
+        annotation.__enter__()
+    try:
+        yield
+    finally:
+        if annotation is not None:
+            annotation.__exit__(None, None, None)
+        if _LOG_SPANS:
+            logger.info("%s took %.3fms", name, (time.monotonic() - start) * 1000)
+
+
+@contextmanager
+def timed(name: str) -> Iterator[None]:
+    """Always-on wall-time log for transfer-sized operations."""
+    start = time.monotonic()
+    try:
+        yield
+    finally:
+        logger.info("%s took %.3fs", name, time.monotonic() - start)
