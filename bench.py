@@ -103,13 +103,13 @@ def main() -> None:
     from torchft_tpu.local_sgd import DiLoCo
     from torchft_tpu.manager import Manager
     from torchft_tpu.optim import Optimizer
-    from torchft_tpu.parallel.process_group import ProcessGroupTCP
+    from torchft_tpu.parallel.native_pg import ProcessGroupNative
     from torchft_tpu.parallel.store import StoreClient, StoreServer
 
     def make_manager(use_async_quorum: bool):
         lighthouse = LighthouseServer(min_replicas=1, join_timeout_ms=100)
         store = StoreServer()
-        pg = ProcessGroupTCP(timeout=30.0)
+        pg = ProcessGroupNative(timeout=30.0)
         manager = Manager(
             pg=pg,
             min_replica_size=1,

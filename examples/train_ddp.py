@@ -41,7 +41,7 @@ def train(args: argparse.Namespace) -> None:
     from torchft_tpu.manager import Manager
     from torchft_tpu.models.simple import DemoCNN
     from torchft_tpu.optim import Optimizer
-    from torchft_tpu.parallel.process_group import ProcessGroupTCP
+    from torchft_tpu.parallel.native_pg import ProcessGroupNative
     from torchft_tpu.parallel.store import StoreClient, StoreServer
 
     group_id = int(os.environ.get("REPLICA_GROUP_ID", args.replica_group_id))
@@ -51,7 +51,7 @@ def train(args: argparse.Namespace) -> None:
     model = DemoCNN(padding_mb=args.padding_mb)
     params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))
 
-    pg = ProcessGroupTCP(timeout=args.timeout)
+    pg = ProcessGroupNative(timeout=args.timeout)
     manager = Manager(
         pg=pg,
         min_replica_size=args.min_replica_size,

@@ -32,7 +32,7 @@ def train(args: argparse.Namespace) -> None:
     from torchft_tpu.local_sgd import DiLoCo
     from torchft_tpu.manager import Manager
     from torchft_tpu.models.simple import DemoMLP
-    from torchft_tpu.parallel.process_group import ProcessGroupTCP
+    from torchft_tpu.parallel.native_pg import ProcessGroupNative
     from torchft_tpu.parallel.store import StoreClient, StoreServer
 
     group_id = int(os.environ.get("REPLICA_GROUP_ID", "0"))
@@ -42,7 +42,7 @@ def train(args: argparse.Namespace) -> None:
     model = DemoMLP(hidden=args.hidden)
     params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 64)))
 
-    pg = ProcessGroupTCP(timeout=args.timeout)
+    pg = ProcessGroupNative(timeout=args.timeout)
     manager = Manager(
         pg=pg,
         min_replica_size=1,

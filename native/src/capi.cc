@@ -138,3 +138,98 @@ void tpuft_store_shutdown(void* handle) {
 void tpuft_store_free(void* handle) { delete static_cast<tpuft::StoreServer*>(handle); }
 
 }  // extern "C"
+
+// ---------- CollectiveGroup ----------
+
+#include "collectives.h"
+
+namespace {
+
+// Per-handle error string for the collective API: calls happen on the
+// Python wrapper's op-worker thread; it reads the error immediately after a
+// failed call on the same thread, but a dedicated slot per group avoids any
+// cross-thread thread_local surprises.
+struct CollectiveHandle {
+  tpuft::CollectiveGroup group;
+  std::string last_error;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* tpuft_collective_new() { return new CollectiveHandle(); }
+
+const char* tpuft_collective_last_error(void* handle) {
+  return static_cast<CollectiveHandle*>(handle)->last_error.c_str();
+}
+
+int tpuft_collective_configure(void* handle, const char* store_addr, const char* prefix,
+                               int rank, int world_size, int64_t timeout_ms) {
+  auto* h = static_cast<CollectiveHandle*>(handle);
+  return h->group.configure(store_addr ? store_addr : "", prefix ? prefix : "", rank,
+                            world_size, timeout_ms, &h->last_error)
+             ? 0
+             : 1;
+}
+
+void tpuft_collective_shutdown(void* handle) {
+  static_cast<CollectiveHandle*>(handle)->group.shutdown();
+}
+
+void tpuft_collective_free(void* handle) { delete static_cast<CollectiveHandle*>(handle); }
+
+int tpuft_collective_allreduce(void* handle, void* data, uint64_t count, int dtype,
+                               int op, int64_t timeout_ms) {
+  auto* h = static_cast<CollectiveHandle*>(handle);
+  return h->group.allreduce(data, count, static_cast<tpuft::DType>(dtype),
+                            static_cast<tpuft::Reduce>(op), timeout_ms, &h->last_error)
+             ? 0
+             : 1;
+}
+
+int tpuft_collective_allgather(void* handle, const void* data, void* out, uint64_t count,
+                               int dtype, int64_t timeout_ms) {
+  auto* h = static_cast<CollectiveHandle*>(handle);
+  return h->group.allgather(data, out, count, static_cast<tpuft::DType>(dtype),
+                            timeout_ms, &h->last_error)
+             ? 0
+             : 1;
+}
+
+int tpuft_collective_broadcast(void* handle, void* data, uint64_t count, int dtype,
+                               int root, int64_t timeout_ms) {
+  auto* h = static_cast<CollectiveHandle*>(handle);
+  return h->group.broadcast(data, count, static_cast<tpuft::DType>(dtype), root,
+                            timeout_ms, &h->last_error)
+             ? 0
+             : 1;
+}
+
+int tpuft_collective_alltoall(void* handle, const void* data, void* out, uint64_t count,
+                              int dtype, int64_t timeout_ms) {
+  auto* h = static_cast<CollectiveHandle*>(handle);
+  return h->group.alltoall(data, out, count, static_cast<tpuft::DType>(dtype), timeout_ms,
+                           &h->last_error)
+             ? 0
+             : 1;
+}
+
+int tpuft_collective_send(void* handle, const void* data, uint64_t nbytes, int dst,
+                          int64_t timeout_ms) {
+  auto* h = static_cast<CollectiveHandle*>(handle);
+  return h->group.send(data, nbytes, dst, timeout_ms, &h->last_error) ? 0 : 1;
+}
+
+int tpuft_collective_recv(void* handle, void* data, uint64_t nbytes, int src,
+                          int64_t timeout_ms) {
+  auto* h = static_cast<CollectiveHandle*>(handle);
+  return h->group.recv(data, nbytes, src, timeout_ms, &h->last_error) ? 0 : 1;
+}
+
+int tpuft_collective_barrier(void* handle, int64_t timeout_ms) {
+  auto* h = static_cast<CollectiveHandle*>(handle);
+  return h->group.barrier(timeout_ms, &h->last_error) ? 0 : 1;
+}
+
+}  // extern "C"

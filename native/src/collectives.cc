@@ -1,0 +1,476 @@
+#include "collectives.h"
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <poll.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cstring>
+#include <thread>
+
+#include "rpc.h"
+#include "store_client.h"
+
+namespace tpuft {
+
+size_t dtype_size(DType dtype) {
+  switch (dtype) {
+    case DType::kF32:
+    case DType::kI32:
+      return 4;
+    case DType::kF64:
+    case DType::kI64:
+      return 8;
+    case DType::kU8:
+      return 1;
+    case DType::kBF16:
+      return 2;
+  }
+  return 1;
+}
+
+namespace {
+
+inline float bf16_to_f32(uint16_t v) {
+  uint32_t bits = static_cast<uint32_t>(v) << 16;
+  float out;
+  std::memcpy(&out, &bits, 4);
+  return out;
+}
+
+inline uint16_t f32_to_bf16(float f) {
+  uint32_t bits;
+  std::memcpy(&bits, &f, 4);
+  // Round-to-nearest-even, matching ml_dtypes/XLA semantics.
+  uint32_t rounding = 0x7fff + ((bits >> 16) & 1);
+  return static_cast<uint16_t>((bits + rounding) >> 16);
+}
+
+template <typename T>
+void reduce_typed(T* acc, const T* other, size_t count, Reduce op) {
+  switch (op) {
+    case Reduce::kSum:
+    case Reduce::kAvg:
+      for (size_t i = 0; i < count; ++i) acc[i] += other[i];
+      break;
+    case Reduce::kMax:
+      for (size_t i = 0; i < count; ++i) acc[i] = std::max(acc[i], other[i]);
+      break;
+    case Reduce::kMin:
+      for (size_t i = 0; i < count; ++i) acc[i] = std::min(acc[i], other[i]);
+      break;
+  }
+}
+
+void reduce_bf16(uint16_t* acc, const uint16_t* other, size_t count, Reduce op) {
+  // f32 accumulate per element (the chunk granularity keeps this hot loop
+  // simple; vectorization is the compiler's job).
+  for (size_t i = 0; i < count; ++i) {
+    float a = bf16_to_f32(acc[i]);
+    float b = bf16_to_f32(other[i]);
+    float out;
+    switch (op) {
+      case Reduce::kSum:
+      case Reduce::kAvg:
+        out = a + b;
+        break;
+      case Reduce::kMax:
+        out = std::max(a, b);
+        break;
+      default:
+        out = std::min(a, b);
+        break;
+    }
+    acc[i] = f32_to_bf16(out);
+  }
+}
+
+void reduce_buffers(void* acc, const void* other, size_t count, DType dtype, Reduce op) {
+  switch (dtype) {
+    case DType::kF32:
+      reduce_typed(static_cast<float*>(acc), static_cast<const float*>(other), count, op);
+      break;
+    case DType::kF64:
+      reduce_typed(static_cast<double*>(acc), static_cast<const double*>(other), count, op);
+      break;
+    case DType::kI32:
+      reduce_typed(static_cast<int32_t*>(acc), static_cast<const int32_t*>(other), count, op);
+      break;
+    case DType::kI64:
+      reduce_typed(static_cast<int64_t*>(acc), static_cast<const int64_t*>(other), count, op);
+      break;
+    case DType::kU8:
+      reduce_typed(static_cast<uint8_t*>(acc), static_cast<const uint8_t*>(other), count, op);
+      break;
+    case DType::kBF16:
+      reduce_bf16(static_cast<uint16_t*>(acc), static_cast<const uint16_t*>(other), count, op);
+      break;
+  }
+}
+
+void finalize_avg(void* data, size_t count, DType dtype, int world_size) {
+  float inv = 1.0f / static_cast<float>(world_size);
+  switch (dtype) {
+    case DType::kF32: {
+      auto* p = static_cast<float*>(data);
+      for (size_t i = 0; i < count; ++i) p[i] *= inv;
+      break;
+    }
+    case DType::kF64: {
+      auto* p = static_cast<double*>(data);
+      for (size_t i = 0; i < count; ++i) p[i] /= world_size;
+      break;
+    }
+    case DType::kBF16: {
+      auto* p = static_cast<uint16_t*>(data);
+      for (size_t i = 0; i < count; ++i) p[i] = f32_to_bf16(bf16_to_f32(p[i]) * inv);
+      break;
+    }
+    default: {
+      // Integer average truncates toward zero (matches numpy //).
+      if (dtype == DType::kI32) {
+        auto* p = static_cast<int32_t*>(data);
+        for (size_t i = 0; i < count; ++i) p[i] /= world_size;
+      } else if (dtype == DType::kI64) {
+        auto* p = static_cast<int64_t*>(data);
+        for (size_t i = 0; i < count; ++i) p[i] /= world_size;
+      } else {
+        auto* p = static_cast<uint8_t*>(data);
+        for (size_t i = 0; i < count; ++i) p[i] = static_cast<uint8_t>(p[i] / world_size);
+      }
+    }
+  }
+}
+
+}  // namespace
+
+CollectiveGroup::~CollectiveGroup() {
+  shutdown();
+  close_fds();
+}
+
+void CollectiveGroup::shutdown() {
+  if (closed_.exchange(true)) return;
+  // Only ::shutdown() here: this may run concurrently with an op thread
+  // blocked inside send/recv on these fds. The fds stay allocated (no
+  // close, no map mutation) so the blocked op fails cleanly rather than
+  // touching a recycled descriptor; close_fds() reclaims them later from a
+  // quiescent context.
+  for (auto& [rank, fd] : peers_) {
+    ::shutdown(fd, SHUT_RDWR);
+  }
+  if (listen_fd_ >= 0) {
+    ::shutdown(listen_fd_, SHUT_RDWR);
+  }
+}
+
+void CollectiveGroup::close_fds() {
+  for (auto& [rank, fd] : peers_) {
+    close(fd);
+  }
+  peers_.clear();
+  if (listen_fd_ >= 0) {
+    close(listen_fd_);
+    listen_fd_ = -1;
+  }
+}
+
+bool CollectiveGroup::configure(const std::string& store_addr, const std::string& prefix,
+                                int rank, int world_size, int64_t timeout_ms,
+                                std::string* err) {
+  shutdown();
+  close_fds();
+  closed_.store(false);
+  rank_ = rank;
+  world_size_ = world_size;
+  if (world_size == 1) return true;
+  Instant deadline = Clock::now() + DurationMs(timeout_ms);
+
+  // Listener for inbound peers (higher ranks dial us... inverse: we dial
+  // lower ranks, accept from higher ones — same convention as the Python
+  // backend so both interoperate conceptually, not on the wire).
+  int lfd = socket(AF_INET6, SOCK_STREAM, 0);
+  if (lfd < 0) {
+    if (err) *err = std::string("socket: ") + strerror(errno);
+    return false;
+  }
+  int one = 1;
+  setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  struct sockaddr_in6 bind_addr{};
+  bind_addr.sin6_family = AF_INET6;
+  bind_addr.sin6_addr = in6addr_any;
+  bind_addr.sin6_port = 0;
+  if (bind(lfd, reinterpret_cast<struct sockaddr*>(&bind_addr), sizeof(bind_addr)) != 0 ||
+      listen(lfd, world_size) != 0) {
+    if (err) *err = std::string("bind/listen: ") + strerror(errno);
+    close(lfd);
+    return false;
+  }
+  listen_fd_ = lfd;
+  struct sockaddr_in6 actual{};
+  socklen_t alen = sizeof(actual);
+  getsockname(lfd, reinterpret_cast<struct sockaddr*>(&actual), &alen);
+  int port = ntohs(actual.sin6_port);
+  char hostname[256];
+  gethostname(hostname, sizeof(hostname));
+
+  StoreClient store(store_addr, prefix);
+  std::string store_err;
+  if (!store.set("cep/" + std::to_string(rank),
+                 std::string(hostname) + ":" + std::to_string(port), &store_err)) {
+    if (err) *err = "store set failed: " + store_err;
+    return false;
+  }
+
+  // Dial lower ranks.
+  for (int peer = 0; peer < rank; ++peer) {
+    int64_t remain = ms_between(Clock::now(), deadline);
+    if (remain <= 0) {
+      if (err) *err = "rendezvous timeout";
+      return false;
+    }
+    auto addr = store.get("cep/" + std::to_string(peer), /*wait=*/true, remain, &store_err);
+    if (!addr.has_value()) {
+      if (err) *err = "peer address missing: " + store_err;
+      return false;
+    }
+    int fd = tcp_connect(*addr, remain, &store_err);
+    if (fd < 0) {
+      if (err) *err = "connect to peer failed: " + store_err;
+      return false;
+    }
+    int32_t my_rank = htonl(rank);
+    if (!write_all(fd, &my_rank, 4, deadline)) {
+      if (err) *err = "rank handshake send failed";
+      close(fd);
+      return false;
+    }
+    peers_[peer] = fd;
+  }
+  // Accept higher ranks (deadline-bounded: a crashed peer must not wedge
+  // configure past timeout_ms).
+  for (int pending = world_size - 1 - rank; pending > 0; --pending) {
+    struct pollfd pfd{lfd, POLLIN, 0};
+    int64_t remain = ms_between(Clock::now(), deadline);
+    int prc = remain > 0 ? poll(&pfd, 1, static_cast<int>(remain)) : 0;
+    if (prc <= 0) {
+      if (err) *err = "rendezvous accept timeout";
+      return false;
+    }
+    int fd = accept(lfd, nullptr, nullptr);
+    if (fd < 0) {
+      if (err) *err = std::string("accept: ") + strerror(errno);
+      return false;
+    }
+    int peer_one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &peer_one, sizeof(peer_one));
+    int32_t peer_rank_net;
+    if (!read_exact(fd, &peer_rank_net, 4, deadline)) {
+      if (err) *err = "rank handshake recv failed";
+      close(fd);
+      return false;
+    }
+    peers_[static_cast<int>(ntohl(peer_rank_net))] = fd;
+  }
+  for (auto& [peer, fd] : peers_) {
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  }
+  return true;
+}
+
+bool CollectiveGroup::ring_step(const void* send_ptr, size_t send_nbytes,
+                                void* recv_ptr, size_t recv_nbytes, Instant deadline,
+                                std::string* err) {
+  int n = world_size_;
+  int next = (rank_ + 1) % n;
+  int prev = (rank_ + n - 1) % n;
+  // Even ranks send-then-recv; odd recv-then-send: prevents head-of-line
+  // deadlock when buffers exceed the socket window.
+  bool send_first = (rank_ % 2) == 0;
+  for (int phase = 0; phase < 2; ++phase) {
+    bool do_send = (phase == 0) == send_first;
+    if (do_send) {
+      if (!send_bytes(next, send_ptr, send_nbytes, deadline, err)) return false;
+    } else {
+      if (!recv_bytes(prev, recv_ptr, recv_nbytes, deadline, err)) return false;
+    }
+  }
+  return true;
+}
+
+bool CollectiveGroup::send_bytes(int peer, const void* data, size_t nbytes,
+                                 Instant deadline, std::string* err) {
+  auto it = peers_.find(peer);
+  if (it == peers_.end()) {
+    if (err) *err = "no connection to rank " + std::to_string(peer);
+    return false;
+  }
+  if (!write_all(it->second, data, nbytes, deadline)) {
+    if (err) *err = "send to rank " + std::to_string(peer) + " failed";
+    return false;
+  }
+  return true;
+}
+
+bool CollectiveGroup::recv_bytes(int peer, void* data, size_t nbytes, Instant deadline,
+                                 std::string* err) {
+  auto it = peers_.find(peer);
+  if (it == peers_.end()) {
+    if (err) *err = "no connection to rank " + std::to_string(peer);
+    return false;
+  }
+  if (!read_exact(it->second, data, nbytes, deadline)) {
+    if (err) *err = "recv from rank " + std::to_string(peer) + " failed";
+    return false;
+  }
+  return true;
+}
+
+bool CollectiveGroup::allreduce(void* data, size_t count, DType dtype, Reduce op,
+                                int64_t timeout_ms, std::string* err) {
+  if (closed_.load()) {
+    if (err) *err = "group closed";
+    return false;
+  }
+  int n = world_size_;
+  if (n == 1) {
+    if (op == Reduce::kAvg) finalize_avg(data, count, dtype, 1);
+    return true;
+  }
+  Instant deadline = Clock::now() + DurationMs(timeout_ms);
+  size_t elem = dtype_size(dtype);
+  auto* bytes = static_cast<uint8_t*>(data);
+
+  // Chunk boundaries: chunk c covers [offsets[c], offsets[c+1]).
+  std::vector<size_t> offsets(n + 1);
+  for (int c = 0; c <= n; ++c) offsets[c] = count * c / n;
+  size_t max_chunk = 0;
+  for (int c = 0; c < n; ++c) max_chunk = std::max(max_chunk, offsets[c + 1] - offsets[c]);
+  std::vector<uint8_t> scratch(max_chunk * elem);
+
+  // Phase 1: ring reduce-scatter. After step s, each rank has accumulated
+  // s+1 contributions into the chunk it will finalize.
+  for (int s = 0; s < n - 1; ++s) {
+    int send_chunk = (rank_ + n - s) % n;
+    int recv_chunk = (rank_ + n - s - 1) % n;
+    size_t send_count = offsets[send_chunk + 1] - offsets[send_chunk];
+    size_t recv_count = offsets[recv_chunk + 1] - offsets[recv_chunk];
+    if (!ring_step(bytes + offsets[send_chunk] * elem, send_count * elem,
+                   scratch.data(), recv_count * elem, deadline, err)) {
+      return false;
+    }
+    reduce_buffers(bytes + offsets[recv_chunk] * elem, scratch.data(), recv_count, dtype,
+                   op);
+  }
+
+  // Phase 2: ring allgather of the finalized chunks.
+  for (int s = 0; s < n - 1; ++s) {
+    int send_chunk = (rank_ + 1 + n - s) % n;
+    int recv_chunk = (rank_ + n - s) % n;
+    size_t send_count = offsets[send_chunk + 1] - offsets[send_chunk];
+    size_t recv_count = offsets[recv_chunk + 1] - offsets[recv_chunk];
+    if (!ring_step(bytes + offsets[send_chunk] * elem, send_count * elem,
+                   bytes + offsets[recv_chunk] * elem, recv_count * elem, deadline,
+                   err)) {
+      return false;
+    }
+  }
+
+  if (op == Reduce::kAvg) finalize_avg(data, count, dtype, n);
+  return true;
+}
+
+bool CollectiveGroup::allgather(const void* data, void* out, size_t count, DType dtype,
+                                int64_t timeout_ms, std::string* err) {
+  if (closed_.load()) {
+    if (err) *err = "group closed";
+    return false;
+  }
+  size_t nbytes = count * dtype_size(dtype);
+  auto* out_bytes = static_cast<uint8_t*>(out);
+  std::memcpy(out_bytes + rank_ * nbytes, data, nbytes);
+  if (world_size_ == 1) return true;
+  Instant deadline = Clock::now() + DurationMs(timeout_ms);
+  int n = world_size_;
+  // Ring: pass blocks around n-1 times.
+  for (int s = 0; s < n - 1; ++s) {
+    int send_block = (rank_ + n - s) % n;
+    int recv_block = (rank_ + n - s - 1) % n;
+    if (!ring_step(out_bytes + send_block * nbytes, nbytes,
+                   out_bytes + recv_block * nbytes, nbytes, deadline, err)) {
+      return false;
+    }
+  }
+  return true;
+}
+
+bool CollectiveGroup::broadcast(void* data, size_t count, DType dtype, int root,
+                                int64_t timeout_ms, std::string* err) {
+  if (closed_.load()) {
+    if (err) *err = "group closed";
+    return false;
+  }
+  if (world_size_ == 1) return true;
+  Instant deadline = Clock::now() + DurationMs(timeout_ms);
+  size_t nbytes = count * dtype_size(dtype);
+  if (rank_ == root) {
+    for (int peer = 0; peer < world_size_; ++peer) {
+      if (peer == root) continue;
+      if (!send_bytes(peer, data, nbytes, deadline, err)) return false;
+    }
+    return true;
+  }
+  return recv_bytes(root, data, nbytes, deadline, err);
+}
+
+bool CollectiveGroup::alltoall(const void* data, void* out, size_t count, DType dtype,
+                               int64_t timeout_ms, std::string* err) {
+  if (closed_.load()) {
+    if (err) *err = "group closed";
+    return false;
+  }
+  size_t nbytes = count * dtype_size(dtype);
+  const auto* in_bytes = static_cast<const uint8_t*>(data);
+  auto* out_bytes = static_cast<uint8_t*>(out);
+  std::memcpy(out_bytes + rank_ * nbytes, in_bytes + rank_ * nbytes, nbytes);
+  Instant deadline = Clock::now() + DurationMs(timeout_ms);
+  for (int peer = 0; peer < world_size_; ++peer) {
+    if (peer == rank_) continue;
+    if (rank_ < peer) {
+      if (!send_bytes(peer, in_bytes + peer * nbytes, nbytes, deadline, err)) return false;
+      if (!recv_bytes(peer, out_bytes + peer * nbytes, nbytes, deadline, err)) return false;
+    } else {
+      if (!recv_bytes(peer, out_bytes + peer * nbytes, nbytes, deadline, err)) return false;
+      if (!send_bytes(peer, in_bytes + peer * nbytes, nbytes, deadline, err)) return false;
+    }
+  }
+  return true;
+}
+
+bool CollectiveGroup::send(const void* data, size_t nbytes, int dst, int64_t timeout_ms,
+                           std::string* err) {
+  if (closed_.load()) {
+    if (err) *err = "group closed";
+    return false;
+  }
+  return send_bytes(dst, data, nbytes, Clock::now() + DurationMs(timeout_ms), err);
+}
+
+bool CollectiveGroup::recv(void* data, size_t nbytes, int src, int64_t timeout_ms,
+                           std::string* err) {
+  if (closed_.load()) {
+    if (err) *err = "group closed";
+    return false;
+  }
+  return recv_bytes(src, data, nbytes, Clock::now() + DurationMs(timeout_ms), err);
+}
+
+bool CollectiveGroup::barrier(int64_t timeout_ms, std::string* err) {
+  float token = 0.0f;
+  return allreduce(&token, 1, DType::kF32, Reduce::kSum, timeout_ms, err);
+}
+
+}  // namespace tpuft

@@ -1,0 +1,103 @@
+// Native collective engine: the data plane of the replica-axis comm layer.
+//
+// Role-equivalent of Gloo in the reference stack (the host/TCP collective
+// backend behind ProcessGroupGloo): a full TCP mesh between the same local
+// rank of every replica group, rendezvoused through the tpuft store, with a
+// bandwidth-optimal ring allreduce. Ops are synchronous in C++; the Python
+// wrapper (torchft_tpu/parallel/native_pg.py) runs them on its op-worker
+// thread — ctypes releases the GIL, so transfers and reductions run truly
+// parallel to training Python.
+//
+// Determinism contract: ring allreduce computes each chunk's reduction in a
+// fixed ring order and propagates the single result, so every rank ends
+// bitwise identical — the invariant the recovery tests assert.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "common.h"
+
+namespace tpuft {
+
+enum class DType : int32_t {
+  kF32 = 0,
+  kF64 = 1,
+  kI32 = 2,
+  kI64 = 3,
+  kU8 = 4,
+  kBF16 = 5,  // accumulates in f32
+};
+
+enum class Reduce : int32_t { kSum = 0, kAvg = 1, kMax = 2, kMin = 3 };
+
+size_t dtype_size(DType dtype);
+
+class CollectiveGroup {
+ public:
+  CollectiveGroup() = default;
+  ~CollectiveGroup();
+
+  // Rendezvous via the store at store_addr ("host:port") under `prefix`;
+  // builds the full mesh. Returns false with *err on failure.
+  bool configure(const std::string& store_addr, const std::string& prefix, int rank,
+                 int world_size, int64_t timeout_ms, std::string* err);
+
+  // Tears down all sockets; outstanding ops fail.
+  void shutdown();
+
+  int rank() const { return rank_; }
+  int world_size() const { return world_size_; }
+
+  // In-place ring allreduce over `count` elements of `dtype` at data.
+  bool allreduce(void* data, size_t count, DType dtype, Reduce op, int64_t timeout_ms,
+                 std::string* err);
+
+  // Gathers each rank's `count`-element buffer into out (world_size*count).
+  bool allgather(const void* data, void* out, size_t count, DType dtype,
+                 int64_t timeout_ms, std::string* err);
+
+  // Root's buffer distributed to all (in place).
+  bool broadcast(void* data, size_t count, DType dtype, int root, int64_t timeout_ms,
+                 std::string* err);
+
+  // data holds world_size blocks of `count` elements; block i goes to rank
+  // i; out receives block-from-rank-i at offset i.
+  bool alltoall(const void* data, void* out, size_t count, DType dtype,
+                int64_t timeout_ms, std::string* err);
+
+  bool send(const void* data, size_t nbytes, int dst, int64_t timeout_ms,
+            std::string* err);
+  bool recv(void* data, size_t nbytes, int src, int64_t timeout_ms, std::string* err);
+
+  bool barrier(int64_t timeout_ms, std::string* err);
+
+ private:
+  bool send_bytes(int peer, const void* data, size_t nbytes, Instant deadline,
+                  std::string* err);
+  bool recv_bytes(int peer, void* data, size_t nbytes, Instant deadline,
+                  std::string* err);
+  // One parity-ordered ring exchange with the neighbors (deadlock-safe:
+  // even ranks send first, odd ranks receive first).
+  bool ring_step(const void* send_ptr, size_t send_nbytes, void* recv_ptr,
+                 size_t recv_nbytes, Instant deadline, std::string* err);
+  // Closes remaining fds; only safe when no op thread is inside the group.
+  void close_fds();
+
+  int rank_ = 0;
+  int world_size_ = 1;
+  // peers_ is written only by configure()/close_fds() (never concurrently
+  // with ops); shutdown() only ::shutdown()s fds (map untouched, fds stay
+  // allocated) so an op blocked in C observes ECONNRESET instead of a
+  // use-after-close on a recycled descriptor.
+  std::map<int, int> peers_;  // rank -> fd
+  int listen_fd_ = -1;
+  std::atomic<bool> closed_{true};
+};
+
+}  // namespace tpuft

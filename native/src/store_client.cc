@@ -1,0 +1,46 @@
+#include "store_client.h"
+
+#include "tpuft.pb.h"
+
+namespace tpuft {
+
+StoreClient::StoreClient(std::string addr, std::string prefix, int64_t connect_timeout_ms)
+    : client_(std::move(addr), connect_timeout_ms), prefix_(std::move(prefix)) {}
+
+std::string StoreClient::full_key(const std::string& key) const {
+  return prefix_.empty() ? key : prefix_ + "/" + key;
+}
+
+bool StoreClient::set(const std::string& key, const std::string& value, std::string* err) {
+  tpuft::StoreSetRequest req;
+  req.set_key(full_key(key));
+  req.set_value(value);
+  RpcResult result = client_.call(kStoreSet, req.SerializeAsString(), 10000);
+  if (result.status != RpcStatus::kOk) {
+    if (err) *err = result.payload;
+    return false;
+  }
+  return true;
+}
+
+std::optional<std::string> StoreClient::get(const std::string& key, bool wait,
+                                            int64_t timeout_ms, std::string* err) {
+  tpuft::StoreGetRequest req;
+  req.set_key(full_key(key));
+  req.set_wait(wait);
+  req.set_timeout_ms(timeout_ms);
+  RpcResult result = client_.call(kStoreGet, req.SerializeAsString(), timeout_ms + 5000);
+  if (result.status != RpcStatus::kOk) {
+    if (err) *err = result.payload;
+    return std::nullopt;
+  }
+  tpuft::StoreGetResponse resp;
+  if (!resp.ParseFromString(result.payload)) {
+    if (err) *err = "malformed StoreGetResponse";
+    return std::nullopt;
+  }
+  if (!resp.found()) return std::nullopt;
+  return resp.value();
+}
+
+}  // namespace tpuft

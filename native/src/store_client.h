@@ -1,0 +1,29 @@
+// C++ client for the tpuft KV store (rendezvous plane).
+#pragma once
+
+#include <optional>
+#include <string>
+
+#include "rpc.h"
+#include "store.h"
+
+namespace tpuft {
+
+class StoreClient {
+ public:
+  // addr: "host:port"; prefix namespaces all keys ("" for none).
+  StoreClient(std::string addr, std::string prefix, int64_t connect_timeout_ms = 10000);
+
+  bool set(const std::string& key, const std::string& value, std::string* err);
+  // Blocks until the key exists when wait=true; nullopt on timeout/absence.
+  std::optional<std::string> get(const std::string& key, bool wait, int64_t timeout_ms,
+                                 std::string* err);
+
+ private:
+  std::string full_key(const std::string& key) const;
+
+  RpcClient client_;
+  std::string prefix_;
+};
+
+}  // namespace tpuft

@@ -1,0 +1,255 @@
+"""ProcessGroupNative conformance: the C++ ring-collective engine must match
+the Python TCP backend on the full collective surface, bitwise determinism,
+and the kill/reconfigure drill; plus it must slot into the Manager and the
+quantized pipelines unchanged."""
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import List
+
+import numpy as np
+import pytest
+
+from test_process_group import fresh_prefix, run_on_all, store_server  # noqa: F401
+
+from torchft_tpu.parallel.collectives import allreduce_quantized_wire
+from torchft_tpu.parallel.native_pg import ProcessGroupNative
+from torchft_tpu.parallel.process_group import ProcessGroup, ReduceOp
+from torchft_tpu.ops import quantization as q
+
+
+def make_native_group(store_server, world_size: int, timeout: float = 15.0):
+    prefix = fresh_prefix()
+    pgs = [ProcessGroupNative(timeout=timeout) for _ in range(world_size)]
+    with ThreadPoolExecutor(max_workers=world_size) as pool:
+        futures = [
+            pool.submit(
+                pg.configure,
+                f"{store_server.address()}/{prefix}",
+                f"native_{i}",
+                i,
+                world_size,
+            )
+            for i, pg in enumerate(pgs)
+        ]
+        for f in futures:
+            f.result(timeout=30)
+    return pgs
+
+
+@pytest.mark.parametrize("world_size", [2, 3, 4])
+def test_native_ring_allreduce(store_server, world_size) -> None:
+    pgs = make_native_group(store_server, world_size)
+    try:
+        # Large enough that every rank owns a real ring chunk.
+        results = run_on_all(
+            pgs,
+            lambda pg, i: pg.allreduce(
+                [np.full(1000, float(i + 1), dtype=np.float32),
+                 np.arange(7, dtype=np.float64) * (i + 1)],
+                ReduceOp.SUM,
+            ).wait(30),
+        )
+        total = sum(range(1, world_size + 1))
+        for r in results:
+            np.testing.assert_allclose(r[0], np.full(1000, float(total)))
+            np.testing.assert_allclose(r[1], np.arange(7) * total)
+        # Bitwise identical across ranks — the recovery invariant.
+        for idx in range(2):
+            assert all(
+                r[idx].tobytes() == results[0][idx].tobytes() for r in results
+            )
+
+        avg = run_on_all(
+            pgs,
+            lambda pg, i: pg.allreduce(
+                [np.full(10, float(i), dtype=np.float32)], ReduceOp.AVG
+            ).wait(30),
+        )
+        mean = sum(range(world_size)) / world_size
+        for r in avg:
+            np.testing.assert_allclose(r[0], np.full(10, mean), rtol=1e-6)
+    finally:
+        for pg in pgs:
+            pg.shutdown()
+
+
+def test_native_bfloat16_and_int(store_server) -> None:
+    import ml_dtypes
+
+    pgs = make_native_group(store_server, 2)
+    try:
+        results = run_on_all(
+            pgs,
+            lambda pg, i: pg.allreduce(
+                [np.full(600, 1.5 + i, dtype=ml_dtypes.bfloat16),
+                 np.full(5, i + 1, dtype=np.int64)],
+                ReduceOp.SUM,
+            ).wait(30),
+        )
+        for r in results:
+            assert r[0].dtype == ml_dtypes.bfloat16
+            np.testing.assert_allclose(r[0].astype(np.float32), np.full(600, 4.0))
+            np.testing.assert_array_equal(r[1], np.full(5, 3, dtype=np.int64))
+    finally:
+        for pg in pgs:
+            pg.shutdown()
+
+
+def test_native_allgather_broadcast_alltoall_sendrecv(store_server) -> None:
+    pgs = make_native_group(store_server, 3)
+    try:
+        gathered = run_on_all(
+            pgs, lambda pg, i: pg.allgather([np.full(i + 1, float(i))]).wait(30)
+        )
+        for per_rank in gathered:
+            assert len(per_rank) == 3
+            for i, arrays in enumerate(per_rank):
+                np.testing.assert_array_equal(arrays[0], np.full(i + 1, float(i)))
+
+        broadcasted = run_on_all(
+            pgs, lambda pg, i: pg.broadcast([np.array([float(i), 7.0])], 2).wait(30)
+        )
+        for r in broadcasted:
+            np.testing.assert_array_equal(r[0], np.array([2.0, 7.0]))
+
+        exchanged = run_on_all(
+            pgs,
+            lambda pg, i: pg.alltoall(
+                [np.array([i * 10.0 + j]) for j in range(3)]
+            ).wait(30),
+        )
+        for i, per_rank in enumerate(exchanged):
+            for j, arr in enumerate(per_rank):
+                np.testing.assert_array_equal(arr, np.array([j * 10.0 + i]))
+
+        def exchange(pg: ProcessGroup, i: int):
+            if i == 0:
+                pg.send([np.array([42.0]), np.ones((2, 2))], dst=1).wait(30)
+                return None
+            if i == 1:
+                return pg.recv([np.empty(1)], src=0).wait(30)
+            return None
+
+        results = run_on_all(pgs, exchange)
+        np.testing.assert_array_equal(results[1][0], np.array([42.0]))
+        run_on_all(pgs, lambda pg, i: pg.barrier().wait(30))
+    finally:
+        for pg in pgs:
+            pg.shutdown()
+
+
+def test_native_resiliency_kill_and_reconfigure(store_server) -> None:
+    world_size = 3
+    pgs = make_native_group(store_server, world_size, timeout=3.0)
+    try:
+        run_on_all(pgs, lambda pg, i: pg.allreduce([np.ones(8)], ReduceOp.SUM).wait(30))
+        pgs[-1].shutdown()
+
+        def survivor(pg: ProcessGroup, i: int):
+            if i == world_size - 1:
+                return None
+            with pytest.raises(Exception):
+                pg.allreduce([np.ones(8)], ReduceOp.SUM).wait(20)
+            return pg.errored()
+
+        errors = run_on_all(pgs[:-1], survivor)
+        assert all(e is not None for e in errors)
+
+        prefix = fresh_prefix()
+        run_on_all(
+            pgs[:-1],
+            lambda pg, i: pg.configure(
+                f"{store_server.address()}/{prefix}", f"native_{i}", i, world_size - 1
+            ),
+        )
+        results = run_on_all(
+            pgs[:-1], lambda pg, i: pg.allreduce([np.ones(8)], ReduceOp.SUM).wait(30)
+        )
+        for r in results:
+            np.testing.assert_array_equal(r[0], np.full(8, 2.0))
+    finally:
+        for pg in pgs:
+            pg.shutdown()
+
+
+def test_native_quantized_wire_pipeline(store_server) -> None:
+    """The fp8 prequantized allreduce rides the native alltoall/allgather."""
+    pgs = make_native_group(store_server, 2)
+    rng = np.random.default_rng(0)
+    inputs = [rng.normal(size=1024).astype(np.float32) for _ in range(2)]
+    quantized = [q.quantize_blocks(x) for x in inputs]
+    try:
+        results = run_on_all(
+            pgs,
+            lambda pg, i: allreduce_quantized_wire(
+                quantized[i][0], quantized[i][1], ReduceOp.SUM, pg
+            ).wait(30),
+        )
+        expected = inputs[0] + inputs[1]
+        for payload, scales in results:
+            restored = q.dequantize_blocks(payload, scales, expected.shape, expected.dtype)
+            np.testing.assert_allclose(restored, expected, rtol=0.2, atol=0.3)
+        assert results[0][0].tobytes() == results[1][0].tobytes()
+    finally:
+        for pg in pgs:
+            pg.shutdown()
+
+
+def test_native_pg_with_manager_integration(store_server) -> None:
+    """End to end: two Managers averaging gradients over ProcessGroupNative."""
+    import threading
+
+    from torchft_tpu.coordination import LighthouseServer
+    from torchft_tpu.manager import Manager
+    from torchft_tpu.parallel.store import StoreClient, StoreServer
+
+    lighthouse = LighthouseServer(
+        min_replicas=1, join_timeout_ms=10000, heartbeat_timeout_ms=1000
+    )
+    results = {}
+
+    def group(idx: int) -> None:
+        store = StoreServer()
+        pg = ProcessGroupNative(timeout=10.0)
+        manager = Manager(
+            pg=pg,
+            min_replica_size=1,
+            store=StoreClient(store.address()),
+            store_addr=store.address(),
+            group_rank=0,
+            lighthouse_addr=lighthouse.address(),
+            replica_id=f"native_mgr_{idx}",
+            heartbeat_interval=0.05,
+            timeout=10.0,
+            quorum_timeout=20.0,
+        )
+        state = {"x": np.zeros(1)}
+        manager.register_state_dict_fn(
+            "state", lambda s: state.update(s), lambda: dict(state)
+        )
+        try:
+            for step in range(2):
+                manager.start_quorum()
+                out = manager.allreduce(np.full(2000, float(idx + 1), np.float32)).wait(30)
+                assert manager.should_commit()
+                results.setdefault(idx, []).append(out)
+        finally:
+            manager.shutdown(wait=False)
+            pg.shutdown()
+            store.shutdown()
+
+    threads = [threading.Thread(target=group, args=(i,)) for i in range(2)]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+        # Step 0: the init_sync joiner contributes zeros while the cohort
+        # divisor is 2 -> both groups see the same biased 0.5 (reference
+        # semantics). Step 1: both participate -> true average 1.5.
+        for idx in range(2):
+            np.testing.assert_allclose(results[idx][1], np.full(2000, 1.5))
+        for step in range(2):
+            assert results[0][step].tobytes() == results[1][step].tobytes()
+    finally:
+        lighthouse.shutdown()

@@ -24,6 +24,7 @@ from torchft_tpu.ddp import DistributedDataParallel, ft_allreduce_gradients
 from torchft_tpu.manager import Manager, WorldSizeMode
 from torchft_tpu.optim import Optimizer, OptimizerWrapper
 from torchft_tpu.parallel.baby import ProcessGroupBaby
+from torchft_tpu.parallel.native_pg import ProcessGroupNative
 from torchft_tpu.parallel.process_group import (
     ProcessGroup,
     ProcessGroupDummy,
@@ -43,6 +44,7 @@ __all__ = [
     "DistributedSampler",
     "ProcessGroup",
     "ProcessGroupTCP",
+    "ProcessGroupNative",
     "ProcessGroupBaby",
     "ProcessGroupDummy",
     "ReduceOp",

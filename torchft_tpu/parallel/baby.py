@@ -31,13 +31,19 @@ from torchft_tpu.work import Work, _DummyWork
 __all__ = ["ProcessGroupBaby"]
 
 
-def _baby_main(req_conn, resp_conn, store_addr, replica_id, rank, world_size, timeout):
-    """Child entry: owns a real ProcessGroupTCP and replays parent ops."""
-    from torchft_tpu.parallel.process_group import ProcessGroupTCP
-
+def _baby_main(req_conn, resp_conn, store_addr, replica_id, rank, world_size, timeout,
+               backend):
+    """Child entry: owns the real inner PG and replays parent ops."""
     req = _MonitoredPipe(req_conn)
     resp = _MonitoredPipe(resp_conn)
-    pg = ProcessGroupTCP(timeout=timeout)
+    if backend == "native":
+        from torchft_tpu.parallel.native_pg import ProcessGroupNative
+
+        pg = ProcessGroupNative(timeout=timeout)
+    else:
+        from torchft_tpu.parallel.process_group import ProcessGroupTCP
+
+        pg = ProcessGroupTCP(timeout=timeout)
     try:
         pg.configure(store_addr, replica_id, rank, world_size)
         resp.send(("ready", None))
@@ -78,9 +84,12 @@ class ProcessGroupBaby(ProcessGroup):
     """Runs the real PG in a spawned subprocess; a hang is cured by SIGKILL
     on the child rather than process death for the trainer."""
 
-    def __init__(self, timeout: float = 60.0) -> None:
+    def __init__(self, timeout: float = 60.0, backend: str = "native") -> None:
         super().__init__()
+        if backend not in ("native", "tcp"):
+            raise ValueError(f"unknown baby backend {backend!r}; use 'native' or 'tcp'")
         self._timeout = timeout
+        self._backend = backend
         self._rank = 0
         self._world_size = 1
         self._proc: Optional[mp.process.BaseProcess] = None
@@ -115,6 +124,7 @@ class ProcessGroupBaby(ProcessGroup):
                 rank,
                 world_size,
                 self._timeout,
+                self._backend,
             ),
             daemon=True,
             name=f"tpuft-baby-{replica_id}-{rank}",

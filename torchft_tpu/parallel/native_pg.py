@@ -1,0 +1,422 @@
+"""ProcessGroupNative: the C++ collective engine behind the PG interface.
+
+The data-plane counterpart of the reference's native Gloo backend: ring
+allreduce, ring allgather, linear broadcast, and pairwise alltoall run in
+C++ (native/src/collectives.cc) over a full TCP mesh, with numpy arrays
+passed zero-copy via ctypes. Calls release the GIL, so collectives overlap
+Python-side training for real.
+
+Same resizable semantics as :class:`ProcessGroupTCP`: ``configure`` under a
+fresh store prefix per quorum, sticky ``errored()``, ``abort`` closes the
+mesh and fails in-flight ops. Same determinism contract: every rank's
+results are bitwise identical.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import pickle
+import queue
+import threading
+from concurrent.futures import Future
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from torchft_tpu import _native
+from torchft_tpu.parallel.process_group import (
+    ProcessGroup,
+    ReduceOp,
+    pickle_dumps_arrays,
+    pickle_loads_arrays,
+)
+from torchft_tpu.work import Work
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["ProcessGroupNative"]
+
+_DTYPE_CODES = {}  # populated lazily (ml_dtypes import)
+
+_REDUCE_CODES = {
+    ReduceOp.SUM: 0,
+    ReduceOp.AVG: 1,
+    ReduceOp.MAX: 2,
+    ReduceOp.MIN: 3,
+}
+
+
+def _dtype_code(dtype: np.dtype) -> int:
+    global _DTYPE_CODES
+    if not _DTYPE_CODES:
+        import ml_dtypes
+
+        _DTYPE_CODES = {
+            np.dtype(np.float32): 0,
+            np.dtype(np.float64): 1,
+            np.dtype(np.int32): 2,
+            np.dtype(np.int64): 3,
+            np.dtype(np.uint8): 4,
+            np.dtype(ml_dtypes.bfloat16): 5,
+        }
+    code = _DTYPE_CODES.get(np.dtype(dtype))
+    if code is None:
+        raise TypeError(f"unsupported dtype for native collectives: {dtype}")
+    return code
+
+
+def _configure_lib(lib: ctypes.CDLL) -> None:
+    if getattr(lib, "_collective_configured", False):
+        return
+    lib.tpuft_collective_new.restype = ctypes.c_void_p
+    lib.tpuft_collective_last_error.restype = ctypes.c_char_p
+    lib.tpuft_collective_last_error.argtypes = [ctypes.c_void_p]
+    lib.tpuft_collective_configure.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int64,
+    ]
+    lib.tpuft_collective_shutdown.argtypes = [ctypes.c_void_p]
+    lib.tpuft_collective_free.argtypes = [ctypes.c_void_p]
+    lib.tpuft_collective_allreduce.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int64,
+    ]
+    lib.tpuft_collective_allgather.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64,
+        ctypes.c_int, ctypes.c_int64,
+    ]
+    lib.tpuft_collective_broadcast.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int64,
+    ]
+    lib.tpuft_collective_alltoall.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64,
+        ctypes.c_int, ctypes.c_int64,
+    ]
+    lib.tpuft_collective_send.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int, ctypes.c_int64,
+    ]
+    lib.tpuft_collective_recv.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int, ctypes.c_int64,
+    ]
+    lib.tpuft_collective_barrier.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib._collective_configured = True
+
+
+def _ptr(array: np.ndarray) -> ctypes.c_void_p:
+    return ctypes.c_void_p(array.ctypes.data)
+
+
+class ProcessGroupNative(ProcessGroup):
+    """Native-backend resizable PG (the NCCL/Gloo slot of the TPU stack)."""
+
+    def __init__(self, timeout: float = 60.0) -> None:
+        super().__init__()
+        self._timeout = timeout
+        self._lib = _native.load()
+        _configure_lib(self._lib)
+        self._handle: Optional[int] = None
+        self._rank = 0
+        self._world_size = 1
+        self._errored_exc: Optional[Exception] = None
+        self._ops: Optional["queue.Queue"] = None
+        self._worker: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def configure(
+        self, store_addr: str, replica_id: str, rank: int, world_size: int
+    ) -> None:
+        self._teardown()
+        self._errored_exc = None
+        self._rank = rank
+        self._world_size = world_size
+        hostport, _, prefix = store_addr.partition("/")
+        handle = self._lib.tpuft_collective_new()
+        rc = self._lib.tpuft_collective_configure(
+            handle,
+            hostport.encode(),
+            prefix.encode(),
+            rank,
+            world_size,
+            int(self._timeout * 1000),
+        )
+        if rc != 0:
+            err = self._lib.tpuft_collective_last_error(handle).decode()
+            self._lib.tpuft_collective_free(handle)
+            error = RuntimeError(f"native configure failed: {err}")
+            self._errored_exc = error
+            raise error
+        self._handle = handle
+        self._ops = queue.Queue()
+        self._worker = threading.Thread(
+            target=self._worker_loop, args=(self._ops,), daemon=True,
+            name=f"native-pg-{replica_id}-{rank}",
+        )
+        self._worker.start()
+
+    def _worker_loop(self, ops: "queue.Queue") -> None:
+        while True:
+            item = ops.get()
+            if item is None:
+                return
+            item()
+
+    def _teardown(self) -> None:
+        with self._lock:
+            handle, self._handle = self._handle, None
+            ops, self._ops = self._ops, None
+        if handle is not None:
+            # ::shutdown()s the sockets, failing any op blocked inside a C
+            # call (fds stay allocated until the free below).
+            self._lib.tpuft_collective_shutdown(handle)
+        if ops is not None:
+            ops.put(None)
+        worker, self._worker = self._worker, None
+        if worker is not None:
+            worker.join(timeout=10.0)
+        if handle is not None:
+            if worker is not None and worker.is_alive():
+                # The op thread is still inside the native call: freeing now
+                # would be a use-after-free. Leak the handle (sockets are
+                # already shut down, so the op will fail and the worker exit
+                # eventually); better a bounded leak than a crash.
+                logger.warning("native pg worker still running; leaking handle")
+            else:
+                self._lib.tpuft_collective_free(handle)
+
+    def abort(self) -> None:
+        self._errored_exc = self._errored_exc or RuntimeError("process group aborted")
+        self._teardown()
+
+    def shutdown(self) -> None:
+        self._teardown()
+
+    def errored(self) -> Optional[Exception]:
+        return self._errored_exc
+
+    def size(self) -> int:
+        return self._world_size
+
+    def rank(self) -> int:
+        return self._rank
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _last_error(self, handle: int) -> str:
+        return self._lib.tpuft_collective_last_error(handle).decode()
+
+    def _submit(self, fn: Callable[[int], object]) -> Work:
+        if self._errored_exc is not None:
+            raise RuntimeError(f"process group in error state: {self._errored_exc}")
+        fut: Future = Future()
+        # Read handle/queue and enqueue under the lock so a concurrent
+        # _teardown cannot slip its None sentinel in between (which would
+        # strand this op's future unresolved forever).
+        with self._lock:
+            handle, ops = self._handle, self._ops
+            if handle is None or ops is None:
+                raise RuntimeError("process group not configured")
+
+            def run() -> None:
+                try:
+                    fut.set_result(fn(handle))
+                except BaseException as e:  # noqa: BLE001
+                    if self._errored_exc is None:
+                        self._errored_exc = (
+                            e if isinstance(e, Exception) else RuntimeError(str(e))
+                        )
+                    fut.set_exception(e)
+
+            ops.put(run)
+        return Work(fut)
+
+    def _check(self, rc: int, handle: int, op: str) -> None:
+        if rc != 0:
+            raise RuntimeError(f"native {op} failed: {self._last_error(handle)}")
+
+    # -- collectives -------------------------------------------------------
+
+    def allreduce(self, arrays: Sequence[np.ndarray], op: ReduceOp = ReduceOp.SUM) -> Work:
+        arrays = [np.ascontiguousarray(a) for a in arrays]
+        timeout_ms = int(self._timeout * 1000)
+
+        def run(handle: int) -> List[np.ndarray]:
+            out = []
+            for array in arrays:
+                buf = array.copy()
+                code = _dtype_code(buf.dtype)
+                self._check(
+                    self._lib.tpuft_collective_allreduce(
+                        handle, _ptr(buf), buf.size, code, _REDUCE_CODES[op], timeout_ms
+                    ),
+                    handle,
+                    "allreduce",
+                )
+                out.append(buf)
+            return out
+
+        return self._submit(run)
+
+    def allgather(self, arrays: Sequence[np.ndarray]) -> Work:
+        # Variable shapes across ranks ride the generic send path: pack,
+        # gather fixed-size length headers, then exchange payloads via the
+        # equal-size alltoall... simplest correct: pickle + max-size pad.
+        blob = pickle_dumps_arrays([np.asarray(a) for a in arrays])
+        timeout_ms = int(self._timeout * 1000)
+
+        def run(handle: int) -> List[List[np.ndarray]]:
+            n = self._world_size
+            length = np.array([len(blob)], dtype=np.int64)
+            lengths = np.zeros(n, dtype=np.int64)
+            self._check(
+                self._lib.tpuft_collective_allgather(
+                    handle, _ptr(length), _ptr(lengths), 1, _dtype_code(np.dtype(np.int64)), timeout_ms
+                ),
+                handle,
+                "allgather",
+            )
+            max_len = int(lengths.max())
+            padded = np.zeros(max_len, dtype=np.uint8)
+            padded[: len(blob)] = np.frombuffer(blob, dtype=np.uint8)
+            gathered = np.zeros(n * max_len, dtype=np.uint8)
+            self._check(
+                self._lib.tpuft_collective_allgather(
+                    handle, _ptr(padded), _ptr(gathered), max_len,
+                    _dtype_code(np.dtype(np.uint8)), timeout_ms,
+                ),
+                handle,
+                "allgather",
+            )
+            return [
+                pickle_loads_arrays(
+                    gathered[r * max_len : r * max_len + int(lengths[r])].tobytes()
+                )
+                for r in range(n)
+            ]
+
+        return self._submit(run)
+
+    def broadcast(self, arrays: Sequence[np.ndarray], root: int = 0) -> Work:
+        arrays = [np.ascontiguousarray(a) for a in arrays]
+        timeout_ms = int(self._timeout * 1000)
+
+        def run(handle: int) -> List[np.ndarray]:
+            out = []
+            for array in arrays:
+                buf = array.copy()
+                self._check(
+                    self._lib.tpuft_collective_broadcast(
+                        handle, _ptr(buf), buf.size, _dtype_code(buf.dtype), root, timeout_ms
+                    ),
+                    handle,
+                    "broadcast",
+                )
+                out.append(buf)
+            return out
+
+        return self._submit(run)
+
+    def reduce_scatter(
+        self, arrays: Sequence[np.ndarray], op: ReduceOp = ReduceOp.SUM
+    ) -> Work:
+        reduced = self.allreduce(arrays, op)
+        n = self._world_size
+        rank = self._rank
+
+        def split(result: List[np.ndarray]) -> List[np.ndarray]:
+            out = []
+            for a in result:
+                if a.shape[0] % n != 0:
+                    raise ValueError(
+                        f"reduce_scatter requires dim0 ({a.shape[0]}) divisible by world_size ({n})"
+                    )
+                out.append(np.split(a, n, axis=0)[rank].copy())
+            return out
+
+        return reduced.then(split)
+
+    def alltoall(self, arrays: Sequence[np.ndarray]) -> Work:
+        arrays = [np.ascontiguousarray(a) for a in arrays]
+        if len(arrays) != self._world_size:
+            raise ValueError(f"alltoall requires {self._world_size} arrays")
+        shapes = {a.shape for a in arrays}
+        dtypes = {a.dtype for a in arrays}
+        if len(shapes) != 1 or len(dtypes) != 1:
+            raise ValueError("native alltoall requires uniform shapes/dtypes")
+        timeout_ms = int(self._timeout * 1000)
+
+        def run(handle: int) -> List[np.ndarray]:
+            stacked = np.concatenate([a.reshape(-1) for a in arrays])
+            out = np.empty_like(stacked)
+            per_rank = arrays[0].size
+            self._check(
+                self._lib.tpuft_collective_alltoall(
+                    handle, _ptr(stacked), _ptr(out), per_rank,
+                    _dtype_code(stacked.dtype), timeout_ms,
+                ),
+                handle,
+                "alltoall",
+            )
+            return [
+                out[r * per_rank : (r + 1) * per_rank].reshape(arrays[0].shape).copy()
+                for r in range(self._world_size)
+            ]
+
+        return self._submit(run)
+
+    def send(self, arrays: Sequence[np.ndarray], dst: int, tag: int = 0) -> Work:
+        blob = pickle_dumps_arrays([np.asarray(a) for a in arrays])
+        timeout_ms = int(self._timeout * 1000)
+
+        def run(handle: int) -> None:
+            header = np.array([len(blob)], dtype=np.int64)
+            self._check(
+                self._lib.tpuft_collective_send(handle, _ptr(header), 8, dst, timeout_ms),
+                handle,
+                "send",
+            )
+            payload = np.frombuffer(blob, dtype=np.uint8)
+            self._check(
+                self._lib.tpuft_collective_send(
+                    handle, _ptr(payload), payload.size, dst, timeout_ms
+                ),
+                handle,
+                "send",
+            )
+
+        return self._submit(run)
+
+    def recv(self, shapes_like: Sequence[np.ndarray], src: int, tag: int = 0) -> Work:
+        timeout_ms = int(self._timeout * 1000)
+
+        def run(handle: int) -> List[np.ndarray]:
+            header = np.zeros(1, dtype=np.int64)
+            self._check(
+                self._lib.tpuft_collective_recv(handle, _ptr(header), 8, src, timeout_ms),
+                handle,
+                "recv",
+            )
+            payload = np.zeros(int(header[0]), dtype=np.uint8)
+            self._check(
+                self._lib.tpuft_collective_recv(
+                    handle, _ptr(payload), payload.size, src, timeout_ms
+                ),
+                handle,
+                "recv",
+            )
+            return pickle_loads_arrays(payload.tobytes())
+
+        return self._submit(run)
+
+    def barrier(self) -> Work:
+        timeout_ms = int(self._timeout * 1000)
+
+        def run(handle: int) -> None:
+            self._check(
+                self._lib.tpuft_collective_barrier(handle, timeout_ms), handle, "barrier"
+            )
+
+        return self._submit(run)
