@@ -44,6 +44,12 @@ inline float bf16_to_f32(uint16_t v) {
 inline uint16_t f32_to_bf16(float f) {
   uint32_t bits;
   std::memcpy(&bits, &f, 4);
+  // NaN must stay NaN: rounding a NaN whose payload lives in the low 16
+  // bits would carry into the exponent and yield Inf (ml_dtypes
+  // special-cases this the same way).
+  if ((bits & 0x7fffffff) > 0x7f800000) {
+    return static_cast<uint16_t>((bits >> 16) | 0x0040);  // quiet NaN
+  }
   // Round-to-nearest-even, matching ml_dtypes/XLA semantics.
   uint32_t rounding = 0x7fff + ((bits >> 16) & 1);
   return static_cast<uint16_t>((bits + rounding) >> 16);

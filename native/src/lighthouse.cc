@@ -10,7 +10,9 @@ Lighthouse::Lighthouse(LighthouseOptions opt) : opt_(std::move(opt)) {
   server_ = std::make_unique<RpcServer>(
       opt_.bind,
       [this](uint8_t method, const std::string& payload) { return handle(method, payload); },
-      [this](const std::string& path) { return handle_http(path); });
+      [this](const std::string& method, const std::string& path) {
+        return handle_http(method, path);
+      });
 }
 
 Lighthouse::~Lighthouse() { shutdown(); }
@@ -226,8 +228,29 @@ RpcResult Lighthouse::handle_kill(const std::string& payload) {
   return {RpcStatus::kOk, resp.SerializeAsString()};
 }
 
-std::string Lighthouse::handle_http(const std::string& path) {
-  // Minimal dashboard (parity with the reference's "/" + "/status" routes).
+std::string Lighthouse::handle_http(const std::string& method, const std::string& path) {
+  // Minimal dashboard (parity with the reference's "/", "/status", and
+  // "/replica/:id/kill" routes).
+  if (path.rfind("/replica/", 0) == 0) {
+    auto rest = path.substr(strlen("/replica/"));
+    auto slash = rest.find('/');
+    if (slash != std::string::npos && rest.substr(slash) == "/kill") {
+      if (method != "POST") {
+        // Destructive action: GETs (prefetchers, crawlers) must not kill.
+        return "<html><body><p>kill requires POST</p><a href=\"/\">back</a></body></html>";
+      }
+      tpuft::KillRequest req;
+      req.set_replica_id(rest.substr(0, slash));
+      RpcResult result = handle_kill(req.SerializeAsString());
+      if (result.status == RpcStatus::kOk) {
+        return "<html><body><p>kill sent to " + rest.substr(0, slash) +
+               "</p><a href=\"/\">back</a></body></html>";
+      }
+      return "<html><body><p>kill failed: " + result.payload +
+             "</p><a href=\"/\">back</a></body></html>";
+    }
+    return "";
+  }
   if (path != "/" && path.rfind("/status", 0) != 0) return "";
   std::lock_guard<std::mutex> lock(mu_);
   std::ostringstream html;
@@ -239,7 +262,7 @@ std::string Lighthouse::handle_http(const std::string& path) {
        << "<p>status: " << last_change_reason_ << "</p>";
   if (state_.prev_quorum.has_value()) {
     html << "<table><tr><th>replica</th><th>step</th><th>address</th><th>store</th>"
-         << "<th>heartbeat age (ms)</th></tr>";
+         << "<th>heartbeat age (ms)</th><th></th></tr>";
     Instant now = Clock::now();
     for (const auto& m : state_.prev_quorum->participants()) {
       auto hb = state_.heartbeats.find(m.replica_id());
@@ -247,7 +270,8 @@ std::string Lighthouse::handle_http(const std::string& path) {
       bool stale = age < 0 || age > static_cast<int64_t>(opt_.heartbeat_timeout_ms);
       html << "<tr" << (stale ? " class=stale" : "") << "><td>" << m.replica_id() << "</td><td>"
            << m.step() << "</td><td>" << m.address() << "</td><td>" << m.store_address()
-           << "</td><td>" << age << "</td></tr>";
+           << "</td><td>" << age << "</td><td><form method=\"post\" action=\"/replica/"
+           << m.replica_id() << "/kill\"><button>kill</button></form></td></tr>";
     }
     html << "</table>";
   } else {

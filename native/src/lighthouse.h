@@ -36,7 +36,7 @@ class Lighthouse {
   RpcResult handle_heartbeat(const std::string& payload);
   RpcResult handle_status(const std::string& payload);
   RpcResult handle_kill(const std::string& payload);
-  std::string handle_http(const std::string& path);
+  std::string handle_http(const std::string& method, const std::string& path);
 
   // Runs quorum_compute over current state and, if a quorum forms, applies the
   // quorum_id bump rules, records it as prev_quorum, clears participants and

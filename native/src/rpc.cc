@@ -311,8 +311,9 @@ void RpcServer::serve_conn(int fd, uint64_t conn_id) {
     uint8_t header[6] = {0};
     std::string payload;
     if (!read_frame(fd, kReqMagic, &method, &payload, frame_deadline(), header)) {
-      // Dashboard parity: a browser speaking HTTP GET gets the status page.
-      if (header[0] == 'G' && http_) {
+      // Dashboard parity: a browser speaking HTTP (GET or the kill POST)
+      // gets the status/action pages.
+      if ((header[0] == 'G' || header[0] == 'P') && http_) {
         std::string req(reinterpret_cast<char*>(header), sizeof(header));
         std::string rest;
         rest.resize(4096);
@@ -329,7 +330,8 @@ void RpcServer::serve_conn(int fd, uint64_t conn_id) {
           auto end = req.find_first_of(" \r\n", slash);
           path = req.substr(slash, end == std::string::npos ? std::string::npos : end - slash);
         }
-        std::string body = http_(path);
+        std::string http_method = header[0] == 'P' ? "POST" : "GET";
+        std::string body = http_(http_method, path);
         std::string status_line = body.empty() ? "HTTP/1.1 404 Not Found\r\n" : "HTTP/1.1 200 OK\r\n";
         if (body.empty()) body = "not found";
         std::string resp = status_line +

@@ -73,9 +73,10 @@ bool write_all(int fd, const void* buf, size_t n, Instant deadline);
 // block (long-poll) but should honor any deadline encoded in the request.
 using RpcHandler = std::function<RpcResult(uint8_t method, const std::string& payload)>;
 
-// Optional plain-HTTP handler: given the request path, return full HTML body
-// (empty => 404).
-using HttpHandler = std::function<std::string(const std::string& path)>;
+// Optional plain-HTTP handler: given the request method ("GET"/"POST") and
+// path, return full HTML body (empty => 404).
+using HttpHandler =
+    std::function<std::string(const std::string& method, const std::string& path)>;
 
 class RpcServer {
  public:

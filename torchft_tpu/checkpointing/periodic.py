@@ -1,0 +1,92 @@
+"""Periodic (disk) checkpointing for cold restarts.
+
+The second checkpoint axis (reference SURVEY §5: live healing never touches
+disk; users must separately persist model/optimizer state *plus the manager
+state_dict* for job-level restarts). This helper wraps orbax with the
+manager bookkeeping so a restore resumes at the right committed step::
+
+    ckpt = PeriodicCheckpointer(manager, "/ckpts/run1", save_every=100)
+    restored = ckpt.restore_or_none()       # on startup
+    ...
+    ckpt.maybe_save({"params": opt.params, "opt_state": opt.opt_state})
+
+Only one replica group needs to write (they are bitwise identical after any
+committed step); by convention the participating rank-0 group saves —
+``maybe_save`` checks ``manager.participating_rank() == 0``.
+"""
+
+from __future__ import annotations
+
+import logging
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+from torchft_tpu.manager import Manager
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["PeriodicCheckpointer"]
+
+
+class PeriodicCheckpointer:
+    def __init__(
+        self,
+        manager: Manager,
+        directory: str,
+        save_every: int = 100,
+        max_to_keep: int = 3,
+        only_replica_rank_zero: bool = True,
+    ) -> None:
+        import orbax.checkpoint as ocp
+
+        self._manager = manager
+        self._save_every = save_every
+        self._only_rank_zero = only_replica_rank_zero
+        self._mngr = ocp.CheckpointManager(
+            Path(directory).absolute(),
+            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep),
+        )
+
+    def maybe_save(self, state: Dict[str, Any], force: bool = False) -> bool:
+        """Saves when the committed step hits the cadence (and this group is
+        the designated writer). Returns whether a save happened."""
+        import orbax.checkpoint as ocp
+
+        step = self._manager.current_step()
+        if not force and (step == 0 or step % self._save_every != 0):
+            return False
+        if self._only_rank_zero and (
+            self._manager.participating_rank() != 0 or self._manager._group_rank != 0
+        ):
+            # One writer per job: local rank 0 of the participating-rank-0
+            # group (multiple local ranks racing one orbax step dir corrupts
+            # the checkpoint).
+            return False
+        payload = {
+            "user": state,
+            "tpuft": self._manager.state_dict(),
+        }
+        self._mngr.save(step, args=ocp.args.StandardSave(payload))
+        logger.info("saved periodic checkpoint at step %d", step)
+        return True
+
+    def restore_or_none(self) -> Optional[Dict[str, Any]]:
+        """Restores the latest checkpoint: loads the manager bookkeeping and
+        returns the user state (None when no checkpoint exists)."""
+        import orbax.checkpoint as ocp
+
+        step = self._mngr.latest_step()
+        if step is None:
+            return None
+        payload = self._mngr.restore(step, args=ocp.args.StandardRestore())
+        self._manager.load_state_dict(
+            {k: int(v) for k, v in payload["tpuft"].items()}
+        )
+        logger.info("restored periodic checkpoint from step %d", step)
+        return payload["user"]
+
+    def wait_until_finished(self) -> None:
+        self._mngr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mngr.close()
