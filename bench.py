@@ -159,9 +159,9 @@ def main() -> None:
         teardown(handles)
     diloco_tps = diloco_steps * tokens_per_step / diloco_elapsed
 
-    # Secondary: per-step FT-DDP (every gradient staged through the manager;
-    # on this box the device<->host hop rides the remote-chip tunnel, so this
-    # is the worst-case bound, not the deployment number).
+    # Secondary: per-step FT-DDP with fp8 device-quantized gradients (only
+    # payload + scales cross the host boundary; on this box that hop rides
+    # the remote-chip tunnel, so this is still the worst-case bound).
     manager, handles = make_manager(use_async_quorum=True)
     opt = Optimizer(manager, tx, params)
     ddp_steps = max(STEPS // 4, 3)
@@ -169,13 +169,15 @@ def main() -> None:
         for step in range(2):
             opt.begin_step()
             _, grads = grad_fn(opt.params, batch_for(step))
-            opt.step(ft_allreduce_gradients(manager, grads))
+            opt.step(ft_allreduce_gradients(manager, grads, should_quantize=True))
         t0 = time.monotonic()
         committed = 0
         for step in range(ddp_steps):
             opt.begin_step()
             _, grads = grad_fn(opt.params, batch_for(step))
-            committed += bool(opt.step(ft_allreduce_gradients(manager, grads)))
+            committed += bool(
+                opt.step(ft_allreduce_gradients(manager, grads, should_quantize=True))
+            )
         _ = float(jax.tree_util.tree_leaves(opt.params)[0].sum())
         ddp_elapsed = time.monotonic() - t0
     finally:

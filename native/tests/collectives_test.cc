@@ -1,0 +1,163 @@
+// Native collective engine tests: ring allreduce correctness/determinism,
+// dtype coverage incl. bf16 NaN preservation, rendezvous timeout, and the
+// concurrent-shutdown abort path.
+
+#include <cmath>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+#include "collectives.h"
+#include "store.h"
+#include "test_util.h"
+
+using namespace tpuft;
+
+namespace {
+
+// Runs fn(rank) on world_size threads against one store prefix.
+template <typename Fn>
+void run_group(int world_size, const std::string& prefix, Fn fn) {
+  StoreServer store;
+  store.start();
+  std::vector<std::thread> threads;
+  for (int r = 0; r < world_size; ++r) {
+    threads.emplace_back([&, r] { fn(store.address(), r); });
+  }
+  for (auto& t : threads) t.join();
+  store.shutdown();
+}
+
+uint16_t f32_to_bf16_bits(float f) {
+  uint32_t bits;
+  std::memcpy(&bits, &f, 4);
+  return static_cast<uint16_t>(bits >> 16);
+}
+
+float bf16_bits_to_f32(uint16_t v) {
+  uint32_t bits = static_cast<uint32_t>(v) << 16;
+  float out;
+  std::memcpy(&out, &bits, 4);
+  return out;
+}
+
+}  // namespace
+
+TPUFT_TEST(ring_allreduce_sum_and_avg) {
+  const int n = 3;
+  const size_t count = 1000;  // forces uneven ring chunks (1000/3)
+  std::vector<std::vector<float>> results(n);
+  run_group(n, "ar", [&](const std::string& store_addr, int rank) {
+    CollectiveGroup group;
+    std::string err;
+    EXPECT_TRUE(group.configure(store_addr, "t1", rank, n, 10000, &err));
+    std::vector<float> data(count);
+    for (size_t i = 0; i < count; ++i) data[i] = static_cast<float>(rank + 1) * 0.5f + i;
+    EXPECT_TRUE(group.allreduce(data.data(), count, DType::kF32, Reduce::kSum, 10000, &err));
+    results[rank] = data;
+    group.shutdown();
+  });
+  for (size_t i = 0; i < count; ++i) {
+    float expected = (0.5f + i) + (1.0f + i) + (1.5f + i);
+    EXPECT_TRUE(std::abs(results[0][i] - expected) < 1e-3f);
+  }
+  // Bitwise identical across ranks (the recovery invariant).
+  for (int r = 1; r < n; ++r) {
+    EXPECT_TRUE(std::memcmp(results[0].data(), results[r].data(), count * 4) == 0);
+  }
+}
+
+TPUFT_TEST(allreduce_bf16_preserves_nan) {
+  const int n = 2;
+  std::vector<std::vector<uint16_t>> results(n);
+  run_group(n, "nan", [&](const std::string& store_addr, int rank) {
+    CollectiveGroup group;
+    std::string err;
+    EXPECT_TRUE(group.configure(store_addr, "t2", rank, n, 10000, &err));
+    // 300 elements so both ring chunks are real; element 7 is NaN on rank 0.
+    std::vector<uint16_t> data(300, f32_to_bf16_bits(1.5f));
+    if (rank == 0) data[7] = 0x7FC1;  // NaN
+    EXPECT_TRUE(group.allreduce(data.data(), data.size(), DType::kBF16, Reduce::kSum,
+                                10000, &err));
+    results[rank] = data;
+    group.shutdown();
+  });
+  EXPECT_TRUE(std::isnan(bf16_bits_to_f32(results[0][7])));
+  EXPECT_TRUE(std::isnan(bf16_bits_to_f32(results[1][7])));
+  EXPECT_TRUE(std::abs(bf16_bits_to_f32(results[0][8]) - 3.0f) < 0.05f);
+}
+
+TPUFT_TEST(configure_times_out_when_peer_missing) {
+  StoreServer store;
+  store.start();
+  CollectiveGroup group;
+  std::string err;
+  Instant start = Clock::now();
+  // world_size=2 but rank 1 never shows up: both the dial path (rank 1
+  // missing from store) and the accept path must respect the deadline.
+  EXPECT_FALSE(group.configure(store.address(), "lonely", 0, 2, 500, &err));
+  EXPECT_TRUE(ms_between(start, Clock::now()) < 5000);
+  store.shutdown();
+}
+
+TPUFT_TEST(shutdown_aborts_blocked_collective) {
+  const int n = 2;
+  run_group(n, "abort", [&](const std::string& store_addr, int rank) {
+    CollectiveGroup group;
+    std::string err;
+    EXPECT_TRUE(group.configure(store_addr, "t3", rank, n, 10000, &err));
+    if (rank == 0) {
+      // Blocks: rank 1 never participates. Another thread aborts us.
+      std::thread aborter([&] {
+        std::this_thread::sleep_for(DurationMs(300));
+        group.shutdown();
+      });
+      std::vector<float> data(1 << 20, 1.0f);
+      std::string op_err;
+      Instant start = Clock::now();
+      bool ok = group.allreduce(data.data(), data.size(), DType::kF32, Reduce::kSum,
+                                30000, &op_err);
+      EXPECT_FALSE(ok);
+      EXPECT_TRUE(ms_between(start, Clock::now()) < 10000);
+      aborter.join();
+    } else {
+      std::this_thread::sleep_for(DurationMs(1000));
+      group.shutdown();
+    }
+  });
+}
+
+TPUFT_TEST(alltoall_and_allgather) {
+  const int n = 3;
+  std::vector<std::vector<int64_t>> a2a(n), ag(n);
+  run_group(n, "a2a", [&](const std::string& store_addr, int rank) {
+    CollectiveGroup group;
+    std::string err;
+    EXPECT_TRUE(group.configure(store_addr, "t4", rank, n, 10000, &err));
+    std::vector<int64_t> input(n * 4);
+    for (int peer = 0; peer < n; ++peer) {
+      for (int j = 0; j < 4; ++j) input[peer * 4 + j] = rank * 100 + peer * 10 + j;
+    }
+    std::vector<int64_t> out(n * 4);
+    EXPECT_TRUE(group.alltoall(input.data(), out.data(), 4, DType::kI64, 10000, &err));
+    a2a[rank] = out;
+
+    std::vector<int64_t> mine(2, rank * 7);
+    std::vector<int64_t> gathered(n * 2);
+    EXPECT_TRUE(group.allgather(mine.data(), gathered.data(), 2, DType::kI64, 10000, &err));
+    ag[rank] = gathered;
+    group.shutdown();
+  });
+  for (int rank = 0; rank < n; ++rank) {
+    for (int peer = 0; peer < n; ++peer) {
+      for (int j = 0; j < 4; ++j) {
+        EXPECT_EQ(a2a[rank][peer * 4 + j], peer * 100 + rank * 10 + j);
+      }
+    }
+    for (int peer = 0; peer < n; ++peer) {
+      EXPECT_EQ(ag[rank][peer * 2], peer * 7);
+    }
+  }
+}
+
+TPUFT_TEST_MAIN()

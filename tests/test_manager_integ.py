@@ -234,3 +234,63 @@ def test_quorum_and_commit_timeout_paths_are_fast(lighthouse) -> None:
     finally:
         manager.shutdown(wait=False)
         store.shutdown()
+
+
+def test_ddp_fp8_gradient_sync_two_groups(lighthouse) -> None:
+    """fp8 device-quantized DDP gradient sync: converges across groups within
+    quantization tolerance and stays bitwise identical between replicas."""
+    import threading
+
+    from torchft_tpu.ddp import ft_allreduce_gradients
+    from torchft_tpu.manager import Manager
+    from torchft_tpu.parallel.native_pg import ProcessGroupNative
+    from torchft_tpu.parallel.store import StoreClient, StoreServer
+
+    results = {}
+    errors = {}
+
+    def group(idx: int) -> None:
+        store = StoreServer()
+        pg = ProcessGroupNative(timeout=10.0)
+        manager = Manager(
+            pg=pg,
+            min_replica_size=1,
+            store=StoreClient(store.address()),
+            store_addr=store.address(),
+            group_rank=0,
+            lighthouse_addr=lighthouse.address(),
+            replica_id=f"fp8ddp_{idx}",
+            heartbeat_interval=0.05,
+            timeout=10.0,
+            quorum_timeout=20.0,
+            init_sync=False,
+        )
+        import jax.numpy as jnp
+
+        try:
+            grads = {"w": jnp.full((512,), float(idx + 1), jnp.float32),
+                     "b": jnp.full((64,), -2.0 * (idx + 1), jnp.float32)}
+            manager.start_quorum()
+            avg = ft_allreduce_gradients(manager, grads, should_quantize=True)
+            assert manager.should_commit()
+            results[idx] = jax.tree_util.tree_map(np.asarray, avg)
+        except BaseException as e:  # noqa: BLE001 — surfaced by the assert below
+            errors[idx] = e
+        finally:
+            manager.shutdown(wait=False)
+            pg.shutdown()
+            store.shutdown()
+
+    threads = [threading.Thread(target=group, args=(i,)) for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    assert not any(t.is_alive() for t in threads), "replica group thread hung"
+    assert not errors, f"replica group failed: {errors}"
+    assert set(results) == {0, 1}
+    # Average of 1s and 2s = 1.5; of -2s and -4s = -3 (fp8 exact for these).
+    np.testing.assert_allclose(results[0]["w"], np.full(512, 1.5), rtol=0.05)
+    np.testing.assert_allclose(results[0]["b"], np.full(64, -3.0), rtol=0.05)
+    for key in results[0]:
+        assert results[0][key].tobytes() == results[1][key].tobytes()

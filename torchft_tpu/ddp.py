@@ -38,8 +38,15 @@ def ft_allreduce_gradients(
 ) -> Any:
     """Averages a gradient pytree across replica groups; returns jax arrays
     on the devices of the inputs. On error the step is poisoned (the commit
-    will fail) and the *local* gradients come back — callers never branch."""
-    work = manager.allreduce_pytree(grads, should_quantize=should_quantize)
+    will fail) and the *local* gradients come back — callers never branch.
+
+    With ``should_quantize``, gradients are fp8-quantized **on device**
+    (Pallas on TPU) so only payload + block scales cross the host boundary
+    (~4x less traffic than f32) and dequantization happens on device too.
+    """
+    if should_quantize:
+        return _ft_allreduce_gradients_fp8(manager, grads)
+    work = manager.allreduce_pytree(grads)
     averaged = work.wait()
 
     def restore(avg_leaf: Any, orig_leaf: Any) -> Any:
@@ -48,6 +55,40 @@ def ft_allreduce_gradients(
         return avg_leaf
 
     return jax.tree_util.tree_map(restore, averaged, grads)
+
+
+# One jitted (quantize, dequantize) codec per gradient pytree structure.
+_FP8_CODECS: dict = {}
+
+
+def _ft_allreduce_gradients_fp8(manager: Manager, grads: Any) -> Any:
+    import jax.numpy as jnp
+
+    from torchft_tpu.ops.quantization import make_tree_fp8_codec
+
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    key = (treedef, tuple((leaf.shape, str(leaf.dtype)) for leaf in leaves))
+    codec = _FP8_CODECS.get(key)
+    if codec is None:
+        codec = make_tree_fp8_codec(leaves)
+        _FP8_CODECS[key] = codec
+    quantize, dequantize = codec
+
+    payload, scales = quantize(leaves)
+    result = manager.allreduce_prequantized(payload, scales).wait()
+    if result is None:
+        # Allreduce failed (error already reported; the step will not
+        # commit): hand back the local gradients, same contract as above.
+        return grads
+    avg_payload, avg_scales = result
+    averaged = dequantize(jnp.asarray(avg_payload), jnp.asarray(avg_scales))
+    # Restore the inputs' shardings/devices (contract: outputs live where
+    # the inputs lived, so the jitted optimizer update never retraces).
+    averaged = [
+        jax.device_put(avg, leaf.sharding) if isinstance(leaf, jax.Array) else avg
+        for avg, leaf in zip(averaged, leaves)
+    ]
+    return jax.tree_util.tree_unflatten(treedef, averaged)
 
 
 class DistributedDataParallel:

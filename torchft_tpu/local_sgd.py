@@ -173,22 +173,18 @@ class _Fragment:
             self._build_device_pipeline()
 
     def _build_device_pipeline(self) -> None:
-        """Jitted device kernels for the quantized path."""
+        """Jitted device kernels for the quantized path (shared fp8 codec)."""
         import jax.numpy as jnp
 
-        from torchft_tpu.ops.quantization import (
-            dequantize_blocks_device,
-            quantize_blocks_device,
-        )
+        from torchft_tpu.ops.quantization import make_tree_fp8_codec
 
-        sizes = [int(np.prod(b.shape)) for b in self.backup]
-        shapes = [tuple(b.shape) for b in self.backup]
-        dtypes = [b.dtype for b in self.backup]
-        total = sum(sizes)
+        _, dequantize = make_tree_fp8_codec(self.backup)
         outer_tx = self._outer_tx
         alpha = self._alpha
 
         def quantize_pseudograd(backup_leaves, local_leaves):
+            from torchft_tpu.ops.quantization import quantize_blocks_device
+
             flat = jnp.concatenate(
                 [
                     (b.astype(jnp.float32) - l.astype(jnp.float32)).reshape(-1)
@@ -200,12 +196,7 @@ class _Fragment:
         def apply_outer(payload, scales, backup_leaves, local_leaves, outer_state):
             import optax
 
-            flat = dequantize_blocks_device(payload, scales)[:total]
-            offsets = np.cumsum([0] + sizes)
-            avg_pg = [
-                flat[offsets[i] : offsets[i + 1]].reshape(shapes[i]).astype(dtypes[i])
-                for i in range(len(sizes))
-            ]
+            avg_pg = dequantize(payload, scales)
             updates, new_state = outer_tx.update(avg_pg, outer_state, backup_leaves)
             new_backup = optax.apply_updates(backup_leaves, updates)
             merged = [

@@ -202,3 +202,40 @@ def dequantize_blocks_device(payload, scales):
     else:
         out = payload.astype(jnp.float32) * scales[:, None]
     return out.reshape(-1)
+
+
+def make_tree_fp8_codec(leaves):
+    """Builds a jitted (quantize, dequantize) pair for a fixed list of float
+    array leaves: quantize concatenates the leaves and emits (payload,
+    scales); dequantize inverts back to per-leaf arrays with the original
+    shapes/dtypes. Shared by the DDP and DiLoCo fp8 device pipelines."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    for leaf in leaves:
+        if np.dtype(leaf.dtype).kind not in ("f", "V"):
+            raise TypeError(
+                f"fp8 quantized sync requires float leaves, got {leaf.dtype}; "
+                "use the unquantized path for integer state"
+            )
+    sizes = [int(np.prod(leaf.shape)) for leaf in leaves]
+    shapes = [tuple(leaf.shape) for leaf in leaves]
+    dtypes = [leaf.dtype for leaf in leaves]
+    total = sum(sizes)
+    offsets = np.cumsum([0] + sizes)
+
+    def quantize(leaves_in):
+        flat = jnp.concatenate(
+            [leaf.astype(jnp.float32).reshape(-1) for leaf in leaves_in]
+        )
+        return quantize_blocks_device(flat)
+
+    def dequantize(payload, scales):
+        flat = dequantize_blocks_device(payload, scales)[:total]
+        return [
+            flat[offsets[i] : offsets[i + 1]].reshape(shapes[i]).astype(dtypes[i])
+            for i in range(len(sizes))
+        ]
+
+    return jax.jit(quantize), jax.jit(dequantize)
