@@ -217,3 +217,15 @@ def test_launch_gives_up_after_max_restarts(tmp_path) -> None:
         max_restarts=1,
     )
     assert code == 1
+
+
+def test_coordination_public_api_documented() -> None:
+    """coordination_test.py parity: the public coordination surface carries
+    docstrings (it is the 'low level API' users script against)."""
+    import inspect
+
+    from torchft_tpu import coordination
+
+    for name in coordination.__all__:
+        obj = getattr(coordination, name)
+        assert inspect.getdoc(obj), f"{name} lacks a docstring"

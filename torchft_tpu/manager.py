@@ -36,10 +36,8 @@ import concurrent.futures
 import logging
 import os
 import socket
-import threading
 import traceback
 import uuid
-from datetime import timedelta
 from enum import Enum
 from typing import Any, Callable, Dict, List, Optional, TypeVar, cast
 
