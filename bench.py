@@ -54,11 +54,6 @@ def _ensure_live_backend() -> None:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-        # Shrink the workload so the degraded run still finishes quickly on
-        # a 1-core host (numbers are marked by the much lower plain baseline).
-        globals()["STEPS"] = min(STEPS, 6)
-        globals()["BATCH"] = 2
-        globals()["SEQ"] = 128
         globals()["DEGRADED"] = True
 
 STEPS = int(os.environ.get("TPUFT_BENCH_STEPS", "20"))
@@ -76,7 +71,14 @@ def main() -> None:
 
     from torchft_tpu.models.llama import Llama, LlamaConfig, cross_entropy_loss
 
+    global STEPS, BATCH, SEQ
     if DEGRADED:
+        # One place for every degraded knob: shrink the workload so the CPU
+        # fallback finishes quickly (explicit env overrides are superseded —
+        # the run is marked degraded_cpu_fallback in the output).
+        STEPS = min(STEPS, 6)
+        BATCH = 2
+        SEQ = 128
         config = LlamaConfig(
             vocab_size=2048, dim=128, n_layers=2, n_heads=4, n_kv_heads=2,
             ffn_hidden=256, max_seq_len=SEQ, dtype=jnp.float32,
@@ -180,6 +182,12 @@ def main() -> None:
     # train_diloco.py:195-204). Inner steps run at device speed; the
     # cross-replica pseudogradient sync amortizes over sync_every steps.
     sync_every = min(int(os.environ.get("TPUFT_BENCH_SYNC_EVERY", "20")), sync_every_cap)
+    # Delay must leave room inside the per-fragment cycle; only auto-clamp
+    # when degraded shrinking changed the cycle, otherwise surface the
+    # configuration error loudly.
+    fragment_sync_delay = int(os.environ.get("TPUFT_BENCH_SYNC_DELAY", "5"))
+    if DEGRADED:
+        fragment_sync_delay = min(fragment_sync_delay, max(sync_every // 2 - 1, 0))
     manager, handles = make_manager(use_async_quorum=False)
     algo = DiLoCo(
         manager,
@@ -189,11 +197,7 @@ def main() -> None:
         sync_every=sync_every,
         n_fragments=2,
         should_quantize=True,
-        # Delay must leave room inside the per-fragment cycle.
-        fragment_sync_delay=min(
-            int(os.environ.get("TPUFT_BENCH_SYNC_DELAY", "5")),
-            max(sync_every // 2 - 1, 0),
-        ),
+        fragment_sync_delay=fragment_sync_delay,
     )
     try:
         for step in range(sync_every):  # one full warmup cycle incl. sync
@@ -243,6 +247,8 @@ def main() -> None:
                 "plain_tokens_per_sec": round(plain_tps, 1),
                 "ft_ddp_tokens_per_sec": round(ddp_tps, 1),
                 "degraded_cpu_fallback": DEGRADED,
+                "sync_every": sync_every,
+                "fragment_sync_delay": fragment_sync_delay,
             }
         )
     )

@@ -365,3 +365,24 @@ def test_store_add_shares_keyspace_with_get(store_server) -> None:
     assert client.add("ready") == 42
     client.close()
     waiter.close()
+
+
+def test_managed_pg_routes_through_manager() -> None:
+    """ManagedProcessGroup parity (reference :1233-1266): allreduce gets
+    manager semantics; size() reports live participants."""
+    from unittest.mock import MagicMock
+
+    from torchft_tpu.parallel.process_group import ManagedProcessGroup
+
+    manager = MagicMock()
+    manager._pg = ProcessGroupDummy()
+    manager.num_participants.return_value = 3
+    from torchft_tpu.work import _DummyWork
+
+    manager.allreduce.return_value = _DummyWork([np.ones(2)])
+    pg = ManagedProcessGroup(manager)
+    out = pg.allreduce([np.ones(2)]).wait()
+    manager.allreduce.assert_called_once()
+    np.testing.assert_array_equal(out[0], np.ones(2))
+    assert pg.size() == 3
+    assert pg.getBackendName() == "tpuft-managed"
