@@ -229,3 +229,11 @@ def test_coordination_public_api_documented() -> None:
     for name in coordination.__all__:
         obj = getattr(coordination, name)
         assert inspect.getdoc(obj), f"{name} lacks a docstring"
+
+
+def test_sampler_state_roundtrip() -> None:
+    sampler = DistributedSampler(50, 0, 2, shuffle=True, seed=9)
+    sampler.set_epoch(4)
+    fresh = DistributedSampler(50, 0, 2, shuffle=True, seed=0)
+    fresh.load_state_dict(sampler.state_dict())
+    assert list(fresh) == list(sampler)

@@ -294,3 +294,103 @@ def test_ddp_fp8_gradient_sync_two_groups(lighthouse) -> None:
     np.testing.assert_allclose(results[0]["b"], np.full(64, -3.0), rtol=0.05)
     for key in results[0]:
         assert results[0][key].tobytes() == results[1][key].tobytes()
+
+
+def _make_solo_manager(lighthouse, replica_id: str):
+    """A world-size-1 Manager on a dummy PG with its own store (shared
+    boilerplate for the coordination-focused integ tests)."""
+    from torchft_tpu.manager import Manager
+    from torchft_tpu.parallel.process_group import ProcessGroupDummy
+    from torchft_tpu.parallel.store import StoreClient, StoreServer
+
+    store = StoreServer()
+    manager = Manager(
+        pg=ProcessGroupDummy(),
+        min_replica_size=1,
+        store=StoreClient(store.address()),
+        store_addr=store.address(),
+        group_rank=0,
+        lighthouse_addr=lighthouse.address(),
+        replica_id=replica_id,
+        heartbeat_interval=0.05,
+        timeout=5.0,
+        quorum_timeout=10.0,
+        init_sync=False,
+    )
+    manager.register_state_dict_fn("s", lambda s: None, lambda: {"x": 1})
+    return manager, store
+
+
+def test_shrink_only_quorum_blocks_new_joiner(lighthouse) -> None:
+    """shrink_only end to end: an established group requesting shrink-only
+    quorums keeps a new joiner out until it stops shrinking (reference
+    lighthouse.rs:195-200 behavior through the whole stack)."""
+    import threading
+    import time as _time
+
+    from torchft_tpu.coordination import LighthouseClient
+
+    mgr_a, store_a = _make_solo_manager(lighthouse, "shrink_0")
+    mgr_b = store_b = None
+    joiner_result = {}
+
+    try:
+        # Establish a prev quorum containing only A.
+        mgr_a.start_quorum()
+        mgr_a.wait_quorum()
+        assert mgr_a.num_participants() == 1
+
+        # B tries to join while A requests shrink-only quorums.
+        mgr_b, store_b = _make_solo_manager(lighthouse, "shrink_1")
+
+        def joiner() -> None:
+            try:
+                mgr_b.start_quorum()
+                mgr_b.wait_quorum()
+                joiner_result["participants"] = mgr_b.num_participants()
+            except Exception as e:  # noqa: BLE001
+                joiner_result["error"] = e
+
+        t = threading.Thread(target=joiner)
+        t.start()
+
+        # Gate on OBSERVED state, not thread timing: wait until the
+        # lighthouse reports B as a pending (joining) participant.
+        client = LighthouseClient(lighthouse.address())
+        deadline = _time.monotonic() + 30
+        while _time.monotonic() < deadline:
+            status = client.status()
+            joining = [
+                m.member.replica_id for m in status.members if m.joining
+            ]
+            if any(rid.startswith("shrink_1") for rid in joining):
+                break
+            _time.sleep(0.05)
+        else:
+            raise AssertionError("joiner never registered at the lighthouse")
+
+        for _ in range(3):
+            mgr_a.start_quorum(shrink_only=True)
+            mgr_a.wait_quorum()
+            # Shrink-only quorums never admit B.
+            assert mgr_a.num_participants() == 1
+            _time.sleep(0.1)
+
+        # A relaxes: the next normal quorum admits B and unparks it.
+        deadline = _time.monotonic() + 30
+        while "participants" not in joiner_result and "error" not in joiner_result:
+            mgr_a.start_quorum(shrink_only=False)
+            mgr_a.wait_quorum()
+            if _time.monotonic() > deadline:
+                break
+            _time.sleep(0.1)
+        t.join(timeout=30)
+        client.close()
+        assert joiner_result.get("participants") == 2, joiner_result
+    finally:
+        if mgr_b is not None:
+            mgr_b.shutdown(wait=False)
+        if store_b is not None:
+            store_b.shutdown()
+        mgr_a.shutdown(wait=False)
+        store_a.shutdown()

@@ -66,6 +66,17 @@ class DistributedSampler:
         shard = order[self.global_rank :: self.global_world_size][: self.num_samples]
         return iter(shard.tolist())
 
+    def state_dict(self) -> dict:
+        """Per-replica loader state for user checkpoints (the reference
+        delegates this to torchdata's StatefulDataLoader; position within an
+        epoch is intentionally not tracked — resume restarts the epoch,
+        consistent with the documented lossiness under membership change)."""
+        return {"epoch": self.epoch, "seed": self.seed}
+
+    def load_state_dict(self, state: dict) -> None:
+        self.epoch = int(state["epoch"])
+        self.seed = int(state["seed"])
+
     def batches(self) -> Iterator[np.ndarray]:
         """Yields index batches of ``batch_size`` (requires batch_size)."""
         assert self.batch_size is not None, "batch_size not set"
