@@ -237,3 +237,62 @@ def test_sampler_state_roundtrip() -> None:
     fresh = DistributedSampler(50, 0, 2, shuffle=True, seed=0)
     fresh.load_state_dict(sampler.state_dict())
     assert list(fresh) == list(sampler)
+
+
+def test_bootstrap_multi_rank_group() -> None:
+    """bootstrap.init_manager wires the group store for both rank 0 (binds a
+    server) and rank 1 (waits for + connects to it). Explicit args, no
+    os.environ mutation (threads share the environment)."""
+    import socket
+    import threading
+    import time as _time
+
+    from torchft_tpu.bootstrap import init_manager
+    from torchft_tpu.coordination import LighthouseServer
+    from torchft_tpu.parallel.process_group import ProcessGroupDummy
+
+    lighthouse = LighthouseServer(min_replicas=1, join_timeout_ms=200)
+    results = {}
+    # Reserve an ephemeral port for the group store.
+    probe = socket.socket()
+    probe.bind(("", 0))
+    store_port = probe.getsockname()[1]
+    probe.close()
+    store_addr = f"localhost:{store_port}"
+
+    def rank_main(rank: int) -> None:
+        try:
+            manager, server = init_manager(
+                ProcessGroupDummy(),
+                min_replica_size=1,
+                group_rank=rank,
+                group_world_size=2,
+                store_addr=store_addr,
+                lighthouse_addr=lighthouse.address(),
+                heartbeat_interval=0.05,
+                timeout=5.0,
+                quorum_timeout=10.0,
+                init_sync=False,
+            )
+            manager.register_state_dict_fn("s", lambda s: None, lambda: {"x": 1})
+            manager.start_quorum()
+            manager.wait_quorum()
+            results[rank] = manager.num_participants()
+            manager.shutdown(wait=False)
+            if server is not None:
+                server.shutdown()
+        except Exception as e:  # noqa: BLE001
+            results[rank] = e
+
+    try:
+        t0 = threading.Thread(target=rank_main, args=(0,))
+        t1 = threading.Thread(target=rank_main, args=(1,))
+        # Rank 1 starts immediately: _wait_for_store gates it on rank 0's
+        # bind (observable state, not timing).
+        t0.start()
+        t1.start()
+        t0.join(30)
+        t1.join(30)
+        assert results.get(0) == 1 and results.get(1) == 1, results
+    finally:
+        lighthouse.shutdown()

@@ -1,0 +1,112 @@
+"""Environment-driven Manager bootstrap.
+
+The reference rides torchelastic/torchrun for process bootstrap (MASTER_ADDR,
+RANK, WORLD_SIZE + its TCPStore); this module is the tpuft equivalent:
+:func:`init_manager` reads the topology env set by ``torchft_tpu.launch``
+(or by hand) and wires the rendezvous store correctly for both single-host
+and multi-host replica groups:
+
+  REPLICA_GROUP_ID       this group's id (informational / replica_id prefix)
+  GROUP_RANK             this process's rank within the group (default 0)
+  GROUP_WORLD_SIZE       processes per group (default 1)
+  TPUFT_LIGHTHOUSE       lighthouse address (rank 0 needs it)
+  TPUFT_STORE_ADDR       group store "host:port". Rank 0 binds a StoreServer
+                         here (or an ephemeral port when unset); other ranks
+                         connect to it.
+
+Usage::
+
+    pg = ProcessGroupNative()
+    manager, store_server = init_manager(pg, min_replica_size=1)
+    ...
+    manager.shutdown(); (store_server and store_server.shutdown())
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional, Tuple
+
+from torchft_tpu.manager import Manager
+from torchft_tpu.parallel.process_group import ProcessGroup
+from torchft_tpu.parallel.store import StoreClient, StoreServer
+
+__all__ = ["init_manager"]
+
+
+def _wait_for_store(store_addr: str, timeout: float) -> None:
+    """Polls until rank 0's store accepts connections: ranks launch
+    concurrently, so a non-zero rank routinely dials before rank 0 binds."""
+    import socket
+    import time
+
+    host, _, port = store_addr.rpartition(":")
+    host = host.strip("[]") or "localhost"
+    deadline = time.monotonic() + timeout
+    last_error: Optional[Exception] = None
+    while time.monotonic() < deadline:
+        try:
+            with socket.create_connection((host, int(port)), timeout=2.0):
+                return
+        except OSError as e:
+            last_error = e
+            time.sleep(0.2)
+    raise TimeoutError(
+        f"group store at {store_addr} not reachable within {timeout}s: {last_error}"
+    )
+
+
+def init_manager(
+    pg: ProcessGroup,
+    min_replica_size: int,
+    replica_id: Optional[str] = None,
+    group_rank: Optional[int] = None,
+    group_world_size: Optional[int] = None,
+    store_addr: Optional[str] = None,
+    **manager_kwargs: Any,
+) -> Tuple[Manager, Optional[StoreServer]]:
+    """Builds the group store per topology (explicit args override the env)
+    and returns (manager, store_server-or-None). The caller owns both
+    lifecycles; only group rank 0 gets a server instance."""
+    group_rank = (
+        group_rank if group_rank is not None else int(os.environ.get("GROUP_RANK", "0"))
+    )
+    group_world_size = (
+        group_world_size
+        if group_world_size is not None
+        else int(os.environ.get("GROUP_WORLD_SIZE", "1"))
+    )
+    group_id = os.environ.get("REPLICA_GROUP_ID", "0")
+    store_addr = store_addr or os.environ.get("TPUFT_STORE_ADDR")
+
+    store_server: Optional[StoreServer] = None
+    if group_rank == 0:
+        bind = "[::]:0"
+        if store_addr:
+            _, _, port = store_addr.rpartition(":")
+            bind = f"[::]:{port}"
+        store_server = StoreServer(bind)
+        # Advertise the operator-provided address when given: gethostname()
+        # may not be routable across hosts, which is exactly why the
+        # operator would pin TPUFT_STORE_ADDR to an IP.
+        advertised = store_addr if store_addr else store_server.address()
+    else:
+        if not store_addr:
+            raise ValueError(
+                "GROUP_RANK != 0 requires TPUFT_STORE_ADDR (or store_addr=) "
+                "pointing at group rank 0's store"
+            )
+        advertised = store_addr
+        _wait_for_store(advertised, timeout=float(manager_kwargs.get("connect_timeout", 60.0)))
+
+    manager = Manager(
+        pg=pg,
+        min_replica_size=min_replica_size,
+        store=StoreClient(advertised),
+        store_addr=advertised,
+        group_rank=group_rank,
+        group_world_size=group_world_size,
+        replica_id=replica_id or f"group_{group_id}",
+        **manager_kwargs,
+    )
+    return manager, store_server

@@ -37,9 +37,15 @@ def supervise(
     relaunch_interval: float = 10.0,
     max_restarts: int = 100,
     extra_env: Optional[Dict[str, str]] = None,
+    group_world_size: int = 1,
+    store_port_base: int = 29600,
 ) -> int:
-    """Runs ``command`` once per replica group, relaunching dead groups.
-    Returns 0 when every group has exited cleanly."""
+    """Runs ``command`` for each (group, rank) cell, relaunching dead
+    groups. With ``group_world_size > 1`` every rank of a group shares
+    GROUP_WORLD_SIZE/TPUFT_STORE_ADDR (group rank 0 binds the store on
+    ``store_port_base + group``); a death of any rank restarts the whole
+    group, matching the per-group restart unit of the reference's
+    torchelastic deployment. Returns 0 when every group exits cleanly."""
     own_lighthouse: Optional[LighthouseServer] = None
     if lighthouse_addr is None:
         own_lighthouse = LighthouseServer(
@@ -48,55 +54,87 @@ def supervise(
         lighthouse_addr = own_lighthouse.address()
         print(f"[launch] embedded lighthouse at {lighthouse_addr}", flush=True)
 
-    def spawn(group: int) -> subprocess.Popen:
-        env = {
-            **os.environ,
-            **(extra_env or {}),
-            "REPLICA_GROUP_ID": str(group),
-            "NUM_REPLICA_GROUPS": str(num_replica_groups),
-            "TPUFT_LIGHTHOUSE": lighthouse_addr,
-        }
-        print(f"[launch] starting replica group {group}: {' '.join(command)}", flush=True)
-        return subprocess.Popen(command, env=env)
+    import socket as _socket
 
-    procs = {g: spawn(g) for g in range(num_replica_groups)}
+    hostname = _socket.gethostname()
+
+    def spawn_group(group: int) -> List[subprocess.Popen]:
+        procs = []
+        store_addr = f"{hostname}:{store_port_base + group}"
+        for rank in range(group_world_size):
+            env = {
+                **os.environ,
+                **(extra_env or {}),
+                "REPLICA_GROUP_ID": str(group),
+                "NUM_REPLICA_GROUPS": str(num_replica_groups),
+                "GROUP_RANK": str(rank),
+                "GROUP_WORLD_SIZE": str(group_world_size),
+                "TPUFT_LIGHTHOUSE": lighthouse_addr,
+            }
+            if group_world_size > 1:
+                env["TPUFT_STORE_ADDR"] = store_addr
+            print(
+                f"[launch] starting group {group} rank {rank}: {' '.join(command)}",
+                flush=True,
+            )
+            procs.append(subprocess.Popen(command, env=env))
+        return procs
+
+    groups = {g: spawn_group(g) for g in range(num_replica_groups)}
     restarts = {g: 0 for g in range(num_replica_groups)}
     done: Dict[int, int] = {}
     try:
         while len(done) < num_replica_groups:
             time.sleep(min(relaunch_interval, 1.0))
-            for group, proc in list(procs.items()):
+            for group, procs in list(groups.items()):
                 if group in done:
                     continue
-                code = proc.poll()
-                if code is None:
-                    continue
-                if code == 0:
+                codes = [p.poll() for p in procs]
+                if all(code == 0 for code in codes):
                     print(f"[launch] group {group} finished", flush=True)
                     done[group] = 0
-                elif restarts[group] < max_restarts:
+                    continue
+                failed = [code for code in codes if code not in (None, 0)]
+                if not failed:
+                    continue
+                # Any dead rank restarts the whole group. Shared deadline so
+                # a wedged multi-rank group can't stall supervision of the
+                # others; after SIGKILL, reap each child so its sockets (the
+                # fixed store port) are released before the respawn.
+                for p in procs:
+                    if p.poll() is None:
+                        p.send_signal(signal.SIGTERM)
+                term_deadline = time.monotonic() + 5
+                for p in procs:
+                    try:
+                        p.wait(timeout=max(0.1, term_deadline - time.monotonic()))
+                    except subprocess.TimeoutExpired:
+                        p.kill()
+                        p.wait()
+                if restarts[group] < max_restarts:
                     restarts[group] += 1
                     print(
-                        f"[launch] group {group} died (exit {code}); "
+                        f"[launch] group {group} died (exit {failed[0]}); "
                         f"relaunch {restarts[group]}/{max_restarts} "
                         f"in {relaunch_interval}s",
                         flush=True,
                     )
                     time.sleep(relaunch_interval)
-                    procs[group] = spawn(group)
+                    groups[group] = spawn_group(group)
                 else:
                     print(
-                        f"[launch] group {group} exhausted restarts (exit {code})",
+                        f"[launch] group {group} exhausted restarts (exit {failed[0]})",
                         flush=True,
                     )
-                    done[group] = code
+                    done[group] = failed[0]
         return 0 if all(code == 0 for code in done.values()) else 1
     finally:
-        for proc in procs.values():
+        all_procs = [p for procs in groups.values() for p in procs]
+        for proc in all_procs:
             if proc.poll() is None:
                 proc.send_signal(signal.SIGTERM)
         deadline = time.monotonic() + 5
-        for proc in procs.values():
+        for proc in all_procs:
             try:
                 proc.wait(timeout=max(0.1, deadline - time.monotonic()))
             except subprocess.TimeoutExpired:
@@ -111,6 +149,8 @@ def main() -> None:
     parser.add_argument("--lighthouse", default=os.environ.get("TPUFT_LIGHTHOUSE"))
     parser.add_argument("--relaunch-interval", type=float, default=10.0)
     parser.add_argument("--max-restarts", type=int, default=100)
+    parser.add_argument("--group-world-size", type=int, default=1)
+    parser.add_argument("--store-port-base", type=int, default=29600)
     parser.add_argument("command", nargs=argparse.REMAINDER, help="-- cmd args...")
     args = parser.parse_args()
     command = args.command
@@ -125,6 +165,8 @@ def main() -> None:
             lighthouse_addr=args.lighthouse,
             relaunch_interval=args.relaunch_interval,
             max_restarts=args.max_restarts,
+            group_world_size=args.group_world_size,
+            store_port_base=args.store_port_base,
         )
     )
 
