@@ -33,30 +33,29 @@ sys.path.insert(0, REPO_ROOT)
 
 def train(args: argparse.Namespace) -> None:
     import jax
+
+    from torchft_tpu.utils.platform import honor_jax_platforms_env
+
+    honor_jax_platforms_env()
     import jax.numpy as jnp
     import optax
 
+    from torchft_tpu.bootstrap import init_manager
     from torchft_tpu.data import DistributedSampler
     from torchft_tpu.ddp import ft_allreduce_gradients
-    from torchft_tpu.manager import Manager
     from torchft_tpu.models.simple import DemoCNN
     from torchft_tpu.optim import Optimizer
     from torchft_tpu.parallel.native_pg import ProcessGroupNative
-    from torchft_tpu.parallel.store import StoreClient, StoreServer
 
     group_id = int(os.environ.get("REPLICA_GROUP_ID", args.replica_group_id))
-    store = StoreServer()
-    store_client = StoreClient(store.address())
 
     model = DemoCNN(padding_mb=args.padding_mb)
     params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))
 
     pg = ProcessGroupNative(timeout=args.timeout)
-    manager = Manager(
-        pg=pg,
+    manager, store = init_manager(
+        pg,
         min_replica_size=args.min_replica_size,
-        store=store_client,
-        store_addr=store.address(),
         replica_id=f"train_ddp_{group_id}",
         timeout=args.timeout,
         quorum_timeout=args.quorum_timeout,
@@ -125,7 +124,8 @@ def train(args: argparse.Namespace) -> None:
     finally:
         manager.shutdown(wait=False)
         pg.shutdown()
-        store.shutdown()
+        if store is not None:
+            store.shutdown()
 
 
 def demo(args: argparse.Namespace) -> None:

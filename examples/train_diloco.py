@@ -25,29 +25,28 @@ sys.path.insert(0, REPO_ROOT)
 
 def train(args: argparse.Namespace) -> None:
     import jax
+
+    from torchft_tpu.utils.platform import honor_jax_platforms_env
+
+    honor_jax_platforms_env()
     import jax.numpy as jnp
     import numpy as np
     import optax
 
+    from torchft_tpu.bootstrap import init_manager
     from torchft_tpu.local_sgd import DiLoCo
-    from torchft_tpu.manager import Manager
     from torchft_tpu.models.simple import DemoMLP
     from torchft_tpu.parallel.native_pg import ProcessGroupNative
-    from torchft_tpu.parallel.store import StoreClient, StoreServer
 
     group_id = int(os.environ.get("REPLICA_GROUP_ID", "0"))
-    store = StoreServer()
-    store_client = StoreClient(store.address())
 
     model = DemoMLP(hidden=args.hidden)
     params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 64)))
 
     pg = ProcessGroupNative(timeout=args.timeout)
-    manager = Manager(
-        pg=pg,
+    manager, store = init_manager(
+        pg,
         min_replica_size=1,
-        store=store_client,
-        store_addr=store.address(),
         replica_id=f"train_diloco_{group_id}",
         use_async_quorum=False,  # DiLoCo requires sync quorum
         timeout=args.timeout,
@@ -102,7 +101,8 @@ def train(args: argparse.Namespace) -> None:
     finally:
         manager.shutdown(wait=False)
         pg.shutdown()
-        store.shutdown()
+        if store is not None:
+            store.shutdown()
 
 
 def demo(args: argparse.Namespace) -> None:

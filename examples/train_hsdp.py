@@ -38,7 +38,7 @@ def train(args: argparse.Namespace) -> None:
     import numpy as np
     import optax
 
-    from torchft_tpu.manager import Manager
+    from torchft_tpu.bootstrap import init_manager
     from torchft_tpu.models.llama import (
         CONFIGS,
         Llama,
@@ -49,16 +49,12 @@ def train(args: argparse.Namespace) -> None:
     from torchft_tpu.optim import Optimizer
     from torchft_tpu.parallel.mesh import ft_allreduce_sharded, ft_init_device_mesh
     from torchft_tpu.parallel.native_pg import ProcessGroupNative
-    from torchft_tpu.parallel.store import StoreClient, StoreServer
 
     group_id = int(os.environ.get("REPLICA_GROUP_ID", "0"))
-    store = StoreServer()
     pg = ProcessGroupNative(timeout=args.timeout)
-    manager = Manager(
-        pg=pg,
+    manager, store = init_manager(
+        pg,
         min_replica_size=1,
-        store=StoreClient(store.address()),
-        store_addr=store.address(),
         replica_id=f"train_hsdp_{group_id}",
         timeout=args.timeout,
         quorum_timeout=args.quorum_timeout,
@@ -119,7 +115,8 @@ def train(args: argparse.Namespace) -> None:
     finally:
         manager.shutdown(wait=False)
         pg.shutdown()
-        store.shutdown()
+        if store is not None:
+            store.shutdown()
 
 
 def demo(args: argparse.Namespace) -> None:

@@ -259,7 +259,11 @@ RpcResult ManagerServer::handle_should_commit(const std::string& payload) {
 RpcResult ManagerServer::handle_kill(const std::string&) {
   TPUFT_WARN("[Replica %s] got kill request", opt_.replica_id.c_str());
   if (opt_.exit_on_kill) {
-    std::exit(1);
+    // _Exit, not exit: running static destructors concurrently with live
+    // runtime threads (jax, our own servers) segfaults during teardown; the
+    // kill contract is an immediate death, matching the reference's
+    // std::process::exit semantics.
+    std::_Exit(1);
   }
   return {RpcStatus::kOk, ""};
 }

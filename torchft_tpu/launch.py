@@ -46,6 +46,8 @@ def supervise(
     ``store_port_base + group``); a death of any rank restarts the whole
     group, matching the per-group restart unit of the reference's
     torchelastic deployment. Returns 0 when every group exits cleanly."""
+    if group_world_size < 1:
+        raise ValueError(f"group_world_size must be >= 1, got {group_world_size}")
     own_lighthouse: Optional[LighthouseServer] = None
     if lighthouse_addr is None:
         own_lighthouse = LighthouseServer(

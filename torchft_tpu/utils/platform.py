@@ -1,0 +1,24 @@
+"""Platform selection helpers."""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["honor_jax_platforms_env"]
+
+
+def honor_jax_platforms_env() -> None:
+    """Applies $JAX_PLATFORMS via jax.config before backend init.
+
+    Some machines pin the platform list in jax's config from a sitecustomize,
+    which silently overrides the environment variable; applying the env value
+    through the config restores the expected contract. No-op once a backend
+    is initialized or when the variable is unset."""
+    import jax
+
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if platforms:
+        try:
+            jax.config.update("jax_platforms", platforms)
+        except RuntimeError:
+            pass
