@@ -29,16 +29,18 @@ def train(args: argparse.Namespace) -> None:
     import jax
 
     # Virtual intra-slice devices for the demo (must precede backend init).
+    # With a group jax cluster (TPUFT_JAX_COORDINATOR), this is the LOCAL
+    # device count per process and the mesh below spans the whole group.
     try:
         jax.config.update("jax_platforms", "cpu")
         jax.config.update("jax_num_cpu_devices", args.devices_per_group)
     except RuntimeError:
         pass
-    import jax.numpy as jnp
-    import numpy as np
-    import optax
+    from torchft_tpu.bootstrap import init_group_jax_cluster, init_manager
 
-    from torchft_tpu.bootstrap import init_manager
+    clustered = init_group_jax_cluster()
+    import jax.numpy as jnp
+    import optax
     from torchft_tpu.models.llama import (
         CONFIGS,
         Llama,
@@ -66,12 +68,20 @@ def train(args: argparse.Namespace) -> None:
     tokens = jnp.zeros((args.batch_size, args.seq_len), dtype=jnp.int32)
     params = model.init(jax.random.PRNGKey(0), tokens)
 
-    # Intra-slice mesh: fsdp x tp over this group's devices; the replica
-    # axis stays virtual (managed by the quorum).
-    fsdp = args.devices_per_group // 2
+    # Intra-slice mesh: fsdp x tp over ALL the group's devices (global when
+    # the group forms a jax cluster); the replica axis stays virtual.
+    n_devices = len(jax.devices())
+    fsdp = max(n_devices // 2, 1)
     ft_mesh = ft_init_device_mesh(
-        manager, mesh_shape=(fsdp, 2), axis_names=("fsdp", "tp")
+        manager, mesh_shape=(fsdp, 2 if n_devices >= 2 else 1),
+        axis_names=("fsdp", "tp"),
     )
+    if clustered:
+        print(
+            f"[group {group_id}] jax cluster: {n_devices} global devices "
+            f"({len(jax.local_devices())} local)",
+            flush=True,
+        )
     params = apply_sharding_plan(params, ft_mesh.mesh, sharding_plan("fsdp", "tp"))
     opt = Optimizer(manager, optax.adamw(1e-3), params)
 
@@ -105,8 +115,12 @@ def train(args: argparse.Namespace) -> None:
                     flush=True,
                 )
         elapsed = time.monotonic() - t_start
+        # Jitted reduce -> replicated scalar, fetchable from any process
+        # (multi-host arrays' remote shards are not addressable directly).
         digest = float(
-            sum(np.abs(np.asarray(l)).sum() for l in jax.tree_util.tree_leaves(opt.params))
+            jax.jit(
+                lambda p: sum(jnp.abs(l).sum() for l in jax.tree_util.tree_leaves(p))
+            )(opt.params)
         )
         print(
             f"[group {group_id}] done in {elapsed:.1f}s param_digest={digest:.6f}",

@@ -13,6 +13,10 @@ and multi-host replica groups:
   TPUFT_STORE_ADDR       group store "host:port". Rank 0 binds a StoreServer
                          here (or an ephemeral port when unset); other ranks
                          connect to it.
+  TPUFT_JAX_COORDINATOR  optional "host:port": when set, the group's ranks
+                         form one jax.distributed cluster (multi-host mesh
+                         inside the replica group — the TPU-pod topology)
+                         before the manager starts.
 
 Usage::
 
@@ -31,7 +35,37 @@ from torchft_tpu.manager import Manager
 from torchft_tpu.parallel.process_group import ProcessGroup
 from torchft_tpu.parallel.store import StoreClient, StoreServer
 
-__all__ = ["init_manager"]
+__all__ = ["init_manager", "init_group_jax_cluster"]
+
+
+def init_group_jax_cluster(
+    coordinator: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Joins this group's ranks into one jax.distributed cluster so the
+    intra-group mesh spans all the group's hosts/chips (defaults read the
+    topology env). Returns whether initialization ran. Must be called before
+    any jax backend use; no-op when no coordinator is configured."""
+    coordinator = coordinator or os.environ.get("TPUFT_JAX_COORDINATOR")
+    if not coordinator:
+        return False
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=(
+            num_processes
+            if num_processes is not None
+            else int(os.environ.get("GROUP_WORLD_SIZE", "1"))
+        ),
+        process_id=(
+            process_id
+            if process_id is not None
+            else int(os.environ.get("GROUP_RANK", "0"))
+        ),
+    )
+    return True
 
 
 def _wait_for_store(store_addr: str, timeout: float) -> None:

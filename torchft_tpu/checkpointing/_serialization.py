@@ -18,7 +18,14 @@ from typing import Any, BinaryIO, List, Optional, Tuple
 
 import numpy as np
 
-__all__ = ["save_state_dict", "load_state_dict", "state_dict_meta", "ArrayMeta"]
+__all__ = [
+    "save_state_dict",
+    "load_state_dict",
+    "state_dict_meta",
+    "ArrayMeta",
+    "ShardedLeaf",
+    "ShardedLeafMeta",
+]
 
 _LEN = struct.Struct("!Q")
 _MAGIC = b"TPFT1\n"
@@ -31,10 +38,62 @@ class ArrayMeta:
     nbytes: int
 
 
+@dataclass
+class ShardedLeaf:
+    """Host capture of a multi-host-sharded jax.Array: only this process's
+    addressable shards (each rank serves/receives its own shard of the
+    state, the per-rank transport contract). Reassembled on the receiver
+    against its matching local sharding (optim.Optimizer._load_state_dict).
+    """
+
+    global_shape: Tuple[int, ...]
+    dtype: str
+    # Per-shard ((start, stop) per dim, host array) in index order.
+    shards: List[Tuple[Tuple[Tuple[int, int], ...], Any]]
+
+    @staticmethod
+    def index_key(index, shape) -> Tuple[Tuple[int, int], ...]:
+        return tuple(
+            (sl.start or 0, sl.stop if sl.stop is not None else dim)
+            for sl, dim in zip(index, shape)
+        )
+
+
+@dataclass
+class ShardedLeafMeta:
+    """Header entry for a ShardedLeaf whose shard buffers ride the raw-bytes
+    section (large multi-host states must stream, not pickle)."""
+
+    global_shape: Tuple[int, ...]
+    dtype: str
+    shard_keys: List[Tuple[Tuple[int, int], ...]]
+    shard_shapes: List[Tuple[int, ...]]
+    shard_nbytes: List[int]
+
+
 def _to_host(leaf: Any) -> Any:
-    """Stages array-like leaves to host numpy; passes others through."""
+    """Stages array-like leaves to host numpy; passes others through.
+    Multi-host sharded arrays (remote shards not addressable) capture only
+    the local shards as a :class:`ShardedLeaf`."""
     if isinstance(leaf, np.ndarray):
         return leaf
+    if hasattr(leaf, "addressable_shards") and hasattr(leaf, "is_fully_addressable"):
+        if not leaf.is_fully_addressable:
+            shards = sorted(
+                (
+                    (ShardedLeaf.index_key(s.index, leaf.shape), np.asarray(s.data))
+                    for s in leaf.addressable_shards
+                ),
+                key=lambda kv: kv[0],
+            )
+            # Replicated copies on multiple local devices dedupe by index.
+            deduped = []
+            seen = set()
+            for key, data in shards:
+                if key not in seen:
+                    seen.add(key)
+                    deduped.append((key, data))
+            return ShardedLeaf(tuple(leaf.shape), np.dtype(leaf.dtype).name, deduped)
     # jax.Array without importing jax at module load.
     if hasattr(leaf, "__array__") and hasattr(leaf, "dtype") and hasattr(leaf, "shape"):
         return np.asarray(leaf)
@@ -48,7 +107,9 @@ def _flatten(state_dict: Any) -> Tuple[List[Any], Any]:
 
 
 def state_dict_meta(state_dict: Any) -> Tuple[Any, List[Optional[ArrayMeta]], List[Any]]:
-    """Returns (treedef, per-leaf ArrayMeta-or-None, host leaves)."""
+    """Returns (treedef, per-leaf meta, host leaves). Metas are ArrayMeta for
+    plain arrays, ShardedLeafMeta for multi-host shard captures, None for
+    header-riding (pickled) leaves."""
     leaves, treedef = _flatten(state_dict)
     leaves = [_to_host(leaf) for leaf in leaves]
     metas: List[Optional[ArrayMeta]] = []
@@ -56,6 +117,16 @@ def state_dict_meta(state_dict: Any) -> Tuple[Any, List[Optional[ArrayMeta]], Li
         if isinstance(leaf, np.ndarray):
             leaf_c = np.ascontiguousarray(leaf)
             metas.append(ArrayMeta(leaf_c.shape, leaf_c.dtype.name, leaf_c.nbytes))
+        elif isinstance(leaf, ShardedLeaf):
+            metas.append(
+                ShardedLeafMeta(
+                    leaf.global_shape,
+                    leaf.dtype,
+                    [key for key, _ in leaf.shards],
+                    [tuple(data.shape) for _, data in leaf.shards],
+                    [int(np.ascontiguousarray(data).nbytes) for _, data in leaf.shards],
+                )
+            )
         else:
             metas.append(None)
     return treedef, metas, leaves
@@ -69,8 +140,11 @@ def save_state_dict(state_dict: Any, stream: BinaryIO) -> None:
     stream.write(_LEN.pack(len(header)))
     stream.write(header)
     for leaf, meta in zip(leaves, metas):
-        if meta is not None:
+        if isinstance(meta, ArrayMeta):
             stream.write(np.ascontiguousarray(leaf).tobytes())
+        elif isinstance(meta, ShardedLeafMeta):
+            for _, data in leaf.shards:
+                stream.write(np.ascontiguousarray(data).tobytes())
 
 
 def _resolve_dtype(name: str) -> np.dtype:
@@ -95,6 +169,17 @@ def load_state_dict(stream: BinaryIO) -> Any:
     for meta in metas:
         if meta is None:
             leaves.append(next(non_array_iter))
+        elif isinstance(meta, ShardedLeafMeta):
+            dtype = _resolve_dtype(meta.dtype)
+            shards = []
+            for key, shape, nbytes in zip(
+                meta.shard_keys, meta.shard_shapes, meta.shard_nbytes
+            ):
+                buf = stream.read(nbytes)
+                if len(buf) != nbytes:
+                    raise EOFError("truncated checkpoint stream (sharded leaf)")
+                shards.append((key, np.frombuffer(buf, dtype=dtype).reshape(shape).copy()))
+            leaves.append(ShardedLeaf(meta.global_shape, meta.dtype, shards))
         else:
             dtype = _resolve_dtype(meta.dtype)
             buf = stream.read(meta.nbytes)

@@ -55,12 +55,16 @@ class PeriodicCheckpointer:
         step = self._manager.current_step()
         if not force and (step == 0 or step % self._save_every != 0):
             return False
-        if self._only_rank_zero and (
-            self._manager.participating_rank() != 0 or self._manager._group_rank != 0
-        ):
-            # One writer per job: local rank 0 of the participating-rank-0
-            # group (multiple local ranks racing one orbax step dir corrupts
-            # the checkpoint).
+        if self._only_rank_zero and self._manager.participating_rank() != 0:
+            return False
+        import jax
+
+        if self._only_rank_zero and jax.process_count() == 1 and self._manager._group_rank != 0:
+            # Single-process-jax groups: exactly one writer (local rank 0 of
+            # the participating-rank-0 group) — concurrent writers racing one
+            # orbax step dir corrupt the checkpoint. Under a multi-process
+            # jax cluster, saves of group-sharded arrays are COLLECTIVE, so
+            # every rank of the writing group must call save together.
             return False
         payload = {
             "user": state,

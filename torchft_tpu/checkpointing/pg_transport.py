@@ -68,10 +68,15 @@ class PGTransport(CheckpointTransport[Any]):
             leaf_metas=metas,
             non_array=[leaf for leaf, m in zip(leaves, metas) if m is None],
         )
+        from torchft_tpu.checkpointing._serialization import ShardedLeafMeta
+
         meta_buf = np.frombuffer(pickle.dumps(meta), dtype=np.uint8).copy()
-        arrays = [
-            np.ascontiguousarray(leaf) for leaf, m in zip(leaves, metas) if m is not None
-        ]
+        arrays = []
+        for leaf, m in zip(leaves, metas):
+            if isinstance(m, ShardedLeafMeta):
+                arrays.extend(np.ascontiguousarray(data) for _, data in leaf.shards)
+            elif m is not None:
+                arrays.append(np.ascontiguousarray(leaf))
         for dst in dst_ranks:
             self._pg.send([np.array([len(meta_buf)], dtype=np.int64)], dst).wait(timeout)
             self._pg.send([meta_buf], dst).wait(timeout)
@@ -97,11 +102,25 @@ class PGTransport(CheckpointTransport[Any]):
             if pickle.dumps(t_treedef) == meta.treedef_bytes:
                 template_leaves = t_leaves
 
+        from torchft_tpu.checkpointing._serialization import ShardedLeaf, ShardedLeafMeta
+
         non_array_iter = iter(meta.non_array)
         leaves: List[Any] = []
         for i, leaf_meta in enumerate(meta.leaf_metas):
             if leaf_meta is None:
                 leaves.append(next(non_array_iter))
+                continue
+            if isinstance(leaf_meta, ShardedLeafMeta):
+                dtype = _serialization._resolve_dtype(leaf_meta.dtype)
+                shards = []
+                for key, shape in zip(leaf_meta.shard_keys, leaf_meta.shard_shapes):
+                    (received,) = self._pg.recv(
+                        [np.empty(shape, dtype=dtype)], src_rank
+                    ).wait(timeout)
+                    shards.append((key, received))
+                leaves.append(
+                    ShardedLeaf(leaf_meta.global_shape, leaf_meta.dtype, shards)
+                )
                 continue
             dtype = _serialization._resolve_dtype(leaf_meta.dtype)
             if (

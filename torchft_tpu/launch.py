@@ -39,6 +39,7 @@ def supervise(
     extra_env: Optional[Dict[str, str]] = None,
     group_world_size: int = 1,
     store_port_base: int = 29600,
+    jax_coordinator_port_base: int = 0,
 ) -> int:
     """Runs ``command`` for each (group, rank) cell, relaunching dead
     groups. With ``group_world_size > 1`` every rank of a group shares
@@ -48,6 +49,11 @@ def supervise(
     torchelastic deployment. Returns 0 when every group exits cleanly."""
     if group_world_size < 1:
         raise ValueError(f"group_world_size must be >= 1, got {group_world_size}")
+    if jax_coordinator_port_base and group_world_size == 1:
+        raise ValueError(
+            "--jax-coordinator-port-base requires --group-world-size > 1 "
+            "(a one-process group has nothing to cluster)"
+        )
     own_lighthouse: Optional[LighthouseServer] = None
     if lighthouse_addr is None:
         own_lighthouse = LighthouseServer(
@@ -75,6 +81,10 @@ def supervise(
             }
             if group_world_size > 1:
                 env["TPUFT_STORE_ADDR"] = store_addr
+                if jax_coordinator_port_base:
+                    env["TPUFT_JAX_COORDINATOR"] = (
+                        f"{hostname}:{jax_coordinator_port_base + group}"
+                    )
             print(
                 f"[launch] starting group {group} rank {rank}: {' '.join(command)}",
                 flush=True,
@@ -153,6 +163,13 @@ def main() -> None:
     parser.add_argument("--max-restarts", type=int, default=100)
     parser.add_argument("--group-world-size", type=int, default=1)
     parser.add_argument("--store-port-base", type=int, default=29600)
+    parser.add_argument(
+        "--jax-coordinator-port-base",
+        type=int,
+        default=0,
+        help="when set, each group's ranks form one jax.distributed cluster "
+        "(coordinator on this port + group id)",
+    )
     parser.add_argument("command", nargs=argparse.REMAINDER, help="-- cmd args...")
     args = parser.parse_args()
     command = args.command
@@ -169,6 +186,7 @@ def main() -> None:
             max_restarts=args.max_restarts,
             group_world_size=args.group_world_size,
             store_port_base=args.store_port_base,
+            jax_coordinator_port_base=args.jax_coordinator_port_base,
         )
     )
 

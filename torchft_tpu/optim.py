@@ -29,6 +29,7 @@ from __future__ import annotations
 from typing import Any, Optional
 
 import jax
+import numpy as np
 
 from torchft_tpu.manager import Manager
 
@@ -49,9 +50,89 @@ def make_jit_update(tx: Any):
     return jax.jit(_update)
 
 
-def _as_device_tree(tree: Any) -> Any:
+def _align_opt_state(opt_state: Any, params: Any) -> Any:
+    """Places optimizer-state leaves on the params' device set.
+
+    Param-shaped leaves (moments) already inherit the params' sharding via
+    zeros_like; scalar bookkeeping (e.g. optax's ``count``) lands on one
+    local device, which breaks the jitted update under a multi-host mesh —
+    replicate those over the params' mesh instead."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    param_leaves = [
+        leaf for leaf in jax.tree_util.tree_leaves(params) if isinstance(leaf, jax.Array)
+    ]
+    if not param_leaves:
+        return opt_state
+    sharding = param_leaves[0].sharding
+    mesh = getattr(sharding, "mesh", None)
+    if mesh is None:
+        return opt_state
+    target_ids = {d.id for d in param_leaves[0].sharding.device_set}
+    if len(target_ids) <= 1:
+        return opt_state
+    replicated = NamedSharding(mesh, PartitionSpec())
+
+    def fix(leaf: Any) -> Any:
+        if isinstance(leaf, jax.Array):
+            if {d.id for d in leaf.sharding.device_set} != target_ids:
+                return jax.device_put(np.asarray(leaf), replicated)
+        return leaf
+
+    return jax.tree_util.tree_map(fix, opt_state)
+
+
+def _restore_leaf(new: Any, current: Any) -> Any:
+    """Restores a healed leaf onto the device layout of ``current``.
+
+    Plain hosts arrays follow the current sharding; a
+    :class:`~torchft_tpu.checkpointing._serialization.ShardedLeaf` (multi-
+    host donor capture) is reassembled shard-by-shard against the current
+    array's sharding — donor and joiner lay out identically by the HSDP
+    contract (same model, same intra-group mesh)."""
     import jax.numpy as jnp
 
+    from torchft_tpu.checkpointing._serialization import ShardedLeaf, _resolve_dtype
+
+    if isinstance(new, ShardedLeaf):
+        if not isinstance(current, jax.Array):
+            raise TypeError(
+                "received a sharded checkpoint leaf but the local state is "
+                "not a jax.Array to supply its sharding"
+            )
+        by_index = dict(new.shards)
+        buffers = []
+        for shard in current.addressable_shards:
+            key = ShardedLeaf.index_key(shard.index, new.global_shape)
+            if key not in by_index:
+                raise ValueError(
+                    f"donor checkpoint lacks shard {key}: donor/joiner "
+                    "shardings must match"
+                )
+            buffers.append(
+                jax.device_put(
+                    np.asarray(by_index[key], dtype=_resolve_dtype(new.dtype)),
+                    shard.device,
+                )
+            )
+        return jax.make_array_from_single_device_arrays(
+            new.global_shape, current.sharding, buffers
+        )
+    if isinstance(current, jax.Array) and hasattr(new, "shape"):
+        return jax.device_put(np.asarray(new), current.sharding)
+    if hasattr(new, "shape"):
+        return jnp.asarray(new)
+    return new
+
+
+def _as_device_tree(tree: Any, like: Any = None) -> Any:
+    import jax.numpy as jnp
+
+    if like is not None:
+        return jax.tree_util.tree_map(
+            _restore_leaf, tree, like,
+            is_leaf=lambda x: not isinstance(x, (dict, list, tuple)),
+        )
     return jax.tree_util.tree_map(
         lambda x: jnp.asarray(x) if hasattr(x, "shape") else x, tree
     )
@@ -70,7 +151,7 @@ class Optimizer:
         self.manager = manager
         self.tx = tx
         self.params = params
-        self.opt_state = tx.init(params)
+        self.opt_state = _align_opt_state(tx.init(params), params)
         manager.register_state_dict_fn(
             register_key, self._load_state_dict, self._state_dict
         )
@@ -81,8 +162,10 @@ class Optimizer:
         return {"params": self.params, "opt_state": self.opt_state}
 
     def _load_state_dict(self, state: Any) -> None:
-        self.params = _as_device_tree(state["params"])
-        self.opt_state = _as_device_tree(state["opt_state"])
+        # Restore against the CURRENT layouts so multi-host shardings are
+        # reassembled locally (each rank received its own shards).
+        self.params = _as_device_tree(state["params"], like=self.params)
+        self.opt_state = _as_device_tree(state["opt_state"], like=self.opt_state)
 
     def begin_step(
         self, timeout: Optional[float] = None, shrink_only: bool = False
