@@ -8,8 +8,6 @@ demo (train_diloco.py:118-119).
 
 from __future__ import annotations
 
-from typing import Any
-
 import flax.linen as nn
 import jax.numpy as jnp
 

@@ -18,7 +18,7 @@ payload is ``scales || fp8 payload``, mirroring the reference's interleaved
 
 from __future__ import annotations
 
-from typing import List, Sequence, Tuple
+from typing import Sequence, Tuple
 
 import ml_dtypes
 import numpy as np

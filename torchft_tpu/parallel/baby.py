@@ -26,7 +26,7 @@ import numpy as np
 
 from torchft_tpu.parallel.multiprocessing import _MonitoredPipe
 from torchft_tpu.parallel.process_group import ProcessGroup, ReduceOp
-from torchft_tpu.work import Work, _DummyWork
+from torchft_tpu.work import Work
 
 __all__ = ["ProcessGroupBaby"]
 

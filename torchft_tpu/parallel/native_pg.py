@@ -16,7 +16,6 @@ from __future__ import annotations
 
 import ctypes
 import logging
-import pickle
 import queue
 import threading
 from concurrent.futures import Future

@@ -18,7 +18,7 @@ import urllib.request
 import uuid
 from abc import ABC, abstractmethod
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Optional
+from typing import Any
 
 from torchft_tpu.parallel.process_group import ProcessGroup, ProcessGroupTCP
 from torchft_tpu.parallel.store import StoreServer
