@@ -164,6 +164,24 @@ def test_diloco_update_alpha_mixes_local_and_global() -> None:
 # -- golden-file regression (parity: diloco_regression_test.py) -------------
 
 
+def check_or_regen_golden(name: str, history: list) -> None:
+    """Compares a parameter history to the committed fixture (or regenerates
+    it under TPUFT_REGEN_FIXTURES=1)."""
+    path = FIXTURES / name
+    if os.environ.get("TPUFT_REGEN_FIXTURES") == "1":
+        FIXTURES.mkdir(exist_ok=True)
+        path.write_text(json.dumps(history, indent=1))
+        pytest.skip("regenerated fixture")
+    assert path.exists(), f"fixture {name} missing; run with TPUFT_REGEN_FIXTURES=1"
+    golden = json.loads(path.read_text())
+    assert len(golden) == len(history), "fixture/history length mismatch"
+    for step, (got, want) in enumerate(zip(history, golden)):
+        for key in want:
+            np.testing.assert_allclose(
+                got[key], want[key], rtol=1e-6, err_msg=f"step {step} key {key}"
+            )
+
+
 @pytest.mark.parametrize(
     "n_fragments,sync_delay,alpha",
     [(1, 0, 0.0), (2, 0, 0.0), (2, 1, 0.0), (2, 0, 0.5)],
@@ -187,16 +205,47 @@ def test_diloco_golden_history(n_fragments, sync_delay, alpha) -> None:
             {k: np.asarray(v).tolist() for k, v in sorted(algo.params.items())}
         )
 
-    name = f"diloco_f{n_fragments}_d{sync_delay}_a{alpha}.json"
-    path = FIXTURES / name
-    if os.environ.get("TPUFT_REGEN_FIXTURES") == "1":
-        FIXTURES.mkdir(exist_ok=True)
-        path.write_text(json.dumps(history, indent=1))
-        pytest.skip("regenerated fixture")
-    assert path.exists(), f"fixture {name} missing; run with TPUFT_REGEN_FIXTURES=1"
-    golden = json.loads(path.read_text())
-    for step, (got, want) in enumerate(zip(history, golden)):
-        for key in want:
-            np.testing.assert_allclose(
-                got[key], want[key], rtol=1e-6, err_msg=f"step {step} key {key}"
-            )
+    check_or_regen_golden(f"diloco_f{n_fragments}_d{sync_delay}_a{alpha}.json", history)
+
+
+@pytest.mark.parametrize("fail_sync_index", [1])
+def test_diloco_failure_timeline_golden(fail_sync_index: int) -> None:
+    """Failure-recovery timeline numerics (parity: diloco_regression_test.py
+    mocked failure timelines :288-639): a commit failure at sync round k
+    resets the in-flight fragment to its last global state, and the
+    subsequent history matches the committed fixture."""
+    manager = scripted_manager(use_async_quorum=False)
+    sync_calls = [0]
+
+    def should_commit(rank, step, vote, timeout):
+        sync_calls[0] += 1
+        if sync_calls[0] - 1 == fail_sync_index:
+            return False
+        return vote
+
+    manager._client.should_commit.side_effect = should_commit
+
+    algo = DiLoCo(
+        manager,
+        optax.sgd(0.1),
+        optax.sgd(0.7, momentum=0.9, nesterov=True),
+        make_params(),
+        sync_every=2,
+        n_fragments=1,
+    )
+    history = []
+    committed_flags = []
+    for step in range(10):
+        committed_flags.append(algo.step(fixed_grads(step)))
+        history.append(
+            {k: np.asarray(v).tolist() for k, v in sorted(algo.params.items())}
+        )
+    # The scripted failure lands at sync round fail_sync_index (sync rounds
+    # commit on steps 2k+1 with sync_every=2).
+    for sync_round in range(5):
+        expected = sync_round != fail_sync_index
+        assert committed_flags[2 * sync_round + 1] is expected, sync_round
+
+    check_or_regen_golden(
+        f"diloco_failure_timeline_{fail_sync_index}.json", history
+    )
