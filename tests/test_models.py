@@ -168,3 +168,31 @@ def test_ring_attention_gradients_match_dense() -> None:
         np.testing.assert_allclose(
             np.asarray(ring_grad), np.asarray(dense_grad), rtol=3e-4, atol=3e-5
         )
+
+
+def test_ring_attention_fully_masked_rows_are_zero() -> None:
+    """A query row positioned before every key (packed padding) must output
+    exactly 0, not mean(V) — regardless of ring layout / causal skipping."""
+    from jax import shard_map
+
+    from torchft_tpu.ops.ring_attention import ring_attention
+
+    b, s, h, kv, d = 1, 16, 2, 1, 8
+    q = jax.random.normal(jax.random.PRNGKey(0), (b, s, h, d), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, s, kv, d), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, s, kv, d), jnp.float32)
+    qpos = jnp.broadcast_to(jnp.arange(s), (b, s)).at[0, 0].set(-100)
+    kpos = jnp.broadcast_to(jnp.arange(s), (b, s))
+    mesh = Mesh(np.array(jax.devices()[:4]), ("sp",))
+    spec = P(None, "sp", None, None)
+    fn = shard_map(
+        lambda q_, k_, v_, qp, kp: ring_attention(
+            q_, k_, v_, "sp", scale=d**-0.5, q_positions=qp, k_positions=kp
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec, P(None, "sp"), P(None, "sp")),
+        out_specs=spec,
+    )
+    out = np.asarray(fn(q, k, v, qpos, kpos))
+    assert np.all(out[0, 0] == 0.0)
+    assert not np.all(out[0, 1] == 0.0)

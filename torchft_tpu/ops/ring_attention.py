@@ -110,11 +110,25 @@ def ring_attention(
 
     def ring_step(step, carry):
         acc, row_max, row_sum, k_blk, v_blk, k_pos = carry
-        acc, row_max, row_sum = _block_attention(
-            qg, k_blk, v_blk, q_positions, k_pos, scale, acc, row_max, row_sum
+
+        # Causal skip: a KV block whose earliest position is beyond this
+        # shard's last query position is fully masked — skip its matmuls
+        # while still rotating it along the ring. With the contiguous layout
+        # this halves attention FLOPs (energy), but per-step latency is set
+        # by the slowest device since ppermute is a barrier; a load-balanced
+        # (zigzag/striped) sequence layout would convert the saving into
+        # wall-clock time and is the natural next step.
+        block_relevant = jnp.min(k_pos) <= jnp.max(q_positions)
+        acc, row_max, row_sum = jax.lax.cond(
+            block_relevant,
+            lambda ops: _block_attention(
+                qg, ops[0], ops[1], q_positions, ops[2], scale, *ops[3:]
+            ),
+            lambda ops: (ops[3], ops[4], ops[5]),
+            (k_blk, v_blk, k_pos, acc, row_max, row_sum),
         )
-        # Rotate KV to the next ring position (skip the final, unused hop is
-        # fine to keep: the loop is static and XLA overlaps it).
+        # Rotate KV to the next ring position (keeping the final, unused hop
+        # is fine: the loop is static and XLA overlaps it).
         perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
         k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
         v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
@@ -125,9 +139,14 @@ def ring_attention(
     carry = jax.lax.fori_loop(0, axis_size, ring_step, carry)
     acc, row_max, row_sum = carry[:3]
 
-    # Fully-masked rows (can't occur with causal self-attention, but keep the
-    # math safe) divide by 1 instead of 0.
-    out = acc / jnp.maximum(row_sum[..., None], 1e-30)
+    # Fully-masked rows (possible with user-supplied positions, e.g. packed
+    # padding) must yield 0: their row_max never left _NEG_INF, and the
+    # softmax shift would otherwise turn the all-masked scores into uniform
+    # weights (mean of V).
+    masked = row_max <= _NEG_INF
+    out = jnp.where(
+        masked[..., None], 0.0, acc / jnp.maximum(row_sum[..., None], 1e-30)
+    )
     return out.reshape(b, s_local, h, d).astype(q.dtype)
 
 
