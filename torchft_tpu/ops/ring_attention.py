@@ -27,7 +27,12 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-__all__ = ["ring_attention", "ring_attention_sharded"]
+__all__ = [
+    "ring_attention",
+    "ring_attention_sharded",
+    "ring_attention_zigzag",
+    "zigzag_permutation",
+]
 
 _NEG_INF = -1e30
 
@@ -73,6 +78,7 @@ def ring_attention(
     scale: Optional[float] = None,
     q_positions: Optional[jnp.ndarray] = None,
     k_positions: Optional[jnp.ndarray] = None,
+    kv_sub_blocks: int = 1,
 ) -> jnp.ndarray:
     """Causal GQA attention with K/V rotating over ``axis_name``.
 
@@ -80,6 +86,11 @@ def ring_attention(
     q/k/v is the per-device shard. Shapes: q (b, s_local, h, d);
     k/v (b, s_local, kv_heads, d). Positions default to contiguous shards
     ordered by the device's axis index.
+
+    ``kv_sub_blocks``: each rotated KV block is processed in this many
+    sequence sub-blocks, each causally skipped independently — with the
+    zigzag layout (2 chunks per shard) this is what turns the skip into a
+    balanced wall-clock saving.
     """
     axis_size = jax.lax.psum(1, axis_name)
     axis_index = jax.lax.axis_index(axis_name)
@@ -108,25 +119,46 @@ def ring_attention(
             for x in (acc, row_max, row_sum)
         )
 
+    if s_local % kv_sub_blocks != 0:
+        raise ValueError(
+            f"kv_sub_blocks ({kv_sub_blocks}) must divide the shard ({s_local})"
+        )
+    sub = s_local // kv_sub_blocks
+
     def ring_step(step, carry):
         acc, row_max, row_sum, k_blk, v_blk, k_pos = carry
 
-        # Causal skip: a KV block whose earliest position is beyond this
-        # shard's last query position is fully masked — skip its matmuls
-        # while still rotating it along the ring. With the contiguous layout
-        # this halves attention FLOPs (energy), but per-step latency is set
-        # by the slowest device since ppermute is a barrier; a load-balanced
-        # (zigzag/striped) sequence layout would convert the saving into
-        # wall-clock time and is the natural next step.
-        block_relevant = jnp.min(k_pos) <= jnp.max(q_positions)
-        acc, row_max, row_sum = jax.lax.cond(
-            block_relevant,
-            lambda ops: _block_attention(
-                qg, ops[0], ops[1], q_positions, ops[2], scale, *ops[3:]
-            ),
-            lambda ops: (ops[3], ops[4], ops[5]),
-            (k_blk, v_blk, k_pos, acc, row_max, row_sum),
-        )
+        # Causal skip, per (query sub-block, KV sub-block) pair: a pair
+        # whose earliest KV position exceeds the sub-block's last query
+        # position is fully masked — skip its matmuls while the block still
+        # rotates. With the contiguous layout (kv_sub_blocks=1) this halves
+        # attention FLOPs but latency stays bound by the busiest device
+        # (ppermute is a barrier); the zigzag layout + sub_blocks=2 makes
+        # every device's relevant-pair count equal, so the saving shows up
+        # in wall-clock time.
+        for qi in range(kv_sub_blocks):
+            q_sub = qg[:, qi * sub : (qi + 1) * sub]
+            qp_sub = q_positions[:, qi * sub : (qi + 1) * sub]
+            acc_sub = acc[:, qi * sub : (qi + 1) * sub]
+            rm_sub = row_max[:, qi * sub : (qi + 1) * sub]
+            rs_sub = row_sum[:, qi * sub : (qi + 1) * sub]
+            q_sub_max = jnp.max(qp_sub)
+            for ki in range(kv_sub_blocks):
+                k_sub = k_blk[:, ki * sub : (ki + 1) * sub]
+                v_sub = v_blk[:, ki * sub : (ki + 1) * sub]
+                p_sub = k_pos[:, ki * sub : (ki + 1) * sub]
+                relevant = jnp.min(p_sub) <= q_sub_max
+                acc_sub, rm_sub, rs_sub = jax.lax.cond(
+                    relevant,
+                    lambda ops: _block_attention(
+                        q_sub, ops[0], ops[1], qp_sub, ops[2], scale, *ops[3:]
+                    ),
+                    lambda ops: (ops[3], ops[4], ops[5]),
+                    (k_sub, v_sub, p_sub, acc_sub, rm_sub, rs_sub),
+                )
+            acc = acc.at[:, qi * sub : (qi + 1) * sub].set(acc_sub)
+            row_max = row_max.at[:, qi * sub : (qi + 1) * sub].set(rm_sub)
+            row_sum = row_sum.at[:, qi * sub : (qi + 1) * sub].set(rs_sub)
         # Rotate KV to the next ring position (keeping the final, unused hop
         # is fine: the loop is static and XLA overlaps it).
         perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
@@ -171,3 +203,68 @@ def ring_attention_sharded(
     return shard_map(
         inner, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
     )(q, k, v)
+
+
+def zigzag_permutation(seq_len: int, sp: int):
+    """Load-balanced ("zigzag") sequence layout for causal ring attention.
+
+    With contiguous shards, causal skipping idles early-ring devices while
+    late ones do full work each step (latency = busiest device). Splitting
+    the sequence into ``2*sp`` chunks and giving device ``i`` chunks
+    ``(i, 2*sp-1-i)`` equalizes the causally-relevant work per device, so
+    the FLOP saving becomes wall-clock saving.
+
+    Returns (perm, inv_perm): apply ``x[:, perm]`` before sharding over
+    ``sp`` and pass the matching positions (``perm`` itself) to
+    :func:`ring_attention`; apply ``out[:, inv_perm]`` to restore order.
+    """
+    import numpy as np
+
+    if seq_len % (2 * sp) != 0:
+        raise ValueError(f"seq_len {seq_len} must divide by 2*sp ({2 * sp})")
+    chunk = seq_len // (2 * sp)
+    order = []
+    for device in range(sp):
+        order.extend([device, 2 * sp - 1 - device])
+    perm = np.concatenate(
+        [np.arange(c * chunk, (c + 1) * chunk) for c in order]
+    )
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(seq_len)
+    return perm, inv
+
+
+def ring_attention_zigzag(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: jax.sharding.Mesh,
+    axis_name: str = "sp",
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Ring attention with the zigzag layout applied transparently: inputs
+    and outputs are in natural sequence order; internally the sequence is
+    permuted so every ring step does balanced causal work."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    sp = mesh.shape[axis_name]
+    b, s = q.shape[0], q.shape[1]
+    perm, inv = zigzag_permutation(s, sp)
+    perm_j = jnp.asarray(perm)
+    positions = jnp.broadcast_to(perm_j, (b, s))
+
+    spec = P(None, axis_name, None, None)
+    pos_spec = P(None, axis_name)
+
+    def inner(q_, k_, v_, pos):
+        return ring_attention(
+            q_, k_, v_, axis_name=axis_name, scale=scale,
+            q_positions=pos, k_positions=pos, kv_sub_blocks=2,
+        )
+
+    mapped = shard_map(
+        inner, mesh=mesh, in_specs=(spec, spec, spec, pos_spec), out_specs=spec
+    )
+    out = mapped(q[:, perm_j], k[:, perm_j], v[:, perm_j], positions)
+    return out[:, jnp.asarray(inv)]
