@@ -136,6 +136,19 @@ def ring_attention(
         # (ppermute is a barrier); the zigzag layout + sub_blocks=2 makes
         # every device's relevant-pair count equal, so the saving shows up
         # in wall-clock time.
+        if kv_sub_blocks == 1:
+            # Direct path: one causal-skip decision for the whole block
+            # (avoids the sliced-accumulator machinery entirely).
+            relevant = jnp.min(k_pos) <= jnp.max(q_positions)
+            acc, row_max, row_sum = jax.lax.cond(
+                relevant,
+                lambda ops: _block_attention(
+                    qg, ops[0], ops[1], q_positions, ops[2], scale, *ops[3:]
+                ),
+                lambda ops: (ops[3], ops[4], ops[5]),
+                (k_blk, v_blk, k_pos, acc, row_max, row_sum),
+            )
+            return acc, row_max, row_sum, *_rotate(k_blk, v_blk, k_pos)
         for qi in range(kv_sub_blocks):
             q_sub = qg[:, qi * sub : (qi + 1) * sub]
             qp_sub = q_positions[:, qi * sub : (qi + 1) * sub]
@@ -156,16 +169,22 @@ def ring_attention(
                     lambda ops: (ops[3], ops[4], ops[5]),
                     (k_sub, v_sub, p_sub, acc_sub, rm_sub, rs_sub),
                 )
-            acc = acc.at[:, qi * sub : (qi + 1) * sub].set(acc_sub)
-            row_max = row_max.at[:, qi * sub : (qi + 1) * sub].set(rm_sub)
-            row_sum = row_sum.at[:, qi * sub : (qi + 1) * sub].set(rs_sub)
+            # dynamic_update_slice (not .at[].set): scatter transposes break
+            # shard_map AD's sharding inference here.
+            acc = jax.lax.dynamic_update_slice_in_dim(acc, acc_sub, qi * sub, axis=1)
+            row_max = jax.lax.dynamic_update_slice_in_dim(row_max, rm_sub, qi * sub, axis=1)
+            row_sum = jax.lax.dynamic_update_slice_in_dim(row_sum, rs_sub, qi * sub, axis=1)
+        return acc, row_max, row_sum, *_rotate(k_blk, v_blk, k_pos)
+
+    def _rotate(k_blk, v_blk, k_pos):
         # Rotate KV to the next ring position (keeping the final, unused hop
         # is fine: the loop is static and XLA overlaps it).
         perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
-        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
-        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
-        k_pos = jax.lax.ppermute(k_pos, axis_name, perm)
-        return acc, row_max, row_sum, k_blk, v_blk, k_pos
+        return (
+            jax.lax.ppermute(k_blk, axis_name, perm),
+            jax.lax.ppermute(v_blk, axis_name, perm),
+            jax.lax.ppermute(k_pos, axis_name, perm),
+        )
 
     carry = (acc, row_max, row_sum, k, v, k_positions)
     carry = jax.lax.fori_loop(0, axis_size, ring_step, carry)
