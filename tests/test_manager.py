@@ -364,3 +364,37 @@ def test_allreduce_prequantized_zeroes_spare_contribution() -> None:
     # Errored manager: immediate None without touching the PG.
     manager.report_error(RuntimeError("boom"))
     assert manager.allreduce_prequantized(payload, scales).wait() is None
+
+
+def test_allreduce_pytree_buckets_mixed_dtypes() -> None:
+    """Bucketed pytree sync: multiple dtype buckets reconstruct to the right
+    leaves (shapes, dtypes, float-average vs int-floor-div), results don't
+    alias each other, and the quantized path stays per-leaf so fp8 block
+    scales never span parameter boundaries."""
+    manager, client, _, _ = make_manager(pg=ProcessGroupDummy(), min_replica_size=1)
+    client._quorum.return_value = make_quorum(replica_world_size=2, max_world_size=2)
+    manager.start_quorum()
+
+    tree = {
+        "w": np.full(5, 4.0, np.float32),
+        "b": [np.full(3, 8.0, np.float32)],
+        "n": np.array([10], np.int64),
+        "scalar": np.float64(6.0),
+    }
+    out = manager.allreduce_pytree(tree).wait()
+    np.testing.assert_array_equal(out["w"], np.full(5, 2.0, np.float32))
+    np.testing.assert_array_equal(out["b"][0], np.full(3, 4.0, np.float32))
+    assert out["n"][0] == 5  # integer average floor-divides
+    assert float(out["scalar"]) == 3.0
+    assert out["w"].dtype == np.float32 and out["n"].dtype == np.int64
+    # No aliasing between same-bucket leaves.
+    out["w"][:] = -1
+    np.testing.assert_array_equal(out["b"][0], np.full(3, 4.0, np.float32))
+
+    # Quantized path: per-leaf quantization — a tiny-magnitude leaf next to a
+    # huge one must survive (shared-bucket fp8 scales would zero it).
+    tree2 = {"big": np.full(512, 300.0, np.float32), "small": np.full(512, 1e-4, np.float32)}
+    out2 = manager.allreduce_pytree(tree2, should_quantize=True).wait()
+    assert np.all(np.abs(out2["small"]) > 0), "small leaf crushed by shared fp8 scale"
+    np.testing.assert_allclose(out2["small"], np.full(512, 5e-5), rtol=0.1)
+    np.testing.assert_allclose(out2["big"], np.full(512, 150.0), rtol=0.1)

@@ -329,7 +329,12 @@ class Manager:
 
     def allreduce_pytree(self, pytree: Any, should_quantize: bool = False) -> Work:
         """Averages every array leaf of ``pytree`` across replicas; resolves
-        to a pytree of the same structure (numpy leaves)."""
+        to a pytree of the same structure (numpy leaves).
+
+        Leaves are **bucketed**: same-dtype leaves concatenate into one flat
+        buffer per dtype so the wire carries one collective per bucket
+        instead of one per parameter (DDP's frozen-bucket role; flatten
+        order is deterministic across replicas for identical models)."""
         import jax
 
         leaves, treedef = jax.tree_util.tree_flatten(pytree)
@@ -341,19 +346,52 @@ class Manager:
             arrays = [np.asarray(leaf) for leaf in leaves]
         if not self.is_participating():
             arrays = [np.zeros_like(a) for a in arrays]
+
+        # Bucket same-dtype leaves (stable order). The quantized path stays
+        # per-leaf: concatenation would let one fp8 block's max-abs scale
+        # span parameter boundaries and crush small-magnitude leaves to 0.
+        if should_quantize:
+            buckets: Dict[Any, List[int]] = {
+                index: [index] for index in range(len(arrays))
+            }
+            flat_buffers = [a.reshape(-1) for a in arrays]
+        else:
+            buckets = {}
+            for index, array in enumerate(arrays):
+                buckets.setdefault(array.dtype, []).append(index)
+            flat_buffers = [
+                np.concatenate([arrays[i].reshape(-1) for i in members])
+                if len(members) > 1
+                else arrays[members[0]].reshape(-1)
+                for members in buckets.values()
+            ]
         try:
             if should_quantize:
                 from torchft_tpu.parallel.collectives import allreduce_quantized
 
-                work = allreduce_quantized(arrays, ReduceOp.SUM, self._pg)
+                work = allreduce_quantized(flat_buffers, ReduceOp.SUM, self._pg)
             else:
-                work = self._pg.allreduce(arrays, ReduceOp.SUM)
+                work = self._pg.allreduce(flat_buffers, ReduceOp.SUM)
 
             def callback(result: List[np.ndarray]) -> Any:
-                averaged = [
-                    (a / num_participants).astype(a.dtype) if a.dtype.kind in ("f", "V") else a // num_participants
-                    for a in result
-                ]
+                averaged: List[Any] = [None] * len(arrays)
+                for flat, members in zip(result, buckets.values()):
+                    flat = (
+                        (flat / num_participants).astype(flat.dtype)
+                        if flat.dtype.kind in ("f", "V")
+                        else flat // num_participants
+                    )
+                    offset = 0
+                    for i in members:
+                        size = arrays[i].size
+                        # Copy: leaves must not alias one shared bucket
+                        # buffer (or the caller's input via an echo PG).
+                        averaged[i] = (
+                            flat[offset : offset + size]
+                            .reshape(arrays[i].shape)
+                            .copy()
+                        )
+                        offset += size
                 return jax.tree_util.tree_unflatten(treedef, averaged)
 
             return self.wrap_work(work.then(callback), default=pytree)
