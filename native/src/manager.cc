@@ -227,6 +227,30 @@ RpcResult ManagerServer::handle_should_commit(const std::string& payload) {
               (long long)req.group_rank(), (int)req.should_commit());
 
   std::unique_lock<std::mutex> lock(mu_);
+  // Votes are step-tagged: after a rank's barrier call times out its vote
+  // stays registered, and without this check a retry or restarted process
+  // voting for a later step could complete a round with mixed-step votes
+  // (round-1 advisor finding). A newer-step vote aborts the stale round
+  // (waiters get should_commit=false); an older-step vote is rejected.
+  if (!commit_votes_.empty() && req.step() != commit_step_) {
+    if (req.step() < commit_step_) {
+      return {RpcStatus::kError,
+              "stale should_commit vote for step " + std::to_string(req.step()) +
+                  " (current round is step " + std::to_string(commit_step_) + ")"};
+    }
+    TPUFT_WARN("[Replica %s] aborting stale should_commit round for step %lld "
+               "(new vote is for step %lld)",
+               opt_.replica_id.c_str(), (long long)commit_step_,
+               (long long)req.step());
+    commit_decision_ = false;
+    commit_votes_.clear();
+    commit_failures_.clear();
+    commit_round_ += 1;
+    cv_.notify_all();
+  }
+  if (commit_votes_.empty()) {
+    commit_step_ = req.step();
+  }
   if (!req.should_commit()) {
     commit_failures_.insert(req.group_rank());
   }
@@ -235,6 +259,7 @@ RpcResult ManagerServer::handle_should_commit(const std::string& payload) {
 
   if (commit_votes_.size() == opt_.world_size) {
     commit_decision_ = commit_failures_.empty();
+    decided_round_ = seen_round;
     TPUFT_INFO("[Replica %s] should_commit completed should_commit=%d",
                opt_.replica_id.c_str(), (int)commit_decision_);
     commit_votes_.clear();
@@ -252,7 +277,11 @@ RpcResult ManagerServer::handle_should_commit(const std::string& payload) {
   }
 
   tpuft::ShouldCommitResponse resp;
-  resp.set_should_commit(commit_decision_);
+  // The decision is tagged with the round it belongs to: a waiter that
+  // wakes late (after further rounds decided or aborted) must not read a
+  // newer round's decision — answer false instead (a spurious non-commit
+  // is safe; a cross-step or split-brain commit is not).
+  resp.set_should_commit(decided_round_ == seen_round ? commit_decision_ : false);
   return {RpcStatus::kOk, resp.SerializeAsString()};
 }
 

@@ -88,6 +88,10 @@ class ManagerServer {
   std::set<int64_t> commit_votes_;
   std::set<int64_t> commit_failures_;
   uint64_t commit_round_ = 0;
+  // The round commit_decision_ belongs to (latched when a round decides, so
+  // late-waking waiters of older rounds never read a newer decision).
+  uint64_t decided_round_ = ~0ull;
+  int64_t commit_step_ = -1;
   bool commit_decision_ = false;
 
   std::atomic<bool> stop_{false};

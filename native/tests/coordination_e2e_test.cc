@@ -229,6 +229,75 @@ TPUFT_TEST(manager_multi_rank_commit_barrier_ands_votes) {
   lighthouse.shutdown();
 }
 
+TPUFT_TEST(manager_commit_votes_are_step_scoped) {
+  // A timed-out rank's registered vote must never combine with votes for a
+  // different step (round-1 advisor finding on handle_should_commit).
+  Lighthouse lighthouse(test_lighthouse_opt(1));
+  lighthouse.start();
+  ManagerServer manager(test_manager_opt("r0", lighthouse.address(), 2));
+  manager.start();
+
+  auto vote = [&](int64_t rank, int64_t step, int64_t timeout_ms) {
+    RpcClient client(manager.address(), 2000);
+    tpuft::ShouldCommitRequest req;
+    req.set_group_rank(rank);
+    req.set_step(step);
+    req.set_should_commit(true);
+    req.set_timeout_ms(timeout_ms);
+    return client.call(kManagerShouldCommit, req.SerializeAsString(), timeout_ms + 2000);
+  };
+
+  // Rank 0's step-5 barrier call times out; its vote stays registered.
+  EXPECT_EQ((int)vote(0, 5, 300).status, (int)RpcStatus::kTimeout);
+
+  // Rank 1 voting alone for step 6 must NOT complete a round against the
+  // stale step-5 vote — it aborts that round and then waits for rank 0.
+  EXPECT_EQ((int)vote(1, 6, 300).status, (int)RpcStatus::kTimeout);
+
+  // A full same-step round then completes true despite the leftovers.
+  auto f0 = std::async(std::launch::async, [&] { return vote(0, 7, 5000); });
+  auto f1 = std::async(std::launch::async, [&] { return vote(1, 7, 5000); });
+  RpcResult r0 = f0.get();
+  RpcResult r1 = f1.get();
+  EXPECT_EQ((int)r0.status, (int)RpcStatus::kOk);
+  EXPECT_EQ((int)r1.status, (int)RpcStatus::kOk);
+  tpuft::ShouldCommitResponse resp;
+  EXPECT_TRUE(resp.ParseFromString(r0.payload));
+  EXPECT_TRUE(resp.should_commit());
+  EXPECT_TRUE(resp.ParseFromString(r1.payload));
+  EXPECT_TRUE(resp.should_commit());
+
+  // Mid-round, an older-step vote is rejected outright instead of joining.
+  // There is no introspection RPC to observe "the newer vote is registered",
+  // so the ordering is retried: if the older vote raced in first (it then
+  // starts its own round, which the newer vote aborts → kOk(false)), try
+  // again with fresh step numbers until the intended interleaving happens.
+  bool saw_rejection = false;
+  for (int attempt = 0; attempt < 10 && !saw_rejection; ++attempt) {
+    int64_t newer = 9 + 2 * attempt;
+    int64_t older = newer - 1;
+    auto f2 = std::async(std::launch::async, [&] { return vote(0, newer, 1500); });
+    std::this_thread::sleep_for(std::chrono::milliseconds(100));
+    RpcResult stale = vote(1, older, 500);
+    if (stale.status == RpcStatus::kError) {
+      saw_rejection = true;
+    } else {
+      // Raced: the older vote registered first. Its round was aborted by
+      // the newer vote, so it must have come back kOk(false), never true.
+      EXPECT_EQ((int)stale.status, (int)RpcStatus::kOk);
+      tpuft::ShouldCommitResponse aborted;
+      EXPECT_TRUE(aborted.ParseFromString(stale.payload));
+      EXPECT_FALSE(aborted.should_commit());
+    }
+    // The newer-step voter never completes its round either way.
+    EXPECT_EQ((int)f2.get().status, (int)RpcStatus::kTimeout);
+  }
+  EXPECT_TRUE(saw_rejection);
+
+  manager.shutdown();
+  lighthouse.shutdown();
+}
+
 TPUFT_TEST(manager_multi_rank_quorum_gathers_all_ranks) {
   Lighthouse lighthouse(test_lighthouse_opt(1));
   lighthouse.start();

@@ -296,3 +296,83 @@ def test_bootstrap_multi_rank_group() -> None:
         assert results.get(0) == 1 and results.get(1) == 1, results
     finally:
         lighthouse.shutdown()
+
+
+def _safe_pickle_roots():
+    from torchft_tpu import _safe_pickle
+
+    return _safe_pickle._ALLOWED_ROOTS
+
+
+def test_safe_pickle_blocks_rce_gadgets_allows_ml_types() -> None:
+    """Network-received pickles resolve ML-ecosystem classes but refuse the
+    classic reduce gadgets (docs/security.md)."""
+    import pickle
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from torchft_tpu._safe_pickle import (
+        RestrictedUnpicklingError,
+        allow_module,
+        safe_loads,
+    )
+
+    # Everything tpuft puts on the wire round-trips.
+    import jax
+
+    tree = {"w": np.ones((2, 2), np.float32), "meta": ("a", 3, 2.5)}
+    assert safe_loads(pickle.dumps(tree))["meta"] == ("a", 3, 2.5)
+    treedef = jax.tree_util.tree_structure({"a": [1, 2], "b": 3})
+    assert safe_loads(pickle.dumps(treedef)) == treedef
+    _ = jnp  # jax arrays are staged to numpy before pickling
+
+    class Evil:
+        def __reduce__(self):
+            import os
+
+            return (os.system, ("true",))
+
+    with pytest.raises(RestrictedUnpicklingError, match="os.system|posix.system"):
+        safe_loads(pickle.dumps(Evil()))
+
+    class EvilGetattr:
+        def __reduce__(self):
+            return (getattr, (int, "__add__"))
+
+    with pytest.raises(RestrictedUnpicklingError, match="getattr"):
+        safe_loads(pickle.dumps(EvilGetattr()))
+
+    # The allowlist-widening gadget (round-1 review exploit): resolving
+    # _safe_pickle.allow_module via REDUCE must be refused even though the
+    # torchft_tpu root is allowlisted, and arbitrary module-level functions
+    # under allowed roots must not resolve either.
+    widen_exploit = (
+        b"\x80\x04"
+        + b"ctorchft_tpu._safe_pickle\nallow_module\n"
+        + b"(X\x02\x00\x00\x00ostR."
+    )
+    with pytest.raises(RestrictedUnpicklingError, match="denied module"):
+        safe_loads(widen_exploit)
+    assert "os" not in _safe_pickle_roots()
+
+    func_gadget = b"\x80\x04" + b"cnumpy\nload\n" + b"(X\x01\x00\x00\x00xtR."
+    with pytest.raises(RestrictedUnpicklingError, match="non-class"):
+        safe_loads(func_gadget)
+
+    # Opt-outs: explicit allowlist extension (restored after — the allowlist
+    # is process-global).
+    import uuid
+
+    from torchft_tpu import _safe_pickle
+
+    with pytest.raises(RestrictedUnpicklingError):
+        safe_loads(pickle.dumps(uuid.uuid4()))
+    snapshot = set(_safe_pickle._ALLOWED_ROOTS)
+    try:
+        allow_module("uuid")
+        value = uuid.uuid4()
+        assert safe_loads(pickle.dumps(value)) == value
+    finally:
+        _safe_pickle._ALLOWED_ROOTS.clear()
+        _safe_pickle._ALLOWED_ROOTS.update(snapshot)

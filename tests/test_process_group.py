@@ -368,8 +368,8 @@ def test_store_add_shares_keyspace_with_get(store_server) -> None:
 
 
 def test_managed_pg_routes_through_manager() -> None:
-    """ManagedProcessGroup parity (reference :1233-1266): allreduce gets
-    manager semantics; size() reports live participants."""
+    """ManagedProcessGroup parity (reference :1233-1266): every array routes
+    through the manager individually; result is a list in input order."""
     from unittest.mock import MagicMock
 
     from torchft_tpu.parallel.process_group import ManagedProcessGroup
@@ -379,10 +379,63 @@ def test_managed_pg_routes_through_manager() -> None:
     manager.num_participants.return_value = 3
     from torchft_tpu.work import _DummyWork
 
-    manager.allreduce.return_value = _DummyWork([np.ones(2)])
+    manager.allreduce.side_effect = lambda array, reduce_op: _DummyWork(array)
+    manager.allreduce_pytree.side_effect = lambda arrays: _DummyWork(list(arrays))
     pg = ManagedProcessGroup(manager)
-    out = pg.allreduce([np.ones(2)]).wait()
-    manager.allreduce.assert_called_once()
+    # Default AVG goes through the bucketed pytree path in one call.
+    out = pg.allreduce([np.ones(2), np.zeros((2, 2))]).wait()
+    assert manager.allreduce_pytree.call_count == 1
+    assert isinstance(out, list) and len(out) == 2
+    np.testing.assert_array_equal(out[0], np.ones(2))
+    np.testing.assert_array_equal(out[1], np.zeros((2, 2)))
+    # SUM routes per-array through manager.allreduce.
+    from torchft_tpu.parallel.process_group import ReduceOp as _Op
+
+    out = pg.allreduce([np.ones(2), np.zeros((2, 2))], op=_Op.SUM).wait()
+    assert manager.allreduce.call_count == 2
     np.testing.assert_array_equal(out[0], np.ones(2))
     assert pg.size() == 3
     assert pg.getBackendName() == "tpuft-managed"
+
+
+def test_managed_pg_real_manager_end_to_end() -> None:
+    """Non-mocked ManagedProcessGroup drill: heterogeneous-shape lists resolve
+    to per-array results through a real Manager; non-AVG/SUM ops raise instead
+    of silently averaging (round-1 advisor finding)."""
+    from torchft_tpu.coordination import LighthouseServer
+    from torchft_tpu.manager import Manager
+    from torchft_tpu.parallel.process_group import ManagedProcessGroup
+
+    lighthouse = LighthouseServer(min_replicas=1, join_timeout_ms=100)
+    store = StoreServer()
+    inner = ProcessGroupTCP(timeout=30.0)
+    manager = Manager(
+        pg=inner,
+        min_replica_size=1,
+        store=StoreClient(store.address()),
+        store_addr=store.address(),
+        lighthouse_addr=lighthouse.address(),
+        replica_id="managed-pg-test",
+        timeout=30.0,
+        quorum_timeout=60.0,
+        use_async_quorum=False,
+    )
+    try:
+        manager.start_quorum()
+        pg = ManagedProcessGroup(manager)
+        arrays = [np.ones(3, np.float32), np.full((2, 2), 4.0, np.float32)]
+        out = pg.allreduce(arrays, op=ReduceOp.AVG).wait(timeout=30)
+        assert isinstance(out, list) and len(out) == 2
+        np.testing.assert_allclose(out[0], np.ones(3))
+        np.testing.assert_allclose(out[1], np.full((2, 2), 4.0))
+        summed = pg.allreduce(arrays, op=ReduceOp.SUM).wait(timeout=30)
+        np.testing.assert_allclose(summed[1], np.full((2, 2), 4.0))
+        with pytest.raises(ValueError, match="SUM/AVG"):
+            pg.allreduce(arrays, op=ReduceOp.MAX)
+        assert pg.size() == 1
+        assert manager.should_commit()
+    finally:
+        manager.shutdown(wait=False)
+        inner.shutdown()
+        store.shutdown()
+        lighthouse.shutdown()

@@ -1,0 +1,147 @@
+"""Restricted unpickling for bytes received from network peers.
+
+The checkpoint/recovery wire formats carry pickled pytree structure
+(treedefs, metadata dataclasses, non-array leaves). Plain ``pickle.loads``
+on attacker-controlled bytes is remote code execution, and the transport
+servers bind ``[::]`` — the reference accepts this under a trusted-network
+assumption (torch.load ``weights_only=False``,
+/root/reference/torchft/checkpointing/http_transport.py:155-162). We keep
+the same *trust model* (run the coordination and transport planes on a
+private, trusted network — see docs/security.md) but reduce the blast
+radius: network-received pickles are decoded with an allowlisting
+Unpickler that only resolves classes from ML-ecosystem modules, which
+blocks the classic ``os.system``/``subprocess``/``getattr`` reduce gadgets.
+
+State dicts whose leaves are instances of other modules' classes can opt
+out with ``TPUFT_ALLOW_UNSAFE_PICKLE=1`` (only on trusted networks) or by
+extending the allowlist via :func:`allow_module`.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import pickle
+from typing import Any, Set
+
+__all__ = ["safe_loads", "allow_module", "RestrictedUnpicklingError"]
+
+UNSAFE_ENV = "TPUFT_ALLOW_UNSAFE_PICKLE"
+
+# Top-level modules whose classes may be resolved during unpickling. These
+# cover everything tpuft itself puts on the wire (numpy arrays + dtypes,
+# jax treedefs, flax/optax state containers, our meta dataclasses) plus the
+# stdlib containers they serialize through.
+_ALLOWED_ROOTS: Set[str] = {
+    "numpy",
+    "jax",
+    "jaxlib",
+    "ml_dtypes",
+    "flax",
+    "optax",
+    "chex",
+    "torchft_tpu",
+    "collections",
+    "functools",
+}
+
+# Safe builtins: literal constructors only. Notably absent: getattr, eval,
+# exec, compile, open, __import__ — the standard pickle RCE gadgets.
+_SAFE_BUILTINS: Set[str] = {
+    "complex",
+    "bytearray",
+    "set",
+    "frozenset",
+    "slice",
+    "range",
+    "tuple",
+    "list",
+    "dict",
+    "bool",
+    "int",
+    "float",
+    "str",
+    "bytes",
+    "object",
+}
+
+
+# Non-class globals (functions/registries) that legitimate payloads resolve
+# during unpickling. Exact (module, name) pairs only — REDUCE can call any
+# resolved callable, so arbitrary functions under allowed roots must NOT
+# resolve (e.g. torchft_tpu's own allow_module would be a one-call
+# allowlist bypass; process-spawning helpers would be gadgets).
+_ALLOWED_FUNCTIONS: Set[tuple] = {
+    ("numpy._core.multiarray", "_reconstruct"),
+    ("numpy._core.multiarray", "scalar"),
+    ("numpy.core.multiarray", "_reconstruct"),  # pre-2.0 pickles
+    ("numpy.core.multiarray", "scalar"),
+    ("jax._src.array", "_reconstruct_array"),
+    ("jax._src.tree_util", "default_registry"),
+}
+
+# Modules that must never resolve even though their root is allowed: this
+# module itself (its allow_module is an allowlist-widening gadget).
+_DENIED_MODULES = ("torchft_tpu._safe_pickle",)
+
+
+class RestrictedUnpicklingError(pickle.UnpicklingError):
+    """A network pickle referenced a global outside the allowlist."""
+
+
+def allow_module(root: str) -> None:
+    """Extends the unpickling allowlist with a top-level module name (for
+    user state dicts carrying custom leaf types). Only classes under the
+    module resolve; module-level functions stay blocked."""
+    _ALLOWED_ROOTS.add(root.split(".", 1)[0])
+
+
+def allow_function(module: str, name: str) -> None:
+    """Allows one exact module-level function to resolve (for user leaf
+    types whose ``__reduce__`` goes through a reconstruction function)."""
+    _ALLOWED_FUNCTIONS.add((module, name))
+
+
+class _RestrictedUnpickler(pickle.Unpickler):
+    def __init__(self, file: Any) -> None:
+        super().__init__(file)
+        # Snapshot at construction: a payload that somehow widens the
+        # process-global allowlists mid-load gains nothing for this (or any
+        # concurrent) load.
+        self._roots = frozenset(_ALLOWED_ROOTS)
+        self._functions = frozenset(_ALLOWED_FUNCTIONS)
+
+    def find_class(self, module: str, name: str) -> Any:
+        if module == "builtins":
+            if name in _SAFE_BUILTINS:
+                return super().find_class(module, name)
+            raise self._refuse(module, name, "builtin outside the safe set")
+        if module.split(".", 1)[0] not in self._roots:
+            raise self._refuse(module, name, "module root not allowlisted")
+        if module in _DENIED_MODULES:
+            raise self._refuse(module, name, "explicitly denied module")
+        obj = super().find_class(module, name)
+        if isinstance(obj, type):
+            return obj
+        if (module, name) in self._functions:
+            return obj
+        raise self._refuse(
+            module, name, "non-class global (REDUCE gadget surface)"
+        )
+
+    @staticmethod
+    def _refuse(module: str, name: str, why: str) -> RestrictedUnpicklingError:
+        return RestrictedUnpicklingError(
+            f"refusing to unpickle {module}.{name} from the network ({why}). "
+            f"If this type is part of your state dict, call torchft_tpu."
+            f"_safe_pickle.allow_module/allow_function, or set {UNSAFE_ENV}=1 "
+            f"on a trusted network (see docs/security.md)."
+        )
+
+
+def safe_loads(data: bytes) -> Any:
+    """``pickle.loads`` for network-received bytes, allowlist-restricted
+    unless ``TPUFT_ALLOW_UNSAFE_PICKLE=1``."""
+    if os.environ.get(UNSAFE_ENV) == "1":
+        return pickle.loads(data)
+    return _RestrictedUnpickler(io.BytesIO(data)).load()

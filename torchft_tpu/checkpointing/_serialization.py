@@ -18,6 +18,8 @@ from typing import Any, BinaryIO, List, Optional, Tuple
 
 import numpy as np
 
+from torchft_tpu._safe_pickle import safe_loads
+
 __all__ = [
     "save_state_dict",
     "load_state_dict",
@@ -163,7 +165,7 @@ def load_state_dict(stream: BinaryIO) -> Any:
     if magic != _MAGIC:
         raise ValueError("bad checkpoint stream magic")
     (header_len,) = _LEN.unpack(stream.read(_LEN.size))
-    treedef, metas, non_array = pickle.loads(stream.read(header_len))
+    treedef, metas, non_array = safe_loads(stream.read(header_len))
     non_array_iter = iter(non_array)
     leaves = []
     for meta in metas:

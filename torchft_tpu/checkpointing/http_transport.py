@@ -23,6 +23,7 @@ from typing import Any, Dict, List, Optional
 
 import jax
 
+from torchft_tpu._safe_pickle import safe_loads
 from torchft_tpu.checkpointing import _serialization
 from torchft_tpu.checkpointing.transport import CheckpointTransport
 
@@ -145,7 +146,7 @@ class HTTPTransport(CheckpointTransport[Any]):
         self, src_rank: int, metadata: str, step: int, timeout: float
     ) -> Any:
         base = f"{metadata}/checkpoint/{step}"
-        num_chunks, treedef = pickle.loads(_fetch(f"{base}/meta", timeout))
+        num_chunks, treedef = safe_loads(_fetch(f"{base}/meta", timeout))
         if num_chunks == 1:
             payloads = [_fetch(f"{base}/0", timeout)]
         else:

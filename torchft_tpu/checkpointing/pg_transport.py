@@ -20,6 +20,8 @@ from typing import Any, Callable, List, Optional
 import jax
 import numpy as np
 
+from torchft_tpu._safe_pickle import safe_loads
+
 from torchft_tpu.checkpointing import _serialization
 from torchft_tpu.checkpointing.transport import CheckpointTransport
 from torchft_tpu.parallel.process_group import ProcessGroup
@@ -90,10 +92,10 @@ class PGTransport(CheckpointTransport[Any]):
         (meta_buf,) = self._pg.recv(
             [np.empty(int(length_arr[0]), dtype=np.uint8)], src_rank
         ).wait(timeout)
-        meta: _StateDictMeta = pickle.loads(meta_buf.tobytes())
+        meta: _StateDictMeta = safe_loads(meta_buf.tobytes())
         if meta.step != step:
             raise ValueError(f"checkpoint step mismatch: wanted {step}, got {meta.step}")
-        treedef = pickle.loads(meta.treedef_bytes)
+        treedef = safe_loads(meta.treedef_bytes)
 
         # In-place template: reuse existing buffers where shapes match.
         template_leaves: Optional[List[Any]] = None

@@ -41,7 +41,7 @@ class _TimerHandle:
 
 class _TimeoutManager:
     """Single scheduler thread firing deadline callbacks, watched by a
-    watchdog that ``sys.exit(1)``s the process if the scheduler stalls."""
+    watchdog that hard-exits the process if the scheduler stalls."""
 
     def __init__(self) -> None:
         self._lock = threading.Condition()
@@ -109,9 +109,15 @@ class _TimeoutManager:
                 )
                 sys.stderr.flush()
                 self._exit(1)
+                # Only reachable when the exit seam is mocked (tests): end
+                # the watchdog thread instead of re-firing forever.
+                return
 
     def _exit(self, code: int) -> None:  # test seam
-        sys.exit(code)
+        # os._exit, not sys.exit: SystemExit raised in a non-main thread
+        # only kills that thread — the watchdog contract is a process
+        # hard-exit when the timeout scheduler is wedged.
+        os._exit(code)
 
 
 _TIMEOUT_MANAGER = _TimeoutManager()

@@ -21,6 +21,7 @@ TPU-first choices:
 
 from __future__ import annotations
 
+import warnings
 from dataclasses import dataclass
 from functools import partial
 from typing import Any, Dict, Optional, Tuple
@@ -117,12 +118,37 @@ class RMSNorm(nn.Module):
 
 
 def _sp_axis_in_mesh(axis: str) -> bool:
-    """True when running under a mesh (shard_map/jit) that has `axis`."""
+    """True when the ambient mesh has `axis` with size > 1.
+
+    Checks the modern accessors (``jax.sharding.set_mesh``/``use_mesh``)
+    first, then the legacy ``with mesh:`` context. If neither context API is
+    available the fallback is LOUD — silently choosing per-shard local
+    attention under an sp mesh would produce wrong results with no error
+    (round-1 advisor finding)."""
+    # get_abstract_mesh sees set_mesh/use_mesh contexts both inside and
+    # outside jit tracing (get_mesh raises under a jit trace).
+    abstract = jax.sharding.get_abstract_mesh()
+    if abstract is not None and axis in abstract.axis_names:
+        return abstract.shape[axis] > 1
+    # Legacy `with mesh:` contexts only publish through thread_resources; the
+    # public alias (jax.interpreters.pxla) is deprecated, so read the source
+    # object. When a future jax drops it entirely, warn instead of silently
+    # assuming "no sp axis".
     try:
-        env = jax.interpreters.pxla.thread_resources.env
-        return axis in env.physical_mesh.axis_names and env.physical_mesh.shape[axis] > 1
-    except Exception:  # noqa: BLE001
+        from jax._src.mesh import thread_resources
+
+        env_mesh = thread_resources.env.physical_mesh
+    except (ImportError, AttributeError):
+        warnings.warn(
+            "cannot detect a legacy with-Mesh context on this jax version; "
+            "attention_impl='auto' is assuming no sequence-parallel axis. "
+            "Pass attention_impl='ring' explicitly when running under an "
+            "sp-sharded mesh.",
+            RuntimeWarning,
+            stacklevel=2,
+        )
         return False
+    return axis in env_mesh.axis_names and env_mesh.shape[axis] > 1
 
 
 def causal_attention(

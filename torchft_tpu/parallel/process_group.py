@@ -38,6 +38,8 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from torchft_tpu._safe_pickle import safe_loads
+
 from torchft_tpu.parallel.store import StoreClient, create_store_client
 from torchft_tpu.work import Work, _DummyWork
 
@@ -199,7 +201,7 @@ def _pack_array(array: np.ndarray) -> bytes:
 
 def _unpack_array(payload: bytes) -> np.ndarray:
     (meta_len,) = _LEN_STRUCT.unpack_from(payload)
-    meta = pickle.loads(payload[_LEN_STRUCT.size : _LEN_STRUCT.size + meta_len])
+    meta = safe_loads(payload[_LEN_STRUCT.size : _LEN_STRUCT.size + meta_len])
     shape, _, dtype_name = meta
     # ml_dtypes names (e.g. bfloat16) resolve through the registry.
     try:
@@ -487,7 +489,7 @@ class ProcessGroupTCP(ProcessGroup):
                     self._sendto(epoch, peer, blob, deadline)
                 return result
             self._sendto(epoch, 0, pickle_dumps_arrays(arrays), deadline)
-            blobs = pickle.loads(self._recvfrom(epoch, 0, deadline))
+            blobs = safe_loads(self._recvfrom(epoch, 0, deadline))
             return [pickle_loads_arrays(b) for b in blobs]
 
         return self._submit(run)
@@ -800,8 +802,23 @@ class ManagedProcessGroup(_WrapperBase):
         super().__init__(manager._pg)
         self._manager = manager
 
-    def allreduce(self, arrays: Sequence[np.ndarray], op: ReduceOp = ReduceOp.SUM) -> Work:
-        return self._manager.allreduce(list(arrays))
+    def allreduce(self, arrays: Sequence[np.ndarray], op: ReduceOp = ReduceOp.AVG) -> Work:
+        # Default is AVG (gradient averaging), matching the reference's
+        # AVG-only ManagedProcessGroup (process_group.py:1251-1263). Only
+        # SUM/AVG have world-size-independent manager semantics (SUM +
+        # divide-by-participants); MAX/MIN would silently change meaning when
+        # non-participants contribute zeros, so reject them loudly.
+        if op not in (ReduceOp.SUM, ReduceOp.AVG):
+            raise ValueError(
+                f"ManagedProcessGroup.allreduce supports SUM/AVG only, got {op}"
+            )
+        if op == ReduceOp.AVG:
+            # One bucketed wire collective for the whole list (a list is a
+            # pytree) instead of one collective per array.
+            return self._manager.allreduce_pytree(list(arrays))
+        return Work.gather(
+            [self._manager.allreduce(array, reduce_op=op) for array in arrays]
+        )
 
     def size(self) -> int:
         return self._manager.num_participants()

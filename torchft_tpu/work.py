@@ -8,6 +8,7 @@ is not participating or the group has errored.
 
 from __future__ import annotations
 
+import threading
 from concurrent.futures import Future
 from typing import Any, Callable, Optional
 
@@ -60,6 +61,41 @@ class Work:
 
         self._future.add_done_callback(callback)
         return Work(out)
+
+    @classmethod
+    def gather(cls, works: "list[Work]") -> "Work":
+        """Combines several works into one resolving to the list of their
+        results (in input order). The first failure wins and propagates."""
+        out: Future = Future()
+        results: list = [None] * len(works)
+        state = {"remaining": len(works), "failed": False}
+        lock = threading.Lock()
+
+        if not works:
+            out.set_result([])
+            return cls(out)
+
+        def make_callback(index: int) -> Callable[["Future[Any]"], None]:
+            def callback(fut: "Future[Any]") -> None:
+                err = fut.exception()
+                with lock:
+                    if state["failed"]:
+                        return
+                    if err is not None:
+                        state["failed"] = True
+                        out.set_exception(err)
+                        return
+                    results[index] = fut.result()
+                    state["remaining"] -= 1
+                    finished = state["remaining"] == 0
+                if finished:
+                    out.set_result(list(results))
+
+            return callback
+
+        for index, work in enumerate(works):
+            work._future.add_done_callback(make_callback(index))
+        return cls(out)
 
     def with_error_handler(
         self, handler: Callable[[Exception], None], fallback: Any
