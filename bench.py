@@ -1,16 +1,19 @@
 #!/usr/bin/env python
 """Benchmark: fault-tolerant training throughput vs plain JAX on this chip.
 
-Runs the flagship Llama-family model twice on the local accelerator:
- 1. plain jitted train step (the no-fault-tolerance ceiling), and
- 2. the same step wrapped in the full tpuft path — per-step quorum via the
-    native coordination plane, gradient staging through the manager's
-    process group, and the commit barrier.
+Phases (all on the local accelerator):
+ 1. plain jitted train step — the no-fault-tolerance ceiling;
+ 2. Streaming DiLoCo through the full tpuft path (fused inner step, fp8
+    outer syncs) — the headline metric;
+ 3. per-step FT-DDP with fp8 device-quantized pipelined gradient sync;
+ 4. a 2-replica-group (threads) drill that measures the actual cross-group
+    wire sync cost, quorum latency percentiles, and steps lost when one
+    group is killed mid-run.
 
 The reference (pytorch/torchft) publishes no absolute numbers (BASELINE.md),
-so the headline metric is fault-tolerant tokens/sec with ``vs_baseline`` =
+so the headline is fault-tolerant tokens/sec with ``vs_baseline`` =
 FT throughput / plain throughput on identical hardware — 1.0 means the
-fault-tolerance layer is free; the reference's own design goal is the same
+fault-tolerance layer is free; the reference's design goal is the same
 "async quorum + overlapped comm ≈ no overhead" property (SURVEY.md §6).
 
 Prints exactly one JSON line.
@@ -20,7 +23,9 @@ from __future__ import annotations
 
 import json
 import os
+import statistics
 import sys
+import threading
 import time
 import subprocess
 from pathlib import Path
@@ -62,6 +67,23 @@ BATCH = int(os.environ.get("TPUFT_BENCH_BATCH", "8"))
 SEQ = int(os.environ.get("TPUFT_BENCH_SEQ", "512"))
 DEGRADED = False  # set when the accelerator probe fails
 
+# Known TPU peak bf16 matmul throughput per chip (for the MFU estimate).
+_PEAK_TFLOPS = {
+    "TPU v4": 275.0,
+    "TPU v5e": 197.0,
+    "TPU v5p": 459.0,
+    "TPU v6e": 918.0,
+    "TPU v5 lite": 197.0,
+}
+
+
+def _peak_tflops(device) -> float | None:
+    kind = getattr(device, "device_kind", "")
+    for name, peak in _PEAK_TFLOPS.items():
+        if name.lower() in str(kind).lower():
+            return peak
+    return None
+
 
 def main() -> None:
     _ensure_live_backend()
@@ -99,6 +121,9 @@ def main() -> None:
     model = Llama(config)
     tokens = jnp.zeros((BATCH, SEQ + 1), dtype=jnp.int32)
     params = model.init(jax.random.PRNGKey(0), tokens[:, :SEQ])
+    n_params = sum(
+        int(leaf.size) for leaf in jax.tree_util.tree_leaves(params)
+    )
     tx = optax.sgd(0.01, momentum=0.9)
 
     def loss_fn(p, batch_tokens):
@@ -112,11 +137,6 @@ def main() -> None:
         loss, grads = jax.value_and_grad(loss_fn)(p, batch_tokens)
         updates, opt_state = tx.update(grads, opt_state, p)
         return optax.apply_updates(p, updates), opt_state, loss
-
-    @jax.jit
-    def apply_update(p, opt_state, grads):
-        updates, opt_state = tx.update(grads, opt_state, p)
-        return optax.apply_updates(p, updates), opt_state
 
     def batch_for(step: int):
         return jax.random.randint(
@@ -179,8 +199,9 @@ def main() -> None:
 
     # Headline: Streaming DiLoCo (the cross-DCN semi-sync config the
     # reference benchmarks against torchtitan; sync_every matches its demo,
-    # train_diloco.py:195-204). Inner steps run at device speed; the
-    # cross-replica pseudogradient sync amortizes over sync_every steps.
+    # train_diloco.py:195-204). Inner steps run fused (ONE jitted dispatch
+    # for loss+grad+update); the cross-replica pseudogradient sync amortizes
+    # over sync_every steps.
     sync_every = min(int(os.environ.get("TPUFT_BENCH_SYNC_EVERY", "20")), sync_every_cap)
     # Delay must leave room inside the per-fragment cycle; only auto-clamp
     # when degraded shrinking changed the cycle, otherwise surface the
@@ -199,25 +220,28 @@ def main() -> None:
         should_quantize=True,
         fragment_sync_delay=fragment_sync_delay,
     )
+    diloco_step = algo.make_step_fn(loss_fn)
     try:
         for step in range(sync_every):  # one full warmup cycle incl. sync
-            algo.step(grad_fn(algo.params, batch_for(step))[1])
+            loss, _ = diloco_step(batch_for(step))
+        float(loss)
         diloco_steps = 2 * sync_every  # two full cycles
         t0 = time.monotonic()
         for step in range(diloco_steps):
-            algo.step(grad_fn(algo.params, batch_for(step))[1])
-        _ = float(jax.tree_util.tree_leaves(algo.params)[0].sum())
+            loss, _ = diloco_step(batch_for(step))
+        float(loss)
         diloco_elapsed = time.monotonic() - t0
     finally:
         teardown(handles)
     diloco_tps = diloco_steps * tokens_per_step / diloco_elapsed
 
-    # Secondary: per-step FT-DDP with fp8 device-quantized gradients (only
-    # payload + scales cross the host boundary; on this box that hop rides
-    # the remote-chip tunnel, so this is still the worst-case bound).
+    # Secondary: per-step FT-DDP with fp8 device-quantized gradients. The
+    # gradient sync is the pipelined bucket schedule and the optimizer
+    # update dispatches speculatively under the commit barrier.
     manager, handles = make_manager(use_async_quorum=True)
     opt = Optimizer(manager, tx, params)
     ddp_steps = max(STEPS // 4, 3)
+    quorum_times: list[float] = []
     try:
         for step in range(2):
             opt.begin_step()
@@ -226,7 +250,10 @@ def main() -> None:
         t0 = time.monotonic()
         committed = 0
         for step in range(ddp_steps):
+            q0 = time.monotonic()
             opt.begin_step()
+            manager.wait_quorum()
+            quorum_times.append(time.monotonic() - q0)
             _, grads = grad_fn(opt.params, batch_for(step))
             committed += bool(
                 opt.step(ft_allreduce_gradients(manager, grads, should_quantize=True))
@@ -236,6 +263,17 @@ def main() -> None:
     finally:
         teardown(handles)
     ddp_tps = committed * tokens_per_step / ddp_elapsed if committed else 0.0
+    quorum_p50_ms = round(1000 * statistics.median(quorum_times), 2) if quorum_times else None
+
+    # ---- 2-replica-group drill: wire sync cost + kill recovery ----
+    two_group = _two_group_drill()
+
+    # MFU estimate for the headline path: causal-LM forward+backward is
+    # ~6·N_params FLOPs/token plus the attention term 12·L·d·s.
+    flops_per_token = 6.0 * n_params + 12.0 * config.n_layers * config.dim * SEQ
+    model_tflops = diloco_tps * flops_per_token / 1e12
+    peak = _peak_tflops(jax.devices()[0])
+    mfu_pct = round(100.0 * model_tflops / peak, 2) if peak else None
 
     print(
         json.dumps(
@@ -246,12 +284,130 @@ def main() -> None:
                 "vs_baseline": round(diloco_tps / plain_tps, 4),
                 "plain_tokens_per_sec": round(plain_tps, 1),
                 "ft_ddp_tokens_per_sec": round(ddp_tps, 1),
+                "ft_ddp_vs_baseline": round(ddp_tps / plain_tps, 4) if plain_tps else None,
                 "degraded_cpu_fallback": DEGRADED,
                 "sync_every": sync_every,
                 "fragment_sync_delay": fragment_sync_delay,
+                "model_tflops_per_sec": round(model_tflops, 3),
+                "mfu_pct": mfu_pct,
+                "device_kind": str(getattr(jax.devices()[0], "device_kind", "unknown")),
+                "n_params": n_params,
+                "quorum_p50_ms": quorum_p50_ms,
+                **two_group,
             }
         )
     )
+
+
+def _two_group_drill() -> dict:
+    """2 replica groups on threads: measures the real cross-group wire sync
+    cost per step, quorum latency with >1 participant, and steps lost when
+    one group is killed mid-run (the BASELINE.md north stars)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from torchft_tpu.coordination import LighthouseServer
+    from torchft_tpu.ddp import ft_allreduce_gradients
+    from torchft_tpu.manager import Manager
+    from torchft_tpu.optim import Optimizer
+    from torchft_tpu.parallel.process_group import ProcessGroupTCP
+    from torchft_tpu.parallel.store import StoreClient, StoreServer
+
+    # Tiny model: this drill measures coordination + wire costs, not FLOPs
+    # (both thread-groups share one chip; compute throughput is phase 2/3's
+    # job).
+    def init_params(seed=0):
+        key = jax.random.PRNGKey(seed)
+        return {
+            "w1": jax.random.normal(key, (256, 256), jnp.float32) * 0.02,
+            "w2": jax.random.normal(key, (256, 128), jnp.float32) * 0.02,
+        }
+
+    def grad_like(params, step):
+        return jax.tree_util.tree_map(
+            lambda a: jnp.full(a.shape, 1e-3 * (step + 1), a.dtype), params
+        )
+
+    n_steps = 12
+    kill_at = 5
+    lighthouse = LighthouseServer(min_replicas=1, join_timeout_ms=2000)
+    sync_times: dict[int, list] = {0: [], 1: []}
+    quorum_times: dict[int, list] = {0: [], 1: []}
+    failed_commits = {0: 0, 1: 0}
+    committed_steps = {0: 0, 1: 0}
+
+    class _Killed(Exception):
+        pass
+
+    def group(idx: int) -> None:
+        attempts = 0
+        while attempts < 3:
+            attempts += 1
+            store = StoreServer()
+            pg = ProcessGroupTCP(timeout=20.0)
+            manager = Manager(
+                pg=pg,
+                min_replica_size=1,
+                store=StoreClient(store.address()),
+                store_addr=store.address(),
+                lighthouse_addr=lighthouse.address(),
+                replica_id=f"bench2g_{idx}",
+                timeout=20.0,
+                quorum_timeout=30.0,
+                use_async_quorum=True,
+                heartbeat_interval=0.05,
+            )
+            opt = Optimizer(manager, optax.sgd(0.05), init_params())
+            try:
+                while manager.current_step() < n_steps:
+                    step = manager.current_step()
+                    if idx == 1 and step == kill_at and attempts == 1:
+                        raise _Killed()  # simulated process death
+                    q0 = time.monotonic()
+                    opt.begin_step()
+                    manager.wait_quorum()
+                    quorum_times[idx].append(time.monotonic() - q0)
+                    grads = grad_like(opt.params, step)
+                    s0 = time.monotonic()
+                    avg = ft_allreduce_gradients(manager, grads)
+                    sync_times[idx].append(time.monotonic() - s0)
+                    if opt.step(avg):
+                        committed_steps[idx] += 1
+                    else:
+                        failed_commits[idx] += 1
+                return
+            except _Killed:
+                time.sleep(0.5)  # supervisor restart delay
+                continue
+            finally:
+                manager.shutdown(wait=False)
+                pg.shutdown()
+                store.shutdown()
+
+    threads = [threading.Thread(target=group, args=(i,)) for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=180)
+    lighthouse.shutdown()
+
+    survivor_sync = sync_times[0]
+    p50_sync_ms = (
+        round(1000 * statistics.median(survivor_sync), 2) if survivor_sync else None
+    )
+    all_quorum = quorum_times[0] + quorum_times[1]
+    return {
+        "two_group_sync_p50_ms": p50_sync_ms,
+        "two_group_quorum_p50_ms": (
+            round(1000 * statistics.median(all_quorum), 2) if all_quorum else None
+        ),
+        # Survivor commits that failed around the kill = steps lost to the
+        # failure (north star: < 1 outer step per kill).
+        "steps_lost_per_kill": failed_commits[0],
+        "two_group_committed_steps": committed_steps,
+    }
 
 
 if __name__ == "__main__":

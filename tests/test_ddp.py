@@ -1,0 +1,111 @@
+"""Pipelined gradient-sync tests (parity: reference ddp_test.py, plus the
+bucket scheduling that replaces the reference's overlapped comm hook)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from test_manager import make_manager, make_quorum
+
+from torchft_tpu.ddp import _plan_buckets, ft_allreduce_gradients
+from torchft_tpu.optim import Optimizer
+from torchft_tpu.parallel.process_group import ProcessGroupDummy
+
+
+def scripted_manager(**kwargs):
+    kwargs.setdefault("min_replica_size", 1)
+    manager, client, pg, transport = make_manager(pg=ProcessGroupDummy(), **kwargs)
+    client._quorum.return_value = make_quorum(replica_world_size=1, max_world_size=1)
+    client.should_commit.side_effect = lambda rank, step, vote, timeout: vote
+    return manager
+
+
+def test_plan_buckets_groups_same_dtype_up_to_cap() -> None:
+    leaves = [
+        np.ones(10, np.float32),  # 40 B
+        np.ones(10, np.float32),  # fits with previous under 100 B
+        np.ones(5, np.int32),  # separate dtype bucket
+        np.ones(20, np.float32),  # 80 B: overflows the open f32 bucket
+        np.ones(2, np.float32),  # joins the new f32 bucket
+    ]
+    buckets = _plan_buckets(leaves, cap_bytes=100)
+    assert buckets == [[0, 1], [2], [3, 4]]
+    # Order within and across buckets is flatten order (deterministic).
+    assert [i for b in buckets for i in sorted(b)] == sorted(range(5))
+
+
+def test_pipelined_allreduce_multi_bucket_identity(monkeypatch) -> None:
+    """With one participant, the pipelined bucket sync is an identity on the
+    gradient pytree — across many leaves, mixed float dtypes, and a bucket
+    cap small enough to force several wire messages."""
+    monkeypatch.setenv("TPUFT_BUCKET_MB", "0.0001")  # ~100 bytes per bucket
+    manager = scripted_manager()
+    manager.start_quorum()
+    grads = {
+        f"layer{i}": {
+            "w": jnp.full((7, 3), 0.5 + i, dtype=jnp.float32),
+            "b": jnp.full((11,), -1.0 * i, dtype=jnp.bfloat16),
+        }
+        for i in range(6)
+    }
+    out = ft_allreduce_gradients(manager, grads)
+    assert manager.errored() is None
+    for (path_a, leaf_out), (path_b, leaf_in) in zip(
+        jax.tree_util.tree_flatten_with_path(out)[0],
+        jax.tree_util.tree_flatten_with_path(grads)[0],
+    ):
+        assert path_a == path_b
+        assert isinstance(leaf_out, jax.Array)
+        assert leaf_out.dtype == leaf_in.dtype and leaf_out.shape == leaf_in.shape
+        np.testing.assert_array_equal(np.asarray(leaf_out), np.asarray(leaf_in))
+
+
+def test_pipelined_allreduce_int_leaves_fall_back() -> None:
+    manager = scripted_manager()
+    manager.start_quorum()
+    grads = {"w": jnp.ones((4,), jnp.float32), "count": jnp.ones((2,), jnp.int32)}
+    out = ft_allreduce_gradients(manager, grads)
+    np.testing.assert_array_equal(np.asarray(out["count"]), np.ones(2, np.int32))
+    np.testing.assert_array_equal(np.asarray(out["w"]), np.ones(4, np.float32))
+
+
+def test_optimizer_speculative_update_discarded_on_heal() -> None:
+    """If the commit barrier heals this replica, the speculatively dispatched
+    update must be recomputed against the healed state, not adopted."""
+    manager = scripted_manager()
+    manager.start_quorum()
+    tx = optax.sgd(0.1)
+    params = {"w": jnp.array([1.0, 1.0], dtype=jnp.float32)}
+    opt = Optimizer(manager, tx, params)
+
+    healed = {"w": jnp.array([10.0, 10.0], dtype=jnp.float32)}
+    real_should_commit = manager.should_commit
+
+    def healing_should_commit(timeout=None):
+        ok = real_should_commit(timeout=timeout)
+        # Simulate the barrier applying a donor state dict mid-call.
+        opt._load_state_dict({"params": healed, "opt_state": opt.opt_state})
+        return ok
+
+    manager.should_commit = healing_should_commit
+    grads = {"w": jnp.array([1.0, 2.0], dtype=jnp.float32)}
+    assert opt.step(grads)
+    # Update must apply to the HEALED params: 10 - 0.1*grad.
+    np.testing.assert_allclose(
+        np.asarray(opt.params["w"]), np.array([9.9, 9.8], np.float32), rtol=1e-6
+    )
+
+
+def test_optimizer_speculative_update_adopted_without_heal() -> None:
+    manager = scripted_manager()
+    manager.start_quorum()
+    tx = optax.sgd(0.1)
+    params = {"w": jnp.array([1.0, 1.0], dtype=jnp.float32)}
+    opt = Optimizer(manager, tx, params)
+    grads = {"w": jnp.array([1.0, 2.0], dtype=jnp.float32)}
+    assert opt.step(grads)
+    np.testing.assert_allclose(
+        np.asarray(opt.params["w"]), np.array([0.9, 0.8], np.float32), rtol=1e-6
+    )

@@ -249,3 +249,40 @@ def test_diloco_failure_timeline_golden(fail_sync_index: int) -> None:
     check_or_regen_golden(
         f"diloco_failure_timeline_{fail_sync_index}.json", history
     )
+
+
+def test_diloco_fused_step_matches_grads_path() -> None:
+    """make_step_fn (fused loss+update dispatch) produces bitwise the same
+    trajectory as step(grads) with the same schedule."""
+
+    def loss_fn(params, x):
+        pred = x @ params["w2"] * params["w1"].sum() + params["b"]
+        return (pred**2).mean()
+
+    x = jnp.full((4, 2), 0.1, dtype=jnp.float32)
+
+    managers = [scripted_manager(), scripted_manager()]
+    algos = [
+        DiLoCo(
+            m,
+            inner_tx=optax.sgd(0.01),
+            outer_tx=optax.sgd(0.7, momentum=0.9, nesterov=True),
+            params=make_params(),
+            sync_every=4,
+            n_fragments=2,
+        )
+        for m in managers
+    ]
+    fused = algos[1].make_step_fn(loss_fn)
+
+    for step in range(8):
+        grads = jax.grad(loss_fn)(algos[0].params, x)
+        committed_a = algos[0].step(grads)
+        loss, committed_b = fused(x)
+        assert committed_a == committed_b
+        assert float(loss) >= 0.0
+    for leaf_a, leaf_b in zip(
+        jax.tree_util.tree_leaves(algos[0].params),
+        jax.tree_util.tree_leaves(algos[1].params),
+    ):
+        np.testing.assert_array_equal(np.asarray(leaf_a), np.asarray(leaf_b))

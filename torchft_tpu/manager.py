@@ -323,7 +323,7 @@ class Manager:
 
             return self.wrap_work(work.then(callback), default=array)
         except Exception as e:  # noqa: BLE001
-            self._logger.exception(f"got exception in all reduce -- skipping remaining: {e}")
+            self._logger.exception(f"allreduce failed; poisoning this step (commit will be skipped): {e}")
             self.report_error(e)
             return _DummyWork(tensor)
 
@@ -343,6 +343,21 @@ class Manager:
         with trace_span("tpuft::manager::allreduce_pytree"):
             self.wait_quorum()
             num_participants = self.num_participants()
+            if self.is_lone_replica():
+                # Identity: SUM over one participant / 1. Resolve to host
+                # copies of the leaves (the documented numpy contract)
+                # without touching the wire.
+                return _DummyWork(
+                    jax.tree_util.tree_unflatten(
+                        treedef, [np.asarray(leaf) for leaf in leaves]
+                    )
+                )
+            # Launch every device→host copy before completing any: the
+            # per-leaf np.asarray then drains transfers that are already in
+            # flight instead of serializing them.
+            for leaf in leaves:
+                if isinstance(leaf, jax.Array):
+                    leaf.copy_to_host_async()
             arrays = [np.asarray(leaf) for leaf in leaves]
         if not self.is_participating():
             arrays = [np.zeros_like(a) for a in arrays]
@@ -396,7 +411,7 @@ class Manager:
 
             return self.wrap_work(work.then(callback), default=pytree)
         except Exception as e:  # noqa: BLE001
-            self._logger.exception(f"got exception in all reduce -- skipping remaining: {e}")
+            self._logger.exception(f"allreduce failed; poisoning this step (commit will be skipped): {e}")
             self.report_error(e)
             return _DummyWork(pytree)
 
@@ -414,6 +429,11 @@ class Manager:
         with trace_span("tpuft::manager::allreduce_prequantized"):
             self.wait_quorum()
             num_participants = self.num_participants()
+        if self.is_lone_replica():
+            # Averaging over one participant is the identity: skip the
+            # device→host→wire→device round trip entirely (the payload stays
+            # on device; callers feed it straight back to the dequant jit).
+            return self.wrap_work(_DummyWork((payload, scales)), default=None)
         if not self.is_participating():
             scales = scales * 0
         try:
@@ -423,7 +443,7 @@ class Manager:
                 default=None,
             )
         except Exception as e:  # noqa: BLE001
-            self._logger.exception(f"got exception in all reduce -- skipping remaining: {e}")
+            self._logger.exception(f"allreduce failed; poisoning this step (commit will be skipped): {e}")
             self.report_error(e)
             return _DummyWork(None)
 
@@ -734,6 +754,23 @@ class Manager:
         self.wait_quorum()
         assert self._participating_replica_world_size >= 0, "internal error"
         return self._participating_replica_world_size
+
+    def is_lone_replica(self) -> bool:
+        """True when this replica is ALONE on the wire for the current
+        quorum: sole participant AND a process-group world of one. Then
+        every averaging collective is an exact identity (SUM over one,
+        divided by one) and may skip the stage/wire round trip.
+
+        Both conditions matter: a healing joiner is a PG member without
+        being a participant, and if the survivor skipped the wire while the
+        joiner entered the collective, the joiner would average with nobody
+        and replica states would diverge (caught by the kill-recovery
+        bitwise-equality integ tests)."""
+        return (
+            self.num_participants() == 1
+            and self.is_participating()
+            and self._pg.size() <= 1
+        )
 
     def is_participating(self) -> bool:
         if self._participating_replica_rank is None:

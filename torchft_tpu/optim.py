@@ -151,6 +151,7 @@ class Optimizer:
         self.manager = manager
         self.tx = tx
         self.params = params
+        self._heal_count = 0
         self.opt_state = _align_opt_state(tx.init(params), params)
         manager.register_state_dict_fn(
             register_key, self._load_state_dict, self._state_dict
@@ -166,6 +167,8 @@ class Optimizer:
         # reassembled locally (each rank received its own shards).
         self.params = _as_device_tree(state["params"], like=self.params)
         self.opt_state = _as_device_tree(state["opt_state"], like=self.opt_state)
+        # Any speculative update dispatched before this heal is stale.
+        self._heal_count += 1
 
     def begin_step(
         self, timeout: Optional[float] = None, shrink_only: bool = False
@@ -179,13 +182,22 @@ class Optimizer:
 
     def step(self, grads: Any, timeout: Optional[float] = None) -> bool:
         """Commits the step; on success applies ``grads`` to the (possibly
-        just-healed) owned state. Returns whether the step committed."""
-        import optax
+        just-healed) owned state. Returns whether the step committed.
 
+        The update is dispatched **speculatively**: the jitted optimizer
+        math runs on device while the commit-barrier RPC is in flight (the
+        analogue of the reference overlapping should_commit's stream syncs,
+        manager.py:569-581 + :816-827). If the barrier heals this replica
+        (state replaced mid-call), the speculation is discarded and the
+        update re-applies against the healed state."""
         # Bound the device work before voting: a replica whose math never
         # finished must not vote to commit (the stream-sync analogue of
         # reference manager.py:816-827).
         grads = jax.block_until_ready(grads)
+        heal_count = self._heal_count
+        spec_params, spec_opt_state = self._jit_update(
+            grads, self.opt_state, self.params
+        )
         # NOTE: should_commit may invoke _load_state_dict (healing); use
         # self.params/opt_state only after it returns.
         if not self.manager.should_commit(timeout=timeout):
@@ -194,9 +206,13 @@ class Optimizer:
         # staging on the quorum thread) never reads a torn params/opt pair.
         self.manager.disallow_state_dict_read()
         try:
-            self.params, self.opt_state = self._jit_update(
-                grads, self.opt_state, self.params
-            )
+            if self._heal_count != heal_count:
+                # Healed during the barrier: recompute on the new state.
+                self.params, self.opt_state = self._jit_update(
+                    grads, self.opt_state, self.params
+                )
+            else:
+                self.params, self.opt_state = spec_params, spec_opt_state
         finally:
             self.manager.allow_state_dict_read()
         return True

@@ -185,10 +185,15 @@ def allreduce_quantized_wire(
     if reduce_op not in (ReduceOp.SUM, ReduceOp.AVG):
         raise ValueError(f"unsupported reduce op: {reduce_op}")
     world_size = pg.size()
+    # Kick off the device→host copies now (non-blocking) so they progress
+    # while this call returns and the caller keeps dispatching inner steps.
+    for array in (payload, scales):
+        if hasattr(array, "copy_to_host_async"):
+            array.copy_to_host_async()
 
     def pipeline():
-        # The device->host fetch happens HERE, on the pipeline thread, so a
-        # streaming caller (fragment_sync_delay > 0) overlaps the transfer
+        # The device->host fetch completes HERE, on the pipeline thread, so
+        # a streaming caller (fragment_sync_delay > 0) overlaps the transfer
         # with further inner steps.
         payload_h = np.asarray(payload)
         scales_h = np.asarray(scales, dtype=np.float32)

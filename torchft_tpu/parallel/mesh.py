@@ -124,6 +124,11 @@ def ft_allreduce_sharded(
     match across groups — guaranteed when every group runs the same model
     under the same intra-slice mesh, the invariant HSDP already requires.
     """
+    from torchft_tpu.ddp import _single_participant_identity
+
+    if _single_participant_identity(manager):
+        return grads
+
     leaves, treedef = jax.tree_util.tree_flatten(grads)
 
     # Stage: per-leaf list of (device, host_shard) in index order.
