@@ -202,13 +202,18 @@ def ddp_train_loop(
     opt = Optimizer(manager, optax.sgd(0.05), _init_model_params())
 
     history: Dict[int, Any] = {}
+    quorum_times: List[float] = []
+    failed_commits = 0
     try:
         while manager.current_step() < runner.num_steps:
             step = manager.current_step()
             if runner.injector is not None:
                 runner.injector.check(runner.replica_group, step, pg)
 
+            t0 = time.monotonic()
             opt.begin_step()
+            manager.wait_quorum()
+            quorum_times.append(time.monotonic() - t0)
             x, y = _batch_for(step, runner.replica_group)
             grads = _grad_fn(opt.params, x, y)
             avg_grads = ft_allreduce_gradients(manager, grads)
@@ -217,10 +222,14 @@ def ddp_train_loop(
                 history[manager.current_step()] = jax.tree_util.tree_map(
                     lambda a: jnp.array(a), opt.params
                 )
+            else:
+                failed_commits += 1
         return {
             "state_dict": {"params": opt.params, "opt_state": opt.opt_state},
             "history": history,
             "manager_state": manager.state_dict(),
+            "quorum_times": quorum_times,
+            "failed_commits": failed_commits,
         }
     finally:
         manager.shutdown(wait=False)
@@ -275,14 +284,19 @@ def diloco_train_loop(
             should_quantize=should_quantize,
         )
         inner_iter = 0
+        failed_syncs = 0  # outer steps lost (north star: <= 1 per kill)
         while manager.current_step() < num_syncs:
             if runner.injector is not None:
                 runner.injector.check(runner.replica_group, manager.current_step(), pg)
             x, y = _batch_for(1000 + inner_iter, runner.replica_group)
             grads = _grad_fn(algo.params, x, y)
-            algo.step(grads)
+            sync_due = algo._local_step + 1 == algo._sync_every
+            committed = algo.step(grads)
+            if sync_due and not committed:
+                failed_syncs += 1
             inner_iter += 1
         return {
+            "failed_syncs": failed_syncs,
             "global_state": [
                 {
                     "backup": [np.array(b) for b in frag.backup],

@@ -1,0 +1,23 @@
+"""The HSDP x replica-axis end-to-end proof in CI: 2 replica groups on
+disjoint sharded meshes, one kill, live heal of sharded state, bitwise
+equality (parity: reference fsdp_test.py:49-120 plus kill injection)."""
+
+import sys
+from pathlib import Path
+
+import jax
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import __graft_entry__ as graft
+
+
+def test_ft_multichip_drill_kill_heal_bitwise() -> None:
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    out = graft.ft_multichip_drill(8, n_steps=5, kill_at=2)
+    assert out["groups"] == 2
+    assert out["kills"] == 1
+    assert out["fsdp"] == 2 and out["tp"] == 2
+    assert out["final_step"] == 5

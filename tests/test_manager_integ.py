@@ -84,6 +84,41 @@ def test_ddp_recovery_after_replica_kill(lighthouse) -> None:
     results = run_replica_groups(runners, timeout=180)
     assert injector.count == 1
     assert_groups_converged(results, 4)
+    # North star (BASELINE.md): a kill costs the survivor < 1 step — at most
+    # the in-flight commit may fail when the peer vanishes mid-allreduce.
+    assert results[0][0]["failed_commits"] <= 1, results[0][0]["failed_commits"]
+
+
+def test_quorum_latency_north_star(lighthouse) -> None:
+    """BASELINE.md north star: steady-state (fast-quorum) latency p50 stays
+    within 2x the lighthouse tick. The first step is excluded — it includes
+    the join/rendezvous round. Wall-clock on a 1-core GIL-scheduled box is
+    noisy (CLAUDE.md), so a failing measurement is retried once before the
+    assertion counts."""
+    import statistics
+
+    def measure() -> float:
+        runners = [
+            Runner(
+                replica_group=i,
+                lighthouse_addr=lighthouse.address(),
+                train_loop=ddp_train_loop,
+                num_steps=8,
+                use_async_quorum=False,
+            )
+            for i in range(2)
+        ]
+        results = run_replica_groups(runners, timeout=180)
+        assert_groups_converged(results, 8)
+        steady = [t for group in results for t in group[0]["quorum_times"][1:]]
+        return 1000 * statistics.median(steady)
+
+    # Lighthouse tick is 100ms (native default, matching the reference's
+    # quorum_tick_ms); fast quorum resolves without waiting a full tick.
+    p50_ms = measure()
+    if p50_ms >= 200.0:
+        p50_ms = measure()  # damp transient machine load
+    assert p50_ms < 200.0, f"steady-state quorum p50 {p50_ms:.1f}ms >= 2x tick"
 
 
 def test_ddp_recovery_after_allreduce_failure(lighthouse) -> None:
