@@ -245,3 +245,37 @@ def test_periodic_checkpointer_empty_dir(tmp_path) -> None:
     ckpt = PeriodicCheckpointer(manager, str(tmp_path / "none"))
     assert ckpt.restore_or_none() is None
     ckpt.close()
+
+
+def test_load_state_dict_template_in_place_and_contiguity_guard() -> None:
+    """Stream decode into an existing template: matching contiguous leaves
+    are filled IN PLACE (same storage); non-contiguous or mismatched leaves
+    fall back to fresh arrays instead of silently returning stale data."""
+    import io
+
+    import numpy as np
+
+    from torchft_tpu.checkpointing import _serialization
+
+    state = {
+        "a": np.arange(12, dtype=np.float32).reshape(3, 4),
+        "b": np.full((2, 2), 7.0, np.float64),
+        "meta": "tag",
+    }
+    wire = _serialization.dumps(state)
+
+    template = {
+        "a": np.zeros((3, 4), np.float32),
+        "b": np.zeros((4, 2), np.float64)[::2],  # non-contiguous view
+        "meta": None,
+    }
+    out = _serialization.load_state_dict(io.BytesIO(wire), template=template)
+    np.testing.assert_array_equal(out["a"], state["a"])
+    np.testing.assert_array_equal(out["b"], state["b"])
+    assert out["meta"] == "tag"
+    # In-place: the contiguous template leaf IS the output.
+    assert out["a"] is template["a"]
+    # Non-contiguous template leaf was not used (fresh array, template
+    # untouched).
+    assert out["b"] is not template["b"]
+    np.testing.assert_array_equal(template["b"], np.zeros((2, 2)))

@@ -134,19 +134,60 @@ def state_dict_meta(state_dict: Any) -> Tuple[Any, List[Optional[ArrayMeta]], Li
     return treedef, metas, leaves
 
 
-def save_state_dict(state_dict: Any, stream: BinaryIO) -> None:
+@dataclass
+class Prepared:
+    """A staged-for-serving state dict: header bytes + host leaves, with the
+    exact serialized size known up front. Holds ONE host copy of the data
+    (the leaves themselves) — serving writes straight from these buffers, so
+    no second serialized copy ever exists (the round-1 2x-peak-memory
+    finding on HTTPTransport, reference http_transport.py:128-137)."""
+
+    header: bytes
+    leaves: List[Any]
+    metas: List[Optional[ArrayMeta]]
+    total_size: int
+
+
+def prepare(state_dict: Any) -> Prepared:
     treedef, metas, leaves = state_dict_meta(state_dict)
     non_array = [leaf for leaf, meta in zip(leaves, metas) if meta is None]
     header = pickle.dumps((treedef, metas, non_array))
-    stream.write(_MAGIC)
-    stream.write(_LEN.pack(len(header)))
-    stream.write(header)
-    for leaf, meta in zip(leaves, metas):
+    payload = 0
+    for meta in metas:
         if isinstance(meta, ArrayMeta):
-            stream.write(np.ascontiguousarray(leaf).tobytes())
+            payload += meta.nbytes
+        elif isinstance(meta, ShardedLeafMeta):
+            payload += sum(meta.shard_nbytes)
+    total = len(_MAGIC) + _LEN.size + len(header) + payload
+    return Prepared(header, leaves, metas, total)
+
+
+def _bytes_view(arr: np.ndarray) -> memoryview:
+    """Raw-byte memoryview of an array without copying (works for ml_dtypes
+    custom dtypes, which reject the buffer protocol directly)."""
+    arr = np.ascontiguousarray(arr)
+    try:
+        return memoryview(arr).cast("B")
+    except (ValueError, TypeError):
+        return np.atleast_1d(arr).view(np.uint8).reshape(-1).data
+
+
+def write_prepared(prepared: Prepared, stream: BinaryIO) -> None:
+    """Streams a :class:`Prepared` state dict; writes are memoryviews of the
+    staged host arrays (no payload-sized intermediate buffers)."""
+    stream.write(_MAGIC)
+    stream.write(_LEN.pack(len(prepared.header)))
+    stream.write(prepared.header)
+    for leaf, meta in zip(prepared.leaves, prepared.metas):
+        if isinstance(meta, ArrayMeta):
+            stream.write(_bytes_view(leaf))
         elif isinstance(meta, ShardedLeafMeta):
             for _, data in leaf.shards:
-                stream.write(np.ascontiguousarray(data).tobytes())
+                stream.write(_bytes_view(data))
+
+
+def save_state_dict(state_dict: Any, stream: BinaryIO) -> None:
+    write_prepared(prepare(state_dict), stream)
 
 
 def _resolve_dtype(name: str) -> np.dtype:
@@ -158,7 +199,45 @@ def _resolve_dtype(name: str) -> np.dtype:
         return np.dtype(getattr(ml_dtypes, name))
 
 
-def load_state_dict(stream: BinaryIO) -> Any:
+def _read_array(stream: BinaryIO, shape, dtype: np.dtype, nbytes: int, out=None) -> np.ndarray:
+    """Reads ``nbytes`` straight into the final (or provided) buffer — no
+    intermediate bytes object, so decode peak stays at one payload copy.
+    ``out`` enables in-place receive (zero allocation when shapes match)."""
+    if out is not None and (
+        tuple(out.shape) != tuple(shape)
+        or out.dtype != dtype
+        # A non-contiguous template would make _bytes_view fill a copy and
+        # silently return the untouched original.
+        or not out.flags["C_CONTIGUOUS"]
+        or not out.flags.writeable
+    ):
+        out = None
+    arr = out if out is not None else np.empty(shape, dtype=dtype)
+    view = _bytes_view(arr)
+    if len(view) != nbytes:
+        raise ValueError(f"buffer/wire size mismatch: {len(view)} != {nbytes}")
+    got = 0
+    readinto = getattr(stream, "readinto", None)
+    while got < nbytes:
+        if readinto is not None:
+            n = readinto(view[got:])
+        else:
+            chunk = stream.read(nbytes - got)
+            n = len(chunk)
+            view[got : got + n] = chunk
+        if not n:
+            raise EOFError(
+                f"truncated checkpoint stream: wanted {nbytes} bytes, got {got}"
+            )
+        got += n
+    return arr
+
+
+def load_state_dict(stream: BinaryIO, template: Any = None) -> Any:
+    """Decodes a state pytree from ``stream``. With ``template`` (a pytree of
+    same-structure arrays), matching leaves are received **in place** into
+    the template's buffers — the PGTransport fast path
+    (reference pg_transport.py:230-286)."""
     import jax
 
     magic = stream.read(len(_MAGIC))
@@ -166,9 +245,18 @@ def load_state_dict(stream: BinaryIO) -> Any:
         raise ValueError("bad checkpoint stream magic")
     (header_len,) = _LEN.unpack(stream.read(_LEN.size))
     treedef, metas, non_array = safe_loads(stream.read(header_len))
+    template_leaves: List[Any] = []
+    if template is not None:
+        # is_leaf on None: the wire's non-array leaves may be None, which
+        # tree_flatten would otherwise drop, misaligning leaf indices.
+        template_leaves = jax.tree_util.tree_flatten(
+            template, is_leaf=lambda x: x is None
+        )[0]
+        if len(template_leaves) != len(metas):
+            template_leaves = []
     non_array_iter = iter(non_array)
     leaves = []
-    for meta in metas:
+    for i, meta in enumerate(metas):
         if meta is None:
             leaves.append(next(non_array_iter))
         elif isinstance(meta, ShardedLeafMeta):
@@ -177,19 +265,16 @@ def load_state_dict(stream: BinaryIO) -> Any:
             for key, shape, nbytes in zip(
                 meta.shard_keys, meta.shard_shapes, meta.shard_nbytes
             ):
-                buf = stream.read(nbytes)
-                if len(buf) != nbytes:
-                    raise EOFError("truncated checkpoint stream (sharded leaf)")
-                shards.append((key, np.frombuffer(buf, dtype=dtype).reshape(shape).copy()))
+                shards.append((key, _read_array(stream, shape, dtype, nbytes)))
             leaves.append(ShardedLeaf(meta.global_shape, meta.dtype, shards))
         else:
             dtype = _resolve_dtype(meta.dtype)
-            buf = stream.read(meta.nbytes)
-            if len(buf) != meta.nbytes:
-                raise EOFError(
-                    f"truncated checkpoint stream: wanted {meta.nbytes} bytes, got {len(buf)}"
-                )
-            leaves.append(np.frombuffer(buf, dtype=dtype).reshape(meta.shape).copy())
+            out = None
+            if template_leaves:
+                candidate = template_leaves[i]
+                if isinstance(candidate, np.ndarray):
+                    out = candidate
+            leaves.append(_read_array(stream, meta.shape, dtype, meta.nbytes, out=out))
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 

@@ -31,9 +31,13 @@ __all__ = ["HTTPTransport"]
 
 
 class _Staged:
-    def __init__(self, step: int, chunks: List[bytes], treedef: Any) -> None:
+    """Prepared (header + host leaves) per chunk — ONE host copy total; the
+    HTTP handlers stream straight from these buffers (no serialized copy,
+    the round-1 2x-peak-memory finding)."""
+
+    def __init__(self, step: int, chunks: List[Any], treedef: Any) -> None:
         self.step = step
-        self.chunks = chunks
+        self.chunks = chunks  # List[_serialization.Prepared]
         self.treedef = treedef
 
 
@@ -86,22 +90,32 @@ class HTTPTransport(CheckpointTransport[Any]):
                     return
                 if parts[2] == "meta":
                     body = pickle.dumps((len(staged.chunks), staged.treedef))
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/octet-stream")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
                 elif parts[2] == "full":
-                    body = b"".join(
-                        len(c).to_bytes(8, "big") + c for c in staged.chunks
-                    )
+                    total = sum(8 + c.total_size for c in staged.chunks)
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/octet-stream")
+                    self.send_header("Content-Length", str(total))
+                    self.end_headers()
+                    for chunk in staged.chunks:
+                        self.wfile.write(chunk.total_size.to_bytes(8, "big"))
+                        _serialization.write_prepared(chunk, self.wfile)
                 else:
                     try:
-                        index = int(parts[2])
-                        body = staged.chunks[index]
+                        chunk = staged.chunks[int(parts[2])]
                     except (ValueError, IndexError):
                         self.send_error(400, "bad chunk index")
                         return
-                self.send_response(200)
-                self.send_header("Content-Type", "application/octet-stream")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/octet-stream")
+                    self.send_header("Content-Length", str(chunk.total_size))
+                    self.end_headers()
+                    # Streams directly from the staged host arrays.
+                    _serialization.write_prepared(chunk, self.wfile)
                 transport._served_event.set()
 
         class DualStackServer(ThreadingHTTPServer):
@@ -133,7 +147,9 @@ class HTTPTransport(CheckpointTransport[Any]):
         chunk_dicts: List[Dict[int, Any]] = [dict() for _ in range(n)]
         for i, leaf in enumerate(leaves):
             chunk_dicts[i % n][i] = leaf
-        chunks = [_serialization.dumps(chunk) for chunk in chunk_dicts]
+        # prepare() keeps the host leaves + a small header per chunk; the
+        # serialized bytes never exist as a second whole-payload copy.
+        chunks = [_serialization.prepare(chunk) for chunk in chunk_dicts]
         with self._cond:
             self._staged = _Staged(step, chunks, treedef)
             self._cond.notify_all()
@@ -147,18 +163,21 @@ class HTTPTransport(CheckpointTransport[Any]):
     ) -> Any:
         base = f"{metadata}/checkpoint/{step}"
         num_chunks, treedef = safe_loads(_fetch(f"{base}/meta", timeout))
+
+        def fetch_chunk(i: int) -> Any:
+            # Stream-decode straight off the socket into final buffers: peak
+            # memory = final leaves + one in-flight read window per chunk.
+            with urllib.request.urlopen(f"{base}/{i}", timeout=timeout) as resp:
+                return _serialization.load_state_dict(resp)
+
         if num_chunks == 1:
-            payloads = [_fetch(f"{base}/0", timeout)]
+            chunks = [fetch_chunk(0)]
         else:
             with ThreadPoolExecutor(max_workers=min(num_chunks, 8)) as pool:
-                payloads = list(
-                    pool.map(
-                        lambda i: _fetch(f"{base}/{i}", timeout), range(num_chunks)
-                    )
-                )
+                chunks = list(pool.map(fetch_chunk, range(num_chunks)))
         merged: Dict[int, Any] = {}
-        for payload in payloads:
-            merged.update(_serialization.loads(payload))
+        for chunk in chunks:
+            merged.update(chunk)
         leaves = [merged[i] for i in range(len(merged))]
         return jax.tree_util.tree_unflatten(treedef, leaves)
 

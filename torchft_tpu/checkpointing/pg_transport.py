@@ -135,9 +135,7 @@ class PGTransport(CheckpointTransport[Any]):
             else:
                 target = np.empty(leaf_meta.shape, dtype=dtype)
             (received,) = self._pg.recv([target], src_rank).wait(timeout)
-            if target.shape == received.shape and target.dtype == received.dtype:
-                np.copyto(target, received)
-                leaves.append(target)
-            else:
-                leaves.append(received)
+            # The PG decodes into `target`'s storage when shape/dtype match
+            # (true in-place receive); otherwise it returns a fresh array.
+            leaves.append(received)
         return jax.tree_util.tree_unflatten(treedef, leaves)

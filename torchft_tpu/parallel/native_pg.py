@@ -390,6 +390,8 @@ class ProcessGroupNative(ProcessGroup):
 
     def recv(self, shapes_like: Sequence[np.ndarray], src: int, tag: int = 0) -> Work:
         timeout_ms = int(self._timeout * 1000)
+        # In-place receive targets (PGTransport template fast path).
+        targets = [a if isinstance(a, np.ndarray) else None for a in shapes_like]
 
         def run(handle: int) -> List[np.ndarray]:
             header = np.zeros(1, dtype=np.int64)
@@ -406,7 +408,7 @@ class ProcessGroupNative(ProcessGroup):
                 handle,
                 "recv",
             )
-            return pickle_loads_arrays(payload.tobytes())
+            return pickle_loads_arrays(payload.tobytes(), out=targets)
 
         return self._submit(run)
 

@@ -199,7 +199,7 @@ def _pack_array(array: np.ndarray) -> bytes:
     return _LEN_STRUCT.pack(len(meta)) + meta + array.tobytes()
 
 
-def _unpack_array(payload: bytes) -> np.ndarray:
+def _unpack_array(payload: bytes, out: Optional[np.ndarray] = None) -> np.ndarray:
     (meta_len,) = _LEN_STRUCT.unpack_from(payload)
     meta = safe_loads(payload[_LEN_STRUCT.size : _LEN_STRUCT.size + meta_len])
     shape, _, dtype_name = meta
@@ -211,7 +211,18 @@ def _unpack_array(payload: bytes) -> np.ndarray:
 
         dtype = np.dtype(getattr(ml_dtypes, dtype_name))
     data = payload[_LEN_STRUCT.size + meta_len :]
-    return np.frombuffer(data, dtype=dtype).reshape(shape).copy()
+    view = np.frombuffer(data, dtype=dtype).reshape(shape)
+    if (
+        out is not None
+        and tuple(out.shape) == tuple(shape)
+        and out.dtype == dtype
+        and out.flags.writeable
+    ):
+        # In-place receive: decode into the caller's existing storage (the
+        # PGTransport template fast path — no result allocation).
+        np.copyto(out, view)
+        return out
+    return view.copy()
 
 
 class _Epoch:
@@ -565,8 +576,12 @@ class ProcessGroupTCP(ProcessGroup):
         return self._submit(run)
 
     def recv(self, shapes_like: Sequence[np.ndarray], src: int, tag: int = 0) -> Work:
+        targets = [a if isinstance(a, np.ndarray) else None for a in shapes_like]
+
         def run(epoch: _Epoch, deadline: float) -> List[np.ndarray]:
-            return pickle_loads_arrays(self._recvfrom(epoch, src, deadline))
+            return pickle_loads_arrays(
+                self._recvfrom(epoch, src, deadline), out=targets
+            )
 
         return self._submit(run)
 
@@ -583,16 +598,19 @@ def pickle_dumps_arrays(arrays: Sequence[np.ndarray]) -> bytes:
     return b"".join(parts)
 
 
-def pickle_loads_arrays(payload: bytes) -> List[np.ndarray]:
+def pickle_loads_arrays(
+    payload: bytes, out: Optional[Sequence[np.ndarray]] = None
+) -> List[np.ndarray]:
     (count,) = struct.unpack_from("!I", payload)
     offset = 4
-    out = []
-    for _ in range(count):
+    result = []
+    for index in range(count):
         (length,) = _LEN_STRUCT.unpack_from(payload, offset)
         offset += _LEN_STRUCT.size
-        out.append(_unpack_array(payload[offset : offset + length]))
+        target = out[index] if out is not None and index < len(out) else None
+        result.append(_unpack_array(payload[offset : offset + length], out=target))
         offset += length
-    return out
+    return result
 
 
 # ---------------------------------------------------------------------------
