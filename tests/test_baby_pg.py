@@ -123,3 +123,81 @@ def test_baby_abort_fails_pending(store_server) -> None:
     finally:
         for pg in pgs:
             pg.shutdown()
+
+
+def test_baby_shared_memory_large_arrays(store_server) -> None:
+    """Arrays >= 1 MiB ride shared memory through the pipe (descriptor only)
+    and come back correct; small arrays keep the pickle path."""
+    pgs = _configure_pair(store_server, "shm")
+    big = 1 << 19  # 512k float32 = 2 MiB
+    try:
+        with ThreadPoolExecutor(max_workers=2) as pool:
+            results = list(
+                pool.map(
+                    lambda i: pgs[i]
+                    .allreduce(
+                        [
+                            np.full(big, float(i + 1), np.float32),
+                            np.full(3, 10.0 * (i + 1), np.float32),
+                        ]
+                    )
+                    .wait(60),
+                    range(2),
+                )
+            )
+        for res in results:
+            np.testing.assert_allclose(res[0], np.full(big, 3.0, np.float32))
+            np.testing.assert_allclose(res[1], np.full(3, 30.0, np.float32))
+        # Segment bookkeeping drains once ops complete.
+        for pg in pgs:
+            assert pg.num_active_work() == 0
+            assert not pg._op_segments
+    finally:
+        for pg in pgs:
+            pg.shutdown()
+
+
+def test_baby_wedged_child_is_killed_and_recovers(store_server) -> None:
+    """Hang chaos (reference Baby raison d'etre): a child whose op loop
+    wedges (hung transfer) is SIGKILLed by abort(); after reconfigure the
+    group converges again."""
+    pgs = _configure_pair(store_server, "wedge1")
+    try:
+        # Wedge rank 1's child: its queued op then never completes.
+        pgs[1]._inject_wedge()
+        work = pgs[1].allreduce([np.ones(4, np.float32)])
+        with pytest.raises(Exception):
+            work.wait(timeout=2.0)  # op is stuck behind the wedge
+        child = pgs[1]._proc
+        assert child is not None and child.is_alive()
+        pgs[1].abort()  # SIGKILL the wedged child
+        deadline = time.monotonic() + 10
+        while child.is_alive() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert not child.is_alive()
+        assert pgs[1].errored() is not None
+        # Rank 0's matching collective fails or hangs against the dead peer;
+        # abort it too, then reconfigure both on a fresh prefix and recover.
+        pgs[0].abort()
+        with ThreadPoolExecutor(max_workers=2) as pool:
+            list(
+                pool.map(
+                    lambda i: pgs[i].configure(
+                        f"{store_server.address()}/wedge2", f"baby_{i}", i, 2
+                    ),
+                    range(2),
+                )
+            )
+        assert pgs[1].errored() is None
+        with ThreadPoolExecutor(max_workers=2) as pool:
+            results = list(
+                pool.map(
+                    lambda i: pgs[i].allreduce([np.full(2, float(i + 1))]).wait(30),
+                    range(2),
+                )
+            )
+        for res in results:
+            np.testing.assert_allclose(res[0], np.full(2, 3.0))
+    finally:
+        for pg in pgs:
+            pg.shutdown()

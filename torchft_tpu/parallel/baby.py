@@ -9,18 +9,24 @@ parent proxies collectives over a request pipe; the child executes them on
 an inner :class:`ProcessGroupTCP` and streams results (or exceptions) back
 over a response pipe drained by a parent-side future-handler thread.
 
-The reference needs shared-memory tensors + CUDA event gymnastics for this;
-here host arrays pickle through the pipe — correctness first, zero-copy via
-shared memory is a later optimization. The child deliberately imports only
-numpy-level deps (no jax), keeping spawn latency low.
+Arrays ≥ 1 MiB cross the process boundary through **shared memory** (the
+reference's share_memory_ enforcement, process_group.py:1338-1349): the
+sender stages the bytes in a SharedMemory segment and ships only a
+descriptor through the pipe; the receiver maps the segment as a zero-copy
+numpy view. Small arrays still pickle through the pipe (cheaper than a
+segment per scalar). The child deliberately imports only numpy-level deps
+(no jax), keeping spawn latency low.
 """
 
 from __future__ import annotations
 
 import multiprocessing as mp
 import threading
+import weakref
 from concurrent.futures import Future
-from typing import Any, Dict, Optional, Sequence
+from dataclasses import dataclass
+from multiprocessing import shared_memory
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -29,6 +35,130 @@ from torchft_tpu.parallel.process_group import ProcessGroup, ReduceOp
 from torchft_tpu.work import Work
 
 __all__ = ["ProcessGroupBaby"]
+
+# Arrays at or above this size ride shared memory instead of the pickle pipe.
+SHM_THRESHOLD_BYTES = 1 << 20
+
+
+@dataclass
+class _ShmRef:
+    """Descriptor of an array staged in a SharedMemory segment."""
+
+    name: str
+    shape: Tuple[int, ...]
+    dtype: str  # np.dtype name (ml_dtypes resolve via registry)
+
+
+def _resolve_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _untrack(shm: shared_memory.SharedMemory) -> None:
+    """Detaches an ATTACHED (not created) segment from this process's
+    resource tracker — the creator owns unlink; double-tracking makes the
+    tracker spuriously destroy or warn about the segment at exit."""
+    try:  # pragma: no cover - stdlib-version dependent
+        from multiprocessing import resource_tracker
+
+        resource_tracker.unregister(shm._name, "shared_memory")  # type: ignore[attr-defined]
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def _stage_arrays(
+    arrays: Sequence[np.ndarray], segments: List[shared_memory.SharedMemory]
+) -> List[Any]:
+    """Replaces large arrays with _ShmRef descriptors; appends the created
+    segments (caller owns close+unlink after the op completes)."""
+    staged: List[Any] = []
+    for array in arrays:
+        array = np.ascontiguousarray(array)
+        if array.nbytes >= SHM_THRESHOLD_BYTES:
+            shm = shared_memory.SharedMemory(create=True, size=array.nbytes)
+            dst = np.ndarray((array.nbytes,), dtype=np.uint8, buffer=shm.buf)
+            dst[:] = np.atleast_1d(array).view(np.uint8).reshape(-1)
+            segments.append(shm)
+            staged.append(_ShmRef(shm.name, tuple(array.shape), np.dtype(array.dtype).name))
+        else:
+            staged.append(array)
+    return staged
+
+
+def _map_arrays(entries: Sequence[Any], owned: bool) -> List[np.ndarray]:
+    """Materializes a staged list: _ShmRef entries become zero-copy views
+    over the mapped segment, kept alive by a finalizer on the array. With
+    ``owned`` the finalizer also unlinks (receiver of a result owns the
+    segment); otherwise the creator unlinks."""
+    out: List[np.ndarray] = []
+    for entry in entries:
+        if isinstance(entry, _ShmRef):
+            shm = shared_memory.SharedMemory(name=entry.name, create=False)
+            _untrack(shm)
+            dtype = _resolve_dtype(entry.dtype)
+            flat = np.ndarray(
+                (int(np.prod(entry.shape or (1,))) * dtype.itemsize,),
+                dtype=np.uint8,
+                buffer=shm.buf,
+            )
+            array = flat.view(dtype).reshape(entry.shape)
+            if owned:
+                weakref.finalize(array, _cleanup_shm, shm, True)
+            else:
+                weakref.finalize(array, _cleanup_shm, shm, False)
+            out.append(array)
+        else:
+            out.append(entry)
+    return out
+
+
+def _cleanup_shm(shm: shared_memory.SharedMemory, unlink: bool) -> None:
+    try:
+        shm.close()
+        if unlink:
+            shm.unlink()
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def _stage_result(value: Any, segments: List[shared_memory.SharedMemory]) -> Any:
+    """Recursively stages large arrays in nested op results (allgather
+    returns lists of lists)."""
+    if isinstance(value, np.ndarray):
+        return _stage_arrays([value], segments)[0]
+    if isinstance(value, (list, tuple)):
+        return type(value)(_stage_result(v, segments) for v in value)
+    return value
+
+
+def _map_result(value: Any) -> Any:
+    """Inverse of :func:`_stage_result` on the receiving side; the receiver
+    owns the segments (finalizers unlink)."""
+    if isinstance(value, _ShmRef):
+        return _map_arrays([value], owned=True)[0]
+    if isinstance(value, (list, tuple)):
+        return type(value)(_map_result(v) for v in value)
+    return value
+
+
+def _discard_result(value: Any) -> None:
+    """Unlinks the segments of a result nobody will consume (the op's
+    future was already dropped by abort/teardown) — without this, the
+    child's transferred-ownership segments would orphan in /dev/shm."""
+    if isinstance(value, _ShmRef):
+        try:
+            shm = shared_memory.SharedMemory(name=value.name, create=False)
+        except FileNotFoundError:
+            return
+        _untrack(shm)
+        _cleanup_shm(shm, unlink=True)
+    elif isinstance(value, (list, tuple)):
+        for v in value:
+            _discard_result(v)
 
 
 def _baby_main(req_conn, resp_conn, store_addr, replica_id, rank, world_size, timeout,
@@ -58,16 +188,41 @@ def _baby_main(req_conn, resp_conn, store_addr, replica_id, rank, world_size, ti
                 return
             if cmd[0] == "shutdown":
                 return
+            if cmd[0] == "wedge":
+                # Test-only chaos: simulate a wedged transfer (the hang the
+                # Baby isolation exists to cure — parent must SIGKILL us).
+                import time as _time
+
+                _time.sleep(10**9)
             assert cmd[0] == "func"
             _, op_id, name, args, kwargs = cmd
             try:
+                # First positional arg of every collective is the array list;
+                # large entries arrive as _ShmRef and map zero-copy.
+                if args and isinstance(args[0], (list, tuple)):
+                    args = ([*_map_arrays(args[0], owned=False)], *args[1:])
                 work = getattr(pg, name)(*args, **kwargs)
 
                 def on_done(fut, op_id=op_id) -> None:
                     err = fut.exception()
                     try:
                         if err is None:
-                            resp.send(("result", op_id, fut.result()))
+                            segments: list = []
+                            result = _stage_result(fut.result(), segments)
+                            # Parent owns the result segments (its mapped
+                            # arrays unlink on garbage collection); drop the
+                            # child's own handles after the send.
+                            resp.send(("result", op_id, result))
+                            for shm in segments:
+                                # Ownership transferred to the parent: drop
+                                # this side's handle AND tracker entry, or
+                                # the child tracker would unlink live
+                                # segments at child exit.
+                                _untrack(shm)
+                                try:
+                                    shm.close()
+                                except Exception:  # noqa: BLE001
+                                    pass
                         else:
                             resp.send(("error", op_id, RuntimeError(str(err))))
                     except (OSError, BrokenPipeError):
@@ -97,6 +252,7 @@ class ProcessGroupBaby(ProcessGroup):
         self._resp: Optional[_MonitoredPipe] = None
         self._errored: Optional[Exception] = None
         self._pending: Dict[int, Future] = {}
+        self._op_segments: Dict[int, List[shared_memory.SharedMemory]] = {}
         self._pending_lock = threading.Lock()
         self._next_op_id = 0
         self._handler: Optional[threading.Thread] = None
@@ -156,10 +312,17 @@ class ProcessGroupBaby(ProcessGroup):
             kind, op_id, payload = msg
             with self._pending_lock:
                 fut = self._pending.pop(op_id, None)
+                segments = self._op_segments.pop(op_id, ())
+            # The op is complete: the request segments (this side created)
+            # can be released.
+            for shm in segments:
+                _cleanup_shm(shm, unlink=True)
             if fut is None:
+                if kind == "result":
+                    _discard_result(payload)
                 continue
             if kind == "result":
-                fut.set_result(payload)
+                fut.set_result(_map_result(payload))
             else:
                 if self._errored is None:
                     self._errored = payload
@@ -182,9 +345,13 @@ class ProcessGroupBaby(ProcessGroup):
             if proc.is_alive():
                 proc.kill()  # SIGKILL: the whole point of the subprocess
                 proc.join(timeout=5.0)
-        # Fail any outstanding work.
+        # Fail any outstanding work; release its staged segments.
         with self._pending_lock:
             pending, self._pending = self._pending, {}
+            segments, self._op_segments = self._op_segments, {}
+        for shms in segments.values():
+            for shm in shms:
+                _cleanup_shm(shm, unlink=True)
         for fut in pending.values():
             if not fut.done():
                 fut.set_exception(RuntimeError("baby process group torn down"))
@@ -209,6 +376,12 @@ class ProcessGroupBaby(ProcessGroup):
         with self._pending_lock:
             return len(self._pending)
 
+    def _inject_wedge(self) -> None:
+        """Test-only: wedges the child's op loop forever (a hung-transfer
+        simulation). The cure is abort() → SIGKILL → reconfigure."""
+        assert self._req is not None
+        self._req.send(("wedge",))
+
     # -- op proxying -------------------------------------------------------
 
     def _run_func(self, name: str, *args: Any, **kwargs: Any) -> Work:
@@ -216,16 +389,25 @@ class ProcessGroupBaby(ProcessGroup):
             raise RuntimeError(f"process group in error state: {self._errored}")
         if self._req is None or self._proc is None or not self._proc.is_alive():
             raise RuntimeError("baby process group not configured / child dead")
+        # Large arrays cross via shared memory (descriptor on the pipe).
+        segments: List[shared_memory.SharedMemory] = []
+        if args and isinstance(args[0], (list, tuple)):
+            args = ([*_stage_arrays(args[0], segments)], *args[1:])
         fut: Future = Future()
         with self._pending_lock:
             op_id = self._next_op_id
             self._next_op_id += 1
             self._pending[op_id] = fut
+            if segments:
+                self._op_segments[op_id] = segments
         try:
             self._req.send(("func", op_id, name, args, kwargs))
         except (OSError, BrokenPipeError) as e:
             with self._pending_lock:
                 self._pending.pop(op_id, None)
+                self._op_segments.pop(op_id, None)
+            for shm in segments:
+                _cleanup_shm(shm, unlink=True)
             self._errored = RuntimeError(f"baby pipe broken: {e}")
             raise self._errored from e
         return Work(fut)
