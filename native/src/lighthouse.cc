@@ -218,7 +218,8 @@ RpcResult Lighthouse::handle_kill(const std::string& payload) {
     return {RpcStatus::kNotFound, "replica " + req.replica_id() + " not in quorum"};
   }
   RpcClient client(addr, /*connect_timeout_ms=*/10000);
-  RpcResult result = client.call(kManagerKill, "", /*timeout_ms=*/10000);
+  // Forward the whole request: the manager reads the fault mode from it.
+  RpcResult result = client.call(kManagerKill, payload, /*timeout_ms=*/10000);
   if (result.status != RpcStatus::kOk) {
     // The victim exits before replying; treat connection loss as success.
     TPUFT_INFO("kill of %s: manager reply status=%d (%s)", req.replica_id().c_str(),

@@ -3,6 +3,7 @@
 #include <unistd.h>
 
 #include <algorithm>
+#include <csignal>
 #include <cstdlib>
 
 namespace tpuft {
@@ -39,6 +40,7 @@ void ManagerServer::shutdown() {
   }
   if (heartbeat_thread_.joinable()) heartbeat_thread_.join();
   if (quorum_worker_.joinable()) quorum_worker_.join();
+  if (deadlock_thread_.joinable()) deadlock_thread_.join();
   if (server_) server_->shutdown();
 }
 
@@ -49,6 +51,10 @@ std::string ManagerServer::address() const {
 void ManagerServer::heartbeat_loop() {
   RpcClient client(opt_.lighthouse_addr, opt_.connect_timeout_ms);
   while (!stop_.load()) {
+    if (partitioned_.load()) {
+      std::this_thread::sleep_for(DurationMs(opt_.heartbeat_interval_ms));
+      continue;
+    }
     tpuft::LighthouseHeartbeatRequest req;
     req.set_replica_id(opt_.replica_id);
     RpcResult result =
@@ -68,6 +74,14 @@ void ManagerServer::heartbeat_loop() {
 }
 
 RpcResult ManagerServer::handle(uint8_t method, const std::string& payload) {
+  if (partitioned_.load()) {
+    // Simulated network partition: hold the request until shutdown (the
+    // caller hits its own deadline, exactly as with dropped packets).
+    while (partitioned_.load() && !stop_.load()) {
+      std::this_thread::sleep_for(DurationMs(50));
+    }
+    return {RpcStatus::kError, "manager partitioned (fault injection)"};
+  }
   switch (method) {
     case kManagerQuorum:
       return handle_quorum(payload);
@@ -99,7 +113,7 @@ void ManagerServer::quorum_worker_loop() {
 
 void ManagerServer::run_lighthouse_quorum(const tpuft::QuorumMember& member,
                                           int64_t timeout_ms) {
-  TPUFT_INFO("[Replica %s] All workers joined - starting quorum", opt_.replica_id.c_str());
+  TPUFT_INFO("[Replica %s] all local ranks gathered; requesting lighthouse quorum", opt_.replica_id.c_str());
 
   tpuft::LighthouseQuorumRequest req;
   *req.mutable_requester() = member;
@@ -285,9 +299,47 @@ RpcResult ManagerServer::handle_should_commit(const std::string& payload) {
   return {RpcStatus::kOk, resp.SerializeAsString()};
 }
 
-RpcResult ManagerServer::handle_kill(const std::string&) {
-  TPUFT_WARN("[Replica %s] got kill request", opt_.replica_id.c_str());
+RpcResult ManagerServer::handle_kill(const std::string& payload) {
+  tpuft::KillRequest req;
+  std::string mode = "exit";
+  if (req.ParseFromString(payload) && !req.mode().empty()) {
+    mode = req.mode();
+  }
+  TPUFT_WARN("[Replica %s] got kill request mode=%s", opt_.replica_id.c_str(),
+             mode.c_str());
+
+  if (mode == "deadlock") {
+    // Alive-but-stuck: a thread takes the coordination mutex and never
+    // releases, so quorum/commit RPCs from local ranks hang while the
+    // heartbeat loop keeps beating — the nastiest failure shape (the
+    // lighthouse still sees us as healthy). Joinable (not detached):
+    // shutdown must be able to wait it out or it would read freed members.
+    {
+      // Test-and-spawn under mu_ so concurrent kill RPCs cannot assign
+      // over a live thread object; the spawned thread then queues on mu_.
+      std::lock_guard<std::mutex> lock(mu_);
+      if (!deadlock_thread_.joinable()) {
+        deadlock_thread_ = std::thread([this] {
+          std::unique_lock<std::mutex> hold(mu_);
+          while (!stop_.load()) {
+            std::this_thread::sleep_for(DurationMs(100));
+          }
+        });
+      }
+    }
+    return {RpcStatus::kOk, ""};
+  }
+  if (mode == "partition") {
+    // Coordination-network partition: heartbeats stop and subsequent RPCs
+    // go unanswered until their deadline, as if our packets were dropped.
+    partitioned_.store(true);
+    return {RpcStatus::kOk, ""};
+  }
   if (opt_.exit_on_kill) {
+    if (mode == "segfault") {
+      // Simulated crash-with-core (reference failure menu SEGFAULT).
+      std::raise(SIGSEGV);
+    }
     // _Exit, not exit: running static destructors concurrently with live
     // runtime threads (jax, our own servers) segfaults during teardown; the
     // kill contract is an immediate death, matching the reference's

@@ -95,6 +95,11 @@ class ManagerServer {
   bool commit_decision_ = false;
 
   std::atomic<bool> stop_{false};
+  // Fault injection (see handle_kill): "partition" makes heartbeats stop
+  // and RPCs go unanswered, as if this host dropped off the network;
+  // "deadlock" parks this thread on mu_ until shutdown.
+  std::atomic<bool> partitioned_{false};
+  std::thread deadlock_thread_;
   std::thread heartbeat_thread_;
   std::thread quorum_worker_;
 };

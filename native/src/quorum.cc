@@ -70,7 +70,7 @@ QuorumDecision quorum_compute(Instant now, const LighthouseState& state,
                              });
         });
     if (is_fast_quorum) {
-      return {std::move(candidates), "Fast quorum found! " + meta.str()};
+      return {std::move(candidates), "Fast quorum: every previous member is healthy and requesting " + meta.str()};
     }
   }
 

@@ -121,6 +121,105 @@ TPUFT_TEST(lighthouse_direct_quorum_two_replicas) {
   lighthouse.shutdown();
 }
 
+namespace {
+// Full-control quorum request: returns the raw RpcResult.
+RpcResult lighthouse_quorum_raw(const std::string& addr, const std::string& replica_id,
+                                bool shrink_only, uint64_t commit_failures,
+                                int64_t timeout_ms) {
+  RpcClient client(addr, 2000);
+  tpuft::LighthouseQuorumRequest req;
+  auto* m = req.mutable_requester();
+  m->set_replica_id(replica_id);
+  m->set_address("addr:" + replica_id);
+  m->set_store_address("store:" + replica_id);
+  m->set_step(1);
+  m->set_world_size(1);
+  m->set_shrink_only(shrink_only);
+  m->set_commit_failures(commit_failures);
+  req.set_timeout_ms(timeout_ms);
+  return client.call(kLighthouseQuorum, req.SerializeAsString(), timeout_ms + 2000);
+}
+
+tpuft::Quorum expect_quorum(const RpcResult& result) {
+  EXPECT_EQ((int)result.status, (int)RpcStatus::kOk);
+  tpuft::LighthouseQuorumResponse resp;
+  EXPECT_TRUE(resp.ParseFromString(result.payload));
+  return resp.quorum();
+}
+}  // namespace
+
+TPUFT_TEST(lighthouse_commit_failures_bump_quorum_id) {
+  // Port of the reference contract lighthouse.rs:1228: commit failures force
+  // a quorum_id bump (=> PG reconfigure) even with unchanged membership.
+  Lighthouse lighthouse(test_lighthouse_opt(1));
+  lighthouse.start();
+
+  tpuft::Quorum q1 = expect_quorum(
+      lighthouse_quorum_raw(lighthouse.address(), "a", false, 0, 5000));
+  tpuft::Quorum q2 = expect_quorum(
+      lighthouse_quorum_raw(lighthouse.address(), "a", false, 0, 5000));
+  // Same membership, no failures: id stable.
+  EXPECT_EQ(q2.quorum_id(), q1.quorum_id());
+  tpuft::Quorum q3 = expect_quorum(
+      lighthouse_quorum_raw(lighthouse.address(), "a", false, 2, 5000));
+  EXPECT_EQ(q3.quorum_id(), q1.quorum_id() + 1);
+  lighthouse.shutdown();
+}
+
+TPUFT_TEST(lighthouse_join_during_shrink_is_deferred) {
+  // Port of the reference e2e lighthouse.rs:1115: while any member requests
+  // shrink_only, a new joiner is excluded; it is admitted on the next
+  // unrestricted round.
+  Lighthouse lighthouse(test_lighthouse_opt(2, /*join_timeout_ms=*/300));
+  lighthouse.start();
+
+  // Round 1: a+b form the quorum.
+  auto fa = std::async(std::launch::async, [&] {
+    return lighthouse_quorum_raw(lighthouse.address(), "a", false, 0, 5000);
+  });
+  auto fb = std::async(std::launch::async, [&] {
+    return lighthouse_quorum_raw(lighthouse.address(), "b", false, 0, 5000);
+  });
+  tpuft::Quorum round1 = expect_quorum(fa.get());
+  expect_quorum(fb.get());
+  EXPECT_EQ(round1.participants_size(), 2);
+
+  // Round 2: a requests shrink-only, b requests normally, c tries to join
+  // with a long-poll that stays PENDING across the shrink round (as the
+  // reference e2e does - a timed-out request would leave a stale
+  // participant entry and skew later join windows).
+  auto fc2 = std::async(std::launch::async, [&] {
+    return lighthouse_quorum_raw(lighthouse.address(), "c", false, 0, 15000);
+  });
+  auto fa2 = std::async(std::launch::async, [&] {
+    return lighthouse_quorum_raw(lighthouse.address(), "a", true, 0, 5000);
+  });
+  auto fb2 = std::async(std::launch::async, [&] {
+    return lighthouse_quorum_raw(lighthouse.address(), "b", false, 0, 5000);
+  });
+  tpuft::Quorum round2 = expect_quorum(fa2.get());
+  expect_quorum(fb2.get());
+  EXPECT_EQ(round2.participants_size(), 2);
+  for (const auto& p : round2.participants()) {
+    EXPECT_TRUE(p.replica_id() != "c");
+  }
+
+  // Round 3 (unrestricted): the joiner's still-pending request resolves
+  // with full membership.
+  auto fa3 = std::async(std::launch::async, [&] {
+    return lighthouse_quorum_raw(lighthouse.address(), "a", false, 0, 5000);
+  });
+  auto fb3 = std::async(std::launch::async, [&] {
+    return lighthouse_quorum_raw(lighthouse.address(), "b", false, 0, 5000);
+  });
+  tpuft::Quorum round3 = expect_quorum(fc2.get());
+  expect_quorum(fa3.get());
+  expect_quorum(fb3.get());
+  EXPECT_EQ(round3.participants_size(), 3);
+  EXPECT_TRUE(round3.quorum_id() > round2.quorum_id());
+  lighthouse.shutdown();
+}
+
 TPUFT_TEST(lighthouse_quorum_timeout_is_clean) {
   Lighthouse lighthouse(test_lighthouse_opt(2));
   lighthouse.start();

@@ -169,3 +169,71 @@ def test_manager_two_groups_heal_plan() -> None:
         for m in managers:
             m.shutdown()
         lighthouse.shutdown()
+
+
+def test_fault_menu_deadlock_and_partition() -> None:
+    """The expanded fault menu (reference monarch failure.py:25-100):
+    'deadlock' wedges coordination while heartbeats continue; 'partition'
+    silences the manager entirely (heartbeats stop, RPCs unanswered)."""
+    import time
+
+    lighthouse = LighthouseServer(min_replicas=1, join_timeout_ms=100)
+    managers = []
+    try:
+        for idx in range(2):
+            managers.append(
+                ManagerServer(
+                    replica_id=f"fault:{idx}",
+                    lighthouse_addr=lighthouse.address(),
+                    store_addr=f"store:{idx}",
+                    world_size=1,
+                    heartbeat_interval=0.05,
+                    exit_on_kill=False,
+                )
+            )
+        clients = [ManagerClient(m.address()) for m in managers]
+        lh_client = LighthouseClient(lighthouse.address())
+        import threading
+
+        def quorum(i, step):
+            return clients[i]._quorum(
+                group_rank=0, step=step, checkpoint_metadata="m",
+                shrink_only=False, init_sync=True, commit_failures=0,
+                timeout=10.0,
+            )
+
+        results = {}
+        threads = [
+            threading.Thread(target=lambda i=i: results.update({i: quorum(i, 0)}))
+            for i in range(2)
+        ]
+        [t.start() for t in threads]
+        [t.join(20) for t in threads]
+        assert len(results[0].quorum.participants) == 2
+
+        # Deadlock manager 0: its commit barrier hangs, heartbeats continue.
+        lh_client.kill("fault:0", mode="deadlock")
+        with pytest.raises(Exception):
+            clients[0].should_commit(0, 1, True, timeout=1.5)
+        deadline = time.monotonic() + 5
+        beating = False
+        while time.monotonic() < deadline and not beating:
+            status = lh_client.status()
+            ages = {
+                m.member.replica_id: m.heartbeat_age_ms
+                for m in status.members
+            }
+            beating = ages.get("fault:0", 10**9) < 1000
+            time.sleep(0.1)
+        assert beating, "deadlocked manager must keep heartbeating (alive-but-stuck)"
+
+        # Partition manager 1: its heartbeats stop flowing.
+        lh_client.kill("fault:1", mode="partition")
+        time.sleep(1.0)
+        status = lh_client.status()
+        ages = {m.member.replica_id: m.heartbeat_age_ms for m in status.members}
+        assert ages.get("fault:1", 0) > 800, ages
+    finally:
+        for m in managers:
+            m.shutdown()
+        lighthouse.shutdown()

@@ -349,8 +349,14 @@ class LighthouseClient:
         resp.ParseFromString(body)
         return resp
 
-    def kill(self, replica_id: str, timeout: float = 10.0) -> None:
-        req = tpuft_pb2.KillRequest(replica_id=replica_id)
+    def kill(self, replica_id: str, timeout: float = 10.0, mode: str = "exit") -> None:
+        """Injects a fault into ``replica_id``'s manager. Modes (reference
+        failure menu, examples/monarch/utils/failure.py:25-100): "exit"
+        (process death), "segfault" (crash with core), "deadlock"
+        (coordination wedges while heartbeats continue), "partition"
+        (heartbeats and RPC serving stop, as if the host dropped off the
+        network)."""
+        req = tpuft_pb2.KillRequest(replica_id=replica_id, mode=mode)
         self._client.call(LIGHTHOUSE_KILL_REPLICA, req.SerializeToString(), timeout)
 
     def close(self) -> None:

@@ -1,12 +1,16 @@
-"""Chaos tool: kill replica groups of a live job.
+"""Chaos tool: inject faults into replica groups of a live job.
 
-Role-equivalent of the reference's ``examples/slurm/punisher.py`` kill_one/
-kill_all/kill_loop CLI: resolves the current quorum from the lighthouse and
-fires Kill RPCs at member managers (which ``exit(1)``, exactly as the
-dashboard's kill button does).
+Role-equivalent of the reference's ``examples/slurm/punisher.py`` kill CLI
+plus the monarch failure menu (examples/monarch/utils/failure.py:25-100):
+resolves the current quorum from the lighthouse and fires fault RPCs at
+member managers. Modes: exit (process death), segfault (crash with core),
+deadlock (coordination wedges while heartbeats continue), partition
+(heartbeats + RPC serving stop).
 
     python -m torchft_tpu.punisher --lighthouse host:29510 kill_one
-    python -m torchft_tpu.punisher --lighthouse host:29510 kill_loop --mtbf 60
+    python -m torchft_tpu.punisher --lighthouse host:29510 fault_one --mode deadlock
+    python -m torchft_tpu.punisher --lighthouse host:29510 kill_loop --mtbf 60 \
+        --menu exit,segfault,deadlock,partition
 """
 
 from __future__ import annotations
@@ -26,15 +30,20 @@ def _members(client: LighthouseClient):
     return [m.member.replica_id for m in status.members if not m.joining]
 
 
-def kill_one(client: LighthouseClient, rng: random.Random) -> None:
+FAULT_MODES = ("exit", "segfault", "deadlock", "partition")
+
+
+def kill_one(
+    client: LighthouseClient, rng: random.Random, mode: str = "exit"
+) -> None:
     members = _members(client)
     if not members:
         print("[punisher] no quorum members to kill")
         return
     victim = rng.choice(members)
-    print(f"[punisher] killing {victim}")
+    print(f"[punisher] injecting {mode} into {victim}")
     try:
-        client.kill(victim)
+        client.kill(victim, mode=mode)
     except Exception as e:  # noqa: BLE001  — victim may die before replying
         print(f"[punisher] kill rpc ended with: {e}")
 
@@ -48,13 +57,20 @@ def kill_all(client: LighthouseClient, rng: random.Random) -> None:
             print(f"[punisher] kill rpc ended with: {e}")
 
 
-def kill_loop(client: LighthouseClient, rng: random.Random, mtbf: float) -> None:
-    """Poisson-ish kill schedule with mean time between failures ``mtbf``."""
-    while True:
+def kill_loop(
+    client: LighthouseClient,
+    rng: random.Random,
+    mtbf: float,
+    menu: tuple = ("exit",),
+    deadline: float = float("inf"),
+) -> None:
+    """Poisson-ish fault schedule with mean time between failures ``mtbf``,
+    drawing each fault from ``menu``."""
+    while time.monotonic() < deadline:
         delay = rng.expovariate(1.0 / mtbf) if mtbf > 0 else 1.0
-        print(f"[punisher] next kill in {delay:.1f}s")
+        print(f"[punisher] next fault in {delay:.1f}s")
         time.sleep(delay)
-        kill_one(client, rng)
+        kill_one(client, rng, mode=rng.choice(list(menu)))
 
 
 def main() -> None:
@@ -68,8 +84,15 @@ def main() -> None:
     sub = parser.add_subparsers(dest="cmd", required=True)
     sub.add_parser("kill_one")
     sub.add_parser("kill_all")
+    fault = sub.add_parser("fault_one")
+    fault.add_argument("--mode", choices=FAULT_MODES, default="exit")
     loop = sub.add_parser("kill_loop")
-    loop.add_argument("--mtbf", type=float, default=60.0, help="mean seconds between kills")
+    loop.add_argument("--mtbf", type=float, default=60.0, help="mean seconds between faults")
+    loop.add_argument(
+        "--menu",
+        default="exit",
+        help="comma-separated fault modes to draw from: " + ",".join(FAULT_MODES),
+    )
     args = parser.parse_args()
 
     rng = random.Random(args.seed)
@@ -78,8 +101,14 @@ def main() -> None:
         kill_one(client, rng)
     elif args.cmd == "kill_all":
         kill_all(client, rng)
+    elif args.cmd == "fault_one":
+        kill_one(client, rng, mode=args.mode)
     else:
-        kill_loop(client, rng, args.mtbf)
+        menu = tuple(m.strip() for m in args.menu.split(",") if m.strip())
+        for m in menu:
+            if m not in FAULT_MODES:
+                parser.error(f"unknown fault mode {m!r}")
+        kill_loop(client, rng, args.mtbf, menu=menu)
 
 
 if __name__ == "__main__":
