@@ -90,6 +90,23 @@ def train(args: argparse.Namespace) -> None:
     print(f"[group {group_id}] starting at manager step {manager.current_step()}", flush=True)
     batches = sampler.batches()
     t_start = time.monotonic()
+
+    # Profiler export (reference train_ddp.py:159-174 chrome-trace loops):
+    # --profile-dir captures BOTH a jax.profiler trace (TensorBoard/perfetto)
+    # and a self-contained chrome trace of the manager-phase spans.
+    from contextlib import ExitStack
+
+    profile_stack = ExitStack()
+    if args.profile_dir:
+        import jax.profiler
+
+        from torchft_tpu.utils.profiling import chrome_trace
+
+        os.makedirs(args.profile_dir, exist_ok=True)
+        profile_stack.enter_context(jax.profiler.trace(args.profile_dir))
+        trace_path = os.path.join(args.profile_dir, f"tpuft_spans_g{group_id}.json")
+        profile_stack.enter_context(chrome_trace(trace_path))
+        print(f"[group {group_id}] profiling to {args.profile_dir}", flush=True)
     try:
         while manager.current_step() < args.steps:
             step = manager.current_step()
@@ -122,6 +139,13 @@ def train(args: argparse.Namespace) -> None:
         digest = float(sum(jnp.sum(jnp.abs(l)) for l in leaves))
         print(f"[group {group_id}] param_digest={digest:.6f}", flush=True)
     finally:
+        profile_stack.close()
+        if args.profile_dir:
+            print(
+                f"[group {group_id}] trace artifacts in {args.profile_dir} "
+                f"(tpuft_spans_g{group_id}.json loads in chrome://tracing)",
+                flush=True,
+            )
         manager.shutdown(wait=False)
         pg.shutdown()
         if store is not None:
@@ -191,6 +215,11 @@ def main() -> None:
     parser.add_argument("--padding-mb", type=int, default=0)
     parser.add_argument("--timeout", type=float, default=30.0)
     parser.add_argument("--quorum-timeout", type=float, default=60.0)
+    parser.add_argument(
+        "--profile-dir",
+        default="",
+        help="capture jax.profiler + chrome-trace span artifacts here",
+    )
     parser.add_argument("--demo", action="store_true", help="run the chaos demo")
     parser.add_argument("--kill-after", type=float, default=8.0)
     parser.add_argument("--restart-after", type=float, default=2.0)

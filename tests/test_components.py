@@ -376,3 +376,27 @@ def test_safe_pickle_blocks_rce_gadgets_allows_ml_types() -> None:
     finally:
         _safe_pickle._ALLOWED_ROOTS.clear()
         _safe_pickle._ALLOWED_ROOTS.update(snapshot)
+
+
+def test_chrome_trace_capture_writes_span_events(tmp_path) -> None:
+    """trace_span regions inside a chrome_trace capture land in a valid
+    chrome://tracing JSON with name/ts/dur (reference chrome-trace export
+    parity, train_ddp.py:159-174)."""
+    import json
+
+    from torchft_tpu.utils.profiling import chrome_trace, trace_span
+
+    path = tmp_path / "trace.json"
+    with chrome_trace(str(path)):
+        with trace_span("tpuft::test::outer"):
+            with trace_span("tpuft::test::inner"):
+                time.sleep(0.01)
+    data = json.loads(path.read_text())
+    names = [e["name"] for e in data["traceEvents"]]
+    assert "tpuft::test::outer" in names and "tpuft::test::inner" in names
+    inner = next(e for e in data["traceEvents"] if e["name"] == "tpuft::test::inner")
+    assert inner["ph"] == "X" and inner["dur"] >= 10_000  # >= 10ms in us
+    # Spans outside a capture don't record anywhere.
+    with trace_span("tpuft::test::outside"):
+        pass
+    assert "outside" not in path.read_text()

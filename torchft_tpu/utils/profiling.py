@@ -1,37 +1,62 @@
-"""Tracing/profiling spans.
+"""Tracing/profiling spans + chrome-trace export.
 
 Role-equivalent of the reference's ``torch.profiler.record_function`` spans
-on every manager phase (manager.py:385-827) and the ``_time``/``_timeit``
-transfer logs (http_transport.py:31-36): here spans emit
-``jax.profiler.TraceAnnotation`` markers, which show up on the TensorBoard
-trace viewer timeline when a ``jax.profiler.trace`` capture is active, and
-optionally log wall time when ``TPUFT_TRACE_LOG`` is set.
+on every manager phase (manager.py:385-827), the ``_time``/``_timeit``
+transfer logs (http_transport.py:31-36), and its chrome-trace export loops
+(train_ddp.py:159-174): spans emit ``jax.profiler.TraceAnnotation`` markers
+(TensorBoard/perfetto timeline when a ``jax.profiler.trace`` capture is
+active), optionally log wall time when ``TPUFT_TRACE_LOG`` is set, and —
+when a :func:`chrome_trace` capture is active — record begin/end events
+into a self-contained ``trace.json`` loadable in ``chrome://tracing`` or
+https://ui.perfetto.dev.
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import os
+import threading
 import time
 from contextlib import contextmanager
-from typing import Generator, Iterator
+from typing import Generator, Iterator, List, Optional
 
 logger = logging.getLogger("torchft_tpu.trace")
 
 _LOG_SPANS = os.environ.get("TPUFT_TRACE_LOG", "") == "1"
 
+# Active chrome-trace capture: (event list, lock) or None.
+_CHROME: Optional[tuple] = None
+
+
+@contextmanager
+def chrome_trace(path: str) -> Generator[None, None, None]:
+    """Captures every :func:`trace_span` in the with-body as chrome-trace
+    "X" (complete) events and writes them to ``path`` on exit."""
+    global _CHROME
+    events: List[dict] = []
+    _CHROME = (events, threading.Lock())
+    try:
+        yield
+    finally:
+        _CHROME = None
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+        logger.info("chrome trace with %d events written to %s", len(events), path)
+
 
 @contextmanager
 def trace_span(name: str) -> Generator[None, None, None]:
     """Marks a region on the jax profiler timeline (no-op cost when no
-    capture is active)."""
+    capture is active) and on any active :func:`chrome_trace` capture."""
     try:
         import jax.profiler
 
         annotation = jax.profiler.TraceAnnotation(name)
     except Exception:  # noqa: BLE001  — profiling must never break training
         annotation = None
-    start = time.monotonic() if _LOG_SPANS else 0.0
+    chrome = _CHROME
+    start = time.monotonic() if (_LOG_SPANS or chrome is not None) else 0.0
     if annotation is not None:
         annotation.__enter__()
     try:
@@ -39,8 +64,23 @@ def trace_span(name: str) -> Generator[None, None, None]:
     finally:
         if annotation is not None:
             annotation.__exit__(None, None, None)
+        elapsed = time.monotonic() - start
+        if chrome is not None:
+            events, lock = chrome
+            with lock:
+                events.append(
+                    {
+                        "name": name,
+                        "ph": "X",
+                        "ts": start * 1e6,
+                        "dur": elapsed * 1e6,
+                        "pid": os.getpid(),
+                        "tid": threading.get_ident() % 2**31,
+                        "cat": "tpuft",
+                    }
+                )
         if _LOG_SPANS:
-            logger.info("%s took %.3fms", name, (time.monotonic() - start) * 1000)
+            logger.info("%s took %.3fms", name, elapsed * 1000)
 
 
 @contextmanager
