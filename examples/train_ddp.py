@@ -139,13 +139,16 @@ def train(args: argparse.Namespace) -> None:
         digest = float(sum(jnp.sum(jnp.abs(l)) for l in leaves))
         print(f"[group {group_id}] param_digest={digest:.6f}", flush=True)
     finally:
-        profile_stack.close()
-        if args.profile_dir:
-            print(
-                f"[group {group_id}] trace artifacts in {args.profile_dir} "
-                f"(tpuft_spans_g{group_id}.json loads in chrome://tracing)",
-                flush=True,
-            )
+        try:
+            profile_stack.close()
+            if args.profile_dir:
+                print(
+                    f"[group {group_id}] trace artifacts in {args.profile_dir} "
+                    f"(tpuft_spans_g{group_id}.json loads in chrome://tracing)",
+                    flush=True,
+                )
+        except Exception as e:  # noqa: BLE001  — profiling must never break teardown
+            print(f"[group {group_id}] trace export failed: {e}", flush=True)
         manager.shutdown(wait=False)
         pg.shutdown()
         if store is not None:

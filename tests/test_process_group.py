@@ -439,3 +439,47 @@ def test_managed_pg_real_manager_end_to_end() -> None:
         inner.shutdown()
         store.shutdown()
         lighthouse.shutdown()
+
+
+@pytest.mark.parametrize("world_size", [2, 3])
+def test_tcp_ring_allreduce_large_payloads(store_server, world_size) -> None:
+    """Arrays >= the ring threshold take the bandwidth-optimal ring path;
+    results are exact (SUM/AVG) and bitwise identical on every rank, with
+    small arrays mixed into the same call via the root path."""
+    pgs = make_group(store_server, world_size)
+    big = 1 << 18  # 256k float32 = 1 MiB (>= default ring threshold)
+    try:
+        rng = np.random.default_rng(0)
+        bases = [rng.standard_normal(big).astype(np.float32) for _ in range(world_size)]
+
+        def call(pg, rank):
+            arrays = [bases[rank], np.full(3, float(rank + 1), np.float32)]
+            return pg.allreduce(arrays, ReduceOp.AVG).wait(60)
+
+        results = run_on_all(pgs, call)
+        expected_big = np.mean(bases, axis=0)
+        expected_small = np.full(3, np.mean([r + 1 for r in range(world_size)]), np.float32)
+        reference_bytes = results[0][0].tobytes()
+        for res in results:
+            np.testing.assert_allclose(res[0], expected_big, rtol=1e-5, atol=1e-6)
+            np.testing.assert_allclose(res[1], expected_small, rtol=1e-6)
+            # Bitwise identical across ranks (the master invariant).
+            assert res[0].tobytes() == reference_bytes
+
+        # SUM and bf16 (f32 accumulation) through the ring.
+        def call_sum(pg, rank):
+            import ml_dtypes
+
+            arr = np.full(big, 0.5 * (rank + 1), dtype=ml_dtypes.bfloat16)
+            return pg.allreduce([arr], ReduceOp.SUM).wait(60)
+
+        sums = run_on_all(pgs, call_sum)
+        expected = sum(0.5 * (r + 1) for r in range(world_size))
+        for res in sums:
+            np.testing.assert_allclose(
+                np.asarray(res[0], dtype=np.float32), np.full(big, expected), rtol=1e-2
+            )
+            assert res[0].tobytes() == sums[0][0].tobytes()
+    finally:
+        for pg in pgs:
+            pg.shutdown()

@@ -25,6 +25,7 @@ Implementations:
 from __future__ import annotations
 
 import logging
+import os
 import pickle
 import queue
 import socket
@@ -34,7 +35,7 @@ import time
 from abc import ABC, abstractmethod
 from concurrent.futures import Future
 from enum import Enum
-from typing import Callable, Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence, cast
 
 import numpy as np
 
@@ -167,6 +168,12 @@ class ProcessGroup(ABC):
 # ---------------------------------------------------------------------------
 
 _LEN_STRUCT = struct.Struct("!Q")
+
+# Arrays at or above this take the ring allreduce (bandwidth-optimal);
+# smaller ones take gather-at-root (latency-optimal). Override in MB.
+_RING_MIN_BYTES = int(
+    float(os.environ.get("TPUFT_TCP_RING_MIN_MB", "1")) * 1024 * 1024
+)
 
 
 def _send_bytes(sock: socket.socket, payload: bytes, deadline: float) -> None:
@@ -455,12 +462,109 @@ class ProcessGroupTCP(ProcessGroup):
     ) -> List[np.ndarray]:
         n = epoch.world_size
         if n == 1:
-            if op == ReduceOp.AVG:
-                return [a.copy() for a in arrays]
             return [a.copy() for a in arrays]
+        # Large payloads take the bandwidth-optimal ring (each rank moves
+        # ~2x payload regardless of N); small ones take gather-at-root +
+        # broadcast, whose single reduction order is the simplest
+        # determinism argument and whose latency (2 hops vs 2(N-1) steps)
+        # wins when payloads are tiny. Both end bitwise identical on every
+        # rank. SUM/AVG only on the ring (MAX/MIN payloads are small in
+        # practice and keep the root path).
+        if op in (ReduceOp.SUM, ReduceOp.AVG):
+            small: List[int] = []
+            out_mixed: List[Optional[np.ndarray]] = [None] * len(arrays)
+            for i, a in enumerate(arrays):
+                if a.nbytes >= _RING_MIN_BYTES:
+                    out_mixed[i] = self._ring_allreduce(epoch, a, op, deadline)
+                else:
+                    small.append(i)
+            if not small:
+                return [cast(np.ndarray, x) for x in out_mixed]
+            if len(small) < len(arrays):
+                reduced_small = self._allreduce_root(
+                    epoch, [arrays[i] for i in small], op, deadline
+                )
+                for slot, i in enumerate(small):
+                    out_mixed[i] = reduced_small[slot]
+                return [cast(np.ndarray, x) for x in out_mixed]
+        return self._allreduce_root(epoch, arrays, op, deadline)
+
+    def _ring_allreduce(
+        self, epoch: _Epoch, array: np.ndarray, op: ReduceOp, deadline: float
+    ) -> np.ndarray:
+        """Ring reduce-scatter + allgather over the full-mesh sockets. Each
+        chunk has exactly one accumulation order (ring order starting at its
+        owner), so every rank ends with identical bytes."""
+        n = epoch.world_size
+        rank = epoch.rank
+        next_peer = (rank + 1) % n
+        prev_peer = (rank - 1) % n
+        acc_dtype = _acc_dtype(array.dtype)
+        flat = array.reshape(-1).astype(acc_dtype, copy=True)
+        bounds = np.linspace(0, flat.size, n + 1, dtype=np.int64)
+
+        def chunk(index: int) -> np.ndarray:
+            index %= n
+            return flat[bounds[index] : bounds[index + 1]]
+
+        def exchange(send_buf: bytes) -> bytes:
+            # Full-duplex: send on a helper thread while receiving, or two
+            # big sendalls would deadlock on socket buffers.
+            error: List[BaseException] = []
+
+            def do_send() -> None:
+                try:
+                    _send_bytes(epoch.peers[next_peer], send_buf, deadline)
+                except BaseException as e:  # noqa: BLE001
+                    error.append(e)
+
+            sender = threading.Thread(target=do_send)
+            sender.start()
+            received = _recv_bytes(epoch.peers[prev_peer], deadline)
+            sender.join()
+            if error:
+                raise error[0]
+            return received
+
+        # Phase 1 - reduce-scatter: after n-1 steps, rank owns the fully
+        # reduced chunk (rank+1).
+        for step in range(n - 1):
+            send_chunk = chunk(rank - step)
+            received = exchange(send_chunk.tobytes())
+            target = chunk(rank - step - 1)
+            target += np.frombuffer(received, dtype=acc_dtype)
+        own = rank + 1
+        if op == ReduceOp.AVG:
+            chunk(own)[...] = chunk(own) / n
+        # Phase 2 - allgather: circulate reduced chunks around the ring in
+        # the ORIGINAL dtype — each owner downcasts its chunk exactly once,
+        # so bf16 payloads move 2 bytes/element (not the f32 accumulator's
+        # 4) and every rank still ends bitwise identical.
+        out = np.empty(flat.size, dtype=array.dtype)
+
+        def out_chunk(index: int) -> np.ndarray:
+            index %= n
+            return out[bounds[index] : bounds[index + 1]]
+
+        out_chunk(own)[...] = chunk(own).astype(array.dtype)
+        for step in range(n - 1):
+            send_chunk = out_chunk(own - step)
+            received = exchange(np.ascontiguousarray(send_chunk).tobytes())
+            out_chunk(own - step - 1)[...] = np.frombuffer(
+                received, dtype=array.dtype
+            )
+        return out.reshape(array.shape)
+
+    def _allreduce_root(
+        self,
+        epoch: _Epoch,
+        arrays: List[np.ndarray],
+        op: ReduceOp,
+        deadline: float,
+    ) -> List[np.ndarray]:
+        n = epoch.world_size
         # Gather-at-root with rank-ascending reduction, broadcast result: all
-        # ranks end bitwise identical. Determinism beats bandwidth balance on
-        # the small replica axis.
+        # ranks end bitwise identical.
         rank = epoch.rank
         out: List[np.ndarray] = []
         if rank == 0:

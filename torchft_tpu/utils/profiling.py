@@ -32,17 +32,26 @@ _CHROME: Optional[tuple] = None
 @contextmanager
 def chrome_trace(path: str) -> Generator[None, None, None]:
     """Captures every :func:`trace_span` in the with-body as chrome-trace
-    "X" (complete) events and writes them to ``path`` on exit."""
+    "X" (complete) events and writes them to ``path`` on exit. Captures may
+    nest/overlap (the previous capture is restored on exit); spans still
+    open on other threads when the capture ends record into the old list
+    harmlessly (they are not in the written file)."""
     global _CHROME
     events: List[dict] = []
-    _CHROME = (events, threading.Lock())
+    lock = threading.Lock()
+    previous = _CHROME
+    _CHROME = (events, lock)
     try:
         yield
     finally:
-        _CHROME = None
+        _CHROME = previous
+        with lock:
+            snapshot = list(events)
         with open(path, "w") as f:
-            json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
-        logger.info("chrome trace with %d events written to %s", len(events), path)
+            json.dump({"traceEvents": snapshot, "displayTimeUnit": "ms"}, f)
+        logger.info(
+            "chrome trace with %d events written to %s", len(snapshot), path
+        )
 
 
 @contextmanager
