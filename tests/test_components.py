@@ -400,3 +400,85 @@ def test_chrome_trace_capture_writes_span_events(tmp_path) -> None:
     with trace_span("tpuft::test::outside"):
         pass
     assert "outside" not in path.read_text()
+
+
+def test_telemetry_file_export_through_real_manager(tmp_path) -> None:
+    """The telemetry attach path end to end: file-mode export captures the
+    quorum/commit events a real manager emits, with the structured fields
+    (job/replica/rank/quorum/step) present."""
+    import json as _json
+
+    from torchft_tpu import telemetry
+    from torchft_tpu.coordination import LighthouseServer
+    from torchft_tpu.manager import Manager
+    from torchft_tpu.parallel.process_group import ProcessGroupDummy
+    from torchft_tpu.parallel.store import StoreClient, StoreServer
+
+    out = tmp_path / "events.jsonl"
+    event_loggers = (
+        telemetry.quorums_logger,
+        telemetry.commits_logger,
+        telemetry.errors_logger,
+    )
+    before = {id(h) for lg in event_loggers for h in lg.handlers}
+    telemetry.configure_telemetry(f"file:{out}")
+    added = [
+        h for lg in event_loggers for h in lg.handlers if id(h) not in before
+    ]
+    manager = store = lighthouse = None
+    try:
+        lighthouse = LighthouseServer(min_replicas=1, join_timeout_ms=100)
+        store = StoreServer()
+        pg = ProcessGroupDummy()
+        manager = Manager(
+            pg=pg,
+            min_replica_size=1,
+            store=StoreClient(store.address()),
+            store_addr=store.address(),
+            lighthouse_addr=lighthouse.address(),
+            replica_id="telemetry-test",
+            timeout=20.0,
+            quorum_timeout=30.0,
+            use_async_quorum=False,
+        )
+        manager.register_state_dict_fn("m", lambda s: None, lambda: {"x": 1})
+        manager.start_quorum()
+        assert manager.should_commit()
+    finally:
+        if manager is not None:
+            manager.shutdown(wait=False)
+        if store is not None:
+            store.shutdown()
+        if lighthouse is not None:
+            lighthouse.shutdown()
+        # Detach and close ONLY the handler this test attached (an
+        # application-configured TPUFT_TELEMETRY handler must survive).
+        for lg in event_loggers:
+            for handler in list(lg.handlers):
+                if id(handler) in {id(h) for h in added}:
+                    lg.removeHandler(handler)
+        for handler in added:
+            stream = getattr(handler, "_stream", None)
+            if stream is not None and stream not in (sys.stderr, sys.stdout):
+                stream.close()
+    events = [_json.loads(line) for line in out.read_text().splitlines()]
+    kinds = {e["event"] for e in events}
+    assert "tpuft_quorums" in kinds and "tpuft_commits" in kinds
+    commit = next(e for e in events if e["event"] == "tpuft_commits")
+    for field in ("replica_id", "rank", "step"):
+        assert field in commit, commit
+
+
+def test_telemetry_otlp_mode_reports_missing_sdk() -> None:
+    """The otlp attach path fails loudly (not silently) when the optional
+    opentelemetry SDK is absent, naming the fix."""
+    from torchft_tpu import telemetry
+
+    try:
+        import opentelemetry.sdk  # noqa: F401
+
+        pytest.skip("opentelemetry-sdk installed; attach would succeed")
+    except ImportError:
+        pass
+    with pytest.raises(RuntimeError, match="opentelemetry-sdk"):
+        telemetry.configure_telemetry("otlp")

@@ -8,9 +8,11 @@ job_id/replica_id/rank/quorum_id/step fields in ``record.__dict__``.
 Export is opt-in via ``TPUFT_TELEMETRY``:
   - ``console``: JSON lines to stderr
   - ``file:<path>``: JSON lines appended to <path>
-  - unset: records flow to whatever handlers the application configures
-    (opentelemetry's LoggingHandler attaches cleanly to these loggers when
-    available — it is not bundled in this environment).
+  - ``otlp``: attach opentelemetry's LoggingHandler (requires the
+    ``opentelemetry-sdk`` packages; endpoint/resource attributes come from
+    the standard ``OTEL_*`` env, mirroring the reference's
+    ``TORCHFT_USE_OTEL`` path, otel.py:42-79)
+  - unset: records flow to whatever handlers the application configures.
 """
 
 from __future__ import annotations
@@ -69,11 +71,35 @@ def configure_telemetry(mode: str | None = None) -> None:
         handler: logging.Handler = _JsonLinesHandler(sys.stderr)
     elif mode.startswith("file:"):
         handler = _JsonLinesHandler(open(mode[len("file:") :], "a"))
+    elif mode == "otlp":
+        handler = _make_otlp_handler()
     else:
         raise ValueError(f"unknown TPUFT_TELEMETRY mode: {mode}")
     for event_logger in (quorums_logger, commits_logger, errors_logger):
         event_logger.addHandler(handler)
         event_logger.setLevel(logging.INFO)
+
+
+def _make_otlp_handler() -> logging.Handler:
+    """Builds an opentelemetry LoggingHandler backed by a batch OTLP log
+    exporter. Raises a clear error when the (optional) SDK is absent."""
+    try:
+        from opentelemetry.exporter.otlp.proto.grpc._log_exporter import (
+            OTLPLogExporter,
+        )
+        from opentelemetry.sdk._logs import LoggerProvider, LoggingHandler
+        from opentelemetry.sdk._logs.export import BatchLogRecordProcessor
+    except ImportError as e:  # pragma: no cover - env-dependent
+        raise RuntimeError(
+            "TPUFT_TELEMETRY=otlp requires the opentelemetry-sdk and "
+            "opentelemetry-exporter-otlp packages (endpoint via OTEL_EXPORTER_"
+            "OTLP_ENDPOINT); use 'console' or 'file:<path>' otherwise"
+        ) from e
+    provider = LoggerProvider()
+    provider.add_log_record_processor(BatchLogRecordProcessor(OTLPLogExporter()))
+    # The provider is passed explicitly; no global set_logger_provider side
+    # effect (it would race an application-configured OTel provider).
+    return LoggingHandler(level=logging.INFO, logger_provider=provider)
 
 
 configure_telemetry()
