@@ -21,3 +21,17 @@ def test_ft_multichip_drill_kill_heal_bitwise() -> None:
     assert out["kills"] == 1
     assert out["fsdp"] == 2 and out["tp"] == 2
     assert out["final_step"] == 5
+
+
+def test_ft_multichip_upscale_while_training() -> None:
+    """HSDP upscale: a third replica group (its own sharded mesh) joins a
+    running 2-group job, heals the sharded state, and all three groups end
+    bitwise identical (the DDP upscale test's missing sharded sibling)."""
+    if len(jax.devices()) < 6:
+        pytest.skip("needs 6 (virtual) devices")
+    out = graft.ft_multichip_drill(
+        6, n_steps=6, kill_at=None, n_groups=3, join_at=1
+    )
+    assert out["groups"] == 3
+    assert out["kills"] == 0
+    assert out["final_step"] == 6
