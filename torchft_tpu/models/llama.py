@@ -53,9 +53,22 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     tie_embeddings: bool = False
-    # "auto": ring attention iff an 'sp' axis is in the ambient mesh.
+    # "auto": ring attention iff an 'sp' axis is in the ambient mesh, else
+    # blockwise when the sequence is long, else dense. Explicit options:
+    # "dense", "blockwise" (O(s*block) memory, ops/ring_attention.py),
+    # "ring".
     attention_impl: str = "auto"
     sp_axis: str = "sp"
+    attention_block_size: int = 512
+    # auto picks blockwise over dense at/after this sequence length.
+    blockwise_min_seq: int = 2048
+
+    def __post_init__(self) -> None:
+        valid = ("auto", "dense", "blockwise", "ring")
+        if self.attention_impl not in valid:
+            raise ValueError(
+                f"attention_impl={self.attention_impl!r} is not one of {valid}"
+            )
 
     @property
     def head_dim(self) -> int:
@@ -191,6 +204,14 @@ class Attention(nn.Module):
             from torchft_tpu.ops.ring_attention import ring_attention
 
             out = ring_attention(q, k, v, axis_name=cfg.sp_axis, scale=scale)
+        elif cfg.attention_impl == "blockwise" or (
+            cfg.attention_impl == "auto" and x.shape[1] >= cfg.blockwise_min_seq
+        ):
+            from torchft_tpu.ops.ring_attention import blockwise_attention
+
+            out = blockwise_attention(
+                q, k, v, scale=scale, block_size=cfg.attention_block_size
+            )
         else:
             out = causal_attention(q, k, v, scale)
         return dense(features=cfg.dim, axis=(-2, -1), name="wo")(out)
