@@ -35,19 +35,29 @@ sys.path.insert(0, str(Path(__file__).resolve().parent))
 
 def _ensure_live_backend() -> None:
     """The accelerator backend can wedge during PJRT init (remote-chip
-    tunnel). Probe it in a disposable subprocess; if the probe can't list
-    devices within the deadline, pin this process to CPU so the bench still
-    reports (with a degraded baseline) instead of hanging the driver."""
+    tunnel) — or, worse, list devices fine and then hang on the first
+    compile/execute (observed 2026-07-29: ``jax.devices()`` returned
+    ``[TPU v5 lite0]`` while a 256x256 matmul never completed). Probe in a
+    disposable subprocess and require a full compile→execute→fetch round
+    trip; if that can't finish within the deadline, pin this process to CPU
+    so the bench still reports (with a degraded baseline) instead of
+    hanging the driver."""
     if os.environ.get("TPUFT_BENCH_NO_PROBE"):
         return
+    probe_src = (
+        "import jax, jax.numpy as jnp;"
+        "x = jnp.ones((128, 128), jnp.bfloat16);"
+        "y = jax.jit(lambda a: a @ a)(x);"
+        "assert float(y[0, 0]) == 128.0"
+    )
     try:
         # DEVNULL, not pipes: a wedged PJRT init can leave a tunnel-helper
         # grandchild holding inherited pipe fds, and draining them after the
         # timeout kill would hang forever — the exact failure this probe
         # exists to catch.
         probe = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=120,
+            [sys.executable, "-c", probe_src],
+            timeout=180,
             stdout=subprocess.DEVNULL,
             stderr=subprocess.DEVNULL,
         )
@@ -273,6 +283,18 @@ def main() -> None:
     # ---- 2-replica-group drill: wire sync cost + kill recovery ----
     two_group = _two_group_drill()
 
+    # On a live chip, also run the Pallas flash-attention kernel through its
+    # compiled (Mosaic) path — the CLAUDE.md "verify kernels on the real
+    # chip" gate, automated so it can never silently go unexercised.
+    flash_on_chip = None
+    if not DEGRADED and jax.devices()[0].platform == "tpu":
+        from torchft_tpu.ops.flash_attention import verify_on_chip
+
+        try:
+            flash_on_chip = verify_on_chip()["ok"]
+        except Exception as e:  # report, don't sink the bench line
+            flash_on_chip = f"failed: {e}"
+
     # MFU estimate for the headline path: causal-LM forward+backward is
     # ~6·N_params FLOPs/token plus the attention term 12·L·d·s.
     flops_per_token = 6.0 * n_params + 12.0 * config.n_layers * config.dim * SEQ
@@ -297,6 +319,7 @@ def main() -> None:
                 "mfu_pct": mfu_pct,
                 "device_kind": str(getattr(jax.devices()[0], "device_kind", "unknown")),
                 "n_params": n_params,
+                "flash_kernel_on_chip": flash_on_chip,
                 "quorum_p50_ms": quorum_p50_ms,
                 **two_group,
             }

@@ -1,0 +1,112 @@
+"""Pallas flash attention vs dense causal attention (interpret mode on CPU;
+the same kernel compiles via Mosaic on real TPU — ops/quantization.py
+convention)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchft_tpu.models.llama import causal_attention
+from torchft_tpu.ops.flash_attention import flash_attention
+
+
+def _qkv(b, s, h, kv, d, seed=0, dtype=jnp.float32):
+    kq, kk, kvk = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(kq, (b, s, h, d), dtype)
+    k = jax.random.normal(kk, (b, s, kv, d), dtype)
+    v = jax.random.normal(kvk, (b, s, kv, d), dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize(
+    "b,s,h,kv,d,block",
+    [
+        (2, 64, 4, 4, 16, 32),   # MHA, block divides s
+        (1, 128, 8, 2, 32, 32),  # GQA group=4
+        (2, 100, 4, 2, 16, 32),  # ragged: s not a block multiple
+        (1, 24, 2, 1, 8, 64),    # block larger than s (clamped)
+    ],
+)
+def test_forward_matches_dense(b, s, h, kv, d, block):
+    q, k, v = _qkv(b, s, h, kv, d)
+    dense = causal_attention(q, k, v, scale=d**-0.5)
+    out = flash_attention(q, k, v, block_q=block, block_k=block, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(dense), atol=2e-5)
+
+
+def test_forward_jits_and_matches_blockwise_lse_layout():
+    # jit the whole thing (the kernel is traced once inside) and cross-check
+    # against the scan-based blockwise path, which shares the backward.
+    from torchft_tpu.ops.ring_attention import blockwise_attention
+
+    q, k, v = _qkv(1, 96, 4, 2, 16, seed=3)
+    f = jax.jit(
+        lambda q, k, v: flash_attention(
+            q, k, v, block_q=32, block_k=32, interpret=True
+        )
+    )
+    np.testing.assert_allclose(
+        np.asarray(f(q, k, v)),
+        np.asarray(blockwise_attention(q, k, v, block_size=32)),
+        atol=2e-5,
+    )
+
+
+def test_gradients_match_dense():
+    b, s, h, kv, d = 1, 64, 4, 2, 16
+    q, k, v = _qkv(b, s, h, kv, d, seed=1)
+    w = jax.random.normal(jax.random.PRNGKey(9), (b, s, h, d), jnp.float32)
+
+    def loss_flash(q, k, v):
+        out = flash_attention(q, k, v, block_q=32, block_k=32, interpret=True)
+        return jnp.sum(out * w)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(causal_attention(q, k, v, scale=d**-0.5) * w)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for gf, gd, name in zip(g_flash, g_dense, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(gf), np.asarray(gd), atol=5e-5, err_msg=f"d{name}"
+        )
+
+
+def test_llama_flash_impl_trains():
+    from torchft_tpu.models.llama import Llama, LlamaConfig, cross_entropy_loss
+
+    config = LlamaConfig(
+        vocab_size=128, dim=32, n_layers=1, n_heads=4, n_kv_heads=2,
+        ffn_hidden=64, max_seq_len=64, dtype=jnp.float32,
+        attention_impl="flash", attention_block_size=32,
+    )
+    model = Llama(config)
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (2, 33), 0, 128)
+    params = model.init(jax.random.PRNGKey(1), tokens[:, :-1])
+
+    def loss_fn(p):
+        return cross_entropy_loss(
+            model.apply(p, tokens[:, :-1]), tokens[:, 1:]
+        )
+
+    loss, grads = jax.jit(jax.value_and_grad(loss_fn))(params)
+    assert np.isfinite(float(loss))
+    # Against the identical model with dense attention: same loss & grads.
+    dense_model = Llama(
+        LlamaConfig(
+            **{**config.__dict__, "attention_impl": "dense"}
+        )
+    )
+    dense_loss = jax.jit(
+        lambda p: cross_entropy_loss(
+            dense_model.apply(p, tokens[:, :-1]), tokens[:, 1:]
+        )
+    )(params)
+    np.testing.assert_allclose(float(loss), float(dense_loss), atol=1e-5)
+    assert all(
+        np.all(np.isfinite(np.asarray(g)))
+        for g in jax.tree_util.tree_leaves(grads)
+    )

@@ -299,7 +299,7 @@ def test_blockwise_attention_matches_dense() -> None:
         with pytest.raises(ValueError, match="attention_impl"):
             from torchft_tpu.models.llama import LlamaConfig
 
-            LlamaConfig(attention_impl="flash")
+            LlamaConfig(attention_impl="flashiest")
 
 
 def test_llama_blockwise_impl_matches_dense_model() -> None:

@@ -479,7 +479,7 @@ class Manager:
         timed = Work(future_timeout(work._future, timeout or self._timeout))
 
         def handler(e: Exception) -> None:
-            self._logger.exception(f"got exception in future -- skipping remaining: {e}")
+            self._logger.exception(f"future raised; remaining callbacks skipped: {e}")
             self.report_error(e)
 
         return timed.with_error_handler(handler, default)

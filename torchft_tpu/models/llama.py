@@ -56,7 +56,8 @@ class LlamaConfig:
     # "auto": ring attention iff an 'sp' axis is in the ambient mesh, else
     # blockwise when the sequence is long, else dense. Explicit options:
     # "dense", "blockwise" (O(s*block) memory, ops/ring_attention.py),
-    # "ring".
+    # "flash" (fused Pallas TPU kernel forward + same flash backward,
+    # ops/flash_attention.py; interpret-mode off-TPU), "ring".
     attention_impl: str = "auto"
     sp_axis: str = "sp"
     attention_block_size: int = 512
@@ -64,7 +65,7 @@ class LlamaConfig:
     blockwise_min_seq: int = 2048
 
     def __post_init__(self) -> None:
-        valid = ("auto", "dense", "blockwise", "ring")
+        valid = ("auto", "dense", "blockwise", "flash", "ring")
         if self.attention_impl not in valid:
             raise ValueError(
                 f"attention_impl={self.attention_impl!r} is not one of {valid}"
@@ -204,6 +205,14 @@ class Attention(nn.Module):
             from torchft_tpu.ops.ring_attention import ring_attention
 
             out = ring_attention(q, k, v, axis_name=cfg.sp_axis, scale=scale)
+        elif cfg.attention_impl == "flash":
+            from torchft_tpu.ops.flash_attention import flash_attention
+
+            out = flash_attention(
+                q, k, v, scale=scale,
+                block_q=cfg.attention_block_size,
+                block_k=cfg.attention_block_size,
+            )
         elif cfg.attention_impl == "blockwise" or (
             cfg.attention_impl == "auto" and x.shape[1] >= cfg.blockwise_min_seq
         ):

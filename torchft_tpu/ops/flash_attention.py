@@ -1,0 +1,277 @@
+"""Pallas TPU flash attention: the fused-kernel path for the hot op.
+
+The scan-based :func:`torchft_tpu.ops.ring_attention.blockwise_attention`
+already gives O(s·block) memory, but each block update is a separate XLA
+fusion: scores, mask, softmax bookkeeping, and the PV matmul round-trip
+through HBM between blocks. This module fuses the whole online-softmax
+inner loop into ONE Pallas kernel so the accumulators (acc, running max,
+running sum) live in VMEM for the duration and the two matmuls per block
+ride the MXU back-to-back (pallas_guide.md: grid iterated sequentially on
+TPU with the last axis minor, which makes cross-grid-step VMEM scratch the
+canonical accumulation pattern).
+
+Scope: forward only. The backward pass reuses the flash-style custom_vjp
+backward already verified for ``blockwise_attention`` (recompute
+probabilities per block from the saved logsumexp) — the Pallas forward
+emits exactly the residuals it needs (out, lse). This keeps the new
+Mosaic-lowered surface to one kernel; following ops/quantization.py's
+convention it is exercised in interpret mode on CPU tests and compiled on
+real TPU. Run :func:`verify_on_chip` on a live chip after any kernel
+change (the CLAUDE.md kernel-verification gate); until that has passed on
+real hardware, "flash" stays opt-in rather than an "auto" choice.
+
+The reference has no attention code at all (SURVEY.md §2.7: long-sequence
+scaling is delegated to torchtitan); this is part of the beyond-reference
+long-context stack, sitting below ring attention (which shards the
+sequence across chips) as the per-chip kernel.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchft_tpu.ops.ring_attention import _blockwise_core_bwd
+
+__all__ = ["flash_attention"]
+
+_NEG_INF = -1e30
+
+
+def _fwd_kernel(
+    q_ref,
+    k_ref,
+    v_ref,
+    o_ref,
+    lse_ref,
+    acc_ref,
+    m_ref,
+    l_ref,
+    *,
+    scale: float,
+    block_q: int,
+    block_k: int,
+    nk: int,
+):
+    """One (batch, head, q-block, kv-block) grid step.
+
+    Refs: q (block_q, d); k/v (block_k, d); o (block_q, d);
+    lse (block_q, 1) — scalars-per-row ride as a column, rank-1 tiled
+    outputs fail Mosaic lowering (see ops/quantization.py). Scratch
+    acc (block_q, d) f32, m/l (block_q, 1) f32 persist across the kv grid
+    axis (innermost, sequential on TPU).
+    """
+    from jax.experimental import pallas as pl
+
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # Causal skip: a KV block whose first position is beyond this q block's
+    # last position is fully masked — skip both matmuls (the grid still
+    # visits the step, but the MXU does nothing).
+    @pl.when(ik * block_k <= iq * block_q + block_q - 1)
+    def _update():
+        q = q_ref[...]
+        k = k_ref[...]
+        scores = (
+            jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )  # (block_q, block_k) f32
+        q_pos = iq * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        k_pos = ik * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        scores = jnp.where(q_pos >= k_pos, scores, _NEG_INF)
+
+        m_prev = m_ref[...]  # (block_q, 1)
+        m_new = jnp.maximum(m_prev, jnp.max(scores, axis=1, keepdims=True))
+        correction = jnp.exp(m_prev - m_new)
+        p = jnp.exp(scores - m_new)  # (block_q, block_k) f32
+        l_ref[...] = l_ref[...] * correction + jnp.sum(p, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v_ref.dtype),
+            v_ref[...],
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[...] = acc_ref[...] * correction + pv
+        m_ref[...] = m_new
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[...] = (acc_ref[...] / l).astype(o_ref.dtype)
+        lse_ref[...] = m_ref[...] + jnp.log(l)
+
+
+def _flash_fwd(q, k, v, scale, block_q, block_k, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, s, h, d = q.shape
+    kv_heads = k.shape[2]
+    group = h // kv_heads
+
+    pad_q = (-s) % block_q
+    pad_k = (-s) % block_k
+    if pad_q:
+        q = jnp.pad(q, ((0, 0), (0, pad_q), (0, 0), (0, 0)))
+    if pad_k:
+        # Padded KV positions sit beyond every real query, so the causal
+        # mask excludes them; padded q rows are sliced off below.
+        k = jnp.pad(k, ((0, 0), (0, pad_k), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad_k), (0, 0), (0, 0)))
+    nq = (s + pad_q) // block_q
+    nk = (s + pad_k) // block_k
+
+    kernel = partial(
+        _fwd_kernel, scale=scale, block_q=block_q, block_k=block_k, nk=nk
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(b, h, nq, nk),
+        in_specs=[
+            pl.BlockSpec(
+                (None, block_q, None, d), lambda ib, ih, iq, ik: (ib, iq, ih, 0)
+            ),
+            pl.BlockSpec(
+                (None, block_k, None, d),
+                lambda ib, ih, iq, ik: (ib, ik, ih // group, 0),
+            ),
+            pl.BlockSpec(
+                (None, block_k, None, d),
+                lambda ib, ih, iq, ik: (ib, ik, ih // group, 0),
+            ),
+        ],
+        out_specs=[
+            pl.BlockSpec(
+                (None, block_q, None, d), lambda ib, ih, iq, ik: (ib, iq, ih, 0)
+            ),
+            pl.BlockSpec(
+                (None, block_q, None, 1), lambda ib, ih, iq, ik: (ib, iq, ih, 0)
+            ),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, s + pad_q, h, d), q.dtype),
+            jax.ShapeDtypeStruct((b, s + pad_q, h, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    if pad_q:
+        out = out[:, :s]
+        lse = lse[:, :s]
+    # (b, s, h, 1) -> (b, s, kv, group): head h is kv-head h // group, the
+    # same layout blockwise_attention's backward expects for its residual.
+    return out, lse[..., 0].reshape(b, s, kv_heads, group)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_core(q, k, v, scale, block_q, block_k, interpret):
+    return _flash_fwd(q, k, v, scale, block_q, block_k, interpret)[0]
+
+
+def _flash_core_fwd(q, k, v, scale, block_q, block_k, interpret):
+    out, lse = _flash_fwd(q, k, v, scale, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_core_bwd(scale, block_q, block_k, interpret, residuals, d_out):
+    # The scan-based flash backward (recompute probabilities per KV block
+    # from the saved logsumexp) — shared with blockwise_attention, already
+    # verified against dense attention gradients.
+    return _blockwise_core_bwd(scale, block_k, residuals, d_out)
+
+
+_flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+
+
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Fused causal GQA attention on one device (Pallas TPU kernel forward,
+    flash-style recompute backward).
+
+    Shapes: q (b, s, h, d); k/v (b, s, kv_heads, d); h % kv_heads == 0.
+    The sequence is padded to block multiples internally; outputs are
+    returned in the original length. ``interpret=None`` auto-selects
+    interpret mode off-TPU so the same call works in CPU tests.
+    """
+    b, s, h, d = q.shape
+    kv_heads = k.shape[2]
+    if h % kv_heads:
+        raise ValueError(f"n_heads {h} not a multiple of kv_heads {kv_heads}")
+    if scale is None:
+        scale = d**-0.5
+    if interpret is None:
+        # Same device-platform check as ops/quantization.py's *_device
+        # helpers: the backend NAME on this machine is "axon" while the
+        # device platform is "tpu", and only the latter says whether Mosaic
+        # can compile the kernel.
+        interpret = jax.devices()[0].platform != "tpu"
+    # Align the block size itself (not just the clamp bound) to a multiple
+    # of 16 — the sublane tile for bf16 (and a multiple of f32's 8) — then
+    # clamp oversized blocks to the padded sequence. A ragged block would
+    # pass interpret-mode tests and fail Mosaic lowering on the chip.
+    block_q = min(_next_multiple(int(block_q), 16), _next_multiple(s, 16))
+    block_k = min(_next_multiple(int(block_k), 16), _next_multiple(s, 16))
+    return _flash_core(
+        q, k, v, float(scale), int(block_q), int(block_k), bool(interpret)
+    )
+
+
+def _next_multiple(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m
+
+
+def verify_on_chip() -> dict:
+    """Compile (not interpret) the kernel on the attached accelerator and
+    check it against dense attention — the CLAUDE.md 'verify kernels on the
+    real chip' gate, runnable whenever the relay is healthy:
+
+        python -c "from torchft_tpu.ops.flash_attention import verify_on_chip; print(verify_on_chip())"
+    """
+    import numpy as np
+
+    from torchft_tpu.models.llama import causal_attention
+
+    dev = jax.devices()[0]
+    if dev.platform != "tpu":
+        raise RuntimeError(f"no TPU attached (devices()[0] is {dev})")
+    b, s, h, kv, d = 2, 256, 4, 2, 64
+    kq, kk, kvk = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(kq, (b, s, h, d), jnp.bfloat16)
+    k = jax.random.normal(kk, (b, s, kv, d), jnp.bfloat16)
+    v = jax.random.normal(kvk, (b, s, kv, d), jnp.bfloat16)
+    out = flash_attention(q, k, v, block_q=128, block_k=128, interpret=False)
+    ref = causal_attention(q, k, v, scale=d**-0.5)
+    err = float(
+        jnp.max(jnp.abs(out.astype(jnp.float32) - ref.astype(jnp.float32)))
+    )
+    if err > 0.05:  # bf16 tolerance
+        raise AssertionError(f"on-chip flash attention mismatch: max err {err}")
+    return {"device": str(dev), "max_err": err, "ok": True}
