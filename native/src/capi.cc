@@ -232,4 +232,80 @@ int tpuft_collective_barrier(void* handle, int64_t timeout_ms) {
   return h->group.barrier(timeout_ms, &h->last_error) ? 0 : 1;
 }
 
+// ---------- Pure-function test hooks ----------
+// Serialized-proto in/out so Python can differential-test the quorum logic
+// without standing up servers. Return value: bytes written into `out`, or
+// -1 with tpuft_last_error() set (out too small counts as an error so a
+// truncated proto can never be parsed as a real result).
+
+int tpuft_quorum_compute(const uint8_t* req_buf, int req_len, uint8_t* out,
+                         int out_cap) {
+  tpuft::QuorumSimRequest req;
+  if (!req.ParseFromArray(req_buf, req_len)) {
+    set_error("QuorumSimRequest parse failed");
+    return -1;
+  }
+  const tpuft::Instant now = tpuft::Clock::now();
+  tpuft::LighthouseState state;
+  for (const auto& p : req.participants()) {
+    const std::string& id = p.member().replica_id();
+    state.heartbeats[id] =
+        now - tpuft::DurationMs(static_cast<int64_t>(p.heartbeat_age_ms()));
+    if (!p.heartbeat_only()) {
+      tpuft::ParticipantDetails details;
+      details.joined =
+          now - tpuft::DurationMs(static_cast<int64_t>(p.joined_age_ms()));
+      details.member = p.member();
+      state.participants[id] = details;
+    }
+  }
+  if (req.has_prev_quorum()) {
+    state.prev_quorum = req.prev_quorum();
+    state.quorum_id = req.prev_quorum().quorum_id();
+  }
+  tpuft::LighthouseOptions opt;
+  opt.min_replicas = req.min_replicas();
+  opt.join_timeout_ms = req.join_timeout_ms();
+  opt.heartbeat_timeout_ms = req.heartbeat_timeout_ms();
+
+  tpuft::QuorumDecision decision = tpuft::quorum_compute(now, state, opt);
+  tpuft::QuorumSimResponse resp;
+  resp.set_has_quorum(decision.participants.has_value());
+  resp.set_reason(decision.reason);
+  if (decision.participants) {
+    for (const auto& m : *decision.participants) *resp.add_participants() = m;
+  }
+  const int needed = static_cast<int>(resp.ByteSizeLong());
+  if (needed > out_cap) {
+    set_error("QuorumSimResponse buffer too small");
+    return -1;
+  }
+  resp.SerializeToArray(out, out_cap);
+  return needed;
+}
+
+int tpuft_compute_quorum_results(const char* replica_id, int64_t group_rank,
+                                 const uint8_t* quorum_buf, int quorum_len,
+                                 int init_sync, uint8_t* out, int out_cap) {
+  tpuft::Quorum quorum;
+  if (!quorum.ParseFromArray(quorum_buf, quorum_len)) {
+    set_error("Quorum parse failed");
+    return -1;
+  }
+  std::string error;
+  std::optional<tpuft::ManagerQuorumResponse> resp = tpuft::compute_quorum_results(
+      replica_id, group_rank, quorum, init_sync != 0, &error);
+  if (!resp) {
+    set_error(error.empty() ? "compute_quorum_results failed" : error);
+    return -1;
+  }
+  const int needed = static_cast<int>(resp->ByteSizeLong());
+  if (needed > out_cap) {
+    set_error("ManagerQuorumResponse buffer too small");
+    return -1;
+  }
+  resp->SerializeToArray(out, out_cap);
+  return needed;
+}
+
 }  // extern "C"

@@ -145,10 +145,13 @@ def test_two_groups_two_jax_procs_sigkill_recovery(tmp_path) -> None:
             results[(group, rank)] = json.loads(path.read_text())
     for (group, rank), data in results.items():
         assert data["step"] == 60, (group, rank, data)
-    # The restarted group's final incarnation must have HEALED into the run
-    # (its history starts past the kill step), not retrained from scratch.
+    # The restarted group's final incarnation must have HEALED into the run,
+    # not retrained from scratch: the SIGKILL fires at step 2 before that
+    # step commits, so a from-scratch incarnation's history starts at 0
+    # while a healed one starts at the survivor's step, which is at least 3
+    # (exactly 3 when the loaded box makes the survivor slow — still a heal).
     g1_first_commit = min(int(k) for k in results[(1, 1)]["history"])
-    assert g1_first_commit > 3, f"group 1 retrained solo from step {g1_first_commit}"
+    assert g1_first_commit > 2, f"group 1 retrained solo from step {g1_first_commit}"
     # Cross-GROUP digest equality per rank: each rank holds the same shard
     # partitions in both groups, and committed state must be bitwise equal.
     assert results[(0, 0)]["digest"] == results[(1, 0)]["digest"]

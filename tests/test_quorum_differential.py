@@ -1,0 +1,268 @@
+"""Differential (randomized property) tests of the native quorum logic.
+
+The native plane's two pure functions — ``quorum_compute`` and
+``compute_quorum_results`` (native/src/quorum.cc) — carry the whole
+coordination contract (reference lighthouse.rs:141-269, manager.rs:489-624).
+The example-based ports of the reference's Rust unit tests live in
+native/tests/quorum_test.cc; this file adds a second, independent layer:
+a Python oracle implementing the documented contract, compared against the
+C++ implementation over thousands of randomized cluster states. Any
+divergence — crash, membership difference, recovery-plan difference — is a
+contract bug in one of the two.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from torchft_tpu.coordination import (
+    Quorum,
+    QuorumMember,
+    SimParticipant,
+    compute_quorum_results_sim,
+    quorum_compute_sim,
+)
+
+# ---------------------------------------------------------------------------
+# Oracles: written from the documented contract (SURVEY.md §2.1), not from
+# the C++ code, so the two implementations are genuinely independent.
+# ---------------------------------------------------------------------------
+
+
+def oracle_quorum_compute(
+    parts: list[SimParticipant],
+    prev: Quorum | None,
+    min_replicas: int,
+    join_timeout_ms: int,
+    heartbeat_timeout_ms: int,
+) -> list[str] | None:
+    """Returns the sorted replica_id list of the quorum, or None."""
+    healthy = {
+        p.member.replica_id
+        for p in parts
+        if p.heartbeat_age_ms < heartbeat_timeout_ms
+    }
+    joined = sorted(
+        (p for p in parts if not p.heartbeat_only and p.member.replica_id in healthy),
+        key=lambda p: p.member.replica_id,
+    )
+    candidates = list(joined)
+    shrink_only = any(p.member.shrink_only for p in joined)
+
+    if prev is not None:
+        prev_ids = {m.replica_id for m in prev.participants}
+        if shrink_only:
+            candidates = [
+                p for p in candidates if p.member.replica_id in prev_ids
+            ]
+        # Fast quorum: every previous member is healthy AND participating.
+        joined_ids = {p.member.replica_id for p in joined}
+        if prev_ids <= joined_ids:
+            return [p.member.replica_id for p in candidates]
+
+    if len(joined) < min_replicas:
+        return None
+    # Split-brain guard: strict majority of everything heartbeating.
+    if len(joined) <= len(healthy) // 2:
+        return None
+    # Straggler wait: healthy non-participants get join_timeout_ms, measured
+    # from the earliest participant join.
+    if len(joined) < len(healthy):
+        oldest_join_age = max((p.joined_age_ms for p in joined), default=0)
+        if oldest_join_age < join_timeout_ms:
+            return None
+    return [p.member.replica_id for p in candidates]
+
+
+def oracle_quorum_results(
+    replica_id: str, group_rank: int, quorum: Quorum, init_sync: bool
+) -> dict | None:
+    members = sorted(quorum.participants, key=lambda m: m.replica_id)
+    ids = [m.replica_id for m in members]
+    if replica_id not in ids:
+        return None
+    replica_rank = ids.index(replica_id)
+
+    max_step = max([m.step for m in members] + [0])
+    max_cohort = [i for i, m in enumerate(members) if m.step == max_step]
+    max_rank = None
+    for j, i in enumerate(max_cohort):
+        if members[i].replica_id == replica_id:
+            max_rank = j
+            break
+    primary = members[max_cohort[group_rank % len(max_cohort)]]
+
+    force_recover = init_sync and max_step == 0
+    recover_dst = [
+        i
+        for i, m in enumerate(members)
+        if m.step != max_step
+        or (force_recover and m.replica_id != primary.replica_id)
+    ]
+    up_to_date = [i for i in range(len(members)) if i not in recover_dst]
+
+    src_of: dict[int, int] = {}
+    for j, dst in enumerate(recover_dst):
+        src_of[dst] = up_to_date[(j + group_rank) % len(up_to_date)]
+    my_src = src_of.get(replica_rank)
+    my_dsts = sorted(d for d, s in src_of.items() if s == replica_rank)
+
+    return {
+        "replica_rank": replica_rank,
+        "replica_world_size": len(members),
+        "store_address": primary.store_address,
+        "max_step": max_step,
+        "max_rank": max_rank,
+        "max_world_size": len(max_cohort),
+        "heal": my_src is not None,
+        "recover_src_replica_rank": my_src,
+        "recover_src_manager_address": (
+            members[my_src].address if my_src is not None else ""
+        ),
+        "recover_dst_replica_ranks": my_dsts,
+        "commit_failures": max((m.commit_failures for m in members), default=0),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Randomized comparison
+# ---------------------------------------------------------------------------
+
+
+def _member(i: int, rng: random.Random) -> QuorumMember:
+    return QuorumMember(
+        replica_id=f"rep{i}",
+        address=f"addr{i}:1",
+        store_address=f"store{i}:2",
+        step=rng.choice([0, 0, 1, 2, 5]),
+        world_size=rng.choice([1, 2, 4]),
+        shrink_only=rng.random() < 0.15,
+        commit_failures=rng.choice([0, 0, 0, 1, 3]),
+    )
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_quorum_compute_matches_oracle(seed):
+    rng = random.Random(1000 + seed)
+    hb_timeout = 5000
+    for case in range(300):
+        n = rng.randint(0, 6)
+        parts = []
+        for i in range(n):
+            parts.append(
+                SimParticipant(
+                    member=_member(i, rng),
+                    joined_age_ms=rng.choice([0, 10, 500, 5000, 70000, 120000]),
+                    heartbeat_age_ms=rng.choice([0, 10, 4999, 5000, 9000]),
+                    heartbeat_only=rng.random() < 0.25,
+                )
+            )
+        prev = None
+        if n and rng.random() < 0.5:
+            prev_members = [
+                p.member for p in parts if rng.random() < 0.6
+            ]
+            prev = Quorum(quorum_id=rng.randint(1, 9), participants=prev_members)
+        min_replicas = rng.randint(1, 3)
+        join_timeout = rng.choice([0, 1000, 60000])
+
+        got_members, reason = quorum_compute_sim(
+            parts,
+            prev_quorum=prev,
+            min_replicas=min_replicas,
+            join_timeout_ms=join_timeout,
+            heartbeat_timeout_ms=hb_timeout,
+        )
+        want = oracle_quorum_compute(
+            parts, prev, min_replicas, join_timeout, hb_timeout
+        )
+        got = None if got_members is None else [m.replica_id for m in got_members]
+        assert got == want, (
+            f"case {case}: native={got} oracle={want} reason={reason!r} "
+            f"parts={[(p.member.replica_id, p.joined_age_ms, p.heartbeat_age_ms, p.heartbeat_only, p.member.shrink_only) for p in parts]} "
+            f"prev={None if prev is None else [m.replica_id for m in prev.participants]} "
+            f"min={min_replicas} join_t={join_timeout}"
+        )
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_compute_quorum_results_matches_oracle(seed):
+    rng = random.Random(2000 + seed)
+    for case in range(200):
+        n = rng.randint(1, 6)
+        members = [_member(i, rng) for i in range(n)]
+        rng.shuffle(members)  # input order must not matter
+        quorum = Quorum(quorum_id=rng.randint(1, 9), participants=members)
+        group_rank = rng.randint(0, 3)
+        init_sync = rng.random() < 0.7
+        for m in members:
+            want = oracle_quorum_results(
+                m.replica_id, group_rank, quorum, init_sync
+            )
+            got = compute_quorum_results_sim(
+                m.replica_id, group_rank, quorum, init_sync=init_sync
+            )
+            got_dict = {
+                "replica_rank": got.replica_rank,
+                "replica_world_size": got.replica_world_size,
+                "store_address": got.store_address,
+                "max_step": got.max_step,
+                "max_rank": got.max_rank,
+                "max_world_size": got.max_world_size,
+                "heal": got.heal,
+                "recover_src_replica_rank": got.recover_src_replica_rank,
+                "recover_src_manager_address": got.recover_src_manager_address,
+                "recover_dst_replica_ranks": got.recover_dst_replica_ranks,
+                "commit_failures": got.commit_failures,
+            }
+            assert got_dict == want, (
+                f"case {case} replica {m.replica_id} group_rank {group_rank} "
+                f"init_sync {init_sync}: native={got_dict} oracle={want} "
+                f"members={[(x.replica_id, x.step) for x in members]}"
+            )
+        # Outside member raises (matched so parse/buffer errors can't hide).
+        with pytest.raises(RuntimeError, match="not participating"):
+            compute_quorum_results_sim("ghost", group_rank, quorum)
+
+
+def test_quorum_rejoin_after_shrink_then_grow():
+    """Directed sequence: shrink-only drops a member, then (flag cleared) the
+    join-timeout path readmits it — the membership timeline the lighthouse
+    walks during a downscale+upscale drill, here as pure decisions."""
+    m = lambda i, shrink=False: QuorumMember(
+        replica_id=f"rep{i}", address=f"a{i}", store_address=f"s{i}",
+        shrink_only=shrink,
+    )
+    full = Quorum(quorum_id=1, participants=[m(0), m(1), m(2)])
+    # rep2 stops participating; rep0 sets shrink_only: candidates restricted.
+    parts = [SimParticipant(m(0, shrink=True)), SimParticipant(m(1)),
+             SimParticipant(m(2), heartbeat_only=True, joined_age_ms=0)]
+    got, _ = quorum_compute_sim(
+        parts, prev_quorum=full, min_replicas=1, join_timeout_ms=60000
+    )
+    # Fast path: all prev members still heartbeat... rep2 is healthy but not
+    # participating -> NOT a fast quorum; straggler wait applies.
+    assert got is None
+    # After the join timeout expires, the shrunk quorum forms without rep2.
+    parts_late = [
+        SimParticipant(m(0, shrink=True), joined_age_ms=70000),
+        SimParticipant(m(1), joined_age_ms=70000),
+        SimParticipant(m(2), heartbeat_only=True),
+    ]
+    got, _ = quorum_compute_sim(
+        parts_late, prev_quorum=full, min_replicas=1, join_timeout_ms=60000
+    )
+    assert [x.replica_id for x in got] == ["rep0", "rep1"]
+    # rep2 re-requests against the shrunk prev quorum (shrink flag cleared):
+    # fast quorum for prev members is irrelevant (rep2 new) -> grows via the
+    # normal path once every healthy replica participates.
+    shrunk = Quorum(quorum_id=2, participants=[m(0), m(1)])
+    parts_regrow = [
+        SimParticipant(m(0)), SimParticipant(m(1)), SimParticipant(m(2)),
+    ]
+    got, _ = quorum_compute_sim(
+        parts_regrow, prev_quorum=shrunk, min_replicas=1, join_timeout_ms=60000
+    )
+    assert [x.replica_id for x in got] == ["rep0", "rep1", "rep2"]

@@ -20,6 +20,14 @@ _BUILD_DIR = _NATIVE_DIR / "build"
 
 _lib = None
 _lib_lock = threading.Lock()
+_has_sim_hooks = False
+
+
+def has_sim_hooks() -> bool:
+    """True when the loaded libtpuft.so exports the pure-function quorum
+    test hooks (tpuft_quorum_compute / tpuft_compute_quorum_results)."""
+    load()
+    return _has_sim_hooks
 
 
 def _candidate_paths() -> list[Path]:
@@ -114,6 +122,34 @@ def load() -> ctypes.CDLL:
         lib.tpuft_store_shutdown.argtypes = [ctypes.c_void_p]
         lib.tpuft_store_free.argtypes = [ctypes.c_void_p]
 
+        # Pure-function test hooks (serialized protos in/out). Guarded: a
+        # stale libtpuft.so from before these symbols existed must not take
+        # down the production plane (servers/collectives) — only the sim
+        # functions, which check `has_sim_hooks` and raise a clear error.
+        try:
+            lib.tpuft_quorum_compute.restype = ctypes.c_int
+            lib.tpuft_quorum_compute.argtypes = [
+                ctypes.c_char_p,
+                ctypes.c_int,
+                ctypes.c_char_p,
+                ctypes.c_int,
+            ]
+            lib.tpuft_compute_quorum_results.restype = ctypes.c_int
+            lib.tpuft_compute_quorum_results.argtypes = [
+                ctypes.c_char_p,
+                ctypes.c_int64,
+                ctypes.c_char_p,
+                ctypes.c_int,
+                ctypes.c_int,
+                ctypes.c_char_p,
+                ctypes.c_int,
+            ]
+            has_sim_hooks = True
+        except AttributeError:
+            has_sim_hooks = False
+
+        global _has_sim_hooks
+        _has_sim_hooks = has_sim_hooks
         _lib = lib
         return _lib
 

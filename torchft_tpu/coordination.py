@@ -214,6 +214,34 @@ class QuorumResult:
     commit_failures: int = 0
     quorum: Optional[Quorum] = None
 
+    @classmethod
+    def _from_proto(cls, resp: tpuft_pb2.ManagerQuorumResponse) -> "QuorumResult":
+        return cls(
+            quorum_id=resp.quorum_id,
+            replica_rank=resp.replica_rank,
+            replica_world_size=resp.replica_world_size,
+            recover_src_manager_address=(
+                resp.recover_src_manager_address
+                if resp.HasField("recover_src_manager_address")
+                else ""
+            ),
+            recover_src_replica_rank=(
+                resp.recover_src_replica_rank
+                if resp.HasField("recover_src_replica_rank")
+                else None
+            ),
+            recover_dst_replica_ranks=list(resp.recover_dst_replica_ranks),
+            store_address=resp.store_address,
+            max_step=resp.max_step,
+            max_rank=(
+                resp.max_replica_rank if resp.HasField("max_replica_rank") else None
+            ),
+            max_world_size=resp.max_world_size,
+            heal=resp.heal,
+            commit_failures=resp.commit_failures,
+            quorum=Quorum._from_proto(resp.quorum) if resp.HasField("quorum") else None,
+        )
+
 
 # ---------------------------------------------------------------------------
 # Servers (native, via ctypes)
@@ -395,27 +423,7 @@ class ManagerClient:
         body = self._client.call(MANAGER_QUORUM, req.SerializeToString(), timeout + 5.0)
         resp = tpuft_pb2.ManagerQuorumResponse()
         resp.ParseFromString(body)
-        return QuorumResult(
-            quorum_id=resp.quorum_id,
-            replica_rank=resp.replica_rank,
-            replica_world_size=resp.replica_world_size,
-            recover_src_manager_address=resp.recover_src_manager_address,
-            recover_src_replica_rank=(
-                resp.recover_src_replica_rank
-                if resp.HasField("recover_src_replica_rank")
-                else None
-            ),
-            recover_dst_replica_ranks=list(resp.recover_dst_replica_ranks),
-            store_address=resp.store_address,
-            max_step=resp.max_step,
-            max_rank=(
-                resp.max_replica_rank if resp.HasField("max_replica_rank") else None
-            ),
-            max_world_size=resp.max_world_size,
-            heal=resp.heal,
-            commit_failures=resp.commit_failures,
-            quorum=Quorum._from_proto(resp.quorum),
-        )
+        return QuorumResult._from_proto(resp)
 
     def _checkpoint_metadata(self, rank: int, timeout: float) -> str:
         req = tpuft_pb2.CheckpointMetadataRequest(
@@ -446,3 +454,95 @@ class ManagerClient:
 
     def close(self) -> None:
         self._client.close()
+
+
+# ---------------------------------------------------------------------------
+# Pure-function test hooks (differential testing of the native quorum logic)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class SimParticipant:
+    """One replica's standing for :func:`quorum_compute_sim`: ``member`` plus
+    how long before "now" it joined (requested quorum) and last heartbeat.
+    ``heartbeat_only`` models a replica that heartbeats without having
+    requested quorum (it counts toward the split-brain denominator and the
+    join-timeout wait, like the reference's heartbeats map)."""
+
+    member: QuorumMember
+    joined_age_ms: int = 0
+    heartbeat_age_ms: int = 0
+    heartbeat_only: bool = False
+
+
+def quorum_compute_sim(
+    participants: List[SimParticipant],
+    prev_quorum: Optional[Quorum] = None,
+    min_replicas: int = 1,
+    join_timeout_ms: int = 60000,
+    heartbeat_timeout_ms: int = 5000,
+) -> tuple[Optional[List[QuorumMember]], str]:
+    """Drives the native ``quorum_compute`` (native/src/quorum.cc, contract of
+    reference lighthouse.rs:141-269) as a pure function. Returns
+    ``(members or None, reason)``."""
+    req = tpuft_pb2.QuorumSimRequest(
+        min_replicas=min_replicas,
+        join_timeout_ms=join_timeout_ms,
+        heartbeat_timeout_ms=heartbeat_timeout_ms,
+    )
+    for p in participants:
+        sim = req.participants.add()
+        sim.member.CopyFrom(p.member._to_proto())
+        sim.joined_age_ms = p.joined_age_ms
+        sim.heartbeat_age_ms = p.heartbeat_age_ms
+        sim.heartbeat_only = p.heartbeat_only
+    if prev_quorum is not None:
+        req.prev_quorum.quorum_id = prev_quorum.quorum_id
+        for m in prev_quorum.participants:
+            req.prev_quorum.participants.add().CopyFrom(m._to_proto())
+
+    lib = _native.load()
+    if not _native.has_sim_hooks():
+        raise RuntimeError(
+            "libtpuft.so is stale (no quorum sim hooks) — rebuild native/build"
+        )
+    payload = req.SerializeToString()
+    out = ctypes.create_string_buffer(max(len(payload) * 2, 1 << 16))
+    n = lib.tpuft_quorum_compute(payload, len(payload), out, len(out))
+    if n < 0:
+        raise RuntimeError(_native.last_error())
+    resp = tpuft_pb2.QuorumSimResponse()
+    resp.ParseFromString(out.raw[:n])
+    if not resp.has_quorum:
+        return None, resp.reason
+    return [QuorumMember._from_proto(m) for m in resp.participants], resp.reason
+
+
+def compute_quorum_results_sim(
+    replica_id: str,
+    group_rank: int,
+    quorum: Quorum,
+    init_sync: bool = True,
+) -> QuorumResult:
+    """Drives the native ``compute_quorum_results`` (native/src/quorum.cc,
+    contract of reference manager.rs:489-624) as a pure function. Raises
+    ``RuntimeError`` when the replica is not in the quorum."""
+    q = tpuft_pb2.Quorum(quorum_id=quorum.quorum_id)
+    for m in quorum.participants:
+        q.participants.add().CopyFrom(m._to_proto())
+    lib = _native.load()
+    if not _native.has_sim_hooks():
+        raise RuntimeError(
+            "libtpuft.so is stale (no quorum sim hooks) — rebuild native/build"
+        )
+    payload = q.SerializeToString()
+    out = ctypes.create_string_buffer(max(len(payload) * 2, 1 << 16))
+    n = lib.tpuft_compute_quorum_results(
+        replica_id.encode(), group_rank, payload, len(payload),
+        1 if init_sync else 0, out, len(out),
+    )
+    if n < 0:
+        raise RuntimeError(_native.last_error())
+    resp = tpuft_pb2.ManagerQuorumResponse()
+    resp.ParseFromString(out.raw[:n])
+    return QuorumResult._from_proto(resp)
