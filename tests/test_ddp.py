@@ -109,3 +109,107 @@ def test_optimizer_speculative_update_adopted_without_heal() -> None:
     np.testing.assert_allclose(
         np.asarray(opt.params["w"]), np.array([0.9, 0.8], np.float32), rtol=1e-6
     )
+
+
+def _plain_trajectory(loss_fn, tx, params, batches):
+    """Identically-structured fused plain program, for bitwise comparison."""
+    @jax.jit
+    def fused(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return loss, optax.apply_updates(params, updates), opt_state
+
+    opt_state = tx.init(params)
+    losses = []
+    for batch in batches:
+        loss, params, opt_state = fused(params, opt_state, batch)
+        losses.append(float(loss))
+    return params, losses
+
+
+def test_make_step_fn_lone_replica_runs_fused_and_matches_plain(monkeypatch):
+    """A lone replica's step must never touch the wire path and must produce
+    the exact plain-JAX trajectory (same fused program shape)."""
+    import torchft_tpu.ddp as ddp_mod
+
+    def _boom(*a, **k):
+        raise AssertionError("wire path used on the lone-replica fused step")
+
+    monkeypatch.setattr(ddp_mod, "ft_allreduce_gradients", _boom)
+
+    manager = scripted_manager()
+    tx = optax.sgd(0.2, momentum=0.9)
+    params = {"w": jnp.array([1.0, -2.0, 3.0], jnp.float32)}
+
+    def loss_fn(p, batch):
+        return jnp.sum((p["w"] - batch) ** 2)
+
+    opt = Optimizer(manager, tx, params)
+    quorum_waits = []
+    step_fn = opt.make_step_fn(loss_fn, on_quorum=quorum_waits.append)
+    batches = [jnp.full((3,), 0.1 * i, jnp.float32) for i in range(5)]
+    losses = []
+    for batch in batches:
+        loss, committed = step_fn(batch)
+        assert committed
+        losses.append(float(loss))
+    assert manager.is_lone_replica()
+    want_params, want_losses = _plain_trajectory(loss_fn, tx, params, batches)
+    np.testing.assert_array_equal(
+        np.asarray(opt.params["w"]), np.asarray(want_params["w"])
+    )
+    assert losses == want_losses
+    assert len(quorum_waits) == 5 and all(t >= 0 for t in quorum_waits)
+
+
+def test_make_step_fn_heal_applies_preheal_grads_to_healed_state():
+    """Heal during the barrier: semantics must match Optimizer.step (and the
+    reference's load_state_dict + optimizer.step() order) — the gradients
+    computed on the PRE-heal params apply to the HEALED state. The loss has
+    a params-dependent gradient so the two possible semantics (pre-heal
+    grads vs grads recomputed on healed params) give different answers."""
+    manager = scripted_manager()
+    tx = optax.sgd(0.1)
+    params = {"w": jnp.array([1.0, 1.0], jnp.float32)}
+    opt = Optimizer(manager, tx, params)
+
+    def loss_fn(p, batch):
+        return jnp.sum((p["w"] - batch) ** 2)  # grad = 2(w - batch)
+
+    healed = {"w": jnp.array([10.0, 10.0], jnp.float32)}
+    real_should_commit = manager.should_commit
+
+    def healing_should_commit(timeout=None):
+        ok = real_should_commit(timeout=timeout)
+        opt._load_state_dict({"params": healed, "opt_state": opt.opt_state})
+        return ok
+
+    manager.should_commit = healing_should_commit
+    step_fn = opt.make_step_fn(loss_fn)
+    _, committed = step_fn(jnp.array([1.0, 2.0], jnp.float32))
+    assert committed
+    # Pre-heal grads: 2*(1-1)=0, 2*(1-2)=-2; applied to healed [10, 10]:
+    # 10 - 0.1*0 = 10.0, 10 - 0.1*(-2) = 10.2. (Grads recomputed on the
+    # healed params would give [8.2, 8.4] — the wrong semantics.)
+    np.testing.assert_allclose(
+        np.asarray(opt.params["w"]), np.array([10.0, 10.2], np.float32), rtol=1e-6
+    )
+
+
+def test_make_step_fn_uses_wire_path_when_not_lone():
+    manager = scripted_manager()
+    manager.is_lone_replica = lambda: False  # other groups participating
+    tx = optax.sgd(0.1)
+    params = {"w": jnp.array([1.0, 1.0], jnp.float32)}
+    opt = Optimizer(manager, tx, params)
+
+    def loss_fn(p, batch):
+        return jnp.sum(p["w"] * batch)
+
+    step_fn = opt.make_step_fn(loss_fn)
+    _, committed = step_fn(jnp.array([1.0, 2.0], jnp.float32))
+    assert committed
+    # Dummy PG loopback: averaged grad == local grad == batch.
+    np.testing.assert_allclose(
+        np.asarray(opt.params["w"]), np.array([0.9, 0.8], np.float32), rtol=1e-6
+    )

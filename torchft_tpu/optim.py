@@ -195,27 +195,120 @@ class Optimizer:
         # reference manager.py:816-827).
         grads = jax.block_until_ready(grads)
         heal_count = self._heal_count
-        spec_params, spec_opt_state = self._jit_update(
-            grads, self.opt_state, self.params
+        spec = self._jit_update(grads, self.opt_state, self.params)
+        return self._commit_and_adopt(
+            heal_count,
+            spec,
+            lambda: self._jit_update(grads, self.opt_state, self.params),
+            timeout,
         )
-        # NOTE: should_commit may invoke _load_state_dict (healing); use
-        # self.params/opt_state only after it returns.
+
+    def _commit_and_adopt(
+        self, heal_count: int, speculation: Any, recompute: Any, timeout: Optional[float]
+    ) -> bool:
+        """The shared barrier protocol: vote/commit, then adopt the
+        speculatively computed ``(params, opt_state)`` — unless the barrier
+        healed this replica (state replaced mid-call), in which case
+        ``recompute()`` re-derives the update against the healed state.
+
+        NOTE: should_commit may invoke _load_state_dict (healing); read
+        self.params/opt_state only after it returns. The mutation is
+        write-locked so a concurrent checkpoint capture (donor staging on
+        the quorum thread) never reads a torn params/opt pair."""
         if not self.manager.should_commit(timeout=timeout):
             return False
-        # Write-lock the mutation so a concurrent checkpoint capture (donor
-        # staging on the quorum thread) never reads a torn params/opt pair.
         self.manager.disallow_state_dict_read()
         try:
             if self._heal_count != heal_count:
-                # Healed during the barrier: recompute on the new state.
-                self.params, self.opt_state = self._jit_update(
-                    grads, self.opt_state, self.params
-                )
+                self.params, self.opt_state = recompute()
             else:
-                self.params, self.opt_state = spec_params, spec_opt_state
+                self.params, self.opt_state = speculation
         finally:
             self.manager.allow_state_dict_read()
         return True
+
+
+    def make_step_fn(
+        self,
+        loss_fn: Any,
+        should_quantize: bool = False,
+        on_quorum: Any = None,
+    ):
+        """Builds the fastest correct FT-DDP step for the current quorum:
+        ``step_fn(*batch) -> (loss, committed)``.
+
+        With other replica groups participating, the step is the standard
+        split program — fused loss+grad dispatch, pipelined bucket gradient
+        sync (:func:`~torchft_tpu.ddp.ft_allreduce_gradients`), speculative
+        update under the commit barrier.
+
+        For a **lone replica** (sole participant and a wire group of one —
+        the identity-skip condition, see ``Manager.is_lone_replica``) the
+        averaged gradient IS the local gradient, so nothing needs to leave
+        the device: the whole loss+grad+update runs as ONE jitted XLA
+        program, exactly like a plain non-FT train step. The update is
+        adopted only if the commit barrier succeeds (and recomputed if the
+        barrier healed this replica), so semantics match :meth:`step` — the
+        fusion removes the last fixed cost the split program pays (the
+        standalone optimizer dispatch), making single-group FT-DDP
+        bitwise-plain compute with only the quorum + commit RPCs on top
+        (the reference's 'FT for free' design point, lighthouse.rs:202-215).
+
+        ``loss_fn(params, *batch) -> scalar``; ``on_quorum(seconds)``, when
+        given, receives each step's measured quorum wait (telemetry hook).
+        """
+        from torchft_tpu.ddp import ft_allreduce_gradients
+
+        def _fused(params, opt_state, *batch):
+            loss, grads = jax.value_and_grad(loss_fn)(params, *batch)
+            updates, new_state = self.tx.update(grads, opt_state, params)
+            import optax
+
+            return loss, optax.apply_updates(params, updates), new_state
+
+        fused = jax.jit(_fused)
+        grad_fn = jax.jit(jax.value_and_grad(loss_fn))
+
+        def step_fn(*batch):
+            self.begin_step()
+            if on_quorum is not None:
+                import time as _time
+
+                t0 = _time.monotonic()
+                self.manager.wait_quorum()
+                on_quorum(_time.monotonic() - t0)
+            else:
+                self.manager.wait_quorum()
+            if self.manager.errored() is None and self.manager.is_lone_replica():
+                heal_count = self._heal_count
+                # Heals rebind self.params (never mutate buffers), so this
+                # reference keeps the pre-heal state alive for the rare
+                # heal-during-barrier recompute below.
+                pre_params = self.params
+                loss, spec_params, spec_opt_state = fused(
+                    self.params, self.opt_state, *batch
+                )
+                jax.block_until_ready(loss)
+
+                def recompute():
+                    # Same semantics as :meth:`step` (and the reference's
+                    # load_state_dict + optimizer.step() sequence): the
+                    # gradients computed on the PRE-heal params apply to the
+                    # healed state.
+                    _, grads = grad_fn(pre_params, *batch)
+                    return self._jit_update(grads, self.opt_state, self.params)
+
+                committed = self._commit_and_adopt(
+                    heal_count, (spec_params, spec_opt_state), recompute, None
+                )
+                return loss, committed
+            loss, grads = grad_fn(self.params, *batch)
+            committed = self.step(
+                ft_allreduce_gradients(self.manager, grads, should_quantize)
+            )
+            return loss, committed
+
+        return step_fn
 
 
 # Name parity with the reference export.
