@@ -58,6 +58,9 @@ def train(args: argparse.Namespace) -> None:
         max_seq_len=args.seq_len,
         dtype=jnp.float32,
         attention_impl="auto",  # ring attention under the sp mesh below
+        # --ring-flash: per-hop block compute as the fused Pallas kernel
+        # (compiled on TPU, interpret elsewhere).
+        ring_use_flash=args.ring_flash,
     )
     model = Llama(config)
     mesh = Mesh(np.array(jax.devices()[: args.sp]), ("sp",))
@@ -167,6 +170,7 @@ def demo(args: argparse.Namespace) -> None:
                 "--batch-size", str(args.batch_size),
                 "--timeout", str(args.timeout),
                 "--quorum-timeout", str(args.quorum_timeout),
+                *(["--ring-flash"] if args.ring_flash else []),
             ],
             env=env,
         )
@@ -199,6 +203,10 @@ def main() -> None:
     parser.add_argument("--batch-size", type=int, default=2)
     parser.add_argument("--seq-len", type=int, default=512)
     parser.add_argument("--sp", type=int, default=4, help="sequence-parallel degree")
+    parser.add_argument(
+        "--ring-flash", action="store_true",
+        help="fused Pallas kernel for the per-hop ring block compute",
+    )
     parser.add_argument("--timeout", type=float, default=30.0)
     parser.add_argument("--quorum-timeout", type=float, default=60.0)
     parser.add_argument("--demo", action="store_true")

@@ -110,3 +110,126 @@ def test_llama_flash_impl_trains():
         np.all(np.isfinite(np.asarray(g)))
         for g in jax.tree_util.tree_leaves(grads)
     )
+
+
+# ---------------------------------------------------------------------------
+# Ring attention with the fused per-hop kernel (interpret mode, CPU mesh)
+# ---------------------------------------------------------------------------
+
+
+def _sp_mesh(sp):
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(jax.devices()[:sp]), ("sp",))
+
+
+def test_ring_flash_forward_matches_scan_and_dense():
+    from torchft_tpu.ops.ring_attention import ring_attention_sharded
+
+    b, sp, h, kv, d = 2, 4, 4, 2, 16
+    s = 32 * sp
+    q, k, v = _qkv(b, s, h, kv, d, seed=5)
+    mesh = _sp_mesh(sp)
+    flash = ring_attention_sharded(q, k, v, mesh, use_flash=True)
+    scan = ring_attention_sharded(q, k, v, mesh, use_flash=False)
+    dense = causal_attention(q, k, v, scale=d**-0.5)
+    np.testing.assert_allclose(np.asarray(flash), np.asarray(dense), atol=3e-5)
+    np.testing.assert_allclose(np.asarray(flash), np.asarray(scan), atol=3e-5)
+
+
+def test_ring_flash_zigzag_matches_dense():
+    from torchft_tpu.ops.ring_attention import ring_attention_zigzag
+
+    b, sp, h, kv, d = 1, 4, 4, 2, 16
+    s = 8 * 2 * sp  # zigzag needs s % (2*sp) == 0
+    q, k, v = _qkv(b, s, h, kv, d, seed=6)
+    mesh = _sp_mesh(sp)
+    out = ring_attention_zigzag(q, k, v, mesh, use_flash=True)
+    dense = causal_attention(q, k, v, scale=d**-0.5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(dense), atol=3e-5)
+
+
+def test_ring_flash_gradients_match_dense():
+    from torchft_tpu.ops.ring_attention import ring_attention_sharded
+
+    b, sp, h, kv, d = 1, 4, 4, 2, 16
+    s = 16 * sp
+    q, k, v = _qkv(b, s, h, kv, d, seed=7)
+    w = jax.random.normal(jax.random.PRNGKey(11), (b, s, h, d), jnp.float32)
+    mesh = _sp_mesh(sp)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(ring_attention_sharded(q, k, v, mesh, use_flash=True) * w)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(causal_attention(q, k, v, scale=d**-0.5) * w)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for gf, gd, name in zip(g_flash, g_dense, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(gf), np.asarray(gd), atol=1e-4, err_msg=f"d{name}"
+        )
+
+
+def test_llama_ring_flash_under_sp_mesh_matches_dense():
+    """attention_impl='ring' + ring_use_flash routes per-hop compute through
+    the fused kernel; logits must match the dense single-device result."""
+    from jax import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from torchft_tpu.models.llama import Llama, LlamaConfig
+
+    cfg = LlamaConfig(
+        vocab_size=128, dim=32, n_layers=1, n_heads=4, n_kv_heads=2,
+        ffn_hidden=64, max_seq_len=64, dtype=jnp.float32,
+        attention_impl="ring", ring_use_flash=True,
+    )
+    model = Llama(cfg)
+    dense_model = Llama(
+        LlamaConfig(**{**cfg.__dict__, "attention_impl": "dense"})
+    )
+    tokens = (jnp.arange(64, dtype=jnp.int32) % cfg.vocab_size).reshape(1, 64)
+    # init through the dense twin: explicit 'ring' requires an sp axis,
+    # which only exists inside the shard_map below.
+    params = dense_model.init(jax.random.PRNGKey(0), tokens)
+    dense_logits = dense_model.apply(params, tokens)
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("sp",))
+    positions = jnp.broadcast_to(jnp.arange(64), (1, 64))
+    sharded_fwd = shard_map(
+        lambda p, t, pos: model.apply(p, t, pos),
+        mesh=mesh,
+        in_specs=(P(), P(None, "sp"), P(None, "sp")),
+        out_specs=P(None, "sp"),
+    )
+    with mesh:
+        ring_logits = sharded_fwd(params, tokens, positions)
+    np.testing.assert_allclose(
+        np.asarray(ring_logits), np.asarray(dense_logits), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_ring_flash_zigzag_gradients_match_dense():
+    """The positions-aware ring backward under the permuted (zigzag)
+    layout: gradients must match dense exactly like the forward does."""
+    from torchft_tpu.ops.ring_attention import ring_attention_zigzag
+
+    b, sp, h, kv, d = 1, 4, 4, 2, 16
+    s = 8 * 2 * sp
+    q, k, v = _qkv(b, s, h, kv, d, seed=8)
+    w = jax.random.normal(jax.random.PRNGKey(12), (b, s, h, d), jnp.float32)
+    mesh = _sp_mesh(sp)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(ring_attention_zigzag(q, k, v, mesh, use_flash=True) * w)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(causal_attention(q, k, v, scale=d**-0.5) * w)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for gf, gd, name in zip(g_flash, g_dense, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(gf), np.asarray(gd), atol=1e-4, err_msg=f"d{name}"
+        )

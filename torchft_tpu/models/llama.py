@@ -61,6 +61,9 @@ class LlamaConfig:
     attention_impl: str = "auto"
     sp_axis: str = "sp"
     attention_block_size: int = 512
+    # Route the ring path's per-hop block compute through the fused Pallas
+    # kernel (ops/flash_attention.py) instead of the jnp scan update.
+    ring_use_flash: bool = False
     # auto picks blockwise over dense at/after this sequence length.
     blockwise_min_seq: int = 2048
 
@@ -202,9 +205,13 @@ class Attention(nn.Module):
             cfg.attention_impl == "auto" and _sp_axis_in_mesh(cfg.sp_axis)
         )
         if use_ring:
-            from torchft_tpu.ops.ring_attention import ring_attention
+            from torchft_tpu.ops.ring_attention import (
+                ring_attention,
+                ring_attention_flash,
+            )
 
-            out = ring_attention(q, k, v, axis_name=cfg.sp_axis, scale=scale)
+            ring = ring_attention_flash if cfg.ring_use_flash else ring_attention
+            out = ring(q, k, v, axis_name=cfg.sp_axis, scale=scale)
         elif cfg.attention_impl == "flash":
             from torchft_tpu.ops.flash_attention import flash_attention
 

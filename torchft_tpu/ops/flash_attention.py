@@ -36,15 +36,33 @@ import jax.numpy as jnp
 
 from torchft_tpu.ops.ring_attention import _blockwise_core_bwd
 
-__all__ = ["flash_attention"]
+__all__ = [
+    "flash_attention",
+    "flash_attention_partial",
+    "merge_attention_partials",
+]
 
 _NEG_INF = -1e30
+_PAD_POS = 2**31 - 1  # position for padded rows: beyond every real query
+
+
+def _out_struct(shape, dtype, inputs):
+    """ShapeDtypeStruct carrying the union of the inputs' varying-mesh-axes
+    (vma): under shard_map(check_vma=True) pallas_call outputs must declare
+    how they vary over manual axes; outside shard_map the union is empty."""
+    try:
+        vma = frozenset().union(*(jax.typeof(x).vma for x in inputs))
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    except (AttributeError, TypeError):
+        return jax.ShapeDtypeStruct(shape, dtype)
 
 
 def _fwd_kernel(
     q_ref,
     k_ref,
     v_ref,
+    qp_ref,
+    kp_ref,
     o_ref,
     lse_ref,
     acc_ref,
@@ -52,21 +70,20 @@ def _fwd_kernel(
     l_ref,
     *,
     scale: float,
-    block_q: int,
-    block_k: int,
     nk: int,
 ):
     """One (batch, head, q-block, kv-block) grid step.
 
-    Refs: q (block_q, d); k/v (block_k, d); o (block_q, d);
-    lse (block_q, 1) — scalars-per-row ride as a column, rank-1 tiled
-    outputs fail Mosaic lowering (see ops/quantization.py). Scratch
-    acc (block_q, d) f32, m/l (block_q, 1) f32 persist across the kv grid
-    axis (innermost, sequential on TPU).
+    Refs: q (block_q, d); k/v (block_k, d); positions qp (block_q, 1) and
+    kp (1, block_k) int32 — explicit arrays, not iota, so permuted layouts
+    (ring/zigzag shards) mask correctly; o (block_q, d); lse (block_q, 1) —
+    scalars-per-row ride as a column, rank-1 tiled outputs fail Mosaic
+    lowering (see ops/quantization.py). Scratch acc (block_q, d) f32,
+    m/l (block_q, 1) f32 persist across the kv grid axis (innermost,
+    sequential on TPU).
     """
     from jax.experimental import pallas as pl
 
-    iq = pl.program_id(2)
     ik = pl.program_id(3)
 
     @pl.when(ik == 0)
@@ -75,10 +92,13 @@ def _fwd_kernel(
         m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
         l_ref[...] = jnp.zeros_like(l_ref)
 
-    # Causal skip: a KV block whose first position is beyond this q block's
-    # last position is fully masked — skip both matmuls (the grid still
-    # visits the step, but the MXU does nothing).
-    @pl.when(ik * block_k <= iq * block_q + block_q - 1)
+    q_pos = qp_ref[...]  # (block_q, 1)
+    k_pos = kp_ref[...]  # (1, block_k)
+
+    # Causal skip: a KV block whose earliest position is beyond this q
+    # block's last position is fully masked — skip both matmuls (the grid
+    # still visits the step, but the MXU does nothing).
+    @pl.when(jnp.min(k_pos) <= jnp.max(q_pos))
     def _update():
         q = q_ref[...]
         k = k_ref[...]
@@ -89,12 +109,6 @@ def _fwd_kernel(
             )
             * scale
         )  # (block_q, block_k) f32
-        q_pos = iq * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0
-        )
-        k_pos = ik * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1
-        )
         scores = jnp.where(q_pos >= k_pos, scores, _NEG_INF)
 
         m_prev = m_ref[...]  # (block_q, 1)
@@ -113,34 +127,59 @@ def _fwd_kernel(
 
     @pl.when(ik == nk - 1)
     def _finalize():
+        # Rows whose running max never left the sentinel saw only masked
+        # scores: their p = exp(score - m) degenerated to 1 (the classic
+        # all-masked-row trap), so acc holds sum-of-V garbage — zero them
+        # and pin lse to the sentinel so partial merges weight them out.
+        empty = m_ref[...] <= _NEG_INF
         l = jnp.maximum(l_ref[...], 1e-30)
-        o_ref[...] = (acc_ref[...] / l).astype(o_ref.dtype)
-        lse_ref[...] = m_ref[...] + jnp.log(l)
+        o_ref[...] = jnp.where(empty, 0.0, acc_ref[...] / l).astype(o_ref.dtype)
+        lse_ref[...] = jnp.where(empty, _NEG_INF, m_ref[...] + jnp.log(l))
 
 
-def _flash_fwd(q, k, v, scale, block_q, block_k, interpret):
+def _flash_fwd(
+    q, k, v, scale, block_q, block_k, interpret,
+    q_positions=None, k_positions=None,
+):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    b, s, h, d = q.shape
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
     kv_heads = k.shape[2]
     group = h // kv_heads
 
-    pad_q = (-s) % block_q
-    pad_k = (-s) % block_k
+    if q_positions is None:
+        q_positions = jnp.broadcast_to(jnp.arange(sq, dtype=jnp.int32), (b, sq))
+    if k_positions is None:
+        k_positions = jnp.broadcast_to(jnp.arange(sk, dtype=jnp.int32), (b, sk))
+
+    pad_q = (-sq) % block_q
+    pad_k = (-sk) % block_k
+    # Padded positions are INT32_MAX: beyond every real query, so the
+    # causal mask excludes padded KV rows for real queries; padded q rows
+    # are sliced off below.
     if pad_q:
         q = jnp.pad(q, ((0, 0), (0, pad_q), (0, 0), (0, 0)))
+        # Edge-pad (repeat the last real position), NOT _PAD_POS: padded q
+        # rows are sliced off below so their mask content is irrelevant,
+        # but an INT32_MAX in the block would defeat the kernel's causal
+        # skip (max(q_pos) would dominate every KV block's min).
+        q_positions = jnp.pad(q_positions, ((0, 0), (0, pad_q)), mode="edge")
     if pad_k:
-        # Padded KV positions sit beyond every real query, so the causal
-        # mask excludes them; padded q rows are sliced off below.
         k = jnp.pad(k, ((0, 0), (0, pad_k), (0, 0), (0, 0)))
         v = jnp.pad(v, ((0, 0), (0, pad_k), (0, 0), (0, 0)))
-    nq = (s + pad_q) // block_q
-    nk = (s + pad_k) // block_k
+        k_positions = jnp.pad(
+            k_positions, ((0, 0), (0, pad_k)), constant_values=_PAD_POS
+        )
+    nq = (sq + pad_q) // block_q
+    nk = (sk + pad_k) // block_k
+    # Positions ride as 3-D so each block is a 2-D tile (a column for q, a
+    # row for k — so the in-kernel compare broadcasts without a transpose).
+    qp = q_positions.astype(jnp.int32).reshape(b, sq + pad_q, 1)
+    kp = k_positions.astype(jnp.int32).reshape(b, 1, sk + pad_k)
 
-    kernel = partial(
-        _fwd_kernel, scale=scale, block_q=block_q, block_k=block_k, nk=nk
-    )
+    kernel = partial(_fwd_kernel, scale=scale, nk=nk)
     out, lse = pl.pallas_call(
         kernel,
         grid=(b, h, nq, nk),
@@ -156,6 +195,12 @@ def _flash_fwd(q, k, v, scale, block_q, block_k, interpret):
                 (None, block_k, None, d),
                 lambda ib, ih, iq, ik: (ib, ik, ih // group, 0),
             ),
+            pl.BlockSpec(
+                (None, block_q, 1), lambda ib, ih, iq, ik: (ib, iq, 0)
+            ),
+            pl.BlockSpec(
+                (None, 1, block_k), lambda ib, ih, iq, ik: (ib, 0, ik)
+            ),
         ],
         out_specs=[
             pl.BlockSpec(
@@ -166,8 +211,8 @@ def _flash_fwd(q, k, v, scale, block_q, block_k, interpret):
             ),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, s + pad_q, h, d), q.dtype),
-            jax.ShapeDtypeStruct((b, s + pad_q, h, 1), jnp.float32),
+            _out_struct((b, sq + pad_q, h, d), q.dtype, (q, k, v, qp, kp)),
+            _out_struct((b, sq + pad_q, h, 1), jnp.float32, (q, k, v, qp, kp)),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
@@ -175,13 +220,13 @@ def _flash_fwd(q, k, v, scale, block_q, block_k, interpret):
             pltpu.VMEM((block_q, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v)
+    )(q, k, v, qp, kp)
     if pad_q:
-        out = out[:, :s]
-        lse = lse[:, :s]
-    # (b, s, h, 1) -> (b, s, kv, group): head h is kv-head h // group, the
+        out = out[:, :sq]
+        lse = lse[:, :sq]
+    # (b, sq, h, 1) -> (b, sq, kv, group): head h is kv-head h // group, the
     # same layout blockwise_attention's backward expects for its residual.
-    return out, lse[..., 0].reshape(b, s, kv_heads, group)
+    return out, lse[..., 0].reshape(b, sq, kv_heads, group)
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -202,6 +247,60 @@ def _flash_core_bwd(scale, block_q, block_k, interpret, residuals, d_out):
 
 
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+
+
+def flash_attention_partial(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    q_positions: jnp.ndarray,
+    k_positions: jnp.ndarray,
+    scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+):
+    """One causal-attention PARTIAL over an arbitrary KV block: the ring
+    attention building block. Masking uses the explicit global position
+    arrays (so zigzag/permuted shard layouts work), and the result is
+    returned with its logsumexp so partials from different KV shards merge
+    exactly (see :func:`merge_attention_partials`).
+
+    Shapes: q (b, sq, h, d); k/v (b, sk, kv_heads, d); positions (b, sq) /
+    (b, sk). Returns (out (b, sq, h, d) in q.dtype, lse (b, sq, h) f32;
+    fully-masked rows come back as out=0, lse≈-1e30). Forward-only — ring
+    callers define their own VJP (ops/ring_attention.py ties it to the
+    scan-based ring backward).
+    """
+    b, sq, h, d = q.shape
+    if scale is None:
+        scale = d**-0.5
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    block_q = min(_next_multiple(int(block_q), 16), _next_multiple(sq, 16))
+    block_k = min(_next_multiple(int(block_k), 16), _next_multiple(k.shape[1], 16))
+    out, lse = _flash_fwd(
+        q, k, v, float(scale), block_q, block_k, bool(interpret),
+        q_positions=q_positions, k_positions=k_positions,
+    )
+    return out, lse.reshape(b, sq, h)
+
+
+def merge_attention_partials(out_a, lse_a, out_b, lse_b):
+    """Combines two normalized attention partials of the same queries over
+    disjoint KV sets via their logsumexps (the flash/ring merge identity).
+    out: (..., d) f32; lse: (...,) f32 with -1e30 as the empty sentinel."""
+    m = jnp.maximum(lse_a, lse_b)
+    # Guard the both-empty case: exp(-1e30 - -1e30) = 1 would resurrect
+    # fully-masked rows with weight 1 each; keep them exactly empty.
+    both_empty = m <= _NEG_INF
+    wa = jnp.where(both_empty, 0.0, jnp.exp(lse_a - m))
+    wb = jnp.where(both_empty, 0.0, jnp.exp(lse_b - m))
+    l = wa + wb
+    safe_l = jnp.maximum(l, 1e-30)
+    out = (out_a * wa[..., None] + out_b * wb[..., None]) / safe_l[..., None]
+    lse = jnp.where(both_empty, _NEG_INF, m + jnp.log(safe_l))
+    return out, lse
 
 
 def flash_attention(
@@ -274,4 +373,38 @@ def verify_on_chip() -> dict:
     )
     if err > 0.05:  # bf16 tolerance
         raise AssertionError(f"on-chip flash attention mismatch: max err {err}")
-    return {"device": str(dev), "max_err": err, "ok": True}
+
+    # The partial surface (ring building block): explicit PERMUTED position
+    # arrays (the (1, block_k) row tile), sq != sk, ragged lengths, a
+    # fully-masked hop, and the logsumexp merge — everything the ring path
+    # lowers that the full-attention call above does not.
+    sq = 200  # ragged: pads to 208
+    pos = jax.random.permutation(jax.random.PRNGKey(3), s)[:sq]
+    qp = jnp.broadcast_to(pos.astype(jnp.int32), (b, sq))
+    kp_full = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+    qs = jax.random.normal(kq, (b, sq, h, d), jnp.bfloat16)
+    half = s // 2
+    o1, l1 = flash_attention_partial(
+        qs, k[:, :half], v[:, :half], qp, kp_full[:, :half], interpret=False
+    )
+    o2, l2 = flash_attention_partial(
+        qs, k[:, half:], v[:, half:], qp, kp_full[:, half:], interpret=False
+    )
+    merged, _ = merge_attention_partials(
+        o1.astype(jnp.float32), l1, o2.astype(jnp.float32), l2
+    )
+    # Reference: dense attention with the same permuted-position mask.
+    qg = qs.astype(jnp.float32).reshape(b, sq, kv, h // kv, d)
+    sc = jnp.einsum("bskgd,btkd->bskgt", qg, k.astype(jnp.float32)) * (d**-0.5)
+    mask = qp[:, :, None, None, None] >= kp_full[:, None, None, None, :]
+    sc = jnp.where(mask, sc, -1e30)
+    pr = jax.nn.softmax(sc, axis=-1)
+    ref_p = jnp.einsum("bskgt,btkd->bskgd", pr, v.astype(jnp.float32)).reshape(
+        b, sq, h, d
+    )
+    err_p = float(jnp.max(jnp.abs(merged - ref_p)))
+    if err_p > 0.05:
+        raise AssertionError(
+            f"on-chip flash PARTIAL/merge mismatch: max err {err_p}"
+        )
+    return {"device": str(dev), "max_err": err, "max_err_partial": err_p, "ok": True}

@@ -30,6 +30,7 @@ import jax.numpy as jnp
 __all__ = [
     "blockwise_attention",
     "ring_attention",
+    "ring_attention_flash",
     "ring_attention_sharded",
     "ring_attention_zigzag",
     "zigzag_permutation",
@@ -340,6 +341,170 @@ def ring_attention(
     return out.reshape(b, s_local, h, d).astype(q.dtype)
 
 
+def _ring_flash_fwd_impl(
+    q, k, v, q_pos, k_pos, axis_name, scale, block_q, block_k, interpret
+):
+    from torchft_tpu.ops.flash_attention import (
+        flash_attention_partial,
+        merge_attention_partials,
+    )
+
+    axis_size = jax.lax.psum(1, axis_name)
+    b, s_local, h, d = q.shape
+    out = jnp.zeros((b, s_local, h, d), jnp.float32)
+    lse = jnp.full((b, s_local, h), _NEG_INF, jnp.float32)
+    # Constant-initialized carries must be varying over the ring axis (see
+    # ring_attention above).
+    if hasattr(jax.lax, "pcast"):
+        out, lse = (
+            jax.lax.pcast(x, (axis_name,), to="varying") for x in (out, lse)
+        )
+
+    def ring_step(_, carry):
+        out, lse, k_blk, v_blk, kp = carry
+        # The fused kernel computes this hop's partial (normalized out +
+        # logsumexp); fully-masked hops come back as (0, sentinel) and the
+        # merge weights them out exactly. Block-granular causal skipping
+        # happens inside the kernel from the position arrays, so zigzag
+        # layouts balance without the sliced-accumulator machinery.
+        o_p, l_p = flash_attention_partial(
+            q, k_blk, v_blk, q_pos, kp,
+            scale=scale, block_q=block_q, block_k=block_k, interpret=interpret,
+        )
+        out, lse = merge_attention_partials(
+            out, lse, o_p.astype(jnp.float32), l_p
+        )
+        perm = [(r, (r + 1) % axis_size) for r in range(axis_size)]
+        return (
+            out,
+            lse,
+            jax.lax.ppermute(k_blk, axis_name, perm),
+            jax.lax.ppermute(v_blk, axis_name, perm),
+            jax.lax.ppermute(kp, axis_name, perm),
+        )
+
+    out, lse, *_ = jax.lax.fori_loop(
+        0, axis_size, ring_step, (out, lse, k, v, k_pos)
+    )
+    return out.astype(q.dtype), lse
+
+
+@_partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _ring_flash(q, k, v, q_pos, k_pos, axis_name, scale, block_q, block_k, interpret):
+    return _ring_flash_fwd_impl(
+        q, k, v, q_pos, k_pos, axis_name, scale, block_q, block_k, interpret
+    )[0]
+
+
+def _ring_flash_fwd(q, k, v, q_pos, k_pos, axis_name, scale, block_q, block_k, interpret):
+    out, lse = _ring_flash_fwd_impl(
+        q, k, v, q_pos, k_pos, axis_name, scale, block_q, block_k, interpret
+    )
+    return out, (q, k, v, q_pos, k_pos, out, lse)
+
+
+def _ring_flash_bwd(axis_name, scale, block_q, block_k, interpret, residuals, d_out):
+    """True ring backward from the saved (out, lse) residuals — the
+    flash-attention-2 identity with the GLOBAL logsumexp, so no forward
+    recompute is needed. dq accumulates locally while each KV block's
+    (dk, dv) partial sums ride the rotation with it: after axis_size hops
+    every block is home with contributions from every shard's queries.
+    (Ring cost: fwd rotates {k, v, pos}; bwd rotates {k, v, pos, dk, dv}.)
+    """
+    q, k, v, q_pos, k_pos, out, lse = residuals
+    axis_size = jax.lax.psum(1, axis_name)
+    b, s_local, h, d = q.shape
+    kv_heads = k.shape[2]
+    group = h // kv_heads
+
+    qg = q.reshape(b, s_local, kv_heads, group, d).astype(jnp.float32)
+    og = out.reshape(b, s_local, kv_heads, group, d).astype(jnp.float32)
+    dog = d_out.reshape(b, s_local, kv_heads, group, d).astype(jnp.float32)
+    lse_g = lse.reshape(b, s_local, kv_heads, group)
+    # delta_i = dO_i . O_i (flash-attention-2 backward identity).
+    delta = jnp.sum(dog * og, axis=-1)  # (b, s, kv, g)
+
+    # Fresh (unvarying) zeros, then mark varying over the ring axis — a
+    # zeros_like of the (already-varying) inputs would make the pcast a
+    # no-op-rejected varying->varying cast.
+    dq = jnp.zeros((b, s_local, kv_heads, group, d), jnp.float32)
+    dk0 = jnp.zeros((b, s_local, kv_heads, d), jnp.float32)
+    dv0 = jnp.zeros_like(dk0)
+    if hasattr(jax.lax, "pcast"):
+        dq, dk0, dv0 = (
+            jax.lax.pcast(x, (axis_name,), to="varying") for x in (dq, dk0, dv0)
+        )
+
+    def ring_step(_, carry):
+        dq, k_blk, v_blk, kp, dk_blk, dv_blk = carry
+        k32 = k_blk.astype(jnp.float32)
+        v32 = v_blk.astype(jnp.float32)
+        scores = jnp.einsum("bskgd,btkd->bskgt", qg, k32) * scale
+        mask = q_pos[:, :, None, None, None] >= kp[:, None, None, None, :]
+        # p rebuilt from the merged global logsumexp; masked entries are
+        # exactly 0 (fully-masked rows have the -1e30 sentinel, whose exp
+        # overflow is discarded by the where).
+        p = jnp.where(mask, jnp.exp(scores - lse_g[..., None]), 0.0)
+        dv_blk = dv_blk + jnp.einsum("bskgt,bskgd->btkd", p, dog)
+        dp = jnp.einsum("bskgd,btkd->bskgt", dog, v32)
+        ds = p * (dp - delta[..., None]) * scale
+        dq = dq + jnp.einsum("bskgt,btkd->bskgd", ds, k32)
+        dk_blk = dk_blk + jnp.einsum("bskgt,bskgd->btkd", ds, qg)
+        perm = [(r, (r + 1) % axis_size) for r in range(axis_size)]
+        rotate = lambda x: jax.lax.ppermute(x, axis_name, perm)
+        return dq, rotate(k_blk), rotate(v_blk), rotate(kp), rotate(dk_blk), rotate(dv_blk)
+
+    dq, _, _, _, dk, dv = jax.lax.fori_loop(
+        0, axis_size, ring_step, (dq, k, v, k_pos, dk0, dv0)
+    )
+    return (
+        dq.reshape(b, s_local, h, d).astype(q.dtype),
+        dk.astype(k.dtype),
+        dv.astype(v.dtype),
+        None,
+        None,
+    )
+
+
+_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
+def ring_attention_flash(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str,
+    scale: Optional[float] = None,
+    q_positions: Optional[jnp.ndarray] = None,
+    k_positions: Optional[jnp.ndarray] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """:func:`ring_attention` with the fused Pallas kernel as the per-hop
+    block compute (ops/flash_attention.py): K/V still rotate over
+    ``axis_name`` via ppermute, but each hop's online-softmax inner loop
+    runs as one kernel with VMEM-resident accumulators, and hops merge by
+    logsumexp. Same shapes/semantics as :func:`ring_attention`; gradients
+    flow through a custom VJP tied to the scan-based ring backward."""
+    axis_index = jax.lax.axis_index(axis_name)
+    b, s_local, h, d = q.shape
+    if scale is None:
+        scale = d**-0.5
+    if q_positions is None:
+        base = axis_index * s_local
+        q_positions = jnp.broadcast_to(base + jnp.arange(s_local), (b, s_local))
+    if k_positions is None:
+        k_positions = q_positions
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    return _ring_flash(
+        q, k, v,
+        q_positions.astype(jnp.int32), k_positions.astype(jnp.int32),
+        axis_name, float(scale), int(block_q), int(block_k), bool(interpret),
+    )
+
+
 def ring_attention_sharded(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -347,16 +512,19 @@ def ring_attention_sharded(
     mesh: jax.sharding.Mesh,
     axis_name: str = "sp",
     scale: Optional[float] = None,
+    use_flash: bool = False,
 ) -> jnp.ndarray:
     """Convenience wrapper: shard_map ring_attention over ``mesh`` with the
-    sequence dim split on ``axis_name`` (other dims replicated)."""
+    sequence dim split on ``axis_name`` (other dims replicated).
+    ``use_flash`` selects the fused Pallas per-hop kernel."""
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     spec = P(None, axis_name, None, None)
+    ring = ring_attention_flash if use_flash else ring_attention
 
     def inner(q_, k_, v_):
-        return ring_attention(q_, k_, v_, axis_name=axis_name, scale=scale)
+        return ring(q_, k_, v_, axis_name=axis_name, scale=scale)
 
     return shard_map(
         inner, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
@@ -399,10 +567,13 @@ def ring_attention_zigzag(
     mesh: jax.sharding.Mesh,
     axis_name: str = "sp",
     scale: Optional[float] = None,
+    use_flash: bool = False,
 ) -> jnp.ndarray:
     """Ring attention with the zigzag layout applied transparently: inputs
     and outputs are in natural sequence order; internally the sequence is
-    permuted so every ring step does balanced causal work."""
+    permuted so every ring step does balanced causal work. ``use_flash``
+    selects the fused Pallas per-hop kernel, whose in-kernel block-granular
+    causal skip replaces the scan path's kv_sub_blocks slicing."""
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
@@ -416,6 +587,11 @@ def ring_attention_zigzag(
     pos_spec = P(None, axis_name)
 
     def inner(q_, k_, v_, pos):
+        if use_flash:
+            return ring_attention_flash(
+                q_, k_, v_, axis_name=axis_name, scale=scale,
+                q_positions=pos, k_positions=pos,
+            )
         return ring_attention(
             q_, k_, v_, axis_name=axis_name, scale=scale,
             q_positions=pos, k_positions=pos, kv_sub_blocks=2,
