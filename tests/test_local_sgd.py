@@ -286,3 +286,38 @@ def test_diloco_fused_step_matches_grads_path() -> None:
         jax.tree_util.tree_leaves(algos[1].params),
     ):
         np.testing.assert_array_equal(np.asarray(leaf_a), np.asarray(leaf_b))
+
+
+def test_local_sgd_make_step_fn_fused_matches_plain() -> None:
+    """The fused inner step must reproduce the exact plain trajectory (one
+    jitted program per step) and sync/commit at the boundary."""
+    manager = scripted_manager()
+    tx = optax.sgd(0.2, momentum=0.9)
+    params = {"w": jnp.array([1.0, -2.0, 3.0], jnp.float32)}
+    algo = LocalSGD(manager, tx, params, sync_every=3)
+
+    def loss_fn(p, batch):
+        return jnp.sum((p["w"] - batch) ** 2)
+
+    step_fn = algo.make_step_fn(loss_fn)
+    batches = [jnp.full((3,), 0.1 * i, jnp.float32) for i in range(6)]
+    synced = []
+    for batch in batches:
+        _, s = step_fn(batch)
+        synced.append(s)
+    assert synced == [False, False, True, False, False, True]
+
+    # Identically-structured fused plain program (single participant:
+    # averaging is identity, so the trajectory must match bitwise).
+    @jax.jit
+    def fused(p, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(p, batch)
+        updates, opt_state = tx.update(grads, opt_state, p)
+        return loss, optax.apply_updates(p, updates), opt_state
+
+    expected, opt_state = params, tx.init(params)
+    for batch in batches:
+        _, expected, opt_state = fused(expected, opt_state, batch)
+    np.testing.assert_array_equal(
+        np.asarray(algo.params["w"]), np.asarray(expected["w"])
+    )

@@ -106,6 +106,33 @@ class LocalSGD:
         self._local_step = 0
         return self._sync()
 
+    def make_step_fn(self, loss_fn: Any):
+        """``step_fn(*batch) -> (loss, synced)``: the inner step as ONE
+        fused jitted dispatch (loss+grad+update — sync_every−1 of every
+        sync_every steps touch no network, so their cost is exactly the
+        plain train step), with the parameter-averaging sync at the
+        boundary. ``loss_fn(params, *batch) -> scalar``. Mirrors
+        ``DiLoCo.make_step_fn`` / ``Optimizer.make_step_fn``."""
+        from torchft_tpu.optim import make_jit_fused_step
+
+        fused = make_jit_fused_step(self._inner_tx, loss_fn)
+
+        def step_fn(*batch):
+            self._manager.disallow_state_dict_read()
+            try:
+                loss, self.params, self.opt_state = fused(
+                    self.params, self.opt_state, *batch
+                )
+            finally:
+                self._manager.allow_state_dict_read()
+            self._local_step += 1
+            if self._local_step < self._sync_every:
+                return loss, False
+            self._local_step = 0
+            return loss, self._sync()
+
+        return step_fn
+
     def _sync(self) -> bool:
         self._manager.start_quorum()
         leaves, treedef = jax.tree_util.tree_flatten(self.params)

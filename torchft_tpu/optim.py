@@ -33,7 +33,24 @@ import numpy as np
 
 from torchft_tpu.manager import Manager
 
-__all__ = ["Optimizer", "OptimizerWrapper", "make_jit_update"]
+__all__ = ["Optimizer", "OptimizerWrapper", "make_jit_update", "make_jit_fused_step"]
+
+
+def make_jit_fused_step(tx: Any, loss_fn: Any):
+    """ONE jitted program for a whole local train step:
+    ``(params, opt_state, *batch) -> (loss, new_params, new_opt_state)``.
+    ``loss_fn(params, *batch) -> scalar``. The fused form is the plain-JAX
+    train step; Optimizer (lone-replica path) and LocalSGD (inner steps)
+    share it — DiLoCo keeps its own leaves-layout variant
+    (local_sgd.py make_step_fn)."""
+    import optax
+
+    def _fused(params: Any, opt_state: Any, *batch: Any):
+        loss, grads = jax.value_and_grad(loss_fn)(params, *batch)
+        updates, new_state = tx.update(grads, opt_state, params)
+        return loss, optax.apply_updates(params, updates), new_state
+
+    return jax.jit(_fused)
 
 
 def make_jit_update(tx: Any):
@@ -259,14 +276,7 @@ class Optimizer:
         """
         from torchft_tpu.ddp import ft_allreduce_gradients
 
-        def _fused(params, opt_state, *batch):
-            loss, grads = jax.value_and_grad(loss_fn)(params, *batch)
-            updates, new_state = self.tx.update(grads, opt_state, params)
-            import optax
-
-            return loss, optax.apply_updates(params, updates), new_state
-
-        fused = jax.jit(_fused)
+        fused = make_jit_fused_step(self.tx, loss_fn)
         grad_fn = jax.jit(jax.value_and_grad(loss_fn))
 
         def step_fn(*batch):
