@@ -33,17 +33,13 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parent))
 
 
-def _ensure_live_backend() -> None:
-    """The accelerator backend can wedge during PJRT init (remote-chip
-    tunnel) — or, worse, list devices fine and then hang on the first
-    compile/execute (observed 2026-07-29: ``jax.devices()`` returned
-    ``[TPU v5 lite0]`` while a 256x256 matmul never completed). Probe in a
-    disposable subprocess and require a full compile→execute→fetch round
-    trip; if that can't finish within the deadline, pin this process to CPU
-    so the bench still reports (with a degraded baseline) instead of
-    hanging the driver."""
-    if os.environ.get("TPUFT_BENCH_NO_PROBE"):
-        return
+def _probe_ok() -> bool:
+    """The accelerator backend (remote-chip tunnel) has three observed
+    machine-wide failure modes: (a) PJRT init hangs for hours; (b) devices
+    list fine but the first compile/execute never completes; (c) the relay
+    dies MID-RUN with connection-refused after working for minutes. Probe
+    in a disposable subprocess and require a full compile→execute→fetch
+    round trip within the deadline."""
     probe_src = (
         "import jax, jax.numpy as jnp;"
         "x = jnp.ones((128, 128), jnp.bfloat16);"
@@ -61,15 +57,76 @@ def _ensure_live_backend() -> None:
             stdout=subprocess.DEVNULL,
             stderr=subprocess.DEVNULL,
         )
-        ok = probe.returncode == 0
+        return probe.returncode == 0
     except subprocess.TimeoutExpired:
-        ok = False
-    if not ok:
-        sys.stderr.write("bench: accelerator probe failed; falling back to CPU\n")
-        import jax
+        return False
 
-        jax.config.update("jax_platforms", "cpu")
-        globals()["DEGRADED"] = True
+
+def _parent() -> None:
+    """Orchestrate the measurement in child subprocesses so the driver
+    ALWAYS gets its one JSON line: a live-looking relay can still die or
+    wedge mid-run (failure mode (c) above — observed 2026-07-29, 20 min
+    into a run), which in-process would either hang forever or crash with
+    a traceback and no JSON. Each attempt gets a hard deadline; on
+    failure the CPU-fallback child reruns the whole bench with a shrunken
+    workload."""
+    attempts = []
+    if _probe_ok():
+        # Generous deadline: a healthy-but-slow tunnel run can near 30 min
+        # (remote compiles alone are minutes); killing it would report CPU
+        # fallback numbers as the round's TPU benchmark.
+        attempts.append(("tpu", int(os.environ.get("TPUFT_BENCH_TPU_DEADLINE", "2400"))))
+    else:
+        sys.stderr.write("bench: accelerator probe failed; skipping TPU attempt\n")
+    attempts.append(("cpu", int(os.environ.get("TPUFT_BENCH_CPU_DEADLINE", "1500"))))
+    import tempfile
+
+    for mode, deadline in attempts:
+        env = dict(os.environ, TPUFT_BENCH_CHILD=mode)
+        with tempfile.NamedTemporaryFile(mode="w+", suffix=f"_bench_{mode}.out") as out:
+            try:
+                # stdout to a file (never a pipe — see probe comment); the
+                # child's stderr passes through for debuggability.
+                subprocess.run(
+                    [sys.executable, os.path.abspath(__file__)],
+                    timeout=deadline,
+                    stdout=out,
+                    env=env,
+                )
+            except subprocess.TimeoutExpired:
+                sys.stderr.write(f"bench: {mode} attempt exceeded {deadline}s deadline\n")
+                continue
+            out.seek(0)
+            line = _last_json_line(out.read())
+            if line is not None:
+                print(line)
+                return
+            sys.stderr.write(f"bench: {mode} attempt produced no JSON line\n")
+    # Last resort — never leave the driver without its line.
+    print(
+        json.dumps(
+            {
+                "metric": "ft_diloco_tokens_per_sec",
+                "value": 0.0,
+                "unit": "tokens/sec",
+                "vs_baseline": 0.0,
+                "error": "all bench attempts failed (accelerator relay down, CPU fallback failed)",
+            }
+        )
+    )
+
+
+def _last_json_line(text: str) -> "str | None":
+    for raw in reversed(text.strip().splitlines()):
+        raw = raw.strip()
+        if not raw.startswith("{"):
+            continue
+        try:
+            if "metric" in json.loads(raw):
+                return raw
+        except json.JSONDecodeError:
+            continue
+    return None
 
 STEPS = int(os.environ.get("TPUFT_BENCH_STEPS", "20"))
 WARMUP = 3
@@ -96,7 +153,6 @@ def _peak_tflops(device) -> float | None:
 
 
 def main() -> None:
-    _ensure_live_backend()
     import jax
     import jax.numpy as jnp
     import optax
@@ -443,4 +499,16 @@ def _two_group_drill() -> dict:
 
 
 if __name__ == "__main__":
-    main()
+    child_mode = os.environ.get("TPUFT_BENCH_CHILD")
+    if child_mode == "cpu":
+        import jax
+
+        # Must run before any backend init (the sitecustomize platform pin
+        # cannot be overridden by env vars on this machine).
+        jax.config.update("jax_platforms", "cpu")
+        DEGRADED = True
+        main()
+    elif child_mode == "tpu" or os.environ.get("TPUFT_BENCH_NO_PROBE"):
+        main()
+    else:
+        _parent()

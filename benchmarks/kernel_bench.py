@@ -1,0 +1,206 @@
+#!/usr/bin/env python
+"""On-chip kernel microbenchmarks: Pallas flash attention vs XLA dense
+attention, and the fp8 wire-codec device kernels.
+
+The training bench (bench.py) measures the FT layer's overhead; this one
+measures the per-chip hot ops themselves — the "don't stop at parity"
+half of the perf story. Requires a live TPU (the kernels' compiled Mosaic
+path, not interpret mode — interpret-mode timings are meaningless).
+
+Usage:  TPUFT_LOG=warn python benchmarks/kernel_bench.py
+Prints one JSON line per configuration plus a summary line.
+
+Timing note (this machine): on the tunneled ``axon`` backend
+``block_until_ready`` can return before execution completes, so every
+timed region is closed by a value fetch of the last output, and
+iterations are data-chained (iteration i+1 consumes iteration i's output)
+so the fetch provably covers the whole loop.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def _probe_backend() -> None:
+    """In-process backend init WEDGES (not errors) when the relay is down —
+    probe in a disposable subprocess first, same as bench.py."""
+    probe_src = (
+        "import jax, jax.numpy as jnp;"
+        "x = jnp.ones((128, 128), jnp.bfloat16);"
+        "assert float(jax.jit(lambda a: a @ a)(x)[0, 0]) == 128.0"
+    )
+    try:
+        ok = (
+            subprocess.run(
+                [sys.executable, "-c", probe_src],
+                timeout=180,
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+            ).returncode
+            == 0
+        )
+    except subprocess.TimeoutExpired:
+        ok = False
+    if not ok:
+        sys.stderr.write("kernel_bench: accelerator probe failed; aborting\n")
+        sys.exit(1)
+
+
+_probe_backend()
+
+import jax
+import jax.numpy as jnp
+
+ITERS = 10
+WARMUP = 2
+
+
+def _timed(fn, *args, iters: int = ITERS, fetch=None):
+    """Median-of-3 wall time for ``iters`` data-chained applications."""
+    out = None
+    for _ in range(WARMUP):
+        out = fn(*args)
+    _force(out if fetch is None else fetch(out))
+    times = []
+    for _ in range(3):
+        t0 = time.monotonic()
+        cur = args
+        for _ in range(iters):
+            out = fn(*cur)
+            cur = _rechain(cur, out)
+        _force(out if fetch is None else fetch(out))
+        times.append((time.monotonic() - t0) / iters)
+    return sorted(times)[1]
+
+
+def _force(x):
+    leaf = jax.tree_util.tree_leaves(x)[0]
+    float(jnp.asarray(leaf).reshape(-1)[0])
+
+
+def _rechain(args, out):
+    """Feed the output back as the first argument (shapes permitting) so the
+    device must execute iterations in order."""
+    first = jax.tree_util.tree_leaves(out)[0]
+    if hasattr(args[0], "shape") and first.shape == args[0].shape:
+        return (first.astype(args[0].dtype),) + tuple(args[1:])
+    return args
+
+
+def bench_attention(results: list) -> None:
+    from torchft_tpu.models.llama import causal_attention
+    from torchft_tpu.ops.flash_attention import flash_attention
+
+    b, h, kv, d = 4, 8, 4, 128
+    for s in (1024, 2048, 4096, 8192):
+        kq, kk, kvk = jax.random.split(jax.random.PRNGKey(0), 3)
+        q = jax.random.normal(kq, (b, s, h, d), jnp.bfloat16)
+        k = jax.random.normal(kk, (b, s, kv, d), jnp.bfloat16)
+        v = jax.random.normal(kvk, (b, s, kv, d), jnp.bfloat16)
+
+        flash = jax.jit(lambda q, k, v: flash_attention(q, k, v, interpret=False))
+        dense = jax.jit(lambda q, k, v: causal_attention(q, k, v, scale=d**-0.5))
+
+        t_flash = _timed(flash, q, k, v)
+        try:
+            t_dense = _timed(dense, q, k, v)
+        except Exception:  # dense O(s^2) logits can OOM at long s
+            t_dense = None
+
+        # Causal attention FLOPs: 2 matmuls x (s^2/2) x h x d x b x 2.
+        flops = 2 * 2 * b * h * d * (s * s / 2)
+        row = {
+            "bench": "attention_fwd",
+            "seq": s,
+            "flash_ms": round(1e3 * t_flash, 3),
+            "dense_ms": round(1e3 * t_dense, 3) if t_dense else None,
+            "speedup_vs_dense": round(t_dense / t_flash, 3) if t_dense else None,
+            "flash_tflops": round(flops / t_flash / 1e12, 2),
+        }
+        results.append(row)
+        print(json.dumps(row))
+
+        # fwd+bwd through the kernel's custom VJP.
+        def loss_flash(q, k, v):
+            return flash_attention(q, k, v, interpret=False).astype(jnp.float32).sum()
+
+        def loss_dense(q, k, v):
+            return causal_attention(q, k, v, scale=d**-0.5).astype(jnp.float32).sum()
+
+        gflash = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))
+        gdense = jax.jit(jax.grad(loss_dense, argnums=(0, 1, 2)))
+        t_gflash = _timed(gflash, q, k, v, fetch=lambda g: g[0])
+        try:
+            t_gdense = _timed(gdense, q, k, v, fetch=lambda g: g[0])
+        except Exception:
+            t_gdense = None
+        row = {
+            "bench": "attention_fwd_bwd",
+            "seq": s,
+            "flash_ms": round(1e3 * t_gflash, 3),
+            "dense_ms": round(1e3 * t_gdense, 3) if t_gdense else None,
+            "speedup_vs_dense": (
+                round(t_gdense / t_gflash, 3) if t_gdense else None
+            ),
+        }
+        results.append(row)
+        print(json.dumps(row))
+
+
+def bench_fp8_codec(results: list) -> None:
+    from torchft_tpu.ops.quantization import (
+        dequantize_blocks_device,
+        quantize_blocks_device,
+    )
+
+    n = 64 * 1024 * 1024  # 256 MB of f32
+    x = jax.random.normal(jax.random.PRNGKey(1), (n,), jnp.float32)
+    quant = jax.jit(quantize_blocks_device)
+    payload, scales = quant(x)
+    dequant = jax.jit(dequantize_blocks_device)
+
+    t_q = _timed(quant, x, iters=5, fetch=lambda o: o[0])
+    t_d = _timed(lambda p, s: dequant(p, s), payload, scales, iters=5)
+    gb = n * 4 / 1e9
+    row = {
+        "bench": "fp8_codec",
+        "input_mb": n * 4 // (1 << 20),
+        "quantize_ms": round(1e3 * t_q, 3),
+        "quantize_gbps": round(gb / t_q, 1),
+        "dequantize_ms": round(1e3 * t_d, 3),
+        "dequantize_gbps": round(gb / t_d, 1),
+    }
+    results.append(row)
+    print(json.dumps(row))
+
+
+def main() -> None:
+    dev = jax.devices()[0]
+    if dev.platform != "tpu":
+        sys.stderr.write(
+            f"kernel_bench: needs a live TPU, devices()[0] is {dev}\n"
+        )
+        sys.exit(1)
+    results: list = []
+    bench_attention(results)
+    bench_fp8_codec(results)
+    print(
+        json.dumps(
+            {
+                "bench": "summary",
+                "device_kind": str(getattr(dev, "device_kind", "unknown")),
+                "rows": len(results),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -100,11 +100,7 @@ class LocalSGD:
             )
         finally:
             self._manager.allow_state_dict_read()
-        self._local_step += 1
-        if self._local_step < self._sync_every:
-            return False
-        self._local_step = 0
-        return self._sync()
+        return self._after_inner_step()
 
     def make_step_fn(self, loss_fn: Any):
         """``step_fn(*batch) -> (loss, synced)``: the inner step as ONE
@@ -125,13 +121,17 @@ class LocalSGD:
                 )
             finally:
                 self._manager.allow_state_dict_read()
-            self._local_step += 1
-            if self._local_step < self._sync_every:
-                return loss, False
-            self._local_step = 0
-            return loss, self._sync()
+            return loss, self._after_inner_step()
 
         return step_fn
+
+    def _after_inner_step(self) -> bool:
+        """Shared sync-boundary bookkeeping for step()/make_step_fn()."""
+        self._local_step += 1
+        if self._local_step < self._sync_every:
+            return False
+        self._local_step = 0
+        return self._sync()
 
     def _sync(self) -> bool:
         self._manager.start_quorum()
