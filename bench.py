@@ -38,28 +38,11 @@ def _probe_ok() -> bool:
     machine-wide failure modes: (a) PJRT init hangs for hours; (b) devices
     list fine but the first compile/execute never completes; (c) the relay
     dies MID-RUN with connection-refused after working for minutes. Probe
-    in a disposable subprocess and require a full compile→execute→fetch
-    round trip within the deadline."""
-    probe_src = (
-        "import jax, jax.numpy as jnp;"
-        "x = jnp.ones((128, 128), jnp.bfloat16);"
-        "y = jax.jit(lambda a: a @ a)(x);"
-        "assert float(y[0, 0]) == 128.0"
-    )
-    try:
-        # DEVNULL, not pipes: a wedged PJRT init can leave a tunnel-helper
-        # grandchild holding inherited pipe fds, and draining them after the
-        # timeout kill would hang forever — the exact failure this probe
-        # exists to catch.
-        probe = subprocess.run(
-            [sys.executable, "-c", probe_src],
-            timeout=180,
-            stdout=subprocess.DEVNULL,
-            stderr=subprocess.DEVNULL,
-        )
-        return probe.returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
+    (a)/(b) in a disposable subprocess; (c) is what the child-process
+    deadline in ``_parent`` covers."""
+    from torchft_tpu.utils.platform import probe_accelerator
+
+    return probe_accelerator(timeout=180.0)
 
 
 def _parent() -> None:

@@ -12,48 +12,30 @@ Prints one JSON line per configuration plus a summary line.
 
 Timing note (this machine): on the tunneled ``axon`` backend
 ``block_until_ready`` can return before execution completes, so every
-timed region is closed by a value fetch of the last output, and
-iterations are data-chained (iteration i+1 consumes iteration i's output)
-so the fetch provably covers the whole loop.
+timed region is closed by a value fetch of the last output. Attention
+iterations are additionally data-chained (iteration i+1 consumes
+iteration i's output) so the fetch provably covers the whole loop; the
+fp8 codec shapes don't permit chaining, so those rely on the device
+executing dispatched programs in order (true of single-stream TPU
+execution) for the final fetch to imply the earlier iterations finished.
 """
 
 from __future__ import annotations
 
 import json
-import subprocess
 import sys
 import time
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
+from torchft_tpu.utils.platform import probe_accelerator
 
-def _probe_backend() -> None:
-    """In-process backend init WEDGES (not errors) when the relay is down —
-    probe in a disposable subprocess first, same as bench.py."""
-    probe_src = (
-        "import jax, jax.numpy as jnp;"
-        "x = jnp.ones((128, 128), jnp.bfloat16);"
-        "assert float(jax.jit(lambda a: a @ a)(x)[0, 0]) == 128.0"
-    )
-    try:
-        ok = (
-            subprocess.run(
-                [sys.executable, "-c", probe_src],
-                timeout=180,
-                stdout=subprocess.DEVNULL,
-                stderr=subprocess.DEVNULL,
-            ).returncode
-            == 0
-        )
-    except subprocess.TimeoutExpired:
-        ok = False
-    if not ok:
-        sys.stderr.write("kernel_bench: accelerator probe failed; aborting\n")
-        sys.exit(1)
-
-
-_probe_backend()
+# In-process backend init WEDGES (not errors) when the relay is down —
+# probe in a disposable subprocess before touching jax, same as bench.py.
+if not probe_accelerator(timeout=180.0):
+    sys.stderr.write("kernel_bench: accelerator probe failed; aborting\n")
+    sys.exit(1)
 
 import jax
 import jax.numpy as jnp
@@ -111,7 +93,8 @@ def bench_attention(results: list) -> None:
         t_flash = _timed(flash, q, k, v)
         try:
             t_dense = _timed(dense, q, k, v)
-        except Exception:  # dense O(s^2) logits can OOM at long s
+        except Exception as e:  # dense O(s^2) logits can OOM at long s
+            sys.stderr.write(f"kernel_bench: dense fwd s={s} failed: {e}\n")
             t_dense = None
 
         # Causal attention FLOPs: 2 matmuls x (s^2/2) x h x d x b x 2.
@@ -139,7 +122,8 @@ def bench_attention(results: list) -> None:
         t_gflash = _timed(gflash, q, k, v, fetch=lambda g: g[0])
         try:
             t_gdense = _timed(gdense, q, k, v, fetch=lambda g: g[0])
-        except Exception:
+        except Exception as e:
+            sys.stderr.write(f"kernel_bench: dense fwd+bwd s={s} failed: {e}\n")
             t_gdense = None
         row = {
             "bench": "attention_fwd_bwd",

@@ -3,8 +3,40 @@
 from __future__ import annotations
 
 import os
+import subprocess
+import sys
 
-__all__ = ["honor_jax_platforms_env"]
+__all__ = ["honor_jax_platforms_env", "probe_accelerator"]
+
+
+def probe_accelerator(timeout: float = 180.0) -> bool:
+    """True iff the attached accelerator completes a full
+    compile→execute→fetch round trip within ``timeout`` seconds.
+
+    Runs in a disposable subprocess because the remote-chip relay on some
+    machines has failure modes that WEDGE rather than error: PJRT init can
+    hang for hours, or ``jax.devices()`` lists the chip while the first
+    compile/execute never completes. Probing in-process would hang the
+    caller — exactly what this function exists to prevent. stdout/stderr go
+    to DEVNULL (not pipes): a wedged init can leave a tunnel-helper
+    grandchild holding inherited pipe fds, and draining them after the
+    timeout kill would hang forever."""
+    probe_src = (
+        "import jax, jax.numpy as jnp;"
+        "x = jnp.ones((128, 128), jnp.bfloat16);"
+        "y = jax.jit(lambda a: a @ a)(x);"
+        "assert float(y[0, 0]) == 128.0"
+    )
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", probe_src],
+            timeout=timeout,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        return probe.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
 
 
 def honor_jax_platforms_env() -> None:
