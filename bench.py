@@ -155,6 +155,26 @@ def main() -> None:
             ffn_hidden=256, max_seq_len=SEQ, dtype=jnp.float32,
         )
         sync_every_cap = 6
+    elif os.environ.get("TPUFT_BENCH_MODEL") == "large":
+        # Opt-in ~400M-param config for a credible MFU datum: enough
+        # compute per step that dispatch latency stops dominating, with
+        # the fused Pallas attention kernel on the long sequence. Not the
+        # driver default (remote compiles alone run minutes). Like the
+        # degraded branch, this supersedes an explicit TPUFT_BENCH_SEQ —
+        # the workload is part of the named config.
+        SEQ = 2048
+        config = LlamaConfig(
+            vocab_size=32768,
+            dim=1024,
+            n_layers=24,
+            n_heads=16,
+            n_kv_heads=8,
+            ffn_hidden=4096,
+            max_seq_len=SEQ,
+            dtype=jnp.bfloat16,
+            attention_impl="flash",
+        )
+        sync_every_cap = 10**9
     else:
         config = LlamaConfig(
             vocab_size=8192,
@@ -383,7 +403,7 @@ def _two_group_drill() -> dict:
     from torchft_tpu.ddp import ft_allreduce_gradients
     from torchft_tpu.manager import Manager
     from torchft_tpu.optim import Optimizer
-    from torchft_tpu.parallel.process_group import ProcessGroupTCP
+    from torchft_tpu.parallel.native_pg import ProcessGroupNative
     from torchft_tpu.parallel.store import StoreClient, StoreServer
 
     # Tiny model: this drill measures coordination + wire costs, not FLOPs
@@ -417,7 +437,9 @@ def _two_group_drill() -> dict:
         while attempts < 3:
             attempts += 1
             store = StoreServer()
-            pg = ProcessGroupTCP(timeout=20.0)
+            # The C++ ring engine (the production default): ~2x lower sync
+            # p50 than the Python TCP fallback in this same drill.
+            pg = ProcessGroupNative(timeout=20.0)
             manager = Manager(
                 pg=pg,
                 min_replica_size=1,
