@@ -24,6 +24,7 @@ import jax
 import numpy as np
 
 from torchft_tpu.manager import Manager
+from torchft_tpu.utils.transfer import prefetch_to_host
 from torchft_tpu.work import Work
 
 __all__ = [
@@ -121,9 +122,7 @@ def ft_allreduce_gradients(
         )
 
     # Stage 1: launch all d2h copies without blocking.
-    for leaf in leaves:
-        if isinstance(leaf, jax.Array):
-            leaf.copy_to_host_async()
+    prefetch_to_host(leaves)
 
     # Stage 2: enqueue one wire collective per bucket. np.asarray completes
     # the (already in-flight) copy for that bucket only; the PG op worker

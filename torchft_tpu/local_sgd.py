@@ -28,6 +28,7 @@ import jax
 import numpy as np
 
 from torchft_tpu.manager import Manager
+from torchft_tpu.utils.transfer import prefetch_to_host
 from torchft_tpu.work import Work
 
 logger = logging.getLogger(__name__)
@@ -266,9 +267,14 @@ class _Fragment:
             # Participation zeroing + error funnel live in the manager.
             self._work = self._manager.allreduce_prequantized(payload, scales)
         else:
+            locals_ = [local_leaves[i] for i in self.leaf_indices]
+            # Launch every device→host copy before consuming any: the
+            # per-leaf np.asarray below then drains transfers already in
+            # flight instead of serializing one round trip per leaf.
+            prefetch_to_host(locals_)
             pseudograds = [
-                backup - np.asarray(local_leaves[i])
-                for backup, i in zip(self.backup, self.leaf_indices)
+                backup - np.asarray(leaf)
+                for backup, leaf in zip(self.backup, locals_)
             ]
             self._work = self._manager.allreduce_pytree(pseudograds)
 
@@ -281,9 +287,14 @@ class _Fragment:
         averaged = self._work.wait()
         self._work = None
 
+        locals_ = [local_leaves[i] for i in self.leaf_indices]
+        if not self._should_quantize:
+            # Same launch-then-drain pattern as prepare_sync: this fetch sits
+            # on the commit critical path right after wait().
+            prefetch_to_host(locals_)
         local_copy = [
-            local_leaves[i] if self._should_quantize else np.asarray(local_leaves[i])
-            for i in self.leaf_indices
+            leaf if self._should_quantize else np.asarray(leaf)
+            for leaf in locals_
         ]
         # Restore to the last global state before voting: on a failed commit
         # the fragment resets rather than over-training on a divergent copy.

@@ -50,6 +50,7 @@ from torchft_tpu.parallel.process_group import ProcessGroup, ReduceOp
 from torchft_tpu.parallel.store import StoreClient
 from torchft_tpu.telemetry import commits_logger, errors_logger, quorums_logger
 from torchft_tpu.utils.profiling import trace_span
+from torchft_tpu.utils.transfer import prefetch_to_host
 from torchft_tpu.work import Work, _DummyWork
 
 T = TypeVar("T")
@@ -355,9 +356,7 @@ class Manager:
             # Launch every device→host copy before completing any: the
             # per-leaf np.asarray then drains transfers that are already in
             # flight instead of serializing them.
-            for leaf in leaves:
-                if isinstance(leaf, jax.Array):
-                    leaf.copy_to_host_async()
+            prefetch_to_host(leaves)
             arrays = [np.asarray(leaf) for leaf in leaves]
         if not self.is_participating():
             arrays = [np.zeros_like(a) for a in arrays]

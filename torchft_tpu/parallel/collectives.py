@@ -21,6 +21,7 @@ import numpy as np
 
 from torchft_tpu.ops import quantization as q
 from torchft_tpu.parallel.process_group import ProcessGroup, ReduceOp
+from torchft_tpu.utils.transfer import prefetch_to_host
 from torchft_tpu.work import Work
 
 __all__ = [
@@ -187,9 +188,7 @@ def allreduce_quantized_wire(
     world_size = pg.size()
     # Kick off the device→host copies now (non-blocking) so they progress
     # while this call returns and the caller keeps dispatching inner steps.
-    for array in (payload, scales):
-        if hasattr(array, "copy_to_host_async"):
-            array.copy_to_host_async()
+    prefetch_to_host((payload, scales))
 
     def pipeline():
         # The device->host fetch completes HERE, on the pipeline thread, so

@@ -1,0 +1,23 @@
+"""Device↔host transfer helpers."""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+__all__ = ["prefetch_to_host"]
+
+
+def prefetch_to_host(leaves: Iterable) -> None:
+    """Launches a non-blocking device→host copy for every jax array.
+
+    Call before a sequence of per-leaf ``np.asarray`` drains: the copies
+    then progress concurrently (and overlap whatever the caller does next)
+    instead of serializing one device round trip per leaf — which dominates
+    on high-latency device links. Non-array leaves (already-host numpy,
+    scalars) are skipped; jax arrays are matched by the
+    ``copy_to_host_async`` attribute so sharded/committed array flavors all
+    qualify.
+    """
+    for leaf in leaves:
+        if hasattr(leaf, "copy_to_host_async"):
+            leaf.copy_to_host_async()
