@@ -350,13 +350,18 @@ def main() -> None:
     # compiled (Mosaic) path — the CLAUDE.md "verify kernels on the real
     # chip" gate, automated so it can never silently go unexercised.
     flash_on_chip = None
+    quant_on_chip = None
     if not DEGRADED and jax.devices()[0].platform == "tpu":
-        from torchft_tpu.ops.flash_attention import verify_on_chip
+        from torchft_tpu.ops import flash_attention, quantization
 
         try:
-            flash_on_chip = verify_on_chip()["ok"]
+            flash_on_chip = flash_attention.verify_on_chip()["ok"]
         except Exception as e:  # report, don't sink the bench line
             flash_on_chip = f"failed: {e}"
+        try:
+            quant_on_chip = quantization.verify_on_chip()["ok"]
+        except Exception as e:
+            quant_on_chip = f"failed: {e}"
 
     # MFU estimate for the headline path: causal-LM forward+backward is
     # ~6·N_params FLOPs/token plus the attention term 12·L·d·s.
@@ -383,6 +388,7 @@ def main() -> None:
                 "device_kind": str(getattr(jax.devices()[0], "device_kind", "unknown")),
                 "n_params": n_params,
                 "flash_kernel_on_chip": flash_on_chip,
+                "quant_kernel_on_chip": quant_on_chip,
                 "quorum_p50_ms": quorum_p50_ms,
                 **two_group,
             }

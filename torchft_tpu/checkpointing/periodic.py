@@ -6,7 +6,9 @@ state_dict* for job-level restarts). This helper wraps orbax with the
 manager bookkeeping so a restore resumes at the right committed step::
 
     ckpt = PeriodicCheckpointer(manager, "/ckpts/run1", save_every=100)
-    restored = ckpt.restore_or_none()       # on startup
+    restored = ckpt.restore_or_none(        # on startup
+        template={"params": opt.params, "opt_state": opt.opt_state}
+    )
     ...
     ckpt.maybe_save({"params": opt.params, "opt_state": opt.opt_state})
 
@@ -74,15 +76,29 @@ class PeriodicCheckpointer:
         logger.info("saved periodic checkpoint at step %d", step)
         return True
 
-    def restore_or_none(self) -> Optional[Dict[str, Any]]:
+    def restore_or_none(
+        self, template: Optional[Dict[str, Any]] = None
+    ) -> Optional[Dict[str, Any]]:
         """Restores the latest checkpoint: loads the manager bookkeeping and
-        returns the user state (None when no checkpoint exists)."""
+        returns the user state (None when no checkpoint exists).
+
+        Pass the live user-state pytree as ``template`` to get the restored
+        state back in ITS structure — without one, orbax launders containers
+        (optax named-tuples come back as lists), which breaks loaders that
+        tree-map the result against live state (e.g.
+        ``Optimizer._load_state_dict``)."""
         import orbax.checkpoint as ocp
 
         step = self._mngr.latest_step()
         if step is None:
             return None
-        payload = self._mngr.restore(step, args=ocp.args.StandardRestore())
+        if template is not None:
+            args = ocp.args.StandardRestore(
+                {"user": template, "tpuft": self._manager.state_dict()}
+            )
+        else:
+            args = ocp.args.StandardRestore()
+        payload = self._mngr.restore(step, args=args)
         self._manager.load_state_dict(
             {k: int(v) for k, v in payload["tpuft"].items()}
         )
