@@ -1,5 +1,6 @@
-"""fp8 quantization + quantized collective tests (parity targets:
-quantization_test.py + collectives_test.py)."""
+"""8-bit (fp8 + int8) quantization + quantized collective tests (parity
+targets: quantization_test.py + collectives_test.py; the dual wire format
+mirrors the reference's fp8-on-SM90+/int8-below split)."""
 
 from concurrent.futures import ThreadPoolExecutor
 
@@ -19,16 +20,23 @@ from torchft_tpu.parallel.process_group import ReduceOp
 # -- kernels (numpy reference) ------------------------------------------------
 
 
+@pytest.mark.parametrize("wire", ["fp8", "int8"])
 @pytest.mark.parametrize(
     "shape", [(7,), (256,), (1000,), (33, 17), (4, 4, 4)]
 )
-def test_quantize_roundtrip_accuracy(shape) -> None:
+def test_quantize_roundtrip_accuracy(shape, wire) -> None:
     rng = np.random.default_rng(0)
     x = rng.normal(size=shape).astype(np.float32) * 10
-    payload, scales = q.quantize_blocks(x)
+    payload, scales = q.quantize_blocks(x, wire=wire)
+    assert payload.dtype == (np.int8 if wire == "int8" else q._FP8)
     restored = q.dequantize_blocks(payload, scales, x.shape, x.dtype)
-    # fp8 e4m3 has ~2 decimal digits; blockwise scales keep relative error low.
-    np.testing.assert_allclose(restored, x, rtol=0.07, atol=0.1)
+    if wire == "int8":
+        # Round-to-nearest guarantee: error <= scale/2 per element.
+        bound = np.max(scales) / 2 * 1.001
+        assert float(np.max(np.abs(restored - x))) <= bound
+    else:
+        # fp8 e4m3 has ~2 decimal digits; blockwise scales keep it low.
+        np.testing.assert_allclose(restored, x, rtol=0.07, atol=0.1)
 
 
 def test_quantize_zero_block() -> None:
@@ -38,10 +46,11 @@ def test_quantize_zero_block() -> None:
     np.testing.assert_array_equal(restored, x)
 
 
-def test_reduce_quantized_matches_float_sum() -> None:
+@pytest.mark.parametrize("wire", ["fp8", "int8"])
+def test_reduce_quantized_matches_float_sum(wire) -> None:
     rng = np.random.default_rng(1)
     chunks = [rng.normal(size=(4, q.BLOCK)).astype(np.float32) for _ in range(3)]
-    quantized = [q.quantize_blocks(c) for c in chunks]
+    quantized = [q.quantize_blocks(c, wire=wire) for c in chunks]
     out_payload, out_scales = q.reduce_quantized(
         [p for p, _ in quantized], [s for _, s in quantized]
     )
@@ -52,12 +61,14 @@ def test_reduce_quantized_matches_float_sum() -> None:
     np.testing.assert_allclose(restored, total, rtol=0.07, atol=0.1)
 
 
-def test_pack_unpack_roundtrip() -> None:
+@pytest.mark.parametrize("wire", ["fp8", "int8"])
+def test_pack_unpack_roundtrip(wire) -> None:
     rng = np.random.default_rng(2)
     x = rng.normal(size=(5, q.BLOCK)).astype(np.float32)
-    payload, scales = q.quantize_blocks(x.reshape(-1))
+    payload, scales = q.quantize_blocks(x.reshape(-1), wire=wire)
     buf = q.pack_arrays(payload, scales)
-    payload2, scales2 = q.unpack_arrays(buf, payload.shape[0])
+    payload2, scales2 = q.unpack_arrays(buf, payload.shape[0], wire=wire)
+    assert payload2.dtype == payload.dtype
     np.testing.assert_array_equal(payload.view(np.uint8), payload2.view(np.uint8))
     np.testing.assert_array_equal(scales, scales2)
 
@@ -65,13 +76,16 @@ def test_pack_unpack_roundtrip() -> None:
 # -- pallas kernels (interpret mode on CPU) -----------------------------------
 
 
-def test_pallas_quantize_matches_numpy() -> None:
+@pytest.mark.parametrize("wire", ["fp8", "int8"])
+def test_pallas_quantize_matches_numpy(wire) -> None:
     import jax.numpy as jnp
 
     rng = np.random.default_rng(3)
     x = rng.normal(size=(8, q.BLOCK)).astype(np.float32) * 5
-    payload_np, scales_np = q.quantize_blocks(x.reshape(-1))
-    payload_pl, scales_pl = q.quantize_blocks_pallas(jnp.asarray(x), interpret=True)
+    payload_np, scales_np = q.quantize_blocks(x.reshape(-1), wire=wire)
+    payload_pl, scales_pl = q.quantize_blocks_pallas(
+        jnp.asarray(x), interpret=True, wire=wire
+    )
     np.testing.assert_allclose(scales_pl, scales_np, rtol=1e-6)
     np.testing.assert_allclose(
         np.asarray(payload_pl).astype(np.float32),
@@ -152,3 +166,94 @@ def test_manager_allreduce_quantized_path() -> None:
     x = np.linspace(-3, 3, 512, dtype=np.float32)
     out = manager.allreduce(x, should_quantize=True).wait()
     np.testing.assert_allclose(out, x, rtol=0.1, atol=0.1)
+
+
+# -- int8 wire format (reference parity: fp8 on SM90+, int8 below) -----------
+
+
+def test_default_wire_env(monkeypatch) -> None:
+    monkeypatch.delenv(q.WIRE_DTYPE_ENV, raising=False)
+    assert q.default_wire() == "fp8"
+    monkeypatch.setenv(q.WIRE_DTYPE_ENV, "int8")
+    assert q.default_wire() == "int8"
+    payload, _ = q.quantize_blocks(np.ones(16, np.float32))
+    assert payload.dtype == np.int8
+    monkeypatch.setenv(q.WIRE_DTYPE_ENV, "fp4")
+    with pytest.raises(ValueError, match="fp4"):
+        q.default_wire()
+
+
+def test_wire_of() -> None:
+    assert q.wire_of(np.zeros(4, np.int8)) == "int8"
+    assert q.wire_of(np.zeros(4, q._FP8)) == "fp8"
+    with pytest.raises(TypeError):
+        q.wire_of(np.zeros(4, np.float32))
+
+
+def test_allreduce_quantized_int8_wire(store_server) -> None:
+    from torchft_tpu.parallel.collectives import allreduce_quantized
+
+    pgs = make_group(store_server, 2)
+    rng = np.random.default_rng(6)
+    inputs = [[rng.normal(size=512).astype(np.float32)] for _ in range(2)]
+    try:
+        results = run_on_all(
+            pgs,
+            lambda pg, i: allreduce_quantized(
+                inputs[i], ReduceOp.AVG, pg, wire_dtype="int8"
+            ).wait(),
+        )
+        expected = (inputs[0][0] + inputs[1][0]) / 2
+        for r in results:
+            np.testing.assert_allclose(r[0], expected, rtol=0.1, atol=0.15)
+        assert results[0][0].tobytes() == results[1][0].tobytes()
+    finally:
+        for pg in pgs:
+            pg.shutdown()
+
+
+def test_device_codec_int8_through_wire_allreduce(store_server) -> None:
+    """A device codec built with wire='int8' flows through
+    allreduce_quantized_wire end to end — the wire format is read from the
+    payload dtype, not the env."""
+    import jax.numpy as jnp
+
+    from torchft_tpu.ops.quantization import make_tree_fp8_codec
+    from torchft_tpu.parallel.collectives import allreduce_quantized_wire
+
+    leaves = [jnp.linspace(-2, 2, 300, dtype=jnp.float32).reshape(30, 10)]
+    quantize, dequantize = make_tree_fp8_codec(leaves, wire="int8")
+    payload, scales = quantize(leaves)
+    assert np.asarray(payload).dtype == np.int8
+
+    pgs = make_group(store_server, 2)
+    try:
+        results = run_on_all(
+            pgs,
+            lambda pg, i: allreduce_quantized_wire(
+                payload, scales, ReduceOp.AVG, pg
+            ).wait(),
+        )
+        for out_payload, out_scales in results:
+            assert out_payload.dtype == np.int8
+            restored = dequantize(
+                jnp.asarray(out_payload), jnp.asarray(out_scales)
+            )
+            np.testing.assert_allclose(
+                np.asarray(restored[0]), np.asarray(leaves[0]), rtol=0.05, atol=0.05
+            )
+    finally:
+        for pg in pgs:
+            pg.shutdown()
+
+
+def test_unpack_rejects_cross_format_buffer() -> None:
+    """A peer that quantized with a different TPUFT_WIRE_DTYPE must be a
+    hard error at decode, never a silent bit reinterpretation."""
+    x = np.linspace(-1, 1, q.BLOCK, dtype=np.float32)
+    payload, scales = q.quantize_blocks(x, wire="fp8")
+    buf = q.pack_arrays(payload, scales)
+    with pytest.raises(ValueError, match="wire format mismatch"):
+        q.unpack_arrays(buf, payload.shape[0], wire="int8")
+    with pytest.raises(ValueError, match="unknown wire format tag"):
+        q.unpack_arrays(np.full(64, 255, np.uint8), 0)

@@ -167,11 +167,19 @@ def _ft_allreduce_gradients_fp8(manager: Manager, grads: Any) -> Any:
 
     from torchft_tpu.ops.quantization import make_tree_fp8_codec
 
+    from torchft_tpu.ops.quantization import default_wire
+
     leaves, treedef = jax.tree_util.tree_flatten(grads)
-    key = (treedef, tuple((leaf.shape, str(leaf.dtype)) for leaf in leaves))
+    key = (
+        treedef,
+        default_wire(),  # env can flip between calls (tests do)
+        tuple((leaf.shape, str(leaf.dtype)) for leaf in leaves),
+    )
     codec = _FP8_CODECS.get(key)
     if codec is None:
-        codec = make_tree_fp8_codec(leaves)
+        # Pass the wire captured in the key: a second env read inside the
+        # codec could race a concurrent flip and cache a mismatched codec.
+        codec = make_tree_fp8_codec(leaves, wire=key[1])
         _FP8_CODECS[key] = codec
     quantize, dequantize = codec
 

@@ -1,24 +1,33 @@
-"""fp8 (e4m3) block quantization for bandwidth-compressed collectives.
+"""8-bit block quantization for bandwidth-compressed collectives.
 
 Role-equivalent of the reference's Triton kernels
 (/root/reference/torchft/quantization.py): rowwise/blockwise max-abs scales,
-fp8e4m3 payloads, and a fused dequantize-reduce-requantize used inside the
-quantized allreduce. The TPU build provides:
+8-bit payloads, and a fused dequantize-reduce-requantize used inside the
+quantized allreduce. Like the reference — which emits fp8e4nv on SM90+ and
+int8 on older GPUs — two wire formats share one layout:
 
-- a numpy/jnp implementation (works everywhere; used for the host-side TCP
-  collective wire format), and
-- Pallas TPU kernels for the device-side hot path (``*_pallas``), exercised
-  in interpret mode on CPU tests and compiled on real TPU.
+- ``"fp8"`` (float8_e4m3): wider per-block dynamic range;
+- ``"int8"``: symmetric round-to-nearest, finer resolution near the block
+  max and universally fast integer hardware.
+
+Select per call or globally via ``TPUFT_WIRE_DTYPE``. The TPU build
+provides a numpy/jnp implementation (works everywhere; used for the
+host-side TCP collective wire format) and Pallas TPU kernels for the
+device-side hot path (``*_pallas``), exercised in interpret mode on CPU
+tests and compiled on real TPU.
 
 Layout: arrays are flattened, padded to a multiple of ``block``, and viewed
 as ``(n_blocks, block)``; each block carries one float32 scale. The wire
-payload is ``scales || fp8 payload``, mirroring the reference's interleaved
-[scales||payload] slices.
+payload is ``scales || payload``, mirroring the reference's interleaved
+[scales||payload] slices. Both formats are 1 byte/element, so the wire
+framing is format-independent; the payload dtype rides in the arrays and
+every consumer (dequantize, reduce, unpack) dispatches on it.
 """
 
 from __future__ import annotations
 
-from typing import Sequence, Tuple
+import os
+from typing import Optional, Sequence, Tuple
 
 import ml_dtypes
 import numpy as np
@@ -26,6 +35,10 @@ import numpy as np
 __all__ = [
     "BLOCK",
     "FP8_MAX",
+    "INT8_MAX",
+    "WIRE_DTYPE_ENV",
+    "default_wire",
+    "wire_of",
     "quantize_blocks",
     "dequantize_blocks",
     "reduce_quantized",
@@ -37,7 +50,31 @@ __all__ = [
 
 BLOCK = 256
 FP8_MAX = 448.0  # float8_e4m3fn dynamic range
+INT8_MAX = 127.0
 _FP8 = ml_dtypes.float8_e4m3fn
+WIRE_DTYPE_ENV = "TPUFT_WIRE_DTYPE"
+
+_WIRE_NP_DTYPES = {"fp8": np.dtype(_FP8), "int8": np.dtype(np.int8)}
+_WIRE_QMAX = {"fp8": FP8_MAX, "int8": INT8_MAX}
+
+
+def default_wire() -> str:
+    """The process-wide wire format: ``TPUFT_WIRE_DTYPE`` or ``"fp8"``."""
+    wire = os.environ.get(WIRE_DTYPE_ENV, "fp8")
+    if wire not in _WIRE_NP_DTYPES:
+        raise ValueError(
+            f"{WIRE_DTYPE_ENV}={wire!r} is not one of {sorted(_WIRE_NP_DTYPES)}"
+        )
+    return wire
+
+
+def wire_of(payload) -> str:
+    """Wire format of an existing payload array, by dtype."""
+    dtype = np.dtype(payload.dtype)
+    for name, np_dtype in _WIRE_NP_DTYPES.items():
+        if dtype == np_dtype:
+            return name
+    raise TypeError(f"array dtype {dtype} is not a known wire payload format")
 
 
 def _as_blocks(flat: np.ndarray, block: int = BLOCK) -> np.ndarray:
@@ -48,14 +85,18 @@ def _as_blocks(flat: np.ndarray, block: int = BLOCK) -> np.ndarray:
 
 
 def quantize_blocks(
-    array: np.ndarray, block: int = BLOCK
+    array: np.ndarray, block: int = BLOCK, wire: Optional[str] = None
 ) -> Tuple[np.ndarray, np.ndarray]:
-    """Returns (payload fp8 (n_blocks, block), scales f32 (n_blocks,))."""
+    """Returns (payload 8-bit (n_blocks, block), scales f32 (n_blocks,))."""
+    wire = wire or default_wire()
     flat = np.ascontiguousarray(array).astype(np.float32).reshape(-1)
     blocks = _as_blocks(flat, block)
     maxabs = np.max(np.abs(blocks), axis=1)
-    scales = np.where(maxabs > 0, maxabs / FP8_MAX, 1.0).astype(np.float32)
-    payload = (blocks / scales[:, None]).astype(_FP8)
+    scales = np.where(maxabs > 0, maxabs / _WIRE_QMAX[wire], 1.0).astype(np.float32)
+    scaled = blocks / scales[:, None]
+    if wire == "int8":
+        scaled = np.rint(scaled)
+    payload = scaled.astype(_WIRE_NP_DTYPES[wire])
     return payload, scales
 
 
@@ -72,31 +113,66 @@ def reduce_quantized(
     payloads: Sequence[np.ndarray], scales: Sequence[np.ndarray]
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Fused dequantize-sum-requantize over per-rank quantized chunks
-    (reference fused_reduce_fp8): accumulates in float32, emits fresh fp8
-    payload + scales for the reduced result."""
+    (reference fused_reduce_fp8): accumulates in float32, emits a fresh
+    payload + scales for the reduced result in the inputs' wire format."""
+    wire = wire_of(payloads[0])
     acc = payloads[0].astype(np.float32) * scales[0][:, None]
     for payload, scale in zip(payloads[1:], scales[1:]):
         acc += payload.astype(np.float32) * scale[:, None]
     maxabs = np.max(np.abs(acc), axis=1)
-    out_scales = np.where(maxabs > 0, maxabs / FP8_MAX, 1.0).astype(np.float32)
-    out_payload = (acc / out_scales[:, None]).astype(_FP8)
+    out_scales = np.where(maxabs > 0, maxabs / _WIRE_QMAX[wire], 1.0).astype(
+        np.float32
+    )
+    out = acc / out_scales[:, None]
+    if wire == "int8":
+        out = np.rint(out)
+    out_payload = out.astype(_WIRE_NP_DTYPES[wire])
     return out_payload, out_scales
 
 
+_WIRE_TAGS = {"fp8": 0, "int8": 1}
+_TAG_WIRES = {tag: name for name, tag in _WIRE_TAGS.items()}
+
+# One leading byte identifies the payload format on the wire. Both formats
+# are 1 byte/element, so without it a cross-rank TPUFT_WIRE_DTYPE
+# disagreement would decode peers' fp8 bits as int8 (or vice versa) and
+# silently corrupt the reduction; the tag turns that into a hard error.
+WIRE_HEADER_BYTES = 1
+
+
 def pack_arrays(payload: np.ndarray, scales: np.ndarray) -> np.ndarray:
-    """Packs [scales || payload] into one uint8 wire buffer."""
+    """Packs [format tag || scales || payload] into one uint8 wire buffer."""
+    tag = np.array([_WIRE_TAGS[wire_of(payload)]], dtype=np.uint8)
     return np.concatenate(
-        [scales.astype(np.float32).view(np.uint8).reshape(-1),
+        [tag,
+         scales.astype(np.float32).view(np.uint8).reshape(-1),
          payload.view(np.uint8).reshape(-1)]
     )
 
 
-def unpack_arrays(buf: np.ndarray, n_blocks: int, block: int = BLOCK) -> Tuple[np.ndarray, np.ndarray]:
+def unpack_arrays(
+    buf: np.ndarray, n_blocks: int, block: int = BLOCK, wire: Optional[str] = None
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Inverse of :func:`pack_arrays`. The embedded format tag is
+    authoritative; passing ``wire`` asserts the peer used the expected
+    format (raising on cross-rank TPUFT_WIRE_DTYPE disagreement)."""
+    tag_wire = _TAG_WIRES.get(int(buf[0]))
+    if tag_wire is None:
+        raise ValueError(f"unknown wire format tag {int(buf[0])} in buffer")
+    if wire is not None and wire != tag_wire:
+        raise ValueError(
+            f"wire format mismatch: peer sent {tag_wire!r}, this rank expects "
+            f"{wire!r} — TPUFT_WIRE_DTYPE must agree across all replicas"
+        )
+    body = buf[WIRE_HEADER_BYTES:]
     scale_bytes = n_blocks * 4
-    scales = buf[:scale_bytes].view(np.float32).copy()
-    payload = buf[scale_bytes : scale_bytes + n_blocks * block].view(_FP8).reshape(
-        n_blocks, block
-    ).copy()
+    scales = body[:scale_bytes].view(np.float32).copy()
+    payload = (
+        body[scale_bytes : scale_bytes + n_blocks * block]
+        .view(_WIRE_NP_DTYPES[tag_wire])
+        .reshape(n_blocks, block)
+        .copy()
+    )
     return payload, scales
 
 
@@ -105,26 +181,34 @@ def unpack_arrays(buf: np.ndarray, n_blocks: int, block: int = BLOCK) -> Tuple[n
 # ---------------------------------------------------------------------------
 
 
-def quantize_blocks_pallas(x, block: int = BLOCK, interpret: bool = False):
-    """Device-side blockwise fp8 quantization.
+def quantize_blocks_pallas(
+    x, block: int = BLOCK, interpret: bool = False, wire: Optional[str] = None
+):
+    """Device-side blockwise 8-bit quantization (fp8 or int8).
 
     ``x``: float array, flattened/padded by the caller to (n_blocks, block).
-    Returns (payload fp8, scales f32). One grid row per block tile keeps the
+    Returns (payload, scales f32). One grid row per block tile keeps the
     VPU busy while scales stay in SMEM-sized slices.
     """
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
+    wire = wire or default_wire()
+    qmax = _WIRE_QMAX[wire]
+    out_dtype = jnp.int8 if wire == "int8" else jnp.float8_e4m3fn
     n_blocks = x.shape[0]
     rows_per_tile = min(n_blocks, 8)
 
     def kernel(x_ref, payload_ref, scales_ref):
         block_data = x_ref[:].astype(jnp.float32)
         maxabs = jnp.max(jnp.abs(block_data), axis=1, keepdims=True)
-        scale = jnp.where(maxabs > 0, maxabs / FP8_MAX, 1.0)
+        scale = jnp.where(maxabs > 0, maxabs / qmax, 1.0)
         scales_ref[:] = scale
-        payload_ref[:] = (block_data / scale).astype(jnp.float8_e4m3fn)
+        scaled = block_data / scale
+        if wire == "int8":
+            scaled = jnp.round(scaled)
+        payload_ref[:] = scaled.astype(out_dtype)
 
     grid = ((n_blocks + rows_per_tile - 1) // rows_per_tile,)
     payload, scales = pl.pallas_call(
@@ -138,7 +222,7 @@ def quantize_blocks_pallas(x, block: int = BLOCK, interpret: bool = False):
             pl.BlockSpec((rows_per_tile, 1), lambda i: (i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((n_blocks, block), jnp.float8_e4m3fn),
+            jax.ShapeDtypeStruct((n_blocks, block), out_dtype),
             jax.ShapeDtypeStruct((n_blocks, 1), jnp.float32),
         ],
         interpret=interpret,
@@ -172,23 +256,29 @@ def dequantize_blocks_pallas(payload, scales, interpret: bool = False):
     )(payload, scales.reshape(n_blocks, 1))
 
 
-def quantize_blocks_device(x, block: int = BLOCK):
+def quantize_blocks_device(x, block: int = BLOCK, wire: Optional[str] = None):
     """Device-side quantization of a flat array: pads to a block multiple,
-    returns (payload fp8 (n_blocks, block), scales f32 (n_blocks,)). Uses the
-    Pallas kernel on TPU, a jitted jnp path elsewhere."""
+    returns (payload 8-bit (n_blocks, block), scales f32 (n_blocks,)). Uses
+    the Pallas kernel on TPU, a jitted jnp path elsewhere."""
     import jax
     import jax.numpy as jnp
 
+    wire = wire or default_wire()
     flat = x.reshape(-1)
     pad = (-flat.size) % block
     if pad:
         flat = jnp.concatenate([flat, jnp.zeros(pad, dtype=flat.dtype)])
     blocks = flat.reshape(-1, block).astype(jnp.float32)
     if jax.devices()[0].platform == "tpu":
-        return quantize_blocks_pallas(blocks, block)
+        return quantize_blocks_pallas(blocks, block, wire=wire)
     maxabs = jnp.max(jnp.abs(blocks), axis=1)
-    scales = jnp.where(maxabs > 0, maxabs / FP8_MAX, 1.0).astype(jnp.float32)
-    payload = (blocks / scales[:, None]).astype(jnp.float8_e4m3fn)
+    scales = jnp.where(maxabs > 0, maxabs / _WIRE_QMAX[wire], 1.0).astype(
+        jnp.float32
+    )
+    scaled = blocks / scales[:, None]
+    if wire == "int8":
+        scaled = jnp.round(scaled)
+    payload = scaled.astype(jnp.int8 if wire == "int8" else jnp.float8_e4m3fn)
     return payload, scales
 
 
@@ -204,19 +294,22 @@ def dequantize_blocks_device(payload, scales):
     return out.reshape(-1)
 
 
-def make_tree_fp8_codec(leaves):
+def make_tree_fp8_codec(leaves, wire: Optional[str] = None):
     """Builds a jitted (quantize, dequantize) pair for a fixed list of float
     array leaves: quantize concatenates the leaves and emits (payload,
     scales); dequantize inverts back to per-leaf arrays with the original
-    shapes/dtypes. Shared by the DDP and DiLoCo fp8 device pipelines."""
+    shapes/dtypes. Shared by the DDP and DiLoCo quantized device pipelines;
+    ``wire`` picks the payload format (default: ``TPUFT_WIRE_DTYPE``/fp8 —
+    the name keeps the historical "fp8" even though int8 is also valid)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
 
+    wire = wire or default_wire()
     for leaf in leaves:
         if np.dtype(leaf.dtype).kind not in ("f", "V"):
             raise TypeError(
-                f"fp8 quantized sync requires float leaves, got {leaf.dtype}; "
+                f"quantized sync requires float leaves, got {leaf.dtype}; "
                 "use the unquantized path for integer state"
             )
     sizes = [int(np.prod(leaf.shape)) for leaf in leaves]
@@ -229,7 +322,7 @@ def make_tree_fp8_codec(leaves):
         flat = jnp.concatenate(
             [leaf.astype(jnp.float32).reshape(-1) for leaf in leaves_in]
         )
-        return quantize_blocks_device(flat)
+        return quantize_blocks_device(flat, wire=wire)
 
     def dequantize(payload, scales):
         flat = dequantize_blocks_device(payload, scales)[:total]
@@ -242,13 +335,15 @@ def make_tree_fp8_codec(leaves):
 
 
 def verify_on_chip() -> dict:
-    """Compile (not interpret) the Pallas fp8 kernels on the attached TPU
-    and check them against the host reference codec — the CLAUDE.md
-    'verify kernels on the real chip' gate, automated like
+    """Compile (not interpret) the Pallas codec kernels on the attached TPU
+    — both wire formats — and check them against the host reference codec:
+    the CLAUDE.md 'verify kernels on the real chip' gate, automated like
     flash_attention.verify_on_chip:
 
         python -c "from torchft_tpu.ops.quantization import verify_on_chip; print(verify_on_chip())"
     """
+    import functools
+
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -268,29 +363,38 @@ def verify_on_chip() -> dict:
         ]
     )
     x = jnp.asarray(host)
-    payload, scales = jax.jit(quantize_blocks_device)(x)
-    out = jax.jit(dequantize_blocks_device)(payload, scales)[: host.size]
+    result: dict = {"ok": True}
+    for wire in _WIRE_NP_DTYPES:
+        payload, scales = jax.jit(
+            functools.partial(quantize_blocks_device, wire=wire)
+        )(x)
+        out = jax.jit(dequantize_blocks_device)(payload, scales)[: host.size]
 
-    ref_payload, ref_scales = quantize_blocks(host)
-    ref = dequantize_blocks(ref_payload, ref_scales, host.shape, host.dtype)
+        ref_payload, ref_scales = quantize_blocks(host, wire=wire)
+        ref = dequantize_blocks(ref_payload, ref_scales, host.shape, host.dtype)
 
-    # The kernel must round-trip as accurately as the host codec (both are
-    # bounded by fp8 e4m3 resolution: ~2^-3 relative per block max).
-    err_chip = float(np.max(np.abs(np.asarray(out) - host)))
-    err_host = float(np.max(np.abs(ref - host)))
-    if err_chip > max(err_host * 1.5, 1e-6):
-        raise AssertionError(
-            f"on-chip fp8 codec error {err_chip} vs host reference {err_host}"
+        # The kernel must round-trip as accurately as the host codec (both
+        # are bounded by the 8-bit format's per-block resolution).
+        err_chip = float(np.max(np.abs(np.asarray(out) - host)))
+        err_host = float(np.max(np.abs(ref - host)))
+        if err_chip > max(err_host * 1.5, 1e-6):
+            raise AssertionError(
+                f"on-chip {wire} codec error {err_chip} vs host {err_host}"
+            )
+        # Wire-format compatibility: the device payload must dequantize with
+        # the HOST kernels too (the mixed device/host paths share one
+        # format).
+        mixed = dequantize_blocks(
+            np.asarray(payload).view(_WIRE_NP_DTYPES[wire]),
+            np.asarray(scales).astype(np.float32),
+            host.shape,
+            host.dtype,
         )
-    # Wire-format compatibility: the device payload must dequantize with the
-    # HOST kernels too (the mixed device/host paths share one format).
-    mixed = dequantize_blocks(
-        np.asarray(payload).view(_FP8),
-        np.asarray(scales).astype(np.float32),
-        host.shape,
-        host.dtype,
-    )
-    err_mixed = float(np.max(np.abs(mixed - np.asarray(out))))
-    if err_mixed > 1e-6:
-        raise AssertionError(f"device payload diverges from host decode: {err_mixed}")
-    return {"ok": True, "max_err": err_chip, "host_err": err_host}
+        err_mixed = float(np.max(np.abs(mixed - np.asarray(out))))
+        if err_mixed > 1e-6:
+            raise AssertionError(
+                f"device {wire} payload diverges from host decode: {err_mixed}"
+            )
+        result[f"{wire}_max_err"] = err_chip
+        result[f"{wire}_host_err"] = err_host
+    return result

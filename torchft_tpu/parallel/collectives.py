@@ -1,4 +1,4 @@
-"""Quantized collectives: fp8 allreduce / reduce_scatter over any PG.
+"""Quantized collectives: 8-bit allreduce / reduce_scatter over any PG.
 
 Role-equivalent of the reference's ``torchft/collectives.py:159-415``:
 
@@ -6,10 +6,12 @@ Role-equivalent of the reference's ``torchft/collectives.py:159-415``:
     quantize -> alltoall of per-rank block chunks -> fused local
     dequantize-reduce-requantize -> allgather -> dequantize into outputs
 
-Wire traffic is fp8 payload + f32 per-block scales (~4x smaller than f32),
-both directions. SUM/AVG only, like the reference. The quantization math
-lives in :mod:`torchft_tpu.ops.quantization` (numpy here; Pallas kernels for
-the on-device path).
+Wire traffic is an 8-bit payload (fp8 e4m3 or int8 — ``TPUFT_WIRE_DTYPE``,
+matching the reference's fp8-on-SM90+/int8-below dual format) + f32
+per-block scales, ~4x smaller than f32 both directions. SUM/AVG only, like
+the reference. The quantization math lives in
+:mod:`torchft_tpu.ops.quantization` (numpy here; Pallas kernels for the
+on-device path).
 """
 
 from __future__ import annotations
@@ -38,7 +40,7 @@ _PIPELINE_POOL = ThreadPoolExecutor(max_workers=8, thread_name_prefix="tpuft-qua
 
 
 def _quantize_and_chunk(
-    arrays: Sequence[np.ndarray], world_size: int
+    arrays: Sequence[np.ndarray], world_size: int, wire: str
 ) -> Tuple[List[np.ndarray], List[dict]]:
     """Quantizes each array and splits its blocks into world_size chunks;
     returns per-rank packed wire buffers + per-array recovery metadata."""
@@ -47,7 +49,7 @@ def _quantize_and_chunk(
     per_rank_parts: List[List[np.ndarray]] = [[] for _ in range(world_size)]
     for array in arrays:
         array = np.asarray(array)
-        payload, scales = q.quantize_blocks(array)
+        payload, scales = q.quantize_blocks(array, wire=wire)
         n_blocks = payload.shape[0]
         # Pad the block count so every rank owns an equal chunk.
         pad = (-n_blocks) % world_size
@@ -63,13 +65,14 @@ def _quantize_and_chunk(
                 "dtype": array.dtype,
                 "n_blocks": n_blocks,
                 "blocks_per_rank": blocks_per_rank,
+                "wire": wire,
             }
         )
         for rank in range(world_size):
             lo, hi = rank * blocks_per_rank, (rank + 1) * blocks_per_rank
             per_rank_parts[rank].append(q.pack_arrays(payload[lo:hi], scales[lo:hi]))
-    wire = [np.concatenate(parts) for parts in per_rank_parts]
-    return wire, metas
+    wire_bufs = [np.concatenate(parts) for parts in per_rank_parts]
+    return wire_bufs, metas
 
 
 def _split_wire(buf: np.ndarray, metas: List[dict]) -> List[Tuple[np.ndarray, np.ndarray]]:
@@ -78,8 +81,10 @@ def _split_wire(buf: np.ndarray, metas: List[dict]) -> List[Tuple[np.ndarray, np
     offset = 0
     for meta in metas:
         nb = meta["blocks_per_rank"]
-        length = nb * 4 + nb * q.BLOCK
-        payload, scales = q.unpack_arrays(buf[offset : offset + length], nb)
+        length = q.WIRE_HEADER_BYTES + nb * 4 + nb * q.BLOCK
+        payload, scales = q.unpack_arrays(
+            buf[offset : offset + length], nb, wire=meta["wire"]
+        )
         out.append((payload, scales))
         offset += length
     return out
@@ -89,11 +94,16 @@ def allreduce_quantized(
     arrays: Sequence[np.ndarray],
     reduce_op: ReduceOp,
     pg: ProcessGroup,
+    wire_dtype: str = None,
 ) -> Work:
-    """fp8 allreduce (reference collectives.py:297-415). Resolves to the
-    reduced arrays in their original dtypes/shapes. SUM and AVG only."""
+    """8-bit allreduce (reference collectives.py:297-415). Resolves to the
+    reduced arrays in their original dtypes/shapes. SUM and AVG only;
+    ``wire_dtype`` is "fp8"/"int8" (default ``TPUFT_WIRE_DTYPE``/fp8 — all
+    replicas must agree, exactly as the reference's SM90 autodetect picks
+    one format per job)."""
     if reduce_op not in (ReduceOp.SUM, ReduceOp.AVG):
         raise ValueError(f"unsupported reduce op for quantized allreduce: {reduce_op}")
+    wire_dtype = wire_dtype or q.default_wire()
     arrays = [np.asarray(a) for a in arrays]
     world_size = pg.size()
     rank = pg.rank()
@@ -102,7 +112,7 @@ def allreduce_quantized(
         result = [a.copy() for a in arrays]
         return Work.completed(result)
 
-    wire, metas = _quantize_and_chunk(arrays, world_size)
+    wire, metas = _quantize_and_chunk(arrays, world_size, wire_dtype)
 
     def pipeline() -> List[np.ndarray]:
         # 1. alltoall: rank r receives everyone's chunk r.
@@ -139,18 +149,21 @@ def reduce_scatter_quantized(
     arrays: Sequence[np.ndarray],
     reduce_op: ReduceOp,
     pg: ProcessGroup,
+    wire_dtype: str = None,
 ) -> Work:
-    """fp8 reduce_scatter (reference collectives.py:159-294): each rank gets
-    its chunk of the reduced result (split along blocks, returned flat)."""
+    """8-bit reduce_scatter (reference collectives.py:159-294): each rank
+    gets its chunk of the reduced result (split along blocks, returned
+    flat)."""
     if reduce_op not in (ReduceOp.SUM, ReduceOp.AVG):
         raise ValueError(f"unsupported reduce op for quantized reduce_scatter: {reduce_op}")
+    wire_dtype = wire_dtype or q.default_wire()
     arrays = [np.asarray(a) for a in arrays]
     world_size = pg.size()
 
     if world_size == 1:
         return Work.completed([a.astype(np.float32).reshape(-1) for a in arrays])
 
-    wire, metas = _quantize_and_chunk(arrays, world_size)
+    wire, metas = _quantize_and_chunk(arrays, world_size, wire_dtype)
 
     def pipeline() -> List[np.ndarray]:
         received = pg.alltoall(wire).wait()
@@ -177,15 +190,21 @@ def allreduce_quantized_wire(
 ) -> Work:
     """Allreduce of ALREADY-quantized data, staying quantized end to end.
 
-    The caller quantized on device (Pallas) and ships only fp8 payload +
-    f32 block scales across the host boundary; this exchanges the chunks
-    (alltoall), does the fused dequant-reduce-requant per chunk, allgathers,
-    and resolves to the reduced (payload, scales) pair for device-side
-    dequantization. AVG folds into the scales (free).
+    The caller quantized on device (Pallas) and ships only the 8-bit
+    payload (fp8 or int8 — read from the payload dtype, so explicit-wire
+    codecs never mismatch the env default) + f32 block scales across the
+    host boundary; this exchanges the chunks (alltoall), does the fused
+    dequant-reduce-requant per chunk, allgathers, and resolves to the
+    reduced (payload, scales) pair for device-side dequantization. AVG
+    folds into the scales (free).
     """
     if reduce_op not in (ReduceOp.SUM, ReduceOp.AVG):
         raise ValueError(f"unsupported reduce op: {reduce_op}")
     world_size = pg.size()
+    # The wire format is whatever the caller's device codec produced — read
+    # it from the payload dtype (no fetch needed) so a codec built with an
+    # explicit wire= never mismatches the env default.
+    wire = q.wire_of(payload)
     # Kick off the device→host copies now (non-blocking) so they progress
     # while this call returns and the caller keeps dispatching inner steps.
     prefetch_to_host((payload, scales))
@@ -211,16 +230,16 @@ def allreduce_quantized_wire(
         else:
             payload_p, scales_p = payload_h, scales_h
         blocks_per_rank = payload_p.shape[0] // world_size
-        wire = [
+        wire_bufs = [
             q.pack_arrays(
                 payload_p[r * blocks_per_rank : (r + 1) * blocks_per_rank],
                 scales_p[r * blocks_per_rank : (r + 1) * blocks_per_rank],
             )
             for r in range(world_size)
         ]
-        received = pg.alltoall(wire).wait()
+        received = pg.alltoall(wire_bufs).wait()
         payloads, chunk_scales = zip(
-            *(q.unpack_arrays(buf, blocks_per_rank) for buf in received)
+            *(q.unpack_arrays(buf, blocks_per_rank, wire=wire) for buf in received)
         )
         out_payload, out_scales = q.reduce_quantized(list(payloads), list(chunk_scales))
         if reduce_op == ReduceOp.AVG:
@@ -229,7 +248,7 @@ def allreduce_quantized_wire(
         full_payloads = []
         full_scales = []
         for bufs in gathered:
-            p_chunk, s_chunk = q.unpack_arrays(bufs[0], blocks_per_rank)
+            p_chunk, s_chunk = q.unpack_arrays(bufs[0], blocks_per_rank, wire=wire)
             full_payloads.append(p_chunk)
             full_scales.append(s_chunk)
         payload_out = np.concatenate(full_payloads)[:n_blocks]
