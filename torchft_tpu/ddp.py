@@ -165,9 +165,7 @@ _FP8_CODECS: dict = {}
 def _ft_allreduce_gradients_fp8(manager: Manager, grads: Any) -> Any:
     import jax.numpy as jnp
 
-    from torchft_tpu.ops.quantization import make_tree_fp8_codec
-
-    from torchft_tpu.ops.quantization import default_wire
+    from torchft_tpu.ops.quantization import default_wire, make_tree_fp8_codec
 
     leaves, treedef = jax.tree_util.tree_flatten(grads)
     key = (

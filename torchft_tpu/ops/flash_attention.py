@@ -17,8 +17,10 @@ emits exactly the residuals it needs (out, lse). This keeps the new
 Mosaic-lowered surface to one kernel; following ops/quantization.py's
 convention it is exercised in interpret mode on CPU tests and compiled on
 real TPU. Run :func:`verify_on_chip` on a live chip after any kernel
-change (the CLAUDE.md kernel-verification gate); until that has passed on
-real hardware, "flash" stays opt-in rather than an "auto" choice.
+change (the CLAUDE.md kernel-verification gate — every live-chip bench.py
+run re-executes it). Note "auto" attention (models/llama.py) now SELECTS
+this kernel on real TPU for long sequences, so a kernel edit reaches
+default-configured runs: never ship one without the on-chip gate.
 
 The reference has no attention code at all (SURVEY.md §2.7: long-sequence
 scaling is delegated to torchtitan); this is part of the beyond-reference
@@ -33,6 +35,8 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+
+from torchft_tpu.utils.platform import on_tpu
 
 from torchft_tpu.ops.ring_attention import _blockwise_core_bwd
 
@@ -276,7 +280,7 @@ def flash_attention_partial(
     if scale is None:
         scale = d**-0.5
     if interpret is None:
-        interpret = jax.devices()[0].platform != "tpu"
+        interpret = not on_tpu()
     block_q = min(_next_multiple(int(block_q), 16), _next_multiple(sq, 16))
     block_k = min(_next_multiple(int(block_k), 16), _next_multiple(k.shape[1], 16))
     out, lse = _flash_fwd(
@@ -331,7 +335,7 @@ def flash_attention(
         # helpers: the backend NAME on this machine is "axon" while the
         # device platform is "tpu", and only the latter says whether Mosaic
         # can compile the kernel.
-        interpret = jax.devices()[0].platform != "tpu"
+        interpret = not on_tpu()
     # Align the block size itself (not just the clamp bound) to a multiple
     # of 16 — the sublane tile for bf16 (and a multiple of f32's 8) — then
     # clamp oversized blocks to the padded sequence. A ragged block would

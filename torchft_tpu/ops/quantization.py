@@ -32,6 +32,8 @@ from typing import Optional, Sequence, Tuple
 import ml_dtypes
 import numpy as np
 
+from torchft_tpu.utils.platform import on_tpu
+
 __all__ = [
     "BLOCK",
     "FP8_MAX",
@@ -56,6 +58,17 @@ WIRE_DTYPE_ENV = "TPUFT_WIRE_DTYPE"
 
 _WIRE_NP_DTYPES = {"fp8": np.dtype(_FP8), "int8": np.dtype(np.int8)}
 _WIRE_QMAX = {"fp8": FP8_MAX, "int8": INT8_MAX}
+
+
+def _resolve_wire(wire: "Optional[str]") -> str:
+    """Validates an explicit wire choice; None means the env default."""
+    if wire is None:
+        return default_wire()
+    if wire not in _WIRE_NP_DTYPES:
+        raise ValueError(
+            f"wire={wire!r} is not one of {sorted(_WIRE_NP_DTYPES)}"
+        )
+    return wire
 
 
 def default_wire() -> str:
@@ -88,7 +101,7 @@ def quantize_blocks(
     array: np.ndarray, block: int = BLOCK, wire: Optional[str] = None
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Returns (payload 8-bit (n_blocks, block), scales f32 (n_blocks,))."""
-    wire = wire or default_wire()
+    wire = _resolve_wire(wire)
     flat = np.ascontiguousarray(array).astype(np.float32).reshape(-1)
     blocks = _as_blocks(flat, block)
     maxabs = np.max(np.abs(blocks), axis=1)
@@ -194,7 +207,7 @@ def quantize_blocks_pallas(
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
-    wire = wire or default_wire()
+    wire = _resolve_wire(wire)
     qmax = _WIRE_QMAX[wire]
     out_dtype = jnp.int8 if wire == "int8" else jnp.float8_e4m3fn
     n_blocks = x.shape[0]
@@ -263,13 +276,13 @@ def quantize_blocks_device(x, block: int = BLOCK, wire: Optional[str] = None):
     import jax
     import jax.numpy as jnp
 
-    wire = wire or default_wire()
+    wire = _resolve_wire(wire)
     flat = x.reshape(-1)
     pad = (-flat.size) % block
     if pad:
         flat = jnp.concatenate([flat, jnp.zeros(pad, dtype=flat.dtype)])
     blocks = flat.reshape(-1, block).astype(jnp.float32)
-    if jax.devices()[0].platform == "tpu":
+    if on_tpu():
         return quantize_blocks_pallas(blocks, block, wire=wire)
     maxabs = jnp.max(jnp.abs(blocks), axis=1)
     scales = jnp.where(maxabs > 0, maxabs / _WIRE_QMAX[wire], 1.0).astype(
@@ -287,7 +300,7 @@ def dequantize_blocks_device(payload, scales):
     import jax
     import jax.numpy as jnp
 
-    if jax.devices()[0].platform == "tpu":
+    if on_tpu():
         out = dequantize_blocks_pallas(payload, scales)
     else:
         out = payload.astype(jnp.float32) * scales[:, None]
@@ -305,7 +318,7 @@ def make_tree_fp8_codec(leaves, wire: Optional[str] = None):
     import jax.numpy as jnp
     import numpy as np
 
-    wire = wire or default_wire()
+    wire = _resolve_wire(wire)
     for leaf in leaves:
         if np.dtype(leaf.dtype).kind not in ("f", "V"):
             raise TypeError(

@@ -94,7 +94,7 @@ def allreduce_quantized(
     arrays: Sequence[np.ndarray],
     reduce_op: ReduceOp,
     pg: ProcessGroup,
-    wire_dtype: str = None,
+    wire_dtype: "str | None" = None,
 ) -> Work:
     """8-bit allreduce (reference collectives.py:297-415). Resolves to the
     reduced arrays in their original dtypes/shapes. SUM and AVG only;
@@ -103,7 +103,7 @@ def allreduce_quantized(
     one format per job)."""
     if reduce_op not in (ReduceOp.SUM, ReduceOp.AVG):
         raise ValueError(f"unsupported reduce op for quantized allreduce: {reduce_op}")
-    wire_dtype = wire_dtype or q.default_wire()
+    wire_dtype = q._resolve_wire(wire_dtype)
     arrays = [np.asarray(a) for a in arrays]
     world_size = pg.size()
     rank = pg.rank()
@@ -112,11 +112,11 @@ def allreduce_quantized(
         result = [a.copy() for a in arrays]
         return Work.completed(result)
 
-    wire, metas = _quantize_and_chunk(arrays, world_size, wire_dtype)
+    wire_bufs, metas = _quantize_and_chunk(arrays, world_size, wire_dtype)
 
     def pipeline() -> List[np.ndarray]:
         # 1. alltoall: rank r receives everyone's chunk r.
-        received = pg.alltoall(wire).wait()
+        received = pg.alltoall(wire_bufs).wait()
         # 2. fused dequant-reduce-requant per array chunk.
         per_rank = [_split_wire(buf, metas) for buf in received]
         my_reduced: List[np.ndarray] = []
@@ -149,24 +149,24 @@ def reduce_scatter_quantized(
     arrays: Sequence[np.ndarray],
     reduce_op: ReduceOp,
     pg: ProcessGroup,
-    wire_dtype: str = None,
+    wire_dtype: "str | None" = None,
 ) -> Work:
     """8-bit reduce_scatter (reference collectives.py:159-294): each rank
     gets its chunk of the reduced result (split along blocks, returned
     flat)."""
     if reduce_op not in (ReduceOp.SUM, ReduceOp.AVG):
         raise ValueError(f"unsupported reduce op for quantized reduce_scatter: {reduce_op}")
-    wire_dtype = wire_dtype or q.default_wire()
+    wire_dtype = q._resolve_wire(wire_dtype)
     arrays = [np.asarray(a) for a in arrays]
     world_size = pg.size()
 
     if world_size == 1:
         return Work.completed([a.astype(np.float32).reshape(-1) for a in arrays])
 
-    wire, metas = _quantize_and_chunk(arrays, world_size, wire_dtype)
+    wire_bufs, metas = _quantize_and_chunk(arrays, world_size, wire_dtype)
 
     def pipeline() -> List[np.ndarray]:
-        received = pg.alltoall(wire).wait()
+        received = pg.alltoall(wire_bufs).wait()
         per_rank = [_split_wire(buf, metas) for buf in received]
         outputs: List[np.ndarray] = []
         for idx, meta in enumerate(metas):

@@ -6,7 +6,19 @@ import os
 import subprocess
 import sys
 
-__all__ = ["honor_jax_platforms_env", "probe_accelerator"]
+__all__ = ["honor_jax_platforms_env", "on_tpu", "probe_accelerator"]
+
+
+def on_tpu() -> bool:
+    """True when the default device's PLATFORM is TPU.
+
+    The backend NAME can differ (e.g. the remote-tunnel backend is "axon"
+    while its device platform is "tpu"), and only the platform says whether
+    Mosaic can compile Pallas kernels — every TPU-vs-elsewhere dispatch
+    must use this check, held here once."""
+    import jax
+
+    return jax.devices()[0].platform == "tpu"
 
 
 def probe_accelerator(timeout: float = 180.0) -> bool:
