@@ -271,10 +271,14 @@ def test_quorum_and_commit_timeout_paths_are_fast(lighthouse) -> None:
         store.shutdown()
 
 
-def test_ddp_fp8_gradient_sync_two_groups(lighthouse) -> None:
+def test_ddp_fp8_gradient_sync_two_groups(lighthouse, monkeypatch) -> None:
     """fp8 device-quantized DDP gradient sync: converges across groups within
-    quantization tolerance and stays bitwise identical between replicas."""
+    quantization tolerance and stays bitwise identical between replicas.
+    The tiny bucket cap forces the quantized path through MULTIPLE pipelined
+    wire messages (one per bucket), not one staged payload."""
     import threading
+
+    monkeypatch.setenv("TPUFT_BUCKET_MB", "0.001")
 
     from torchft_tpu.ddp import ft_allreduce_gradients
     from torchft_tpu.manager import Manager

@@ -158,44 +158,90 @@ def ft_allreduce_gradients(
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
-# One jitted (quantize, dequantize) codec per gradient pytree structure.
+# One jitted (quantize, dequantize) codec per bucket leaf-set + wire format.
 _FP8_CODECS: dict = {}
 
 
-def _ft_allreduce_gradients_fp8(manager: Manager, grads: Any) -> Any:
-    import jax.numpy as jnp
+def _bucket_codec(bucket_leaves: List[Any], wire: str):
+    from torchft_tpu.ops.quantization import make_tree_fp8_codec
 
-    from torchft_tpu.ops.quantization import default_wire, make_tree_fp8_codec
-
-    leaves, treedef = jax.tree_util.tree_flatten(grads)
     key = (
-        treedef,
-        default_wire(),  # env can flip between calls (tests do)
-        tuple((leaf.shape, str(leaf.dtype)) for leaf in leaves),
+        wire,
+        tuple((leaf.shape, str(leaf.dtype)) for leaf in bucket_leaves),
     )
     codec = _FP8_CODECS.get(key)
     if codec is None:
         # Pass the wire captured in the key: a second env read inside the
         # codec could race a concurrent flip and cache a mismatched codec.
-        codec = make_tree_fp8_codec(leaves, wire=key[1])
+        codec = make_tree_fp8_codec(bucket_leaves, wire=wire)
         _FP8_CODECS[key] = codec
-    quantize, dequantize = codec
+    return codec
 
-    payload, scales = quantize(leaves)
-    result = manager.allreduce_prequantized(payload, scales).wait()
-    if result is None:
-        # Allreduce failed (error already reported; the step will not
-        # commit): hand back the local gradients, same contract as above.
-        return grads
-    avg_payload, avg_scales = result
-    averaged = dequantize(jnp.asarray(avg_payload), jnp.asarray(avg_scales))
-    # Restore the inputs' shardings/devices (contract: outputs live where
-    # the inputs lived, so the jitted optimizer update never retraces).
-    averaged = [
-        jax.device_put(avg, leaf.sharding) if isinstance(leaf, jax.Array) else avg
-        for avg, leaf in zip(averaged, leaves)
-    ]
-    return jax.tree_util.tree_unflatten(treedef, averaged)
+
+def _ft_allreduce_gradients_fp8(manager: Manager, grads: Any) -> Any:
+    """Quantized sync, bucketed: all buckets' device quantizes + async d2h
+    copies launch up front (they overlap each other and the wire), then the
+    wire exchanges run STRICTLY in flatten order, one at a time, on a
+    per-call single worker — while the caller dequantizes bucket k, the
+    worker runs bucket k+1's exchange.
+
+    The wire phases must not overlap each other: the PG collectives are
+    order-matched byte streams with no op tags, so concurrent bucket
+    pipelines could enqueue their ops in different orders on different
+    replicas and average mismatched buckets (or desync the stream). The
+    single FIFO worker pins the op order to flatten order on every replica.
+    It is per-call (not module-level) because threads-as-replicas tests run
+    several replica groups in one process — a shared worker would serialize
+    group A's exchange ahead of group B's, and A's collective cannot
+    complete until B reaches it: deadlock."""
+    import concurrent.futures
+
+    import jax.numpy as jnp
+
+    from torchft_tpu.ops.quantization import default_wire
+
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    wire = default_wire()  # read once: env can flip between calls (tests do)
+    buckets = _plan_buckets(leaves, _bucket_cap_bytes())
+
+    quantized = []
+    for members in buckets:
+        bucket_leaves = [leaves[i] for i in members]
+        quantize, dequantize = _bucket_codec(bucket_leaves, wire)
+        payload, scales = quantize(bucket_leaves)
+        prefetch_to_host((payload, scales))
+        quantized.append((members, dequantize, payload, scales))
+
+    out: List[Any] = [None] * len(leaves)
+    wire_worker = concurrent.futures.ThreadPoolExecutor(
+        max_workers=1, thread_name_prefix="tpuft-fp8-order"
+    )
+    try:
+        futures = [
+            wire_worker.submit(
+                lambda p=payload, s=scales: manager.allreduce_prequantized(p, s).wait()
+            )
+            for members, dequantize, payload, scales in quantized
+        ]
+        for (members, dequantize, _, _), future in zip(quantized, futures):
+            result = future.result()
+            if result is None:
+                # Allreduce failed (error already reported; the step will
+                # not commit): hand back the local gradients, same contract
+                # as above.
+                return grads
+            avg_payload, avg_scales = result
+            averaged = dequantize(jnp.asarray(avg_payload), jnp.asarray(avg_scales))
+            for slot, i in enumerate(members):
+                leaf = leaves[i]
+                out[i] = (
+                    jax.device_put(averaged[slot], leaf.sharding)
+                    if isinstance(leaf, jax.Array)
+                    else averaged[slot]
+                )
+    finally:
+        wire_worker.shutdown(wait=False)
+    return jax.tree_util.tree_unflatten(treedef, out)
 
 
 class DistributedDataParallel:
