@@ -1,0 +1,207 @@
+#!/usr/bin/env python
+"""Opportunistic real-TPU artifact capture.
+
+The remote-chip relay on this machine flaps on hour scales (three failure
+modes, CLAUDE.md "Environment quirks"), so an end-of-round-only benchmark
+attempt keeps losing the coin flip. This sentinel inverts that: it reprobes
+the accelerator every ``TPUFT_SENTINEL_INTERVAL`` seconds (default 20 min)
+and, the moment a probe succeeds, captures the on-chip evidence in order of
+increasing runtime — committing each artifact to git IMMEDIATELY so a
+mid-run relay death cannot erase what was already measured:
+
+  1. ONCHIP_VERIFY.json        — flash_attention + quantization
+                                 verify_on_chip() (the Mosaic-lowering gate)
+  2. KERNEL_BENCH_TPU.json     — Pallas kernel microbenchmarks vs XLA dense
+  3. BENCH_TPU_OPPORTUNISTIC.json — bench.py, default config, on-chip
+  4. BENCH_TPU_LARGE.json      — bench.py, ~400M-param flash config (MFU)
+
+Every measurement runs in a deadline-bounded child subprocess (stdout to a
+file, never a pipe — a wedged relay leaves grandchildren holding pipe fds)
+because the relay can die mid-run after probing healthy. The sentinel exits
+once all artifacts exist, or keeps probing until killed.
+
+Usage: nohup python scripts/tpu_sentinel.py >> scripts/sentinel.log 2>&1 &
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+INTERVAL = float(os.environ.get("TPUFT_SENTINEL_INTERVAL", "1200"))
+
+
+def _log(msg: str) -> None:
+    print(f"[sentinel {time.strftime('%H:%M:%S')}] {msg}", flush=True)
+
+
+def _git_commit(path: Path, message: str) -> None:
+    """Commit one artifact file, retrying around a concurrent index.lock."""
+    for attempt in range(10):
+        add = subprocess.run(
+            ["git", "add", str(path)], cwd=REPO, capture_output=True, text=True
+        )
+        if add.returncode == 0:
+            commit = subprocess.run(
+                ["git", "commit", "-m", message, "--", str(path)],
+                cwd=REPO,
+                capture_output=True,
+                text=True,
+            )
+            if commit.returncode == 0:
+                _log(f"committed {path.name}")
+                return
+            # "nothing to commit" when the file is unchanged — fine.
+            if "nothing to commit" in commit.stdout + commit.stderr:
+                return
+            _log(f"commit retry {attempt}: {commit.stderr.strip()[:200]}")
+        time.sleep(3.0)
+    _log(f"GAVE UP committing {path.name} (left in working tree)")
+
+
+def _run_child(
+    argv: list[str], deadline: float, env_extra: dict | None = None
+) -> "tuple[int, str] | None":
+    """Run argv with a hard deadline; return (returncode, stdout) or None."""
+    env = dict(os.environ)
+    env.update(env_extra or {})
+    with tempfile.NamedTemporaryFile(mode="w+", suffix="_sentinel.out") as out:
+        try:
+            proc = subprocess.run(argv, cwd=REPO, timeout=deadline, stdout=out, env=env)
+        except subprocess.TimeoutExpired:
+            _log(f"child {' '.join(argv[:3])}... exceeded {deadline}s deadline")
+            return None
+        out.seek(0)
+        return proc.returncode, out.read()
+
+
+_VERIFY_SRC = """
+import json, time
+out = {"device_kind": None, "captured_unix": time.time()}
+import jax
+dev = jax.devices()[0]
+out["device_kind"] = str(getattr(dev, "device_kind", dev.platform))
+out["platform"] = dev.platform
+from torchft_tpu.ops import flash_attention, quantization
+t0 = time.monotonic()
+out["flash"] = flash_attention.verify_on_chip()
+out["flash_s"] = round(time.monotonic() - t0, 1)
+t0 = time.monotonic()
+out["quant"] = quantization.verify_on_chip()
+out["quant_s"] = round(time.monotonic() - t0, 1)
+out["ok"] = bool(out["flash"].get("ok")) and bool(out["quant"].get("ok"))
+print(json.dumps(out))
+"""
+
+
+def _json_lines(res: "tuple[int, str] | None") -> list[dict]:
+    rows = []
+    text = res[1] if res else ""
+    for raw in text.splitlines():
+        raw = raw.strip()
+        if raw.startswith("{"):
+            try:
+                rows.append(json.loads(raw))
+            except json.JSONDecodeError:
+                pass
+    return rows
+
+
+def capture_verify(path: Path) -> bool:
+    res = _run_child([sys.executable, "-c", _VERIFY_SRC], deadline=1500.0)
+    rows = _json_lines(res)
+    if rows and rows[-1].get("ok"):
+        path.write_text(json.dumps(rows[-1], indent=2) + "\n")
+        _git_commit(path, "Capture on-chip Pallas kernel verification (flash + fp8/int8 codecs)")
+        return True
+    _log(f"verify_on_chip failed: {rows[-1] if rows else 'no JSON'}")
+    return False
+
+
+def capture_kernel_bench(path: Path) -> bool:
+    res = _run_child(
+        [sys.executable, "benchmarks/kernel_bench.py"],
+        deadline=2400.0,
+        env_extra={"TPUFT_LOG": "warn"},
+    )
+    rows = _json_lines(res)
+    # A mid-run relay death leaves partial rows with a nonzero exit and no
+    # terminal summary row — committing that would freeze incomplete
+    # evidence as "done". Require a clean exit AND the summary sentinel.
+    if res and res[0] == 0 and rows and rows[-1].get("bench") == "summary":
+        path.write_text(json.dumps(rows, indent=2) + "\n")
+        _git_commit(path, "Capture on-chip Pallas kernel microbenchmarks")
+        return True
+    _log(f"kernel_bench incomplete (rc={res[0] if res else None}, rows={len(rows)})")
+    return False
+
+
+def capture_bench(path: Path, large: bool) -> bool:
+    env = {"TPUFT_BENCH_CHILD": "tpu", "TPUFT_LOG": "warn"}
+    if large:
+        env["TPUFT_BENCH_MODEL"] = "large"
+        # The ~400M-param config compiles a much bigger program and moves far
+        # more bytes over the ~32MB/s tunnel than the default config the base
+        # deadline was sized for — give it its own, larger bound.
+        deadline = float(os.environ.get("TPUFT_BENCH_TPU_DEADLINE_LARGE", "3600"))
+    else:
+        deadline = float(os.environ.get("TPUFT_BENCH_TPU_DEADLINE", "2400"))
+    res = _run_child([sys.executable, "bench.py"], deadline=deadline, env_extra=env)
+    rows = [r for r in _json_lines(res) if "metric" in r]
+    if rows and not rows[-1].get("degraded_cpu_fallback"):
+        row = rows[-1]
+        row["captured_unix"] = time.time()
+        path.write_text(json.dumps(row, indent=2) + "\n")
+        tag = "large/MFU config" if large else "default config"
+        _git_commit(path, f"Capture opportunistic real-TPU benchmark ({tag})")
+        return True
+    _log(f"bench (large={large}) produced no usable JSON")
+    return False
+
+
+def main() -> None:
+    targets = [
+        (REPO / "ONCHIP_VERIFY.json", lambda p: capture_verify(p)),
+        (REPO / "KERNEL_BENCH_TPU.json", lambda p: capture_kernel_bench(p)),
+        (REPO / "BENCH_TPU_OPPORTUNISTIC.json", lambda p: capture_bench(p, large=False)),
+        (REPO / "BENCH_TPU_LARGE.json", lambda p: capture_bench(p, large=True)),
+    ]
+    from torchft_tpu.utils.platform import probe_accelerator
+
+    while True:
+        pending = [(p, fn) for p, fn in targets if not p.exists()]
+        if not pending:
+            _log("all artifacts captured; sentinel done")
+            return
+        _log(f"probing accelerator ({len(pending)} artifacts pending)")
+        if probe_accelerator(timeout=180.0):
+            _log("probe OK — capturing")
+            captured_all = True
+            for path, fn in pending:
+                if not fn(path):
+                    captured_all = False
+                    # Distinguish a relay death (stop; everything else will
+                    # also fail, each burning its full deadline) from a
+                    # deterministic failure in THIS target (move on so one
+                    # broken target can't starve the rest forever).
+                    if not probe_accelerator(timeout=180.0):
+                        _log("relay died mid-capture; back to sleep")
+                        break
+                    _log(f"{path.name} failed with relay healthy; trying next target")
+            if captured_all:
+                continue  # recheck pending now; exits without a final sleep
+        else:
+            _log("probe failed")
+        time.sleep(INTERVAL)
+
+
+if __name__ == "__main__":
+    main()
