@@ -213,3 +213,19 @@ def test_make_step_fn_uses_wire_path_when_not_lone():
     np.testing.assert_allclose(
         np.asarray(opt.params["w"]), np.array([0.9, 0.8], np.float32), rtol=1e-6
     )
+
+
+def test_fp8_wire_worker_cached_per_manager_and_released_on_shutdown():
+    """The FIFO wire worker is reused across steps for one manager (no
+    per-step thread churn — round-2 advisor) and torn down by
+    Manager.shutdown even while the manager object stays referenced."""
+    import torchft_tpu.ddp as ddp_mod
+
+    manager = scripted_manager()
+    w1 = ddp_mod._wire_worker_for(manager)
+    w2 = ddp_mod._wire_worker_for(manager)
+    assert w1 is w2
+    assert w1.submit(lambda: 7).result() == 7
+    manager.shutdown(wait=False)
+    with pytest.raises(RuntimeError):  # executor refused after shutdown
+        w1.submit(lambda: 0)

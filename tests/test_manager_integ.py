@@ -115,9 +115,15 @@ def test_quorum_latency_north_star(lighthouse) -> None:
 
     # Lighthouse tick is 100ms (native default, matching the reference's
     # quorum_tick_ms); fast quorum resolves without waiting a full tick.
+    # Bounded retry: exactly one re-measure to damp transient 1-core machine
+    # load, the first value is logged, and the SECOND measurement is
+    # asserted strictly — a retry loop that hides a real regression is a
+    # weaker invariant than the reference's hard bound
+    # (manager_integ_test.py:539-551).
     p50_ms = measure()
     if p50_ms >= 200.0:
-        p50_ms = measure()  # damp transient machine load
+        print(f"first quorum p50 measurement {p50_ms:.1f}ms >= 200ms; re-measuring once")
+        p50_ms = measure()
     assert p50_ms < 200.0, f"steady-state quorum p50 {p50_ms:.1f}ms >= 2x tick"
 
 

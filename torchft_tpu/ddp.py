@@ -18,6 +18,8 @@ and the optimizer:
 
 from __future__ import annotations
 
+import threading
+import weakref
 from typing import Any, List
 
 import jax
@@ -26,6 +28,10 @@ import numpy as np
 from torchft_tpu.manager import Manager
 from torchft_tpu.utils.transfer import prefetch_to_host
 from torchft_tpu.work import Work
+
+# One FIFO wire worker per Manager (see _wire_worker_for).
+_WIRE_WORKERS: "weakref.WeakKeyDictionary[Manager, Any]" = weakref.WeakKeyDictionary()
+_WIRE_WORKERS_LOCK = threading.Lock()
 
 __all__ = [
     "ft_allreduce_gradients",
@@ -178,11 +184,39 @@ def _bucket_codec(bucket_leaves: List[Any], wire: str):
     return codec
 
 
+def _wire_worker_for(manager: Manager):
+    """The single FIFO wire worker for one Manager (= one replica group).
+
+    One worker per GROUP, not per process: threads-as-replicas tests run
+    several replica groups in one process, and a shared worker would
+    serialize group A's exchange ahead of group B's while A's collective
+    cannot complete until B reaches it — deadlock. One worker per group,
+    not per CALL: the old per-call executor added thread create/destroy
+    churn to every training step (round-2 advisor). Torn down by
+    Manager.shutdown (a retired manager held by a fixture list must not
+    leak its idle thread), with a GC finalizer as the backstop for
+    managers that are dropped without shutdown."""
+    import concurrent.futures
+
+    with _WIRE_WORKERS_LOCK:
+        worker = _WIRE_WORKERS.get(manager)
+        if worker is None:
+            worker = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="tpuft-fp8-order"
+            )
+            _WIRE_WORKERS[manager] = worker
+            manager.register_shutdown_hook(
+                lambda w=worker: w.shutdown(wait=False)
+            )
+            weakref.finalize(manager, worker.shutdown, wait=False)
+        return worker
+
+
 def _ft_allreduce_gradients_fp8(manager: Manager, grads: Any) -> Any:
     """Quantized sync, bucketed: all buckets' device quantizes + async d2h
     copies launch up front (they overlap each other and the wire), then the
-    wire exchanges run STRICTLY in flatten order, one at a time, on a
-    per-call single worker — while the caller dequantizes bucket k, the
+    wire exchanges run STRICTLY in flatten order, one at a time, on the
+    group's single FIFO worker — while the caller dequantizes bucket k, the
     worker runs bucket k+1's exchange.
 
     The wire phases must not overlap each other: the PG collectives are
@@ -190,10 +224,11 @@ def _ft_allreduce_gradients_fp8(manager: Manager, grads: Any) -> Any:
     pipelines could enqueue their ops in different orders on different
     replicas and average mismatched buckets (or desync the stream). The
     single FIFO worker pins the op order to flatten order on every replica.
-    It is per-call (not module-level) because threads-as-replicas tests run
-    several replica groups in one process — a shared worker would serialize
-    group A's exchange ahead of group B's, and A's collective cannot
-    complete until B reaches it: deadlock."""
+
+    No wire op may outlive the step boundary: on a failed bucket the
+    remaining queued exchanges are cancelled and the in-flight one drained
+    before returning, so a stale bucket can never enqueue a collective on a
+    freshly reconfigured PG out of lockstep with peers (round-2 advisor)."""
     import concurrent.futures
 
     import jax.numpy as jnp
@@ -213,16 +248,14 @@ def _ft_allreduce_gradients_fp8(manager: Manager, grads: Any) -> Any:
         quantized.append((members, dequantize, payload, scales))
 
     out: List[Any] = [None] * len(leaves)
-    wire_worker = concurrent.futures.ThreadPoolExecutor(
-        max_workers=1, thread_name_prefix="tpuft-fp8-order"
-    )
+    wire_worker = _wire_worker_for(manager)
+    futures = [
+        wire_worker.submit(
+            lambda p=payload, s=scales: manager.allreduce_prequantized(p, s).wait()
+        )
+        for members, dequantize, payload, scales in quantized
+    ]
     try:
-        futures = [
-            wire_worker.submit(
-                lambda p=payload, s=scales: manager.allreduce_prequantized(p, s).wait()
-            )
-            for members, dequantize, payload, scales in quantized
-        ]
         for (members, dequantize, _, _), future in zip(quantized, futures):
             result = future.result()
             if result is None:
@@ -240,7 +273,13 @@ def _ft_allreduce_gradients_fp8(manager: Manager, grads: Any) -> Any:
                     else averaged[slot]
                 )
     finally:
-        wire_worker.shutdown(wait=False)
+        # Success: every future is done — cancel/wait are no-ops. Failure:
+        # cancel the queued exchanges and drain the in-flight one (its PG op
+        # carries its own timeout) so the worker is quiescent at the step
+        # boundary and reusable next step.
+        for f in futures:
+            f.cancel()
+        concurrent.futures.wait(futures)
     return jax.tree_util.tree_unflatten(treedef, out)
 
 

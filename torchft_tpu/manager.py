@@ -88,10 +88,19 @@ class WorldSizeMode(Enum):
 
 
 class ExceptionWithTraceback(Exception):
+    """Carries a worker-thread exception across the report_error funnel with
+    its formatted stack attached, so the thread hop cannot strand the
+    traceback (reference manager.py:130-134 behavior).
+
+    Formats from the exception's own ``__traceback__`` rather than the
+    ambient ``format_exc`` state, so wrapping works from any thread — not
+    only inside the original ``except`` block."""
+
     def __init__(self, e: Exception) -> None:
+        tb = "".join(traceback.format_exception(type(e), e, e.__traceback__))
+        super().__init__(f"{e}\n{tb}")
         self.original_exception = e
-        self.stack_trace: str = traceback.format_exc()
-        super().__init__(f"{e}\n{self.stack_trace}")
+        self.stack_trace: str = tb
 
 
 class Manager:
@@ -183,6 +192,7 @@ class Manager:
 
         # Per-step error/heal state.
         self._errored: Optional[ExceptionWithTraceback] = None
+        self._shutdown_hooks: List[Callable[[], None]] = []
         self._healing = False
         self._pending_state_dict: Optional[Dict[str, Any]] = None
 
@@ -258,7 +268,23 @@ class Manager:
     def allow_state_dict_read(self) -> None:
         self._state_dict_lock.w_release()
 
+    def register_shutdown_hook(self, hook: Callable[[], None]) -> None:
+        """Runs ``hook`` during :meth:`shutdown` (before the executor stops).
+
+        Lets higher layers tie per-manager resources (e.g. ddp's cached fp8
+        wire worker) to the manager's explicit lifecycle instead of garbage
+        collection — a shut-down manager held by a fixture list must not
+        leak threads. Hooks run at most once; errors are swallowed so one
+        failing hook cannot block teardown."""
+        self._shutdown_hooks.append(hook)
+
     def shutdown(self, wait: bool = True) -> None:
+        hooks, self._shutdown_hooks = self._shutdown_hooks, []
+        for hook in hooks:
+            try:
+                hook()
+            except Exception:
+                pass
         self._checkpoint_transport.shutdown(wait=wait)
         if self._manager is not None:
             self._manager.shutdown()

@@ -21,7 +21,6 @@ TPU-first choices:
 
 from __future__ import annotations
 
-import warnings
 from dataclasses import dataclass
 from functools import partial
 from typing import Any, Dict, Optional, Tuple
@@ -138,37 +137,22 @@ class RMSNorm(nn.Module):
 
 
 def _sp_axis_in_mesh(axis: str) -> bool:
-    """True when the ambient mesh has `axis` with size > 1.
+    """True when the ambient abstract mesh binds ``axis`` with size > 1.
 
-    Checks the modern accessors (``jax.sharding.set_mesh``/``use_mesh``)
-    first, then the legacy ``with mesh:`` context. If neither context API is
-    available the fallback is LOUD — silently choosing per-shard local
-    attention under an sp mesh would produce wrong results with no error
-    (round-1 advisor finding)."""
-    # get_abstract_mesh sees set_mesh/use_mesh contexts both inside and
-    # outside jit tracing (get_mesh raises under a jit trace).
+    Reads only the public ``jax.sharding.get_abstract_mesh`` accessor, which
+    sees every context the ring path can actually execute in: shard_map
+    tracing (Manual axes — the only place ``lax.ppermute(axis_name=...)``
+    is bound) and ``jax.set_mesh``/``use_mesh`` scopes. A legacy
+    ``with mesh:`` block alone is invisible here, but it also cannot bind
+    the collective axis name ring attention requires — under it ``auto``
+    correctly computes local attention, and an explicit
+    ``attention_impl='ring'`` fails loudly at trace time with an
+    unbound-axis-name error (test_models.py asserts that loud path) rather
+    than silently returning per-shard results."""
     abstract = jax.sharding.get_abstract_mesh()
-    if abstract is not None and axis in abstract.axis_names:
-        return abstract.shape[axis] > 1
-    # Legacy `with mesh:` contexts only publish through thread_resources; the
-    # public alias (jax.interpreters.pxla) is deprecated, so read the source
-    # object. When a future jax drops it entirely, warn instead of silently
-    # assuming "no sp axis".
-    try:
-        from jax._src.mesh import thread_resources
-
-        env_mesh = thread_resources.env.physical_mesh
-    except (ImportError, AttributeError):
-        warnings.warn(
-            "cannot detect a legacy with-Mesh context on this jax version; "
-            "attention_impl='auto' is assuming no sequence-parallel axis. "
-            "Pass attention_impl='ring' explicitly when running under an "
-            "sp-sharded mesh.",
-            RuntimeWarning,
-            stacklevel=2,
-        )
+    if abstract is None or axis not in getattr(abstract, "axis_names", ()):
         return False
-    return axis in env_mesh.axis_names and env_mesh.shape[axis] > 1
+    return abstract.shape[axis] > 1
 
 
 def causal_attention(
