@@ -110,16 +110,31 @@ def bench_attention(results: list) -> None:
         results.append(row)
         print(json.dumps(row))
 
-        # fwd+bwd through the kernel's custom VJP.
+        # fwd+bwd through the kernel's custom VJP: the default on-chip path
+        # (fused Pallas dq/dkv backward), the scan-based blockwise backward
+        # it replaced, and dense.
         def loss_flash(q, k, v):
             return flash_attention(q, k, v, interpret=False).astype(jnp.float32).sum()
+
+        def loss_flash_scan_bwd(q, k, v):
+            return (
+                flash_attention(q, k, v, interpret=False, use_pallas_bwd=False)
+                .astype(jnp.float32)
+                .sum()
+            )
 
         def loss_dense(q, k, v):
             return causal_attention(q, k, v, scale=d**-0.5).astype(jnp.float32).sum()
 
         gflash = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))
+        gscan = jax.jit(jax.grad(loss_flash_scan_bwd, argnums=(0, 1, 2)))
         gdense = jax.jit(jax.grad(loss_dense, argnums=(0, 1, 2)))
         t_gflash = _timed(gflash, q, k, v, fetch=lambda g: g[0])
+        try:
+            t_gscan = _timed(gscan, q, k, v, fetch=lambda g: g[0])
+        except Exception as e:
+            sys.stderr.write(f"kernel_bench: scan bwd s={s} failed: {e}\n")
+            t_gscan = None
         try:
             t_gdense = _timed(gdense, q, k, v, fetch=lambda g: g[0])
         except Exception as e:
@@ -129,9 +144,13 @@ def bench_attention(results: list) -> None:
             "bench": "attention_fwd_bwd",
             "seq": s,
             "flash_ms": round(1e3 * t_gflash, 3),
-            "dense_ms": round(1e3 * t_gdense, 3) if t_gdense else None,
+            "scan_bwd_ms": round(1e3 * t_gscan, 3) if t_gscan is not None else None,
+            "dense_ms": round(1e3 * t_gdense, 3) if t_gdense is not None else None,
+            "speedup_vs_scan_bwd": (
+                round(t_gscan / t_gflash, 3) if t_gscan is not None else None
+            ),
             "speedup_vs_dense": (
-                round(t_gdense / t_gflash, 3) if t_gdense else None
+                round(t_gdense / t_gflash, 3) if t_gdense is not None else None
             ),
         }
         results.append(row)

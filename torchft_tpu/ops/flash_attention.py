@@ -10,17 +10,22 @@ ride the MXU back-to-back (pallas_guide.md: grid iterated sequentially on
 TPU with the last axis minor, which makes cross-grid-step VMEM scratch the
 canonical accumulation pattern).
 
-Scope: forward only. The backward pass reuses the flash-style custom_vjp
-backward already verified for ``blockwise_attention`` (recompute
-probabilities per block from the saved logsumexp) — the Pallas forward
-emits exactly the residuals it needs (out, lse). This keeps the new
-Mosaic-lowered surface to one kernel; following ops/quantization.py's
-convention it is exercised in interpret mode on CPU tests and compiled on
-real TPU. Run :func:`verify_on_chip` on a live chip after any kernel
-change (the CLAUDE.md kernel-verification gate — every live-chip bench.py
-run re-executes it). Note "auto" attention (models/llama.py) now SELECTS
-this kernel on real TPU for long sequences, so a kernel edit reaches
-default-configured runs: never ship one without the on-chip gate.
+Forward AND backward are fused Pallas kernels on TPU. The backward is the
+standard FlashAttention-2 two-pass recompute from the saved (out, lse)
+residuals: a dq kernel accumulating over KV blocks and a dkv kernel
+accumulating over Q blocks, with the per-row ``delta = rowsum(dO*O)``
+identity computed by XLA outside the kernels (it fuses into the
+surrounding graph). GQA is handled by emitting per-q-head dk/dv partials
+and summing over the group axis outside — keeps every output block
+written exactly once per grid pass (no cross-step output aliasing, which
+Mosaic cannot express). The scan-based blockwise backward remains the
+interpret/CPU fallback (``use_pallas_bwd`` selects; CPU tests run the
+Pallas backward in interpret mode explicitly). Run :func:`verify_on_chip`
+on a live chip after any kernel change (the CLAUDE.md kernel-verification
+gate — every live-chip bench.py run re-executes it, forward and backward).
+Note "auto" attention (models/llama.py) SELECTS this kernel on real TPU
+for long sequences, so a kernel edit reaches default-configured runs:
+never ship one without the on-chip gate.
 
 The reference has no attention code at all (SURVEY.md §2.7: long-sequence
 scaling is delegated to torchtitan); this is part of the beyond-reference
@@ -233,20 +238,245 @@ def _flash_fwd(
     return out, lse[..., 0].reshape(b, sq, kv_heads, group)
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_core(q, k, v, scale, block_q, block_k, interpret):
+def _bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, qp_ref, kp_ref,
+    dq_ref, dq_acc_ref, *, scale: float, nk: int,
+):
+    """dQ pass: grid (b, h, nq, nk), KV axis innermost; dq accumulates in
+    VMEM scratch across the KV blocks of one q block (FlashAttention-2
+    backward, probabilities recomputed from the saved logsumexp)."""
+    from jax.experimental import pallas as pl
+
+    ik = pl.program_id(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_acc_ref[...] = jnp.zeros_like(dq_acc_ref)
+
+    q_pos = qp_ref[...]  # (block_q, 1)
+    k_pos = kp_ref[...]  # (1, block_k)
+
+    @pl.when(jnp.min(k_pos) <= jnp.max(q_pos))
+    def _update():
+        q = q_ref[...]
+        k = k_ref[...]
+        scores = (
+            jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )  # (block_q, block_k) f32
+        # p from the saved lse; masked entries exactly 0 (also kills padded
+        # q rows, whose position is -1 — below every key).
+        p = jnp.where(q_pos >= k_pos, jnp.exp(scores - lse_ref[...]), 0.0)
+        dp = jax.lax.dot_general(
+            do_ref[...], v_ref[...], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (block_q, block_k) f32
+        ds = p * (dp - dl_ref[...]) * scale
+        dq_acc_ref[...] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        dq_ref[...] = dq_acc_ref[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, qp_ref, kp_ref,
+    dk_ref, dv_ref, dk_acc_ref, dv_acc_ref, *, scale: float, nq: int,
+):
+    """dK/dV pass: grid (b, h, nk, nq), Q axis innermost; dk/dv accumulate
+    in VMEM scratch across the q blocks of one KV block. Outputs are
+    PER-Q-HEAD partials (b, sk, h, d) — the GQA group sum happens outside
+    so every output block is written exactly once."""
+    from jax.experimental import pallas as pl
+
+    iq = pl.program_id(3)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
+
+    q_pos = qp_ref[...]  # (block_q, 1)
+    k_pos = kp_ref[...]  # (1, block_k)
+
+    @pl.when(jnp.max(q_pos) >= jnp.min(k_pos))
+    def _update():
+        q = q_ref[...]
+        k = k_ref[...]
+        scores = (
+            jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )  # (block_q, block_k) f32
+        p = jnp.where(q_pos >= k_pos, jnp.exp(scores - lse_ref[...]), 0.0)
+        do = do_ref[...]
+        dv_acc_ref[...] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (block_k, d)
+        dp = jax.lax.dot_general(
+            do, v_ref[...], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - dl_ref[...]) * scale
+        dk_acc_ref[...] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (block_k, d)
+
+    @pl.when(iq == nq - 1)
+    def _finalize():
+        dk_ref[...] = dk_acc_ref[...].astype(dk_ref.dtype)
+        dv_ref[...] = dv_acc_ref[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, out, lse, d_out, scale, block_q, block_k, interpret):
+    """Fused Pallas backward for full causal attention.
+
+    lse arrives per-q-head (b, sq, h) f32. Returns (dq, dk, dv) in the
+    input dtypes. Padding: q rows pad with position -1 (below every key →
+    zero contribution to every gradient); KV rows pad with _PAD_POS (above
+    every query → likewise zero)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    kv_heads = k.shape[2]
+    group = h // kv_heads
+
+    # delta_i = rowsum(dO_i * O_i): cheap elementwise+reduce, XLA fuses it.
+    delta = jnp.sum(
+        d_out.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    )  # (b, sq, h)
+
+    pad_q = (-sq) % block_q
+    pad_k = (-sk) % block_k
+    q_positions = jnp.broadcast_to(jnp.arange(sq, dtype=jnp.int32), (b, sq))
+    k_positions = jnp.broadcast_to(jnp.arange(sk, dtype=jnp.int32), (b, sk))
+    if pad_q:
+        q = jnp.pad(q, ((0, 0), (0, pad_q), (0, 0), (0, 0)))
+        d_out = jnp.pad(d_out, ((0, 0), (0, pad_q), (0, 0), (0, 0)))
+        lse = jnp.pad(lse, ((0, 0), (0, pad_q), (0, 0)))
+        delta = jnp.pad(delta, ((0, 0), (0, pad_q), (0, 0)))
+        q_positions = jnp.pad(
+            q_positions, ((0, 0), (0, pad_q)), constant_values=-1
+        )
+    if pad_k:
+        k = jnp.pad(k, ((0, 0), (0, pad_k), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad_k), (0, 0), (0, 0)))
+        k_positions = jnp.pad(
+            k_positions, ((0, 0), (0, pad_k)), constant_values=_PAD_POS
+        )
+    nq = (sq + pad_q) // block_q
+    nk = (sk + pad_k) // block_k
+    qp = q_positions.reshape(b, sq + pad_q, 1)
+    kp = k_positions.reshape(b, 1, sk + pad_k)
+    lse_col = lse.reshape(b, sq + pad_q, h, 1)
+    delta_col = delta.reshape(b, sq + pad_q, h, 1)
+
+    q_spec = pl.BlockSpec(
+        (None, block_q, None, d), lambda ib, ih, iq, ik: (ib, iq, ih, 0)
+    )
+    k_spec = pl.BlockSpec(
+        (None, block_k, None, d), lambda ib, ih, iq, ik: (ib, ik, ih // group, 0)
+    )
+    col_spec = pl.BlockSpec(
+        (None, block_q, None, 1), lambda ib, ih, iq, ik: (ib, iq, ih, 0)
+    )
+    qp_spec = pl.BlockSpec((None, block_q, 1), lambda ib, ih, iq, ik: (ib, iq, 0))
+    kp_spec = pl.BlockSpec((None, 1, block_k), lambda ib, ih, iq, ik: (ib, 0, ik))
+    inputs = (q, k, v, d_out, lse_col, delta_col, qp, kp)
+
+    dq = pl.pallas_call(
+        partial(_bwd_dq_kernel, scale=scale, nk=nk),
+        grid=(b, h, nq, nk),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, col_spec, col_spec, qp_spec, kp_spec],
+        out_specs=[q_spec],
+        out_shape=[_out_struct((b, sq + pad_q, h, d), q.dtype, inputs)],
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(*inputs)[0]
+
+    # dK/dV pass: swap the two inner grid axes (KV outer, Q innermost) so
+    # the accumulators persist across q blocks. Index maps take (iq, ik) in
+    # swapped positions.
+    q_spec_t = pl.BlockSpec(
+        (None, block_q, None, d), lambda ib, ih, ik, iq: (ib, iq, ih, 0)
+    )
+    k_spec_t = pl.BlockSpec(
+        (None, block_k, None, d), lambda ib, ih, ik, iq: (ib, ik, ih // group, 0)
+    )
+    kh_spec_t = pl.BlockSpec(
+        (None, block_k, None, d), lambda ib, ih, ik, iq: (ib, ik, ih, 0)
+    )
+    col_spec_t = pl.BlockSpec(
+        (None, block_q, None, 1), lambda ib, ih, ik, iq: (ib, iq, ih, 0)
+    )
+    qp_spec_t = pl.BlockSpec((None, block_q, 1), lambda ib, ih, ik, iq: (ib, iq, 0))
+    kp_spec_t = pl.BlockSpec((None, 1, block_k), lambda ib, ih, ik, iq: (ib, 0, ik))
+    dk_h, dv_h = pl.pallas_call(
+        partial(_bwd_dkv_kernel, scale=scale, nq=nq),
+        grid=(b, h, nk, nq),
+        in_specs=[
+            q_spec_t, k_spec_t, k_spec_t, q_spec_t, col_spec_t, col_spec_t,
+            qp_spec_t, kp_spec_t,
+        ],
+        out_specs=[kh_spec_t, kh_spec_t],
+        out_shape=[
+            _out_struct((b, sk + pad_k, h, d), k.dtype, inputs),
+            _out_struct((b, sk + pad_k, h, d), v.dtype, inputs),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*inputs)
+
+    if pad_q:
+        dq = dq[:, :sq]
+    if pad_k:
+        dk_h = dk_h[:, :sk]
+        dv_h = dv_h[:, :sk]
+    # GQA group sum of the per-q-head partials (one XLA reduction).
+    dk = dk_h.reshape(b, sk, kv_heads, group, d).sum(axis=3).astype(k.dtype)
+    dv = dv_h.reshape(b, sk, kv_heads, group, d).sum(axis=3).astype(v.dtype)
+    return dq, dk, dv
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_core(q, k, v, scale, block_q, block_k, interpret, pallas_bwd):
     return _flash_fwd(q, k, v, scale, block_q, block_k, interpret)[0]
 
 
-def _flash_core_fwd(q, k, v, scale, block_q, block_k, interpret):
+def _flash_core_fwd(q, k, v, scale, block_q, block_k, interpret, pallas_bwd):
     out, lse = _flash_fwd(q, k, v, scale, block_q, block_k, interpret)
     return out, (q, k, v, out, lse)
 
 
-def _flash_core_bwd(scale, block_q, block_k, interpret, residuals, d_out):
-    # The scan-based flash backward (recompute probabilities per KV block
-    # from the saved logsumexp) — shared with blockwise_attention, already
-    # verified against dense attention gradients.
+def _flash_core_bwd(
+    scale, block_q, block_k, interpret, pallas_bwd, residuals, d_out
+):
+    q, k, v, out, lse = residuals
+    if pallas_bwd:
+        b, s, h, d = q.shape
+        # Residual lse is (b, s, kv, group); the kernels index it per
+        # q-head h = kvh * group + g — the exact inverse reshape.
+        return _flash_bwd(
+            q, k, v, out, lse.reshape(b, s, h), d_out,
+            scale, block_q, block_k, interpret,
+        )
+    # Scan-based flash backward (recompute per KV block from the saved
+    # logsumexp) — shared with blockwise_attention; the CPU/fallback path.
     return _blockwise_core_bwd(scale, block_k, residuals, d_out)
 
 
@@ -315,14 +545,20 @@ def flash_attention(
     block_q: int = 128,
     block_k: int = 128,
     interpret: Optional[bool] = None,
+    use_pallas_bwd: Optional[bool] = None,
 ) -> jnp.ndarray:
-    """Fused causal GQA attention on one device (Pallas TPU kernel forward,
-    flash-style recompute backward).
+    """Fused causal GQA attention on one device: Pallas forward AND
+    FlashAttention-2-style Pallas backward (dq + dkv kernels recomputing
+    probabilities from the saved logsumexp).
 
     Shapes: q (b, s, h, d); k/v (b, s, kv_heads, d); h % kv_heads == 0.
     The sequence is padded to block multiples internally; outputs are
     returned in the original length. ``interpret=None`` auto-selects
     interpret mode off-TPU so the same call works in CPU tests.
+    ``use_pallas_bwd=None`` picks the fused backward exactly when the
+    forward compiles (on TPU); CPU tests pass True to exercise the
+    backward kernels in interpret mode, and False forces the scan-based
+    blockwise fallback.
     """
     b, s, h, d = q.shape
     kv_heads = k.shape[2]
@@ -336,6 +572,8 @@ def flash_attention(
         # device platform is "tpu", and only the latter says whether Mosaic
         # can compile the kernel.
         interpret = not on_tpu()
+    if use_pallas_bwd is None:
+        use_pallas_bwd = not interpret
     # Align the block size itself (not just the clamp bound) to a multiple
     # of 16 — the sublane tile for bf16 (and a multiple of f32's 8) — then
     # clamp oversized blocks to the padded sequence. A ragged block would
@@ -343,7 +581,8 @@ def flash_attention(
     block_q = min(_next_multiple(int(block_q), 16), _next_multiple(s, 16))
     block_k = min(_next_multiple(int(block_k), 16), _next_multiple(s, 16))
     return _flash_core(
-        q, k, v, float(scale), int(block_q), int(block_k), bool(interpret)
+        q, k, v, float(scale), int(block_q), int(block_k), bool(interpret),
+        bool(use_pallas_bwd),
     )
 
 
@@ -378,6 +617,30 @@ def verify_on_chip() -> dict:
     if err > 0.05:  # bf16 tolerance
         raise AssertionError(f"on-chip flash attention mismatch: max err {err}")
 
+    # Backward: compile the fused dq/dkv kernels on-chip and check the
+    # gradients against dense attention's.
+    def loss_flash(q_, k_, v_):
+        return jnp.sum(
+            flash_attention(q_, k_, v_, interpret=False, use_pallas_bwd=True)
+            .astype(jnp.float32) ** 2
+        )
+
+    def loss_dense(q_, k_, v_):
+        return jnp.sum(
+            causal_attention(q_, k_, v_, scale=d**-0.5).astype(jnp.float32) ** 2
+        )
+
+    grads_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    grads_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    err_bwd = max(
+        float(jnp.max(jnp.abs(gf.astype(jnp.float32) - gd.astype(jnp.float32))))
+        for gf, gd in zip(grads_flash, grads_dense)
+    )
+    # Gradients square the bf16 rounding; the scan-backward CPU tests hold
+    # the same bound.
+    if err_bwd > 0.25:
+        raise AssertionError(f"on-chip flash BACKWARD mismatch: max err {err_bwd}")
+
     # The partial surface (ring building block): explicit PERMUTED position
     # arrays (the (1, block_k) row tile), sq != sk, ragged lengths, a
     # fully-masked hop, and the logsumexp merge — everything the ring path
@@ -411,4 +674,10 @@ def verify_on_chip() -> dict:
         raise AssertionError(
             f"on-chip flash PARTIAL/merge mismatch: max err {err_p}"
         )
-    return {"device": str(dev), "max_err": err, "max_err_partial": err_p, "ok": True}
+    return {
+        "device": str(dev),
+        "max_err": err,
+        "max_err_bwd": err_bwd,
+        "max_err_partial": err_p,
+        "ok": True,
+    }
