@@ -3,7 +3,9 @@
 live lighthouse — the CI promotion of the reference's slurm/monarch chaos
 drives (punisher.py + failure.py:25-100).
 
-Gated behind TPUFT_SOAK=1 (runs minutes); TPUFT_SOAK_SECONDS controls the
+ON by default (a soak that never runs automatically is a soak that rots —
+round-2 verdict weak #5): every full-suite run pays the ~2 minutes.
+TPUFT_SOAK=0 opts out for quick iteration; TPUFT_SOAK_SECONDS controls the
 fault window (default 60; VERDICT's 10-minute soak = TPUFT_SOAK_SECONDS=600).
 The master invariant: after every group finishes, committed states are
 bitwise identical across groups.
@@ -20,8 +22,8 @@ import time
 import pytest
 
 pytestmark = pytest.mark.skipif(
-    os.environ.get("TPUFT_SOAK") != "1",
-    reason="chaos soak runs minutes; set TPUFT_SOAK=1 to enable",
+    os.environ.get("TPUFT_SOAK", "1") == "0",
+    reason="chaos soak disabled by TPUFT_SOAK=0",
 )
 
 _TRAIN_SCRIPT = r"""
