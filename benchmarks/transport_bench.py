@@ -72,7 +72,9 @@ def synth_state(total_bytes: int) -> dict:
         w[:8] = float(i + 1)  # leaf-unique head
         state[f"layer{i}"] = {
             "w": w.reshape(side, side),
-            "b": np.zeros((side,), dtype=np.float32),
+            # Nonzero + leaf-unique: a zero bias would make the receiver's
+            # zero template digest-match even if 1-D leaves never moved.
+            "b": np.full((side,), 0.5 + i, dtype=np.float32),
         }
     state["step"] = 123
     return state

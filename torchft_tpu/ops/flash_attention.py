@@ -48,6 +48,7 @@ from torchft_tpu.ops.ring_attention import _blockwise_core_bwd
 __all__ = [
     "flash_attention",
     "flash_attention_partial",
+    "flash_attention_partial_bwd",
     "merge_attention_partials",
 ]
 
@@ -338,13 +339,29 @@ def _bwd_dkv_kernel(
         dv_ref[...] = dv_acc_ref[...].astype(dv_ref.dtype)
 
 
-def _flash_bwd(q, k, v, out, lse, d_out, scale, block_q, block_k, interpret):
-    """Fused Pallas backward for full causal attention.
+def flash_attention_partial_bwd(
+    q, k, v, d_out, out, lse,
+    q_positions, k_positions,
+    scale, block_q, block_k, interpret,
+    delta=None,
+    out_dtype=None,
+):
+    """Fused Pallas backward PARTIAL over an arbitrary KV block: the ring
+    backward building block (and, with arange positions, the full causal
+    backward). Masking uses explicit global position arrays, so permuted
+    (zigzag) ring layouts work; ``lse`` is the GLOBAL logsumexp per q-head
+    (b, sq, h) f32 — with it, one call yields this KV block's exact (dk,
+    dv) and this query shard's dq contribution, no forward recompute
+    (FlashAttention-2 identity).
 
-    lse arrives per-q-head (b, sq, h) f32. Returns (dq, dk, dv) in the
-    input dtypes. Padding: q rows pad with position -1 (below every key →
-    zero contribution to every gradient); KV rows pad with _PAD_POS (above
-    every query → likewise zero)."""
+    ``delta = rowsum(dO*O)`` may be precomputed (ring callers reuse it
+    across hops). Returns (dq_partial, dk, dv) in ``out_dtype`` (default
+    f32 — ring callers accumulate partials across hops in f32 and cast
+    once at the end; the single-block full-causal caller passes the input
+    dtype so the kernels cast in VMEM and halve the gradient writeback for
+    bf16 models). dk/dv are group-summed. Padding: q rows pad with
+    position -1 (below every key → zero contribution to every gradient);
+    KV rows pad with _PAD_POS (above every query → likewise zero)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -352,16 +369,21 @@ def _flash_bwd(q, k, v, out, lse, d_out, scale, block_q, block_k, interpret):
     sk = k.shape[1]
     kv_heads = k.shape[2]
     group = h // kv_heads
+    # Same sublane rounding as every forward entry point: ragged blocks
+    # pass interpret mode but fail Mosaic lowering on real TPU.
+    block_q = min(_next_multiple(int(block_q), 16), _next_multiple(sq, 16))
+    block_k = min(_next_multiple(int(block_k), 16), _next_multiple(sk, 16))
+    if out_dtype is None:
+        out_dtype = jnp.float32
 
-    # delta_i = rowsum(dO_i * O_i): cheap elementwise+reduce, XLA fuses it.
-    delta = jnp.sum(
-        d_out.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
-    )  # (b, sq, h)
+    if delta is None:
+        # Cheap elementwise+reduce, XLA fuses it into the surrounding graph.
+        delta = jnp.sum(
+            d_out.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+        )  # (b, sq, h)
 
     pad_q = (-sq) % block_q
     pad_k = (-sk) % block_k
-    q_positions = jnp.broadcast_to(jnp.arange(sq, dtype=jnp.int32), (b, sq))
-    k_positions = jnp.broadcast_to(jnp.arange(sk, dtype=jnp.int32), (b, sk))
     if pad_q:
         q = jnp.pad(q, ((0, 0), (0, pad_q), (0, 0), (0, 0)))
         d_out = jnp.pad(d_out, ((0, 0), (0, pad_q), (0, 0), (0, 0)))
@@ -378,8 +400,8 @@ def _flash_bwd(q, k, v, out, lse, d_out, scale, block_q, block_k, interpret):
         )
     nq = (sq + pad_q) // block_q
     nk = (sk + pad_k) // block_k
-    qp = q_positions.reshape(b, sq + pad_q, 1)
-    kp = k_positions.reshape(b, 1, sk + pad_k)
+    qp = q_positions.astype(jnp.int32).reshape(b, sq + pad_q, 1)
+    kp = k_positions.astype(jnp.int32).reshape(b, 1, sk + pad_k)
     lse_col = lse.reshape(b, sq + pad_q, h, 1)
     delta_col = delta.reshape(b, sq + pad_q, h, 1)
 
@@ -401,7 +423,7 @@ def _flash_bwd(q, k, v, out, lse, d_out, scale, block_q, block_k, interpret):
         grid=(b, h, nq, nk),
         in_specs=[q_spec, k_spec, k_spec, q_spec, col_spec, col_spec, qp_spec, kp_spec],
         out_specs=[q_spec],
-        out_shape=[_out_struct((b, sq + pad_q, h, d), q.dtype, inputs)],
+        out_shape=[_out_struct((b, sq + pad_q, h, d), out_dtype, inputs)],
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
     )(*inputs)[0]
@@ -432,8 +454,8 @@ def _flash_bwd(q, k, v, out, lse, d_out, scale, block_q, block_k, interpret):
         ],
         out_specs=[kh_spec_t, kh_spec_t],
         out_shape=[
-            _out_struct((b, sk + pad_k, h, d), k.dtype, inputs),
-            _out_struct((b, sk + pad_k, h, d), v.dtype, inputs),
+            _out_struct((b, sk + pad_k, h, d), out_dtype, inputs),
+            _out_struct((b, sk + pad_k, h, d), out_dtype, inputs),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
@@ -448,9 +470,24 @@ def _flash_bwd(q, k, v, out, lse, d_out, scale, block_q, block_k, interpret):
         dk_h = dk_h[:, :sk]
         dv_h = dv_h[:, :sk]
     # GQA group sum of the per-q-head partials (one XLA reduction).
-    dk = dk_h.reshape(b, sk, kv_heads, group, d).sum(axis=3).astype(k.dtype)
-    dv = dv_h.reshape(b, sk, kv_heads, group, d).sum(axis=3).astype(v.dtype)
+    dk = dk_h.reshape(b, sk, kv_heads, group, d).sum(axis=3)
+    dv = dv_h.reshape(b, sk, kv_heads, group, d).sum(axis=3)
     return dq, dk, dv
+
+
+def _flash_bwd(q, k, v, out, lse, d_out, scale, block_q, block_k, interpret):
+    """Full-causal fused backward: the partial backward with arange
+    positions and a single all-KV block set."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    q_positions = jnp.broadcast_to(jnp.arange(sq, dtype=jnp.int32), (b, sq))
+    k_positions = jnp.broadcast_to(jnp.arange(sk, dtype=jnp.int32), (b, sk))
+    dq, dk, dv = flash_attention_partial_bwd(
+        q, k, v, d_out, out, lse, q_positions, k_positions,
+        scale, block_q, block_k, interpret,
+        out_dtype=q.dtype,  # no cross-call accumulation: cast in VMEM
+    )
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
@@ -503,8 +540,9 @@ def flash_attention_partial(
     Shapes: q (b, sq, h, d); k/v (b, sk, kv_heads, d); positions (b, sq) /
     (b, sk). Returns (out (b, sq, h, d) in q.dtype, lse (b, sq, h) f32;
     fully-masked rows come back as out=0, lse≈-1e30). Forward-only — ring
-    callers define their own VJP (ops/ring_attention.py ties it to the
-    scan-based ring backward).
+    callers define their own VJP (ops/ring_attention.py: per-hop
+    :func:`flash_attention_partial_bwd` on TPU, einsum ring backward as
+    the interpret/CPU fallback).
     """
     b, sq, h, d = q.shape
     if scale is None:
@@ -657,7 +695,7 @@ def verify_on_chip() -> dict:
     o2, l2 = flash_attention_partial(
         qs, k[:, half:], v[:, half:], qp, kp_full[:, half:], interpret=False
     )
-    merged, _ = merge_attention_partials(
+    merged, lse_g = merge_attention_partials(
         o1.astype(jnp.float32), l1, o2.astype(jnp.float32), l2
     )
     # Reference: dense attention with the same permuted-position mask.
@@ -673,6 +711,43 @@ def verify_on_chip() -> dict:
     if err_p > 0.05:
         raise AssertionError(
             f"on-chip flash PARTIAL/merge mismatch: max err {err_p}"
+        )
+
+    # The ring-backward building block: flash_attention_partial_bwd
+    # compiled with PERMUTED positions, sq != sk, and the global (merged)
+    # logsumexp — checked against the FlashAttention-2 einsum identity
+    # (the _ring_flash_bwd_scan per-hop math, computed inline).
+    d_out_p = jax.random.normal(jax.random.PRNGKey(7), merged.shape, jnp.float32)
+    dq_pal, dk_pal, dv_pal = flash_attention_partial_bwd(
+        qs, k[:, :half], v[:, :half], d_out_p.astype(qs.dtype),
+        merged.astype(qs.dtype), lse_g,
+        qp, kp_full[:, :half],
+        d**-0.5, 128, 128, False,
+    )
+    group = h // kv
+    qg2 = qs.astype(jnp.float32).reshape(b, sq, kv, group, d)
+    dog = d_out_p.reshape(b, sq, kv, group, d)
+    og = merged.reshape(b, sq, kv, group, d)
+    delta = jnp.sum(dog * og, axis=-1)
+    k32 = k[:, :half].astype(jnp.float32)
+    v32 = v[:, :half].astype(jnp.float32)
+    scores2 = jnp.einsum("bskgd,btkd->bskgt", qg2, k32) * (d**-0.5)
+    mask2 = qp[:, :, None, None, None] >= kp_full[:, None, None, None, :half]
+    lse_gg = lse_g.reshape(b, sq, kv, group)
+    p2 = jnp.where(mask2, jnp.exp(scores2 - lse_gg[..., None]), 0.0)
+    dv_ref = jnp.einsum("bskgt,bskgd->btkd", p2, dog)
+    dp2 = jnp.einsum("bskgd,btkd->bskgt", dog, v32)
+    ds2 = p2 * (dp2 - delta[..., None]) * (d**-0.5)
+    dq_ref = jnp.einsum("bskgt,btkd->bskgd", ds2, k32).reshape(b, sq, h, d)
+    dk_ref = jnp.einsum("bskgt,bskgd->btkd", ds2, qg2)
+    err_pb = max(
+        float(jnp.max(jnp.abs(dq_pal.astype(jnp.float32) - dq_ref))),
+        float(jnp.max(jnp.abs(dk_pal.astype(jnp.float32) - dk_ref))),
+        float(jnp.max(jnp.abs(dv_pal.astype(jnp.float32) - dv_ref))),
+    )
+    if err_pb > 0.25:
+        raise AssertionError(
+            f"on-chip flash PARTIAL BACKWARD mismatch: max err {err_pb}"
         )
     return {
         "device": str(dev),

@@ -389,30 +389,115 @@ def _ring_flash_fwd_impl(
     return out.astype(q.dtype), lse
 
 
-@_partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
-def _ring_flash(q, k, v, q_pos, k_pos, axis_name, scale, block_q, block_k, interpret):
+@_partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+def _ring_flash(
+    q, k, v, q_pos, k_pos, axis_name, scale, block_q, block_k, interpret,
+    pallas_bwd,
+):
     return _ring_flash_fwd_impl(
         q, k, v, q_pos, k_pos, axis_name, scale, block_q, block_k, interpret
     )[0]
 
 
-def _ring_flash_fwd(q, k, v, q_pos, k_pos, axis_name, scale, block_q, block_k, interpret):
+def _ring_flash_fwd(
+    q, k, v, q_pos, k_pos, axis_name, scale, block_q, block_k, interpret,
+    pallas_bwd,
+):
     out, lse = _ring_flash_fwd_impl(
         q, k, v, q_pos, k_pos, axis_name, scale, block_q, block_k, interpret
     )
     return out, (q, k, v, q_pos, k_pos, out, lse)
 
 
-def _ring_flash_bwd(axis_name, scale, block_q, block_k, interpret, residuals, d_out):
-    """True ring backward from the saved (out, lse) residuals — the
-    flash-attention-2 identity with the GLOBAL logsumexp, so no forward
-    recompute is needed. dq accumulates locally while each KV block's
-    (dk, dv) partial sums ride the rotation with it: after axis_size hops
-    every block is home with contributions from every shard's queries.
+def _ring_bwd_loop(axis_name, dq0, k, v, k_pos, per_hop):
+    """The ring-backward scaffold shared by both per-hop engines: f32
+    (dq, dk, dv) carries marked varying over the ring axis (fresh zeros —
+    a zeros_like of the already-varying inputs would make the pcast a
+    rejected varying→varying cast), with each KV block's (dk, dv) partial
+    sums riding the rotation home. ``per_hop(k_blk, v_blk, kp)`` returns
+    this hop's (dq_inc, dk_inc, dv_inc) in f32.
     (Ring cost: fwd rotates {k, v, pos}; bwd rotates {k, v, pos, dk, dv}.)
     """
-    q, k, v, q_pos, k_pos, out, lse = residuals
     axis_size = jax.lax.psum(1, axis_name)
+    dk0 = jnp.zeros(k.shape, jnp.float32)
+    dv0 = jnp.zeros_like(dk0)
+    if hasattr(jax.lax, "pcast"):
+        dq0, dk0, dv0 = (
+            jax.lax.pcast(x, (axis_name,), to="varying") for x in (dq0, dk0, dv0)
+        )
+
+    def ring_step(_, carry):
+        dq, k_blk, v_blk, kp, dk_blk, dv_blk = carry
+        dq_inc, dk_inc, dv_inc = per_hop(k_blk, v_blk, kp)
+        perm = [(r, (r + 1) % axis_size) for r in range(axis_size)]
+        rotate = lambda x: jax.lax.ppermute(x, axis_name, perm)
+        return (
+            dq + dq_inc,
+            rotate(k_blk),
+            rotate(v_blk),
+            rotate(kp),
+            rotate(dk_blk + dk_inc),
+            rotate(dv_blk + dv_inc),
+        )
+
+    dq, _, _, _, dk, dv = jax.lax.fori_loop(
+        0, axis_size, ring_step, (dq0, k, v, k_pos, dk0, dv0)
+    )
+    return dq, dk, dv
+
+
+def _ring_flash_bwd_pallas(
+    axis_name, scale, block_q, block_k, interpret, residuals, d_out
+):
+    """Ring backward with the fused Pallas dq/dkv kernels as the per-hop
+    block compute: each hop runs flash_attention_partial_bwd with the
+    GLOBAL logsumexp (and the hop-invariant delta = rowsum(dO·O), computed
+    once). The kernels' position-driven causal block skip gives zigzag
+    layouts their balance on the backward too."""
+    from torchft_tpu.ops.flash_attention import flash_attention_partial_bwd
+
+    q, k, v, q_pos, k_pos, out, lse = residuals
+    b, s_local, h, d = q.shape
+
+    delta = jnp.sum(
+        d_out.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    )  # (b, s, h), hop-invariant
+
+    def per_hop(k_blk, v_blk, kp):
+        return flash_attention_partial_bwd(
+            q, k_blk, v_blk, d_out, out, lse, q_pos, kp,
+            scale, block_q, block_k, interpret, delta=delta,
+        )
+
+    dq0 = jnp.zeros((b, s_local, h, d), jnp.float32)
+    dq, dk, dv = _ring_bwd_loop(axis_name, dq0, k, v, k_pos, per_hop)
+    return (
+        dq.astype(q.dtype),
+        dk.astype(k.dtype),
+        dv.astype(v.dtype),
+        None,
+        None,
+    )
+
+
+def _ring_flash_bwd(
+    axis_name, scale, block_q, block_k, interpret, pallas_bwd, residuals, d_out
+):
+    if pallas_bwd:
+        return _ring_flash_bwd_pallas(
+            axis_name, scale, block_q, block_k, interpret, residuals, d_out
+        )
+    return _ring_flash_bwd_scan(
+        axis_name, scale, block_q, block_k, interpret, residuals, d_out
+    )
+
+
+def _ring_flash_bwd_scan(axis_name, scale, block_q, block_k, interpret, residuals, d_out):
+    """True ring backward from the saved (out, lse) residuals — the
+    flash-attention-2 identity with the GLOBAL logsumexp as XLA einsums,
+    so no forward recompute is needed. The interpret/CPU engine; shares
+    the rotation scaffold with the Pallas engine via _ring_bwd_loop."""
+    q, k, v, q_pos, k_pos, out, lse = residuals
     b, s_local, h, d = q.shape
     kv_heads = k.shape[2]
     group = h // kv_heads
@@ -424,19 +509,7 @@ def _ring_flash_bwd(axis_name, scale, block_q, block_k, interpret, residuals, d_
     # delta_i = dO_i . O_i (flash-attention-2 backward identity).
     delta = jnp.sum(dog * og, axis=-1)  # (b, s, kv, g)
 
-    # Fresh (unvarying) zeros, then mark varying over the ring axis — a
-    # zeros_like of the (already-varying) inputs would make the pcast a
-    # no-op-rejected varying->varying cast.
-    dq = jnp.zeros((b, s_local, kv_heads, group, d), jnp.float32)
-    dk0 = jnp.zeros((b, s_local, kv_heads, d), jnp.float32)
-    dv0 = jnp.zeros_like(dk0)
-    if hasattr(jax.lax, "pcast"):
-        dq, dk0, dv0 = (
-            jax.lax.pcast(x, (axis_name,), to="varying") for x in (dq, dk0, dv0)
-        )
-
-    def ring_step(_, carry):
-        dq, k_blk, v_blk, kp, dk_blk, dv_blk = carry
+    def per_hop(k_blk, v_blk, kp):
         k32 = k_blk.astype(jnp.float32)
         v32 = v_blk.astype(jnp.float32)
         scores = jnp.einsum("bskgd,btkd->bskgt", qg, k32) * scale
@@ -445,18 +518,15 @@ def _ring_flash_bwd(axis_name, scale, block_q, block_k, interpret, residuals, d_
         # exactly 0 (fully-masked rows have the -1e30 sentinel, whose exp
         # overflow is discarded by the where).
         p = jnp.where(mask, jnp.exp(scores - lse_g[..., None]), 0.0)
-        dv_blk = dv_blk + jnp.einsum("bskgt,bskgd->btkd", p, dog)
+        dv_inc = jnp.einsum("bskgt,bskgd->btkd", p, dog)
         dp = jnp.einsum("bskgd,btkd->bskgt", dog, v32)
         ds = p * (dp - delta[..., None]) * scale
-        dq = dq + jnp.einsum("bskgt,btkd->bskgd", ds, k32)
-        dk_blk = dk_blk + jnp.einsum("bskgt,bskgd->btkd", ds, qg)
-        perm = [(r, (r + 1) % axis_size) for r in range(axis_size)]
-        rotate = lambda x: jax.lax.ppermute(x, axis_name, perm)
-        return dq, rotate(k_blk), rotate(v_blk), rotate(kp), rotate(dk_blk), rotate(dv_blk)
+        dq_inc = jnp.einsum("bskgt,btkd->bskgd", ds, k32)
+        dk_inc = jnp.einsum("bskgt,bskgd->btkd", ds, qg)
+        return dq_inc, dk_inc, dv_inc
 
-    dq, _, _, _, dk, dv = jax.lax.fori_loop(
-        0, axis_size, ring_step, (dq, k, v, k_pos, dk0, dv0)
-    )
+    dq0 = jnp.zeros((b, s_local, kv_heads, group, d), jnp.float32)
+    dq, dk, dv = _ring_bwd_loop(axis_name, dq0, k, v, k_pos, per_hop)
     return (
         dq.reshape(b, s_local, h, d).astype(q.dtype),
         dk.astype(k.dtype),
@@ -480,13 +550,17 @@ def ring_attention_flash(
     block_q: int = 128,
     block_k: int = 128,
     interpret: Optional[bool] = None,
+    use_pallas_bwd: Optional[bool] = None,
 ) -> jnp.ndarray:
     """:func:`ring_attention` with the fused Pallas kernel as the per-hop
     block compute (ops/flash_attention.py): K/V still rotate over
     ``axis_name`` via ppermute, but each hop's online-softmax inner loop
     runs as one kernel with VMEM-resident accumulators, and hops merge by
-    logsumexp. Same shapes/semantics as :func:`ring_attention`; gradients
-    flow through a custom VJP tied to the scan-based ring backward."""
+    logsumexp. Same shapes/semantics as :func:`ring_attention`. The
+    backward is a true ring backward from the saved (out, lse); on TPU
+    (``use_pallas_bwd=None`` → when the forward compiles) each hop runs
+    the fused dq/dkv kernels (flash_attention_partial_bwd), with the
+    einsum ring backward as the interpret/CPU fallback."""
     axis_index = jax.lax.axis_index(axis_name)
     b, s_local, h, d = q.shape
     if scale is None:
@@ -498,10 +572,13 @@ def ring_attention_flash(
         k_positions = q_positions
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
+    if use_pallas_bwd is None:
+        use_pallas_bwd = not interpret
     return _ring_flash(
         q, k, v,
         q_positions.astype(jnp.int32), k_positions.astype(jnp.int32),
         axis_name, float(scale), int(block_q), int(block_k), bool(interpret),
+        bool(use_pallas_bwd),
     )
 
 
