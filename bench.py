@@ -196,6 +196,11 @@ def main() -> None:
             max_seq_len=SEQ,
             dtype=jnp.bfloat16,
             attention_impl="flash",
+            # O(1) HLO in depth: the remote-compile tunnel is the large
+            # config's main risk. No remat — recompute FLOPs aren't in the
+            # 6N formula and would skew the MFU datum (400M/seq-2048
+            # activations fit without it).
+            scan_layers=True,
         )
         sync_every_cap = 10**9
     else:

@@ -16,7 +16,10 @@ TPU-first choices:
   lengths sharded across devices;
 - :func:`sharding_plan` gives PartitionSpecs for fsdp/tp axes (megatron
   layout: column-parallel qkv/up, row-parallel out/down) consumed by
-  ``jax.jit`` via NamedSharding.
+  ``jax.jit`` via NamedSharding;
+- ``remat`` ("full"/"dots") and ``scan_layers`` on the config: gradient
+  checkpointing and a lax.scan'd layer stack, so 70B-class/long-context
+  steps fit in HBM and compile in O(1) HLO size in depth.
 """
 
 from __future__ import annotations
@@ -68,12 +71,27 @@ class LlamaConfig:
     ring_use_flash: bool = False
     # auto picks blockwise over dense at/after this sequence length.
     blockwise_min_seq: int = 2048
+    # Rematerialization (gradient checkpointing): trade FLOPs for HBM so
+    # long-context / 70B-class steps fit. "full" recomputes each block in
+    # the backward; "dots" keeps MXU dot outputs and recomputes the cheap
+    # elementwise/VPU work (jax.checkpoint_policies.checkpoint_dots) —
+    # usually the right TPU default when activations don't fit.
+    remat: str = "none"
+    # lax.scan over the layer stack: one traced/compiled Block for the
+    # whole depth instead of n_layers inlined copies — O(1) HLO size and
+    # compile time in depth (matters at 80 layers). Params gain a leading
+    # layer axis; sharding_plan/apply_sharding_plan handle both layouts.
+    scan_layers: bool = False
 
     def __post_init__(self) -> None:
         valid = ("auto", "dense", "blockwise", "flash", "ring")
         if self.attention_impl not in valid:
             raise ValueError(
                 f"attention_impl={self.attention_impl!r} is not one of {valid}"
+            )
+        if self.remat not in ("none", "full", "dots"):
+            raise ValueError(
+                f"remat={self.remat!r} is not one of ('none', 'full', 'dots')"
             )
 
     @property
@@ -253,6 +271,22 @@ class Block(nn.Module):
         return x
 
 
+def _remat_policy(remat: str):
+    return jax.checkpoint_policies.checkpoint_dots if remat == "dots" else None
+
+
+class _ScanCell(nn.Module):
+    """One Block in ``(carry, broadcast) -> (carry, out)`` shape for
+    ``nn.scan``; params live under ``<stack>/block`` with a leading layer
+    axis added by the scan's ``variable_axes={'params': 0}``."""
+
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, positions: jnp.ndarray):
+        return Block(self.config, name="block")(x, positions), None
+
+
 class Llama(nn.Module):
     config: LlamaConfig
 
@@ -270,8 +304,28 @@ class Llama(nn.Module):
             name="tok_embed",
         )
         x = embed(tokens)
-        for layer in range(cfg.n_layers):
-            x = Block(cfg, name=f"layer_{layer}")(x, positions)
+        if cfg.scan_layers:
+            cell = _ScanCell
+            if cfg.remat != "none":
+                # prevent_cse is safe (and standard) under scan: the loop
+                # boundary already blocks the CSE remat would otherwise fight.
+                cell = nn.remat(
+                    cell, policy=_remat_policy(cfg.remat), prevent_cse=False
+                )
+            stack = nn.scan(
+                cell,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                length=cfg.n_layers,
+                in_axes=nn.broadcast,
+            )
+            x, _ = stack(cfg, name="layers")(x, positions)
+        else:
+            block = Block
+            if cfg.remat != "none":
+                block = nn.remat(Block, policy=_remat_policy(cfg.remat))
+            for layer in range(cfg.n_layers):
+                x = block(cfg, name=f"layer_{layer}")(x, positions)
         x = RMSNorm(cfg.norm_eps, cfg.dtype, name="final_norm")(x)
         if cfg.tie_embeddings:
             logits = embed.attend(x)
@@ -329,6 +383,11 @@ def apply_sharding_plan(params: Any, mesh: Any, plan: Dict[str, Any]) -> Any:
             if re.fullmatch(pattern, name):
                 spec = candidate
                 break
+        # Scanned stacks carry a leading layer axis (scan_layers=True):
+        # the plan describes the per-layer shape, so shift it right and
+        # replicate over the stack axis.
+        if len(spec) and leaf.ndim == len(spec) + 1:
+            spec = P(None, *spec)
         # Drop spec axes that don't divide the leaf's dims.
         fixed = []
         for dim, entry in enumerate(spec):
