@@ -68,7 +68,15 @@ def train(args: argparse.Namespace) -> None:
         logits = model.apply(p, x)
         return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
 
-    grad_fn = jax.jit(jax.value_and_grad(loss_fn))
+    if args.microbatches > 1:
+        # Gradient accumulation inside one jitted program (lax.scan over
+        # equal batch chunks) — the HBM lever when the global batch
+        # doesn't fit. Same mean gradient up to f32 reduction order.
+        from torchft_tpu.optim import make_microbatch_grad
+
+        grad_fn = jax.jit(make_microbatch_grad(loss_fn, args.microbatches))
+    else:
+        grad_fn = jax.jit(jax.value_and_grad(loss_fn))
 
     # Synthetic CIFAR-shaped data, deterministic per index.
     dataset_size = 50_000
@@ -214,6 +222,10 @@ def main() -> None:
     parser.add_argument("--num-replica-groups", type=int, default=2)
     parser.add_argument("--steps", type=int, default=20)
     parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument(
+        "--microbatches", type=int, default=1,
+        help="gradient-accumulation chunks per step (batch-size must divide)",
+    )
     parser.add_argument("--min-replica-size", type=int, default=1)
     parser.add_argument("--padding-mb", type=int, default=0)
     parser.add_argument("--timeout", type=float, default=30.0)

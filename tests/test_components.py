@@ -482,3 +482,56 @@ def test_telemetry_otlp_mode_reports_missing_sdk() -> None:
         pass
     with pytest.raises(RuntimeError, match="opentelemetry-sdk"):
         telemetry.configure_telemetry("otlp")
+
+
+def test_microbatch_grad_matches_full_batch() -> None:
+    """make_microbatch_grad: mean-of-means over equal chunks equals the
+    full-batch gradient (token-mean loss), and the fused step with
+    num_microbatches>1 produces the same update as the plain fused step."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from torchft_tpu.models.llama import CONFIGS, Llama, cross_entropy_loss
+    from torchft_tpu.optim import make_jit_fused_step, make_microbatch_grad
+
+    cfg = CONFIGS["tiny"]
+    model = Llama(cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (4, 17), 0, cfg.vocab_size)
+    params = model.init(jax.random.PRNGKey(1), tokens[:, :-1])
+
+    def loss_fn(p, batch):
+        logits = model.apply(p, batch[:, :-1])
+        return cross_entropy_loss(logits, batch[:, 1:])
+
+    loss_full, g_full = jax.jit(jax.value_and_grad(loss_fn))(params, tokens)
+    loss_mb, g_mb = jax.jit(make_microbatch_grad(loss_fn, 4))(params, tokens)
+    np.testing.assert_allclose(float(loss_mb), float(loss_full), rtol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-5, atol=1e-6
+        ),
+        g_mb, g_full,
+    )
+
+    tx = optax.sgd(0.1)
+    opt_state = tx.init(params)
+    _, p_full, _ = make_jit_fused_step(tx, loss_fn)(params, opt_state, tokens)
+    _, p_mb, _ = make_jit_fused_step(tx, loss_fn, num_microbatches=2)(
+        params, opt_state, tokens
+    )
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-5, atol=1e-6
+        ),
+        p_mb, p_full,
+    )
+
+    # Indivisible batch fails loudly at trace time.
+    try:
+        jax.jit(make_microbatch_grad(loss_fn, 3))(params, tokens)
+    except ValueError as e:
+        assert "not divisible" in str(e)
+    else:
+        raise AssertionError("expected ValueError for indivisible batch")

@@ -61,6 +61,18 @@ def test_train_ddp(lighthouse):
     assert "param_digest=" in out
 
 
+def test_train_ddp_microbatched(lighthouse):
+    out = _run(
+        "train_ddp.py",
+        [
+            "--num-replica-groups", 1, "--steps", 2, "--batch-size", 4,
+            "--microbatches", 2,
+        ],
+        lighthouse,
+    )
+    assert "param_digest=" in out
+
+
 def test_train_diloco(lighthouse):
     out = _run(
         "train_diloco.py",

@@ -33,20 +33,105 @@ import numpy as np
 
 from torchft_tpu.manager import Manager
 
-__all__ = ["Optimizer", "OptimizerWrapper", "make_jit_update", "make_jit_fused_step"]
+__all__ = [
+    "Optimizer",
+    "OptimizerWrapper",
+    "make_jit_update",
+    "make_jit_fused_step",
+    "make_microbatch_grad",
+]
 
 
-def make_jit_fused_step(tx: Any, loss_fn: Any):
+def make_microbatch_grad(loss_fn: Any, num_microbatches: int):
+    """Gradient accumulation the TPU way: ``(params, *batch) -> (loss,
+    grads)`` that splits each batch array's leading axis into
+    ``num_microbatches`` equal chunks and ``lax.scan``s value_and_grad over
+    them inside ONE traced program — activations for only one microbatch
+    are live at a time (the standard HBM lever when the global batch
+    doesn't fit), with f32 accumulators so bf16 models don't lose gradient
+    mass across chunks. Equal-sized chunks make mean-of-means exactly the
+    full-batch mean for per-example/token-mean losses (up to f32 reduction
+    order). Every ``*batch`` arg must carry the batch axis at dim 0; pass
+    non-batched aux (rng keys, constants) via closure.
+
+    The reference leans on torch's eager semantics for this —
+    ``loss.backward()`` accumulates into ``.grad`` buffers between
+    ``zero_grad()`` and ``step()`` (the train-loop protocol at
+    /root/reference/train_ddp.py:185-196), so users accumulate by simply
+    calling backward N times. Under XLA the scan is the idiomatic
+    equivalent — no data-dependent Python control flow, one compiled loop
+    body reused across chunks."""
+    import jax.numpy as jnp
+
+    if num_microbatches < 1:
+        raise ValueError(f"num_microbatches must be >= 1, got {num_microbatches}")
+
+    def grad_fn(params: Any, *batch: Any):
+        def split(x):
+            # Every *batch leaf must carry the batch axis at dim 0 —
+            # pass non-batched aux (rng keys, scalars) via closure, not
+            # as a batch arg.
+            if getattr(x, "ndim", 0) == 0:
+                raise ValueError(
+                    "make_microbatch_grad: got a rank-0 batch arg; every "
+                    "batch array must have the batch axis at dim 0 (close "
+                    "over non-batched aux instead)"
+                )
+            if x.shape[0] % num_microbatches:
+                raise ValueError(
+                    f"batch dim {x.shape[0]} not divisible by "
+                    f"num_microbatches={num_microbatches}"
+                )
+            return x.reshape(
+                (num_microbatches, x.shape[0] // num_microbatches) + x.shape[1:]
+            )
+
+        micro = jax.tree_util.tree_map(split, batch)
+        vg = jax.value_and_grad(loss_fn)
+
+        def body(carry, mb):
+            loss_acc, g_acc = carry
+            loss, g = vg(params, *mb)
+            g_acc = jax.tree_util.tree_map(
+                lambda a, b: a + b.astype(jnp.float32), g_acc, g
+            )
+            return (loss_acc + loss.astype(jnp.float32), g_acc), None
+
+        zeros = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params
+        )
+        (loss_sum, g_sum), _ = jax.lax.scan(
+            body, (jnp.zeros((), jnp.float32), zeros), micro
+        )
+        inv = 1.0 / num_microbatches
+        grads = jax.tree_util.tree_map(
+            lambda g, p: (g * inv).astype(p.dtype), g_sum, params
+        )
+        return loss_sum * inv, grads
+
+    return grad_fn
+
+
+def make_jit_fused_step(tx: Any, loss_fn: Any, num_microbatches: int = 1):
     """ONE jitted program for a whole local train step:
     ``(params, opt_state, *batch) -> (loss, new_params, new_opt_state)``.
     ``loss_fn(params, *batch) -> scalar``. The fused form is the plain-JAX
     train step; Optimizer (lone-replica path) and LocalSGD (inner steps)
     share it — DiLoCo keeps its own leaves-layout variant
-    (local_sgd.py make_step_fn)."""
+    (local_sgd.py make_step_fn). ``num_microbatches > 1`` accumulates
+    gradients over equal batch chunks inside the same program
+    (:func:`make_microbatch_grad`)."""
     import optax
 
+    if num_microbatches < 1:
+        raise ValueError(f"num_microbatches must be >= 1, got {num_microbatches}")
+    if num_microbatches > 1:
+        grad_fn = make_microbatch_grad(loss_fn, num_microbatches)
+    else:
+        grad_fn = jax.value_and_grad(loss_fn)
+
     def _fused(params: Any, opt_state: Any, *batch: Any):
-        loss, grads = jax.value_and_grad(loss_fn)(params, *batch)
+        loss, grads = grad_fn(params, *batch)
         updates, new_state = tx.update(grads, opt_state, params)
         return loss, optax.apply_updates(params, updates), new_state
 
