@@ -1,0 +1,96 @@
+"""Compile-cost benchmark: loop vs lax.scan'd layer stack.
+
+Measures what ``LlamaConfig.scan_layers`` buys at depth: jaxpr trace +
+StableHLO lowering time, lowered-module text size, and XLA compile time
+for the bench 'large' shape (dim 1024, seq 2048) at several depths, using
+AOT lowering over ``jax.ShapeDtypeStruct`` avals — no parameters are
+materialized, so the measurement isolates program size from memory.
+
+Writes one JSON document (default ``SCAN_COMPILE_BENCH.json``) — the
+artifact backing PARITY.md's "O(1) HLO in depth" claim. Each row records
+the batch/seq it measured. Runs on local CPU XLA (forced before backend
+init — the axon sitecustomize pin ignores env vars, CLAUDE.md): the CPU
+backend lowers the same HLO graph shapes the TPU backend would (backend
+codegen differs; the *scaling* with depth is the claim).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from dataclasses import replace
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+
+
+def _measure(config, batch: int = 1, seq: int = 512) -> dict:
+    from torchft_tpu.models.llama import Llama, cross_entropy_loss
+
+    model = Llama(config)
+    tokens = jax.ShapeDtypeStruct((batch, seq + 1), jnp.int32)
+
+    # Abstract init: param avals without allocating anything.
+    params = jax.eval_shape(
+        model.init, jax.random.PRNGKey(0), jax.ShapeDtypeStruct((batch, seq), jnp.int32)
+    )
+
+    def loss_fn(p, toks):
+        logits = model.apply(p, toks[:, :-1])
+        return cross_entropy_loss(logits, toks[:, 1:])
+
+    grad_fn = jax.jit(jax.value_and_grad(loss_fn))
+
+    t0 = time.perf_counter()
+    lowered = grad_fn.lower(params, tokens)
+    t_lower = time.perf_counter() - t0
+    hlo_bytes = len(lowered.as_text())
+    t0 = time.perf_counter()
+    lowered.compile()
+    t_compile = time.perf_counter() - t0
+    return {
+        "batch": batch,
+        "seq": seq,
+        "lower_s": round(t_lower, 3),
+        "hlo_bytes": hlo_bytes,
+        "compile_s": round(t_compile, 3),
+    }
+
+
+def main() -> None:
+    from torchft_tpu.models.llama import LlamaConfig
+
+    out = sys.argv[1] if len(sys.argv) > 1 else "SCAN_COMPILE_BENCH.json"
+    # The bench 'large' dims; seq 512 keeps the 1-core XLA compile
+    # tractable (rows record it — program size scaling with DEPTH is the
+    # claim, and depth is what varies).
+    base = LlamaConfig(
+        vocab_size=32768, dim=1024, n_layers=24, n_heads=16, n_kv_heads=8,
+        ffn_hidden=4096, max_seq_len=2048, dtype=jnp.bfloat16,
+    )
+    results = {"device_kind": jax.devices()[0].platform, "rows": []}
+    for n_layers in (6, 12, 24):
+        cfg = replace(base, n_layers=n_layers)
+        row = {"n_layers": n_layers}
+        row["loop"] = _measure(cfg)
+        row["scan"] = _measure(replace(cfg, scan_layers=True))
+        row["hlo_ratio_loop_over_scan"] = round(
+            row["loop"]["hlo_bytes"] / row["scan"]["hlo_bytes"], 2
+        )
+        results["rows"].append(row)
+        print(json.dumps(row), flush=True)
+    with open(out, "w") as f:
+        json.dump(results, f, indent=2)
+        f.write("\n")
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -535,3 +535,48 @@ def test_microbatch_grad_matches_full_batch() -> None:
         assert "not divisible" in str(e)
     else:
         raise AssertionError("expected ValueError for indivisible batch")
+
+
+def test_device_prefetcher_orders_places_and_propagates() -> None:
+    """DevicePrefetcher: preserves order, lands batches on device (with a
+    NamedSharding when given), re-raises source exceptions, and close()
+    unblocks a producer stalled on a full queue."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from torchft_tpu.data import DevicePrefetcher
+
+    batches = [
+        {"x": np.full((8, 4), i, np.float32), "y": np.arange(8) + i}
+        for i in range(5)
+    ]
+    mesh = Mesh(np.array(jax.devices()[:4]), ("dp",))
+    sharding = NamedSharding(mesh, P("dp"))
+    with DevicePrefetcher(iter(batches), depth=2, sharding=sharding) as pf:
+        got = list(pf)
+    assert len(got) == 5
+    for i, b in enumerate(got):
+        assert float(b["x"][0, 0]) == i  # order preserved
+        assert isinstance(b["x"], jax.Array)
+        assert b["x"].sharding == sharding
+
+    # Source exception surfaces at the consumer.
+    def boom():
+        yield np.zeros(2)
+        raise RuntimeError("loader died")
+
+    pf = DevicePrefetcher(boom(), depth=1)
+    next(pf)
+    with pytest.raises(RuntimeError, match="loader died"):
+        next(pf)
+
+    # close() releases a producer blocked on the full queue (depth=1,
+    # many batches) and the thread terminates.
+    pf = DevicePrefetcher((np.zeros(2) for _ in range(100)), depth=1)
+    next(pf)
+    pf.close()
+    pf._thread.join(timeout=5)
+    assert not pf._thread.is_alive()
+    with pytest.raises(StopIteration):
+        next(pf)
