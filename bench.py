@@ -398,6 +398,30 @@ def main() -> None:
     peak = _peak_tflops(jax.devices()[0])
     mfu_pct = round(100.0 * model_tflops / peak, 2) if peak else None
 
+    # The degraded fallback's ratios amortize fixed RPC costs against a
+    # deliberately tiny deadline-bounded run — the worst case. When a
+    # committed non-degraded CPU artifact exists (generated by the
+    # TPUFT_BENCH_CHILD=cpu-full mode, which takes minutes), surface its
+    # measured numbers alongside so the driver's one line carries the
+    # representative figure too, labeled with its provenance.
+    cpu_full_ref = None
+    if DEGRADED:
+        import glob
+
+        candidates = sorted(glob.glob(str(Path(__file__).parent / "BENCH_CPU_FULL_*.json")))
+        if candidates:
+            try:
+                with open(candidates[-1]) as f:
+                    full = json.load(f)
+                cpu_full_ref = {
+                    "artifact": os.path.basename(candidates[-1]),
+                    "vs_baseline": full.get("vs_baseline"),
+                    "ft_ddp_vs_baseline": full.get("ft_ddp_vs_baseline"),
+                    "n_params": full.get("n_params"),
+                }
+            except (OSError, json.JSONDecodeError):
+                pass
+
     print(
         json.dumps(
             {
@@ -418,6 +442,7 @@ def main() -> None:
                 "flash_kernel_on_chip": flash_on_chip,
                 "quant_kernel_on_chip": quant_on_chip,
                 "quorum_p50_ms": quorum_p50_ms,
+                **({"cpu_full_reference": cpu_full_ref} if cpu_full_ref else {}),
                 **two_group,
             }
         )
