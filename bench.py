@@ -199,8 +199,10 @@ def main() -> None:
             # O(1) HLO in depth: the remote-compile tunnel is the large
             # config's main risk. No remat — recompute FLOPs aren't in the
             # 6N formula and would skew the MFU datum (400M/seq-2048
-            # activations fit without it).
+            # activations fit without it). The fused CE removes the 2 GiB
+            # f32 logits without changing counted FLOPs.
             scan_layers=True,
+            loss_vocab_chunk=4096,
         )
         sync_every_cap = 10**9
     else:
@@ -224,6 +226,12 @@ def main() -> None:
     tx = optax.sgd(0.01, momentum=0.9)
 
     def loss_fn(p, batch_tokens):
+        if config.loss_vocab_chunk is not None:
+            # Fused linear+CE: the (b, s, vocab) logits never materialize
+            # (ops/cross_entropy.py) — same FLOPs, so no MFU skew.
+            return model.apply(
+                p, batch_tokens[:, :-1], targets=batch_tokens[:, 1:]
+            )
         logits = model.apply(p, batch_tokens[:, :-1])
         return cross_entropy_loss(logits, batch_tokens[:, 1:])
 

@@ -77,6 +77,10 @@ class LlamaConfig:
     # elementwise/VPU work (jax.checkpoint_policies.checkpoint_dots) —
     # usually the right TPU default when activations don't fit.
     remat: str = "none"
+    # Vocab slab width for the fused linear+CE loss path (``targets=`` in
+    # __call__): the (b, s, vocab) logits — 8 GiB at 8x2048x128k f32 —
+    # are never materialized (ops/cross_entropy.py). None = dense CE.
+    loss_vocab_chunk: Optional[int] = None
     # lax.scan over the layer stack: one traced/compiled Block for the
     # whole depth instead of n_layers inlined copies — O(1) HLO size and
     # compile time in depth (matters at 80 layers). Params gain a leading
@@ -287,12 +291,49 @@ class _ScanCell(nn.Module):
         return Block(self.config, name="block")(x, positions), None
 
 
-class Llama(nn.Module):
+class _LMHead(nn.Module):
+    """The output projection, param-compatible with ``nn.Dense`` (same
+    ``lm_head/kernel`` path, lecun-normal init, dtype promotion): owning
+    the kernel directly lets the fused loss path hand it to
+    :func:`~torchft_tpu.ops.cross_entropy.chunked_cross_entropy` without
+    ever forming the logits."""
+
     config: LlamaConfig
 
     @nn.compact
     def __call__(
-        self, tokens: jnp.ndarray, positions: Optional[jnp.ndarray] = None
+        self,
+        x: jnp.ndarray,
+        targets: Optional[jnp.ndarray] = None,
+    ) -> jnp.ndarray:
+        cfg = self.config
+        kernel = self.param(
+            "kernel",
+            nn.initializers.lecun_normal(),
+            (cfg.dim, cfg.vocab_size),
+            cfg.dtype,
+        )
+        if targets is None:
+            return jnp.dot(x, kernel.astype(cfg.dtype))
+        from torchft_tpu.ops.cross_entropy import chunked_cross_entropy
+
+        return chunked_cross_entropy(x, kernel, targets, cfg.loss_vocab_chunk)
+
+
+class Llama(nn.Module):
+    """Callable two ways: ``apply(params, tokens)`` returns logits;
+    ``apply(params, tokens, targets=targets)`` returns the mean token
+    cross-entropy directly — with ``config.loss_vocab_chunk`` set, via the
+    fused linear+CE that never materializes the logits."""
+
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(
+        self,
+        tokens: jnp.ndarray,
+        positions: Optional[jnp.ndarray] = None,
+        targets: Optional[jnp.ndarray] = None,
     ) -> jnp.ndarray:
         cfg = self.config
         if positions is None:
@@ -327,13 +368,18 @@ class Llama(nn.Module):
             for layer in range(cfg.n_layers):
                 x = block(cfg, name=f"layer_{layer}")(x, positions)
         x = RMSNorm(cfg.norm_eps, cfg.dtype, name="final_norm")(x)
+        if targets is not None:
+            from torchft_tpu.ops.cross_entropy import chunked_cross_entropy
+
+            if cfg.tie_embeddings:
+                return chunked_cross_entropy(
+                    x, embed.embedding.T, targets, cfg.loss_vocab_chunk
+                )
+            return _LMHead(cfg, name="lm_head")(x, targets)
         if cfg.tie_embeddings:
             logits = embed.attend(x)
         else:
-            logits = nn.Dense(
-                cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
-                param_dtype=cfg.dtype, name="lm_head",
-            )(x)
+            logits = _LMHead(cfg, name="lm_head")(x)
         return logits.astype(jnp.float32)
 
 
