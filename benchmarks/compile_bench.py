@@ -31,22 +31,30 @@ jax.config.update("jax_platforms", "cpu")
 import jax.numpy as jnp
 
 
-def _measure(config, batch: int = 1, seq: int = 512) -> dict:
+def _abstract_step(config, batch: int, seq: int):
+    """(grad_fn, params, tokens) for one value_and_grad step over abstract
+    avals — nothing is allocated, so the measurement isolates program
+    shape from memory. Dispatches to the fused linear+CE when the config
+    selects it (loss_vocab_chunk), like the real train loops."""
     from torchft_tpu.models.llama import Llama, cross_entropy_loss
 
     model = Llama(config)
     tokens = jax.ShapeDtypeStruct((batch, seq + 1), jnp.int32)
-
-    # Abstract init: param avals without allocating anything.
     params = jax.eval_shape(
         model.init, jax.random.PRNGKey(0), jax.ShapeDtypeStruct((batch, seq), jnp.int32)
     )
 
     def loss_fn(p, toks):
+        if config.loss_vocab_chunk is not None:
+            return model.apply(p, toks[:, :-1], targets=toks[:, 1:])
         logits = model.apply(p, toks[:, :-1])
         return cross_entropy_loss(logits, toks[:, 1:])
 
-    grad_fn = jax.jit(jax.value_and_grad(loss_fn))
+    return jax.jit(jax.value_and_grad(loss_fn)), params, tokens
+
+
+def _measure(config, batch: int = 1, seq: int = 512) -> dict:
+    grad_fn, params, tokens = _abstract_step(config, batch, seq)
 
     t0 = time.perf_counter()
     lowered = grad_fn.lower(params, tokens)
@@ -61,6 +69,22 @@ def _measure(config, batch: int = 1, seq: int = 512) -> dict:
         "lower_s": round(t_lower, 3),
         "hlo_bytes": hlo_bytes,
         "compile_s": round(t_compile, 3),
+    }
+
+
+def _measure_memory(config, batch: int = 4, seq: int = 1024) -> dict:
+    """XLA temp-buffer bytes for one value_and_grad step — the compiler's
+    own accounting of peak intermediate memory (CompiledMemoryStats), the
+    honest CPU-side proxy for HBM pressure of the fused-CE and remat
+    paths."""
+    grad_fn, params, tokens = _abstract_step(config, batch, seq)
+    compiled = grad_fn.lower(params, tokens).compile()
+    stats = compiled.memory_analysis()
+    return {
+        "batch": batch,
+        "seq": seq,
+        "temp_bytes": int(stats.temp_size_in_bytes),
+        "temp_gib": round(stats.temp_size_in_bytes / 2**30, 3),
     }
 
 
@@ -86,6 +110,23 @@ def main() -> None:
         )
         results["rows"].append(row)
         print(json.dumps(row), flush=True)
+
+    # Peak intermediate memory: materialized CE vs fused CE vs fused+remat
+    # on the scanned 12-layer stack (vocab 32768 — the f32 logits alone are
+    # batch*seq*vocab*4 = 512 MiB at 4x1024).
+    mem_base = replace(base, n_layers=12, scan_layers=True)
+    mem = {
+        "materialized_ce": _measure_memory(mem_base),
+        "fused_ce": _measure_memory(replace(mem_base, loss_vocab_chunk=4096)),
+        "fused_ce_remat_dots": _measure_memory(
+            replace(mem_base, loss_vocab_chunk=4096, remat="dots")
+        ),
+    }
+    mem["fused_ce_savings_gib"] = round(
+        mem["materialized_ce"]["temp_gib"] - mem["fused_ce"]["temp_gib"], 3
+    )
+    results["memory"] = mem
+    print(json.dumps(mem), flush=True)
     with open(out, "w") as f:
         json.dump(results, f, indent=2)
         f.write("\n")
