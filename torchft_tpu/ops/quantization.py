@@ -1,27 +1,33 @@
-"""8-bit block quantization for bandwidth-compressed collectives.
+"""Block quantization (fp8/int8/int4) for bandwidth-compressed collectives.
 
 Role-equivalent of the reference's Triton kernels
 (/root/reference/torchft/quantization.py): rowwise/blockwise max-abs scales,
 8-bit payloads, and a fused dequantize-reduce-requantize used inside the
 quantized allreduce. Like the reference — which emits fp8e4nv on SM90+ and
-int8 on older GPUs — two wire formats share one layout:
+int8 on older GPUs — the wire formats share one layout:
 
 - ``"fp8"`` (float8_e4m3): wider per-block dynamic range;
 - ``"int8"``: symmetric round-to-nearest, finer resolution near the block
-  max and universally fast integer hardware.
+  max and universally fast integer hardware;
+- ``"int4"`` (beyond reference): symmetric [-7, 7] nibbles packed two per
+  byte — HALF the wire bytes of the 8-bit formats. The cross-DCN outer
+  syncs (DiLoCo pseudogradients) are the intended user; at 4 bits the
+  per-block resolution is coarse, so it is opt-in, never the default.
 
 Select per call or globally via ``TPUFT_WIRE_DTYPE``. The TPU build
 provides a numpy/jnp implementation (works everywhere; used for the
 host-side TCP collective wire format) and Pallas TPU kernels for the
 device-side hot path (``*_pallas``), exercised in interpret mode on CPU
-tests and compiled on real TPU.
+tests and compiled on real TPU. int4 uses the jnp device path on every
+backend (nibble packing is plain XLA integer ops; no Pallas kernel).
 
 Layout: arrays are flattened, padded to a multiple of ``block``, and viewed
 as ``(n_blocks, block)``; each block carries one float32 scale. The wire
 payload is ``scales || payload``, mirroring the reference's interleaved
-[scales||payload] slices. Both formats are 1 byte/element, so the wire
-framing is format-independent; the payload dtype rides in the arrays and
-every consumer (dequantize, reduce, unpack) dispatches on it.
+[scales||payload] slices. The 8-bit formats are 1 byte/element and int4
+is a packed uint8 ``(n_blocks, block // 2)``; ``payload_cols()`` gives the
+per-block wire width, and the payload dtype rides in the arrays so every
+consumer (dequantize, reduce, unpack) dispatches on it.
 """
 
 from __future__ import annotations
@@ -38,9 +44,11 @@ __all__ = [
     "BLOCK",
     "FP8_MAX",
     "INT8_MAX",
+    "INT4_MAX",
     "WIRE_DTYPE_ENV",
     "default_wire",
     "wire_of",
+    "payload_cols",
     "quantize_blocks",
     "dequantize_blocks",
     "reduce_quantized",
@@ -53,11 +61,41 @@ __all__ = [
 BLOCK = 256
 FP8_MAX = 448.0  # float8_e4m3fn dynamic range
 INT8_MAX = 127.0
+INT4_MAX = 7.0  # symmetric nibbles: [-7, 7], -8 never produced
 _FP8 = ml_dtypes.float8_e4m3fn
 WIRE_DTYPE_ENV = "TPUFT_WIRE_DTYPE"
 
-_WIRE_NP_DTYPES = {"fp8": np.dtype(_FP8), "int8": np.dtype(np.int8)}
-_WIRE_QMAX = {"fp8": FP8_MAX, "int8": INT8_MAX}
+# int4's payload is nibble-packed into uint8 — a dtype neither 8-bit
+# format uses, so dtype-dispatch (wire_of) stays unambiguous.
+_WIRE_NP_DTYPES = {
+    "fp8": np.dtype(_FP8),
+    "int8": np.dtype(np.int8),
+    "int4": np.dtype(np.uint8),
+}
+_WIRE_QMAX = {"fp8": FP8_MAX, "int8": INT8_MAX, "int4": INT4_MAX}
+
+
+def payload_cols(wire: str, block: int = BLOCK) -> int:
+    """Per-block wire payload width in bytes (int4 packs two per byte)."""
+    if wire == "int4" and block % 2:
+        raise ValueError(f"int4 requires an even block size, got {block}")
+    return block // 2 if wire == "int4" else block
+
+
+def _pack_int4_np(v: np.ndarray) -> np.ndarray:
+    """(n, block) int8 in [-7, 7] -> (n, block//2) uint8, low nibble first."""
+    u = v.astype(np.uint8) & 0xF
+    return (u[:, 0::2] | (u[:, 1::2] << 4)).astype(np.uint8)
+
+
+def _unpack_int4_np(p: np.ndarray) -> np.ndarray:
+    """Inverse of :func:`_pack_int4_np` with 4-bit sign extension."""
+    lo = p & 0xF
+    hi = (p >> 4) & 0xF
+    out = np.empty((p.shape[0], p.shape[1] * 2), np.uint8)
+    out[:, 0::2] = lo
+    out[:, 1::2] = hi
+    return ((out.astype(np.int16) ^ 8) - 8).astype(np.int8)
 
 
 def _resolve_wire(wire: "Optional[str]") -> str:
@@ -100,7 +138,9 @@ def _as_blocks(flat: np.ndarray, block: int = BLOCK) -> np.ndarray:
 def quantize_blocks(
     array: np.ndarray, block: int = BLOCK, wire: Optional[str] = None
 ) -> Tuple[np.ndarray, np.ndarray]:
-    """Returns (payload 8-bit (n_blocks, block), scales f32 (n_blocks,))."""
+    """Returns (payload (n_blocks, payload_cols(wire)), scales f32
+    (n_blocks,)) — 1 byte/element for fp8/int8, nibble-packed uint8 at
+    block//2 bytes for int4."""
     wire = _resolve_wire(wire)
     flat = np.ascontiguousarray(array).astype(np.float32).reshape(-1)
     blocks = _as_blocks(flat, block)
@@ -109,15 +149,25 @@ def quantize_blocks(
     scaled = blocks / scales[:, None]
     if wire == "int8":
         scaled = np.rint(scaled)
+    elif wire == "int4":
+        payload_cols(wire, block)  # validates even block
+        return _pack_int4_np(np.rint(scaled).astype(np.int8)), scales
     payload = scaled.astype(_WIRE_NP_DTYPES[wire])
     return payload, scales
+
+
+def _decode_payload_np(payload: np.ndarray) -> np.ndarray:
+    """Payload -> f32 block values (unpacks int4 by dtype dispatch)."""
+    if payload.dtype == np.uint8:
+        payload = _unpack_int4_np(payload)
+    return payload.astype(np.float32)
 
 
 def dequantize_blocks(
     payload: np.ndarray, scales: np.ndarray, shape: Tuple[int, ...], dtype: np.dtype
 ) -> np.ndarray:
     """Inverse of :func:`quantize_blocks` (drops padding)."""
-    blocks = payload.astype(np.float32) * scales[:, None]
+    blocks = _decode_payload_np(payload) * scales[:, None]
     size = int(np.prod(shape))
     return blocks.reshape(-1)[:size].reshape(shape).astype(dtype)
 
@@ -129,9 +179,9 @@ def reduce_quantized(
     (reference fused_reduce_fp8): accumulates in float32, emits a fresh
     payload + scales for the reduced result in the inputs' wire format."""
     wire = wire_of(payloads[0])
-    acc = payloads[0].astype(np.float32) * scales[0][:, None]
+    acc = _decode_payload_np(payloads[0]) * scales[0][:, None]
     for payload, scale in zip(payloads[1:], scales[1:]):
-        acc += payload.astype(np.float32) * scale[:, None]
+        acc += _decode_payload_np(payload) * scale[:, None]
     maxabs = np.max(np.abs(acc), axis=1)
     out_scales = np.where(maxabs > 0, maxabs / _WIRE_QMAX[wire], 1.0).astype(
         np.float32
@@ -139,16 +189,18 @@ def reduce_quantized(
     out = acc / out_scales[:, None]
     if wire == "int8":
         out = np.rint(out)
+    elif wire == "int4":
+        return _pack_int4_np(np.rint(out).astype(np.int8)), out_scales
     out_payload = out.astype(_WIRE_NP_DTYPES[wire])
     return out_payload, out_scales
 
 
-_WIRE_TAGS = {"fp8": 0, "int8": 1}
+_WIRE_TAGS = {"fp8": 0, "int8": 1, "int4": 2}
 _TAG_WIRES = {tag: name for name, tag in _WIRE_TAGS.items()}
 
-# One leading byte identifies the payload format on the wire. Both formats
-# are 1 byte/element, so without it a cross-rank TPUFT_WIRE_DTYPE
-# disagreement would decode peers' fp8 bits as int8 (or vice versa) and
+# One leading byte identifies the payload format on the wire. The 8-bit
+# formats are byte-identical in size, so without it a cross-rank
+# TPUFT_WIRE_DTYPE disagreement would decode peers' fp8 bits as int8 and
 # silently corrupt the reduction; the tag turns that into a hard error.
 WIRE_HEADER_BYTES = 1
 
@@ -180,10 +232,11 @@ def unpack_arrays(
     body = buf[WIRE_HEADER_BYTES:]
     scale_bytes = n_blocks * 4
     scales = body[:scale_bytes].view(np.float32).copy()
+    cols = payload_cols(tag_wire, block)
     payload = (
-        body[scale_bytes : scale_bytes + n_blocks * block]
+        body[scale_bytes : scale_bytes + n_blocks * cols]
         .view(_WIRE_NP_DTYPES[tag_wire])
-        .reshape(n_blocks, block)
+        .reshape(n_blocks, cols)
         .copy()
     )
     return payload, scales
@@ -208,6 +261,10 @@ def quantize_blocks_pallas(
     from jax.experimental import pallas as pl
 
     wire = _resolve_wire(wire)
+    if wire == "int4":
+        raise ValueError(
+            "int4 has no Pallas kernel — use quantize_blocks_device (jnp path)"
+        )
     qmax = _WIRE_QMAX[wire]
     out_dtype = jnp.int8 if wire == "int8" else jnp.float8_e4m3fn
     n_blocks = x.shape[0]
@@ -244,11 +301,15 @@ def quantize_blocks_pallas(
 
 
 def dequantize_blocks_pallas(payload, scales, interpret: bool = False):
-    """Device-side blockwise fp8 dequantization to float32."""
+    """Device-side blockwise fp8/int8 dequantization to float32."""
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
+    if payload.dtype == jnp.uint8:
+        raise ValueError(
+            "packed int4 has no Pallas kernel — use dequantize_blocks_device"
+        )
     n_blocks, block = payload.shape
     rows_per_tile = min(n_blocks, 8)
 
@@ -271,8 +332,9 @@ def dequantize_blocks_pallas(payload, scales, interpret: bool = False):
 
 def quantize_blocks_device(x, block: int = BLOCK, wire: Optional[str] = None):
     """Device-side quantization of a flat array: pads to a block multiple,
-    returns (payload 8-bit (n_blocks, block), scales f32 (n_blocks,)). Uses
-    the Pallas kernel on TPU, a jitted jnp path elsewhere."""
+    returns (payload (n_blocks, payload_cols(wire)), scales f32
+    (n_blocks,)). Uses the Pallas kernel on TPU (fp8/int8), a jitted jnp
+    path elsewhere and for packed int4."""
     import jax
     import jax.numpy as jnp
 
@@ -282,7 +344,7 @@ def quantize_blocks_device(x, block: int = BLOCK, wire: Optional[str] = None):
     if pad:
         flat = jnp.concatenate([flat, jnp.zeros(pad, dtype=flat.dtype)])
     blocks = flat.reshape(-1, block).astype(jnp.float32)
-    if on_tpu():
+    if on_tpu() and wire != "int4":
         return quantize_blocks_pallas(blocks, block, wire=wire)
     maxabs = jnp.max(jnp.abs(blocks), axis=1)
     scales = jnp.where(maxabs > 0, maxabs / _WIRE_QMAX[wire], 1.0).astype(
@@ -291,6 +353,10 @@ def quantize_blocks_device(x, block: int = BLOCK, wire: Optional[str] = None):
     scaled = blocks / scales[:, None]
     if wire == "int8":
         scaled = jnp.round(scaled)
+    elif wire == "int4":
+        # Nibble-pack on device: plain XLA integer ops, no Pallas kernel.
+        u = jnp.round(scaled).astype(jnp.int8).astype(jnp.uint8) & 0xF
+        return (u[:, 0::2] | (u[:, 1::2] << 4)).astype(jnp.uint8), scales
     payload = scaled.astype(jnp.int8 if wire == "int8" else jnp.float8_e4m3fn)
     return payload, scales
 
@@ -300,7 +366,13 @@ def dequantize_blocks_device(payload, scales):
     import jax
     import jax.numpy as jnp
 
-    if on_tpu():
+    if payload.dtype == jnp.uint8:  # packed int4: unpack with sign extension
+        lo = payload & 0xF
+        hi = (payload >> 4) & 0xF
+        both = jnp.stack([lo, hi], axis=-1).reshape(payload.shape[0], -1)
+        vals = (both.astype(jnp.int16) ^ 8) - 8
+        out = vals.astype(jnp.float32) * scales[:, None]
+    elif on_tpu():
         out = dequantize_blocks_pallas(payload, scales)
     else:
         out = payload.astype(jnp.float32) * scales[:, None]
@@ -349,7 +421,7 @@ def make_tree_fp8_codec(leaves, wire: Optional[str] = None):
 
 def verify_on_chip() -> dict:
     """Compile (not interpret) the Pallas codec kernels on the attached TPU
-    — both wire formats — and check them against the host reference codec:
+    — every wire format — and check them against the host reference codec:
     the CLAUDE.md 'verify kernels on the real chip' gate, automated like
     flash_attention.verify_on_chip:
 

@@ -6,8 +6,9 @@ Role-equivalent of the reference's ``torchft/collectives.py:159-415``:
     quantize -> alltoall of per-rank block chunks -> fused local
     dequantize-reduce-requantize -> allgather -> dequantize into outputs
 
-Wire traffic is an 8-bit payload (fp8 e4m3 or int8 — ``TPUFT_WIRE_DTYPE``,
-matching the reference's fp8-on-SM90+/int8-below dual format) + f32
+Wire traffic is a quantized payload (fp8 e4m3 / int8, matching the
+reference's fp8-on-SM90+/int8-below dual format, or opt-in packed int4 at
+half the bytes — ``TPUFT_WIRE_DTYPE``) + f32
 per-block scales, ~4x smaller than f32 both directions. SUM/AVG only, like
 the reference. The quantization math lives in
 :mod:`torchft_tpu.ops.quantization` (numpy here; Pallas kernels for the
@@ -81,7 +82,7 @@ def _split_wire(buf: np.ndarray, metas: List[dict]) -> List[Tuple[np.ndarray, np
     offset = 0
     for meta in metas:
         nb = meta["blocks_per_rank"]
-        length = q.WIRE_HEADER_BYTES + nb * 4 + nb * q.BLOCK
+        length = q.WIRE_HEADER_BYTES + nb * 4 + nb * q.payload_cols(meta["wire"])
         payload, scales = q.unpack_arrays(
             buf[offset : offset + length], nb, wire=meta["wire"]
         )
@@ -98,7 +99,7 @@ def allreduce_quantized(
 ) -> Work:
     """8-bit allreduce (reference collectives.py:297-415). Resolves to the
     reduced arrays in their original dtypes/shapes. SUM and AVG only;
-    ``wire_dtype`` is "fp8"/"int8" (default ``TPUFT_WIRE_DTYPE``/fp8 — all
+    ``wire_dtype`` is "fp8"/"int8"/"int4" (default ``TPUFT_WIRE_DTYPE``/fp8 — all
     replicas must agree, exactly as the reference's SM90 autodetect picks
     one format per job)."""
     if reduce_op not in (ReduceOp.SUM, ReduceOp.AVG):
@@ -175,7 +176,7 @@ def reduce_scatter_quantized(
             out_payload, out_scales = q.reduce_quantized(payloads, scales)
             if reduce_op == ReduceOp.AVG:
                 out_scales = (out_scales / world_size).astype(np.float32)
-            chunk = out_payload.astype(np.float32) * out_scales[:, None]
+            chunk = q._decode_payload_np(out_payload) * out_scales[:, None]
             outputs.append(chunk.reshape(-1))
         return outputs
 
@@ -191,7 +192,7 @@ def allreduce_quantized_wire(
     """Allreduce of ALREADY-quantized data, staying quantized end to end.
 
     The caller quantized on device (Pallas) and ships only the 8-bit
-    payload (fp8 or int8 — read from the payload dtype, so explicit-wire
+    payload (fp8/int8/packed int4 — read from the payload dtype, so explicit-wire
     codecs never mismatch the env default) + f32 block scales across the
     host boundary; this exchanges the chunks (alltoall), does the fused
     dequant-reduce-requant per chunk, allgathers, and resolves to the
