@@ -158,7 +158,11 @@ def main() -> None:
     parser.add_argument("--sync-every", type=int, default=8)
     parser.add_argument("--fragments", type=int, default=2)
     parser.add_argument("--fragment-sync-delay", type=int, default=0)
-    parser.add_argument("--quantize", action="store_true", help="fp8 allreduce")
+    parser.add_argument(
+        "--quantize", action="store_true",
+        help="quantized outer syncs (wire format via TPUFT_WIRE_DTYPE: "
+        "fp8 default, int8, or packed int4 at half the bytes)",
+    )
     parser.add_argument("--hidden", type=int, default=128)
     parser.add_argument("--batch-size", type=int, default=32)
     parser.add_argument("--timeout", type=float, default=30.0)
