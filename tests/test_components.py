@@ -580,3 +580,41 @@ def test_device_prefetcher_orders_places_and_propagates() -> None:
     assert not pf._thread.is_alive()
     with pytest.raises(StopIteration):
         next(pf)
+
+
+def test_flight_recorder_ring_and_dump(tmp_path, monkeypatch) -> None:
+    """Ring records bounded entries, dump() writes JSONL, and the
+    TPUFT_FLIGHT_RECORDER env turns failure hooks into dumps (the
+    reference's TRIGGER_FR_ON_ABORT semantics)."""
+    import json
+
+    from torchft_tpu.utils import flight_recorder as fr
+
+    fr.record("test", "hello", op="allreduce", n=3)
+    entries = fr.snapshot()
+    assert entries[-1]["event"] == "hello" and entries[-1]["op"] == "allreduce"
+    seqs = [e["seq"] for e in entries]
+    assert seqs == sorted(seqs)
+
+    # Explicit dump path.
+    path = tmp_path / "fr.jsonl"
+    fr.dump(str(path), reason="unit")
+    lines = [json.loads(l) for l in path.read_text().splitlines()]
+    assert lines[0]["flight_recorder_dump_reason"] == "unit"
+    assert any(e.get("event") == "hello" for e in lines[1:])
+
+    # Without the env, failure hooks are silent; with it, they dump.
+    monkeypatch.delenv(fr.ENV_DIR, raising=False)
+    assert fr.dump_on_failure("test", "no-env") is None
+    monkeypatch.setenv(fr.ENV_DIR, str(tmp_path / "frdir"))
+    out = fr.dump_on_failure("test", "boom")
+    assert out is not None
+    dumped = [json.loads(l) for l in open(out)]
+    assert any(
+        e.get("event") == "failure" and e.get("reason") == "boom"
+        for e in dumped
+    )
+
+    # Non-JSON detail values are coerced, never raise.
+    fr.record("test", "weird", obj=object())
+    fr.dump(str(path))

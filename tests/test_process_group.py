@@ -483,3 +483,30 @@ def test_tcp_ring_allreduce_large_payloads(store_server, world_size) -> None:
     finally:
         for pg in pgs:
             pg.shutdown()
+
+
+def test_flight_recorder_captures_collective_ops(store_server) -> None:
+    """Real TCP PG ops land in the flight-recorder ring with the
+    collective's name (submit + op_done), and abort records a failure."""
+    from torchft_tpu.utils import flight_recorder as fr
+
+    pgs = make_group(store_server, 2)
+    try:
+        prior = fr.snapshot()
+        # seq-based cut, not index-based: the global ring may already be at
+        # maxlen from earlier tests, where list indices stop advancing.
+        last_seq = prior[-1]["seq"] if prior else -1
+        run_on_all(
+            pgs,
+            lambda pg, i: pg.allreduce(
+                [np.ones(8, np.float32)], ReduceOp.SUM
+            ).wait(),
+        )
+        events = [e for e in fr.snapshot() if e["seq"] > last_seq]
+        ops = [e for e in events if e["source"] == "pg_tcp"]
+        assert any(e["event"] == "submit" and e["op"] == "allreduce" for e in ops)
+        done = [e for e in ops if e["event"] == "op_done"]
+        assert done and all(e["op"] == "allreduce" and e["ms"] >= 0 for e in done)
+    finally:
+        for pg in pgs:
+            pg.shutdown()

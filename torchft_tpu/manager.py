@@ -491,6 +491,12 @@ class Manager:
                 "error": str(e),
             },
         )
+        from torchft_tpu.utils import flight_recorder
+
+        flight_recorder.dump_on_failure(
+            "manager",
+            f"report_error step={self._step} quorum={self._quorum_id}: {e}",
+        )
 
     def errored(self) -> Optional[ExceptionWithTraceback]:
         return self._errored

@@ -18,12 +18,14 @@ import ctypes
 import logging
 import queue
 import threading
+import time
 from concurrent.futures import Future
 from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
 from torchft_tpu import _native
+from torchft_tpu.utils import flight_recorder as fr
 from torchft_tpu.parallel.process_group import (
     ProcessGroup,
     ReduceOp,
@@ -128,6 +130,10 @@ class ProcessGroupNative(ProcessGroup):
     def configure(
         self, store_addr: str, replica_id: str, rank: int, world_size: int
     ) -> None:
+        fr.record(
+            "pg_native", "configure", replica_id=replica_id, rank=rank,
+            world_size=world_size,
+        )
         self._teardown()
         self._errored_exc = None
         self._rank = rank
@@ -189,6 +195,7 @@ class ProcessGroupNative(ProcessGroup):
     def abort(self) -> None:
         self._errored_exc = self._errored_exc or RuntimeError("process group aborted")
         self._teardown()
+        fr.dump_on_failure("pg_native", f"abort rank={self._rank}")
 
     def shutdown(self) -> None:
         self._teardown()
@@ -211,6 +218,8 @@ class ProcessGroupNative(ProcessGroup):
         if self._errored_exc is not None:
             raise RuntimeError(f"process group in error state: {self._errored_exc}")
         fut: Future = Future()
+        op = fr.op_name_of(fn)
+        fr.record("pg_native", "submit", op=op, rank=self._rank)
         # Read handle/queue and enqueue under the lock so a concurrent
         # _teardown cannot slip its None sentinel in between (which would
         # strand this op's future unresolved forever).
@@ -220,6 +229,7 @@ class ProcessGroupNative(ProcessGroup):
                 raise RuntimeError("process group not configured")
 
             def run() -> None:
+                start = time.monotonic()
                 try:
                     fut.set_result(fn(handle))
                 except BaseException as e:  # noqa: BLE001
@@ -227,7 +237,15 @@ class ProcessGroupNative(ProcessGroup):
                         self._errored_exc = (
                             e if isinstance(e, Exception) else RuntimeError(str(e))
                         )
+                    # Resolve the waiter FIRST: a raising record() must not
+                    # strand the future or kill the op-worker thread.
                     fut.set_exception(e)
+                    fr.record("pg_native", "op_error", op=op, rank=self._rank, error=e)
+                else:
+                    fr.record(
+                        "pg_native", "op_done", op=op, rank=self._rank,
+                        ms=round(1e3 * (time.monotonic() - start), 2),
+                    )
 
             ops.put(run)
         return Work(fut)

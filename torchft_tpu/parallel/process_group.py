@@ -42,6 +42,7 @@ import numpy as np
 from torchft_tpu._safe_pickle import safe_loads
 
 from torchft_tpu.parallel.store import StoreClient, create_store_client
+from torchft_tpu.utils import flight_recorder as fr
 from torchft_tpu.work import Work, _DummyWork
 
 logger = logging.getLogger(__name__)
@@ -374,6 +375,10 @@ class ProcessGroupTCP(ProcessGroup):
     def configure(
         self, store_addr: str, replica_id: str, rank: int, world_size: int
     ) -> None:
+        fr.record(
+            "pg_tcp", "configure", replica_id=replica_id, rank=rank,
+            world_size=world_size,
+        )
         with self._configure_lock:
             old = self._epoch
             self._epoch = None
@@ -399,6 +404,7 @@ class ProcessGroupTCP(ProcessGroup):
         if epoch is not None:
             logger.warning("process_group_abort rank=%d", self._rank)
             epoch.close()
+        fr.dump_on_failure("pg_tcp", f"abort rank={self._rank}")
 
     def shutdown(self) -> None:
         epoch = self._epoch
@@ -424,16 +430,25 @@ class ProcessGroupTCP(ProcessGroup):
         if epoch is None:
             raise RuntimeError("process group not configured")
         deadline = time.monotonic() + self._timeout
+        op = fr.op_name_of(fn)
+        fr.record("pg_tcp", "submit", op=op, rank=self._rank)
 
         def run() -> object:
+            start = time.monotonic()
             try:
-                return fn(epoch, deadline)
+                result = fn(epoch, deadline)
             except BaseException as e:
                 # First failure poisons the group until reconfigure.
                 if self._errored is None:
                     self._errored = e if isinstance(e, Exception) else RuntimeError(str(e))
                 epoch.close()
+                fr.record("pg_tcp", "op_error", op=op, rank=self._rank, error=e)
                 raise
+            fr.record(
+                "pg_tcp", "op_done", op=op, rank=self._rank,
+                ms=round(1e3 * (time.monotonic() - start), 2),
+            )
+            return result
 
         return Work(epoch.submit(run))
 

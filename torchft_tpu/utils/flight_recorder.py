@@ -1,0 +1,121 @@
+"""In-memory flight recorder for post-mortem debugging of wedged or
+aborted collectives.
+
+Role-equivalent of the reference's NCCL flight-recorder hookup: on PG
+abort it triggers an NCCL FR trace dump through a pipe
+(/root/reference/torchft/process_group.py:93-107, gated by
+``TORCHFT_TRIGGER_FR_ON_ABORT``). TPU collectives have no NCCL FR, so
+the framework keeps its own bounded ring of recent events — every PG op
+submit/complete/error, configure, abort, and manager error funnels in —
+and dumps it as JSON lines when things go wrong.
+
+Always on (a deque append per event is noise next to any wire op); the
+DUMP is opt-in: set ``TPUFT_FLIGHT_RECORDER`` to a directory and every
+abort / reported error writes ``tpuft_fr_<pid>.jsonl`` there. ``dump()``
+can also be called explicitly with a path (e.g. from a debugger or a
+supervisor's crash handler).
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import json
+import os
+import threading
+import time
+from typing import Any, Deque, Dict, List, Optional
+
+__all__ = ["record", "dump", "dump_on_failure", "snapshot", "ENV_DIR"]
+
+ENV_DIR = "TPUFT_FLIGHT_RECORDER"
+ENV_SIZE = "TPUFT_FLIGHT_RECORDER_SIZE"
+
+def _ring_size() -> int:
+    try:
+        return max(16, int(os.environ.get(ENV_SIZE, "2048")))
+    except ValueError:
+        return 2048  # malformed env must not break package import
+
+
+_RING: Deque[Dict[str, Any]] = collections.deque(maxlen=_ring_size())
+_SEQ = itertools.count()
+_DUMP_LOCK = threading.Lock()
+
+
+def _jsonable(value: Any) -> Any:
+    if isinstance(value, (str, int, float, bool, type(None))):
+        return value
+    try:
+        return repr(value)
+    except Exception:  # pathological __repr__ on an exception object
+        return f"<unreprable {type(value).__name__}>"
+
+
+def record(source: str, event: str, **detail: Any) -> None:
+    """Appends one entry to the ring. Thread-safe (deque appends are
+    atomic); ``detail`` values are coerced to JSON-safe scalars. Never
+    raises — it is called from failure paths that must stay clean."""
+    try:
+        _RING.append(
+            {
+                "seq": next(_SEQ),
+                "ts": time.time(),
+                "thread": threading.current_thread().name,
+                "source": source,
+                "event": event,
+                **{k: _jsonable(v) for k, v in detail.items()},
+            }
+        )
+    except Exception:
+        pass
+
+
+def snapshot() -> List[Dict[str, Any]]:
+    """A consistent copy of the current ring (oldest first)."""
+    return list(_RING)
+
+
+def dump(path: Optional[str] = None, reason: str = "") -> Optional[str]:
+    """Writes the ring as JSON lines. With no ``path``, uses a fresh
+    ``$TPUFT_FLIGHT_RECORDER/tpuft_fr_<pid>_<ns>.jsonl`` — or does
+    nothing (returns None) when the env is unset. Returns the path."""
+    if path is None:
+        directory = os.environ.get(ENV_DIR, "")
+        if not directory:
+            return None
+        os.makedirs(directory, exist_ok=True)
+        # Unique per dump: a later failure must not overwrite the first
+        # (root-cause) trace — the ring has usually wrapped by then.
+        path = os.path.join(
+            directory, f"tpuft_fr_{os.getpid()}_{time.time_ns()}.jsonl"
+        )
+    entries = snapshot()
+    with _DUMP_LOCK, open(path, "w") as f:
+        if reason:
+            f.write(json.dumps({"flight_recorder_dump_reason": reason}) + "\n")
+        for entry in entries:
+            f.write(json.dumps(entry) + "\n")
+    return path
+
+
+def dump_on_failure(source: str, reason: str) -> Optional[str]:
+    """The abort/error hook: records the failure, then dumps iff
+    ``TPUFT_FLIGHT_RECORDER`` is set (the reference's
+    TRIGGER_FR_ON_ABORT semantics). Never raises — this runs on failure
+    paths that must stay clean."""
+    record(source, "failure", reason=reason)
+    try:
+        return dump(reason=f"{source}: {reason}")
+    except OSError:
+        return None
+
+
+def op_name_of(fn: Any) -> str:
+    """Collective name from a closure defined inside a PG method:
+    'ProcessGroupTCP.allreduce.<locals>.run' -> 'allreduce'."""
+    qualname = getattr(fn, "__qualname__", "")
+    parts = qualname.split(".")
+    if len(parts) >= 3 and parts[-2] == "<locals>":
+        return parts[-3]
+    return qualname or repr(fn)
