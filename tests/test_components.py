@@ -618,3 +618,48 @@ def test_flight_recorder_ring_and_dump(tmp_path, monkeypatch) -> None:
     # Non-JSON detail values are coerced, never raise.
     fr.record("test", "weird", obj=object())
     fr.dump(str(path))
+
+
+def test_doctor_checks_pass_and_catch_problems(monkeypatch, capsys) -> None:
+    """run_checks passes on a healthy box (live lighthouse), flags unknown
+    TPUFT_* vars, and KNOWN_ENV tracks every env var the tree reads."""
+    import re
+    import subprocess
+    from pathlib import Path
+
+    from torchft_tpu import doctor
+    from torchft_tpu.coordination import LighthouseServer
+
+    lh = LighthouseServer(min_replicas=1, join_timeout_ms=500)
+    try:
+        rc = doctor.run_checks(lh.address(), skip_device=True)
+    finally:
+        lh.shutdown()
+    out = capsys.readouterr().out
+    assert rc == 0 and "doctor: OK" in out
+    assert "lighthouse" in out and "answered" in out
+
+    monkeypatch.setenv("TPUFT_DEFINITELY_A_TYPO", "1")
+    rc = doctor.run_checks("", skip_device=True)
+    out = capsys.readouterr().out
+    assert "TPUFT_DEFINITELY_A_TYPO" in out
+
+    monkeypatch.delenv("TPUFT_DEFINITELY_A_TYPO")
+    monkeypatch.setenv("TPUFT_WIRE_DTYPE", "fp4")
+    rc = doctor.run_checks("", skip_device=True)
+    out = capsys.readouterr().out
+    assert rc == 1 and "TPUFT_WIRE_DTYPE" in out
+    monkeypatch.delenv("TPUFT_WIRE_DTYPE")
+
+    # Drift guard: every TPUFT_* name used anywhere in the repo (package,
+    # tests, benchmarks, scripts, top-level drivers) must be declared in
+    # doctor.KNOWN_ENV, or doctor would cry typo on a real knob.
+    repo = Path(doctor.__file__).parent.parent
+    used = set()
+    for sub in ("torchft_tpu", "tests", "benchmarks", "scripts"):
+        for py in (repo / sub).rglob("*.py"):
+            used |= set(re.findall(r"TPUFT_[A-Z_0-9]+", py.read_text()))
+    for top in ("bench.py", "__graft_entry__.py"):
+        used |= set(re.findall(r"TPUFT_[A-Z_0-9]+", (repo / top).read_text()))
+    missing = used - doctor.KNOWN_ENV - {"TPUFT_", "TPUFT_DEFINITELY_A_TYPO"}
+    assert not missing, f"doctor.KNOWN_ENV missing: {sorted(missing)}"

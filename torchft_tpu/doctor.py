@@ -1,0 +1,185 @@
+"""Preflight diagnostics: ``python -m torchft_tpu.doctor``.
+
+Checks the things that actually break real deployments — native plane,
+control-plane connectivity, accelerator backend, kernel sanity, env-var
+typos — and prints one PASS/WARN/FAIL line each, exiting non-zero iff
+something FAILed. Beyond-reference ops tooling (torchft debugging leans
+on torchrun/NCCL envs; this stack's moving parts are different), built
+from the failure modes the round logs actually hit: dead relay backends,
+unbuildable native lib, unreachable lighthouse, misspelled ``TPUFT_*``
+vars silently ignored.
+
+Usage::
+
+    python -m torchft_tpu.doctor [--lighthouse host:port] [--skip-device]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Callable, List, Tuple
+
+# Everything this process recognizes; drift is caught by the test that
+# greps the tree for os.environ reads of TPUFT_* names.
+KNOWN_ENV = {
+    "TPUFT_LIGHTHOUSE", "TPUFT_MANAGER_PORT", "TPUFT_TIMEOUT_SEC",
+    "TPUFT_QUORUM_TIMEOUT_SEC", "TPUFT_CONNECT_TIMEOUT_SEC",
+    "TPUFT_QUORUM_RETRIES", "TPUFT_WATCHDOG_TIMEOUT_SEC", "TPUFT_BUCKET_MB",
+    "TPUFT_TELEMETRY", "TPUFT_LOG", "TPUFT_STORE_ADDR", "TPUFT_WIRE_DTYPE",
+    "TPUFT_JAX_COORDINATOR", "TPUFT_TCP_RING_MIN_MB", "TPUFT_TRACE_LOG",
+    "TPUFT_NATIVE_LIB", "TPUFT_ALLOW_UNSAFE_PICKLE", "TPUFT_SOAK",
+    "TPUFT_FLIGHT_RECORDER", "TPUFT_FLIGHT_RECORDER_SIZE",
+    "TPUFT_HEARTBEAT_INTERVAL", "TPUFT_INIT_SYNC", "TPUFT_BENCH_CHILD",
+    "TPUFT_BENCH_MODEL", "TPUFT_BENCH_STEPS", "TPUFT_BENCH_BATCH",
+    "TPUFT_BENCH_SEQ", "TPUFT_BENCH_SYNC_EVERY", "TPUFT_BENCH_SYNC_DELAY",
+    "TPUFT_BENCH_TPU_DEADLINE", "TPUFT_BENCH_TPU_DEADLINE_LARGE",
+    "TPUFT_BENCH_CPU_DEADLINE", "TPUFT_BENCH_NO_PROBE",
+    # Repo tooling outside the package (tests/benchmarks/sentinel) — real
+    # knobs a user may have exported; not typos.
+    "TPUFT_SOAK_SECONDS", "TPUFT_REGEN_FIXTURES", "TPUFT_SENTINEL_INTERVAL",
+    "TPUFT_TRANSPORT_BENCH_GB", "TPUFT_TRANSPORT_BENCH_MODE",
+    "TPUFT_TRANSPORT_BENCH_DEADLINE", "TPUFT_TRANSPORT_RSS_BOUND",
+}
+
+Check = Tuple[str, Callable[[], Tuple[str, str]]]  # name -> (status, detail)
+
+
+def _check_native() -> Tuple[str, str]:
+    from torchft_tpu import _native
+
+    path = _native.ensure_built()
+    return "PASS", f"libtpuft loaded ({path})"
+
+
+def _check_lighthouse(address: str) -> Tuple[str, str]:
+    if not address:
+        return "WARN", "no --lighthouse / TPUFT_LIGHTHOUSE set; skipped"
+    from torchft_tpu.coordination import LighthouseClient
+
+    client = LighthouseClient(address, connect_timeout=5.0)
+    status = client.status(timeout=5.0)
+    return (
+        "PASS",
+        f"lighthouse at {address} answered "
+        f"({len(status.members)} members, has_quorum={status.has_quorum})",
+    )
+
+
+def _check_store() -> Tuple[str, str]:
+    from torchft_tpu.parallel.store import StoreClient, StoreServer
+
+    server = StoreServer()
+    try:
+        client = StoreClient(server.address())
+        client.set("doctor/ping", b"ok")
+        if client.get("doctor/ping", timeout=5.0) != b"ok":
+            return "FAIL", "KV roundtrip returned wrong value"
+        return "PASS", "native KV store roundtrip ok"
+    finally:
+        server.shutdown()
+
+
+def _check_device() -> Tuple[str, str]:
+    import subprocess
+
+    from torchft_tpu.utils.platform import probe_accelerator
+
+    if probe_accelerator(timeout=120.0):
+        # Device detail from a deadline-bounded child, never in-process:
+        # the relay can wedge BETWEEN the probe and a naive jax.devices()
+        # here (its documented mid-run death mode), and the doctor must
+        # not hang — it is the tool for diagnosing exactly that.
+        detail = "device detail fetch timed out"
+        try:
+            out = subprocess.run(
+                [
+                    sys.executable, "-c",
+                    "import jax; d = jax.devices()[0];"
+                    "print(d.platform, d.device_kind)",
+                ],
+                timeout=60,
+                capture_output=True,
+                text=True,
+            )
+            if out.returncode == 0:
+                detail = out.stdout.strip()
+        except subprocess.TimeoutExpired:
+            pass
+        return "PASS", f"accelerator probe ok ({detail})"
+    return (
+        "WARN",
+        "accelerator probe failed (relay down or no TPU) — CPU fallback "
+        "paths still work; see CLAUDE.md relay notes",
+    )
+
+
+def _check_kernels() -> Tuple[str, str]:
+    import numpy as np
+
+    from torchft_tpu.ops import quantization as q
+
+    x = np.linspace(-3, 3, 1000, dtype=np.float32)
+    for wire in ("fp8", "int8", "int4"):
+        payload, scales = q.quantize_blocks(x, wire=wire)
+        back = q.dequantize_blocks(payload, scales, x.shape, x.dtype)
+        if not np.allclose(back, x, atol=0.5):
+            return "FAIL", f"{wire} codec roundtrip error"
+    return "PASS", "host wire codecs (fp8/int8/int4) roundtrip ok"
+
+
+def _check_env() -> Tuple[str, str]:
+    # Value validation first — a fatal misconfig must FAIL even when a
+    # typo'd var would also WARN.
+    wire = os.environ.get("TPUFT_WIRE_DTYPE")
+    if wire and wire not in ("fp8", "int8", "int4"):
+        return "FAIL", f"TPUFT_WIRE_DTYPE={wire!r} is invalid"
+    unknown = sorted(
+        name for name in os.environ
+        if name.startswith("TPUFT_") and name not in KNOWN_ENV
+    )
+    if unknown:
+        return "WARN", f"unrecognized TPUFT_* vars (typo?): {', '.join(unknown)}"
+    return "PASS", "TPUFT_* env vars recognized"
+
+
+def run_checks(lighthouse: str, skip_device: bool = False) -> int:
+    checks: List[Check] = [
+        ("native plane", _check_native),
+        ("kv store", _check_store),
+        ("wire codecs", _check_kernels),
+        ("env vars", _check_env),
+        ("lighthouse", lambda: _check_lighthouse(lighthouse)),
+    ]
+    if not skip_device:
+        checks.append(("accelerator", _check_device))
+    failed = False
+    for name, fn in checks:
+        try:
+            status, detail = fn()
+        except Exception as e:  # noqa: BLE001 — each check reports, never aborts
+            status, detail = "FAIL", f"{type(e).__name__}: {e}"
+        failed |= status == "FAIL"
+        print(f"[{status:4s}] {name}: {detail}", flush=True)
+    print("doctor: " + ("FAIL" if failed else "OK"))
+    return 1 if failed else 0
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--lighthouse",
+        default=os.environ.get("TPUFT_LIGHTHOUSE", ""),
+        help="lighthouse address to ping (default: $TPUFT_LIGHTHOUSE)",
+    )
+    parser.add_argument(
+        "--skip-device", action="store_true",
+        help="skip the accelerator probe (slow when the backend is wedged)",
+    )
+    args = parser.parse_args()
+    sys.exit(run_checks(args.lighthouse, skip_device=args.skip_device))
+
+
+if __name__ == "__main__":
+    main()
