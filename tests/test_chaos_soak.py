@@ -146,6 +146,9 @@ def test_chaos_soak_full_fault_menu(tmp_path) -> None:
                 # ~20 steps/s by the script's sleep).
                 "SOAK_STEPS": str(int(soak_seconds * 15)),
                 "TPUFT_LOG": "warn",
+                # Flight recorder armed: injected faults must leave
+                # post-mortem dumps behind (asserted below).
+                "TPUFT_FLIGHT_RECORDER": str(out_dir / "fr"),
             },
         )
     finally:
@@ -161,3 +164,12 @@ def test_chaos_soak_full_fault_menu(tmp_path) -> None:
     assert faults["count"] >= 2, f"soak injected only {faults['count']} faults"
     # Master invariant: bitwise-identical committed state across groups.
     assert digests[0] == digests[1], digests
+    # The recorder stays armed through the soak as a realism smoke: dumps
+    # appear only when a fault surfaces as a comm error (kills are often
+    # absorbed by quorum membership changes with no error path at all),
+    # so any dumps that did appear must be well-formed — the DETERMINISTIC
+    # dump assertion lives in test_manager_integ.py's injected-failure
+    # test, where report_error is guaranteed to fire.
+    for dump in (out_dir / "fr").glob("tpuft_fr_*.jsonl"):
+        entries = [json.loads(l) for l in dump.read_text().splitlines()]
+        assert entries and "flight_recorder_dump_reason" in entries[0]

@@ -127,7 +127,10 @@ def test_quorum_latency_north_star(lighthouse) -> None:
     assert p50_ms < 200.0, f"steady-state quorum p50 {p50_ms:.1f}ms >= 2x tick"
 
 
-def test_ddp_recovery_after_allreduce_failure(lighthouse) -> None:
+def test_ddp_recovery_after_allreduce_failure(lighthouse, tmp_path, monkeypatch) -> None:
+    # Arm the flight recorder: the injected failure is guaranteed to reach
+    # report_error, so exactly this test can assert the dump end to end.
+    monkeypatch.setenv("TPUFT_FLIGHT_RECORDER", str(tmp_path / "fr"))
     injector = EventInjector().fail_allreduce_at(group=0, step=1)
     runners = [
         Runner(
@@ -142,6 +145,14 @@ def test_ddp_recovery_after_allreduce_failure(lighthouse) -> None:
     results = run_replica_groups(runners, timeout=180)
     assert injector.count == 1
     assert_groups_converged(results, 4)
+
+    import json
+
+    dumps = list((tmp_path / "fr").glob("tpuft_fr_*.jsonl"))
+    assert dumps, "injected allreduce failure produced no flight-recorder dump"
+    entries = [json.loads(l) for l in dumps[0].read_text().splitlines()]
+    assert "flight_recorder_dump_reason" in entries[0]
+    assert any(e.get("source") == "manager" for e in entries[1:])
 
 
 def test_ddp_three_groups_two_failures(lighthouse) -> None:

@@ -11,7 +11,7 @@ and dumps it as JSON lines when things go wrong.
 
 Always on (a deque append per event is noise next to any wire op); the
 DUMP is opt-in: set ``TPUFT_FLIGHT_RECORDER`` to a directory and every
-abort / reported error writes ``tpuft_fr_<pid>.jsonl`` there. ``dump()``
+abort / reported error writes a fresh ``tpuft_fr_<pid>_<ns>.jsonl`` there. ``dump()``
 can also be called explicitly with a path (e.g. from a debugger or a
 supervisor's crash handler).
 """
@@ -91,11 +91,16 @@ def dump(path: Optional[str] = None, reason: str = "") -> Optional[str]:
             directory, f"tpuft_fr_{os.getpid()}_{time.time_ns()}.jsonl"
         )
     entries = snapshot()
-    with _DUMP_LOCK, open(path, "w") as f:
-        if reason:
-            f.write(json.dumps({"flight_recorder_dump_reason": reason}) + "\n")
-        for entry in entries:
-            f.write(json.dumps(entry) + "\n")
+    # Atomic: a chaos kill mid-dump must never leave a truncated JSONL at
+    # the final name (the soak asserts every surviving dump parses).
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with _DUMP_LOCK:
+        with open(tmp, "w") as f:
+            if reason:
+                f.write(json.dumps({"flight_recorder_dump_reason": reason}) + "\n")
+            for entry in entries:
+                f.write(json.dumps(entry) + "\n")
+        os.replace(tmp, path)
     return path
 
 
