@@ -63,7 +63,16 @@ def train(args: argparse.Namespace) -> None:
         heartbeat_interval=0.1,
     )
 
-    config = CONFIGS["tiny"]
+    from dataclasses import replace
+
+    # The 70B-class fit levers, composable with the HSDP sharding: scanned
+    # layer stack (O(1) HLO in depth), dots-remat, fused linear+CE.
+    config = replace(
+        CONFIGS["tiny"],
+        scan_layers=args.scan_layers,
+        remat="dots" if args.remat else "none",
+        loss_vocab_chunk=128 if args.fused_ce else None,
+    )
     model = Llama(config)
     tokens = jnp.zeros((args.batch_size, args.seq_len), dtype=jnp.int32)
     params = model.init(jax.random.PRNGKey(0), tokens)
@@ -86,6 +95,10 @@ def train(args: argparse.Namespace) -> None:
     opt = Optimizer(manager, optax.adamw(1e-3), params)
 
     def loss_fn(p, batch_tokens):
+        if config.loss_vocab_chunk is not None:
+            return model.apply(
+                p, batch_tokens[:, :-1], targets=batch_tokens[:, 1:]
+            )
         logits = model.apply(p, batch_tokens[:, :-1])
         return cross_entropy_loss(logits, batch_tokens[:, 1:])
 
@@ -143,14 +156,21 @@ def demo(args: argparse.Namespace) -> None:
 
     def spawn(group: int) -> subprocess.Popen:
         env = {**env_base, "REPLICA_GROUP_ID": str(group)}
-        return subprocess.Popen(
-            [
-                sys.executable, os.path.abspath(__file__),
-                "--steps", str(args.steps),
-                "--devices-per-group", str(args.devices_per_group),
-            ],
-            env=env,
-        )
+        argv = [
+            sys.executable, os.path.abspath(__file__),
+            "--steps", str(args.steps),
+            "--devices-per-group", str(args.devices_per_group),
+            "--batch-size", str(args.batch_size),
+            "--seq-len", str(args.seq_len),
+        ]
+        for flag, on in (
+            ("--scan-layers", args.scan_layers),
+            ("--remat", args.remat),
+            ("--fused-ce", args.fused_ce),
+        ):
+            if on:
+                argv.append(flag)
+        return subprocess.Popen(argv, env=env)
 
     procs = {g: spawn(g) for g in range(args.num_replica_groups)}
     victim = args.num_replica_groups - 1
@@ -180,6 +200,17 @@ def main() -> None:
     parser.add_argument("--batch-size", type=int, default=4)
     parser.add_argument("--seq-len", type=int, default=64)
     parser.add_argument("--devices-per-group", type=int, default=4)
+    parser.add_argument(
+        "--scan-layers", action="store_true",
+        help="lax.scan'd layer stack (O(1) HLO in depth)",
+    )
+    parser.add_argument(
+        "--remat", action="store_true", help="dots-policy gradient checkpointing"
+    )
+    parser.add_argument(
+        "--fused-ce", action="store_true",
+        help="fused linear+cross-entropy (logits never materialize)",
+    )
     parser.add_argument("--timeout", type=float, default=30.0)
     parser.add_argument("--quorum-timeout", type=float, default=60.0)
     parser.add_argument("--demo", action="store_true")

@@ -97,6 +97,20 @@ def test_train_hsdp(lighthouse):
     assert "param_digest=" in out
 
 
+def test_train_hsdp_fit_levers(lighthouse):
+    """scan-layers + dots-remat + fused CE compose with the HSDP sharding."""
+    out = _run(
+        "train_hsdp.py",
+        [
+            "--num-replica-groups", 1, "--steps", 2, "--batch-size", 4,
+            "--seq-len", 32, "--devices-per-group", 2,
+            "--scan-layers", "--remat", "--fused-ce",
+        ],
+        lighthouse,
+    )
+    assert "param_digest=" in out
+
+
 def test_train_longcontext(lighthouse):
     out = _run(
         "train_longcontext.py",
