@@ -86,9 +86,29 @@ def test_diloco_recovery_after_kill(lighthouse) -> None:
     assert results[0][0]["failed_syncs"] <= 1, results[0][0]["failed_syncs"]
 
 
-def test_diloco_quantized_two_groups(lighthouse) -> None:
-    """The fp8 device pipeline: pseudograds quantized on device, only fp8 on
-    the wire; global state must still converge bitwise across groups."""
+@pytest.mark.parametrize("wire", ["fp8", "int4"])
+def test_diloco_quantized_two_groups(lighthouse, monkeypatch, wire) -> None:
+    """The quantized device pipeline: pseudograds quantized on device, only
+    the wire payload crosses the host boundary; global state must still
+    converge bitwise across groups — for the default fp8 format and the
+    packed-int4 half-width format alike (TPUFT_WIRE_DTYPE threads through
+    the whole pipeline: device codec -> wire -> fused reduce)."""
+    monkeypatch.setenv("TPUFT_WIRE_DTYPE", wire)
+    # Spy on the device codec so a silent fallback to fp8 cannot pass the
+    # int4 case: record the payload dtype the pipeline actually produces.
+    import ml_dtypes
+
+    from torchft_tpu.ops import quantization as q
+
+    seen_dtypes = []
+    orig_quantize = q.quantize_blocks_device
+
+    def spy(x, block=q.BLOCK, wire=None):
+        payload, scales = orig_quantize(x, block, wire=wire)
+        seen_dtypes.append(np.dtype(payload.dtype))
+        return payload, scales
+
+    monkeypatch.setattr(q, "quantize_blocks_device", spy)
     runners = [
         Runner(
             replica_group=i,
@@ -108,3 +128,5 @@ def test_diloco_quantized_two_groups(lighthouse) -> None:
     for group_result in results:
         assert group_result[0]["manager_state"]["step"] == 3
     assert_equal_global_state(results)
+    expected = np.uint8 if wire == "int4" else np.dtype(ml_dtypes.float8_e4m3fn)
+    assert seen_dtypes and all(d == expected for d in seen_dtypes), seen_dtypes
