@@ -19,10 +19,15 @@ DiLoCo), ``torchft_tpu.parallel.mesh`` (FTMesh/HSDP), ``torchft_tpu.models``,
 ``torchft_tpu.checkpointing``, ``torchft_tpu.ops``.
 """
 
-from torchft_tpu.data import DistributedSampler
+from torchft_tpu.data import DevicePrefetcher, DistributedSampler
 from torchft_tpu.ddp import DistributedDataParallel, ft_allreduce_gradients
 from torchft_tpu.manager import Manager, WorldSizeMode
-from torchft_tpu.optim import Optimizer, OptimizerWrapper
+from torchft_tpu.optim import (
+    Optimizer,
+    OptimizerWrapper,
+    make_jit_fused_step,
+    make_microbatch_grad,
+)
 from torchft_tpu.parallel.baby import ProcessGroupBaby
 from torchft_tpu.parallel.native_pg import ProcessGroupNative
 from torchft_tpu.parallel.process_group import (
@@ -42,6 +47,9 @@ __all__ = [
     "DistributedDataParallel",
     "ft_allreduce_gradients",
     "DistributedSampler",
+    "DevicePrefetcher",
+    "make_jit_fused_step",
+    "make_microbatch_grad",
     "ProcessGroup",
     "ProcessGroupTCP",
     "ProcessGroupNative",
