@@ -72,8 +72,23 @@ def record(source: str, event: str, **detail: Any) -> None:
 
 
 def snapshot() -> List[Dict[str, Any]]:
-    """A consistent copy of the current ring (oldest first)."""
-    return list(_RING)
+    """A consistent copy of the current ring (oldest first). Deque appends
+    are atomic but iterating while another thread appends can raise
+    'deque mutated during iteration' — retry briefly, then fall back to
+    an index-walk copy (possibly missing the newest entries, which is
+    fine for a post-mortem ring)."""
+    for _ in range(4):
+        try:
+            return list(_RING)
+        except RuntimeError:
+            continue
+    out: List[Dict[str, Any]] = []
+    for i in range(len(_RING)):
+        try:
+            out.append(_RING[i])
+        except IndexError:
+            break
+    return out
 
 
 def dump(path: Optional[str] = None, reason: str = "") -> Optional[str]:
@@ -112,7 +127,7 @@ def dump_on_failure(source: str, reason: str) -> Optional[str]:
     record(source, "failure", reason=reason)
     try:
         return dump(reason=f"{source}: {reason}")
-    except OSError:
+    except Exception:  # noqa: BLE001 — failure hooks must never raise
         return None
 
 
