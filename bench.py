@@ -416,7 +416,12 @@ def main() -> None:
     if DEGRADED:
         import glob
 
-        candidates = sorted(glob.glob(str(Path(__file__).parent / "BENCH_CPU_FULL_*.json")))
+        # Most-recent by mtime, not filename: lexicographic order misorders
+        # r10 vs r9 / mixed naming once round numbers grow (round-3 advisor).
+        candidates = sorted(
+            glob.glob(str(Path(__file__).parent / "BENCH_CPU_FULL_*.json")),
+            key=os.path.getmtime,
+        )
         if candidates:
             try:
                 with open(candidates[-1]) as f:

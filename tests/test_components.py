@@ -581,6 +581,24 @@ def test_device_prefetcher_orders_places_and_propagates() -> None:
     with pytest.raises(StopIteration):
         next(pf)
 
+    # An ABANDONED prefetcher (reference dropped, no close) is reaped by
+    # its GC finalizer: the worker only shares _PrefetchState — never the
+    # prefetcher itself — so collection fires weakref.finalize, which
+    # closes the state and the worker exits instead of polling forever
+    # with queued device batches pinned (round-3 advisor).
+    import gc
+    import time as _time
+
+    pf = DevicePrefetcher((np.zeros(2) for _ in range(100)), depth=1)
+    next(pf)
+    worker = pf._thread
+    del pf
+    gc.collect()
+    deadline = _time.monotonic() + 5
+    while worker.is_alive() and _time.monotonic() < deadline:
+        _time.sleep(0.05)
+    assert not worker.is_alive()
+
 
 def test_flight_recorder_ring_and_dump(tmp_path, monkeypatch) -> None:
     """Ring records bounded entries, dump() writes JSONL, and the
@@ -618,6 +636,33 @@ def test_flight_recorder_ring_and_dump(tmp_path, monkeypatch) -> None:
     # Non-JSON detail values are coerced, never raise.
     fr.record("test", "weird", obj=object())
     fr.dump(str(path))
+
+    # Clean snapshots carry no truncation marker...
+    entries, truncated = fr._snapshot_meta()
+    assert entries and not truncated
+
+    # ...but when the list() copy keeps losing to concurrent appends and
+    # the index-walk fallback fires, the dump header records it so readers
+    # know the sample may be non-contiguous.
+    class _Mutating:
+        def __iter__(self):
+            raise RuntimeError("deque mutated during iteration")
+
+        def __len__(self):
+            return 1
+
+        def __getitem__(self, i):
+            if i == 0:
+                return {"seq": 0, "event": "walked"}
+            raise IndexError
+
+    monkeypatch.setattr(fr, "_RING", _Mutating())
+    entries, truncated = fr._snapshot_meta()
+    assert truncated and entries == [{"seq": 0, "event": "walked"}]
+    tpath = tmp_path / "fr_trunc.jsonl"
+    fr.dump(str(tpath))
+    tlines = [json.loads(l) for l in tpath.read_text().splitlines()]
+    assert tlines[0]["truncated"] is True
 
 
 def test_doctor_checks_pass_and_catch_problems(monkeypatch, capsys) -> None:

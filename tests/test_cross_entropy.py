@@ -61,6 +61,25 @@ def test_chunked_ce_matches_dense(dtype, vocab) -> None:
         assert dw.shape == w.shape  # pad AD restores the true vocab width
 
 
+def test_out_of_range_targets_clamp_consistently() -> None:
+    """Targets outside [0, vocab) are clamped once in the wrapper, so the
+    chunked and dense paths return the SAME value for invalid input
+    (previously the chunked path silently used a 0.0 target logit while
+    the dense path clamped — round-3 advisor)."""
+    n, d, vocab = 8, 16, 256
+    kx, kw = jax.random.split(jax.random.PRNGKey(3))
+    x = jax.random.normal(kx, (n, d), jnp.float32)
+    w = jax.random.normal(kw, (d, vocab), jnp.float32) * 0.1
+    bad = jnp.array([-5, 0, vocab - 1, vocab, vocab + 7, 3, -1, 2 * vocab])
+    clamped = jnp.clip(bad, 0, vocab - 1)
+
+    dense = chunked_cross_entropy(x, w, bad, None)
+    chunked = chunked_cross_entropy(x, w, bad, 64)
+    ref = chunked_cross_entropy(x, w, clamped, None)
+    np.testing.assert_allclose(float(dense), float(ref), rtol=1e-6)
+    np.testing.assert_allclose(float(chunked), float(ref), rtol=1e-5)
+
+
 @pytest.mark.parametrize("tied", [False, True])
 def test_llama_fused_loss_matches_materialized(tied) -> None:
     """model.apply(params, tokens, targets=...) with loss_vocab_chunk equals

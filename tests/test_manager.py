@@ -368,9 +368,10 @@ def test_allreduce_prequantized_zeroes_spare_contribution() -> None:
 
 def test_allreduce_pytree_buckets_mixed_dtypes() -> None:
     """Bucketed pytree sync: multiple dtype buckets reconstruct to the right
-    leaves (shapes, dtypes, float-average vs int-floor-div), results don't
-    alias each other, and the quantized path stays per-leaf so fp8 block
-    scales never span parameter boundaries."""
+    leaves (shapes, dtypes), results don't alias each other, integer leaves
+    raise (averaging would silently floor-divide — same contract as the
+    scalar allreduce AVG path), and the quantized path stays per-leaf so fp8
+    block scales never span parameter boundaries."""
     manager, client, _, _ = make_manager(pg=ProcessGroupDummy(), min_replica_size=1)
     client._quorum.return_value = make_quorum(replica_world_size=2, max_world_size=2)
     manager.start_quorum()
@@ -378,15 +379,32 @@ def test_allreduce_pytree_buckets_mixed_dtypes() -> None:
     tree = {
         "w": np.full(5, 4.0, np.float32),
         "b": [np.full(3, 8.0, np.float32)],
-        "n": np.array([10], np.int64),
         "scalar": np.float64(6.0),
     }
     out = manager.allreduce_pytree(tree).wait()
     np.testing.assert_array_equal(out["w"], np.full(5, 2.0, np.float32))
     np.testing.assert_array_equal(out["b"][0], np.full(3, 4.0, np.float32))
-    assert out["n"][0] == 5  # integer average floor-divides
     assert float(out["scalar"]) == 3.0
-    assert out["w"].dtype == np.float32 and out["n"].dtype == np.int64
+    assert out["w"].dtype == np.float32
+
+    # Integer leaf: ValueError BEFORE any wire op, step not poisoned.
+    with pytest.raises(ValueError, match="floating"):
+        manager.allreduce_pytree({"n": np.array([10], np.int64)})
+    assert not manager.errored()
+
+    # The check fires before every early return: a LONE replica raises
+    # too — otherwise an int leaf would "work" single-replica and start
+    # raising only once a second replica joins.
+    lone, lone_client, _, _ = make_manager(
+        pg=ProcessGroupDummy(), min_replica_size=1
+    )
+    lone_client._quorum.return_value = make_quorum(
+        replica_world_size=1, max_world_size=1
+    )
+    lone.start_quorum()
+    assert lone.is_lone_replica()
+    with pytest.raises(ValueError, match="floating"):
+        lone.allreduce_pytree({"n": np.array([10], np.int64)})
     # No aliasing between same-bucket leaves.
     out["w"][:] = -1
     np.testing.assert_array_equal(out["b"][0], np.full(3, 4.0, np.float32))

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import weakref
 from typing import Any, Callable, Iterable, Iterator, Optional
 
 import numpy as np
@@ -106,10 +107,13 @@ class DevicePrefetcher:
     Iteration order is preserved; an exception in the source iterator or
     the transfer re-raises at the consuming ``__next__``. ``close()``
     (also called on exhaustion and by ``with``) stops the worker; a
-    blocked worker is released by draining.
+    blocked worker is released by draining. An *abandoned* prefetcher
+    (consumer drops its reference without closing) is also cleaned up:
+    the worker thread shares only a ``_PrefetchState`` holder — never the
+    prefetcher itself — so garbage collection triggers a
+    ``weakref.finalize`` that closes the state, releasing the worker and
+    the queued device batches.
     """
-
-    _DONE = object()
 
     def __init__(
         self,
@@ -129,54 +133,27 @@ class DevicePrefetcher:
                 device_put = lambda batch: jax.device_put(batch, sharding)
             else:
                 device_put = jax.device_put
-        self._put = device_put
-        self._q: "queue.Queue[Any]" = queue.Queue(maxsize=depth)
-        self._err: Optional[BaseException] = None
-        self._closed = False
+        self._state = _PrefetchState(depth)
         self._thread = threading.Thread(
-            target=self._worker, args=(iter(source),), daemon=True
+            target=_prefetch_worker,
+            args=(self._state, iter(source), device_put),
+            daemon=True,
         )
         self._thread.start()
-
-    def _enqueue(self, item: Any) -> bool:
-        """Blocking put that gives up when the consumer closed (False) —
-        dropping ``item`` rather than pinning a device batch in the dead
-        queue."""
-        while not self._closed:
-            try:
-                self._q.put(item, timeout=0.1)
-                return True
-            except queue.Full:
-                continue
-        return False
-
-    def _worker(self, it: Iterator[Any]) -> None:
-        try:
-            for batch in it:
-                if not self._enqueue(self._put(batch)):
-                    return
-        except BaseException as e:  # noqa: BLE001 — re-raised at __next__
-            self._err = e
-        finally:
-            if not self._enqueue(self._DONE):
-                # Closed consumer no longer waits on get(); best-effort
-                # only — the sentinel is tiny, unlike a device batch.
-                try:
-                    self._q.put_nowait(self._DONE)
-                except queue.Full:
-                    pass
+        self._finalizer = weakref.finalize(self, self._state.close)
 
     def __iter__(self) -> "DevicePrefetcher":
         return self
 
     def __next__(self) -> Any:
-        if self._closed:
+        state = self._state
+        if state.closed:
             raise StopIteration
-        item = self._q.get()
-        if item is self._DONE:
-            self._closed = True
-            if self._err is not None:
-                raise self._err
+        item = state.q.get()
+        if item is _PREFETCH_DONE:
+            state.closed = True
+            if state.err is not None:
+                raise state.err
             raise StopIteration
         return item
 
@@ -187,11 +164,63 @@ class DevicePrefetcher:
         self.close()
 
     def close(self) -> None:
-        self._closed = True
-        # Release a worker blocked on a full queue, then reap it.
+        self._finalizer()  # idempotent: closes + drains the shared state
+        self._thread.join(timeout=5)
+
+
+_PREFETCH_DONE = object()
+
+
+class _PrefetchState:
+    """Queue + flags shared between a prefetcher and its worker thread.
+
+    Deliberately does NOT reference the ``DevicePrefetcher``: the worker
+    holding only this object lets an abandoned prefetcher be collected,
+    firing its finalizer (→ ``close``) so the worker exits instead of
+    polling forever with ``depth`` device batches pinned.
+    """
+
+    def __init__(self, depth: int) -> None:
+        self.q: "queue.Queue[Any]" = queue.Queue(maxsize=depth)
+        self.err: Optional[BaseException] = None
+        self.closed = False
+
+    def enqueue(self, item: Any) -> bool:
+        """Blocking put that gives up when the consumer closed (False) —
+        dropping ``item`` rather than pinning a device batch in the dead
+        queue."""
+        while not self.closed:
+            try:
+                self.q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def close(self) -> None:
+        self.closed = True
+        # Release a worker blocked on a full queue.
         try:
             while True:
-                self._q.get_nowait()
+                self.q.get_nowait()
         except queue.Empty:
             pass
-        self._thread.join(timeout=5)
+
+
+def _prefetch_worker(
+    state: _PrefetchState, it: Iterator[Any], put: Callable[[Any], Any]
+) -> None:
+    try:
+        for batch in it:
+            if not state.enqueue(put(batch)):
+                return
+    except BaseException as e:  # noqa: BLE001 — re-raised at __next__
+        state.err = e
+    finally:
+        if not state.enqueue(_PREFETCH_DONE):
+            # Closed consumer no longer waits on get(); best-effort
+            # only — the sentinel is tiny, unlike a device batch.
+            try:
+                state.q.put_nowait(_PREFETCH_DONE)
+            except queue.Full:
+                pass

@@ -249,13 +249,20 @@ def _ft_allreduce_gradients_fp8(manager: Manager, grads: Any) -> Any:
 
     out: List[Any] = [None] * len(leaves)
     wire_worker = _wire_worker_for(manager)
-    futures = [
-        wire_worker.submit(
-            lambda p=payload, s=scales: manager.allreduce_prequantized(p, s).wait()
-        )
-        for members, dequantize, payload, scales in quantized
-    ]
+    futures: List["concurrent.futures.Future"] = []
     try:
+        # Submit INSIDE the try: a submit that raises mid-loop (e.g. a
+        # concurrent Manager.shutdown closed the executor) must still hit
+        # the finally's cancel+drain for the exchanges already queued, or a
+        # stale bucket could outlive the step boundary (round-3 advisor).
+        for members, dequantize, payload, scales in quantized:
+            futures.append(
+                wire_worker.submit(
+                    lambda p=payload, s=scales: manager.allreduce_prequantized(
+                        p, s
+                    ).wait()
+                )
+            )
         for (members, dequantize, _, _), future in zip(quantized, futures):
             result = future.result()
             if result is None:

@@ -365,6 +365,18 @@ class Manager:
         import jax
 
         leaves, treedef = jax.tree_util.tree_flatten(pytree)
+        # Same contract as the scalar `allreduce` AVG path (see
+        # _allreduce_impl): averaging integer leaves would silently
+        # floor-divide. Validate BEFORE every early return (errored /
+        # lone-replica) so the programming error surfaces deterministically
+        # at any quorum size instead of only once a second replica joins.
+        for leaf in leaves:
+            if np.dtype(getattr(leaf, "dtype", type(leaf))).kind not in ("f", "V"):
+                raise ValueError(
+                    "allreduce_pytree averages leaves and requires floating "
+                    f"point dtypes; got {np.dtype(getattr(leaf, 'dtype', type(leaf)))}. "
+                    "Cast the leaf to float or exclude it from the synced pytree."
+                )
         if self.errored():
             return _DummyWork(pytree)
         with trace_span("tpuft::manager::allreduce_pytree"):
@@ -416,11 +428,8 @@ class Manager:
             def callback(result: List[np.ndarray]) -> Any:
                 averaged: List[Any] = [None] * len(arrays)
                 for flat, members in zip(result, buckets.values()):
-                    flat = (
-                        (flat / num_participants).astype(flat.dtype)
-                        if flat.dtype.kind in ("f", "V")
-                        else flat // num_participants
-                    )
+                    # Float-only by the precondition above.
+                    flat = (flat / num_participants).astype(flat.dtype)
                     offset = 0
                     for i in members:
                         size = arrays[i].size

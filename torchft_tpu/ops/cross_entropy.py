@@ -157,11 +157,17 @@ def chunked_cross_entropy(
     Matches ``cross_entropy_loss(x @ w, targets)`` (models/llama.py) to
     f32 tolerance in value and gradients; peak activation memory drops
     from O(n·vocab) to O(n·vocab_chunk).
+
+    Targets must lie in ``[0, vocab)``; out-of-range values are clamped
+    to the nearest valid index (once, here in the wrapper) so the
+    chunked and dense paths return the SAME value for invalid input —
+    previously the chunked path silently used a 0.0 target logit while
+    the dense path clamped (round-3 advisor).
     """
     d = x.shape[-1]
     vocab = w.shape[1]
     x2 = x.reshape(-1, d)
-    targets1 = targets.reshape(-1).astype(jnp.int32)
+    targets1 = jnp.clip(targets.reshape(-1).astype(jnp.int32), 0, vocab - 1)
     if vocab_chunk is None or vocab_chunk >= vocab:
         logits = jnp.dot(
             x2.astype(jnp.float32), w.astype(jnp.float32),

@@ -77,9 +77,17 @@ def snapshot() -> List[Dict[str, Any]]:
     'deque mutated during iteration' — retry briefly, then fall back to
     an index-walk copy (possibly missing the newest entries, which is
     fine for a post-mortem ring)."""
+    return _snapshot_meta()[0]
+
+
+def _snapshot_meta() -> "tuple[List[Dict[str, Any]], bool]":
+    """(entries, truncated): ``truncated`` is True when the index-walk
+    fallback fired — on a wrapped ring under concurrent appends entry i
+    can shift mid-walk, so the sample may be non-contiguous; dumps record
+    it so readers know (round-3 verdict)."""
     for _ in range(4):
         try:
-            return list(_RING)
+            return list(_RING), False
         except RuntimeError:
             continue
     out: List[Dict[str, Any]] = []
@@ -88,7 +96,7 @@ def snapshot() -> List[Dict[str, Any]]:
             out.append(_RING[i])
         except IndexError:
             break
-    return out
+    return out, True
 
 
 def dump(path: Optional[str] = None, reason: str = "") -> Optional[str]:
@@ -105,14 +113,17 @@ def dump(path: Optional[str] = None, reason: str = "") -> Optional[str]:
         path = os.path.join(
             directory, f"tpuft_fr_{os.getpid()}_{time.time_ns()}.jsonl"
         )
-    entries = snapshot()
+    entries, truncated = _snapshot_meta()
     # Atomic: a chaos kill mid-dump must never leave a truncated JSONL at
     # the final name (the soak asserts every surviving dump parses).
     tmp = f"{path}.tmp.{os.getpid()}"
     with _DUMP_LOCK:
         with open(tmp, "w") as f:
-            if reason:
-                f.write(json.dumps({"flight_recorder_dump_reason": reason}) + "\n")
+            if reason or truncated:
+                header: Dict[str, Any] = {"flight_recorder_dump_reason": reason}
+                if truncated:
+                    header["truncated"] = True
+                f.write(json.dumps(header) + "\n")
             for entry in entries:
                 f.write(json.dumps(entry) + "\n")
         os.replace(tmp, path)
