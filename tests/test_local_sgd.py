@@ -251,6 +251,79 @@ def test_diloco_failure_timeline_golden(fail_sync_index: int) -> None:
     )
 
 
+def test_heal_restore_preserves_shardings() -> None:
+    """Healing restores state onto the EXISTING leaves' shardings: a
+    joiner whose params carry fsdp/tp NamedShardings must not end up with
+    replicated arrays after _load_inner/_load_state (replicated restores
+    made the joiner's jitted programs partition differently from the
+    donor's — one-ulp drift per sync, breaking the bitwise invariant)."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from torchft_tpu.local_sgd import _restore_like
+
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("fsdp", "tp"))
+    sharding = NamedSharding(mesh, P("fsdp", "tp"))
+    params = {
+        "w": jax.device_put(jnp.ones((4, 4), jnp.float32), sharding),
+        "b": jnp.zeros((3,), jnp.float32),
+    }
+
+    manager = scripted_manager(use_async_quorum=False)
+    algo = DiLoCo(
+        manager, optax.sgd(1.0), optax.sgd(1.0), params,
+        sync_every=2, n_fragments=2, should_quantize=True,
+    )
+    # Simulate a heal: host-numpy state (what the checkpoint wire carries).
+    algo._load_inner(
+        {
+            "leaves": [np.full((3,), 7.0, np.float32), np.full((4, 4), 5.0, np.float32)],
+            "opt_state": jax.tree_util.tree_map(
+                lambda x: np.asarray(x) if hasattr(x, "shape") else x,
+                algo.inner_opt_state,
+            ),
+        }
+    )
+    # Flatten order: "b" then "w" (sorted dict keys) — w is leaf 1.
+    healed_w = algo._leaves[1]
+    assert healed_w.sharding == sharding, healed_w.sharding
+    np.testing.assert_array_equal(np.asarray(healed_w), np.full((4, 4), 5.0))
+
+    # Quantized fragments keep device backups: heal restores their
+    # shardings too (fragment 1 owns leaf index 1 = w).
+    frag = algo._fragments[1]
+    frag._load_state(
+        {
+            "original_parameters": [np.full((4, 4), 9.0, np.float32)],
+            "outer_optimizer": jax.tree_util.tree_map(
+                lambda x: np.asarray(x) if hasattr(x, "shape") else x,
+                frag.outer_opt_state,
+            ),
+        }
+    )
+    assert frag.backup[0].sharding == sharding
+    np.testing.assert_array_equal(np.asarray(frag.backup[0]), np.full((4, 4), 9.0))
+
+    # Structure mismatch falls back to a plain restore instead of raising.
+    out = _restore_like({"different": np.ones(2, np.float32)}, {"x": 1}, device=True)
+    assert isinstance(out["different"], jax.Array)
+
+    # LocalSGD heal restores the params' shardings the same way.
+    algo2 = LocalSGD(manager, optax.sgd(1.0), params, sync_every=2, register_key="ls2")
+    algo2._load_state(
+        {
+            "params": {
+                "w": np.full((4, 4), 3.0, np.float32),
+                "b": np.zeros((3,), np.float32),
+            },
+            "opt_state": jax.tree_util.tree_map(
+                lambda x: np.asarray(x) if hasattr(x, "shape") else x,
+                algo2.opt_state,
+            ),
+        }
+    )
+    assert algo2.params["w"].sharding == sharding
+
+
 def test_diloco_fused_step_matches_grads_path() -> None:
     """make_step_fn (fused loss+update dispatch) produces bitwise the same
     trajectory as step(grads) with the same schedule."""

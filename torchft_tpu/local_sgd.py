@@ -48,6 +48,34 @@ def _to_device_like(host: np.ndarray, like: Any) -> Any:
     return jnp.asarray(host)
 
 
+def _restore_like(state: Any, template: Any, device: bool) -> Any:
+    """Restores a healed pytree onto the TEMPLATE's shardings (leaf by
+    leaf, where shapes line up) so a joiner's state lands with the same
+    partitioning the donor computes with; falls back to a plain restore
+    when the structures differ."""
+    import jax.numpy as jnp
+
+    as_leaf = jnp.asarray if device else np.asarray
+
+    def restore(x: Any, like: Any) -> Any:
+        if not hasattr(x, "shape"):
+            return x
+        if (
+            device
+            and isinstance(like, jax.Array)
+            and getattr(like, "shape", None) == x.shape
+        ):
+            return _to_device_like(np.asarray(x), like)
+        return as_leaf(x)
+
+    try:
+        return jax.tree_util.tree_map(restore, state, template)
+    except ValueError:  # structure mismatch (e.g. fresh vs restored optax state)
+        return jax.tree_util.tree_map(
+            lambda x: as_leaf(x) if hasattr(x, "shape") else x, state
+        )
+
+
 class LocalSGD:
     """Parameter-averaging semi-sync training (reference local_sgd.py:46-173).
 
@@ -83,11 +111,10 @@ class LocalSGD:
         return {"params": self.params, "opt_state": self.opt_state}
 
     def _load_state(self, state: Dict[str, Any]) -> None:
-        import jax.numpy as jnp
-
-        self.params = jax.tree_util.tree_map(jnp.asarray, state["params"])
-        self.opt_state = jax.tree_util.tree_map(
-            lambda x: jnp.asarray(x) if hasattr(x, "shape") else x, state["opt_state"]
+        # Sharding-preserving restore (see _restore_like).
+        self.params = _restore_like(state["params"], self.params, device=True)
+        self.opt_state = _restore_like(
+            state["opt_state"], self.opt_state, device=True
         )
 
     def step(self, grads: Any) -> bool:
@@ -244,14 +271,23 @@ class _Fragment:
         }
 
     def _load_state(self, state: Dict[str, Any]) -> None:
-        import jax.numpy as jnp
-
-        restore = jnp.asarray if self._should_quantize else np.array
-        self.backup = [restore(b) for b in state["original_parameters"]]
-        as_leaf = jnp.asarray if self._should_quantize else np.asarray
-        self.outer_opt_state = jax.tree_util.tree_map(
-            lambda x: as_leaf(x) if hasattr(x, "shape") else x,
+        # Healing must restore SHARDING, not just values: the joiner's
+        # pre-heal backups carry the model's fsdp/tp shardings, and a plain
+        # jnp.asarray restore would leave the healed state replicated — the
+        # joiner's jitted programs would then partition differently from the
+        # donor's, and their reductions drift by an ulp per sync (breaking
+        # the bitwise cross-replica invariant the integration tests assert).
+        if self._should_quantize:
+            self.backup = [
+                _to_device_like(np.asarray(b), like)
+                for b, like in zip(state["original_parameters"], self.backup)
+            ]
+        else:
+            self.backup = [np.array(b) for b in state["original_parameters"]]
+        self.outer_opt_state = _restore_like(
             state["outer_optimizer"],
+            self.outer_opt_state,
+            device=self._should_quantize,
         )
 
     def prepare_sync(self, local_leaves: List[Any]) -> None:
@@ -454,9 +490,22 @@ class DiLoCo:
     def _load_inner(self, state: Dict[str, Any]) -> None:
         import jax.numpy as jnp
 
-        self._leaves = [jnp.asarray(x) for x in state["leaves"]]
-        self.inner_opt_state = jax.tree_util.tree_map(
-            lambda x: jnp.asarray(x) if hasattr(x, "shape") else x, state["opt_state"]
+        # Restore onto the existing leaves' shardings (see _restore_like):
+        # a healed joiner must end up with the same partitioning the donor
+        # computes with, or their jitted programs diverge by an ulp.
+        old = self._leaves
+        new = state["leaves"]
+        if len(old) == len(new):
+            self._leaves = [
+                _to_device_like(np.asarray(x), like)
+                if getattr(like, "shape", None) == getattr(x, "shape", None)
+                else jnp.asarray(x)
+                for x, like in zip(new, old)
+            ]
+        else:
+            self._leaves = [jnp.asarray(x) for x in new]
+        self.inner_opt_state = _restore_like(
+            state["opt_state"], self.inner_opt_state, device=True
         )
 
     def _current_fragment(self) -> int:
