@@ -701,6 +701,23 @@ class Manager:
     # commit
     # ------------------------------------------------------------------
 
+    def should_commit_async(
+        self, timeout: Optional[float] = None
+    ) -> "concurrent.futures.Future":
+        """:meth:`should_commit` dispatched on the manager's executor so the
+        barrier RPC overlaps work the caller still has to do this step —
+        e.g. dispatching the speculative optimizer update (optim.py) or the
+        next batch's h2d. The reference's analogue is keeping commit cost
+        off the step's critical path (manager.py:790-878 design note).
+
+        The caller MUST resolve the future before reading any state the
+        barrier may heal (should_commit applies pending state dicts) and
+        before calling start_quorum: start_quorum resets the per-step
+        error/heal flags on the CALLER thread before submitting its quorum
+        task, so an unresolved commit queued behind it would vote with
+        wiped flags and silently drop a pending heal."""
+        return self._executor.submit(self.should_commit, timeout)
+
     def should_commit(self, timeout: Optional[float] = None) -> bool:
         """All-local-rank commit barrier (reference: manager.py:790-878).
 

@@ -286,27 +286,53 @@ class Optimizer:
         """Commits the step; on success applies ``grads`` to the (possibly
         just-healed) owned state. Returns whether the step committed.
 
-        The update is dispatched **speculatively**: the jitted optimizer
-        math runs on device while the commit-barrier RPC is in flight (the
-        analogue of the reference overlapping should_commit's stream syncs,
-        manager.py:569-581 + :816-827). If the barrier heals this replica
-        (state replaced mid-call), the speculation is discarded and the
-        update re-applies against the healed state."""
+        The update is dispatched **speculatively** and the commit-barrier
+        RPC rides the manager's executor, so BOTH the RPC wire time and the
+        device-side optimizer math overlap (the analogue of the reference
+        overlapping should_commit's stream syncs, manager.py:569-581 +
+        :816-827). If the barrier heals this replica (state replaced
+        mid-call), the speculation is discarded and the update re-applies
+        against the healed state."""
         # Bound the device work before voting: a replica whose math never
         # finished must not vote to commit (the stream-sync analogue of
         # reference manager.py:816-827).
         grads = jax.block_until_ready(grads)
         heal_count = self._heal_count
-        spec = self._jit_update(grads, self.opt_state, self.params)
+        # Snapshot the state refs, THEN launch the barrier: the RPC is in
+        # flight while the update dispatches below. A concurrent heal can
+        # rebind self.params mid-dispatch — harmless, because the
+        # heal_count check discards the speculation in that case.
+        params, opt_state = self.params, self.opt_state
+        commit_future = self.manager.should_commit_async(timeout)
+        try:
+            spec = self._jit_update(grads, opt_state, params)
+        except BaseException:
+            # The barrier is already in flight and may commit the step
+            # (the vote was computed from pre-dispatch health); never leave
+            # it dangling on the executor — resolve it, then surface the
+            # dispatch failure (the supervisor restart + heal path owns
+            # recovery from a step counter that advanced without its
+            # update).
+            try:
+                commit_future.result()
+            except Exception:
+                pass
+            raise
         return self._commit_and_adopt(
             heal_count,
             spec,
             lambda: self._jit_update(grads, self.opt_state, self.params),
             timeout,
+            commit_future=commit_future,
         )
 
     def _commit_and_adopt(
-        self, heal_count: int, speculation: Any, recompute: Any, timeout: Optional[float]
+        self,
+        heal_count: int,
+        speculation: Any,
+        recompute: Any,
+        timeout: Optional[float],
+        commit_future: Any = None,
     ) -> bool:
         """The shared barrier protocol: vote/commit, then adopt the
         speculatively computed ``(params, opt_state)`` — unless the barrier
@@ -317,7 +343,12 @@ class Optimizer:
         self.params/opt_state only after it returns. The mutation is
         write-locked so a concurrent checkpoint capture (donor staging on
         the quorum thread) never reads a torn params/opt pair."""
-        if not self.manager.should_commit(timeout=timeout):
+        committed = (
+            commit_future.result()
+            if commit_future is not None
+            else self.manager.should_commit(timeout=timeout)
+        )
+        if not committed:
             return False
         self.manager.disallow_state_dict_read()
         try:
