@@ -1,0 +1,308 @@
+#!/usr/bin/env python
+"""Control-plane scalability benchmark: one lighthouse, 64+ replicas.
+
+Every quorum datum in the test suite comes from 1-3 replicas; the
+reference's design targets are bigger — BASELINE.md's 8-group topology and
+the reference's own slurm example defaults to a 10x10x10 sweep
+(/root/reference/torchft/examples/slurm/runner.py). This benchmark drives
+the native lighthouse (native/src/lighthouse.cc, quorum tick loop in
+native/src/quorum.cc) and a native manager server with a simulated fleet
+over REAL RPC (framed protobuf/TCP, the production wire) and measures:
+
+  1. steady-state fast-quorum latency with N healthy replicas re-requesting
+     each round (reference fast path: lighthouse.rs:202-215);
+  2. quorum convergence when one replica leaves (the straggler wait is
+     join_timeout by design — reported as overhead ABOVE the configured
+     wait, lighthouse.rs:243-263);
+  3. heartbeat RPC latency while the whole fleet heartbeats at 10 Hz
+     (lighthouse.rs:553-566);
+  4. dashboard/status render latency with N live members
+     (lighthouse.rs:370-399);
+  5. the should_commit AND-barrier at group_world_size=8
+     (manager.rs:423-479).
+
+Prints one JSON object (also written to CONTROL_PLANE_SCALE.json at the
+repo root) and asserts generous sanity bounds so CI catches an
+accidentally quadratic tick or barrier.
+
+Usage: python benchmarks/control_plane_scale.py [n_replicas]
+Env: TPUFT_CPS_REPLICAS (default 64), TPUFT_CPS_ROUNDS (default 10).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from torchft_tpu.coordination import (  # noqa: E402
+    LighthouseClient,
+    LighthouseServer,
+    ManagerClient,
+    ManagerServer,
+    QuorumMember,
+)
+
+JOIN_TIMEOUT_MS = 1000
+QUORUM_TICK_MS = 50
+HEARTBEAT_TIMEOUT_MS = 5000
+
+
+def _pctl(values, q):
+    values = sorted(values)
+    if not values:
+        return None
+    idx = min(len(values) - 1, int(round(q * (len(values) - 1))))
+    return values[idx]
+
+
+def _summary(values_s):
+    ms = [v * 1000.0 for v in values_s]
+    return {
+        "p50_ms": round(_pctl(ms, 0.50), 2),
+        "p95_ms": round(_pctl(ms, 0.95), 2),
+        "max_ms": round(max(ms), 2),
+        "n": len(ms),
+    }
+
+
+def bench_lighthouse(n_replicas: int, rounds: int) -> dict:
+    """Steady-state fast-quorum, heartbeat storm + dashboard render with a
+    full member table, and one-leaver convergence — all against ONE
+    lighthouse so the status phase renders real membership."""
+    lighthouse = LighthouseServer(
+        min_replicas=1,
+        join_timeout_ms=JOIN_TIMEOUT_MS,
+        quorum_tick_ms=QUORUM_TICK_MS,
+        heartbeat_timeout_ms=HEARTBEAT_TIMEOUT_MS,
+    )
+    addr = lighthouse.address()
+    clients = [LighthouseClient(addr) for _ in range(n_replicas)]
+    latencies: list = []
+    leave_latencies: list = []
+    start_barrier = threading.Barrier(n_replicas)
+
+    # Continuous heartbeats for the WHOLE run, like a real manager's
+    # heartbeat loop (native/src/manager.cc): quorum requests only count
+    # while the requester is heartbeat-healthy, so a parked request whose
+    # implicit join heartbeat ages out would become invisible to
+    # quorum_compute and hang its long-poll — real fleets never request
+    # without heartbeating. These threads double as the heartbeat-latency
+    # measurement.
+    hb_lat: list = []
+    hb_lock = threading.Lock()
+    hb_stop = threading.Event()
+
+    def heartbeater(idx: int) -> None:
+        client = LighthouseClient(addr)
+        try:
+            while not hb_stop.is_set():
+                t0 = time.monotonic()
+                client.heartbeat(f"sim{idx}", timeout=10.0)
+                dt = time.monotonic() - t0
+                with hb_lock:
+                    hb_lat.append(dt)
+                hb_stop.wait(0.1)
+        finally:
+            client.close()
+
+    hb_threads = [
+        threading.Thread(target=heartbeater, args=(i,), daemon=True)
+        for i in range(n_replicas)
+    ]
+    for t in hb_threads:
+        t.start()
+    try:
+        def free_run(
+            skip: "int | None", n_rounds: int, step0: int, measure_lo: int = 2
+        ):
+            """Every replica (minus ``skip``) FREE-RUNS ``n_rounds`` quorum
+            requests — no cross-replica barrier between rounds, exactly like
+            real managers hitting their own step boundaries. This matters:
+            a request that lands just after a delivery tick parks until the
+            NEXT quorum, and only peers that keep re-requesting (not peers
+            blocked waiting for the straggler) can form it. Rounds 1..n-2
+            are measured; round 0 is the convergence warmup and the final
+            round exists so any straggler parked in the last measured round
+            still resolves (its own last request uses a short timeout and
+            tolerates expiry — nobody re-requests after it).
+
+            Returns (measured latencies, min participants seen in measured
+            rounds)."""
+            lat_lock = threading.Lock()
+            measured: list = []
+            min_seen = [n_replicas]
+            warmup = measure_lo
+            active = n_replicas if skip is None else n_replicas - 1
+            barrier = threading.Barrier(active)
+
+            def run_replica(idx: int) -> None:
+                if idx == skip:
+                    return
+                barrier.wait(timeout=120)
+                for r in range(n_rounds):
+                    member = QuorumMember(
+                        replica_id=f"sim{idx}", address=f"addr{idx}", step=step0 + r
+                    )
+                    final = r == n_rounds - 1
+                    t0 = time.monotonic()
+                    try:
+                        quorum = clients[idx].quorum(
+                            member, timeout=10.0 if final else 60.0
+                        )
+                    except (TimeoutError, RuntimeError):
+                        if final:
+                            return  # unmeasured trailing round; see docstring
+                        raise
+                    dt = time.monotonic() - t0
+                    if warmup <= r < n_rounds - 1:
+                        with lat_lock:
+                            measured.append(dt)
+                            min_seen[0] = min(
+                                min_seen[0], len(quorum.participants)
+                            )
+
+            with ThreadPoolExecutor(max_workers=active) as pool:
+                list(pool.map(run_replica, range(n_replicas)))
+            return measured, min_seen[0]
+
+        lat, n_members = free_run(None, rounds + 3, step0=0)
+        assert n_members == n_replicas, (
+            f"membership incomplete in measured rounds: {n_members}"
+        )
+        latencies.extend(lat)
+
+        # Dashboard render with the full member table (the quorum above
+        # populated prev_quorum, so status renders all N) while the fleet
+        # heartbeats at 10 Hz in the background threads.
+        status_lat: list = []
+        status_client = LighthouseClient(addr)
+        deadline = time.monotonic() + 3.0
+        while time.monotonic() < deadline:
+            t0 = time.monotonic()
+            resp = status_client.status(timeout=10.0)
+            status_lat.append(time.monotonic() - t0)
+            time.sleep(0.1)
+        members_rendered = len(resp.members)
+        status_client.close()
+
+        # One replica leaves (stops requesting; still heartbeats, like a
+        # live-but-stalled host): the fast path can't fire, the lighthouse
+        # waits join_timeout for the healthy-but-absent prev member by
+        # design. Measure the TRANSITION round (measure_lo=0): rounds after
+        # it ride the fast path again on the shrunken membership.
+        lat, n_members = free_run(
+            n_replicas - 1, 2, step0=rounds + 3, measure_lo=0
+        )
+        assert n_members == n_replicas - 1, f"leaver still in quorum: {n_members}"
+        leave_latencies.extend(lat)
+    finally:
+        hb_stop.set()
+        for t in hb_threads:
+            t.join(timeout=10)
+        for c in clients:
+            c.close()
+        lighthouse.shutdown()
+
+    leave = _summary(leave_latencies)
+    leave["overhead_above_join_timeout_ms"] = round(
+        leave["p50_ms"] - JOIN_TIMEOUT_MS, 2
+    )
+    return {
+        "fast_quorum": _summary(latencies),
+        "leave_requorum": leave,
+        "heartbeat": _summary(hb_lat),
+        "status_render": {
+            **_summary(status_lat),
+            "members_rendered": members_rendered,
+        },
+    }
+
+
+def bench_commit_barrier(group_world_size: int, rounds: int) -> dict:
+    """should_commit AND-barrier latency at the reference's slurm-scale
+    group_world_size (manager.rs:423-479: last rank in releases all)."""
+    lighthouse = LighthouseServer(min_replicas=1, join_timeout_ms=200)
+    manager = ManagerServer(
+        replica_id="barrier_bench",
+        lighthouse_addr=lighthouse.address(),
+        world_size=group_world_size,
+        exit_on_kill=False,
+    )
+    addr = manager.address()
+    clients = [ManagerClient(addr) for _ in range(group_world_size)]
+    start_barrier = threading.Barrier(group_world_size)
+    latencies: list = []
+    lock = threading.Lock()
+    try:
+        def vote(rank: int, step: int) -> None:
+            start_barrier.wait(timeout=60)
+            t0 = time.monotonic()
+            ok = clients[rank].should_commit(rank, step, True, timeout=30.0)
+            dt = time.monotonic() - t0
+            assert ok, f"unanimous-true barrier returned False at step {step}"
+            with lock:
+                latencies.append(dt)
+
+        for step in range(rounds):
+            with ThreadPoolExecutor(max_workers=group_world_size) as pool:
+                list(pool.map(lambda r: vote(r, step), range(group_world_size)))
+    finally:
+        for c in clients:
+            c.close()
+        manager.shutdown()
+        lighthouse.shutdown()
+    return {"should_commit_barrier": _summary(latencies)}
+
+
+def main() -> dict:
+    n_replicas = int(
+        sys.argv[1] if len(sys.argv) > 1 else os.environ.get("TPUFT_CPS_REPLICAS", "64")
+    )
+    rounds = int(os.environ.get("TPUFT_CPS_ROUNDS", "10"))
+    group_world_size = int(os.environ.get("TPUFT_CPS_GROUP_WORLD_SIZE", "8"))
+
+    result = {
+        "bench": "control_plane_scale",
+        "n_replicas": n_replicas,
+        "rounds": rounds,
+        "group_world_size": group_world_size,
+        "quorum_tick_ms": QUORUM_TICK_MS,
+        "join_timeout_ms": JOIN_TIMEOUT_MS,
+        "transport": "framed protobuf/TCP (production wire), threads-as-replicas",
+        "captured_unix": time.time(),
+    }
+    result.update(bench_lighthouse(n_replicas, rounds))
+    result.update(bench_commit_barrier(group_world_size, rounds * 3))
+
+    # Sanity bounds (generous: this box is 1 CPU core and the GIL schedules
+    # all N clients; production numbers can only be better). A quadratic
+    # tick or a barrier that serializes on N would blow these by 10x.
+    fast_p50 = result["fast_quorum"]["p50_ms"]
+    assert fast_p50 < 10 * QUORUM_TICK_MS, (
+        f"fast-quorum p50 {fast_p50}ms >= {10 * QUORUM_TICK_MS}ms"
+    )
+    leave_overhead = result["leave_requorum"]["overhead_above_join_timeout_ms"]
+    assert leave_overhead < 1000, (
+        f"leave requorum overhead {leave_overhead}ms above join_timeout"
+    )
+    hb_p50 = result["heartbeat"]["p50_ms"]
+    assert hb_p50 < 100, f"heartbeat p50 {hb_p50}ms"
+    barrier_p50 = result["should_commit_barrier"]["p50_ms"]
+    assert barrier_p50 < 250, f"should_commit barrier p50 {barrier_p50}ms"
+
+    print(json.dumps(result))
+    return result
+
+
+if __name__ == "__main__":
+    out = main()
+    (REPO / "CONTROL_PLANE_SCALE.json").write_text(json.dumps(out, indent=2) + "\n")

@@ -41,6 +41,7 @@ KNOWN_ENV = {
     "TPUFT_SOAK_SECONDS", "TPUFT_REGEN_FIXTURES", "TPUFT_SENTINEL_INTERVAL",
     "TPUFT_TRANSPORT_BENCH_GB", "TPUFT_TRANSPORT_BENCH_MODE",
     "TPUFT_TRANSPORT_BENCH_DEADLINE", "TPUFT_TRANSPORT_RSS_BOUND",
+    "TPUFT_CPS_REPLICAS", "TPUFT_CPS_ROUNDS", "TPUFT_CPS_GROUP_WORLD_SIZE",
 }
 
 Check = Tuple[str, Callable[[], Tuple[str, str]]]  # name -> (status, detail)
