@@ -71,6 +71,12 @@ def _parent() -> None:
         attempts.append(("tpu", int(os.environ.get("TPUFT_BENCH_TPU_DEADLINE", "2400"))))
     else:
         sys.stderr.write("bench: accelerator probe failed; skipping TPU attempt\n")
+    # CPU fallback order: the REPRESENTATIVE (non-degraded 27M) config
+    # first — its ratios are the scoreboard number (round-3 verdict item
+    # 6) — then the deadline-bounded degraded config as the last resort.
+    attempts.append(
+        ("cpu-full", int(os.environ.get("TPUFT_BENCH_CPU_FULL_DEADLINE", "3300")))
+    )
     attempts.append(("cpu", int(os.environ.get("TPUFT_BENCH_CPU_DEADLINE", "1500"))))
     import tempfile
 
@@ -84,6 +90,16 @@ def _parent() -> None:
             # an inherited TPUFT_BENCH_MODEL=large would retry the same
             # large workload under a shorter deadline.
             env.pop("TPUFT_BENCH_MODEL", None)
+        elif mode == "cpu-full":
+            # The representative 27M config at ~25 s/step on this 1-core
+            # box: the full default workload (20 steps x best-of-N across
+            # three phases) runs >80 min, so the driver-facing attempt
+            # sizes the loops down (same sync schedule as the committed
+            # BENCH_CPU_FULL artifacts; per-step time is seconds, so few
+            # steps still give stable ratios). Explicit user env wins.
+            env.setdefault("TPUFT_BENCH_STEPS", "6")
+            env.setdefault("TPUFT_BENCH_SYNC_EVERY", "8")
+            env.setdefault("TPUFT_BENCH_SYNC_DELAY", "3")
         with tempfile.NamedTemporaryFile(mode="w+", suffix=f"_bench_{mode}.out") as out:
             try:
                 # stdout to a file (never a pipe — see probe comment); the
@@ -249,25 +265,6 @@ def main() -> None:
 
     tokens_per_step = BATCH * SEQ
 
-    # ---- plain baseline ----
-    # NOTE: timing forces completion by fetching the loss value — on this
-    # machine's remote-chip backend, block_until_ready returns early while a
-    # value fetch truly synchronizes the dispatched chain.
-    # Best-of-3 to damp the remote link's run-to-run variance.
-    opt_state = tx.init(params)
-    p = params
-    for step in range(WARMUP):
-        p, opt_state, loss = plain_step(p, opt_state, batch_for(step))
-    float(loss)
-    plain_tps = 0.0
-    for _rep in range(3):
-        t0 = time.monotonic()
-        for step in range(STEPS):
-            p, opt_state, loss = plain_step(p, opt_state, batch_for(step))
-        float(loss)
-        plain_elapsed = time.monotonic() - t0
-        plain_tps = max(plain_tps, STEPS * tokens_per_step / plain_elapsed)
-
     # ---- fault-tolerant paths ----
     from torchft_tpu.coordination import LighthouseServer
     from torchft_tpu.local_sgd import DiLoCo
@@ -312,9 +309,9 @@ def main() -> None:
     fragment_sync_delay = int(os.environ.get("TPUFT_BENCH_SYNC_DELAY", "5"))
     if DEGRADED:
         fragment_sync_delay = min(fragment_sync_delay, max(sync_every // 2 - 1, 0))
-    manager, handles = make_manager(use_async_quorum=False)
+    diloco_manager, diloco_handles = make_manager(use_async_quorum=False)
     algo = DiLoCo(
-        manager,
+        diloco_manager,
         inner_tx=tx,
         outer_tx=optax.sgd(0.7, momentum=0.9, nesterov=True),
         params=params,
@@ -324,29 +321,14 @@ def main() -> None:
         fragment_sync_delay=fragment_sync_delay,
     )
     diloco_step = algo.make_step_fn(loss_fn)
-    try:
-        for step in range(sync_every):  # one full warmup cycle incl. sync
-            loss, _ = diloco_step(batch_for(step))
-        float(loss)
-        diloco_steps = 2 * sync_every  # two full cycles
-        diloco_tps = 0.0
-        for _rep in range(2):  # best-of-2 damps run-to-run variance
-            t0 = time.monotonic()
-            for step in range(diloco_steps):
-                loss, _ = diloco_step(batch_for(step))
-            float(loss)
-            diloco_elapsed = time.monotonic() - t0
-            diloco_tps = max(diloco_tps, diloco_steps * tokens_per_step / diloco_elapsed)
-    finally:
-        teardown(handles)
 
     # Secondary: per-step FT-DDP via Optimizer.make_step_fn — for this
     # single-group config the lone-replica path fuses loss+grad+update into
     # ONE jitted dispatch (bitwise the plain program), adopted only under
     # the commit barrier; with >1 group the same step_fn switches to the
     # pipelined fp8 bucket sync + speculative update.
-    manager, handles = make_manager(use_async_quorum=True)
-    opt = Optimizer(manager, tx, params)
+    ddp_manager, ddp_handles = make_manager(use_async_quorum=True)
+    opt = Optimizer(ddp_manager, tx, params)
     ddp_steps = max(STEPS // 2, 6)
     quorum_times: list[float] = []
     # Warmup quorum waits (incl. cold first-quorum formation) must not
@@ -357,26 +339,78 @@ def main() -> None:
         should_quantize=True,
         on_quorum=lambda dt: quorum_times.append(dt) if recording[0] else None,
     )
-    ddp_tps = 0.0
+
+    # ---- measurement: INTERLEAVED rounds, order-alternated, summed ----
+    # Per-step compute on this box drifts several percent over minutes
+    # (thermal / scheduler / memory pressure), so sequential phases hand
+    # whichever config ran in the quietest window a free advantage — and a
+    # best-of max over windows then AMPLIFIES the noise into the ratio
+    # (observed both directions: 0.94 and 1.11 for the same ~10ms/step FT
+    # machinery). Instead every round measures all three configs back to
+    # back, the round order flips each time (first slot pays any post-warmup
+    # cold cost), and tps comes from TOTAL steps / TOTAL elapsed across
+    # rounds — summation is unbiased under drift where max is not.
+    # NOTE: timing forces completion by fetching a value — on this
+    # machine's remote-chip backend, block_until_ready returns early while
+    # a value fetch truly synchronizes the dispatched chain.
+    diloco_round_steps = sync_every  # one full cycle (incl. its sync) per round
+    totals = {"plain": [0, 0.0], "ddp": [0, 0.0], "diloco": [0, 0.0]}
     try:
+        # Warmups: plain, one full DiLoCo cycle, two DDP steps.
+        opt_state = tx.init(params)
+        p = params
+        for step in range(WARMUP):
+            p, opt_state, loss = plain_step(p, opt_state, batch_for(step))
+        float(loss)
+        for step in range(sync_every):
+            loss, _ = diloco_step(batch_for(step))
+        float(loss)
         for step in range(2):
             ddp_step(batch_for(step))
-        # Force warmup completion with a value fetch (axon caveat: only a
-        # fetch truly syncs) so rep 1's clock starts on an idle device.
         _ = float(jax.tree_util.tree_leaves(opt.params)[0].sum())
         recording[0] = True
-        for _rep in range(2):  # best-of-2 damps run-to-run variance
+
+        def run_plain() -> None:
+            nonlocal p, opt_state
+            t0 = time.monotonic()
+            for step in range(STEPS):
+                p, opt_state, loss = plain_step(p, opt_state, batch_for(step))
+            float(loss)
+            totals["plain"][0] += STEPS
+            totals["plain"][1] += time.monotonic() - t0
+
+        def run_ddp() -> None:
             t0 = time.monotonic()
             committed = 0
             for step in range(ddp_steps):
                 _, ok = ddp_step(batch_for(step))
                 committed += bool(ok)
             _ = float(jax.tree_util.tree_leaves(opt.params)[0].sum())
-            ddp_elapsed = time.monotonic() - t0
-            if committed:
-                ddp_tps = max(ddp_tps, committed * tokens_per_step / ddp_elapsed)
+            totals["ddp"][0] += committed
+            totals["ddp"][1] += time.monotonic() - t0
+
+        def run_diloco() -> None:
+            t0 = time.monotonic()
+            for step in range(diloco_round_steps):
+                loss, _ = diloco_step(batch_for(step))
+            float(loss)
+            totals["diloco"][0] += diloco_round_steps
+            totals["diloco"][1] += time.monotonic() - t0
+
+        order = [run_plain, run_ddp, run_diloco]
+        for _round in range(2):
+            for run in order:
+                run()
+            order.reverse()
     finally:
-        teardown(handles)
+        teardown(diloco_handles)
+        teardown(ddp_handles)
+
+    def _tps(key: str) -> float:
+        steps_done, elapsed = totals[key]
+        return steps_done * tokens_per_step / elapsed if elapsed and steps_done else 0.0
+
+    plain_tps, ddp_tps, diloco_tps = _tps("plain"), _tps("ddp"), _tps("diloco")
     quorum_p50_ms = round(1000 * statistics.median(quorum_times), 2) if quorum_times else None
 
     # ---- 2-replica-group drill: wire sync cost + kill recovery ----
@@ -448,6 +482,7 @@ def main() -> None:
                 "degraded_cpu_fallback": DEGRADED,
                 "sync_every": sync_every,
                 "fragment_sync_delay": fragment_sync_delay,
+                "bench_steps": STEPS,
                 "model_tflops_per_sec": round(model_tflops, 3),
                 "mfu_pct": mfu_pct,
                 "device_kind": str(getattr(jax.devices()[0], "device_kind", "unknown")),
@@ -568,6 +603,11 @@ def _two_group_drill() -> dict:
         "two_group_quorum_p50_ms": (
             round(1000 * statistics.median(all_quorum), 2) if all_quorum else None
         ),
+        # Both groups share one host: these p50s are a control-plane floor
+        # over localhost, NOT a DCN measurement. The flag travels with the
+        # numbers so no downstream table can quote them without the caveat
+        # (round-3 verdict, weak #7).
+        "two_group_numbers_are_loopback": True,
         # Survivor commits that failed around the kill = steps lost to the
         # failure (north star: < 1 outer step per kill).
         "steps_lost_per_kill": failed_commits[0],

@@ -35,7 +35,8 @@ KNOWN_ENV = {
     "TPUFT_BENCH_MODEL", "TPUFT_BENCH_STEPS", "TPUFT_BENCH_BATCH",
     "TPUFT_BENCH_SEQ", "TPUFT_BENCH_SYNC_EVERY", "TPUFT_BENCH_SYNC_DELAY",
     "TPUFT_BENCH_TPU_DEADLINE", "TPUFT_BENCH_TPU_DEADLINE_LARGE",
-    "TPUFT_BENCH_CPU_DEADLINE", "TPUFT_BENCH_NO_PROBE",
+    "TPUFT_BENCH_CPU_DEADLINE", "TPUFT_BENCH_CPU_FULL_DEADLINE",
+    "TPUFT_BENCH_NO_PROBE",
     # Repo tooling outside the package (tests/benchmarks/sentinel) — real
     # knobs a user may have exported; not typos.
     "TPUFT_SOAK_SECONDS", "TPUFT_REGEN_FIXTURES", "TPUFT_SENTINEL_INTERVAL",
