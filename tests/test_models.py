@@ -268,7 +268,13 @@ def test_ring_attention_zigzag_matches_dense() -> None:
 
 def test_ring_attention_zigzag_gradients_match_dense() -> None:
     """The balanced layout's backward pass (cond + sliced accumulators
-    inside fori_loop) must match dense gradients."""
+    inside fori_loop) must match dense gradients.
+
+    sp=2 deliberately: the reverse-mode shard_map program's compile time
+    grows with ring hops and dominated the suite at sp=4 (~50s); two hops
+    already exercise every backward mechanism (cond branches, sliced
+    accumulators, the permuted layout), and the sp=4 forward is covered by
+    test_ring_attention_zigzag_matches_dense."""
     from torchft_tpu.ops.ring_attention import ring_attention_zigzag
 
     b, s, h, kv, d = 2, 32, 4, 2, 16
@@ -276,7 +282,7 @@ def test_ring_attention_zigzag_gradients_match_dense() -> None:
     q = jax.random.normal(keys[0], (b, s, h, d), jnp.float32)
     k = jax.random.normal(keys[1], (b, s, kv, d), jnp.float32)
     v = jax.random.normal(keys[2], (b, s, kv, d), jnp.float32)
-    mesh = Mesh(np.array(jax.devices()[:4]), ("sp",))
+    mesh = Mesh(np.array(jax.devices()[:2]), ("sp",))
 
     def loss_zz(q, k, v):
         return jnp.sum(ring_attention_zigzag(q, k, v, mesh, scale=d**-0.5) ** 2)
