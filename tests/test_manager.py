@@ -337,6 +337,84 @@ def test_state_dict_roundtrip() -> None:
     assert manager.batches_committed() == 84
 
 
+def test_quorum_happy_timeouts() -> None:
+    """Per-call timeouts thread through to the coordination RPCs (parity:
+    manager_test.py:625-652): an explicit start_quorum timeout reaches
+    the quorum RPC, the ctor timeout is the should_commit default, and an
+    explicit should_commit timeout overrides it."""
+    manager, client, _, _ = make_manager(pg=ProcessGroupDummy(), min_replica_size=1)
+    client._quorum.return_value = make_quorum()
+    client.should_commit.side_effect = lambda rank, step, vote, timeout: vote
+
+    manager.start_quorum(timeout=12.5)
+    assert client._quorum.call_args.kwargs["timeout"] == 12.5
+    manager.start_quorum()  # falls back to the ctor quorum_timeout
+    assert client._quorum.call_args.kwargs["timeout"] == 5.0
+
+    manager.should_commit()
+    assert client.should_commit.call_args.kwargs["timeout"] == 5.0
+    manager.should_commit(timeout=3.25)
+    assert client.should_commit.call_args.kwargs["timeout"] == 3.25
+
+
+def test_quorum_skip_init() -> None:
+    """init_sync=False threads through the quorum request (parity:
+    manager_test.py:653-681 — the server-side plan then skips the step-0
+    parameter mosaic)."""
+    manager, client, _, _ = make_manager(
+        pg=ProcessGroupDummy(), min_replica_size=1, init_sync=False
+    )
+    client._quorum.return_value = make_quorum()
+    manager.start_quorum()
+    assert client._quorum.call_args.kwargs["init_sync"] is False
+
+    default_manager, default_client, _, _ = make_manager(
+        pg=ProcessGroupDummy(), min_replica_size=1
+    )
+    default_client._quorum.return_value = make_quorum()
+    default_manager.start_quorum()
+    assert default_client._quorum.call_args.kwargs["init_sync"] is True
+
+
+def test_quorum_checkpoint_errors() -> None:
+    """A failing checkpoint fetch during healing funnels into report_error
+    and blocks the commit instead of raising through the train loop
+    (parity: manager_test.py:682-724)."""
+    manager, client, _, transport = make_manager(
+        pg=ProcessGroupDummy(), min_replica_size=1
+    )
+    client._quorum.return_value = make_quorum(
+        heal=True,
+        max_step=3,
+        recover_src_manager_address="fake:1",
+        recover_src_replica_rank=1,
+    )
+    transport.recv_checkpoint.side_effect = RuntimeError("fetch failed")
+    with patch(
+        "torchft_tpu.manager.ManagerClient", autospec=True
+    ):  # the recovery-source client constructed inside _async_quorum
+        manager.start_quorum()
+    assert manager.errored() is not None
+    client.should_commit.side_effect = lambda rank, step, vote, timeout: vote
+    assert manager.should_commit() is False
+
+
+def test_quorum_configure_errors() -> None:
+    """A failing pg.configure funnels into report_error, leaves quorum_id
+    unchanged (so the next quorum retries the reconfigure), and blocks the
+    commit (parity: manager_test.py:725-754)."""
+    pg = create_autospec(ProcessGroup, instance=True)
+    pg.configure.side_effect = RuntimeError("configure failed")
+    pg.errored.return_value = None
+    manager, client, _, _ = make_manager(pg=pg, min_replica_size=1)
+    client._quorum.return_value = make_quorum(quorum_id=7)
+    manager.start_quorum()
+    assert manager.errored() is not None
+    assert manager._quorum_id != 7  # retried on the next quorum round
+    client.should_commit.side_effect = lambda rank, step, vote, timeout: vote
+    assert manager.should_commit() is False
+
+
 def test_allreduce_prequantized_zeroes_spare_contribution() -> None:
     """FIXED_WITH_SPARES: a spare's prequantized payload must contribute
     nothing (scales zeroed) and errors must short-circuit to None."""
