@@ -1,0 +1,187 @@
+"""Multi-process streaming DiLoCo end-to-end: 2 replica groups x 2 jax
+processes each (one jax.distributed CPU cluster per group, gloo
+collectives), sharded params, 2 streaming fragments over the packed-int4
+wire, a SIGKILLed rank mid-run, supervised group restart, live heal of
+the DiLoCo state (inner leaves + fragment backups + outer optimizer),
+and cross-process digest equality of the committed global state.
+
+Completes the multi-process operational story: test_multiprocess_e2e.py
+covers FT-DDP across real processes; this covers the semi-sync
+(LocalSGD/DiLoCo) axis the reference exercises only in threads
+(local_sgd_integ_test.py) or external slurm chaos."""
+
+import json
+import pathlib
+import sys
+
+
+_TRAIN_SCRIPT = r"""
+import hashlib, json, os, pathlib, signal, sys, time
+sys.path.insert(0, "@REPO@")
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+os.environ["TPUFT_WIRE_DTYPE"] = "int4"
+
+from torchft_tpu.bootstrap import init_group_jax_cluster, init_manager
+
+group = os.environ["REPLICA_GROUP_ID"]
+rank = int(os.environ.get("GROUP_RANK", "0"))
+out_dir = pathlib.Path(os.environ["E2E_OUT"])
+marker = out_dir / "killed_once"
+
+init_group_jax_cluster()
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from torchft_tpu.local_sgd import DiLoCo
+from torchft_tpu.parallel.process_group import ProcessGroupTCP
+
+pg = ProcessGroupTCP(timeout=15.0)
+manager, store_server = init_manager(
+    pg,
+    min_replica_size=1,
+    timeout=15.0,
+    quorum_timeout=30.0,
+    heartbeat_interval=0.1,
+    use_async_quorum=False,  # DiLoCo requires sync quorum
+    # Identical seeded init on every rank makes the step-0 parameter
+    # mosaic redundant — and with 4 GIL-starved processes hitting the
+    # mosaic in lockstep, a fetcher can lose the race against the donor's
+    # commit closing the serve window, cascading into retry rounds this
+    # 1-core box grinds through very slowly. The mid-run kill still
+    # exercises the REAL heal (restarted group behind, live recovery).
+    init_sync=False,
+)
+
+mesh = Mesh(np.array(jax.devices()), ("fsdp",))
+
+def init_params():
+    key = jax.random.PRNGKey(0)
+    return {
+        "w1": jax.device_put(
+            jax.random.normal(key, (16, 8), jnp.float32) * 0.1,
+            NamedSharding(mesh, P("fsdp", None)),
+        ),
+        "w2": jax.device_put(
+            jnp.zeros((8, 4), jnp.float32), NamedSharding(mesh, P())
+        ),
+    }
+
+SYNC_EVERY, N_SYNCS = 4, 6
+algo = DiLoCo(
+    manager,
+    inner_tx=optax.sgd(0.05),
+    outer_tx=optax.sgd(0.4, momentum=0.9, nesterov=True),
+    params=init_params(),
+    sync_every=SYNC_EVERY,
+    n_fragments=2,
+    should_quantize=True,  # packed-int4 wire (TPUFT_WIRE_DTYPE above)
+)
+
+def grad_for(step, pos):
+    key = jax.random.PRNGKey(100 + 31 * step + pos)
+    return {
+        "w1": jax.device_put(
+            jax.random.normal(key, (16, 8), jnp.float32) * 0.01,
+            NamedSharding(mesh, P("fsdp", None)),
+        ),
+        "w2": jax.device_put(
+            jnp.full((8, 4), 0.001 * pos, jnp.float32), NamedSharding(mesh, P())
+        ),
+    }
+
+def digest_leaves(leaves):
+    # Digest of this RANK's addressable shards (np.asarray on a
+    # non-fully-addressable array raises; each rank digests its own shard
+    # set, compared per-rank across groups).
+    digest = hashlib.sha256()
+    for leaf in leaves:
+        if isinstance(leaf, jax.Array):
+            for shard in sorted(
+                leaf.addressable_shards,
+                key=lambda s: tuple((sl.start or 0) for sl in s.index),
+            ):
+                digest.update(np.asarray(shard.data).tobytes())
+        else:
+            digest.update(np.asarray(leaf).tobytes())
+    return digest.hexdigest()
+
+# Gradients keyed on (committed step, position in cycle) — observed state,
+# identical across groups, self-realigning after the heal.
+while manager.current_step() < N_SYNCS:
+    step = manager.current_step()
+    if group == "1" and rank == 1 and step == 1 and not marker.exists():
+        marker.write_text("x")
+        os.kill(os.getpid(), signal.SIGKILL)  # hard death, no cleanup
+    algo.step(grad_for(step, algo._local_step))
+    time.sleep(0.1)
+
+(out_dir / f"g{group}_r{rank}.json").write_text(
+    json.dumps(
+        {
+            "step": manager.current_step(),
+            # Committed global state: fragment backups (host side already).
+            "backup_digest": digest_leaves(
+                [b for frag in algo._fragments for b in frag.backup]
+            ),
+            # Local leaves equal the merged globals right after the final
+            # committed sync (alpha=0, loop exits at the sync boundary).
+            "leaves_digest": digest_leaves(algo._leaves),
+        }
+    )
+)
+manager.shutdown(wait=False)
+pg.shutdown()
+if store_server is not None:
+    store_server.shutdown()
+"""
+
+
+def test_two_groups_two_jax_procs_diloco_sigkill_recovery(tmp_path) -> None:
+    from torchft_tpu.launch import supervise
+
+    repo = str(pathlib.Path(__file__).resolve().parents[1])
+    script = tmp_path / "diloco_e2e_job.py"
+    script.write_text(_TRAIN_SCRIPT.replace("@REPO@", repo))
+    out_dir = tmp_path / "out"
+    out_dir.mkdir()
+
+    code = supervise(
+        [sys.executable, str(script)],
+        num_replica_groups=2,
+        group_world_size=2,
+        relaunch_interval=0.5,
+        max_restarts=3,
+        store_port_base=29850,
+        jax_coordinator_port_base=29950,
+        extra_env={"E2E_OUT": str(out_dir), "TPUFT_LOG": "warn"},
+    )
+    assert code == 0
+    assert (out_dir / "killed_once").exists(), "the SIGKILL never fired"
+
+    results = {}
+    for group in range(2):
+        for rank in range(2):
+            path = out_dir / f"g{group}_r{rank}.json"
+            assert path.exists(), f"missing result for group {group} rank {rank}"
+            results[(group, rank)] = json.loads(path.read_text())
+    for (group, rank), data in results.items():
+        assert data["step"] == 6, (group, rank, data)
+    # Master invariant: committed DiLoCo global state (fragment backups)
+    # and the merged local leaves (alpha=0: leaves == globals at the exit
+    # boundary) bitwise identical ACROSS GROUPS, per rank — each rank
+    # digests its own shard partitions, identical in both groups by the
+    # HSDP layout contract.
+    for rank in range(2):
+        assert (
+            results[(0, rank)]["backup_digest"]
+            == results[(1, rank)]["backup_digest"]
+        ), rank
+        assert (
+            results[(0, rank)]["leaves_digest"]
+            == results[(1, rank)]["leaves_digest"]
+        ), rank

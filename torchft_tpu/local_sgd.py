@@ -358,9 +358,32 @@ class _Fragment:
                 import jax.numpy as jnp
 
                 payload, scales = averaged
+                # The averaged wire payload arrives as a HOST array on every
+                # local rank. With a multi-rank group the backups are global
+                # arrays over the group's mesh, and a plain jnp.asarray
+                # would make the payload process-LOCAL — mixed local/global
+                # inputs desync the ranks' jitted programs (one raises, the
+                # peer enters the collective: deadlock). Restore it
+                # REPLICATED on the backup's mesh; every rank holds the
+                # identical averaged bytes, so the replicated device_put is
+                # consistent by construction.
+                mesh = (
+                    getattr(self.backup[0].sharding, "mesh", None)
+                    if isinstance(self.backup[0], jax.Array)
+                    else None
+                )
+                if mesh is not None and len(mesh.devices.flat) > 1:
+                    from jax.sharding import NamedSharding, PartitionSpec
+
+                    replicated = NamedSharding(mesh, PartitionSpec())
+                    payload = jax.device_put(np.asarray(payload), replicated)
+                    scales = jax.device_put(np.asarray(scales), replicated)
+                else:
+                    payload = jnp.asarray(payload)
+                    scales = jnp.asarray(scales)
                 new_backup, merged, self.outer_opt_state = self._jit_apply_outer(
-                    jnp.asarray(payload),
-                    jnp.asarray(scales),
+                    payload,
+                    scales,
                     self.backup,
                     local_copy,
                     self.outer_opt_state,
