@@ -15,7 +15,9 @@ from __future__ import annotations
 
 import pickle
 import socket
+import time
 import threading
+import urllib.error
 import urllib.request
 from concurrent.futures import ThreadPoolExecutor
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -162,7 +164,9 @@ class HTTPTransport(CheckpointTransport[Any]):
         self, src_rank: int, metadata: str, step: int, timeout: float
     ) -> Any:
         base = f"{metadata}/checkpoint/{step}"
-        num_chunks, treedef = safe_loads(_fetch(f"{base}/meta", timeout))
+        num_chunks, treedef = safe_loads(
+            _fetch_retry_404(f"{base}/meta", timeout)
+        )
 
         def fetch_chunk(i: int) -> Any:
             # Stream-decode straight off the socket into final buffers: peak
@@ -191,3 +195,27 @@ class HTTPTransport(CheckpointTransport[Any]):
 def _fetch(url: str, timeout: float) -> bytes:
     with urllib.request.urlopen(url, timeout=timeout) as resp:
         return resp.read()
+
+
+def _fetch_retry_404(url: str, timeout: float) -> bytes:
+    """Fetch with bounded retry on 404.
+
+    A 404 from the donor means "nothing staged for this step" — which is
+    often *not yet*: the joiner's fetch races the donor staging inside its
+    own quorum round, and under a loaded host (many GIL-scheduled ranks)
+    the donor's serve window can even close (commit → disallow) and REOPEN
+    on the retry round before a slow fetcher gets through. Retrying within
+    the caller's timeout turns both races into a wait; a real
+    wrong-step/never-staged fetch still fails when the window expires.
+    Only the first (meta) fetch needs this — once meta succeeds the chunks
+    are staged and pinned by the same _Staged object."""
+    deadline = time.monotonic() + timeout
+    delay = 0.05
+    while True:
+        try:
+            return _fetch(url, max(0.1, deadline - time.monotonic()))
+        except urllib.error.HTTPError as e:
+            if e.code != 404 or time.monotonic() + delay >= deadline:
+                raise
+        time.sleep(delay)
+        delay = min(delay * 1.5, 1.0)
