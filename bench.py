@@ -91,6 +91,12 @@ def _parent() -> None:
             # large workload under a shorter deadline.
             env.pop("TPUFT_BENCH_MODEL", None)
         elif mode == "cpu-full":
+            # The representative config must be the DEFAULT model: an
+            # inherited TPUFT_BENCH_MODEL=large (the way users request the
+            # MFU config on a live chip) would grind the ~400M workload on
+            # CPU until the deadline kills it (same inheritance bug the
+            # tpu fallback pops above).
+            env.pop("TPUFT_BENCH_MODEL", None)
             # The representative 27M config at ~25 s/step on this 1-core
             # box: the full default workload (20 steps x best-of-N across
             # three phases) runs >80 min, so the driver-facing attempt
@@ -626,9 +632,11 @@ if __name__ == "__main__":
         DEGRADED = True
         main()
     elif child_mode == "cpu-full":
-        # The default (27M-param) config on CPU, NOT degraded: the artifact
-        # generator for PERF.md's non-degraded CPU rows (takes minutes; not
-        # on the driver's fallback path, which must meet a deadline).
+        # The default (27M-param) config on CPU, NOT degraded. This IS the
+        # driver fallback chain's first CPU attempt (deadline
+        # TPUFT_BENCH_CPU_FULL_DEADLINE; _parent sizes the loops down via
+        # TPUFT_BENCH_STEPS/SYNC_EVERY) — keep the workload inside that
+        # budget when growing it. Also the PERF.md artifact generator.
         import jax
 
         jax.config.update("jax_platforms", "cpu")

@@ -88,7 +88,6 @@ def bench_lighthouse(n_replicas: int, rounds: int) -> dict:
     clients = [LighthouseClient(addr) for _ in range(n_replicas)]
     latencies: list = []
     leave_latencies: list = []
-    start_barrier = threading.Barrier(n_replicas)
 
     # Continuous heartbeats for the WHOLE run, like a real manager's
     # heartbeat loop (native/src/manager.cc): quorum requests only count

@@ -71,7 +71,7 @@ def init_params():
         ),
     }
 
-SYNC_EVERY, N_SYNCS = 4, 6
+SYNC_EVERY, N_SYNCS = 4, 8
 algo = DiLoCo(
     manager,
     inner_tx=optax.sgd(0.05),
@@ -112,18 +112,27 @@ def digest_leaves(leaves):
 
 # Gradients keyed on (committed step, position in cycle) — observed state,
 # identical across groups, self-realigning after the heal.
+# Paced (0.5s/step) so the survivor is still training when the killed
+# group's restart (~15s of jax startup) rejoins: the restarted group must
+# LIVE-HEAL into the run, which the committed-steps assertion below
+# verifies — a from-scratch solo replay would commit from step 1.
+committed_steps = []
 while manager.current_step() < N_SYNCS:
     step = manager.current_step()
     if group == "1" and rank == 1 and step == 1 and not marker.exists():
         marker.write_text("x")
         os.kill(os.getpid(), signal.SIGKILL)  # hard death, no cleanup
-    algo.step(grad_for(step, algo._local_step))
-    time.sleep(0.1)
+    if algo.step(grad_for(step, algo._local_step)):
+        committed_steps.append(manager.current_step())
+    time.sleep(0.5)
 
 (out_dir / f"g{group}_r{rank}.json").write_text(
     json.dumps(
         {
             "step": manager.current_step(),
+            # This incarnation's committed steps: a healed joiner's first
+            # commit continues from the survivor's step, never from 1.
+            "committed_steps": committed_steps,
             # Committed global state: fragment backups (host side already).
             "backup_digest": digest_leaves(
                 [b for frag in algo._fragments for b in frag.backup]
@@ -170,7 +179,16 @@ def test_two_groups_two_jax_procs_diloco_sigkill_recovery(tmp_path) -> None:
             assert path.exists(), f"missing result for group {group} rank {rank}"
             results[(group, rank)] = json.loads(path.read_text())
     for (group, rank), data in results.items():
-        assert data["step"] == 6, (group, rank, data)
+        assert data["step"] == 8, (group, rank, data)
+    # The restarted group's final incarnation must have HEALED into the
+    # run, not replayed solo: the SIGKILL fires at outer step 1, so a
+    # from-scratch incarnation's commits start at 1-2 while a healed one
+    # starts at the survivor's step (>2 by the time ~15s of jax restart
+    # has passed against the survivor's ~2s sync cadence).
+    g1_first_commit = min(results[(1, 1)]["committed_steps"])
+    assert g1_first_commit > 2, (
+        f"group 1 replayed solo from step {g1_first_commit} — heal never ran"
+    )
     # Master invariant: committed DiLoCo global state (fragment backups)
     # and the merged local leaves (alpha=0: leaves == globals at the exit
     # boundary) bitwise identical ACROSS GROUPS, per rank — each rank

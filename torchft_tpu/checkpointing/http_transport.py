@@ -171,8 +171,23 @@ class HTTPTransport(CheckpointTransport[Any]):
         def fetch_chunk(i: int) -> Any:
             # Stream-decode straight off the socket into final buffers: peak
             # memory = final leaves + one in-flight read window per chunk.
-            with urllib.request.urlopen(f"{base}/{i}", timeout=timeout) as resp:
-                return _serialization.load_state_dict(resp)
+            # Same 404 retry as the meta fetch: the donor's serve window can
+            # close (commit -> disallow) BETWEEN our meta and chunk requests
+            # — nothing pins the staged object across GETs — and reopen on
+            # its retry round.
+            deadline = time.monotonic() + timeout
+            delay = 0.05
+            while True:
+                try:
+                    with urllib.request.urlopen(
+                        f"{base}/{i}", timeout=max(0.1, deadline - time.monotonic())
+                    ) as resp:
+                        return _serialization.load_state_dict(resp)
+                except urllib.error.HTTPError as e:
+                    if e.code != 404 or time.monotonic() + delay >= deadline:
+                        raise
+                time.sleep(delay)
+                delay = min(delay * 1.5, 1.0)
 
         if num_chunks == 1:
             chunks = [fetch_chunk(0)]
@@ -207,8 +222,9 @@ def _fetch_retry_404(url: str, timeout: float) -> bytes:
     on the retry round before a slow fetcher gets through. Retrying within
     the caller's timeout turns both races into a wait; a real
     wrong-step/never-staged fetch still fails when the window expires.
-    Only the first (meta) fetch needs this — once meta succeeds the chunks
-    are staged and pinned by the same _Staged object."""
+    The chunk fetches carry the same retry (fetch_chunk above): the server
+    re-resolves the staged object per GET, so nothing pins it between the
+    meta and chunk requests."""
     deadline = time.monotonic() + timeout
     delay = 0.05
     while True:

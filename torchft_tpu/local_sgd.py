@@ -48,32 +48,49 @@ def _to_device_like(host: np.ndarray, like: Any) -> Any:
     return jnp.asarray(host)
 
 
-def _restore_like(state: Any, template: Any, device: bool) -> Any:
-    """Restores a healed pytree onto the TEMPLATE's shardings (leaf by
-    leaf, where shapes line up) so a joiner's state lands with the same
-    partitioning the donor computes with; falls back to a plain restore
-    when the structures differ."""
+def _restore_leaf_like(new: Any, like: Any, device: bool) -> Any:
+    """One healed leaf onto ``like``'s layout. Routes through
+    ``optim._restore_leaf`` so multi-host donor captures
+    (:class:`~torchft_tpu.checkpointing._serialization.ShardedLeaf`) are
+    reassembled shard-by-shard against the current sharding — plain host
+    arrays land via device_put on the template's sharding."""
     import jax.numpy as jnp
 
-    as_leaf = jnp.asarray if device else np.asarray
+    from torchft_tpu.checkpointing._serialization import ShardedLeaf
+    from torchft_tpu.optim import _restore_leaf
 
-    def restore(x: Any, like: Any) -> Any:
-        if not hasattr(x, "shape"):
-            return x
-        if (
-            device
-            and isinstance(like, jax.Array)
-            and getattr(like, "shape", None) == x.shape
-        ):
-            return _to_device_like(np.asarray(x), like)
-        return as_leaf(x)
+    if isinstance(new, ShardedLeaf) or device:
+        return _restore_leaf(new, like)
+    if hasattr(new, "shape"):
+        return np.asarray(new)
+    return new
 
-    try:
-        return jax.tree_util.tree_map(restore, state, template)
-    except ValueError:  # structure mismatch (e.g. fresh vs restored optax state)
+
+def _restore_like(state: Any, template: Any, device: bool) -> Any:
+    """Restores a healed pytree onto the TEMPLATE's shardings (leaf by
+    leaf) so a joiner's state lands with the same partitioning the donor
+    computes with; falls back to a plain restore only on an explicit
+    treedef mismatch (e.g. fresh vs restored optax state) — a leaf-level
+    failure inside a matching restore must surface, not silently drop the
+    shardings."""
+    import jax.numpy as jnp
+
+    from torchft_tpu.checkpointing._serialization import ShardedLeaf
+
+    is_leaf = lambda x: isinstance(x, ShardedLeaf)  # noqa: E731
+    if jax.tree_util.tree_structure(
+        state, is_leaf=is_leaf
+    ) != jax.tree_util.tree_structure(template):
+        as_leaf = jnp.asarray if device else np.asarray
         return jax.tree_util.tree_map(
             lambda x: as_leaf(x) if hasattr(x, "shape") else x, state
         )
+    return jax.tree_util.tree_map(
+        lambda x, like: _restore_leaf_like(x, like, device),
+        state,
+        template,
+        is_leaf=is_leaf,
+    )
 
 
 class LocalSGD:
@@ -265,8 +282,17 @@ class _Fragment:
         self._jit_apply_outer = jax.jit(apply_outer)
 
     def _save_state(self) -> Dict[str, Any]:
+        # Device backups are handed over as-is: the checkpoint transport
+        # host-converts every leaf at staging time (ShardedLeaf capture for
+        # non-fully-addressable arrays — an eager np.array here would RAISE
+        # on multi-host shardings). Host backups are snapshotted since the
+        # list is rebound, never mutated, on sync.
         return {
-            "original_parameters": [np.array(b) for b in self.backup],
+            "original_parameters": (
+                list(self.backup)
+                if self._should_quantize
+                else [np.array(b) for b in self.backup]
+            ),
             "outer_optimizer": self.outer_opt_state,
         }
 
@@ -277,13 +303,22 @@ class _Fragment:
         # joiner's jitted programs would then partition differently from the
         # donor's, and their reductions drift by an ulp per sync (breaking
         # the bitwise cross-replica invariant the integration tests assert).
+        # Multi-host donor captures arrive as ShardedLeaf and reassemble
+        # against the current backup's sharding (_restore_leaf_like).
+        restored = state["original_parameters"]
+        if len(restored) != len(self.backup):
+            raise ValueError(
+                f"healed fragment has {len(restored)} leaves, expected "
+                f"{len(self.backup)}: donor/joiner fragment partitioning "
+                "must match"
+            )
         if self._should_quantize:
             self.backup = [
-                _to_device_like(np.asarray(b), like)
-                for b, like in zip(state["original_parameters"], self.backup)
+                _restore_leaf_like(b, like, device=True)
+                for b, like in zip(restored, self.backup)
             ]
         else:
-            self.backup = [np.array(b) for b in state["original_parameters"]]
+            self.backup = [np.array(b) for b in restored]
         self.outer_opt_state = _restore_like(
             state["outer_optimizer"],
             self.outer_opt_state,
@@ -511,22 +546,21 @@ class DiLoCo:
         return {"leaves": list(self._leaves), "opt_state": self.inner_opt_state}
 
     def _load_inner(self, state: Dict[str, Any]) -> None:
-        import jax.numpy as jnp
-
-        # Restore onto the existing leaves' shardings (see _restore_like):
-        # a healed joiner must end up with the same partitioning the donor
-        # computes with, or their jitted programs diverge by an ulp.
+        # Restore onto the existing leaves' shardings (see
+        # _restore_leaf_like): a healed joiner must end up with the same
+        # partitioning the donor computes with, or their jitted programs
+        # diverge by an ulp. Multi-host donor captures (ShardedLeaf)
+        # reassemble against the current leaves' shardings.
         old = self._leaves
         new = state["leaves"]
-        if len(old) == len(new):
-            self._leaves = [
-                _to_device_like(np.asarray(x), like)
-                if getattr(like, "shape", None) == getattr(x, "shape", None)
-                else jnp.asarray(x)
-                for x, like in zip(new, old)
-            ]
-        else:
-            self._leaves = [jnp.asarray(x) for x in new]
+        if len(old) != len(new):
+            raise ValueError(
+                f"healed inner state has {len(new)} leaves, expected "
+                f"{len(old)}: donor/joiner models must match"
+            )
+        self._leaves = [
+            _restore_leaf_like(x, like, device=True) for x, like in zip(new, old)
+        ]
         self.inner_opt_state = _restore_like(
             state["opt_state"], self.inner_opt_state, device=True
         )
