@@ -6,6 +6,7 @@ machine: configure-on-quorum-change, participation math, healing sync/async,
 error funnel, commit/max_retries, FIXED_WITH_SPARES.
 """
 
+import threading
 from typing import Optional
 from unittest.mock import MagicMock, create_autospec, patch
 
@@ -413,6 +414,54 @@ def test_quorum_configure_errors() -> None:
     assert manager._quorum_id != 7  # retried on the next quorum round
     client.should_commit.side_effect = lambda rank, step, vote, timeout: vote
     assert manager.should_commit() is False
+
+
+def test_should_commit_async_overlaps_and_heals() -> None:
+    """should_commit_async runs the full barrier on the manager's executor:
+    the returned future resolves to the commit verdict, a pending heal is
+    applied during resolution (not before), and step accounting matches
+    the synchronous path."""
+    import time as _time
+
+    manager, client, _, transport = make_manager(
+        pg=ProcessGroupDummy(), min_replica_size=1
+    )
+    client._quorum.return_value = make_quorum()
+    manager.start_quorum()
+
+    release = threading.Event()
+
+    def slow_commit(rank, step, vote, timeout):
+        release.wait(timeout=10)
+        return vote
+
+    client.should_commit.side_effect = slow_commit
+    future = manager.should_commit_async()
+    # The caller thread is free while the RPC is parked on the executor.
+    assert not future.done()
+    release.set()
+    assert future.result(timeout=10) is True
+    assert manager.current_step() == 1
+
+    # A heal staged before the barrier is applied during resolution.
+    client._quorum.return_value = make_quorum(
+        heal=True,
+        max_step=5,
+        recover_src_manager_address="fake:1",
+        recover_src_replica_rank=1,
+    )
+    healed = {"user": {"model": {"w": np.full(2, 9.0)}}, "tpuft": {"step": 5, "batches_committed": 5}}
+    transport.recv_checkpoint.return_value = healed
+    client.should_commit.side_effect = lambda rank, step, vote, timeout: vote
+    with patch("torchft_tpu.manager.ManagerClient", autospec=True):
+        manager.start_quorum(allow_heal=True)
+    # Sync-mode quorum applies the heal eagerly at start_quorum; the async
+    # barrier must still see the healed step and advance it.
+    load_fn = manager._load_state_dict_fns["model"]
+    load_fn.assert_called_once()
+    assert manager.current_step() == 5
+    assert manager.should_commit_async().result(timeout=10) is True
+    assert manager.current_step() == 6
 
 
 def test_allreduce_prequantized_zeroes_spare_contribution() -> None:
