@@ -63,6 +63,33 @@ def test_local_sgd_syncs_every_n_steps() -> None:
         np.testing.assert_allclose(algo.params[key], expected[key], rtol=1e-6)
 
 
+def test_local_sgd_sync_preserves_shardings() -> None:
+    """The parameter-averaging sync rides the shard-preserving path: after
+    a committed sync, sharded leaves keep their NamedShardings (a host
+    round-trip that re-landed them replicated would desync multi-rank
+    groups' jitted programs, and a whole-leaf fetch would raise outright
+    on non-fully-addressable state)."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("fsdp", "tp"))
+    sharding = NamedSharding(mesh, P("fsdp", "tp"))
+    params = {
+        "w": jax.device_put(jnp.ones((4, 4), jnp.float32), sharding),
+        "b": jnp.zeros((3,), jnp.float32),
+    }
+    manager = scripted_manager()
+    algo = LocalSGD(manager, optax.sgd(0.1), params, sync_every=1)
+    grads = {
+        "w": jax.device_put(jnp.full((4, 4), 0.5, jnp.float32), sharding),
+        "b": jnp.full((3,), 0.1, jnp.float32),
+    }
+    assert algo.step(grads)  # sync round commits
+    assert algo.params["w"].sharding == sharding
+    np.testing.assert_allclose(
+        np.asarray(algo.params["w"]), np.full((4, 4), 0.95), rtol=1e-6
+    )
+
+
 def test_local_sgd_failed_commit_keeps_local_params() -> None:
     manager = scripted_manager()
     manager._client.should_commit.side_effect = None

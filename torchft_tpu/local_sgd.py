@@ -36,10 +36,6 @@ logger = logging.getLogger(__name__)
 __all__ = ["LocalSGD", "DiLoCo"]
 
 
-def _to_host_leaves(leaves: Sequence[Any]) -> List[np.ndarray]:
-    return [np.asarray(leaf) for leaf in leaves]
-
-
 def _to_device_like(host: np.ndarray, like: Any) -> Any:
     import jax.numpy as jnp
 
@@ -179,17 +175,20 @@ class LocalSGD:
         return self._sync()
 
     def _sync(self) -> bool:
+        # Shard-preserving parameter averaging (parallel/mesh.py): each
+        # rank stages its OWN addressable shards, reduces them with the
+        # same-rank shards in the other replica groups, and reassembles
+        # onto the original shardings — so LocalSGD composes with
+        # multi-host fsdp/tp state (a whole-leaf host fetch would raise on
+        # non-fully-addressable arrays and lose the shardings on restore).
+        from torchft_tpu.parallel.mesh import ft_allreduce_sharded
+
         self._manager.start_quorum()
-        leaves, treedef = jax.tree_util.tree_flatten(self.params)
-        work = self._manager.allreduce_pytree(_to_host_leaves(leaves))
-        averaged = work.wait()
+        averaged = ft_allreduce_sharded(self._manager, self.params)
         if self._manager.should_commit():
             self._manager.disallow_state_dict_read()
             try:
-                self.params = jax.tree_util.tree_unflatten(
-                    treedef,
-                    [_to_device_like(avg, leaf) for avg, leaf in zip(averaged, leaves)],
-                )
+                self.params = averaged
             finally:
                 self._manager.allow_state_dict_read()
             return True
@@ -234,6 +233,17 @@ class _Fragment:
             self.backup: List[Any] = [jnp.asarray(x) for x in initial_leaves]
         else:
             # Host backup (the "CPU-pinned" analogue of the reference).
+            # Requires fully-addressable leaves: fail at construction with
+            # guidance rather than deep inside the first sync.
+            for x in initial_leaves:
+                if isinstance(x, jax.Array) and not x.is_fully_addressable:
+                    raise ValueError(
+                        "DiLoCo's host (non-quantized) pipeline needs "
+                        "fully-addressable parameters; for multi-host "
+                        "sharded state use should_quantize=True (the "
+                        "device pipeline keeps backups sharded on the "
+                        "group mesh)"
+                    )
             self.backup = [np.array(x, copy=True) for x in initial_leaves]
         self.outer_opt_state = outer_tx.init(self.backup)
         self._work: Optional[Work] = None
