@@ -178,14 +178,20 @@ def test_llama_ring_impl_without_bound_axis_fails_loudly() -> None:
 
 def test_ring_attention_gradients_match_dense() -> None:
     """Training through ring attention: reverse-mode through the
-    fori_loop + ppermute ring must match dense attention gradients."""
+    fori_loop + ppermute ring must match dense attention gradients.
+
+    sp=2 (like the zigzag gradient test): the reverse-mode shard_map
+    compile grows with ring hops and dominated suite time at sp=4; two
+    hops already exercise every backward mechanism, and sp=4 forward
+    coverage lives in test_ring_attention_matches_dense and the sp-mesh
+    Llama tests."""
     b, s, h, kv, d = 2, 32, 4, 2, 16
     key = jax.random.PRNGKey(0)
     kq, kk, kvk = jax.random.split(key, 3)
     q = jax.random.normal(kq, (b, s, h, d), jnp.float32)
     k = jax.random.normal(kk, (b, s, kv, d), jnp.float32)
     v = jax.random.normal(kvk, (b, s, kv, d), jnp.float32)
-    mesh = Mesh(np.array(jax.devices()[:4]), ("sp",))
+    mesh = Mesh(np.array(jax.devices()[:2]), ("sp",))
 
     def loss_ring(q, k, v):
         return jnp.sum(ring_attention_sharded(q, k, v, mesh, scale=d**-0.5) ** 2)
@@ -303,7 +309,10 @@ def test_blockwise_attention_matches_dense() -> None:
     from torchft_tpu.models.llama import causal_attention
     from torchft_tpu.ops.ring_attention import blockwise_attention
 
-    for (b, s, h, kv, d, blk) in [(2, 96, 4, 2, 16, 32), (1, 100, 4, 4, 8, 32)]:
+    # ONE case carrying every property at once (GQA h != kv AND a
+    # non-block-multiple sequence): the second shape only re-compiled the
+    # same fwd+vjp programs for ~7s of suite time with no new mechanism.
+    for (b, s, h, kv, d, blk) in [(2, 100, 4, 2, 16, 32)]:
         kq, kk, kvk = jax.random.split(jax.random.PRNGKey(s), 3)
         q = jax.random.normal(kq, (b, s, h, d), jnp.float32)
         k = jax.random.normal(kk, (b, s, kv, d), jnp.float32)
