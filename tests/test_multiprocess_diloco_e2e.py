@@ -117,6 +117,7 @@ def digest_leaves(leaves):
 # LIVE-HEAL into the run, which the committed-steps assertion below
 # verifies — a from-scratch solo replay would commit from step 1.
 committed_steps = []
+loop_started_unix = time.time()
 while manager.current_step() < N_SYNCS:
     step = manager.current_step()
     if group == "1" and rank == 1 and step == 1 and not marker.exists():
@@ -133,6 +134,11 @@ while manager.current_step() < N_SYNCS:
             # This incarnation's committed steps: a healed joiner's first
             # commit continues from the survivor's step, never from 1.
             "committed_steps": committed_steps,
+            # Overlap detection for the heal assertion: the heal is only
+            # physically possible if this incarnation's loop started while
+            # the survivor was still training.
+            "loop_started_unix": loop_started_unix,
+            "finished_unix": time.time(),
             # Committed global state: fragment backups (host side already).
             "backup_digest": digest_leaves(
                 [b for frag in algo._fragments for b in frag.backup]
@@ -184,11 +190,28 @@ def test_two_groups_two_jax_procs_diloco_sigkill_recovery(tmp_path) -> None:
     # run, not replayed solo: the SIGKILL fires at outer step 1, so a
     # from-scratch incarnation's commits start at 1-2 while a healed one
     # starts at the survivor's step (>2 by the time ~15s of jax restart
-    # has passed against the survivor's ~2s sync cadence).
-    g1_first_commit = min(results[(1, 1)]["committed_steps"])
-    assert g1_first_commit > 2, (
-        f"group 1 replayed solo from step {g1_first_commit} — heal never ran"
+    # has passed against the survivor's ~2s sync cadence). On a normal box
+    # the restart always overlaps the paced survivor; under extreme load
+    # the survivor can finish first, in which case a heal is physically
+    # impossible (nothing left to heal from) and the solo replay is the
+    # CORRECT elastic behavior — the digest checks above still hold. Gate
+    # on observed overlap, not timing assumptions (CLAUDE.md).
+    overlapped = (
+        results[(1, 1)]["loop_started_unix"] < results[(0, 0)]["finished_unix"]
     )
+    g1_first_commit = min(results[(1, 1)]["committed_steps"])
+    if overlapped:
+        assert g1_first_commit > 2, (
+            f"group 1 replayed solo from step {g1_first_commit} despite "
+            "overlapping the survivor — heal never ran"
+        )
+    else:
+        import warnings
+
+        warnings.warn(
+            "survivor finished before the restart rejoined (loaded box): "
+            "heal not exercised this run; digests still verified"
+        )
     # Master invariant: committed DiLoCo global state (fragment backups)
     # and the merged local leaves (alpha=0: leaves == globals at the exit
     # boundary) bitwise identical ACROSS GROUPS, per rank — each rank
