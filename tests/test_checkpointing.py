@@ -134,6 +134,73 @@ def test_http_transport_wrong_step_404s() -> None:
         donor.shutdown()
 
 
+def test_retry_window_semantics() -> None:
+    """Pins _RetryWindow's contract: (a) the window opens at the FIRST 404
+    (transfer time never drains it); (b) parallel waiters cost wall clock
+    once — the same wake_time answers the same for every fetch; (c) a fetch
+    keeps its per-fetch floor even when the shared window is spent."""
+    from torchft_tpu.checkpointing.http_transport import _RetryWindow
+
+    # (a) Window not opened by construction time: sleeping (as a slow
+    # transfer would) before the first allows() call must not drain it.
+    w = _RetryWindow(0.2)
+    time.sleep(0.3)
+    now = time.monotonic()
+    assert w.allows(now + 0.05, fetch_floor_deadline=0.0)
+
+    # (b) Shared wall deadline: identical wake_times get identical answers
+    # regardless of how many fetches ask (no additive draining).
+    far_wake = now + 10.0
+    assert not w.allows(far_wake, fetch_floor_deadline=0.0)
+    assert not w.allows(far_wake, fetch_floor_deadline=0.0)
+    near_wake = now + 0.05
+    assert w.allows(near_wake, fetch_floor_deadline=0.0)
+    assert w.allows(near_wake, fetch_floor_deadline=0.0)
+
+    # (c) A zero-width shared window still admits retries under the
+    # fetch's own floor (late-pool chunk after others spent the window).
+    w2 = _RetryWindow(0.0)
+    now = time.monotonic()
+    assert not w2.allows(now + 0.05, fetch_floor_deadline=0.0)
+    assert w2.allows(now + 0.05, fetch_floor_deadline=now + 5.0)
+
+
+def test_fetch_retry_404_retries_until_staged() -> None:
+    """_fetch_retry_404 rides out 404s (donor hasn't staged yet / serve
+    window reopening) and returns the body once the server serves."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from torchft_tpu.checkpointing.http_transport import _fetch_retry_404
+
+    hits = []
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            hits.append(1)
+            if len(hits) <= 2:
+                self.send_error(404)
+                return
+            body = b"staged"
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        url = f"http://127.0.0.1:{server.server_address[1]}/x"
+        assert _fetch_retry_404(url, timeout=5.0) == b"staged"
+        assert len(hits) == 3  # two 404 rounds, then success
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
 # -- PG transport -----------------------------------------------------------
 
 

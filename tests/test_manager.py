@@ -464,6 +464,112 @@ def test_should_commit_async_overlaps_and_heals() -> None:
     assert manager.current_step() == 6
 
 
+def test_start_quorum_drains_unresolved_commit_future() -> None:
+    """start_quorum must not wipe the per-step error/heal flags while a
+    should_commit_async future is unresolved: it drains the future first so
+    the queued barrier votes with THIS step's flags (the ordering contract
+    documented on should_commit_async, now enforced rather than advisory)."""
+    manager, client, _, _ = make_manager(pg=ProcessGroupDummy(), min_replica_size=1)
+    client._quorum.return_value = make_quorum()
+    manager.start_quorum()
+
+    manager.report_error(RuntimeError("step math failed"))
+    votes = []
+    client.should_commit.side_effect = (
+        lambda rank, step, vote, timeout: votes.append(vote) or vote
+    )
+
+    # Park the single-worker executor so the async barrier stays QUEUED —
+    # the dangerous window where a misordered start_quorum used to wipe the
+    # flags before the barrier ever read them.
+    gate = threading.Event()
+    manager._executor.submit(gate.wait, 10)
+    future = manager.should_commit_async()
+
+    started = threading.Event()
+    finished = threading.Event()
+
+    def second_quorum() -> None:
+        started.set()
+        manager.start_quorum()
+        finished.set()
+
+    t = threading.Thread(target=second_quorum, daemon=True)
+    t.start()
+    assert started.wait(timeout=5)
+    # start_quorum is blocked draining the unresolved commit; the error
+    # flag must still be live for the barrier to see.
+    assert not finished.wait(timeout=0.5)
+    assert manager.errored() is not None
+    gate.set()
+    t.join(timeout=10)
+    assert finished.is_set()
+    assert future.done()
+    assert future.result() is False  # voted with the real (errored) flags
+    assert votes == [False]
+    assert manager.current_step() == 0  # the failed commit did not advance
+
+
+def test_tracked_commit_future_timeout_is_not_consumption() -> None:
+    """A result() wait that times out observed nothing: the future must
+    stay unconsumed so a later drain still delivers the barrier outcome —
+    while a delivered outcome (value or the barrier's own exception) marks
+    it consumed."""
+    import concurrent.futures
+
+    from torchft_tpu.manager import _TrackedCommitFuture
+
+    pool = concurrent.futures.ThreadPoolExecutor(max_workers=1)
+    gate = threading.Event()
+    try:
+        f = _TrackedCommitFuture(pool.submit(gate.wait, 10))
+        with pytest.raises(TimeoutError):
+            f.result(timeout=0.05)
+        assert not f.consumed
+        gate.set()
+        assert f.result(timeout=10) is True
+        assert f.consumed
+
+        boom = _TrackedCommitFuture(pool.submit(lambda: 1 / 0))
+        with pytest.raises(ZeroDivisionError):
+            boom.result(timeout=10)
+        assert boom.consumed
+
+        via_exc = _TrackedCommitFuture(pool.submit(lambda: 1 / 0))
+        assert isinstance(via_exc.exception(timeout=10), ZeroDivisionError)
+        assert via_exc.consumed
+    finally:
+        gate.set()
+        pool.shutdown(wait=False)
+
+
+def test_start_quorum_propagates_unconsumed_barrier_exception_once() -> None:
+    """A barrier exception the caller never observed must surface from the
+    drain (else e.g. the max_retries supervisor-restart signal is silently
+    dropped) — but one the caller already resolved and handled must NOT
+    replay on a later, healthy start_quorum."""
+    manager, client, _, _ = make_manager(
+        pg=ProcessGroupDummy(), min_replica_size=1, max_retries=0
+    )
+    client._quorum.return_value = make_quorum()
+    client.should_commit.side_effect = lambda rank, step, vote, timeout: False
+
+    # Unconsumed errored future -> the drain raises it.
+    manager.start_quorum()
+    future = manager.should_commit_async()
+    with pytest.raises(RuntimeError, match="max_retries"):
+        manager.start_quorum()
+    assert future.done()
+
+    # Consumed errored future -> the next start_quorum must NOT replay it.
+    manager.start_quorum()
+    future = manager.should_commit_async()
+    with pytest.raises(RuntimeError, match="max_retries"):
+        future.result(timeout=10)
+    manager.start_quorum()  # caller handled it; no stale re-raise
+    assert manager.errored() is None
+
+
 def test_allreduce_prequantized_zeroes_spare_contribution() -> None:
     """FIXED_WITH_SPARES: a spare's prequantized payload must contribute
     nothing (scales zeroed) and errors must short-circuit to None."""

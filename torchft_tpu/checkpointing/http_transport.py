@@ -21,7 +21,7 @@ import urllib.error
 import urllib.request
 from concurrent.futures import ThreadPoolExecutor
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 import jax
 
@@ -164,8 +164,11 @@ class HTTPTransport(CheckpointTransport[Any]):
         self, src_rank: int, metadata: str, step: int, timeout: float
     ) -> Any:
         base = f"{metadata}/checkpoint/{step}"
+        # One shared window caps the 404-RETRY WAITING across the meta and
+        # every chunk (see _RetryWindow for the exact semantics).
+        retry_window = _RetryWindow(timeout)
         num_chunks, treedef = safe_loads(
-            _fetch_retry_404(f"{base}/meta", timeout)
+            _fetch_retry_404(f"{base}/meta", timeout, retry_window=retry_window)
         )
 
         def fetch_chunk(i: int) -> Any:
@@ -175,19 +178,12 @@ class HTTPTransport(CheckpointTransport[Any]):
             # close (commit -> disallow) BETWEEN our meta and chunk requests
             # — nothing pins the staged object across GETs — and reopen on
             # its retry round.
-            deadline = time.monotonic() + timeout
-            delay = 0.05
-            while True:
-                try:
-                    with urllib.request.urlopen(
-                        f"{base}/{i}", timeout=max(0.1, deadline - time.monotonic())
-                    ) as resp:
-                        return _serialization.load_state_dict(resp)
-                except urllib.error.HTTPError as e:
-                    if e.code != 404 or time.monotonic() + delay >= deadline:
-                        raise
-                time.sleep(delay)
-                delay = min(delay * 1.5, 1.0)
+            return _fetch_retry_404(
+                f"{base}/{i}",
+                timeout,
+                consume=_serialization.load_state_dict,
+                retry_window=retry_window,
+            )
 
         if num_chunks == 1:
             chunks = [fetch_chunk(0)]
@@ -207,31 +203,69 @@ class HTTPTransport(CheckpointTransport[Any]):
             self._thread.join(timeout=5)
 
 
-def _fetch(url: str, timeout: float) -> bytes:
-    with urllib.request.urlopen(url, timeout=timeout) as resp:
-        return resp.read()
+class _RetryWindow:
+    """Bounds the WALL-CLOCK time one recv_checkpoint spends waiting on
+    404s, shared across the meta and all chunk fetches (so a multi-chunk
+    recv can't spend (1 + num_chunks) x timeout just waiting). The window
+    opens at the FIRST 404 — transfer time on a slow link never drains it —
+    and parallel waiters cost it once (wall clock), not N times. Each fetch
+    additionally keeps a small guaranteed floor from its own first 404 so a
+    late-pool chunk hitting the donor's commit->disallow->reopen race still
+    gets retries even after earlier fetches spent the shared window."""
+
+    FLOOR_S = 5.0
+
+    def __init__(self, seconds: float) -> None:
+        self._seconds = seconds
+        self._lock = threading.Lock()
+        self._deadline: Optional[float] = None
+
+    def allows(self, wake_time: float, fetch_floor_deadline: float) -> bool:
+        """True if a retry sleeping until ``wake_time`` may proceed."""
+        with self._lock:
+            if self._deadline is None:
+                self._deadline = time.monotonic() + self._seconds
+            return wake_time < max(self._deadline, fetch_floor_deadline)
 
 
-def _fetch_retry_404(url: str, timeout: float) -> bytes:
-    """Fetch with bounded retry on 404.
+def _fetch_retry_404(
+    url: str,
+    timeout: float,
+    consume: Optional[Callable[[Any], Any]] = None,
+    retry_window: Optional[_RetryWindow] = None,
+) -> Any:
+    """Fetch with bounded retry on 404; ``consume`` (default: read all
+    bytes) processes the open response, letting chunk fetches stream-decode
+    off the socket through the same retry loop as the meta fetch.
 
     A 404 from the donor means "nothing staged for this step" — which is
     often *not yet*: the joiner's fetch races the donor staging inside its
     own quorum round, and under a loaded host (many GIL-scheduled ranks)
     the donor's serve window can even close (commit → disallow) and REOPEN
     on the retry round before a slow fetcher gets through. Retrying within
-    the caller's timeout turns both races into a wait; a real
-    wrong-step/never-staged fetch still fails when the window expires.
-    The chunk fetches carry the same retry (fetch_chunk above): the server
-    re-resolves the staged object per GET, so nothing pins it between the
-    meta and chunk requests."""
-    deadline = time.monotonic() + timeout
+    the budget turns both races into a wait; a real wrong-step/never-staged
+    fetch still fails when the budget is spent.
+
+    ``retry_window`` bounds only the retry WAITING (see _RetryWindow) —
+    one recv_checkpoint shares it across the meta and every chunk. The
+    socket timeout stays the caller's full ``timeout`` per attempt:
+    urllib's timeout is a per-recv inactivity bound, not a wall-time bound,
+    and shrinking it would strangle chunks whose turn in the fetch pool
+    comes late (queued behind max_workers)."""
+    if retry_window is None:
+        retry_window = _RetryWindow(timeout)
     delay = 0.05
+    first_404: Optional[float] = None
     while True:
         try:
-            return _fetch(url, max(0.1, deadline - time.monotonic()))
+            with urllib.request.urlopen(url, timeout=timeout) as resp:
+                return consume(resp) if consume is not None else resp.read()
         except urllib.error.HTTPError as e:
-            if e.code != 404 or time.monotonic() + delay >= deadline:
+            now = time.monotonic()
+            if first_404 is None:
+                first_404 = now
+            floor_deadline = first_404 + min(timeout, _RetryWindow.FLOOR_S)
+            if e.code != 404 or not retry_window.allows(now + delay, floor_deadline):
                 raise
         time.sleep(delay)
         delay = min(delay * 1.5, 1.0)

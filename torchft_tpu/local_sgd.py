@@ -78,9 +78,24 @@ def _restore_like(state: Any, template: Any, device: bool) -> Any:
         state, is_leaf=is_leaf
     ) != jax.tree_util.tree_structure(template):
         as_leaf = jnp.asarray if device else np.asarray
-        return jax.tree_util.tree_map(
-            lambda x: as_leaf(x) if hasattr(x, "shape") else x, state
-        )
+
+        def _fallback_leaf(x: Any) -> Any:
+            # A ShardedLeaf here means a multi-host donor capture arrived
+            # with a mismatched treedef: there is no template leaf to
+            # reassemble its shards against, and passing the dataclass
+            # through would only fail later inside jit with an opaque
+            # error. Fail now, with guidance.
+            if isinstance(x, ShardedLeaf):
+                raise ValueError(
+                    "healed state contains a multi-host ShardedLeaf but its "
+                    "tree structure does not match the local template; "
+                    "donor and joiner opt-state structures must match for "
+                    "multi-host heal (construct the joiner's optimizer "
+                    "state with the same optax chain before healing)"
+                )
+            return as_leaf(x) if hasattr(x, "shape") else x
+
+        return jax.tree_util.tree_map(_fallback_leaf, state, is_leaf=is_leaf)
     return jax.tree_util.tree_map(
         lambda x, like: _restore_leaf_like(x, like, device),
         state,

@@ -103,6 +103,60 @@ class ExceptionWithTraceback(Exception):
         self.stack_trace: str = tb
 
 
+class _TrackedCommitFuture:
+    """Proxy around should_commit_async's executor future that records
+    whether the caller ever observed its outcome, so start_quorum's drain
+    can tell "caller already handled the barrier result/exception" (skip)
+    from "caller never looked" (drain, propagating any stored exception)."""
+
+    def __init__(self, inner: concurrent.futures.Future) -> None:
+        self._inner = inner
+        self.consumed = False
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        # Only a DELIVERED outcome (value or the barrier's own exception)
+        # counts as consumption: a wait that merely timed out — or was cut
+        # short by KeyboardInterrupt/SystemExit — observed nothing, and
+        # checking done() after the fact would race a barrier completing
+        # just after the wait expires. Future re-raises the stored
+        # exception OBJECT itself, so identity against the stored exception
+        # tells a delivered outcome from an interrupted wait.
+        try:
+            value = self._inner.result(timeout)
+        except BaseException as e:
+            try:
+                delivered = (
+                    self._inner.done() and self._inner.exception(timeout=0) is e
+                )
+            except concurrent.futures.CancelledError:
+                delivered = False
+            if delivered:
+                self.consumed = True
+            raise
+        self.consumed = True
+        return value
+
+    def exception(self, timeout: Optional[float] = None) -> Optional[BaseException]:
+        # Future.exception RETURNS a stored exception and only raises
+        # TimeoutError/CancelledError for the wait itself, so any return
+        # means the outcome was delivered.
+        exc = self._inner.exception(timeout)
+        self.consumed = True
+        return exc
+
+    def done(self) -> bool:
+        return self._inner.done()
+
+    def running(self) -> bool:
+        return self._inner.running()
+
+    def cancelled(self) -> bool:
+        return self._inner.cancelled()
+
+    def add_done_callback(self, fn: Callable[[Any], None]) -> None:
+        self._inner.add_done_callback(lambda _inner: fn(self))
+
+
 class Manager:
     """Fault tolerance manager for one rank of one replica group.
 
@@ -195,6 +249,7 @@ class Manager:
         self._shutdown_hooks: List[Callable[[], None]] = []
         self._healing = False
         self._pending_state_dict: Optional[Dict[str, Any]] = None
+        self._pending_commit_future: Optional[_TrackedCommitFuture] = None
 
         # Quorum state.
         self._quorum_id = -1
@@ -542,6 +597,13 @@ class Manager:
         if self._quorum_future is not None:
             self._quorum_future.result()
 
+        # Enforce the should_commit_async ordering contract: the commit
+        # barrier reads (and may heal through) the per-step error/heal flags,
+        # so an unresolved commit future queued behind this quorum would vote
+        # with wiped flags and silently drop a pending heal. Drain it here so
+        # the misordering is impossible rather than merely documented.
+        self._drain_pending_commit("start_quorum")
+
         self._errored = None
         self._healing = False
 
@@ -558,6 +620,26 @@ class Manager:
                 # runs against recovered parameters.
                 self._apply_pending_state_dict()
                 self._healing = False
+
+    def _drain_pending_commit(self, caller: str) -> None:
+        """Resolves any should_commit_async future the caller never
+        observed, BEFORE the per-step error/heal flags are wiped (or a new
+        barrier queued behind it): an unresolved commit queued behind a new
+        quorum would vote with wiped flags and silently drop a pending
+        heal, and a stored barrier exception (e.g. the max_retries
+        RuntimeError, the supervisor-restart signal) must propagate rather
+        than be silently dropped. A future the caller already resolved and
+        handled is NOT replayed on a later, healthy step."""
+        pending_commit = self._pending_commit_future
+        self._pending_commit_future = None
+        if pending_commit is not None and not pending_commit.consumed:
+            if not pending_commit.done():
+                self._logger.warn(
+                    f"{caller} called with an unresolved should_commit_async "
+                    "future; draining it so the commit votes with its own "
+                    "step's error/heal flags instead of the wiped ones"
+                )
+            pending_commit.result()
 
     def wait_quorum(self) -> None:
         """Blocks until the quorum completes; the PG is healthy after."""
@@ -703,20 +785,27 @@ class Manager:
 
     def should_commit_async(
         self, timeout: Optional[float] = None
-    ) -> "concurrent.futures.Future":
+    ) -> "_TrackedCommitFuture":
         """:meth:`should_commit` dispatched on the manager's executor so the
         barrier RPC overlaps work the caller still has to do this step —
         e.g. dispatching the speculative optimizer update (optim.py) or the
         next batch's h2d. The reference's analogue is keeping commit cost
         off the step's critical path (manager.py:790-878 design note).
 
-        The caller MUST resolve the future before reading any state the
+        The caller SHOULD resolve the future before reading any state the
         barrier may heal (should_commit applies pending state dicts) and
-        before calling start_quorum: start_quorum resets the per-step
-        error/heal flags on the CALLER thread before submitting its quorum
-        task, so an unresolved commit queued behind it would vote with
-        wiped flags and silently drop a pending heal."""
-        return self._executor.submit(self.should_commit, timeout)
+        before calling start_quorum. The ordering is enforced:
+        ``start_quorum`` drains any still-unresolved commit future before
+        wiping the per-step error/heal flags, so a misordered caller blocks
+        (and sees the barrier's exception, if any) instead of silently
+        dropping a pending heal."""
+        # A second async barrier with the first still unobserved would
+        # silently drop the first's tracking (and any stored exception) on
+        # overwrite — drain it with the same semantics start_quorum uses.
+        self._drain_pending_commit("should_commit_async")
+        future = _TrackedCommitFuture(self._executor.submit(self.should_commit, timeout))
+        self._pending_commit_future = future
+        return future
 
     def should_commit(self, timeout: Optional[float] = None) -> bool:
         """All-local-rank commit barrier (reference: manager.py:790-878).

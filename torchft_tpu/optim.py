@@ -26,12 +26,15 @@ re-read any registered state *after* it returns.
 
 from __future__ import annotations
 
+import logging
 from typing import Any, Optional
 
 import jax
 import numpy as np
 
 from torchft_tpu.manager import Manager
+
+logger = logging.getLogger(__name__)
 
 __all__ = [
     "Optimizer",
@@ -314,9 +317,23 @@ class Optimizer:
             # recovery from a step counter that advanced without its
             # update).
             try:
-                commit_future.result()
+                barrier_result = commit_future.result()
             except Exception:
-                pass
+                # Both causes matter to a supervisor diagnosing "step
+                # advanced without its update": keep the barrier's failure
+                # (e.g. should_commit's max_retries RuntimeError) visible
+                # alongside the dispatch failure we re-raise below.
+                logger.exception(
+                    "commit barrier also failed while handling an optimizer "
+                    "dispatch failure; barrier outcome lost to the re-raise"
+                )
+            else:
+                logger.error(
+                    "optimizer dispatch failed with the commit barrier in "
+                    "flight; barrier resolved committed=%s (a committed step "
+                    "here advanced the step counter without its update)",
+                    barrier_result,
+                )
             raise
         return self._commit_and_adopt(
             heal_count,
