@@ -1,6 +1,7 @@
 """Checkpoint transport + lock component tests (parity targets:
 http_transport_test.py, pg_transport_test.py, rwlock_test.py)."""
 
+import contextlib
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
@@ -134,53 +135,20 @@ def test_http_transport_wrong_step_404s() -> None:
         donor.shutdown()
 
 
-def test_retry_window_semantics() -> None:
-    """Pins _RetryWindow's contract: (a) the window opens at the FIRST 404
-    (transfer time never drains it); (b) parallel waiters cost wall clock
-    once — the same wake_time answers the same for every fetch; (c) a fetch
-    keeps its per-fetch floor even when the shared window is spent."""
-    from torchft_tpu.checkpointing.http_transport import _RetryWindow
-
-    # (a) Window not opened by construction time: sleeping (as a slow
-    # transfer would) before the first allows() call must not drain it.
-    w = _RetryWindow(0.2)
-    time.sleep(0.3)
-    now = time.monotonic()
-    assert w.allows(now + 0.05, fetch_floor_deadline=0.0)
-
-    # (b) Shared wall deadline: identical wake_times get identical answers
-    # regardless of how many fetches ask (no additive draining).
-    far_wake = now + 10.0
-    assert not w.allows(far_wake, fetch_floor_deadline=0.0)
-    assert not w.allows(far_wake, fetch_floor_deadline=0.0)
-    near_wake = now + 0.05
-    assert w.allows(near_wake, fetch_floor_deadline=0.0)
-    assert w.allows(near_wake, fetch_floor_deadline=0.0)
-
-    # (c) A zero-width shared window still admits retries under the
-    # fetch's own floor (late-pool chunk after others spent the window).
-    w2 = _RetryWindow(0.0)
-    now = time.monotonic()
-    assert not w2.allows(now + 0.05, fetch_floor_deadline=0.0)
-    assert w2.allows(now + 0.05, fetch_floor_deadline=now + 5.0)
-
-
-def test_fetch_retry_404_retries_until_staged() -> None:
-    """_fetch_retry_404 rides out 404s (donor hasn't staged yet / serve
-    window reopening) and returns the body once the server serves."""
+@contextlib.contextmanager
+def _http_404_server(n_404s: int, body: bytes = b"staged"):
+    """Local HTTP server that 404s the first ``n_404s`` GETs (all of them
+    if negative) then serves ``body``; yields (url, hits)."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-
-    from torchft_tpu.checkpointing.http_transport import _fetch_retry_404
 
     hits = []
 
     class Handler(BaseHTTPRequestHandler):
         def do_GET(self):
             hits.append(1)
-            if len(hits) <= 2:
+            if n_404s < 0 or len(hits) <= n_404s:
                 self.send_error(404)
                 return
-            body = b"staged"
             self.send_response(200)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
@@ -190,15 +158,77 @@ def test_fetch_retry_404_retries_until_staged() -> None:
             pass
 
     server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
-    t = threading.Thread(target=server.serve_forever, daemon=True)
-    t.start()
+    threading.Thread(target=server.serve_forever, daemon=True).start()
     try:
-        url = f"http://127.0.0.1:{server.server_address[1]}/x"
-        assert _fetch_retry_404(url, timeout=5.0) == b"staged"
-        assert len(hits) == 3  # two 404 rounds, then success
+        yield f"http://127.0.0.1:{server.server_address[1]}/x", hits
     finally:
         server.shutdown()
         server.server_close()
+
+
+def test_fetch_retry_404_bounded_when_never_staged() -> None:
+    """A never-staged fetch fails once its retry window (opened at the
+    first 404) expires — retries are bounded, not forever."""
+    import urllib.error
+
+    from torchft_tpu.checkpointing.http_transport import _fetch_retry_404
+
+    with _http_404_server(n_404s=-1) as (url, _):
+        t0 = time.monotonic()
+        with pytest.raises(urllib.error.HTTPError):
+            _fetch_retry_404(url, timeout=0.4)
+        assert time.monotonic() - t0 < 10  # bounded, generous GIL margin
+
+
+def test_fetch_retry_404_retries_until_staged() -> None:
+    """_fetch_retry_404 rides out 404s (donor hasn't staged yet / serve
+    window reopening) and returns the body once the server serves."""
+    from torchft_tpu.checkpointing.http_transport import _fetch_retry_404
+
+    with _http_404_server(n_404s=2) as (url, hits):
+        assert _fetch_retry_404(url, timeout=5.0) == b"staged"
+        assert len(hits) == 3  # two 404 rounds, then success
+
+
+def test_fetch_retry_404_window_opens_at_first_404(monkeypatch) -> None:
+    """Deterministic (virtual-clock) pin of the lazy window: the retry
+    deadline opens at the fetch's FIRST 404, not at the fetch's start, so
+    server/transfer time before and between 404s never drains the budget.
+    Each virtual request takes 1 s; with timeout=2 an EAGER window
+    (start + timeout = 2.0) would expire before the second 404's retry
+    decision at t=2.05, while the lazy window (first 404 at t=1 + timeout
+    = 3.0) spans it and reaches the staged response on request 3."""
+    import io
+    import types
+    import urllib.error
+
+    from torchft_tpu.checkpointing import http_transport as ht
+
+    clock = types.SimpleNamespace(t=0.0)
+    fake_time = types.SimpleNamespace(
+        monotonic=lambda: clock.t,
+        sleep=lambda s: setattr(clock, "t", clock.t + s),
+    )
+    calls = []
+
+    def fake_urlopen(url, timeout=None):
+        calls.append(clock.t)
+        clock.t += 1.0  # the virtual server takes 1 s per response
+        if len(calls) <= 2:
+            raise urllib.error.HTTPError(url, 404, "not staged", None, None)
+        return io.BytesIO(b"staged")
+
+    monkeypatch.setattr(ht, "time", fake_time)
+    monkeypatch.setattr(
+        ht,
+        "urllib",
+        types.SimpleNamespace(
+            request=types.SimpleNamespace(urlopen=fake_urlopen),
+            error=urllib.error,
+        ),
+    )
+    assert ht._fetch_retry_404("http://fake/x", timeout=2.0) == b"staged"
+    assert len(calls) == 3  # an eager window would have raised after call 2
 
 
 # -- PG transport -----------------------------------------------------------

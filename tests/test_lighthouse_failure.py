@@ -1,0 +1,397 @@
+"""Control-plane failure resilience: the manager must survive a flaky or
+dying lighthouse.
+
+Parity targets:
+- The reference's manager survives failed/refused quorum RPCs via its
+  ``quorum_retries`` loop with client re-creation per attempt
+  (reference: manager.rs:250-327), proven against a fault-injecting
+  MockLighthouse that errors N requests then recovers
+  (reference: manager.rs:1109-1217). Here the fault injector is a TCP
+  proxy that kills N connections in front of a real lighthouse — the
+  native manager re-creates its RpcClient per attempt
+  (native/src/manager.cc:126-143), so each dropped connection exercises
+  one retry.
+- The lighthouse is restartable on the same address mid-job: training
+  stalls bounded-ly and resumes with no lost commits and no survivor
+  divergence (the control-plane-SPOF story behind the reference's
+  standalone lighthouse binary, reference: src/bin/lighthouse.rs).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from tests.ft_harness import (
+    Runner,
+    _batch_for,
+    _grad_fn,
+    _init_model_params,
+)
+from torchft_tpu.coordination import LighthouseServer
+from torchft_tpu.ddp import ft_allreduce_gradients
+from torchft_tpu.manager import Manager
+from torchft_tpu.optim import Optimizer
+from torchft_tpu.parallel.process_group import (
+    FakeProcessGroupWrapper,
+    ProcessGroupDummy,
+    ProcessGroupTCP,
+)
+from torchft_tpu.parallel.store import StoreClient, StoreServer
+
+
+class FaultInjectingLighthouse:
+    """The reference MockLighthouse analogue (manager.rs:1109-1217) on this
+    repo's wire: a framed-protobuf TCP front that REFUSES the next N
+    LIGHTHOUSE_QUORUM requests with a proper error-status response and
+    forwards everything else to a real lighthouse. Because the refusal is
+    a valid response frame, the RpcClient's stale-connection redial never
+    triggers — each injected failure consumes exactly one attempt of the
+    native manager's quorum_retries loop (native/src/manager.cc:126-143),
+    deterministically."""
+
+    def __init__(self, target_addr: str) -> None:
+        from torchft_tpu import coordination as co
+
+        self._co = co
+        host, _, port = target_addr.rpartition(":")
+        self._target = (host.strip("[]") or "127.0.0.1", int(port))
+        self._fail_remaining = 0
+        self.failures_injected = 0
+        self._lock = threading.Lock()
+        self._stop = False
+        self._srv = socket.create_server(("127.0.0.1", 0))
+        self._srv.settimeout(0.2)
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._thread.start()
+
+    def address(self) -> str:
+        return f"127.0.0.1:{self._srv.getsockname()[1]}"
+
+    def fail_next(self, n: int) -> None:
+        with self._lock:
+            self._fail_remaining = n
+
+    def _accept_loop(self) -> None:
+        while not self._stop:
+            try:
+                conn, _ = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,), daemon=True).start()
+
+    @staticmethod
+    def _recv_exact(sock: socket.socket, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("peer closed")
+            buf += chunk
+        return buf
+
+    def _serve(self, conn: socket.socket) -> None:
+        import struct
+
+        co = self._co
+        conn.settimeout(30)
+        try:
+            while not self._stop:
+                header = self._recv_exact(conn, 6)
+                magic, method, length = struct.unpack("!BBI", header)
+                payload = self._recv_exact(conn, length) if length else b""
+                inject = False
+                if method == co.LIGHTHOUSE_QUORUM:
+                    with self._lock:
+                        if self._fail_remaining > 0:
+                            self._fail_remaining -= 1
+                            self.failures_injected += 1
+                            inject = True
+                if inject:
+                    body = b"injected lighthouse failure"
+                    conn.sendall(
+                        struct.pack("!BBI", co._RESP_MAGIC, co._STATUS_ERROR, len(body))
+                        + body
+                    )
+                    continue
+                # Forward verbatim to the real lighthouse, relay the reply.
+                with socket.create_connection(self._target, timeout=10) as up:
+                    up.sendall(header + payload)
+                    rh = self._recv_exact(up, 6)
+                    _, _, rlen = struct.unpack("!BBI", rh)
+                    rbody = self._recv_exact(up, rlen) if rlen else b""
+                conn.sendall(rh + rbody)
+        except (OSError, ConnectionError):
+            pass
+        finally:
+            conn.close()
+
+    def shutdown(self) -> None:
+        self._stop = True
+        self._thread.join(timeout=2)
+        self._srv.close()
+
+
+def _make_manager(lighthouse_addr: str, quorum_retries: int, store: StoreServer):
+    client = StoreClient(store.address(), prefix="g0")
+    state = {"w": np.zeros(2)}
+    return Manager(
+        pg=ProcessGroupDummy(0, 1),
+        min_replica_size=1,
+        store=client,
+        store_addr=store.address(),
+        load_state_dict=lambda sd: state.update(sd),
+        state_dict=lambda: dict(state),
+        replica_id="flaky_lh_test",
+        lighthouse_addr=lighthouse_addr,
+        group_rank=0,
+        group_world_size=1,
+        use_async_quorum=False,
+        # No heartbeats during the test window: every proxied connection
+        # drop must be consumed by a QUORUM attempt, deterministically.
+        heartbeat_interval=3600.0,
+        timeout=15.0,
+        quorum_timeout=20.0,
+        quorum_retries=quorum_retries,
+    )
+
+
+def test_quorum_retries_rides_out_dropped_lighthouse_rpcs() -> None:
+    """quorum_retries > 0: with N connections killed in front of the
+    lighthouse and retries > N, every step's quorum still forms and
+    commits — the MockLighthouse fault-injection contract
+    (reference: manager.rs:1109-1217)."""
+    lh = LighthouseServer(bind="127.0.0.1:0", min_replicas=1, join_timeout_ms=5000)
+    proxy = FaultInjectingLighthouse(lh.address())
+    store = StoreServer()
+    mgr = _make_manager(proxy.address(), quorum_retries=3, store=store)
+    try:
+        for step in range(3):
+            # Two of the (up to) four attempts are refused; the retry
+            # loop's fresh-client reconnect carries the round.
+            proxy.fail_next(2)
+            mgr.start_quorum()
+            assert mgr.should_commit() is True
+        assert mgr.current_step() == 3
+        assert proxy.failures_injected == 6  # each refusal ate one retry
+    finally:
+        mgr.shutdown()
+        proxy.shutdown()
+        lh.shutdown()
+
+
+def test_quorum_without_retries_fails_on_dropped_rpc() -> None:
+    """Control: quorum_retries=0 turns the same single dropped connection
+    into a quorum failure surfaced at the step boundary (supervisor
+    territory) — proving the resilience above is the retry loop, not
+    accident."""
+    lh = LighthouseServer(bind="127.0.0.1:0", min_replicas=1, join_timeout_ms=5000)
+    proxy = FaultInjectingLighthouse(lh.address())
+    store = StoreServer()
+    mgr = _make_manager(proxy.address(), quorum_retries=0, store=store)
+    try:
+        mgr.start_quorum()
+        assert mgr.should_commit() is True
+
+        proxy.fail_next(1)
+        with pytest.raises(RuntimeError, match="lighthouse quorum failed"):
+            mgr.start_quorum()
+        assert proxy.failures_injected == 1
+    finally:
+        mgr.shutdown()
+        proxy.shutdown()
+        lh.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Real lighthouse SIGKILL + same-address restart mid-training
+# ---------------------------------------------------------------------------
+
+
+def _spawn_lighthouse(port: int, min_replicas: int = 2) -> subprocess.Popen:
+    """Starts the real `python -m torchft_tpu.lighthouse` daemon and blocks
+    until it accepts TCP connections (observed readiness, not a sleep)."""
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "torchft_tpu.lighthouse",
+            "--bind",
+            f"127.0.0.1:{port}",
+            "--min-replicas",
+            str(min_replicas),
+            "--join-timeout-ms",
+            "3000",
+        ],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+        env={**os.environ, "TPUFT_LOG": "warn"},
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(f"lighthouse exited at startup: rc={proc.returncode}")
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=0.5):
+                return proc
+        except OSError:
+            time.sleep(0.1)
+    proc.kill()
+    raise TimeoutError("lighthouse did not start accepting connections")
+
+
+def _reporting_ddp_loop(
+    runner: Runner,
+    rank: int,
+    store_client: StoreClient,
+    store_addr: str,
+    progress: Dict[int, int],
+    hold: threading.Event,
+    hold_at_step: int,
+) -> Dict:
+    """ddp_train_loop sized down, publishing each committed step into the
+    shared ``progress`` map so the test can gate the lighthouse kill and
+    the resume check on OBSERVED training progress (CLAUDE.md: never on
+    sleeps). Parks at ``hold_at_step`` until the test releases ``hold`` —
+    the deterministic window in which the lighthouse is killed."""
+    pg = FakeProcessGroupWrapper(ProcessGroupTCP(timeout=10.0))
+    manager = Manager(
+        pg=pg,
+        min_replica_size=2,
+        store=store_client,
+        store_addr=store_addr,
+        use_async_quorum=False,
+        group_rank=rank,
+        group_world_size=runner.world_size,
+        lighthouse_addr=runner.lighthouse_addr,
+        replica_id=f"lhkill_{runner.replica_group}",
+        heartbeat_interval=0.5,
+        timeout=15.0,
+        quorum_timeout=60.0,
+        **runner.manager_args,
+    )
+    opt = Optimizer(manager, optax.sgd(0.05), _init_model_params())
+    history = {}
+    try:
+        while manager.current_step() < runner.num_steps:
+            step = manager.current_step()
+            if step == hold_at_step:
+                assert hold.wait(timeout=180), "test never released the hold"
+            opt.begin_step()
+            manager.wait_quorum()
+            x, y = _batch_for(step, runner.replica_group)
+            grads = _grad_fn(opt.params, x, y)
+            avg = ft_allreduce_gradients(manager, grads)
+            if opt.step(avg):
+                history[manager.current_step()] = jax.tree_util.tree_map(
+                    np.asarray, opt.params
+                )
+                progress[runner.replica_group] = manager.current_step()
+        return {"history": history}
+    finally:
+        manager.shutdown(wait=False)
+        pg.shutdown()
+
+
+def test_lighthouse_sigkill_restart_mid_training() -> None:
+    """SIGKILL the real lighthouse daemon mid-training and restart it on
+    the same address: training stalls bounded-ly (the managers'
+    quorum_retries loop keeps re-dialing), then resumes with no lost
+    commits and bitwise-identical replica states."""
+    with socket.create_server(("127.0.0.1", 0)) as s:
+        port = s.getsockname()[1]
+    proc = _spawn_lighthouse(port)
+    addr = f"127.0.0.1:{port}"
+    progress: Dict[int, int] = {0: 0, 1: 0}
+    num_steps = 6
+    hold_at_step = 3
+    hold = threading.Event()
+    runners = [
+        Runner(
+            replica_group=g,
+            lighthouse_addr=addr,
+            train_loop=_reporting_ddp_loop,
+            num_steps=num_steps,
+            use_async_quorum=False,
+            # Enough fast-failing (connection-refused) attempts to bridge
+            # the lighthouse's restart: ~10 attempts/s (100 ms inter-try
+            # sleep), restart observed-ready in ~3-5 s on this box.
+            manager_args={"quorum_retries": 150},
+            train_loop_args={
+                "progress": progress,
+                "hold": hold,
+                "hold_at_step": hold_at_step,
+            },
+        )
+        for g in range(2)
+    ]
+
+    def _check_alive(futs) -> None:
+        for f in futs:
+            if f.done() and f.exception() is not None:
+                raise f.exception()
+
+    try:
+        with ThreadPoolExecutor(max_workers=2, thread_name_prefix="lhkill") as pool:
+            futs = [pool.submit(r.run_replica) for r in runners]
+
+            # Both groups commit up to the hold point, then park.
+            deadline = time.monotonic() + 120
+            while min(progress.values()) < hold_at_step:
+                _check_alive(futs)
+                assert time.monotonic() < deadline, f"no progress: {progress}"
+                time.sleep(0.1)
+
+            os.kill(proc.pid, signal.SIGKILL)
+            proc.wait(timeout=10)  # observed death
+            t_kill = time.monotonic()
+            floor = dict(progress)
+
+            # Release the replicas INTO the outage: their quorum_retries
+            # loop hammers the dead address while the daemon restarts on
+            # the same port.
+            hold.set()
+            proc = _spawn_lighthouse(port)  # observed restart (TCP accept)
+
+            # Bounded stall: both groups commit a NEW step within the bound.
+            resume_deadline = time.monotonic() + 150
+            while not all(progress[g] > floor[g] for g in progress):
+                _check_alive(futs)
+                assert (
+                    time.monotonic() < resume_deadline
+                ), f"stall not bounded: {progress} vs {floor}"
+                time.sleep(0.1)
+            stall_s = time.monotonic() - t_kill
+
+            results = [f.result(timeout=180) for f in futs]
+    finally:
+        proc.kill()
+
+    h0 = results[0][0]["history"]
+    h1 = results[1][0]["history"]
+    # No lost commits: the step counter only advances on commit, so both
+    # groups must hold every step 1..num_steps exactly once.
+    assert sorted(h0) == list(range(1, num_steps + 1)), sorted(h0)
+    assert sorted(h1) == list(range(1, num_steps + 1)), sorted(h1)
+    # No survivor divergence: bitwise-identical params at every step.
+    for step in h0:
+        for (k, a), (_, b) in zip(
+            sorted(h0[step].items()), sorted(h1[step].items())
+        ):
+            assert np.array_equal(a, b), f"divergence at step {step} key {k}"
+    print(f"lighthouse kill->resume stall: {stall_s:.1f}s")

@@ -164,12 +164,7 @@ class HTTPTransport(CheckpointTransport[Any]):
         self, src_rank: int, metadata: str, step: int, timeout: float
     ) -> Any:
         base = f"{metadata}/checkpoint/{step}"
-        # One shared window caps the 404-RETRY WAITING across the meta and
-        # every chunk (see _RetryWindow for the exact semantics).
-        retry_window = _RetryWindow(timeout)
-        num_chunks, treedef = safe_loads(
-            _fetch_retry_404(f"{base}/meta", timeout, retry_window=retry_window)
-        )
+        num_chunks, treedef = safe_loads(_fetch_retry_404(f"{base}/meta", timeout))
 
         def fetch_chunk(i: int) -> Any:
             # Stream-decode straight off the socket into final buffers: peak
@@ -179,17 +174,23 @@ class HTTPTransport(CheckpointTransport[Any]):
             # — nothing pins the staged object across GETs — and reopen on
             # its retry round.
             return _fetch_retry_404(
-                f"{base}/{i}",
-                timeout,
-                consume=_serialization.load_state_dict,
-                retry_window=retry_window,
+                f"{base}/{i}", timeout, consume=_serialization.load_state_dict
             )
 
         if num_chunks == 1:
             chunks = [fetch_chunk(0)]
         else:
             with ThreadPoolExecutor(max_workers=min(num_chunks, 8)) as pool:
-                chunks = list(pool.map(fetch_chunk, range(num_chunks)))
+                futs = [pool.submit(fetch_chunk, i) for i in range(num_chunks)]
+                try:
+                    chunks = [f.result() for f in futs]
+                except BaseException:
+                    # Fail fast: without this, the pool's __exit__ would run
+                    # every QUEUED fetch to completion — each burning its
+                    # own full retry window against a donor that may be
+                    # gone — before the error reaches the manager.
+                    pool.shutdown(wait=False, cancel_futures=True)
+                    raise
         merged: Dict[int, Any] = {}
         for chunk in chunks:
             merged.update(chunk)
@@ -203,36 +204,10 @@ class HTTPTransport(CheckpointTransport[Any]):
             self._thread.join(timeout=5)
 
 
-class _RetryWindow:
-    """Bounds the WALL-CLOCK time one recv_checkpoint spends waiting on
-    404s, shared across the meta and all chunk fetches (so a multi-chunk
-    recv can't spend (1 + num_chunks) x timeout just waiting). The window
-    opens at the FIRST 404 — transfer time on a slow link never drains it —
-    and parallel waiters cost it once (wall clock), not N times. Each fetch
-    additionally keeps a small guaranteed floor from its own first 404 so a
-    late-pool chunk hitting the donor's commit->disallow->reopen race still
-    gets retries even after earlier fetches spent the shared window."""
-
-    FLOOR_S = 5.0
-
-    def __init__(self, seconds: float) -> None:
-        self._seconds = seconds
-        self._lock = threading.Lock()
-        self._deadline: Optional[float] = None
-
-    def allows(self, wake_time: float, fetch_floor_deadline: float) -> bool:
-        """True if a retry sleeping until ``wake_time`` may proceed."""
-        with self._lock:
-            if self._deadline is None:
-                self._deadline = time.monotonic() + self._seconds
-            return wake_time < max(self._deadline, fetch_floor_deadline)
-
-
 def _fetch_retry_404(
     url: str,
     timeout: float,
     consume: Optional[Callable[[Any], Any]] = None,
-    retry_window: Optional[_RetryWindow] = None,
 ) -> Any:
     """Fetch with bounded retry on 404; ``consume`` (default: read all
     bytes) processes the open response, letting chunk fetches stream-decode
@@ -242,30 +217,32 @@ def _fetch_retry_404(
     often *not yet*: the joiner's fetch races the donor staging inside its
     own quorum round, and under a loaded host (many GIL-scheduled ranks)
     the donor's serve window can even close (commit → disallow) and REOPEN
-    on the retry round before a slow fetcher gets through. Retrying within
-    the budget turns both races into a wait; a real wrong-step/never-staged
-    fetch still fails when the budget is spent.
+    on the retry round — up to a training step later — before a slow
+    fetcher gets through. Retrying turns both races into a wait; a real
+    wrong-step/never-staged fetch still fails when the window expires.
 
-    ``retry_window`` bounds only the retry WAITING (see _RetryWindow) —
-    one recv_checkpoint shares it across the meta and every chunk. The
-    socket timeout stays the caller's full ``timeout`` per attempt:
-    urllib's timeout is a per-recv inactivity bound, not a wall-time bound,
-    and shrinking it would strangle chunks whose turn in the fetch pool
-    comes late (queued behind max_workers)."""
-    if retry_window is None:
-        retry_window = _RetryWindow(timeout)
+    The retry window is PER FETCH and opens at this fetch's FIRST 404, so
+    time spent actually transferring bytes (legitimate on a slow link)
+    never charges anyone's retry budget, and a chunk whose turn in the
+    fetch pool comes late gets a full window against the reopen race —
+    leftovers of a window shared with the meta fetch could not span the
+    donor's reopen interval. The resulting worst-case retry waiting for a
+    whole recv_checkpoint is (1 + ceil(num_chunks / pool_width)) x
+    timeout — bounded by pool waves, not by chunk count, since in-pool
+    chunks wait out the same wall-clock window concurrently. The socket
+    timeout stays ``timeout`` per attempt (urllib's timeout is a per-recv
+    inactivity bound, not a wall-time bound)."""
     delay = 0.05
-    first_404: Optional[float] = None
+    retry_deadline: Optional[float] = None
     while True:
         try:
             with urllib.request.urlopen(url, timeout=timeout) as resp:
                 return consume(resp) if consume is not None else resp.read()
         except urllib.error.HTTPError as e:
             now = time.monotonic()
-            if first_404 is None:
-                first_404 = now
-            floor_deadline = first_404 + min(timeout, _RetryWindow.FLOOR_S)
-            if e.code != 404 or not retry_window.allows(now + delay, floor_deadline):
+            if retry_deadline is None:
+                retry_deadline = now + timeout
+            if e.code != 404 or now + delay >= retry_deadline:
                 raise
         time.sleep(delay)
         delay = min(delay * 1.5, 1.0)
