@@ -1,0 +1,348 @@
+#!/usr/bin/env python
+"""Latency-tolerance curve under an emulated DCN link.
+
+Every cross-group number this box can produce natively is loopback, which
+says nothing about the design claims that motivate streaming DiLoCo and
+the int4 wire (the reference's DiLoCo pitch, reference local_sgd.py:
+176-568 design comments): hiding outer-sync latency and halving bytes
+only matter under non-zero RTT and bounded bandwidth. This bench injects
+both via torchft_tpu.utils.netem (ProcessGroupTCP sends + HTTP heal
+serves) and sweeps RTT for:
+
+  1. FT-DDP per-step sync        — degrades with RTT (pays it every step)
+  2. Streaming DiLoCo per-step   — holds ~flat (sync amortized/overlapped)
+  3. Outer sync fp8 vs int4      — int4 ~2x faster at bounded bandwidth
+  4. Heal transfer               — linear in RTT + bytes/bandwidth
+
+Writes EMULATED_DCN_BENCH.json. Usage:
+
+    python benchmarks/emulated_dcn_bench.py [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from pathlib import Path
+from typing import Any, Dict, List
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+os.environ.setdefault("TPUFT_LOG", "warn")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import optax
+
+from torchft_tpu.coordination import LighthouseServer
+from torchft_tpu.ddp import ft_allreduce_gradients
+from torchft_tpu.manager import Manager
+from torchft_tpu.optim import Optimizer
+from torchft_tpu.parallel import collectives
+from torchft_tpu.parallel.process_group import ProcessGroupTCP, ReduceOp
+from torchft_tpu.parallel.store import StoreClient, StoreServer
+from torchft_tpu.utils import netem
+
+RTTS_MS = [0.0, 1.0, 10.0, 50.0]
+GBPS = 1.0
+OUTER_MB = 8  # f32 megabytes averaged per outer sync in the micro-bench
+HEAL_MB = 8
+
+# A model big enough that an inner step is real compute (~20-40 ms on this
+# box): latency hiding is the whole design claim, and there is nothing to
+# hide a sync behind when an inner step costs 1 ms. ~790 KB of f32 params.
+_DIM = 512
+_BATCH = 32
+
+import jax.numpy as jnp
+
+
+def _bench_params() -> Any:
+    k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+    return {
+        "w1": jax.random.normal(k1, (_DIM, _DIM), dtype=jnp.float32) * 0.05,
+        "b1": jnp.zeros((_DIM,), dtype=jnp.float32),
+        "w2": jax.random.normal(k2, (_DIM, _DIM), dtype=jnp.float32) * 0.05,
+        "b2": jnp.zeros((_DIM,), dtype=jnp.float32),
+    }
+
+
+@jax.jit
+def _bench_loss(params: Any, x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    h = jnp.tanh(x @ params["w1"] + params["b1"])
+    return jnp.mean((h @ params["w2"] + params["b2"] - y) ** 2)
+
+
+_bench_grad = jax.jit(jax.grad(_bench_loss))
+
+
+def _bench_batch(step: int, group: int) -> Any:
+    kx, ky = jax.random.split(jax.random.PRNGKey(1000 * group + step))
+    return (
+        jax.random.normal(kx, (_BATCH, _DIM), dtype=jnp.float32),
+        jax.random.normal(ky, (_BATCH, _DIM), dtype=jnp.float32),
+    )
+
+
+def _make_manager(group: int, lh_addr: str, store: StoreServer, **kw: Any) -> Manager:
+    client = StoreClient(store.address(), prefix=f"g{group}")
+    return Manager(
+        pg=ProcessGroupTCP(timeout=30.0),
+        min_replica_size=2,
+        store=client,
+        store_addr=store.address() + f"/g{group}",
+        use_async_quorum=False,
+        group_rank=0,
+        group_world_size=1,
+        lighthouse_addr=lh_addr,
+        replica_id=f"dcnbench_{group}",
+        heartbeat_interval=0.5,
+        timeout=30.0,
+        quorum_timeout=60.0,
+        **kw,
+    )
+
+
+def bench_ft_ddp(lh_addr: str, num_steps: int) -> float:
+    """Mean committed-step wall time (s) for 2-group FT-DDP; every step
+    pays the cross-group allreduce on the emulated link."""
+    step_walls: Dict[int, List[float]] = {0: [], 1: []}
+
+    def replica(group: int) -> None:
+        store = StoreServer()
+        manager = _make_manager(group, lh_addr, store)
+        opt = Optimizer(manager, optax.sgd(0.05), _bench_params())
+        try:
+            while manager.current_step() < num_steps:
+                step = manager.current_step()
+                t0 = time.perf_counter()
+                opt.begin_step()
+                manager.wait_quorum()
+                x, y = _bench_batch(step, group)
+                grads = _bench_grad(opt.params, x, y)
+                avg = ft_allreduce_gradients(manager, grads)
+                if opt.step(avg):
+                    step_walls[group].append(time.perf_counter() - t0)
+        finally:
+            manager.shutdown(wait=False)
+            store.shutdown()
+
+    with ThreadPoolExecutor(max_workers=2) as pool:
+        futs = [pool.submit(replica, g) for g in range(2)]
+        for f in futs:
+            f.result(timeout=600)
+    # Mean over both groups, skipping each group's first two steps (jit
+    # compile + PG rendezvous).
+    walls = step_walls[0][2:] + step_walls[1][2:]
+    return float(np.mean(walls))
+
+
+def bench_diloco(lh_addr: str, num_outer: int, sync_every: int) -> Dict[str, float]:
+    """Streaming DiLoCo (2 fragments, quantized wire): mean per-inner-step
+    wall including sync steps (the amortized cost a user sees)."""
+    from torchft_tpu.local_sgd import DiLoCo
+
+    per_step: Dict[int, List[float]] = {0: [], 1: []}
+
+    def replica(group: int) -> None:
+        store = StoreServer()
+        manager = _make_manager(group, lh_addr, store)
+        try:
+            algo = DiLoCo(
+                manager,
+                inner_tx=optax.sgd(0.05),
+                outer_tx=optax.sgd(0.7, momentum=0.9, nesterov=True),
+                params=_bench_params(),
+                sync_every=sync_every,
+                n_fragments=2,
+                fragment_sync_delay=4,
+                should_quantize=True,
+            )
+            inner_iter = 0
+            while manager.current_step() < num_outer:
+                t0 = time.perf_counter()
+                x, y = _bench_batch(1000 + inner_iter, group)
+                grads = _bench_grad(algo.params, x, y)
+                algo.step(grads)
+                per_step[group].append(time.perf_counter() - t0)
+                inner_iter += 1
+        finally:
+            manager.shutdown(wait=False)
+            store.shutdown()
+
+    with ThreadPoolExecutor(max_workers=2) as pool:
+        futs = [pool.submit(replica, g) for g in range(2)]
+        for f in futs:
+            f.result(timeout=600)
+    # Each fragment's first sync pays one-time jit compiles (~1 s on this
+    # box, measured); the first sync_every inner steps cover both
+    # fragments' first syncs. Mean AFTER that warmup so the amortized
+    # outer-sync cost stays in the number (a median would hide it).
+    walls = per_step[0][sync_every:] + per_step[1][sync_every:]
+    return {"per_step_s": float(np.mean(walls)), "p_max_s": float(np.max(walls))}
+
+
+def bench_outer_sync(wire_dtype: str) -> Dict[str, float]:
+    """Wall time of one outer-sync exchange of an ALREADY-quantized
+    OUTER_MB-of-f32 pseudogradient (the streaming-DiLoCo hot path:
+    quantization runs on device inside the jitted sync step, so the wire
+    exchange is what the link sees) between 2 ranks over the emulated
+    link. Also reports the wire bytes per rank."""
+    from torchft_tpu.ops import quantization as q
+
+    n = OUTER_MB * 1024 * 1024 // 4
+    store = StoreServer()
+    results: Dict[int, float] = {}
+    wire_bytes: Dict[int, int] = {}
+
+    def rank(r: int) -> None:
+        pg = ProcessGroupTCP(timeout=60.0)
+        pg.configure(store.address() + "/outer", f"rank{r}", r, 2)
+        arr = np.full(n, float(r + 1), dtype=np.float32)
+        payload, scales = q.quantize_blocks(arr, wire=wire_dtype)
+        wire_bytes[r] = payload.nbytes + scales.nbytes
+        try:
+            # Warmup (rendezvous + first-message costs), then timed run.
+            collectives.allreduce_quantized_wire(
+                payload, scales, ReduceOp.AVG, pg
+            ).wait()
+            t0 = time.perf_counter()
+            collectives.allreduce_quantized_wire(
+                payload, scales, ReduceOp.AVG, pg
+            ).wait()
+            results[r] = time.perf_counter() - t0
+        finally:
+            pg.shutdown()
+
+    with ThreadPoolExecutor(max_workers=2) as pool:
+        futs = [pool.submit(rank, r) for r in range(2)]
+        for f in futs:
+            f.result(timeout=600)
+    store.shutdown()
+    return {"wall_s": float(max(results.values())), "wire_mb": wire_bytes[0] / 1e6}
+
+
+def bench_heal() -> float:
+    """Wall time to receive a HEAL_MB checkpoint over the emulated link."""
+    from torchft_tpu.checkpointing import HTTPTransport
+
+    state = {"w": np.ones(HEAL_MB * 1024 * 1024 // 4, dtype=np.float32)}
+    donor = HTTPTransport(num_chunks=4)
+    joiner = HTTPTransport()
+    try:
+        donor.send_checkpoint([1], step=1, state_dict=state, timeout=60)
+        t0 = time.perf_counter()
+        restored = joiner.recv_checkpoint(0, donor.metadata(), step=1, timeout=60)
+        dt = time.perf_counter() - t0
+        assert np.array_equal(restored["w"], state["w"])
+        return dt
+    finally:
+        donor.shutdown()
+        joiner.shutdown()
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--quick", action="store_true", help="fewer steps")
+    args = parser.parse_args()
+    num_steps = 6 if args.quick else 10
+    num_outer = 4 if args.quick else 6
+    # 2 fragments x (sync every 8 inner steps) with a 4-step overlap
+    # window (~60 ms of inner compute) — the streaming schedule whose
+    # point is hiding the sync's wire time behind inner steps.
+    sync_every = 16
+
+    # (rtt_ms, gbps): the RTT sweep at DCN-class bandwidth, plus one
+    # bandwidth-CONSTRAINED point where the int4 wire's halved bytes
+    # dominate the outer-sync wall (inter-region links are often
+    # ~0.1 Gbps per flow).
+    points = [(rtt, GBPS) for rtt in RTTS_MS] + [(50.0, 0.1)]
+    sweep = []
+    for rtt, gbps in points:
+        netem.configure(rtt, gbps)
+        lh = LighthouseServer(
+            bind="127.0.0.1:0", min_replicas=2, join_timeout_ms=10000
+        )
+        try:
+            ddp_s = bench_ft_ddp(lh.address(), num_steps)
+            diloco = bench_diloco(lh.address(), num_outer=num_outer, sync_every=sync_every)
+        finally:
+            lh.shutdown()
+        outer = {}
+        for wire in ("fp8", "int4"):
+            outer[wire] = bench_outer_sync(wire)
+        heal_s = bench_heal()
+        row = {
+            "rtt_ms": rtt,
+            "gbps": gbps,
+            "ddp_step_s": round(ddp_s, 4),
+            "diloco_step_s": round(diloco["per_step_s"], 4),
+            "diloco_step_max_s": round(diloco["p_max_s"], 4),
+            "outer_sync_s": {k: round(v["wall_s"], 4) for k, v in outer.items()},
+            "outer_wire_mb": {k: round(v["wire_mb"], 3) for k, v in outer.items()},
+            "heal_s": round(heal_s, 4),
+        }
+        sweep.append(row)
+        print(json.dumps(row), flush=True)
+        netem.configure(0, 0)
+
+    # Select rows by predicate, not position — editing `points` above must
+    # not silently re-aim the headline claims.
+    full_bw = [r for r in sweep if r["gbps"] == GBPS]
+    base = min(full_bw, key=lambda r: r["rtt_ms"])
+    worst = max(full_bw, key=lambda r: r["rtt_ms"])
+    constrained = min(sweep, key=lambda r: r["gbps"])
+    ddp_infl = worst["ddp_step_s"] - base["ddp_step_s"]
+    diloco_infl = worst["diloco_step_s"] - base["diloco_step_s"]
+    claims = {
+        # Absolute per-step inflation at the worst RTT (the honest
+        # comparison: the two loops have different RTT=0 baselines).
+        "ddp_step_inflation_ms_at_worst_rtt": round(ddp_infl * 1000, 1),
+        "diloco_step_inflation_ms_at_worst_rtt": round(diloco_infl * 1000, 1),
+        "diloco_hides_fraction_of_ddp_inflation": round(
+            1.0 - diloco_infl / ddp_infl, 3
+        ) if ddp_infl > 0 else None,
+        "ddp_slowdown_at_worst_rtt": round(worst["ddp_step_s"] / base["ddp_step_s"], 3),
+        "diloco_slowdown_at_worst_rtt": round(
+            worst["diloco_step_s"] / base["diloco_step_s"], 3
+        ),
+        "int4_outer_speedup_vs_fp8_at_worst_rtt": round(
+            worst["outer_sync_s"]["fp8"] / worst["outer_sync_s"]["int4"], 3
+        ),
+        "int4_outer_speedup_vs_fp8_constrained_bw": round(
+            constrained["outer_sync_s"]["fp8"] / constrained["outer_sync_s"]["int4"], 3
+        ),
+        "int4_wire_bytes_vs_fp8": round(
+            worst["outer_wire_mb"]["int4"] / worst["outer_wire_mb"]["fp8"], 3
+        ),
+        "sync_every": sync_every,
+        "n_fragments": 2,
+        "fragment_sync_delay": 4,
+        "outer_payload_mb": OUTER_MB,
+        "heal_payload_mb": HEAL_MB,
+    }
+    result = {
+        "bench": "emulated_dcn",
+        "device_kind": "cpu",
+        "emulation": "netem shim at ProcessGroupTCP/HTTP wire choke points "
+        "(per-flow: RTT/2 per message + bytes/bandwidth)",
+        "sweep": sweep,
+        "claims": claims,
+    }
+    out = REPO / "EMULATED_DCN_BENCH.json"
+    out.write_text(json.dumps(result, indent=2) + "\n")
+    print(json.dumps({"claims": claims}), flush=True)
+    print(f"wrote {out}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -719,3 +719,29 @@ def test_doctor_checks_pass_and_catch_problems(monkeypatch, capsys) -> None:
         used |= set(re.findall(r"TPUFT_[A-Z_0-9]+", (repo / top).read_text()))
     missing = used - doctor.KNOWN_ENV - {"TPUFT_", "TPUFT_DEFINITELY_A_TYPO"}
     assert not missing, f"doctor.KNOWN_ENV missing: {sorted(missing)}"
+
+
+def test_netem_shim_pacing() -> None:
+    """The emulated-DCN shim: disabled by default (zero-cost no-op), and
+    when configured injects RTT/2 + bytes/bandwidth per message."""
+    import time as _time
+
+    from torchft_tpu.utils import netem
+
+    try:
+        netem.configure(0, 0)
+        assert not netem.enabled()
+        netem.pace(10_000_000)  # no-op when disabled (no timing assert:
+        # wall-clock upper bounds flake on this 1-core box)
+
+        # 20 ms RTT -> 10 ms one-way; 0.008 Gbps = 1e6 B/s -> 100 ms for
+        # 100 KB. Lower bound is exact (sleep never undershoots); upper
+        # bound generous for the GIL-loaded box.
+        netem.configure(rtt_ms=20, gbps=0.008)
+        assert netem.enabled()
+        t0 = _time.perf_counter()
+        netem.pace(100_000)
+        dt = _time.perf_counter() - t0
+        assert 0.11 <= dt < 2.0, dt
+    finally:
+        netem.configure(0, 0)

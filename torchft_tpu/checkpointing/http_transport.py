@@ -26,6 +26,7 @@ from typing import Any, Callable, Dict, List, Optional
 import jax
 
 from torchft_tpu._safe_pickle import safe_loads
+from torchft_tpu.utils import netem
 from torchft_tpu.checkpointing import _serialization
 from torchft_tpu.checkpointing.transport import CheckpointTransport
 
@@ -103,9 +104,13 @@ class HTTPTransport(CheckpointTransport[Any]):
                     self.send_header("Content-Type", "application/octet-stream")
                     self.send_header("Content-Length", str(total))
                     self.end_headers()
+                    out = self.wfile
+                    if netem.enabled():  # emulated-DCN heal path
+                        netem.pace_latency()
+                        out = netem.PacingWriter(out)
                     for chunk in staged.chunks:
-                        self.wfile.write(chunk.total_size.to_bytes(8, "big"))
-                        _serialization.write_prepared(chunk, self.wfile)
+                        out.write(chunk.total_size.to_bytes(8, "big"))
+                        _serialization.write_prepared(chunk, out)
                 else:
                     try:
                         chunk = staged.chunks[int(parts[2])]
@@ -116,8 +121,15 @@ class HTTPTransport(CheckpointTransport[Any]):
                     self.send_header("Content-Type", "application/octet-stream")
                     self.send_header("Content-Length", str(chunk.total_size))
                     self.end_headers()
+                    out = self.wfile
+                    if netem.enabled():  # emulated-DCN heal path
+                        netem.pace_latency()
+                        # Serialization time interleaves with the writes —
+                        # one up-front sleep would hold the wire silent
+                        # past the joiner's per-recv inactivity timeout.
+                        out = netem.PacingWriter(out)
                     # Streams directly from the staged host arrays.
-                    _serialization.write_prepared(chunk, self.wfile)
+                    _serialization.write_prepared(chunk, out)
                 transport._served_event.set()
 
         class DualStackServer(ThreadingHTTPServer):

@@ -37,6 +37,7 @@ KNOWN_ENV = {
     "TPUFT_BENCH_TPU_DEADLINE", "TPUFT_BENCH_TPU_DEADLINE_LARGE",
     "TPUFT_BENCH_CPU_DEADLINE", "TPUFT_BENCH_CPU_FULL_DEADLINE",
     "TPUFT_BENCH_NO_PROBE",
+    "TPUFT_EMULATED_RTT_MS", "TPUFT_EMULATED_GBPS",
     # Repo tooling outside the package (tests/benchmarks/sentinel) — real
     # knobs a user may have exported; not typos.
     "TPUFT_SOAK_SECONDS", "TPUFT_REGEN_FIXTURES", "TPUFT_SENTINEL_INTERVAL",

@@ -40,6 +40,7 @@ from typing import Callable, Dict, List, Optional, Sequence, cast
 import numpy as np
 
 from torchft_tpu._safe_pickle import safe_loads
+from torchft_tpu.utils import netem
 
 from torchft_tpu.parallel.store import StoreClient, create_store_client
 from torchft_tpu.utils import flight_recorder as fr
@@ -178,6 +179,7 @@ _RING_MIN_BYTES = int(
 
 
 def _send_bytes(sock: socket.socket, payload: bytes, deadline: float) -> None:
+    netem.pace(len(payload))  # no-op unless an emulated-DCN link is set
     sock.settimeout(max(0.001, deadline - time.monotonic()))
     sock.sendall(_LEN_STRUCT.pack(len(payload)) + payload)
 

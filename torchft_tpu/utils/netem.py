@@ -1,0 +1,100 @@
+"""Emulated-DCN network shim for single-host benchmarking.
+
+This box (and any single-host CI) can only produce loopback numbers for
+cross-replica traffic, which says nothing about the design claims that
+motivate streaming DiLoCo and the int4 wire — hiding outer-sync latency
+and halving bytes only MATTER under non-zero RTT and bounded bandwidth
+(the reference's whole DiLoCo pitch, reference local_sgd.py:176-568
+design comments). This shim injects both at the Python wire choke points
+(ProcessGroupTCP sends, HTTP checkpoint chunk serves) so a loopback bench
+can sweep a latency-tolerance curve.
+
+Configuration, in precedence order:
+
+- :func:`configure` (what benches call per sweep point), or
+- env at first use: ``TPUFT_EMULATED_RTT_MS`` (per-message one-way delay
+  = RTT/2) and ``TPUFT_EMULATED_GBPS`` (serialization time =
+  bytes / bandwidth).
+
+Disabled (the default) costs one attribute load + truthiness test per
+message. This is a measurement shim, not a simulator: delays are sleeps
+on the sending side, so concurrent flows each pay their own
+serialization — a per-flow bandwidth model, not a shared-link one.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Optional, Tuple
+
+# (one_way_delay_s, seconds_per_byte); None = not yet resolved from env.
+_config: Optional[Tuple[float, float]] = None
+
+
+def configure(rtt_ms: float = 0.0, gbps: float = 0.0) -> None:
+    """Set the emulated link for this process; zeros disable."""
+    global _config
+    one_way = max(rtt_ms, 0.0) / 2000.0
+    spb = 8.0 / (gbps * 1e9) if gbps > 0 else 0.0
+    _config = (one_way, spb)
+
+
+def _resolve() -> Tuple[float, float]:
+    global _config
+    if _config is None:
+        configure(
+            float(os.environ.get("TPUFT_EMULATED_RTT_MS", "0") or 0.0),
+            float(os.environ.get("TPUFT_EMULATED_GBPS", "0") or 0.0),
+        )
+    assert _config is not None
+    return _config
+
+
+def enabled() -> bool:
+    delay, spb = _resolve()
+    return delay > 0.0 or spb > 0.0
+
+
+def pace(nbytes: int) -> None:
+    """Sleep for the emulated link's share of sending ``nbytes`` as one
+    message: RTT/2 of propagation + bytes/bandwidth of serialization."""
+    delay, spb = _resolve()
+    d = delay + nbytes * spb
+    if d > 0.0:
+        time.sleep(d)
+
+
+def pace_latency() -> None:
+    """The propagation half only (RTT/2) — charge once per message when
+    the serialization share is paced incrementally via a PacingWriter."""
+    delay, _ = _resolve()
+    if delay > 0.0:
+        time.sleep(delay)
+
+
+class PacingWriter:
+    """File-like wrapper that charges the emulated link's serialization
+    time interleaved with the actual writes, in bounded slices — one
+    up-front sleep for a huge body would hold the wire silent longer than
+    a per-recv inactivity timeout, a failure a real link of the same
+    bandwidth (which trickles bytes) would not produce. Wrap only when
+    :func:`enabled`; pace latency separately via :func:`pace_latency`."""
+
+    _SLICE = 8 << 20  # 8 MiB: bandwidth sleep per write stays ~sub-second
+
+    def __init__(self, raw: Any) -> None:
+        self._raw = raw
+
+    def write(self, data: Any) -> int:
+        _, spb = _resolve()
+        view = memoryview(bytes(data) if isinstance(data, str) else data)
+        for off in range(0, max(len(view), 1), self._SLICE):
+            part = view[off : off + self._SLICE]
+            if spb > 0.0 and len(part):
+                time.sleep(len(part) * spb)
+            self._raw.write(part)
+        return len(view)
+
+    def flush(self) -> None:
+        self._raw.flush()
