@@ -541,6 +541,8 @@ def _two_group_drill() -> dict:
     quorum_times: dict[int, list] = {0: [], 1: []}
     failed_commits = {0: 0, 1: 0}
     committed_steps = {0: 0, 1: 0}
+    commit_times: dict[int, list] = {0: [], 1: []}
+    kill_time: dict[str, float] = {}
 
     class _Killed(Exception):
         pass
@@ -570,6 +572,7 @@ def _two_group_drill() -> dict:
                 while manager.current_step() < n_steps:
                     step = manager.current_step()
                     if idx == 1 and step == kill_at and attempts == 1:
+                        kill_time["t"] = time.monotonic()
                         raise _Killed()  # simulated process death
                     q0 = time.monotonic()
                     opt.begin_step()
@@ -581,6 +584,7 @@ def _two_group_drill() -> dict:
                     sync_times[idx].append(time.monotonic() - s0)
                     if opt.step(avg):
                         committed_steps[idx] += 1
+                        commit_times[idx].append(time.monotonic())
                     else:
                         failed_commits[idx] += 1
                 return
@@ -618,7 +622,18 @@ def _two_group_drill() -> dict:
         # failure (north star: < 1 outer step per kill).
         "steps_lost_per_kill": failed_commits[0],
         "two_group_committed_steps": committed_steps,
+        # Kill -> first committed step, per role: the operator-facing
+        # recovery TIME ("< 1 outer step" counted above, timed here). The
+        # joiner's number includes the 0.5 s simulated supervisor restart
+        # delay plus rejoin + live heal.
+        "heal_wall_time_s": _heal_walls(kill_time, commit_times),
     }
+
+
+def _heal_walls(kill_time: dict, commit_times: dict) -> "dict | None":
+    from torchft_tpu.utils.profiling import heal_wall_times
+
+    return heal_wall_times(kill_time.get("t"), commit_times)
 
 
 if __name__ == "__main__":

@@ -134,15 +134,74 @@ def _emit(obj: dict) -> None:
     sys.stdout.flush()
 
 
-def role_http_donor(total_bytes: int) -> None:
+class _StepWorker:
+    """Donor-side training-step stand-in: a jitted matmul update running
+    continuously on its own thread, recording (end_time, wall) per step so
+    the bench can compare the donor's step cadence before staging, while
+    staging, and while SERVING a heal — SURVEY §7's "healing without
+    stopping donors" (the reference serves from staged CPU copies on a side
+    stream, reference http_transport.py:226-242; here the staged host
+    copies play that role). On this 1-core box the serve thread contends
+    for the only core, so the serving inflation is an upper bound — on a
+    real TPU host the step math runs on the device."""
+
+    DIM = 256
+
+    def __init__(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        self._jax = jax
+        key = jax.random.PRNGKey(0)
+        self._w = jax.random.normal(key, (self.DIM, self.DIM), dtype=jnp.float32)
+        self._x = jax.random.normal(key, (self.DIM, self.DIM), dtype=jnp.float32)
+        self._step = jax.jit(lambda w, x: w - 1e-6 * (w @ x @ x.T))
+        self.samples: list = []  # (end_monotonic, wall_s)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        # Compile outside the measured windows.
+        self._w = self._step(self._w, self._x).block_until_ready()
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            t0 = time.monotonic()
+            self._w = self._step(self._w, self._x).block_until_ready()
+            t1 = time.monotonic()
+            self.samples.append((t1, t1 - t0))
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=10)
+
+    def wall_ms(self, t_from: float, t_to: float):
+        """(mean_ms, max_ms) over the window, or (None, None) when the
+        window is too short to contain a completed step (e.g. staging,
+        which holds only references and finishes in ~1 ms)."""
+        walls = [w for t, w in self.samples if t_from <= t <= t_to]
+        if not walls:
+            return None, None
+        return float(np.mean(walls) * 1000), float(np.max(walls) * 1000)
+
+
+def role_http_donor(total_bytes: int, with_stepper: bool = True) -> None:
     _force_cpu()
     from torchft_tpu.checkpointing.http_transport import HTTPTransport
 
     state = synth_state(total_bytes)
+    stepper = None
+    t_base0 = time.monotonic()
+    if with_stepper:
+        stepper = _StepWorker()
+        stepper.start()
+        time.sleep(1.5)  # collect the baseline step cadence
     donor = HTTPTransport(timeout=600.0, num_chunks=8)
-    t0 = time.monotonic()
+    t_stage0 = time.monotonic()
     donor.send_checkpoint([1], step=7, state_dict=state, timeout=600.0)
-    stage_s = time.monotonic() - t0
+    t_stage1 = time.monotonic()
+    stage_s = t_stage1 - t_stage0
     _emit(
         {
             "addr": donor.metadata(),
@@ -151,8 +210,46 @@ def role_http_donor(total_bytes: int) -> None:
         }
     )
     sys.stdin.readline()  # parent signals when the receiver is done
+    t_serve1 = time.monotonic()
     donor.shutdown()
-    _emit({"peak_rss": _rss_bytes()})
+    if stepper is None:
+        _emit({"peak_rss": _rss_bytes()})
+        return
+    stepper.stop()
+    base_ms, _ = stepper.wall_ms(t_base0, t_stage0)
+    staging_ms, staging_max = stepper.wall_ms(t_stage0, t_stage1)
+    serving_ms, serving_max = stepper.wall_ms(t_stage1, t_serve1)
+
+    def _round(v, nd=2):
+        return round(v, nd) if v is not None else None
+
+    def _infl(v):
+        return round((v / base_ms - 1.0) * 100, 1) if v is not None else None
+
+    _emit(
+        {
+            "peak_rss": _rss_bytes(),
+            "step_ms_baseline": _round(base_ms),
+            "step_ms_while_staging": _round(staging_ms),
+            "step_ms_while_serving": _round(serving_ms),
+            # The operator question "does the donor STOP?": the longest
+            # single step while serving. The double-buffered design (serve
+            # from staged host copies, never the live state) means no step
+            # ever blocks on the transfer — only on this box's single
+            # core.
+            "step_ms_worst_while_serving": _round(serving_max),
+            "donor_step_inflation_pct": _infl(serving_ms),
+            # Staging holds only references (~1 ms); a window with no
+            # completed step reports null rather than a fake number.
+            "donor_step_inflation_staging_pct": _infl(staging_ms),
+            "stage_s": round(stage_s, 3),
+            # The serve window opens when the parent has the address and
+            # closes at the receiver-done signal; it includes the
+            # receiver's ~2 s process startup (no serving happening),
+            # which dilutes the mean slightly toward the baseline.
+            "single_core_contention_upper_bound": True,
+        }
+    )
 
 
 def role_http_receiver(addr: str) -> None:
@@ -268,8 +365,10 @@ def _read_json(proc: subprocess.Popen, deadline: float) -> dict:
     return box
 
 
-def bench_http_multiproc(total_bytes: int, deadline: float) -> dict:
-    donor = _spawn("http-donor", str(total_bytes))
+def bench_http_multiproc(
+    total_bytes: int, deadline: float, with_stepper: bool = True
+) -> dict:
+    donor = _spawn("http-donor", str(total_bytes), "1" if with_stepper else "0")
     receiver = None
     try:
         staged = _read_json(donor, deadline)
@@ -285,12 +384,31 @@ def bench_http_multiproc(total_bytes: int, deadline: float) -> dict:
             if p is not None and p.poll() is None:
                 p.kill()
     assert staged["digests"] == fetched["digests"], "HTTP content mismatch"
-    return {
+    out = {
         "http_stage_s": staged["stage_s"],
         "http_fetch_s": fetched["fetch_s"],
         "http_donor_rss": donor_final["peak_rss"],
         "http_receiver_rss": fetched["peak_rss"],
     }
+    if "step_ms_baseline" in donor_final:
+        out.update(
+            {
+                "donor_step_ms_baseline": donor_final["step_ms_baseline"],
+                "donor_step_ms_while_staging": donor_final["step_ms_while_staging"],
+                "donor_step_ms_while_serving": donor_final["step_ms_while_serving"],
+                "donor_step_ms_worst_while_serving": donor_final[
+                    "step_ms_worst_while_serving"
+                ],
+                "donor_step_inflation_pct": donor_final["donor_step_inflation_pct"],
+                "donor_step_inflation_staging_pct": donor_final[
+                    "donor_step_inflation_staging_pct"
+                ],
+                "donor_stall_single_core_upper_bound": donor_final[
+                    "single_core_contention_upper_bound"
+                ],
+            }
+        )
+    return out
 
 
 def bench_pg_multiproc(total_bytes: int, deadline: float) -> dict:
@@ -413,10 +531,23 @@ def main() -> None:
     payload = n_big * (side * side + side) * 4
 
     out = {"payload_gb": round(payload / (1 << 30), 3), "mode": "multiproc"}
-    out.update(bench_http_multiproc(total, deadline))
+    # Clean leg: the donor only serves — heal time/goodput/RSS without
+    # CPU contention from a stepping workload (on a real multi-core host
+    # the two don't compete for a core).
+    out.update(bench_http_multiproc(total, deadline, with_stepper=False))
     out["http_goodput_gbps"] = round(8 * payload / (1 << 30) / out["http_fetch_s"], 2)
     out.update(bench_pg_multiproc(total, deadline))
     out["pg_goodput_gbps"] = round(8 * payload / (1 << 30) / out["pg_heal_s"], 2)
+
+    # Donor-stall leg: same transfer with a jitted step loop running on
+    # the donor throughout (SURVEY §7 "healing without stopping donors").
+    stall = bench_http_multiproc(total, deadline, with_stepper=True)
+    out["donor_stall"] = {
+        k: v
+        for k, v in stall.items()
+        if k.startswith("donor_step") or k == "donor_stall_single_core_upper_bound"
+    }
+    out["donor_stall"]["http_fetch_s_while_stepping"] = stall["http_fetch_s"]
 
     # A python+numpy+jax process is ~0.3 GB before it touches the payload;
     # fold that fixed floor into the budget so the flag is meaningful at
@@ -434,6 +565,18 @@ def main() -> None:
         worst = max(worst, (rss - fixed_floor) / payload)
     out["peak_rss_multiple_worst_side"] = round(worst, 2)
     out["within_memory_budget"] = worst <= rss_bound
+
+    # Donor stall at the 27M-model scale too (~0.11 GB of f32 params —
+    # the representative bench config): the small-heal case a DDP/DiLoCo
+    # group actually serves every time a replica rejoins.
+    small = bench_http_multiproc(int(0.11 * (1 << 30)), deadline)
+    out["donor_stall_27m_scale"] = {
+        "http_fetch_s": small["http_fetch_s"],
+        "donor_step_inflation_pct": small["donor_step_inflation_pct"],
+        "donor_step_inflation_staging_pct": small[
+            "donor_step_inflation_staging_pct"
+        ],
+    }
     print(json.dumps(out))
 
 
@@ -441,7 +584,7 @@ if __name__ == "__main__":
     if len(sys.argv) > 2 and sys.argv[1] == "--role":
         role, args = sys.argv[2], sys.argv[3:]
         if role == "http-donor":
-            role_http_donor(int(args[0]))
+            role_http_donor(int(args[0]), args[1] == "1" if len(args) > 1 else True)
         elif role == "http-receiver":
             role_http_receiver(args[0])
         elif role == "pg-sender":

@@ -88,7 +88,7 @@ class PacingWriter:
 
     def write(self, data: Any) -> int:
         _, spb = _resolve()
-        view = memoryview(bytes(data) if isinstance(data, str) else data)
+        view = memoryview(data)
         for off in range(0, max(len(view), 1), self._SLICE):
             part = view[off : off + self._SLICE]
             if spb > 0.0 and len(part):

@@ -93,6 +93,24 @@ def trace_span(name: str) -> Generator[None, None, None]:
 
 
 @contextmanager
+def heal_wall_times(kill_t: "float | None", commit_times: dict) -> "dict | None":
+    """Kill → first-committed-step wall time per replica group, the
+    operator-facing recovery number (BASELINE.md north stars time-bound
+    what steps_lost_per_kill only counts). ``commit_times`` maps group
+    index → monotonic commit timestamps; group 0 is labeled the survivor
+    and group 1 the joiner (the drills' kill target), higher groups keep
+    an index label. Returns None when no kill happened; a group with no
+    commit after the kill reports None for its role."""
+    if kill_t is None:
+        return None
+    out = {}
+    for idx, times in sorted(commit_times.items()):
+        after = [t for t in times if t > kill_t]
+        role = "joiner" if idx == 1 else ("survivor" if idx == 0 else f"g{idx}")
+        out[role] = round(min(after) - kill_t, 3) if after else None
+    return out
+
+
 def timed(name: str) -> Iterator[None]:
     """Always-on wall-time log for transfer-sized operations."""
     start = time.monotonic()
