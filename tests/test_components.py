@@ -745,3 +745,13 @@ def test_netem_shim_pacing() -> None:
         assert 0.11 <= dt < 2.0, dt
     finally:
         netem.configure(0, 0)
+
+
+def test_heal_wall_times_helper() -> None:
+    """Shared kill->first-commit timing used by bench + dryrun drills:
+    role labels, post-kill filtering, and the no-kill/no-commit cases."""
+    from torchft_tpu.utils.profiling import heal_wall_times
+
+    assert heal_wall_times(None, {0: [1.0]}) is None
+    out = heal_wall_times(10.0, {0: [9.0, 12.5, 14.0], 1: [9.5, 16.25], 2: []})
+    assert out == {"survivor": 2.5, "joiner": 6.25, "g2": None}

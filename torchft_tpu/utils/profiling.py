@@ -92,7 +92,6 @@ def trace_span(name: str) -> Generator[None, None, None]:
             logger.info("%s took %.3fms", name, elapsed * 1000)
 
 
-@contextmanager
 def heal_wall_times(kill_t: "float | None", commit_times: dict) -> "dict | None":
     """Kill → first-committed-step wall time per replica group, the
     operator-facing recovery number (BASELINE.md north stars time-bound
@@ -111,6 +110,7 @@ def heal_wall_times(kill_t: "float | None", commit_times: dict) -> "dict | None"
     return out
 
 
+@contextmanager
 def timed(name: str) -> Iterator[None]:
     """Always-on wall-time log for transfer-sized operations."""
     start = time.monotonic()
