@@ -231,6 +231,55 @@ def bench_outer_sync(wire_dtype: str) -> Dict[str, float]:
     return {"wall_s": float(max(results.values())), "wire_mb": wire_bytes[0] / 1e6}
 
 
+def bench_quorum_rtt(rtt_ms: float, steps: int = 12) -> Dict[str, float]:
+    """Control-plane sensitivity to lighthouse RTT: per-step quorum and
+    commit-barrier p50 for one replica group whose manager reaches the
+    lighthouse through a netem.LatencyProxy (the native manager's
+    quorum/heartbeat RPCs ride it; the manager<->local-rank wire stays
+    loopback, same-host by design). The quorum round pays the hop; the
+    commit barrier is intra-group (local ranks) and should stay flat."""
+    from torchft_tpu.parallel.process_group import ProcessGroupDummy
+
+    lh = LighthouseServer(bind="127.0.0.1:0", min_replicas=1, join_timeout_ms=5000)
+    proxy = netem.LatencyProxy(lh.address(), rtt_ms)
+    store = StoreServer()
+    client = StoreClient(store.address(), prefix="cp")
+    manager = Manager(
+        pg=ProcessGroupDummy(0, 1),
+        min_replica_size=1,
+        store=client,
+        store_addr=store.address() + "/cp",
+        use_async_quorum=False,
+        group_rank=0,
+        group_world_size=1,
+        lighthouse_addr=proxy.address(),
+        replica_id="cp_rtt",
+        heartbeat_interval=0.5,
+        timeout=30.0,
+        quorum_timeout=60.0,
+    )
+    quorum_walls: List[float] = []
+    commit_walls: List[float] = []
+    try:
+        for _ in range(steps):
+            t0 = time.perf_counter()
+            manager.start_quorum()
+            t1 = time.perf_counter()
+            assert manager.should_commit() is True
+            t2 = time.perf_counter()
+            quorum_walls.append(t1 - t0)
+            commit_walls.append(t2 - t1)
+    finally:
+        manager.shutdown()
+        proxy.shutdown()
+        lh.shutdown()
+    quorum_walls, commit_walls = quorum_walls[1:], commit_walls[1:]
+    return {
+        "quorum_p50_ms": round(float(np.median(quorum_walls)) * 1000, 2),
+        "commit_p50_ms": round(float(np.median(commit_walls)) * 1000, 2),
+    }
+
+
 def bench_heal() -> float:
     """Wall time to receive a HEAL_MB checkpoint over the emulated link."""
     from torchft_tpu.checkpointing import HTTPTransport
@@ -295,6 +344,14 @@ def main() -> None:
         print(json.dumps(row), flush=True)
         netem.configure(0, 0)
 
+    # Control-plane RTT sensitivity: quorum pays the lighthouse hop, the
+    # intra-group commit barrier stays flat (RTT-only; bandwidth is
+    # irrelevant at quorum message sizes).
+    control_plane = {
+        f"{int(rtt)}ms": bench_quorum_rtt(rtt) for rtt in RTTS_MS
+    }
+    print(json.dumps({"control_plane_rtt": control_plane}), flush=True)
+
     # Select rows by predicate, not position — editing `points` above must
     # not silently re-aim the headline claims.
     full_bw = [r for r in sweep if r["gbps"] == GBPS]
@@ -336,6 +393,7 @@ def main() -> None:
         "emulation": "netem shim at ProcessGroupTCP/HTTP wire choke points "
         "(per-flow: RTT/2 per message + bytes/bandwidth)",
         "sweep": sweep,
+        "control_plane_rtt": control_plane,
         "claims": claims,
     }
     out = REPO / "EMULATED_DCN_BENCH.json"

@@ -50,9 +50,10 @@ from torchft_tpu.parallel.process_group import (
     ProcessGroupTCP,
 )
 from torchft_tpu.parallel.store import StoreClient, StoreServer
+from torchft_tpu.utils import netem
 
 
-class FaultInjectingLighthouse:
+class FaultInjectingLighthouse(netem.TCPFront):
     """The reference MockLighthouse analogue (manager.rs:1109-1217) on this
     repo's wire: a framed-protobuf TCP front that REFUSES the next N
     LIGHTHOUSE_QUORUM requests with a proper error-status response and
@@ -60,39 +61,21 @@ class FaultInjectingLighthouse:
     a valid response frame, the RpcClient's stale-connection redial never
     triggers — each injected failure consumes exactly one attempt of the
     native manager's quorum_retries loop (native/src/manager.cc:126-143),
-    deterministically."""
+    deterministically. Connection plumbing shared with the emulated-DCN
+    LatencyProxy via netem.TCPFront."""
 
     def __init__(self, target_addr: str) -> None:
         from torchft_tpu import coordination as co
 
         self._co = co
-        host, _, port = target_addr.rpartition(":")
-        self._target = (host.strip("[]") or "127.0.0.1", int(port))
         self._fail_remaining = 0
         self.failures_injected = 0
         self._lock = threading.Lock()
-        self._stop = False
-        self._srv = socket.create_server(("127.0.0.1", 0))
-        self._srv.settimeout(0.2)
-        self._thread = threading.Thread(target=self._accept_loop, daemon=True)
-        self._thread.start()
-
-    def address(self) -> str:
-        return f"127.0.0.1:{self._srv.getsockname()[1]}"
+        super().__init__(target_addr)
 
     def fail_next(self, n: int) -> None:
         with self._lock:
             self._fail_remaining = n
-
-    def _accept_loop(self) -> None:
-        while not self._stop:
-            try:
-                conn, _ = self._srv.accept()
-            except socket.timeout:
-                continue
-            except OSError:
-                return
-            threading.Thread(target=self._serve, args=(conn,), daemon=True).start()
 
     @staticmethod
     def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -104,13 +87,13 @@ class FaultInjectingLighthouse:
             buf += chunk
         return buf
 
-    def _serve(self, conn: socket.socket) -> None:
+    def handle(self, conn: socket.socket) -> None:
         import struct
 
         co = self._co
         conn.settimeout(30)
         try:
-            while not self._stop:
+            while not self.stopping:
                 header = self._recv_exact(conn, 6)
                 magic, method, length = struct.unpack("!BBI", header)
                 payload = self._recv_exact(conn, length) if length else b""
@@ -129,7 +112,7 @@ class FaultInjectingLighthouse:
                     )
                     continue
                 # Forward verbatim to the real lighthouse, relay the reply.
-                with socket.create_connection(self._target, timeout=10) as up:
+                with socket.create_connection(self.target, timeout=10) as up:
                     up.sendall(header + payload)
                     rh = self._recv_exact(up, 6)
                     _, _, rlen = struct.unpack("!BBI", rh)
@@ -139,11 +122,6 @@ class FaultInjectingLighthouse:
             pass
         finally:
             conn.close()
-
-    def shutdown(self) -> None:
-        self._stop = True
-        self._thread.join(timeout=2)
-        self._srv.close()
 
 
 def _make_manager(lighthouse_addr: str, quorum_retries: int, store: StoreServer):

@@ -25,6 +25,8 @@ serialization — a per-flow bandwidth model, not a shared-link one.
 from __future__ import annotations
 
 import os
+import socket
+import threading
 import time
 from typing import Any, Optional, Tuple
 
@@ -98,3 +100,88 @@ class PacingWriter:
 
     def flush(self) -> None:
         self._raw.flush()
+
+
+class TCPFront:
+    """Shared scaffolding for wire-front proxies placed ahead of a real
+    server (latency injection here; fault injection in the lighthouse
+    tests): target address parsing, the listener + accept loop, and
+    per-connection handler threads. Subclasses implement
+    :meth:`handle`."""
+
+    def __init__(self, target_addr: str) -> None:
+        host, _, port = target_addr.rpartition(":")
+        self.target = (host.strip("[]") or "127.0.0.1", int(port))
+        self._stop = False
+        self._srv = socket.create_server(("127.0.0.1", 0))
+        self._srv.settimeout(0.2)
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._thread.start()
+
+    def address(self) -> str:
+        return f"127.0.0.1:{self._srv.getsockname()[1]}"
+
+    @property
+    def stopping(self) -> bool:
+        return self._stop
+
+    def _accept_loop(self) -> None:
+        while not self._stop:
+            try:
+                conn, _ = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self.handle, args=(conn,), daemon=True).start()
+
+    def handle(self, conn: socket.socket) -> None:
+        raise NotImplementedError
+
+    def shutdown(self) -> None:
+        self._stop = True
+        self._thread.join(timeout=2)
+        self._srv.close()
+
+
+class LatencyProxy(TCPFront):
+    """Byte-level proxy that sleeps RTT/2 before forwarding each burst in
+    each direction — a DCN hop in front of a control-plane server. Framing
+    agnostic; used by the emulated-DCN bench to measure quorum latency
+    sensitivity."""
+
+    def __init__(self, target_addr: str, rtt_ms: float) -> None:
+        self._one_way = max(rtt_ms, 0.0) / 2000.0
+        super().__init__(target_addr)
+
+    def handle(self, conn: socket.socket) -> None:
+        try:
+            up = socket.create_connection(self.target, timeout=10)
+        except OSError:
+            conn.close()
+            return
+
+        def copy(src: socket.socket, dst: socket.socket) -> None:
+            try:
+                while True:
+                    data = src.recv(65536)
+                    if not data:
+                        break
+                    if self._one_way:
+                        time.sleep(self._one_way)
+                    dst.sendall(data)
+            except OSError:
+                pass
+            finally:
+                for s in (src, dst):
+                    try:
+                        s.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+
+        t = threading.Thread(target=copy, args=(up, conn), daemon=True)
+        t.start()
+        copy(conn, up)
+        t.join(timeout=10)
+        conn.close()
+        up.close()
