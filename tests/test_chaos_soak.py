@@ -97,7 +97,11 @@ print(f"group {group} done at step {manager.current_step()}", flush=True)
 
 
 def test_chaos_soak_full_fault_menu(tmp_path) -> None:
-    from torchft_tpu.coordination import LighthouseClient, LighthouseServer
+    import signal
+    import socket
+
+    from tests.test_lighthouse_failure import _spawn_lighthouse
+    from torchft_tpu.coordination import LighthouseClient
     from torchft_tpu.launch import supervise
     from torchft_tpu.punisher import FAULT_MODES, kill_one
 
@@ -112,22 +116,60 @@ def test_chaos_soak_full_fault_menu(tmp_path) -> None:
     out_dir = tmp_path / "out"
     out_dir.mkdir()
 
-    lighthouse = LighthouseServer(
-        min_replicas=1, join_timeout_ms=2000, heartbeat_timeout_ms=2000
-    )
+    # The lighthouse is a REAL subprocess daemon on a fixed port so the
+    # fault menu can include its own death: the punisher SIGKILLs and
+    # restarts it mid-soak (same address), and the replicas' quorum_retries
+    # loop must carry training through the control-plane outage (the SPOF
+    # scenario tests/test_lighthouse_failure.py proves in isolation, here
+    # composed with the data-plane fault menu).
+    with socket.create_server(("127.0.0.1", 0)) as s:
+        lh_port = s.getsockname()[1]
+    def _lh() -> "subprocess.Popen":
+        return _spawn_lighthouse(
+            lh_port, min_replicas=1, join_timeout_ms=2000, heartbeat_timeout_ms=2000
+        )
+
+    lh = {"proc": _lh()}
+    lh_addr = f"127.0.0.1:{lh_port}"
     stop = threading.Event()
 
-    faults = {"count": 0}
+    faults = {"count": 0, "lighthouse_restarts": 0}
 
     def punish() -> None:
-        client = LighthouseClient(lighthouse.address())
+        client = LighthouseClient(lh_addr)
         rng = random.Random(1234)
         deadline = time.monotonic() + soak_seconds
+        lh_kill_at = time.monotonic() + soak_seconds / 2  # mid-window
         # Wait for the job to form a quorum before the first fault.
-        time.sleep(5.0)
+        if stop.wait(5.0):
+            return
         mtbf = max(soak_seconds / 8.0, 5.0)
         while time.monotonic() < deadline and not stop.is_set():
-            time.sleep(rng.expovariate(1.0 / mtbf))
+            # Cap each draw so (a) the mid-window lighthouse kill is
+            # reached DETERMINISTICALLY (an uncapped exponential sleep
+            # could overshoot the whole window — CLAUDE.md forbids
+            # timing-based test gating) and (b) the loop exits promptly
+            # at the deadline; stop.wait wakes immediately on teardown.
+            draw = min(
+                rng.expovariate(1.0 / mtbf),
+                max(deadline - time.monotonic(), 0.01),
+            )
+            if faults["lighthouse_restarts"] == 0:
+                draw = min(draw, max(lh_kill_at - time.monotonic(), 0.01))
+            if stop.wait(draw):
+                return
+            if faults["lighthouse_restarts"] == 0 and time.monotonic() >= lh_kill_at:
+                try:
+                    os.kill(lh.get("proc").pid, signal.SIGKILL)
+                    lh.get("proc").wait(timeout=10)  # observed death
+                    lh["proc"] = _lh()
+                    # Tracked separately; NOT counted toward the >= 2
+                    # data-plane fault floor below.
+                    faults["lighthouse_restarts"] += 1
+                    print("[soak] lighthouse SIGKILLed and restarted")
+                except Exception as e:  # noqa: BLE001
+                    print(f"[soak] lighthouse restart failed: {e}")
+                continue
             mode = rng.choice(list(FAULT_MODES))
             try:
                 kill_one(client, rng, mode=mode)
@@ -141,7 +183,7 @@ def test_chaos_soak_full_fault_menu(tmp_path) -> None:
         code = supervise(
             [sys.executable, str(script)],
             num_replica_groups=2,
-            lighthouse_addr=lighthouse.address(),
+            lighthouse_addr=lh_addr,
             relaunch_interval=0.5,
             max_restarts=100,
             extra_env={
@@ -150,6 +192,10 @@ def test_chaos_soak_full_fault_menu(tmp_path) -> None:
                 # ~20 steps/s by the script's sleep).
                 "SOAK_STEPS": str(int(soak_seconds * 15)),
                 "TPUFT_LOG": "warn",
+                # Ride out the mid-soak lighthouse restart: ~10/s
+                # connection-refused attempts against the dead address
+                # give ~15 s of coverage vs a ~3-5 s restart.
+                "TPUFT_QUORUM_RETRIES": "150",
                 # Flight recorder armed: injected faults must leave
                 # post-mortem dumps behind (asserted below).
                 "TPUFT_FLIGHT_RECORDER": str(out_dir / "fr"),
@@ -157,8 +203,10 @@ def test_chaos_soak_full_fault_menu(tmp_path) -> None:
         )
     finally:
         stop.set()
-        lighthouse.shutdown()
+        punisher.join(timeout=30)  # no respawn may race the kill below
+        lh["proc"].kill()
     assert code == 0
+    assert faults["lighthouse_restarts"] == 1, faults
 
     digests = {}
     for group in range(2):

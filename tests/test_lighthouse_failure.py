@@ -200,9 +200,15 @@ def test_quorum_without_retries_fails_on_dropped_rpc() -> None:
 # ---------------------------------------------------------------------------
 
 
-def _spawn_lighthouse(port: int, min_replicas: int = 2) -> subprocess.Popen:
+def _spawn_lighthouse(
+    port: int,
+    min_replicas: int = 2,
+    join_timeout_ms: int = 3000,
+    heartbeat_timeout_ms: int = 5000,
+) -> subprocess.Popen:
     """Starts the real `python -m torchft_tpu.lighthouse` daemon and blocks
-    until it accepts TCP connections (observed readiness, not a sleep)."""
+    until it accepts TCP connections (observed readiness, not a sleep).
+    Also used by the chaos soak's lighthouse-restart fault."""
     proc = subprocess.Popen(
         [
             sys.executable,
@@ -213,7 +219,9 @@ def _spawn_lighthouse(port: int, min_replicas: int = 2) -> subprocess.Popen:
             "--min-replicas",
             str(min_replicas),
             "--join-timeout-ms",
-            "3000",
+            str(join_timeout_ms),
+            "--heartbeat-timeout-ms",
+            str(heartbeat_timeout_ms),
         ],
         stdout=subprocess.DEVNULL,
         stderr=subprocess.DEVNULL,
