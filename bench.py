@@ -518,6 +518,7 @@ def _two_group_drill() -> dict:
     from torchft_tpu.optim import Optimizer
     from torchft_tpu.parallel.native_pg import ProcessGroupNative
     from torchft_tpu.parallel.store import StoreClient, StoreServer
+    from torchft_tpu.utils.profiling import heal_wall_times
 
     # Tiny model: this drill measures coordination + wire costs, not FLOPs
     # (both thread-groups share one chip; compute throughput is phase 2/3's
@@ -626,14 +627,8 @@ def _two_group_drill() -> dict:
         # recovery TIME ("< 1 outer step" counted above, timed here). The
         # joiner's number includes the 0.5 s simulated supervisor restart
         # delay plus rejoin + live heal.
-        "heal_wall_time_s": _heal_walls(kill_time, commit_times),
+        "heal_wall_time_s": heal_wall_times(kill_time.get("t"), commit_times),
     }
-
-
-def _heal_walls(kill_time: dict, commit_times: dict) -> "dict | None":
-    from torchft_tpu.utils.profiling import heal_wall_times
-
-    return heal_wall_times(kill_time.get("t"), commit_times)
 
 
 if __name__ == "__main__":

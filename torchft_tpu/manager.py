@@ -107,7 +107,13 @@ class _TrackedCommitFuture:
     """Proxy around should_commit_async's executor future that records
     whether the caller ever observed its outcome, so start_quorum's drain
     can tell "caller already handled the barrier result/exception" (skip)
-    from "caller never looked" (drain, propagating any stored exception)."""
+    from "caller never looked" (drain, propagating any stored exception).
+
+    A RESTRICTED future proxy, not a concurrent.futures.Future subclass:
+    it supports result/exception/done/running/cancelled/cancel/
+    add_done_callback, but not the module-level ``concurrent.futures.wait``
+    / ``as_completed`` helpers (which poke Future internals). Callers
+    coordinating multiple futures should resolve this one directly."""
 
     def __init__(self, inner: concurrent.futures.Future) -> None:
         self._inner = inner
@@ -152,6 +158,13 @@ class _TrackedCommitFuture:
 
     def cancelled(self) -> bool:
         return self._inner.cancelled()
+
+    def cancel(self) -> bool:
+        # A cancelled barrier was observed by whoever cancelled it.
+        cancelled = self._inner.cancel()
+        if cancelled:
+            self.consumed = True
+        return cancelled
 
     def add_done_callback(self, fn: Callable[[Any], None]) -> None:
         self._inner.add_done_callback(lambda _inner: fn(self))

@@ -179,7 +179,9 @@ _RING_MIN_BYTES = int(
 
 
 def _send_bytes(sock: socket.socket, payload: bytes, deadline: float) -> None:
-    netem.pace(len(payload))  # no-op unless an emulated-DCN link is set
+    # No-op unless an emulated-DCN link is set; deadline-bounded so the
+    # emulated link times the op out exactly where a real link would.
+    netem.pace_deadline(len(payload), deadline)
     sock.settimeout(max(0.001, deadline - time.monotonic()))
     sock.sendall(_LEN_STRUCT.pack(len(payload)) + payload)
 

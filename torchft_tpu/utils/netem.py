@@ -67,6 +67,24 @@ def pace(nbytes: int) -> None:
         time.sleep(d)
 
 
+def pace_deadline(nbytes: int, deadline: float) -> None:
+    """:func:`pace`, bounded by an absolute monotonic ``deadline``: sleeps
+    at most the remaining time and raises ``socket.timeout`` when the
+    emulated link cannot deliver the message in time — the failure a real
+    link of this speed would produce under the caller's op timeout.
+    Deadline-bounded wire paths (ProcessGroupTCP sends) must use this so
+    an emulated slow link cannot stall an op past its deadline."""
+    delay, spb = _resolve()
+    d = delay + nbytes * spb
+    if d <= 0.0:
+        return
+    remaining = deadline - time.monotonic()
+    if d > max(remaining, 0.0):
+        time.sleep(max(remaining, 0.0))
+        raise socket.timeout("emulated link exceeded the op deadline")
+    time.sleep(d)
+
+
 def pace_latency() -> None:
     """The propagation half only (RTT/2) — charge once per message when
     the serialization share is paced incrementally via a PacingWriter."""
