@@ -510,3 +510,58 @@ def test_flight_recorder_captures_collective_ops(store_server) -> None:
     finally:
         for pg in pgs:
             pg.shutdown()
+
+
+def test_emulated_link_paces_and_respects_deadlines(store_server) -> None:
+    """The netem shim on the TCP wire: a modest emulated link paces ops
+    (lower-bounded by the injected latency — sleeps never undershoot),
+    and a link too slow for the payload FAILS AT THE OP DEADLINE instead
+    of stalling for the full emulated serialization time."""
+    from torchft_tpu.utils import netem
+
+    # Generous configure deadline (mesh setup under suite load on the
+    # 1-core box), then a tight OP deadline via set_timeout.
+    pgs = make_group(store_server, 2)
+    for pg in pgs:
+        pg.set_timeout(3.0)
+    try:
+        # Paced: gather-at-root for a tiny array = at least one proxied
+        # message on the critical path; 400 ms RTT -> >= 200 ms injected,
+        # well above this box's loopback scheduling noise (a silent no-op
+        # netem would finish in tens of ms).
+        netem.configure(rtt_ms=400, gbps=1.0)
+        t0 = time.monotonic()
+        outs = run_on_all(
+            pgs,
+            lambda pg, i: pg.allreduce([np.ones(4, np.float32)], ReduceOp.SUM).wait(),
+        )
+        dt = time.monotonic() - t0
+        np.testing.assert_array_equal(outs[0][0], np.full(4, 2.0))
+        assert dt >= 0.2, f"pacing not applied: {dt}"
+
+        # Absurd link (~1 KB/s) vs a 4 MB payload: the emulated
+        # serialization would take ~an hour; the op must fail AT its own
+        # 3 s deadline (netem.pace_deadline raises socket.timeout there).
+        # The wait(8) backstop must never be what fires — dt < 6 asserts
+        # the failure came from the op deadline, not the wait.
+        netem.configure(rtt_ms=0, gbps=1e-6)
+        t0 = time.monotonic()
+        errs = run_on_all(
+            pgs,
+            lambda pg, i: _expect_wire_failure(pg),
+        )
+        dt = time.monotonic() - t0
+        assert all(errs), errs
+        assert dt < 6, f"failed via the wait backstop, not the op deadline: {dt}"
+    finally:
+        netem.configure(0, 0)
+        for pg in pgs:
+            pg.shutdown()
+
+
+def _expect_wire_failure(pg: ProcessGroup) -> str:
+    try:
+        pg.allreduce([np.ones(1_000_000, np.float32)], ReduceOp.SUM).wait(8)
+    except Exception as e:  # noqa: BLE001
+        return type(e).__name__
+    return ""
