@@ -344,6 +344,21 @@ def main() -> None:
         print(json.dumps(row), flush=True)
         netem.configure(0, 0)
 
+    # Wire-bound outer-sync point: at 0.01 Gbps serialization dominates
+    # everything else, so the int4-vs-fp8 wall ratio approaches the byte
+    # ratio's 1.97x asymptote (fixed RTT + host reduce costs cap it at
+    # ~1.6x on the 0.1 Gbps row above). Outer sync only — the per-step
+    # loops would crawl pointlessly at this bandwidth.
+    netem.configure(50.0, 0.01)
+    outer_wire_bound = {w: bench_outer_sync(w) for w in ("fp8", "int4")}
+    netem.configure(0, 0)
+    print(
+        json.dumps(
+            {"outer_sync_wire_bound_s": {k: round(v["wall_s"], 3) for k, v in outer_wire_bound.items()}}
+        ),
+        flush=True,
+    )
+
     # Control-plane RTT sensitivity: quorum pays the lighthouse hop, the
     # intra-group commit barrier stays flat (RTT-only; bandwidth is
     # irrelevant at quorum message sizes).
@@ -378,6 +393,9 @@ def main() -> None:
         "int4_outer_speedup_vs_fp8_constrained_bw": round(
             constrained["outer_sync_s"]["fp8"] / constrained["outer_sync_s"]["int4"], 3
         ),
+        "int4_outer_speedup_vs_fp8_wire_bound": round(
+            outer_wire_bound["fp8"]["wall_s"] / outer_wire_bound["int4"]["wall_s"], 3
+        ),
         "int4_wire_bytes_vs_fp8": round(
             worst["outer_wire_mb"]["int4"] / worst["outer_wire_mb"]["fp8"], 3
         ),
@@ -393,6 +411,12 @@ def main() -> None:
         "emulation": "netem shim at ProcessGroupTCP/HTTP wire choke points "
         "(per-flow: RTT/2 per message + bytes/bandwidth)",
         "sweep": sweep,
+        "outer_sync_wire_bound": {
+            "rtt_ms": 50.0,
+            "gbps": 0.01,
+            "wall_s": {k: round(v["wall_s"], 3) for k, v in outer_wire_bound.items()},
+            "wire_mb": {k: round(v["wire_mb"], 3) for k, v in outer_wire_bound.items()},
+        },
         "control_plane_rtt": control_plane,
         "claims": claims,
     }
