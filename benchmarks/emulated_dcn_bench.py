@@ -349,7 +349,8 @@ def main() -> None:
     # ratio's 1.97x asymptote (fixed RTT + host reduce costs cap it at
     # ~1.6x on the 0.1 Gbps row above). Outer sync only — the per-step
     # loops would crawl pointlessly at this bandwidth.
-    netem.configure(50.0, 0.01)
+    WIRE_BOUND_RTT_MS, WIRE_BOUND_GBPS = 50.0, 0.01
+    netem.configure(WIRE_BOUND_RTT_MS, WIRE_BOUND_GBPS)
     outer_wire_bound = {w: bench_outer_sync(w) for w in ("fp8", "int4")}
     netem.configure(0, 0)
     print(
@@ -412,8 +413,8 @@ def main() -> None:
         "(per-flow: RTT/2 per message + bytes/bandwidth)",
         "sweep": sweep,
         "outer_sync_wire_bound": {
-            "rtt_ms": 50.0,
-            "gbps": 0.01,
+            "rtt_ms": WIRE_BOUND_RTT_MS,
+            "gbps": WIRE_BOUND_GBPS,
             "wall_s": {k: round(v["wall_s"], 3) for k, v in outer_wire_bound.items()},
             "wire_mb": {k: round(v["wire_mb"], 3) for k, v in outer_wire_bound.items()},
         },
