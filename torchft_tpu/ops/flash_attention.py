@@ -189,21 +189,31 @@ def _flash_fwd(
     qp = q_positions.astype(jnp.int32).reshape(b, sq + pad_q, 1)
     kp = k_positions.astype(jnp.int32).reshape(b, 1, sk + pad_k)
 
+    # Kernels run on (b, heads, seq, d): Mosaic requires the last two BLOCK
+    # dims be (mult-of-8, mult-of-128-or-whole-dim), so seq and head_dim must
+    # be minor. The model-facing (b, seq, heads, d) layout would squeeze the
+    # heads dim into second-to-last block position (block 1 vs array h — an
+    # on-chip lowering error interpret mode never sees). The transposes are
+    # plain XLA copies at the kernel boundary.
+    qt = q.transpose(0, 2, 1, 3)  # (b, h, sq_p, d)
+    kt = k.transpose(0, 2, 1, 3)  # (b, kv_heads, sk_p, d)
+    vt = v.transpose(0, 2, 1, 3)
+
     kernel = partial(_fwd_kernel, scale=scale, nk=nk)
     out, lse = pl.pallas_call(
         kernel,
         grid=(b, h, nq, nk),
         in_specs=[
             pl.BlockSpec(
-                (None, block_q, None, d), lambda ib, ih, iq, ik: (ib, iq, ih, 0)
+                (None, None, block_q, d), lambda ib, ih, iq, ik: (ib, ih, iq, 0)
             ),
             pl.BlockSpec(
-                (None, block_k, None, d),
-                lambda ib, ih, iq, ik: (ib, ik, ih // group, 0),
+                (None, None, block_k, d),
+                lambda ib, ih, iq, ik: (ib, ih // group, ik, 0),
             ),
             pl.BlockSpec(
-                (None, block_k, None, d),
-                lambda ib, ih, iq, ik: (ib, ik, ih // group, 0),
+                (None, None, block_k, d),
+                lambda ib, ih, iq, ik: (ib, ih // group, ik, 0),
             ),
             pl.BlockSpec(
                 (None, block_q, 1), lambda ib, ih, iq, ik: (ib, iq, 0)
@@ -214,15 +224,15 @@ def _flash_fwd(
         ],
         out_specs=[
             pl.BlockSpec(
-                (None, block_q, None, d), lambda ib, ih, iq, ik: (ib, iq, ih, 0)
+                (None, None, block_q, d), lambda ib, ih, iq, ik: (ib, ih, iq, 0)
             ),
             pl.BlockSpec(
-                (None, block_q, None, 1), lambda ib, ih, iq, ik: (ib, iq, ih, 0)
+                (None, None, block_q, 1), lambda ib, ih, iq, ik: (ib, ih, iq, 0)
             ),
         ],
         out_shape=[
-            _out_struct((b, sq + pad_q, h, d), q.dtype, (q, k, v, qp, kp)),
-            _out_struct((b, sq + pad_q, h, 1), jnp.float32, (q, k, v, qp, kp)),
+            _out_struct((b, h, sq + pad_q, d), q.dtype, (q, k, v, qp, kp)),
+            _out_struct((b, h, sq + pad_q, 1), jnp.float32, (q, k, v, qp, kp)),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
@@ -230,13 +240,15 @@ def _flash_fwd(
             pltpu.VMEM((block_q, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v, qp, kp)
+    )(qt, kt, vt, qp, kp)
+    out = out.transpose(0, 2, 1, 3)  # back to (b, sq_p, h, d)
+    lse = lse[..., 0].transpose(0, 2, 1)  # (b, sq_p, h)
     if pad_q:
         out = out[:, :sq]
         lse = lse[:, :sq]
-    # (b, sq, h, 1) -> (b, sq, kv, group): head h is kv-head h // group, the
+    # (b, sq, h) -> (b, sq, kv, group): head h is kv-head h // group, the
     # same layout blockwise_attention's backward expects for its residual.
-    return out, lse[..., 0].reshape(b, sq, kv_heads, group)
+    return out, lse.reshape(b, sq, kv_heads, group)
 
 
 def _bwd_dq_kernel(
@@ -402,46 +414,54 @@ def flash_attention_partial_bwd(
     nk = (sk + pad_k) // block_k
     qp = q_positions.astype(jnp.int32).reshape(b, sq + pad_q, 1)
     kp = k_positions.astype(jnp.int32).reshape(b, 1, sk + pad_k)
-    lse_col = lse.reshape(b, sq + pad_q, h, 1)
-    delta_col = delta.reshape(b, sq + pad_q, h, 1)
+    # Same heads-major transposition as _flash_fwd (see comment there): the
+    # kernels see (b, h, seq, d) / (b, h, seq, 1) so seq and d are the block
+    # minor dims Mosaic requires.
+    qt = q.transpose(0, 2, 1, 3)  # (b, h, sq_p, d)
+    kt = k.transpose(0, 2, 1, 3)  # (b, kv_heads, sk_p, d)
+    vt = v.transpose(0, 2, 1, 3)
+    dot = d_out.transpose(0, 2, 1, 3)  # (b, h, sq_p, d)
+    lse_col = lse.reshape(b, sq + pad_q, h, 1).transpose(0, 2, 1, 3)
+    delta_col = delta.reshape(b, sq + pad_q, h, 1).transpose(0, 2, 1, 3)
 
     q_spec = pl.BlockSpec(
-        (None, block_q, None, d), lambda ib, ih, iq, ik: (ib, iq, ih, 0)
+        (None, None, block_q, d), lambda ib, ih, iq, ik: (ib, ih, iq, 0)
     )
     k_spec = pl.BlockSpec(
-        (None, block_k, None, d), lambda ib, ih, iq, ik: (ib, ik, ih // group, 0)
+        (None, None, block_k, d), lambda ib, ih, iq, ik: (ib, ih // group, ik, 0)
     )
     col_spec = pl.BlockSpec(
-        (None, block_q, None, 1), lambda ib, ih, iq, ik: (ib, iq, ih, 0)
+        (None, None, block_q, 1), lambda ib, ih, iq, ik: (ib, ih, iq, 0)
     )
     qp_spec = pl.BlockSpec((None, block_q, 1), lambda ib, ih, iq, ik: (ib, iq, 0))
     kp_spec = pl.BlockSpec((None, 1, block_k), lambda ib, ih, iq, ik: (ib, 0, ik))
-    inputs = (q, k, v, d_out, lse_col, delta_col, qp, kp)
+    inputs = (qt, kt, vt, dot, lse_col, delta_col, qp, kp)
 
     dq = pl.pallas_call(
         partial(_bwd_dq_kernel, scale=scale, nk=nk),
         grid=(b, h, nq, nk),
         in_specs=[q_spec, k_spec, k_spec, q_spec, col_spec, col_spec, qp_spec, kp_spec],
         out_specs=[q_spec],
-        out_shape=[_out_struct((b, sq + pad_q, h, d), out_dtype, inputs)],
+        out_shape=[_out_struct((b, h, sq + pad_q, d), out_dtype, inputs)],
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
     )(*inputs)[0]
+    dq = dq.transpose(0, 2, 1, 3)  # (b, sq_p, h, d)
 
     # dK/dV pass: swap the two inner grid axes (KV outer, Q innermost) so
     # the accumulators persist across q blocks. Index maps take (iq, ik) in
     # swapped positions.
     q_spec_t = pl.BlockSpec(
-        (None, block_q, None, d), lambda ib, ih, ik, iq: (ib, iq, ih, 0)
+        (None, None, block_q, d), lambda ib, ih, ik, iq: (ib, ih, iq, 0)
     )
     k_spec_t = pl.BlockSpec(
-        (None, block_k, None, d), lambda ib, ih, ik, iq: (ib, ik, ih // group, 0)
+        (None, None, block_k, d), lambda ib, ih, ik, iq: (ib, ih // group, ik, 0)
     )
     kh_spec_t = pl.BlockSpec(
-        (None, block_k, None, d), lambda ib, ih, ik, iq: (ib, ik, ih, 0)
+        (None, None, block_k, d), lambda ib, ih, ik, iq: (ib, ih, ik, 0)
     )
     col_spec_t = pl.BlockSpec(
-        (None, block_q, None, 1), lambda ib, ih, ik, iq: (ib, iq, ih, 0)
+        (None, None, block_q, 1), lambda ib, ih, ik, iq: (ib, ih, iq, 0)
     )
     qp_spec_t = pl.BlockSpec((None, block_q, 1), lambda ib, ih, ik, iq: (ib, iq, 0))
     kp_spec_t = pl.BlockSpec((None, 1, block_k), lambda ib, ih, ik, iq: (ib, 0, ik))
@@ -454,8 +474,8 @@ def flash_attention_partial_bwd(
         ],
         out_specs=[kh_spec_t, kh_spec_t],
         out_shape=[
-            _out_struct((b, sk + pad_k, h, d), out_dtype, inputs),
-            _out_struct((b, sk + pad_k, h, d), out_dtype, inputs),
+            _out_struct((b, h, sk + pad_k, d), out_dtype, inputs),
+            _out_struct((b, h, sk + pad_k, d), out_dtype, inputs),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
@@ -463,6 +483,8 @@ def flash_attention_partial_bwd(
         ],
         interpret=interpret,
     )(*inputs)
+    dk_h = dk_h.transpose(0, 2, 1, 3)  # (b, sk_p, h, d)
+    dv_h = dv_h.transpose(0, 2, 1, 3)
 
     if pad_q:
         dq = dq[:, :sq]
