@@ -107,6 +107,59 @@ def test_pallas_backward_matches_dense(b, s, h, kv, d, block):
         )
 
 
+@pytest.mark.parametrize("s", [320, 300])
+def test_multi_kv_block_forward_matches_dense(s):
+    # block_k rounds UP to the 128 lane tile (the kp row-tile constraint),
+    # so every s <= 128 case above runs with a single KV grid step —
+    # multi-KV-block machinery (ik==0 init, exp(m_prev-m_new) correction,
+    # finalize, causal block skip) needs s > 128: 320 -> nk=3 exact,
+    # 300 -> nk=3 through the ragged-padding path.
+    b, h, kv, d = 1, 2, 1, 16
+    q, k, v = _qkv(b, s, h, kv, d, seed=5)
+    dense = causal_attention(q, k, v, scale=d**-0.5)
+    out = flash_attention(q, k, v, block_q=64, block_k=128, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(dense), atol=2e-5)
+
+
+def test_multi_kv_block_pallas_backward_matches_dense():
+    # Cross-KV-block dq accumulation and the dkv pass's multi-q-block loop
+    # (nq=5, nk=3) — see the forward test above for why s must exceed 128.
+    b, s, h, kv, d = 1, 320, 2, 1, 16
+    q, k, v = _qkv(b, s, h, kv, d, seed=6)
+    w = jax.random.normal(jax.random.PRNGKey(13), (b, s, h, d), jnp.float32)
+
+    def loss_pallas(q, k, v):
+        out = flash_attention(
+            q, k, v, block_q=64, block_k=128,
+            interpret=True, use_pallas_bwd=True,
+        )
+        return jnp.sum(out * w)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(causal_attention(q, k, v, scale=d**-0.5) * w)
+
+    g_pallas = jax.grad(loss_pallas, argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for gp, gd, name in zip(g_pallas, g_dense, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(gp), np.asarray(gd), atol=5e-5, err_msg=f"d{name}"
+        )
+
+
+def test_multi_kv_block_partial_matches_dense():
+    # The ring building block with a KV window spanning two 128-blocks.
+    from torchft_tpu.ops.flash_attention import flash_attention_partial
+
+    b, s, h, kv, d = 1, 256, 2, 1, 16
+    q, k, v = _qkv(b, s, h, kv, d, seed=7)
+    pos = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+    out, _lse = flash_attention_partial(
+        q, k, v, pos, pos, block_q=64, block_k=128, interpret=True
+    )
+    dense = causal_attention(q, k, v, scale=d**-0.5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(dense), atol=2e-5)
+
+
 def test_pallas_backward_jits():
     """The whole value_and_grad step jits with the fused backward (the
     shape tested is what the bench's large config uses per block)."""

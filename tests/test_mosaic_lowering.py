@@ -1,0 +1,165 @@
+"""Cross-platform Mosaic lowering gates for every Pallas kernel.
+
+Interpret mode skips Mosaic entirely, so a kernel whose block layout
+violates TPU tiling (last two block dims must be multiple-of-8 /
+multiple-of-128 or the whole array dim) passes every CPU test and then
+fails its first real compile — exactly what happened to the round-1..4
+flash kernels (heads squeezed into second-to-last block position; first
+healthy relay probe rejected all three kernels, 2026-07-31).
+
+jax's AOT path lowers for a TPU target WITHOUT a TPU attached
+(``jit(f).trace(...).lower(lowering_platforms=("tpu",))`` — the
+jax.export mechanism), and Pallas block-mapping validation runs during
+that lowering. These tests pin the Mosaic-visible layout of each kernel
+so the constraint class is caught in the default CPU suite, not on the
+flaky relay. Execution semantics (numerics) stay covered by the
+interpret-mode tests plus verify_on_chip(); this file only proves the
+programs LOWER for real TPU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from torchft_tpu.ops import quantization
+from torchft_tpu.ops.flash_attention import (
+    flash_attention,
+    flash_attention_partial,
+    flash_attention_partial_bwd,
+)
+
+
+def _lower_tpu(fn, *args):
+    """Lower ``fn`` for a TPU target on this CPU-only host; returns the
+    Lowered object (raises ValueError on a Mosaic block-mapping error)."""
+    return jax.jit(fn).trace(*args).lower(lowering_platforms=("tpu",))
+
+
+def _sds(shape, dtype):
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+# (b, s, h, kv_heads, d): verify_on_chip's GQA shape, kernel_bench's MHA
+# shape, and a ragged sequence that exercises the padding path.
+ATTN_SHAPES = [
+    pytest.param(2, 256, 4, 2, 64, id="gqa-256x64"),
+    pytest.param(4, 1024, 8, 8, 128, id="mha-1024x128"),
+    pytest.param(1, 200, 4, 4, 64, id="ragged-200x64"),
+]
+
+
+@pytest.mark.parametrize("b,s,h,kv,d", ATTN_SHAPES)
+def test_flash_forward_lowers_for_tpu(b, s, h, kv, d):
+    q = _sds((b, s, h, d), jnp.bfloat16)
+    k = _sds((b, s, kv, d), jnp.bfloat16)
+    v = _sds((b, s, kv, d), jnp.bfloat16)
+    _lower_tpu(lambda q, k, v: flash_attention(q, k, v, interpret=False), q, k, v)
+
+
+@pytest.mark.parametrize("bq,bk", [(64, 64), (192, 192), (48, 512)])
+def test_flash_forward_lowers_with_non128_blocks(bq, bk):
+    # Public block sizes are rounded internally (block_q to the 16 sublane
+    # tile, block_k to the 128 lane tile the kp row-tile needs) — a
+    # non-128-multiple block_k must not reach Mosaic un-rounded.
+    b, s, h, kv, d = 2, 256, 4, 2, 64
+    q = _sds((b, s, h, d), jnp.bfloat16)
+    k = _sds((b, s, kv, d), jnp.bfloat16)
+    v = _sds((b, s, kv, d), jnp.bfloat16)
+    _lower_tpu(
+        lambda q, k, v: flash_attention(
+            q, k, v, block_q=bq, block_k=bk, interpret=False
+        ),
+        q, k, v,
+    )
+
+
+def test_flash_backward_lowers_for_tpu():
+    b, s, h, kv, d = 2, 256, 4, 2, 64
+    q = _sds((b, s, h, d), jnp.bfloat16)
+    k = _sds((b, s, kv, d), jnp.bfloat16)
+    v = _sds((b, s, kv, d), jnp.bfloat16)
+
+    def loss(q, k, v):
+        out = flash_attention(q, k, v, interpret=False, use_pallas_bwd=True)
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    _lower_tpu(jax.grad(loss, argnums=(0, 1, 2)), q, k, v)
+
+
+def test_flash_partial_and_partial_bwd_lower_for_tpu():
+    # The ring-attention building blocks: a KV block smaller than the
+    # query shard, with explicit (permuted-layout-capable) positions.
+    b, sq, sk, h, kv, d = 1, 256, 128, 4, 2, 64
+    q = _sds((b, sq, h, d), jnp.bfloat16)
+    k = _sds((b, sk, kv, d), jnp.bfloat16)
+    v = _sds((b, sk, kv, d), jnp.bfloat16)
+    qp = _sds((b, sq), jnp.int32)
+    kp = _sds((b, sk), jnp.int32)
+
+    _lower_tpu(
+        lambda q, k, v, qp, kp: flash_attention_partial(
+            q, k, v, qp, kp, interpret=False
+        ),
+        q, k, v, qp, kp,
+    )
+
+    out = _sds((b, sq, h, d), jnp.bfloat16)
+    lse = _sds((b, sq, h), jnp.float32)
+    _lower_tpu(
+        lambda q, k, v, do, out, lse, qp, kp: flash_attention_partial_bwd(
+            q, k, v, do, out, lse, qp, kp,
+            scale=d**-0.5, block_q=128, block_k=128, interpret=False,
+        ),
+        q, k, v, out, out, lse, qp, kp,
+    )
+
+
+@pytest.mark.parametrize("wire", ["fp8", "int8"])
+@pytest.mark.parametrize("n_blocks", [3, 64])
+def test_quant_kernels_lower_for_tpu(wire, n_blocks):
+    # n_blocks=3 pins the rows_per_tile == whole-dim branch of the tiling
+    # rule; 64 pins the multi-tile grid.
+    x = _sds((n_blocks, quantization.BLOCK), jnp.float32)
+    _lower_tpu(
+        lambda x: quantization.quantize_blocks_pallas(
+            x, interpret=False, wire=wire
+        ),
+        x,
+    )
+
+    pdtype = jnp.int8 if wire == "int8" else jnp.float8_e4m3fn
+    payload = _sds((n_blocks, quantization.BLOCK), pdtype)
+    scales = _sds((n_blocks,), jnp.float32)
+    _lower_tpu(
+        lambda p, s: quantization.dequantize_blocks_pallas(
+            p, s, interpret=False
+        ),
+        payload,
+        scales,
+    )
+
+
+def test_lowering_gate_catches_bad_block_layout():
+    """Meta-test: the gate actually fires on the exact constraint class the
+    round-1..4 flash kernels violated (squeezed dim in second-to-last block
+    position). If jax ever stops validating block mappings during
+    cross-platform lowering, this fails and the gate must move on-chip."""
+    from jax.experimental import pallas as pl
+
+    def kern(x_ref, o_ref):
+        o_ref[...] = x_ref[...]
+
+    def bad(x):
+        return pl.pallas_call(
+            kern,
+            grid=(4,),
+            in_specs=[pl.BlockSpec((None, 128, None, 64), lambda i: (0, 0, i, 0))],
+            out_specs=pl.BlockSpec((None, 128, None, 64), lambda i: (0, 0, i, 0)),
+            out_shape=jax.ShapeDtypeStruct((2, 256, 4, 64), jnp.bfloat16),
+        )(x)
+
+    x = _sds((2, 256, 4, 64), jnp.bfloat16)
+    with pytest.raises(ValueError, match="last two dimensions"):
+        _lower_tpu(bad, x)

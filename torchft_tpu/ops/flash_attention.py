@@ -22,7 +22,10 @@ Mosaic cannot express). The scan-based blockwise backward remains the
 interpret/CPU fallback (``use_pallas_bwd`` selects; CPU tests run the
 Pallas backward in interpret mode explicitly). Run :func:`verify_on_chip`
 on a live chip after any kernel change (the CLAUDE.md kernel-verification
-gate — every live-chip bench.py run re-executes it, forward and backward).
+gate — every live-chip bench.py run re-executes it, forward and backward);
+tests/test_mosaic_lowering.py additionally cross-lowers every kernel here
+for a TPU target in the CPU suite, so block-layout violations (the class
+interpret mode cannot see) fail fast without the relay.
 Note "auto" attention (models/llama.py) SELECTS this kernel on real TPU
 for long sequences, so a kernel edit reaches default-configured runs:
 never ship one without the on-chip gate.
@@ -381,10 +384,13 @@ def flash_attention_partial_bwd(
     sk = k.shape[1]
     kv_heads = k.shape[2]
     group = h // kv_heads
-    # Same sublane rounding as every forward entry point: ragged blocks
-    # pass interpret mode but fail Mosaic lowering on real TPU.
+    # Same rounding as every forward entry point — block_q to the 16
+    # sublane tile, block_k to the 128 LANE tile (the kp position row rides
+    # as a (1, block_k) tile whose last dim must be a 128-multiple or the
+    # whole dim): ragged blocks pass interpret mode but fail Mosaic
+    # lowering on real TPU.
     block_q = min(_next_multiple(int(block_q), 16), _next_multiple(sq, 16))
-    block_k = min(_next_multiple(int(block_k), 16), _next_multiple(sk, 16))
+    block_k = min(_next_multiple(int(block_k), 128), _next_multiple(sk, 128))
     if out_dtype is None:
         out_dtype = jnp.float32
 
@@ -572,7 +578,7 @@ def flash_attention_partial(
     if interpret is None:
         interpret = not on_tpu()
     block_q = min(_next_multiple(int(block_q), 16), _next_multiple(sq, 16))
-    block_k = min(_next_multiple(int(block_k), 16), _next_multiple(k.shape[1], 16))
+    block_k = min(_next_multiple(int(block_k), 128), _next_multiple(k.shape[1], 128))
     out, lse = _flash_fwd(
         q, k, v, float(scale), block_q, block_k, bool(interpret),
         q_positions=q_positions, k_positions=k_positions,
@@ -634,12 +640,15 @@ def flash_attention(
         interpret = not on_tpu()
     if use_pallas_bwd is None:
         use_pallas_bwd = not interpret
-    # Align the block size itself (not just the clamp bound) to a multiple
-    # of 16 — the sublane tile for bf16 (and a multiple of f32's 8) — then
-    # clamp oversized blocks to the padded sequence. A ragged block would
-    # pass interpret-mode tests and fail Mosaic lowering on the chip.
+    # Align the block sizes themselves (not just the clamp bounds):
+    # block_q to 16 — the bf16 sublane tile (and a multiple of f32's 8);
+    # block_k to 128 — the LANE tile, because the kp position row rides as
+    # a (1, block_k) block whose last dim must be a 128-multiple or the
+    # whole padded dim. Then clamp oversized blocks to the padded sequence.
+    # A ragged block would pass interpret-mode tests and fail Mosaic
+    # lowering on the chip (tests/test_mosaic_lowering.py pins this).
     block_q = min(_next_multiple(int(block_q), 16), _next_multiple(s, 16))
-    block_k = min(_next_multiple(int(block_k), 16), _next_multiple(s, 16))
+    block_k = min(_next_multiple(int(block_k), 128), _next_multiple(s, 128))
     return _flash_core(
         q, k, v, float(scale), int(block_q), int(block_k), bool(interpret),
         bool(use_pallas_bwd),
