@@ -76,6 +76,20 @@ def _rechain(args, out):
     return args
 
 
+def bench_dispatch_floor(results: list) -> None:
+    """Per-iteration cost of a trivial jitted op, timed with the identical
+    chained-fetch schedule: the tunnel/dispatch floor every row below pays.
+    On this machine it measures ~8 ms — rows whose kernel time is near the
+    floor are comparing dispatch latency, not kernels (the r04 capture's
+    s=1024 rows showed flash and dense both at exactly 8.0 ms)."""
+    x = jax.random.normal(jax.random.PRNGKey(9), (128, 128), jnp.float32)
+    tiny = jax.jit(lambda x: x * 1.0000001)
+    t = _timed(tiny, x)
+    row = {"bench": "dispatch_floor", "floor_ms": round(1e3 * t, 3)}
+    results.append(row)
+    print(json.dumps(row))
+
+
 def bench_attention(results: list) -> None:
     from torchft_tpu.models.llama import causal_attention
     from torchft_tpu.ops.flash_attention import flash_attention
@@ -112,31 +126,45 @@ def bench_attention(results: list) -> None:
 
         # fwd+bwd through the kernel's custom VJP: the default on-chip path
         # (fused Pallas dq/dkv backward), the scan-based blockwise backward
-        # it replaced, and dense.
-        def loss_flash(q, k, v):
-            return flash_attention(q, k, v, interpret=False).astype(jnp.float32).sum()
+        # it replaced, and dense. The loss is a dot with a RANDOM cotangent
+        # (passed as an argument, not a closed-over constant): a plain
+        # ``out.sum()`` makes dO all-ones, which XLA's algebraic simplifier
+        # exploits to collapse much of the dense backward — the r04 capture
+        # measured dense fwd+bwd at s=8192 "running" in 71 ms while dense
+        # fwd ALONE OOM'd, i.e. the baseline wasn't doing the work. A
+        # custom-VJP kernel sees dO as opaque either way, so the old loss
+        # biased every speedup_vs_dense down.
+        r = jax.random.normal(jax.random.PRNGKey(2), (b, s, h, d), jnp.float32)
 
-        def loss_flash_scan_bwd(q, k, v):
-            return (
-                flash_attention(q, k, v, interpret=False, use_pallas_bwd=False)
-                .astype(jnp.float32)
-                .sum()
+        def loss_flash(q, k, v, r):
+            return jnp.vdot(
+                flash_attention(q, k, v, interpret=False).astype(jnp.float32), r
             )
 
-        def loss_dense(q, k, v):
-            return causal_attention(q, k, v, scale=d**-0.5).astype(jnp.float32).sum()
+        def loss_flash_scan_bwd(q, k, v, r):
+            return jnp.vdot(
+                flash_attention(
+                    q, k, v, interpret=False, use_pallas_bwd=False
+                ).astype(jnp.float32),
+                r,
+            )
+
+        def loss_dense(q, k, v, r):
+            return jnp.vdot(
+                causal_attention(q, k, v, scale=d**-0.5).astype(jnp.float32), r
+            )
 
         gflash = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))
         gscan = jax.jit(jax.grad(loss_flash_scan_bwd, argnums=(0, 1, 2)))
         gdense = jax.jit(jax.grad(loss_dense, argnums=(0, 1, 2)))
-        t_gflash = _timed(gflash, q, k, v, fetch=lambda g: g[0])
+        t_gflash = _timed(gflash, q, k, v, r, fetch=lambda g: g[0])
         try:
-            t_gscan = _timed(gscan, q, k, v, fetch=lambda g: g[0])
+            t_gscan = _timed(gscan, q, k, v, r, fetch=lambda g: g[0])
         except Exception as e:
             sys.stderr.write(f"kernel_bench: scan bwd s={s} failed: {e}\n")
             t_gscan = None
         try:
-            t_gdense = _timed(gdense, q, k, v, fetch=lambda g: g[0])
+            t_gdense = _timed(gdense, q, k, v, r, fetch=lambda g: g[0])
         except Exception as e:
             sys.stderr.write(f"kernel_bench: dense fwd+bwd s={s} failed: {e}\n")
             t_gdense = None
@@ -192,6 +220,7 @@ def main() -> None:
         )
         sys.exit(1)
     results: list = []
+    bench_dispatch_floor(results)
     bench_attention(results)
     bench_fp8_codec(results)
     print(

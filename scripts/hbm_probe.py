@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""AOT-compile candidate bench configs for the attached TPU and report HBM.
+
+The ~400M MFU config OOM'd on the real chip (TPU v5 lite, 15.75 GB HBM:
+29.26 GB program at batch 8, no remat — sentinel.log 2026-07-31). The relay's
+compile helper does full chipless AOT compilation, so candidate (batch,
+remat) points can be sized in seconds without burning the execution window.
+
+Usage: python scripts/hbm_probe.py batch=4,remat=dots [batch=2,remat=none ...]
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def probe(batch: int, remat: str, seq: int = 2048) -> None:
+    from torchft_tpu.models.llama import Llama, LlamaConfig
+
+    config = LlamaConfig(
+        vocab_size=32768,
+        dim=1024,
+        n_layers=24,
+        n_heads=16,
+        n_kv_heads=8,
+        ffn_hidden=4096,
+        max_seq_len=seq,
+        dtype=jnp.bfloat16,
+        attention_impl="flash",
+        scan_layers=True,
+        loss_vocab_chunk=4096,
+        remat=remat,
+    )
+    model = Llama(config)
+    tokens = jnp.zeros((batch, seq + 1), dtype=jnp.int32)
+    params = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0), tokens[:, :seq])
+    )
+    tx = optax.sgd(0.01, momentum=0.9)
+    opt_state = jax.eval_shape(lambda: tx.init(params))
+
+    def loss_fn(p, batch_tokens):
+        return model.apply(p, batch_tokens[:, :-1], targets=batch_tokens[:, 1:])
+
+    def step(p, o, batch_tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(p, batch_tokens)
+        updates, o = tx.update(grads, o, p)
+        return optax.apply_updates(p, updates), o, loss
+
+    label = f"batch={batch} remat={remat} seq={seq}"
+    try:
+        lowered = jax.jit(step).lower(params, opt_state, tokens)
+        compiled = lowered.compile()
+    except Exception as exc:  # OOM arrives as a compile error with the budget
+        msg = str(exc)
+        line = next(
+            (l for l in msg.splitlines() if "hbm" in l.lower() and "used" in l.lower()),
+            msg.splitlines()[0] if msg else "?",
+        )
+        print(f"[hbm_probe] {label}: FAIL — {line.strip()}", flush=True)
+        return
+    try:
+        mem = compiled.memory_analysis()
+        print(f"[hbm_probe] {label}: OK — {mem}", flush=True)
+    except Exception:
+        print(f"[hbm_probe] {label}: OK (no memory_analysis available)", flush=True)
+
+
+def main() -> None:
+    for spec in sys.argv[1:] or ["batch=4,remat=dots"]:
+        kv = dict(part.split("=") for part in spec.split(","))
+        probe(
+            batch=int(kv.get("batch", 4)),
+            remat=kv.get("remat", "dots"),
+            seq=int(kv.get("seq", 2048)),
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -229,3 +229,40 @@ def test_fp8_wire_worker_cached_per_manager_and_released_on_shutdown():
     manager.shutdown(wait=False)
     with pytest.raises(RuntimeError):  # executor refused after shutdown
         w1.submit(lambda: 0)
+
+@pytest.mark.parametrize("strict", [False, True])
+def test_make_step_fn_commit_sync_ordering(monkeypatch, strict):
+    """Default: the lone-replica fused step launches the commit barrier
+    BEFORE the device readiness wait so the RPC rides under it (on a
+    high-latency device link the serialized order costs a full extra round
+    trip per step). TPUFT_STRICT_COMMIT=1 restores the reference's strict
+    ordering — vote only after observed completion (manager.py:816-827) —
+    and must sync before the vote leaves."""
+    import torchft_tpu.optim as optim_mod
+
+    monkeypatch.setenv("TPUFT_STRICT_COMMIT", "1" if strict else "0")
+    manager = scripted_manager()
+    tx = optax.sgd(0.1)
+    params = {"w": jnp.array([1.0, 1.0], jnp.float32)}
+    opt = Optimizer(manager, tx, params)
+
+    events = []
+    real_sync = optim_mod.jax.block_until_ready
+    real_async = manager.should_commit_async
+
+    def spy_sync(x):
+        events.append("sync")
+        return real_sync(x)
+
+    def spy_async(timeout=None):
+        events.append("vote")
+        return real_async(timeout)
+
+    monkeypatch.setattr(optim_mod.jax, "block_until_ready", spy_sync)
+    manager.should_commit_async = spy_async
+
+    step_fn = opt.make_step_fn(lambda p, b: jnp.sum(p["w"] * b))
+    _, committed = step_fn(jnp.array([1.0, 2.0], jnp.float32))
+    assert committed
+    want = ["sync", "vote"] if strict else ["vote", "sync"]
+    assert events == want

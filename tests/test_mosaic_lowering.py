@@ -117,10 +117,12 @@ def test_flash_partial_and_partial_bwd_lower_for_tpu():
 
 
 @pytest.mark.parametrize("wire", ["fp8", "int8"])
-@pytest.mark.parametrize("n_blocks", [3, 64])
+@pytest.mark.parametrize("n_blocks", [3, 64, 1500, 2048])
 def test_quant_kernels_lower_for_tpu(wire, n_blocks):
     # n_blocks=3 pins the rows_per_tile == whole-dim branch of the tiling
-    # rule; 64 pins the multi-tile grid.
+    # rule; 64 pins whole-dim above the old 8-row tiles; 1500 pins the
+    # RAGGED 1024-row grid (a partial final tile — the common shape for
+    # arbitrary gradient sizes) and 2048 the exact-multiple grid.
     x = _sds((n_blocks, quantization.BLOCK), jnp.float32)
     _lower_tpu(
         lambda x: quantization.quantize_blocks_pallas(

@@ -85,11 +85,17 @@ def test_pack_unpack_roundtrip(wire) -> None:
 
 
 @pytest.mark.parametrize("wire", ["fp8", "int8"])
-def test_pallas_quantize_matches_numpy(wire) -> None:
+@pytest.mark.parametrize("n_blocks", [8, 1500])
+def test_pallas_quantize_matches_numpy(wire, n_blocks) -> None:
+    # n_blocks=8 is a single whole-dim tile; 1500 forces the ragged
+    # 1024-row grid (partial final tile) the retiled kernels use for
+    # arbitrary gradient sizes -- numeric proof that padded rows never
+    # bleed into real rows' scales/payload (the lowering gate only proves
+    # the shape compiles).
     import jax.numpy as jnp
 
     rng = np.random.default_rng(3)
-    x = rng.normal(size=(8, q.BLOCK)).astype(np.float32) * 5
+    x = rng.normal(size=(n_blocks, q.BLOCK)).astype(np.float32) * 5
     payload_np, scales_np = q.quantize_blocks(x.reshape(-1), wire=wire)
     payload_pl, scales_pl = q.quantize_blocks_pallas(
         jnp.asarray(x), interpret=True, wire=wire

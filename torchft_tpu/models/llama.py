@@ -66,6 +66,11 @@ class LlamaConfig:
     attention_impl: str = "auto"
     sp_axis: str = "sp"
     attention_block_size: int = 512
+    # KV-block length for the flash path only (the kernel's sequential
+    # accumulation axis). The on-chip sweep (scripts/flash_block_sweep.py,
+    # TPU v5 lite) puts the knee at 512x1024: vs 512x512 the s=8192
+    # fwd+bwd drops 47.2 -> 37.9 ms. None = attention_block_size.
+    attention_block_k: Optional[int] = 1024
     # Route the ring path's per-hop block compute through the fused Pallas
     # kernel (ops/flash_attention.py) instead of the jnp scan update.
     ring_use_flash: bool = False
@@ -235,7 +240,7 @@ class Attention(nn.Module):
             out = flash_attention(
                 q, k, v, scale=scale,
                 block_q=cfg.attention_block_size,
-                block_k=cfg.attention_block_size,
+                block_k=cfg.attention_block_k or cfg.attention_block_size,
             )
         elif cfg.attention_impl == "blockwise" or (
             cfg.attention_impl == "auto" and x.shape[1] >= cfg.blockwise_min_seq

@@ -27,6 +27,7 @@ re-read any registered state *after* it returns.
 from __future__ import annotations
 
 import logging
+import os
 from typing import Any, Optional
 
 import jax
@@ -431,7 +432,50 @@ class Optimizer:
                 loss, spec_params, spec_opt_state = fused(
                     self.params, self.opt_state, *batch
                 )
-                jax.block_until_ready(loss)
+                # Launch the barrier BEFORE the device sync so the commit
+                # RPC rides under the readiness wait instead of after it
+                # (on a high-latency device link the sync alone costs a
+                # full round trip — ~70 ms on this machine's tunnel — so
+                # serializing sync -> RPC was pure addition). This widens
+                # .step()'s accepted envelope slightly: .step() bounds the
+                # GRADS pre-vote and risks only a host-side dispatch
+                # failure post-vote, while here a device-side failure of
+                # the whole fused step can land after the vote was sent.
+                # The blast radius in this LONE topology is bounded
+                # accounting, not divergence: there is no peer to diverge
+                # from, and recovery is the same supervisor-restart path
+                # .step() documents — the committed counter can run one
+                # step ahead of the restored state (a phantom commit).
+                # Deployments that prefer the strict reference ordering
+                # (vote only after observed completion; reference
+                # manager.py:816-827) set TPUFT_STRICT_COMMIT=1 and pay
+                # the serialized sync; a sync failure then raises before
+                # any vote leaves, the pre-change semantics exactly.
+                strict = os.environ.get("TPUFT_STRICT_COMMIT", "0") == "1"
+                if strict:
+                    jax.block_until_ready(loss)
+                commit_future = self.manager.should_commit_async(None)
+                if not strict:
+                    try:
+                        jax.block_until_ready(loss)
+                    except BaseException:
+                        try:
+                            barrier_result = commit_future.result()
+                        except Exception:
+                            logger.exception(
+                                "commit barrier also failed while handling a "
+                                "fused-step sync failure; barrier outcome lost "
+                                "to the re-raise"
+                            )
+                        else:
+                            logger.error(
+                                "fused step sync failed with the commit barrier "
+                                "in flight; barrier resolved committed=%s (a "
+                                "committed step here advanced the step counter "
+                                "without its update)",
+                                barrier_result,
+                            )
+                        raise
 
                 def recompute():
                     # Same semantics as :meth:`step` (and the reference's
@@ -442,7 +486,8 @@ class Optimizer:
                     return self._jit_update(grads, self.opt_state, self.params)
 
                 committed = self._commit_and_adopt(
-                    heal_count, (spec_params, spec_opt_state), recompute, None
+                    heal_count, (spec_params, spec_opt_state), recompute, None,
+                    commit_future=commit_future,
                 )
                 return loss, committed
             loss, grads = grad_fn(self.params, *batch)
