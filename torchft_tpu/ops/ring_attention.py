@@ -547,8 +547,8 @@ def ring_attention_flash(
     scale: Optional[float] = None,
     q_positions: Optional[jnp.ndarray] = None,
     k_positions: Optional[jnp.ndarray] = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 512,
+    block_k: int = 1024,
     interpret: Optional[bool] = None,
     use_pallas_bwd: Optional[bool] = None,
 ) -> jnp.ndarray:
@@ -556,7 +556,10 @@ def ring_attention_flash(
     block compute (ops/flash_attention.py): K/V still rotate over
     ``axis_name`` via ppermute, but each hop's online-softmax inner loop
     runs as one kernel with VMEM-resident accumulators, and hops merge by
-    logsumexp. Same shapes/semantics as :func:`ring_attention`. The
+    logsumexp. Default blocks follow the flash kernel's on-chip sweep
+    (512x1024, see flash_attention's docstring); the kernel entry points
+    clamp them to each hop's padded local lengths, so small shards are
+    unaffected. Same shapes/semantics as :func:`ring_attention`. The
     backward is a true ring backward from the saved (out, lse); on TPU
     (``use_pallas_bwd=None`` → when the forward compiles) each hop runs
     the fused dq/dkv kernels (flash_attention_partial_bwd), with the
