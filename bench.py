@@ -207,34 +207,19 @@ def main() -> None:
         # driver default (remote compiles alone run minutes). Like the
         # degraded branch, this supersedes an explicit TPUFT_BENCH_SEQ —
         # the workload is part of the named config.
-        SEQ = 2048
+        # The ~445M flagship config: ONE definition shared with the HBM
+        # probe, compile bench, and Mosaic cross-lowering gate — every
+        # sizing and geometry decision (batch 4 + dots-remat for the
+        # 15.75 GB HBM budget; 8x128 heads so the MXU isn't starved) is
+        # an on-chip measurement documented on the factory. dots-remat
+        # recomputes only elementwise ops and MFU counts 6N model FLOPs
+        # either way, so the datum stays honest — the recompute cost
+        # lands in the measured step time.
+        from torchft_tpu.models.llama import large_bench_config
+
         BATCH = 4
-        config = LlamaConfig(
-            vocab_size=32768,
-            dim=1024,
-            n_layers=24,
-            n_heads=16,
-            n_kv_heads=8,
-            ffn_hidden=4096,
-            max_seq_len=SEQ,
-            dtype=jnp.bfloat16,
-            attention_impl="flash",
-            # Sized for the attached chip's measured HBM budget (TPU v5
-            # lite, 15.75 GB): batch 8 / no remat needs 29.26 GB and even
-            # batch 4 / no remat misses by 245 MB, while batch 4 +
-            # checkpoint_dots compiles to 5.77 GB of temps (scripts/
-            # hbm_probe.py, chipless AOT numbers from the real TPU
-            # compiler) — leaving headroom for the FT phases, which
-            # materialize a grads-sized output the fused plain step
-            # doesn't. dots-remat recomputes only elementwise ops (dot
-            # outputs are saved), and MFU counts 6N model FLOPs either
-            # way, so the datum stays honest — the recompute cost lands in
-            # the measured step time. The fused CE removes the 2 GiB f32
-            # logits without changing counted FLOPs.
-            scan_layers=True,
-            loss_vocab_chunk=4096,
-            remat="dots",
-        )
+        config = large_bench_config()
+        SEQ = config.max_seq_len
         sync_every_cap = 10**9
     else:
         config = LlamaConfig(

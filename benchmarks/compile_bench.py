@@ -89,15 +89,15 @@ def _measure_memory(config, batch: int = 4, seq: int = 1024) -> dict:
 
 
 def main() -> None:
-    from torchft_tpu.models.llama import LlamaConfig
+    from torchft_tpu.models.llama import large_bench_config
 
     out = sys.argv[1] if len(sys.argv) > 1 else "SCAN_COMPILE_BENCH.json"
-    # The bench 'large' dims; seq 512 keeps the 1-core XLA compile
-    # tractable (rows record it — program size scaling with DEPTH is the
-    # claim, and depth is what varies).
-    base = LlamaConfig(
-        vocab_size=32768, dim=1024, n_layers=24, n_heads=16, n_kv_heads=8,
-        ffn_hidden=4096, max_seq_len=2048, dtype=jnp.bfloat16,
+    # The bench 'large' dims from the SHARED flagship definition, with
+    # the features this bench measures (scan_layers, remat, fused CE)
+    # reset to off so each _measure variant can flip them individually.
+    base = large_bench_config(
+        attention_impl="auto", scan_layers=False, loss_vocab_chunk=None,
+        remat="none",
     )
     results = {"device_kind": jax.devices()[0].platform, "rows": []}
     for n_layers in (6, 12, 24):

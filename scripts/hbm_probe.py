@@ -22,22 +22,11 @@ import optax
 
 
 def probe(batch: int, remat: str, seq: int = 2048) -> None:
-    from torchft_tpu.models.llama import Llama, LlamaConfig
+    from torchft_tpu.models.llama import Llama, large_bench_config
 
-    config = LlamaConfig(
-        vocab_size=32768,
-        dim=1024,
-        n_layers=24,
-        n_heads=16,
-        n_kv_heads=8,
-        ffn_hidden=4096,
-        max_seq_len=seq,
-        dtype=jnp.bfloat16,
-        attention_impl="flash",
-        scan_layers=True,
-        loss_vocab_chunk=4096,
-        remat=remat,
-    )
+    # The SHARED flagship config (one definition with bench.py and the
+    # lowering gate), with the probe's sweep axes overridden.
+    config = large_bench_config(max_seq_len=seq, remat=remat)
     model = Llama(config)
     tokens = jnp.zeros((batch, seq + 1), dtype=jnp.int32)
     params = jax.eval_shape(

@@ -144,13 +144,15 @@ def test_quant_kernels_lower_for_tpu(wire, n_blocks):
 
 
 def test_flagship_flash_train_step_lowers_for_tpu(monkeypatch):
-    """Cross-lower the FULL ~400M large-bench train step (scan llama +
-    Pallas flash fwd/bwd + fused CE + sgd update) for a TPU target — the
-    integration-level version of the kernel gates above. bench.py's
-    tpu-large attempt compiles exactly this program shape on the chip
-    (TPUFT_BENCH_MODEL=large, bench.py:203-228); a lowering regression
-    anywhere in that stack fails here instead of burning a relay window.
-    Everything is abstract (jax.eval_shape) — no 400M params materialize.
+    """Cross-lower the FULL ~445M large-bench train step (scan llama +
+    dots-remat + Pallas flash fwd/bwd + fused CE + sgd update) for a TPU
+    target — the integration-level version of the kernel gates above.
+    bench.py's tpu-large attempt compiles exactly this program shape on
+    the chip (TPUFT_BENCH_MODEL=large; the config comes from the shared
+    ``large_bench_config()`` so the gate cannot drift from the bench);
+    a lowering regression anywhere in that stack fails here instead of
+    burning a relay window. Everything is abstract (jax.eval_shape) —
+    no 445M params materialize.
     """
     import optax
 
@@ -164,12 +166,11 @@ def test_flagship_flash_train_step_lowers_for_tpu(monkeypatch):
     monkeypatch.setattr(fa_mod, "on_tpu", lambda: True)
     monkeypatch.setattr(llama_mod, "on_tpu", lambda: True)
 
-    seq = 2048
-    config = LlamaConfig(
-        vocab_size=32768, dim=1024, n_layers=24, n_heads=16, n_kv_heads=8,
-        ffn_hidden=4096, max_seq_len=seq, dtype=jnp.bfloat16,
-        attention_impl="flash", scan_layers=True, loss_vocab_chunk=4096,
-    )
+    # The SHARED flagship definition: the gate must lower exactly the
+    # program bench.py's large mode runs (a copied config drifted when
+    # the head geometry was retuned — review finding, round 5).
+    config = llama_mod.large_bench_config()
+    seq = config.max_seq_len
     model = Llama(config)
     tx = optax.sgd(0.01, momentum=0.9)
     tokens = _sds((1, seq + 1), jnp.int32)

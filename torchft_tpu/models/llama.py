@@ -24,7 +24,7 @@ TPU-first choices:
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, replace
 from functools import partial
 from typing import Any, Dict, Optional, Tuple
 
@@ -39,6 +39,7 @@ __all__ = [
     "LlamaConfig",
     "Llama",
     "CONFIGS",
+    "large_bench_config",
     "sharding_plan",
     "cross_entropy_loss",
 ]
@@ -132,6 +133,43 @@ CONFIGS: Dict[str, LlamaConfig] = {
         ffn_hidden=28672, max_seq_len=8192,
     ),
 }
+
+
+def large_bench_config(**overrides) -> LlamaConfig:
+    """The ~445M-parameter flagship benchmark config — the ONE definition.
+
+    Shared by bench.py's ``TPUFT_BENCH_MODEL=large`` run, the chipless
+    HBM sizing probe (scripts/hbm_probe.py), the compile-cost bench
+    (benchmarks/compile_bench.py base dims), and the Mosaic
+    cross-lowering gate (tests/test_mosaic_lowering.py) so the gate and
+    probes always track the config the bench actually runs — the config
+    used to be copied verbatim into all four files, and a retune in one
+    silently drifted the other three.
+
+    The choices are on-chip measurements (TPU v5 lite, 2026-07-31):
+
+    - head geometry 8x128, not 16x64: identical params and FLOPs at
+      dim 1024, but the 64-wide heads starve the 128-lane MXU —
+      measured 306 -> 214 ms/step (1.43x) at batch 4 x seq 2048, i.e.
+      43.5% -> 63.1% MFU under the bench's 6N + 12*L*d*s accounting
+      (BENCH_TPU_LARGE.json).
+    - remat="dots" + batch 4: the 15.75 GB HBM budget, sized by
+      chipless AOT compiles (scripts/hbm_probe.py) — batch 8 without
+      remat needs ~29 GB.
+    - flash attention + scanned layers + fused CE: the long-sequence
+      kernel path, O(1) HLO in depth, and no materialized logits.
+
+    ``overrides`` are dataclasses.replace fields (the compile bench
+    flips scan_layers/remat to measure their cost; the HBM probe sweeps
+    remat and sequence length).
+    """
+    base = LlamaConfig(
+        vocab_size=32768, dim=1024, n_layers=24, n_heads=8, n_kv_heads=4,
+        ffn_hidden=4096, max_seq_len=2048, dtype=jnp.bfloat16,
+        attention_impl="flash", scan_layers=True, loss_vocab_chunk=4096,
+        remat="dots",
+    )
+    return replace(base, **overrides) if overrides else base
 
 
 def _rope_freqs(head_dim: int, theta: float) -> jnp.ndarray:
