@@ -31,7 +31,8 @@ KNOWN_ENV = {
     "TPUFT_JAX_COORDINATOR", "TPUFT_TCP_RING_MIN_MB", "TPUFT_TRACE_LOG",
     "TPUFT_NATIVE_LIB", "TPUFT_ALLOW_UNSAFE_PICKLE", "TPUFT_SOAK",
     "TPUFT_FLIGHT_RECORDER", "TPUFT_FLIGHT_RECORDER_SIZE",
-    "TPUFT_HEARTBEAT_INTERVAL", "TPUFT_INIT_SYNC", "TPUFT_BENCH_CHILD",
+    "TPUFT_HEARTBEAT_INTERVAL", "TPUFT_INIT_SYNC", "TPUFT_STRICT_COMMIT",
+    "TPUFT_BENCH_CHILD",
     "TPUFT_BENCH_MODEL", "TPUFT_BENCH_STEPS", "TPUFT_BENCH_BATCH",
     "TPUFT_BENCH_SEQ", "TPUFT_BENCH_SYNC_EVERY", "TPUFT_BENCH_SYNC_DELAY",
     "TPUFT_BENCH_TPU_DEADLINE", "TPUFT_BENCH_TPU_DEADLINE_LARGE",
