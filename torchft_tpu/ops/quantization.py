@@ -438,10 +438,7 @@ def verify_on_chip() -> dict:
 
         python -c "from torchft_tpu.ops.quantization import verify_on_chip; print(verify_on_chip())"
     """
-    import functools
-
     import jax
-    import jax.numpy as jnp
     import numpy as np
 
     dev = jax.devices()[0]
@@ -449,17 +446,34 @@ def verify_on_chip() -> dict:
         raise RuntimeError(f"no TPU attached (devices()[0] is {dev})")
 
     # Ragged length forces the padding path; mixed magnitudes + an all-zero
-    # block exercise the scale selection.
+    # block exercise the scale selection. The second, larger length lands
+    # on 1200 blocks — past _ROWS_PER_TILE with a partial final grid tile —
+    # so the retiled kernels' ragged-grid branch is numerically verified on
+    # the compiled Mosaic path too, not just interpret mode + the chipless
+    # lowering gate.
     rng = np.random.default_rng(0)
-    host = np.concatenate(
+    host_small = np.concatenate(
         [
             rng.normal(0, 3.0, 700).astype(np.float32),
             np.zeros(BLOCK, np.float32),
             rng.normal(0, 1e-4, 500).astype(np.float32),
         ]
     )
-    x = jnp.asarray(host)
+    host_ragged = rng.normal(0, 2.0, 1200 * BLOCK - 37).astype(np.float32)
     result: dict = {"ok": True}
+    for host in (host_small, host_ragged):
+        _verify_roundtrips(host, result)
+    return result
+
+
+def _verify_roundtrips(host, result: dict) -> None:
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    x = jnp.asarray(host)
     for wire in _WIRE_NP_DTYPES:
         payload, scales = jax.jit(
             functools.partial(quantize_blocks_device, wire=wire)
@@ -491,6 +505,7 @@ def verify_on_chip() -> dict:
             raise AssertionError(
                 f"device {wire} payload diverges from host decode: {err_mixed}"
             )
+        # Last pass wins (the ragged multi-tile case): both passes must
+        # clear the assertions above either way.
         result[f"{wire}_max_err"] = err_chip
         result[f"{wire}_host_err"] = err_host
-    return result
