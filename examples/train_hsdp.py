@@ -111,7 +111,9 @@ def train(args: argparse.Namespace) -> None:
     )
     t_start = time.monotonic()
     try:
-        with ft_mesh.mesh:
+        # set_mesh (not a legacy `with mesh:`) so the flash path can
+        # shard_map itself under the fsdp/tp axes on real TPU.
+        with jax.set_mesh(ft_mesh.mesh):
             while manager.current_step() < args.steps:
                 step = manager.current_step()
                 key = jax.random.PRNGKey(5000 * group_id + step)

@@ -507,3 +507,92 @@ def test_all_fit_levers_compose_in_one_step() -> None:
     # full-batch fused loss (equal chunks -> mean-of-means == mean).
     full_loss = model.apply(params, tokens[:, :-1], targets=tokens[:, 1:])
     np.testing.assert_allclose(float(loss), float(full_loss), rtol=1e-5)
+
+
+def test_flash_shard_maps_itself_under_ambient_mesh(monkeypatch):
+    """Under a bound mesh (jax.set_mesh — the sharded-train-step context)
+    the flash dispatcher must shard_map the Pallas kernel over the
+    batch/head axes itself: XLA SPMD refuses to partition Mosaic custom
+    calls, so the bare kernel call fails to lower inside jit-with-mesh
+    (test_mosaic_lowering.py's 8B gate pins the lowering half; this test
+    pins numerics — the mapped kernel must match dense attention
+    exactly where each (batch, head) shard computes independently)."""
+    from torchft_tpu.models.llama import (
+        _flash_under_ambient_mesh, causal_attention,
+    )
+
+    cfg = replace(
+        CONFIGS["tiny"], attention_impl="flash",
+        flash_batch_axes=("dp", "fsdp"), flash_tp_axis="tp",
+    )
+    b, s, h, kv, d = 4, 128, 4, 2, 64
+    kq, kk, kvk = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(kq, (b, s, h, d), jnp.float32)
+    k = jax.random.normal(kk, (b, s, kv, d), jnp.float32)
+    v = jax.random.normal(kvk, (b, s, kv, d), jnp.float32)
+
+    mesh = jax.make_mesh((4, 2), ("fsdp", "tp"))
+    with jax.set_mesh(mesh):
+        out = jax.jit(
+            lambda q, k, v: _flash_under_ambient_mesh(cfg, q, k, v, d**-0.5)
+        )(q, k, v)
+    ref = causal_attention(q, k, v, scale=d**-0.5)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+    # Non-dividing dims must still compute correctly: the axes stay
+    # manual (a bare pallas_call under the mesh is the lowering error
+    # this wrapper avoids) but drop out of the specs, replicating the
+    # kernel over them — 3 batch rows over fsdp=4 and 3 q-heads over
+    # tp=2.
+    q3 = jax.random.normal(kq, (3, s, 3, d), jnp.float32)
+    k3 = jax.random.normal(kk, (3, s, 3, d), jnp.float32)
+    with jax.set_mesh(mesh):
+        out3 = jax.jit(
+            lambda q, k, v: _flash_under_ambient_mesh(cfg, q, k, v, d**-0.5)
+        )(q3, k3, k3)
+    ref3 = causal_attention(q3, k3, k3, scale=d**-0.5)
+    np.testing.assert_allclose(
+        np.asarray(out3), np.asarray(ref3), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_flash_dispatcher_is_inert_inside_callers_shard_map():
+    """Inside a caller's shard_map the fsdp/tp axes are Manual and shapes
+    are already per-shard local: the dispatcher must use the plain kernel
+    call (a nested map over local shapes would mis-divide them — caught
+    by comparing AxisType.Manual, which its first version string-compared
+    wrong)."""
+    from torchft_tpu.models.llama import (
+        _flash_under_ambient_mesh, causal_attention,
+    )
+
+    cfg = replace(CONFIGS["tiny"], attention_impl="flash")
+    b, s, h, kv, d = 8, 128, 4, 2, 64
+    kq, kk, kvk = jax.random.split(jax.random.PRNGKey(1), 3)
+    q = jax.random.normal(kq, (b, s, h, d), jnp.float32)
+    k = jax.random.normal(kk, (b, s, kv, d), jnp.float32)
+    v = jax.random.normal(kvk, (b, s, kv, d), jnp.float32)
+
+    mesh = jax.make_mesh((4, 2), ("fsdp", "tp"))
+    # kv heads shard over tp like q heads — splitting only q heads would
+    # break the GLOBAL GQA pairing inside each shard (the dispatcher's
+    # own mapped path uses the same paired layout for exactly this
+    # reason).
+    spec = P("fsdp", None, "tp", None)
+    out = jax.jit(
+        jax.shard_map(
+            lambda q, k, v: _flash_under_ambient_mesh(cfg, q, k, v, d**-0.5),
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            check_vma=False,
+        )
+    )(q, k, v)
+    # Each (batch, head) shard attends independently over the full local
+    # sequence, so the mapped result equals unsharded dense attention.
+    ref = causal_attention(q, k, v, scale=d**-0.5)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )

@@ -41,6 +41,8 @@ __all__ = [
     "CONFIGS",
     "large_bench_config",
     "sharding_plan",
+    "plan_shardings",
+    "apply_sharding_plan",
     "cross_entropy_loss",
 ]
 
@@ -72,6 +74,15 @@ class LlamaConfig:
     # TPU v5 lite) puts the knee at 512x1024: vs 512x512 the s=8192
     # fwd+bwd drops 47.2 -> 37.9 ms. None = attention_block_size.
     attention_block_k: Optional[int] = 1024
+    # Mosaic kernels cannot be auto-partitioned by XLA SPMD: under a
+    # jit-with-mesh (fsdp/tp/dp sharded train step) the flash path must
+    # shard_map ITSELF or lowering fails outright. These name the mesh
+    # axes it maps over when the ambient mesh binds them (batch over the
+    # data axes, q/kv heads over the tensor axis — the megatron layout
+    # sharding_plan uses); axes that are absent, size-1, already manual,
+    # or non-dividing are dropped per-call.
+    flash_batch_axes: Tuple[str, ...] = ("dp", "fsdp")
+    flash_tp_axis: Optional[str] = "tp"
     # Route the ring path's per-hop block compute through the fused Pallas
     # kernel (ops/flash_attention.py) instead of the jnp scan update.
     ring_use_flash: bool = False
@@ -220,6 +231,93 @@ def _sp_axis_in_mesh(axis: str) -> bool:
     return abstract.shape[axis] > 1
 
 
+def _flash_under_ambient_mesh(cfg: LlamaConfig, q, k, v, scale: float):
+    """Dispatches the fused Pallas kernel, shard_mapping it over the
+    ambient mesh's data/tensor axes when one is bound.
+
+    XLA SPMD cannot partition a Mosaic custom call ("Mosaic kernels
+    cannot be automatically partitioned") — so inside a sharded train
+    step (jit with a NamedSharding mesh: the FTMesh/HSDP path) a bare
+    ``flash_attention`` fails to lower. Attention is embarrassingly
+    parallel over (batch, head) in the non-SP case, so the wrapper maps
+    batch over ``cfg.flash_batch_axes`` and heads over
+    ``cfg.flash_tp_axis`` — the same layout ``sharding_plan`` gives the
+    QKV projections, so no resharding is introduced — and leaves any
+    other mesh axes automatic (``axis_names``: partial-manual). Axes
+    that are absent, size-1, or already manual (the model is inside a
+    caller's shard_map — shapes are already local and the kernel just
+    works) are excluded from the map; with none left the plain call is
+    used. A usable axis whose batch/head count doesn't divide STAYS
+    manual but drops out of the specs — the kernel then computes
+    replicated over it, because a bare pallas_call under jit-with-mesh
+    is the exact lowering error this wrapper exists to avoid, dividing
+    or not. GQA inside each shard is preserved: h and kv_heads are
+    divided by the same tp factor, so the group ratio is unchanged.
+
+    The ambient mesh is read via ``jax.sharding.get_abstract_mesh`` —
+    bind it with ``jax.set_mesh(mesh)`` (what the in-repo drills and
+    examples do); a legacy ``with mesh:`` block alone is invisible
+    here, leaving the bare kernel to fail lowering on a real pod with
+    XLA's own "wrap the call in a shard_map" error."""
+    from torchft_tpu.ops.flash_attention import flash_attention
+
+    from jax.sharding import AxisType
+
+    call = partial(
+        flash_attention,
+        scale=scale,
+        block_q=cfg.attention_block_size,
+        block_k=cfg.attention_block_k or cfg.attention_block_size,
+    )
+    mesh = jax.sharding.get_abstract_mesh()
+    axis_types = dict(
+        zip(getattr(mesh, "axis_names", ()), getattr(mesh, "axis_types", ()))
+    )
+
+    def usable(axis: Optional[str]) -> bool:
+        if axis is None or axis not in axis_types:
+            return False
+        if mesh.shape[axis] <= 1:
+            return False
+        # Already-manual axes (the model is inside a caller's shard_map)
+        # must not be wrapped again — shapes are already local there and
+        # a nested map over local shapes mis-divides them.
+        return axis_types[axis] != AxisType.Manual
+
+    b, _, h, _ = q.shape
+    kv_heads = k.shape[2]
+    # Every usable axis becomes manual: even when a dim doesn't divide
+    # (so its spec entry drops to None and the compute replicates over
+    # that axis), the kernel must still run inside the manual context —
+    # a bare pallas_call under jit-with-mesh is the exact lowering error
+    # this wrapper exists to avoid, dividing or not.
+    manual = {a for a in cfg.flash_batch_axes if usable(a)}
+    if usable(cfg.flash_tp_axis):
+        manual.add(cfg.flash_tp_axis)
+    if not manual:
+        return call(q, k, v)
+    batch_axes = tuple(
+        a for a in cfg.flash_batch_axes if a in manual
+    )
+    bsz = 1
+    for a in batch_axes:
+        bsz *= mesh.shape[a]
+    if batch_axes and b % bsz:
+        batch_axes = ()
+    tp = cfg.flash_tp_axis if cfg.flash_tp_axis in manual else None
+    if tp is not None and (h % mesh.shape[tp] or kv_heads % mesh.shape[tp]):
+        tp = None
+    bspec = batch_axes if batch_axes else None
+    spec = P(bspec, None, tp, None)
+    return jax.shard_map(
+        call,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        axis_names=manual,
+    )(q, k, v)
+
+
 def causal_attention(
     q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, scale: float
 ) -> jnp.ndarray:
@@ -272,14 +370,10 @@ class Attention(nn.Module):
             # On real TPU hardware, auto prefers the fused Pallas kernel for
             # long sequences: same O(s·block) memory as blockwise but one
             # Mosaic kernel instead of a jnp scan (re-verified against dense
-            # on every live-chip bench via verify_on_chip).
-            from torchft_tpu.ops.flash_attention import flash_attention
-
-            out = flash_attention(
-                q, k, v, scale=scale,
-                block_q=cfg.attention_block_size,
-                block_k=cfg.attention_block_k or cfg.attention_block_size,
-            )
+            # on every live-chip bench via verify_on_chip). Under a sharded
+            # train step the dispatcher shard_maps the kernel itself —
+            # Mosaic custom calls cannot be auto-partitioned by XLA SPMD.
+            out = _flash_under_ambient_mesh(cfg, q, k, v, scale)
         elif cfg.attention_impl == "blockwise" or (
             cfg.attention_impl == "auto" and x.shape[1] >= cfg.blockwise_min_seq
         ):
@@ -450,9 +544,13 @@ def sharding_plan(
     }
 
 
-def apply_sharding_plan(params: Any, mesh: Any, plan: Dict[str, Any]) -> Any:
+def plan_shardings(params: Any, mesh: Any, plan: Dict[str, Any]) -> Any:
     """Maps each param leaf (by its flattened path) to a NamedSharding from
-    the plan; unmatched leaves replicate."""
+    the plan; unmatched leaves replicate. Works on abstract leaves
+    (ShapeDtypeStruct / eval_shape output) and abstract meshes too — only
+    ``.ndim``/``.shape`` are read — so AOT lowering of a sharded train
+    step (tests/test_mosaic_lowering.py's scale gate) can build the exact
+    in_shardings the runtime path uses without materializing anything."""
     import re
 
     from jax.sharding import NamedSharding
@@ -488,5 +586,12 @@ def apply_sharding_plan(params: Any, mesh: Any, plan: Dict[str, Any]) -> Any:
             for axis in axes:
                 size *= mesh.shape.get(axis, 1)
             fixed.append(entry if leaf.shape[dim] % size == 0 else None)
-        out.append(jax.device_put(leaf, NamedSharding(mesh, P(*fixed))))
+        out.append(NamedSharding(mesh, P(*fixed)))
     return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def apply_sharding_plan(params: Any, mesh: Any, plan: Dict[str, Any]) -> Any:
+    """Places each param leaf onto its :func:`plan_shardings` sharding
+    (one batched transfer — per-leaf puts would serialize hundreds of
+    copies over a slow host↔device link)."""
+    return jax.device_put(params, plan_shardings(params, mesh, plan))
