@@ -273,7 +273,7 @@ def main() -> None:
     from torchft_tpu.parallel.native_pg import ProcessGroupNative
     from torchft_tpu.parallel.store import StoreClient, StoreServer
 
-    def make_manager(use_async_quorum: bool):
+    def make_manager(use_async_quorum: bool, commit_pipeline_depth: int = 0):
         lighthouse = LighthouseServer(min_replicas=1, join_timeout_ms=100)
         store = StoreServer()
         pg = ProcessGroupNative(timeout=30.0)
@@ -287,6 +287,7 @@ def main() -> None:
             timeout=30.0,
             quorum_timeout=60.0,
             use_async_quorum=use_async_quorum,
+            commit_pipeline_depth=commit_pipeline_depth,
         )
         return manager, (manager, pg, store, lighthouse)
 
@@ -340,6 +341,33 @@ def main() -> None:
         on_quorum=lambda dt: quorum_times.append(dt) if recording[0] else None,
     )
 
+    # The same per-step FT-DDP path with the commit PIPELINED (depth 1):
+    # step N's device sync + vote resolve under step N+1's dispatch, so
+    # the serialized readiness round trip — the whole measured gap between
+    # ft_ddp and plain on the tunneled chip — leaves the critical path.
+    pipe_manager, pipe_handles = make_manager(
+        use_async_quorum=True, commit_pipeline_depth=1
+    )
+    pipe_opt = Optimizer(pipe_manager, tx, params)
+    pipe_step = pipe_opt.make_step_fn(loss_fn, should_quantize=True)
+
+    # The decomposition datum VERDICT asked to sit NEXT TO the overhead
+    # field: one in-flight readiness probe, measured the way the FT step
+    # pays it (dispatch a jitted op, immediately ask for readiness).
+    # Relay-state-dependent on the tunnel (CLAUDE.md) — recorded as the
+    # companion to ft_ddp_step_overhead_ms, not as a precision figure.
+    def measure_device_sync_rtt() -> "float | None":
+        probe = jax.jit(lambda x: (x @ x).sum())
+        x = jnp.ones((256, 256), jnp.float32)
+        float(probe(x))  # compile + settle
+        samples = []
+        for _ in range(5):
+            y = probe(x)
+            t0 = time.monotonic()
+            jax.block_until_ready(y)
+            samples.append(time.monotonic() - t0)
+        return round(1000 * statistics.median(samples), 3)
+
     # ---- measurement: INTERLEAVED rounds, order-alternated, summed ----
     # Per-step compute on this box drifts several percent over minutes
     # (thermal / scheduler / memory pressure), so sequential phases hand
@@ -354,9 +382,15 @@ def main() -> None:
     # machine's remote-chip backend, block_until_ready returns early while
     # a value fetch truly synchronizes the dispatched chain.
     diloco_round_steps = sync_every  # one full cycle (incl. its sync) per round
-    totals = {"plain": [0, 0.0], "ddp": [0, 0.0], "diloco": [0, 0.0]}
+    totals = {
+        "plain": [0, 0.0],
+        "ddp": [0, 0.0],
+        "ddp_pipe": [0, 0.0],
+        "diloco": [0, 0.0],
+    }
+    device_sync_rtt_ms = None
     try:
-        # Warmups: plain, one full DiLoCo cycle, two DDP steps.
+        # Warmups: plain, one full DiLoCo cycle, two DDP steps (each mode).
         opt_state = tx.init(params)
         p = params
         for step in range(WARMUP):
@@ -368,6 +402,11 @@ def main() -> None:
         for step in range(2):
             ddp_step(batch_for(step))
         _ = float(jax.tree_util.tree_leaves(opt.params)[0].sum())
+        for step in range(2):
+            pipe_step(batch_for(step))
+        pipe_opt.flush_pipeline()
+        _ = float(jax.tree_util.tree_leaves(pipe_opt.params)[0].sum())
+        device_sync_rtt_ms = measure_device_sync_rtt()
         recording[0] = True
 
         def run_plain() -> None:
@@ -389,6 +428,21 @@ def main() -> None:
             totals["ddp"][0] += committed
             totals["ddp"][1] += time.monotonic() - t0
 
+        def run_ddp_pipelined() -> None:
+            t0 = time.monotonic()
+            committed = 0
+            for step in range(ddp_steps):
+                _, prev_ok = pipe_step(batch_for(step))
+                committed += bool(prev_ok)
+            # The trailing in-flight step resolves inside the window so
+            # the measured wall carries the FULL cost of every counted
+            # step (conservative: the last sync isn't hidden by a next
+            # dispatch here).
+            committed += bool(pipe_opt.flush_pipeline())
+            _ = float(jax.tree_util.tree_leaves(pipe_opt.params)[0].sum())
+            totals["ddp_pipe"][0] += committed
+            totals["ddp_pipe"][1] += time.monotonic() - t0
+
         def run_diloco() -> None:
             t0 = time.monotonic()
             for step in range(diloco_round_steps):
@@ -397,7 +451,7 @@ def main() -> None:
             totals["diloco"][0] += diloco_round_steps
             totals["diloco"][1] += time.monotonic() - t0
 
-        order = [run_plain, run_ddp, run_diloco]
+        order = [run_plain, run_ddp, run_ddp_pipelined, run_diloco]
         for _round in range(2):
             for run in order:
                 run()
@@ -405,12 +459,14 @@ def main() -> None:
     finally:
         teardown(diloco_handles)
         teardown(ddp_handles)
+        teardown(pipe_handles)
 
     def _tps(key: str) -> float:
         steps_done, elapsed = totals[key]
         return steps_done * tokens_per_step / elapsed if elapsed and steps_done else 0.0
 
     plain_tps, ddp_tps, diloco_tps = _tps("plain"), _tps("ddp"), _tps("diloco")
+    ddp_pipe_tps = _tps("ddp_pipe")
     quorum_p50_ms = round(1000 * statistics.median(quorum_times), 2) if quorum_times else None
 
     # ---- 2-replica-group drill: wire sync cost + kill recovery ----
@@ -463,6 +519,18 @@ def main() -> None:
         if ddp_tps and plain_tps
         else None
     )
+    # Pipelined mode's residual overhead: with the sync off the critical
+    # path this should collapse toward the quorum + commit RPC cost; read
+    # it NEXT TO device_sync_rtt_ms — the decomposition VERDICT asked for
+    # in-artifact (the non-pipelined overhead ≈ that RTT, the pipelined
+    # one shouldn't be).
+    ft_ddp_pipelined_step_overhead_ms = (
+        round(
+            1000 * (tokens_per_step / ddp_pipe_tps - tokens_per_step / plain_tps), 2
+        )
+        if ddp_pipe_tps and plain_tps
+        else None
+    )
 
     # The degraded fallback's ratios amortize fixed RPC costs against a
     # deliberately tiny deadline-bounded run — the worst case. When a
@@ -503,6 +571,11 @@ def main() -> None:
                 "plain_tokens_per_sec": round(plain_tps, 1),
                 "ft_ddp_tokens_per_sec": round(ddp_tps, 1),
                 "ft_ddp_vs_baseline": round(ddp_tps / plain_tps, 4) if plain_tps else None,
+                "ft_ddp_pipelined_tokens_per_sec": round(ddp_pipe_tps, 1),
+                "ft_ddp_pipelined_vs_baseline": (
+                    round(ddp_pipe_tps / plain_tps, 4) if plain_tps else None
+                ),
+                "commit_pipeline_depth": 1,
                 "degraded_cpu_fallback": DEGRADED,
                 "sync_every": sync_every,
                 "fragment_sync_delay": fragment_sync_delay,
@@ -515,6 +588,8 @@ def main() -> None:
                 "quant_kernel_on_chip": quant_on_chip,
                 "quorum_p50_ms": quorum_p50_ms,
                 "ft_ddp_step_overhead_ms": ft_ddp_step_overhead_ms,
+                "ft_ddp_pipelined_step_overhead_ms": ft_ddp_pipelined_step_overhead_ms,
+                "device_sync_rtt_ms": device_sync_rtt_ms,
                 **({"cpu_full_reference": cpu_full_ref} if cpu_full_ref else {}),
                 **two_group,
             }

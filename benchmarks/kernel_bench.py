@@ -124,15 +124,22 @@ def bench_attention(results: list) -> None:
 
         # Causal attention FLOPs: 2 matmuls x (s^2/2) x h x d x b x 2.
         flops = 2 * 2 * b * h * d * (s * s / 2)
+        # `is not None`, never truthiness, for every timing-null guard: a
+        # legitimate 0.0 timing must be reported, not nulled (and the
+        # fwd_bwd row below already guards this way — keep them identical).
         row = {
             "bench": "attention_fwd",
             "seq": s,
-            "flash_ms": round(1e3 * t_flash, 3) if t_flash else None,
-            "dense_ms": round(1e3 * t_dense, 3) if t_dense else None,
+            "flash_ms": round(1e3 * t_flash, 3) if t_flash is not None else None,
+            "dense_ms": round(1e3 * t_dense, 3) if t_dense is not None else None,
             "speedup_vs_dense": (
-                round(t_dense / t_flash, 3) if t_dense and t_flash else None
+                round(t_dense / t_flash, 3)
+                if t_dense is not None and t_flash is not None
+                else None
             ),
-            "flash_tflops": round(flops / t_flash / 1e12, 2) if t_flash else None,
+            "flash_tflops": (
+                round(flops / t_flash / 1e12, 2) if t_flash is not None else None
+            ),
         }
         results.append(row)
         print(json.dumps(row))

@@ -15,10 +15,21 @@ from pathlib import Path
 # rewrites jax_platforms to "axon,cpu" on interpreter start, so we override
 # the jax config directly before any backend initialization.
 os.environ["JAX_PLATFORMS"] = "cpu"  # belt and suspenders for subprocesses
+# Older jax (< jax_num_cpu_devices) sizes the virtual CPU mesh via
+# XLA_FLAGS, which must land before the backend initializes — set it
+# unconditionally (harmless on newer jax) so the suite collects on both.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:  # older jax: the XLA_FLAGS fallback above covers it
+    pass
 
 REPO_ROOT = Path(__file__).resolve().parent.parent
 if str(REPO_ROOT) not in sys.path:

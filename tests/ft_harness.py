@@ -236,6 +236,77 @@ def ddp_train_loop(
         pg.shutdown()
 
 
+def pipelined_ddp_train_loop(
+    runner: Runner,
+    rank: int,
+    store_client: StoreClient,
+    store_addr: str,
+    min_replica_size: int = 1,
+) -> Dict[str, Any]:
+    """The DDP loop under the pipelined-commit schedule
+    (commit_pipeline_depth=1): step N's device sync + vote resolve while
+    step N+1 is dispatched. Batches are keyed on
+    ``opt.next_pipelined_step()`` — ``manager.current_step()`` advances on
+    the executor while a vote is in flight, so it cannot key a lockstep
+    data stream (see Optimizer.next_pipelined_step). Returns the same
+    shape as ddp_train_loop plus rollback accounting."""
+    pg = FakeProcessGroupWrapper(ProcessGroupTCP(timeout=10.0))
+    manager = Manager(
+        pg=pg,
+        min_replica_size=min_replica_size,
+        store=store_client,
+        store_addr=store_addr,
+        use_async_quorum=runner.use_async_quorum,
+        group_rank=rank,
+        group_world_size=runner.world_size,
+        lighthouse_addr=runner.lighthouse_addr,
+        replica_id=f"ddp_{runner.replica_group}",
+        heartbeat_interval=0.05,
+        timeout=10.0,
+        quorum_timeout=20.0,
+        commit_pipeline_depth=1,
+        **runner.manager_args,
+    )
+    opt = Optimizer(manager, optax.sgd(0.05), _init_model_params())
+    step_fn = opt.make_step_fn(_loss_fn)
+
+    failed_commits = 0
+    try:
+        # Terminate on the dispatch prediction, not current_step(): with a
+        # vote in flight the manager counter lags by one, and looping on
+        # it would dispatch (and commit) one step past num_steps. The
+        # prediction assumes the in-flight step commits, so after a flush
+        # that refused the final step the outer loop resumes training.
+        while manager.current_step() < runner.num_steps:
+            while opt.next_pipelined_step() < runner.num_steps:
+                step = opt.next_pipelined_step()
+                if runner.injector is not None:
+                    # The injected death lands with the PREVIOUS step's
+                    # vote still in flight (launched at the end of the
+                    # last step_fn call) — the kill-during-pipelined-vote
+                    # case.
+                    runner.injector.check(runner.replica_group, step, pg)
+                x, y = _batch_for(step, runner.replica_group)
+                _, prev_committed = step_fn(x, y)
+                if prev_committed is False:
+                    failed_commits += 1
+            if opt.flush_pipeline() is False:
+                failed_commits += 1
+        return {
+            "state_dict": {"params": opt.params, "opt_state": opt.opt_state},
+            "manager_state": manager.state_dict(),
+            "failed_commits": failed_commits,
+            "rollbacks": opt.rollback_count,
+        }
+    finally:
+        try:
+            opt.flush_pipeline(raise_on_error=False)
+        except Exception:
+            pass
+        manager.shutdown(wait=False)
+        pg.shutdown()
+
+
 # ---------------------------------------------------------------------------
 # DiLoCo train loop (reference train_diloco.py analogue, sized for tests)
 # ---------------------------------------------------------------------------

@@ -119,6 +119,34 @@ def test_context_timeout_triggers_callback() -> None:
     assert not fired2.is_set()
 
 
+def test_commit_pipeline_depth_bookkeeping() -> None:
+    """CommitPipeline: depth-bounded admission, oldest-first ordering, and
+    a drain that empties it — the bookkeeping the pipelined-commit
+    optimizer and the manager's quorum-change hook share across threads."""
+    with pytest.raises(ValueError):
+        ft_futures.CommitPipeline(0)
+
+    pipe = ft_futures.CommitPipeline(1)
+    assert len(pipe) == 0 and pipe.oldest() is None and pipe.depth == 1
+    rec_a, rec_b = object(), object()
+    pipe.push(rec_a)
+    assert len(pipe) == 1 and pipe.oldest() is rec_a
+    with pytest.raises(RuntimeError, match="pipeline full"):
+        pipe.push(rec_b)
+    pipe.remove(rec_a)
+    pipe.remove(rec_a)  # idempotent
+    pipe.push(rec_b)
+    assert pipe.pending() == (rec_b,)
+    assert pipe.drain() == (rec_b,)
+    assert len(pipe) == 0 and pipe.drain() == ()
+
+    deep = ft_futures.CommitPipeline(2)
+    deep.push(rec_a)
+    deep.push(rec_b)
+    assert deep.pending() == (rec_a, rec_b)  # oldest first
+    assert deep.drain() == (rec_a, rec_b)
+
+
 def test_watchdog_exits_on_stalled_scheduler(monkeypatch) -> None:
     """Parity with the reference's watchdog sys.exit test (futures_test.py:97):
     a stalled scheduler loop must trigger the exit hook."""

@@ -230,39 +230,212 @@ def test_fp8_wire_worker_cached_per_manager_and_released_on_shutdown():
     with pytest.raises(RuntimeError):  # executor refused after shutdown
         w1.submit(lambda: 0)
 
-@pytest.mark.parametrize("strict", [False, True])
-def test_make_step_fn_commit_sync_ordering(monkeypatch, strict):
-    """Default: the lone-replica fused step launches the commit barrier
-    BEFORE the device readiness wait so the RPC rides under it (on a
-    high-latency device link the serialized order costs a full extra round
-    trip per step). TPUFT_STRICT_COMMIT=1 restores the reference's strict
-    ordering — vote only after observed completion (manager.py:816-827) —
-    and must sync before the vote leaves."""
+def _spy_commit_ordering(monkeypatch, manager, opt):
+    """Instruments the device-sync seam and the vote launch; returns the
+    event list (entries: ("sync", synced_obj) / ("vote",))."""
     import torchft_tpu.optim as optim_mod
 
-    monkeypatch.setenv("TPUFT_STRICT_COMMIT", "1" if strict else "0")
-    manager = scripted_manager()
-    tx = optax.sgd(0.1)
-    params = {"w": jnp.array([1.0, 1.0], jnp.float32)}
-    opt = Optimizer(manager, tx, params)
-
     events = []
-    real_sync = optim_mod.jax.block_until_ready
+    real_sync = optim_mod._bound_device
     real_async = manager.should_commit_async
 
     def spy_sync(x):
-        events.append("sync")
+        events.append(("sync", x))
         return real_sync(x)
 
     def spy_async(timeout=None):
-        events.append("vote")
+        events.append(("vote",))
         return real_async(timeout)
 
-    monkeypatch.setattr(optim_mod.jax, "block_until_ready", spy_sync)
+    monkeypatch.setattr(optim_mod, "_bound_device", spy_sync)
     manager.should_commit_async = spy_async
+    return events
+
+
+@pytest.mark.parametrize("mode", ["strict", "overlapped", "pipelined"])
+def test_make_step_fn_commit_sync_orderings(monkeypatch, mode):
+    """Pins all three commit orderings on the lone-replica step:
+
+    - strict (TPUFT_STRICT_COMMIT=1): vote only after observed completion
+      (reference manager.py:816-827) — sync precedes the vote, same call,
+      every step.
+    - overlapped (default): the barrier RPC launches first and rides under
+      the readiness wait — vote precedes sync, same call, every step.
+    - pipelined (commit_pipeline_depth=1): a step's own call does NO sync
+      of its own loss; it syncs the PREVIOUS step's loss (after dispatch,
+      so the readiness RTT rides under the new step's device execution)
+      and then votes — exactly one step's completion unobserved per vote.
+    """
+    monkeypatch.setenv("TPUFT_STRICT_COMMIT", "1" if mode == "strict" else "0")
+    manager = scripted_manager(
+        commit_pipeline_depth=1 if mode == "pipelined" else 0
+    )
+    tx = optax.sgd(0.1)
+    params = {"w": jnp.array([1.0, 1.0], jnp.float32)}
+    opt = Optimizer(manager, tx, params)
+    events = _spy_commit_ordering(monkeypatch, manager, opt)
 
     step_fn = opt.make_step_fn(lambda p, b: jnp.sum(p["w"] * b))
+    losses = []
+    for _ in range(3):
+        loss, committed = step_fn(jnp.array([1.0, 2.0], jnp.float32))
+        losses.append(loss)
+    kinds = [e[0] for e in events]
+    if mode == "strict":
+        assert kinds == ["sync", "vote"] * 3
+        # Each call syncs its OWN loss before its vote leaves.
+        assert [e[1] for e in events if e[0] == "sync"] == losses
+    elif mode == "overlapped":
+        assert kinds == ["vote", "sync"] * 3
+        assert [e[1] for e in events if e[0] == "sync"] == losses
+    else:
+        # Call 1 has nothing pending: vote only. Calls 2..n sync the
+        # PREVIOUS call's loss, then vote; the flush syncs the last.
+        assert kinds == ["vote", "sync", "vote", "sync", "vote"]
+        assert [e[1] for e in events if e[0] == "sync"] == losses[:2]
+        assert opt.pending_commits() == 1
+        assert opt.flush_pipeline() is True
+        assert [e[1] for e in events if e[0] == "sync"] == losses
+        assert opt.pending_commits() == 0
+
+
+def test_strict_commit_env_overrides_pipeline(monkeypatch):
+    """TPUFT_STRICT_COMMIT=1 wins over commit_pipeline_depth=1: the step
+    runs the strict ordering and nothing rides the pipeline."""
+    monkeypatch.setenv("TPUFT_STRICT_COMMIT", "1")
+    manager = scripted_manager(commit_pipeline_depth=1)
+    opt = Optimizer(manager, optax.sgd(0.1), {"w": jnp.ones(2, jnp.float32)})
+    events = _spy_commit_ordering(monkeypatch, manager, opt)
+    step_fn = opt.make_step_fn(lambda p, b: jnp.sum(p["w"] * b))
     _, committed = step_fn(jnp.array([1.0, 2.0], jnp.float32))
-    assert committed
-    want = ["sync", "vote"] if strict else ["vote", "sync"]
-    assert events == want
+    assert committed is True  # strict mode reports THIS step's verdict
+    assert [e[0] for e in events] == ["sync", "vote"]
+    assert opt.pending_commits() == 0
+
+
+def test_pipelined_step_fn_matches_plain_and_skips_wire(monkeypatch):
+    """The pipelined lone-replica loop must produce the exact plain-JAX
+    trajectory (same fused program) and never touch the wire path."""
+    import torchft_tpu.ddp as ddp_mod
+
+    def _boom(*a, **k):
+        raise AssertionError("wire path used on the lone-replica pipelined step")
+
+    monkeypatch.setattr(ddp_mod, "ft_allreduce_gradients", _boom)
+
+    manager = scripted_manager(commit_pipeline_depth=1)
+    tx = optax.sgd(0.2, momentum=0.9)
+    params = {"w": jnp.array([1.0, -2.0, 3.0], jnp.float32)}
+
+    def loss_fn(p, batch):
+        return jnp.sum((p["w"] - batch) ** 2)
+
+    opt = Optimizer(manager, tx, params)
+    step_fn = opt.make_step_fn(loss_fn)
+    batches = [jnp.full((3,), 0.1 * i, jnp.float32) for i in range(5)]
+    committed_flags = []
+    losses = []
+    for batch in batches:
+        loss, prev_committed = step_fn(batch)
+        committed_flags.append(prev_committed)
+        losses.append(float(loss))
+    assert committed_flags == [None, True, True, True, True]
+    assert opt.flush_pipeline() is True
+    assert manager.current_step() == 5
+
+    want_params, want_losses = _plain_trajectory(loss_fn, tx, params, batches)
+    np.testing.assert_array_equal(
+        np.asarray(opt.params["w"]), np.asarray(want_params["w"])
+    )
+    assert losses == want_losses
+
+
+def test_pipelined_rollback_on_failed_commit():
+    """A failed commit discovered one step late rolls the live state back
+    to the pre-step snapshot before the next dispatch — the speculative
+    update never leaks into committed history."""
+    manager = scripted_manager(commit_pipeline_depth=1)
+    # Step votes: commit 1 succeeds, commit 2 fails, rest succeed.
+    votes = iter([True, False, True, True])
+    manager._client.should_commit.side_effect = (
+        lambda rank, step, vote, timeout: vote and next(votes)
+    )
+    tx = optax.sgd(0.1)
+    opt = Optimizer(manager, tx, {"w": jnp.array([1.0, 1.0], jnp.float32)})
+
+    def loss_fn(p, b):
+        return jnp.sum((p["w"] - b) ** 2)  # grad = 2(w - b)
+
+    step_fn = opt.make_step_fn(loss_fn)
+    flags = []
+    for i in range(4):
+        _, prev_committed = step_fn(jnp.full((2,), float(i), jnp.float32))
+        flags.append(prev_committed)
+    assert opt.flush_pipeline() is True
+    assert flags == [None, True, False, True]
+    assert opt.rollback_count == 1
+    # 4 dispatches, 1 refused: exactly 3 committed steps.
+    assert manager.current_step() == 3
+
+    # Recompute the trajectory the commits describe: batches 0, (1 refused
+    # and rolled back), 2, 3 applied to the surviving state.
+    w = np.array([1.0, 1.0], np.float32)
+    for b in (0.0, 2.0, 3.0):
+        w = w - 0.1 * 2 * (w - b)
+    np.testing.assert_allclose(np.asarray(opt.params["w"]), w, rtol=1e-6)
+
+
+def test_pipelined_heal_recomputes_on_healed_state():
+    """A heal landing inside an in-flight pipelined vote: the resolution
+    must apply the PRE-heal gradients to the HEALED state (reference
+    load_state_dict + optimizer.step() order), not keep the stale
+    speculation."""
+    manager = scripted_manager(commit_pipeline_depth=1)
+    tx = optax.sgd(0.1)
+    opt = Optimizer(manager, tx, {"w": jnp.array([1.0, 1.0], jnp.float32)})
+
+    def loss_fn(p, batch):
+        return jnp.sum((p["w"] - batch) ** 2)  # grad = 2(w - batch)
+
+    healed = {"w": jnp.array([10.0, 10.0], jnp.float32)}
+    real_should_commit = manager.should_commit
+    heal_once = []
+
+    def healing_should_commit(timeout=None):
+        ok = real_should_commit(timeout=timeout)
+        if not heal_once:
+            heal_once.append(True)
+            opt._load_state_dict({"params": healed, "opt_state": opt.opt_state})
+        return ok
+
+    manager.should_commit = healing_should_commit
+    step_fn = opt.make_step_fn(loss_fn)
+    _, _ = step_fn(jnp.array([1.0, 2.0], jnp.float32))
+    # The heal happened during step 1's (already launched) vote; resolving
+    # it must recompute: pre-heal grads 2*(1-1)=0, 2*(1-2)=-2 applied to
+    # healed [10, 10] -> [10.0, 10.2].
+    assert opt.flush_pipeline() is True
+    np.testing.assert_allclose(
+        np.asarray(opt.params["w"]), np.array([10.0, 10.2], np.float32),
+        rtol=1e-6,
+    )
+
+
+def test_pipelined_wire_path_two_participants():
+    """With another participant, the pipelined step runs the wire path:
+    dummy-PG loopback averaging, speculative update adopted under the
+    in-flight vote, verdicts one step late."""
+    manager = scripted_manager(commit_pipeline_depth=1)
+    manager.is_lone_replica = lambda: False
+    tx = optax.sgd(0.1)
+    opt = Optimizer(manager, tx, {"w": jnp.array([1.0, 1.0], jnp.float32)})
+    step_fn = opt.make_step_fn(lambda p, b: jnp.sum(p["w"] * b))
+    for _ in range(3):
+        _, _ = step_fn(jnp.array([1.0, 2.0], jnp.float32))
+    assert opt.flush_pipeline() is True
+    # Dummy PG loopback: averaged grad == local grad == batch, 3 steps.
+    np.testing.assert_allclose(
+        np.asarray(opt.params["w"]), np.array([0.7, 0.4], np.float32),
+        rtol=1e-5,
+    )
+    assert manager.current_step() == 3

@@ -5,6 +5,10 @@ The --demo chaos variants (multi-process kill/restart/heal) are NOT run
 here — that behavior is covered by the heavier harnesses
 (tests/test_multiprocess_e2e.py, tests/test_chaos_soak.py under
 TPUFT_SOAK=1); this file keeps per-example cost to one process + one jit.
+
+The whole module is marked ``slow`` (~100 s of subprocess smoke runs):
+the tier-1 gate runs ``-m 'not slow'`` so new per-round tests fit its
+budget; the full suite (plain ``pytest tests/``) still runs these.
 """
 
 from __future__ import annotations
@@ -15,6 +19,8 @@ import sys
 from pathlib import Path
 
 import pytest
+
+pytestmark = pytest.mark.slow
 
 REPO = Path(__file__).resolve().parent.parent
 EXAMPLES = REPO / "examples"

@@ -523,7 +523,8 @@ def test_tracked_commit_future_timeout_is_not_consumption() -> None:
     gate = threading.Event()
     try:
         f = _TrackedCommitFuture(pool.submit(gate.wait, 10))
-        with pytest.raises(TimeoutError):
+        # py3.10: concurrent.futures.TimeoutError is not yet the builtin.
+        with pytest.raises((TimeoutError, concurrent.futures.TimeoutError)):
             f.result(timeout=0.05)
         assert not f.consumed
         gate.set()
@@ -568,6 +569,59 @@ def test_start_quorum_propagates_unconsumed_barrier_exception_once() -> None:
         future.result(timeout=10)
     manager.start_quorum()  # caller handled it; no stale re-raise
     assert manager.errored() is None
+
+
+def test_commit_pipeline_depth_env_and_validation(monkeypatch) -> None:
+    """TPUFT_COMMIT_PIPELINE overrides the ctor depth; only 0/1 are legal
+    (the bounded envelope is one step deep)."""
+    manager, _, _, _ = make_manager(pg=ProcessGroupDummy())
+    assert manager.commit_pipeline_depth == 0
+
+    manager, _, _, _ = make_manager(pg=ProcessGroupDummy(), commit_pipeline_depth=1)
+    assert manager.commit_pipeline_depth == 1
+
+    monkeypatch.setenv("TPUFT_COMMIT_PIPELINE", "1")
+    manager, _, _, _ = make_manager(pg=ProcessGroupDummy())
+    assert manager.commit_pipeline_depth == 1
+
+    monkeypatch.setenv("TPUFT_COMMIT_PIPELINE", "2")
+    with pytest.raises(ValueError, match="commit_pipeline_depth"):
+        make_manager(pg=ProcessGroupDummy())
+
+
+def test_quorum_change_hook_runs_before_reconfigure() -> None:
+    """The registered quorum-change hook fires on the quorum thread BEFORE
+    pg.configure (the pipelined-commit drain point: no reconfigure — and
+    no donor send — while an uncommitted step is in flight), and only when
+    the quorum id actually changes. Hook errors funnel into report_error
+    instead of aborting the reconfigure."""
+    events = []
+    pg = create_autospec(ProcessGroup, instance=True)
+    pg.errored.return_value = None
+    pg.configure.side_effect = lambda *a, **k: events.append("configure")
+    manager, client, _, _ = make_manager(pg=pg, min_replica_size=1)
+    manager.register_quorum_change_hook(lambda: events.append("drain"))
+    client._quorum.return_value = make_quorum(quorum_id=3)
+
+    manager.start_quorum()
+    manager.wait_quorum()
+    assert events == ["drain", "configure"]
+
+    # Same quorum id: neither fires again.
+    manager.start_quorum()
+    manager.wait_quorum()
+    assert events == ["drain", "configure"]
+
+    # A failing hook reports the error (blocking the commit) but the
+    # reconfigure still happens for the new era.
+    manager.register_quorum_change_hook(
+        lambda: (_ for _ in ()).throw(RuntimeError("drain failed"))
+    )
+    client._quorum.return_value = make_quorum(quorum_id=4)
+    manager.start_quorum()
+    manager.wait_quorum()
+    assert events == ["drain", "configure", "drain", "configure"]
+    assert manager.errored() is not None
 
 
 def test_allreduce_prequantized_zeroes_spare_contribution() -> None:

@@ -12,6 +12,7 @@ from ft_harness import (
     EventInjector,
     Runner,
     ddp_train_loop,
+    pipelined_ddp_train_loop,
     run_replica_groups,
 )
 
@@ -87,6 +88,58 @@ def test_ddp_recovery_after_replica_kill(lighthouse) -> None:
     # North star (BASELINE.md): a kill costs the survivor < 1 step — at most
     # the in-flight commit may fail when the peer vanishes mid-allreduce.
     assert results[0][0]["failed_commits"] <= 1, results[0][0]["failed_commits"]
+
+
+def test_ddp_pipelined_two_groups_healthy(lighthouse) -> None:
+    """Pipelined-commit FT-DDP across two replica groups: verdicts resolve
+    one step late, batches ride the dispatch prediction, and the groups
+    still end bitwise identical at exactly num_steps."""
+    runners = [
+        Runner(
+            replica_group=i,
+            lighthouse_addr=lighthouse.address(),
+            train_loop=pipelined_ddp_train_loop,
+            num_steps=4,
+        )
+        for i in range(2)
+    ]
+    results = run_replica_groups(runners, timeout=180)
+    assert_groups_converged(results, 4)
+    # A healthy run never rolls back.
+    for group_result in results:
+        assert group_result[0]["rollbacks"] == 0
+        assert group_result[0]["failed_commits"] == 0
+
+
+def test_ddp_pipelined_kill_rolls_back_uncommitted_step(lighthouse) -> None:
+    """SIGKILL-equivalent (simulated process death, the harness's kill
+    model) of one replica group while the survivor has a pipelined vote in
+    flight: the survivor's in-flight step cannot commit once its peer
+    vanishes mid-collective, so it must ROLL BACK the speculatively
+    adopted update — and after the peer restarts and heals, both groups
+    must be bitwise identical at the target step (the uncommitted
+    speculation never leaked into committed history)."""
+    injector = EventInjector().fail_at(group=1, step=2)
+    runners = [
+        Runner(
+            replica_group=i,
+            lighthouse_addr=lighthouse.address(),
+            train_loop=pipelined_ddp_train_loop,
+            num_steps=5,
+            injector=injector,
+        )
+        for i in range(2)
+    ]
+    results = run_replica_groups(runners, timeout=240)
+    assert injector.count == 1
+    assert_groups_converged(results, 5)
+    survivor = results[0][0]
+    # The survivor discovered the dead peer through a failed pipelined
+    # commit and refused the speculative update (rollback >= 1); it lost
+    # at most the in-flight step.
+    assert survivor["rollbacks"] >= 1, survivor
+    assert survivor["failed_commits"] >= 1, survivor
+    assert survivor["failed_commits"] <= 2, survivor
 
 
 def test_quorum_latency_north_star(lighthouse) -> None:

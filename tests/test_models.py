@@ -558,6 +558,68 @@ def test_flash_shard_maps_itself_under_ambient_mesh(monkeypatch):
     )
 
 
+def test_flash_mesh_fallback_keeps_largest_dividing_subset(caplog):
+    """The non-dividing batch fallback is per-axis: batch 2 on a
+    dp=2 x fsdp=2 mesh keeps dp sharded (product 4 does not divide, dp=2
+    does) instead of replicating over both, and the drop to replication
+    over fsdp logs a once-per-shape warning."""
+    import logging as _logging
+
+    from torchft_tpu.models import llama as llama_mod
+    from torchft_tpu.models.llama import (
+        _flash_under_ambient_mesh, causal_attention,
+    )
+
+    cfg = replace(
+        CONFIGS["tiny"], attention_impl="flash",
+        flash_batch_axes=("dp", "fsdp"), flash_tp_axis="tp",
+    )
+    s, h, kv, d = 128, 4, 2, 64
+    kq, kk, kvk = jax.random.split(jax.random.PRNGKey(3), 3)
+    q = jax.random.normal(kq, (2, s, h, d), jnp.float32)
+    k = jax.random.normal(kk, (2, s, kv, d), jnp.float32)
+    v = jax.random.normal(kvk, (2, s, kv, d), jnp.float32)
+
+    llama_mod._FLASH_REPLICATION_WARNED.clear()
+    mesh = jax.make_mesh((2, 2, 2), ("dp", "fsdp", "tp"))
+    with caplog.at_level(_logging.WARNING, logger="torchft_tpu.models.llama"):
+        with jax.set_mesh(mesh):
+            out = jax.jit(
+                lambda q, k, v: _flash_under_ambient_mesh(cfg, q, k, v, d**-0.5)
+            )(q, k, v)
+            # Same shape again: the warning must not repeat.
+            jax.jit(
+                lambda q, k, v: _flash_under_ambient_mesh(cfg, q, k, v, d**-0.5)
+            )(q, k, v)
+    ref = causal_attention(q, k, v, scale=d**-0.5)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+    warnings = [r for r in caplog.records if "replicates its compute" in r.message]
+    assert len(warnings) == 1, [r.message for r in caplog.records]
+    assert "fsdp=2" in warnings[0].message
+
+
+def test_largest_dividing_subset_selection():
+    """The pure fallback helper: keeps the max-shard-count dividing subset
+    in spec order; all-or-nothing only when nothing divides."""
+    from torchft_tpu.models.llama import _largest_dividing_subset
+
+    sizes = {"dp": 2, "fsdp": 4}
+    assert _largest_dividing_subset(("dp", "fsdp"), sizes, 8) == ("dp", "fsdp")
+    assert _largest_dividing_subset(("dp", "fsdp"), sizes, 4) == ("fsdp",)
+    assert _largest_dividing_subset(("dp", "fsdp"), sizes, 2) == ("dp",)
+    assert _largest_dividing_subset(("dp", "fsdp"), sizes, 3) == ()
+    # Ties prefer more axes (finer layout): 4 rows on 2x2 -> both axes.
+    assert _largest_dividing_subset(
+        ("dp", "fsdp"), {"dp": 2, "fsdp": 2}, 4
+    ) == ("dp", "fsdp")
+    # Order in the result is spec order regardless of subset enumeration.
+    assert _largest_dividing_subset(
+        ("a", "b", "c"), {"a": 3, "b": 2, "c": 2}, 12
+    ) == ("a", "b", "c")
+
+
 def test_flash_dispatcher_is_inert_inside_callers_shard_map():
     """Inside a caller's shard_map the fsdp/tp axes are Manual and shapes
     are already per-shard local: the dispatcher must use the plain kernel

@@ -35,9 +35,27 @@ _WIRE_WORKERS_LOCK = threading.Lock()
 
 __all__ = [
     "ft_allreduce_gradients",
+    "prefetch_gradients",
     "DistributedDataParallel",
     "PureDistributedDataParallel",
 ]
+
+
+def prefetch_gradients(grads: Any) -> None:
+    """Starts the async device→host copy of every float array leaf of a
+    gradient pytree without blocking — the staging half of the bucket
+    schedule, exposed so the pipelined-commit step can launch it for the
+    NEXT step's gradients before the previous step's vote has even
+    resolved. By the time :func:`ft_allreduce_gradients` runs for real,
+    its per-bucket ``np.asarray`` calls drain copies already in flight
+    instead of starting them cold."""
+    prefetch_to_host(
+        [
+            leaf
+            for leaf in jax.tree_util.tree_leaves(grads)
+            if isinstance(leaf, jax.Array)
+        ]
+    )
 
 
 def _single_participant_identity(manager: Manager) -> bool:

@@ -24,7 +24,13 @@ from concurrent.futures import Future
 from contextlib import contextmanager
 from typing import Any, Callable, Generator, Optional
 
-__all__ = ["future_timeout", "future_wait", "context_timeout", "stream_timeout"]
+__all__ = [
+    "future_timeout",
+    "future_wait",
+    "context_timeout",
+    "stream_timeout",
+    "CommitPipeline",
+]
 
 WATCHDOG_TIMEOUT_SEC = float(os.environ.get("TPUFT_WATCHDOG_TIMEOUT_SEC", "30"))
 
@@ -177,3 +183,69 @@ def stream_timeout(callback: Callable[[], None], timeout: float) -> _TimerHandle
     TPU analogue of the reference's CUDA-event stream timeout: pair it with
     ``jax.block_until_ready`` and cancel on completion."""
     return _TIMEOUT_MANAGER.schedule(timeout, callback)
+
+
+class CommitPipeline:
+    """Depth-bounded chain of pending pipelined-commit steps — the future
+    chain behind ``Manager(commit_pipeline_depth=...)``.
+
+    At most ``depth`` steps may be awaiting their commit verdict at once:
+    the owner (optim.Optimizer's pipelined step_fn) pushes one record per
+    dispatched step and must fully resolve the oldest before pushing the
+    next. Records are opaque beyond the two idempotent phases every
+    pipelined step has — a vote resolution (owner-driven, may roll state
+    back) and a device bound (``bound_device(raise_on_error=...)``, safe
+    from any thread). The chain itself only does thread-safe bookkeeping:
+    the manager's quorum-change drain and the optimizer's step loop touch
+    it from different threads.
+    """
+
+    def __init__(self, depth: int = 1) -> None:
+        if depth < 1:
+            raise ValueError(f"pipeline depth must be >= 1, got {depth}")
+        self._depth = depth
+        self._lock = threading.Lock()
+        self._records: list = []
+
+    @property
+    def depth(self) -> int:
+        return self._depth
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._records)
+
+    def push(self, record: Any) -> None:
+        """Admits a newly dispatched step. The owner resolves the oldest
+        record before pushing past ``depth`` — exceeding it means a step
+        was dispatched with more than ``depth`` commits unaccounted, which
+        the bounded envelope forbids."""
+        with self._lock:
+            if len(self._records) >= self._depth:
+                raise RuntimeError(
+                    f"commit pipeline full (depth={self._depth}); resolve the "
+                    "oldest pending step before dispatching another"
+                )
+            self._records.append(record)
+
+    def oldest(self) -> Optional[Any]:
+        with self._lock:
+            return self._records[0] if self._records else None
+
+    def remove(self, record: Any) -> None:
+        with self._lock:
+            if record in self._records:
+                self._records.remove(record)
+
+    def pending(self) -> tuple:
+        """Snapshot of the pending records, oldest first."""
+        with self._lock:
+            return tuple(self._records)
+
+    def drain(self) -> tuple:
+        """Pops every pending record (oldest first); the caller resolves
+        them. Used at step-loop boundaries: flush, shutdown, switching
+        step protocols."""
+        with self._lock:
+            records, self._records = tuple(self._records), []
+            return records

@@ -66,6 +66,7 @@ CONNECT_TIMEOUT_SEC_ENV = "TPUFT_CONNECT_TIMEOUT_SEC"
 QUORUM_RETRIES_ENV = "TPUFT_QUORUM_RETRIES"
 LIGHTHOUSE_ENV = "TPUFT_LIGHTHOUSE"
 MANAGER_PORT_ENV = "TPUFT_MANAGER_PORT"
+COMMIT_PIPELINE_ENV = "TPUFT_COMMIT_PIPELINE"
 
 
 def _env_timeout(env: str, default: float) -> float:
@@ -187,6 +188,10 @@ class Manager:
             appended per process lifetime.
         group_rank/group_world_size: this process's coordinates inside the
             replica group (host index / hosts per group).
+        commit_pipeline_depth: 0 (default) resolves every step's commit
+            before the next dispatch; 1 opts into the pipelined-commit
+            schedule (``$TPUFT_COMMIT_PIPELINE`` overrides; see
+            optim.Optimizer.make_step_fn for the widened envelope).
     """
 
     def __init__(
@@ -213,6 +218,7 @@ class Manager:
         init_sync: bool = True,
         max_retries: Optional[int] = None,
         quorum_retries: int = 0,
+        commit_pipeline_depth: int = 0,
     ) -> None:
         self._pg = pg
         self._min_replica_size = min_replica_size
@@ -222,6 +228,19 @@ class Manager:
         self._quorum_retries = int(
             os.environ.get(QUORUM_RETRIES_ENV, str(quorum_retries))
         )
+        # Pipelined commit (opt-in): step N's device sync + commit vote may
+        # resolve while step N+1 is already dispatched — optim.make_step_fn
+        # reads this depth and runs its pipelined schedule. Depth 1 is the
+        # supported window (a one-step-deep bounded-accounting envelope,
+        # see optim.py); TPUFT_STRICT_COMMIT=1 overrides it back to 0.
+        self._commit_pipeline_depth = int(
+            os.environ.get(COMMIT_PIPELINE_ENV, str(commit_pipeline_depth))
+        )
+        if self._commit_pipeline_depth not in (0, 1):
+            raise ValueError(
+                "commit_pipeline_depth must be 0 (off) or 1 (one uncommitted "
+                f"step in flight); got {self._commit_pipeline_depth}"
+            )
         self._use_async_quorum = use_async_quorum
         self._replica_world_size_mode = world_size_mode
         self._init_sync = init_sync
@@ -260,6 +279,7 @@ class Manager:
         # Per-step error/heal state.
         self._errored: Optional[ExceptionWithTraceback] = None
         self._shutdown_hooks: List[Callable[[], None]] = []
+        self._quorum_change_hooks: List[Callable[[], None]] = []
         self._healing = False
         self._pending_state_dict: Optional[Dict[str, Any]] = None
         self._pending_commit_future: Optional[_TrackedCommitFuture] = None
@@ -335,6 +355,25 @@ class Manager:
 
     def allow_state_dict_read(self) -> None:
         self._state_dict_lock.w_release()
+
+    @property
+    def commit_pipeline_depth(self) -> int:
+        """How many uncommitted steps the train loop may keep in flight
+        (0 = resolve every commit before the next dispatch)."""
+        return self._commit_pipeline_depth
+
+    def register_quorum_change_hook(self, hook: Callable[[], None]) -> None:
+        """Runs ``hook`` on the quorum thread whenever the quorum id
+        changes, BEFORE the process group reconfigures (and therefore
+        before any donor checkpoint send for the new quorum).
+
+        This is the pipelined-commit drain point: a membership change must
+        not reconfigure the comm layer — or stage a donor send — while an
+        uncommitted speculative step is still in flight, so the pipelined
+        optimizer registers a full pipeline resolution here. Hook errors
+        funnel into :meth:`report_error` (the step will not commit) rather
+        than aborting the reconfigure."""
+        self._quorum_change_hooks.append(hook)
 
     def register_shutdown_hook(self, hook: Callable[[], None]) -> None:
         """Runs ``hook`` during :meth:`shutdown` (before the executor stops).
@@ -712,6 +751,18 @@ class Manager:
             self._logger.info(
                 f"reconfiguring for quorum_id={quorum.quorum_id} {store_prefixed_addr=}"
             )
+            # Membership changed: drain anything the pipelined-commit mode
+            # still has in flight BEFORE reconfiguring the wire or serving
+            # a donor checkpoint — the new quorum era (and any joiner
+            # healing from this replica) must observe committed state only.
+            for hook in self._quorum_change_hooks:
+                try:
+                    hook()
+                except Exception as e:  # noqa: BLE001
+                    self._logger.exception(
+                        f"quorum-change drain hook failed: {e}"
+                    )
+                    self.report_error(e)
             try:
                 with trace_span("tpuft::manager::_pg::configure"):
                     self._pg.configure(

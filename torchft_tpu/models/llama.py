@@ -24,6 +24,7 @@ TPU-first choices:
 
 from __future__ import annotations
 
+import logging
 from dataclasses import dataclass, replace
 from functools import partial
 from typing import Any, Dict, Optional, Tuple
@@ -231,6 +232,55 @@ def _sp_axis_in_mesh(axis: str) -> bool:
     return abstract.shape[axis] > 1
 
 
+def _largest_dividing_subset(
+    axes: Tuple[str, ...], sizes: Dict[str, int], n: int
+) -> Tuple[str, ...]:
+    """The subset of ``axes`` with the largest shard-count product that
+    divides ``n``, in the original axis order (the spec/flatten order).
+    Ties prefer more axes (finer sharding layout), then earlier subsets.
+    Brute force: flash_batch_axes is 2-3 names, never a search problem."""
+    best: Tuple[str, ...] = ()
+    best_size = 1
+    for mask in range(1, 1 << len(axes)):
+        subset = tuple(a for i, a in enumerate(axes) if mask & (1 << i))
+        size = 1
+        for a in subset:
+            size *= sizes[a]
+        if n % size == 0 and (
+            size > best_size or (size == best_size and len(subset) > len(best))
+        ):
+            best, best_size = subset, size
+    return best
+
+
+# (shape, dropped-axes) combinations already warned about — the fallback
+# fires on every traced call, and a sharded train step retraces per shape.
+_FLASH_REPLICATION_WARNED: set = set()
+
+
+def _warn_flash_replicated(
+    dropped: Tuple[str, ...], kept: Tuple[str, ...], tp, dims, mesh
+) -> None:
+    """Once-per-shape warning when a usable mesh axis falls back to
+    replication because the batch/head count doesn't divide it: the kernel
+    still runs (inside the manual context), but the compute is replicated
+    — and q/k/v all-gathered — across every dropped axis, a large silent
+    performance cliff worth surfacing."""
+    b, h, kv_heads = dims
+    key = (dims, dropped, kept, tp)
+    if key in _FLASH_REPLICATION_WARNED:
+        return
+    _FLASH_REPLICATION_WARNED.add(key)
+    sizes = ", ".join(f"{a}={mesh.shape[a]}" for a in dropped)
+    logging.getLogger(__name__).warning(
+        "flash attention: batch=%d heads=%d/%d does not divide mesh axis(es) "
+        "%s — the kernel replicates its compute (and all-gathers q/k/v) "
+        "across them; kept batch axes %s, tp axis %s. Resize the batch/head "
+        "counts or flash_batch_axes to restore full sharding.",
+        b, h, kv_heads, sizes, kept or "()", tp,
+    )
+
+
 def _flash_under_ambient_mesh(cfg: LlamaConfig, q, k, v, scale: float):
     """Dispatches the fused Pallas kernel, shard_mapping it over the
     ambient mesh's data/tensor axes when one is bound.
@@ -296,17 +346,25 @@ def _flash_under_ambient_mesh(cfg: LlamaConfig, q, k, v, scale: float):
         manual.add(cfg.flash_tp_axis)
     if not manual:
         return call(q, k, v)
-    batch_axes = tuple(
-        a for a in cfg.flash_batch_axes if a in manual
+    usable_batch = tuple(a for a in cfg.flash_batch_axes if a in manual)
+    # Non-dividing fallback is PER-AXIS, not all-or-nothing: keep the
+    # largest dividing subset (by total shard count) of the usable batch
+    # axes instead of replicating over every one of them the moment the
+    # product stops dividing — e.g. batch 4 on dp=2 x fsdp=4 still shards
+    # over dp. Any axis left out replicates the attention compute (and
+    # all-gathers q/k/v) across it — a silent performance cliff, so it
+    # warns once per shape below.
+    batch_axes = _largest_dividing_subset(
+        usable_batch, {a: mesh.shape[a] for a in usable_batch}, b
     )
-    bsz = 1
-    for a in batch_axes:
-        bsz *= mesh.shape[a]
-    if batch_axes and b % bsz:
-        batch_axes = ()
     tp = cfg.flash_tp_axis if cfg.flash_tp_axis in manual else None
     if tp is not None and (h % mesh.shape[tp] or kv_heads % mesh.shape[tp]):
         tp = None
+    dropped = tuple(a for a in usable_batch if a not in batch_axes)
+    if cfg.flash_tp_axis in manual and tp is None:
+        dropped += (cfg.flash_tp_axis,)
+    if dropped:
+        _warn_flash_replicated(dropped, batch_axes, tp, (b, h, kv_heads), mesh)
     bspec = batch_axes if batch_axes else None
     spec = P(bspec, None, tp, None)
     return jax.shard_map(

@@ -461,12 +461,12 @@ def verify_on_chip() -> dict:
     )
     host_ragged = rng.normal(0, 2.0, 1200 * BLOCK - 37).astype(np.float32)
     result: dict = {"ok": True}
-    for host in (host_small, host_ragged):
-        _verify_roundtrips(host, result)
+    for label, host in (("small", host_small), ("ragged", host_ragged)):
+        _verify_roundtrips(host, result, label)
     return result
 
 
-def _verify_roundtrips(host, result: dict) -> None:
+def _verify_roundtrips(host, result: dict, label: str) -> None:
     import functools
 
     import jax
@@ -505,7 +505,12 @@ def _verify_roundtrips(host, result: dict) -> None:
             raise AssertionError(
                 f"device {wire} payload diverges from host decode: {err_mixed}"
             )
-        # Last pass wins (the ragged multi-tile case): both passes must
-        # clear the assertions above either way.
-        result[f"{wire}_max_err"] = err_chip
-        result[f"{wire}_host_err"] = err_host
+        # Per-pass keys so the committed artifact records BOTH passes (the
+        # ragged multi-tile pass used to overwrite the small mixed-
+        # magnitude one); the unlabeled legacy key stays as the worst case
+        # across passes so existing artifact readers keep a meaningful
+        # number.
+        result[f"{wire}_max_err_{label}"] = err_chip
+        result[f"{wire}_host_err_{label}"] = err_host
+        result[f"{wire}_max_err"] = max(result.get(f"{wire}_max_err", 0.0), err_chip)
+        result[f"{wire}_host_err"] = max(result.get(f"{wire}_host_err", 0.0), err_host)

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import logging
 import os
+import threading
 from typing import Any, Optional
 
 import jax
@@ -44,6 +45,18 @@ __all__ = [
     "make_jit_fused_step",
     "make_microbatch_grad",
 ]
+
+
+def _bound_device(x: Any) -> Any:
+    """Readiness seam for every commit-ordering device sync.
+
+    One named chokepoint instead of inline ``jax.block_until_ready`` calls
+    so (a) the ordering tests can spy the sync relative to the vote for all
+    three commit orderings, and (b) the emulated-DCN bench can shim it with
+    ``netem.emulated_device_sync`` to model the remote-device readiness
+    round trip this machine's tunnel charges (~73 ms — the cost the
+    pipelined mode exists to hide)."""
+    return jax.block_until_ready(x)
 
 
 def make_microbatch_grad(loss_fn: Any, num_microbatches: int):
@@ -244,6 +257,75 @@ def _as_device_tree(tree: Any, like: Any = None) -> Any:
     )
 
 
+class _PendingStep:
+    """One uncommitted pipelined step: the speculative ``(params,
+    opt_state)`` is already adopted as the live state (so the next step
+    could dispatch on it), and this record carries everything needed to
+    confirm, roll back, or re-derive it once its commit verdict lands.
+
+    Both phases are idempotent and lock-guarded because two threads may
+    reach them: the train loop (the normal resolution path) and the
+    manager's quorum thread (the drain-before-reconfigure hook)."""
+
+    __slots__ = (
+        "manager",
+        "heal_count",
+        "loss",
+        "snapshot",
+        "recompute",
+        "commit_future",
+        "committed",
+        "_bound",
+        "_bound_error",
+        "_lock",
+    )
+
+    def __init__(
+        self, manager: Manager, heal_count: int, loss: Any, snapshot: Any,
+        recompute: Any, commit_future: Any,
+    ) -> None:
+        self.manager = manager
+        self.heal_count = heal_count
+        self.loss = loss
+        self.snapshot = snapshot
+        self.recompute = recompute
+        self.commit_future = commit_future
+        self.committed: Optional[bool] = None  # set by the vote resolution
+        self._bound = False
+        self._bound_error: Optional[BaseException] = None
+        self._lock = threading.Lock()
+
+    def bound_device(self, raise_on_error: bool = True) -> None:
+        """Observes this step's device completion (once). A failure here is
+        the widened envelope's bounded-accounting case: the step may
+        already have committed (vote resolved before completion), so the
+        error is logged with that context and funneled into
+        :meth:`Manager.report_error` — poisoning the NEXT commit, whose
+        resolution rolls the speculative successor back. ``raise_on_error``
+        is False on the quorum-thread drain (report, don't unwind the
+        quorum) and True on the train-loop path (the supervisor-restart
+        boundary owns hard device failures, as in the non-pipelined
+        orderings)."""
+        with self._lock:
+            if not self._bound:
+                self._bound = True
+                try:
+                    _bound_device(self.loss)
+                except BaseException as e:  # noqa: BLE001
+                    self._bound_error = e
+                    logger.error(
+                        "pipelined step's device work failed after its commit "
+                        "vote resolved committed=%s (a committed step here "
+                        "advanced the step counter without a verified update "
+                        "— the depth-1 phantom-commit envelope)",
+                        self.committed,
+                    )
+                    if isinstance(e, Exception):
+                        self.manager.report_error(e)
+        if self._bound_error is not None and raise_on_error:
+            raise self._bound_error
+
+
 class Optimizer:
     """Owns (params, opt_state); steps only on quorum-wide commit."""
 
@@ -264,6 +346,13 @@ class Optimizer:
         )
 
         self._jit_update = make_jit_update(tx)
+
+        # Pipelined-commit state (populated by make_step_fn when the
+        # manager's commit_pipeline_depth >= 1).
+        self._pipeline: Optional[Any] = None
+        self._pipeline_hooked = False
+        self._next_pipelined_step = 0
+        self.rollback_count = 0
 
     def _state_dict(self) -> Any:
         return {"params": self.params, "opt_state": self.opt_state}
@@ -300,7 +389,7 @@ class Optimizer:
         # Bound the device work before voting: a replica whose math never
         # finished must not vote to commit (the stream-sync analogue of
         # reference manager.py:816-827).
-        grads = jax.block_until_ready(grads)
+        grads = _bound_device(grads)
         heal_count = self._heal_count
         # Snapshot the state refs, THEN launch the barrier: the RPC is in
         # flight while the update dispatches below. A concurrent heal can
@@ -378,6 +467,86 @@ class Optimizer:
             self.manager.allow_state_dict_read()
         return True
 
+    # ------------------------------------------------------------------
+    # pipelined commit (depth 1): resolution machinery
+    # ------------------------------------------------------------------
+
+    def pending_commits(self) -> int:
+        """Uncommitted pipelined steps currently in flight (0 or 1)."""
+        return len(self._pipeline) if self._pipeline is not None else 0
+
+    def next_pipelined_step(self) -> int:
+        """The step index the next pipelined ``step_fn`` call will compute.
+
+        ``manager.current_step()`` is unstable while a pipelined vote is in
+        flight (it advances on the manager's executor the moment the
+        barrier resolves), so DDP loops that key their data stream on the
+        step must use this caller-thread-maintained prediction instead. It
+        assumes the in-flight step commits; a failed commit or a heal makes
+        exactly one prediction stale, and the next call re-anchors — every
+        replica observes the same quorum-wide verdicts, so the streams stay
+        in lockstep."""
+        return self._next_pipelined_step
+
+    def _resolve_pipelined_record(self, rec: _PendingStep) -> bool:
+        """Vote phase: reads the barrier verdict and reconciles the already
+        adopted speculation — confirm (no-op), roll back to the pre-step
+        snapshot on a failed commit, or (same semantics as
+        :meth:`_commit_and_adopt`) re-derive the update against a state the
+        barrier healed. Idempotent: the quorum-change drain and the train
+        loop may both reach it."""
+        with rec._lock:
+            if rec.committed is not None:
+                return rec.committed
+            committed = rec.commit_future.result()
+            self.manager.disallow_state_dict_read()
+            try:
+                if self._heal_count != rec.heal_count:
+                    # Healed mid-flight: the donor state is authoritative;
+                    # a committed step still owes its update (pre-heal
+                    # grads applied to the healed state — reference
+                    # load_state_dict + optimizer.step() order).
+                    if committed:
+                        self.params, self.opt_state = rec.recompute()
+                elif not committed:
+                    # Refuse to adopt: restore the pre-step state the
+                    # speculation was dispatched from.
+                    self.params, self.opt_state = rec.snapshot
+                    self.rollback_count += 1
+            finally:
+                self.manager.allow_state_dict_read()
+            rec.committed = committed
+            return committed
+
+    def flush_pipeline(self, raise_on_error: bool = True) -> Optional[bool]:
+        """Resolves every pending pipelined step (vote + rollback + device
+        bound); returns the last step's commit verdict, or None when the
+        pipeline was idle. Call at train-loop boundaries — end of run,
+        before a checkpoint restore, before switching step protocols."""
+        if self._pipeline is None:
+            return None
+        last: Optional[bool] = None
+        for rec in self._pipeline.drain():
+            last = self._resolve_pipelined_record(rec)
+            rec.bound_device(raise_on_error=raise_on_error)
+        return last
+
+    def _drain_pipeline_for_quorum_change(self) -> None:
+        """Quorum-change hook (runs on the manager's quorum thread): fully
+        resolve the pipeline before the PG reconfigures or a donor send
+        samples this replica's state — a joiner must never heal from an
+        uncommitted speculative step. Safe here: the pending vote ran
+        earlier on the same single-thread executor (FIFO), so its result()
+        cannot deadlock, and the train-loop thread is parked in
+        wait_quorum while this runs. Records stay in the pipeline (resolved
+        in place, both phases idempotent) so the train loop still observes
+        each step's verdict on its own thread."""
+        if self._pipeline is None:
+            return
+        for rec in self._pipeline.pending():
+            self._resolve_pipelined_record(rec)
+            rec.bound_device(raise_on_error=False)
+
 
     def make_step_fn(
         self,
@@ -407,11 +576,35 @@ class Optimizer:
 
         ``loss_fn(params, *batch) -> scalar``; ``on_quorum(seconds)``, when
         given, receives each step's measured quorum wait (telemetry hook).
+
+        With ``Manager(commit_pipeline_depth=1)`` (or
+        ``TPUFT_COMMIT_PIPELINE=1``) the returned step_fn runs the
+        **pipelined-commit** schedule instead: step N's device sync and
+        commit vote resolve while step N+1 is already dispatched, so the
+        loop pays zero serialized readiness round trips per step. The
+        returned ``committed`` flag then reports the PREVIOUS step's
+        verdict (None on the first call); call :meth:`flush_pipeline` at
+        the loop boundary for the final step's. ``TPUFT_STRICT_COMMIT=1``
+        overrides the pipeline back to the strict per-step ordering.
         """
         from torchft_tpu.ddp import ft_allreduce_gradients
 
         fused = make_jit_fused_step(self.tx, loss_fn)
         grad_fn = jax.jit(jax.value_and_grad(loss_fn))
+
+        depth = self.manager.commit_pipeline_depth
+        if depth and os.environ.get("TPUFT_STRICT_COMMIT", "0") == "1":
+            logger.warning(
+                "TPUFT_STRICT_COMMIT=1 overrides commit_pipeline_depth=%d: "
+                "running strict per-step commits (vote only after observed "
+                "completion)",
+                depth,
+            )
+            depth = 0
+        if depth:
+            return self._make_pipelined_step_fn(
+                fused, grad_fn, should_quantize, on_quorum, depth
+            )
 
         def step_fn(*batch):
             self.begin_step()
@@ -453,11 +646,11 @@ class Optimizer:
                 # any vote leaves, the pre-change semantics exactly.
                 strict = os.environ.get("TPUFT_STRICT_COMMIT", "0") == "1"
                 if strict:
-                    jax.block_until_ready(loss)
+                    _bound_device(loss)
                 commit_future = self.manager.should_commit_async(None)
                 if not strict:
                     try:
-                        jax.block_until_ready(loss)
+                        _bound_device(loss)
                     except BaseException:
                         try:
                             barrier_result = commit_future.result()
@@ -495,6 +688,138 @@ class Optimizer:
                 ft_allreduce_gradients(self.manager, grads, should_quantize)
             )
             return loss, committed
+
+        return step_fn
+
+    def _make_pipelined_step_fn(
+        self, fused: Any, grad_fn: Any, should_quantize: bool,
+        on_quorum: Any, depth: int,
+    ):
+        """The pipelined-commit schedule (commit depth 1): per call —
+
+        1. (wire path) speculatively dispatch this step's forward/backward
+           and start staging the gradients to host, BEFORE the previous
+           vote resolves;
+        2. resolve the previous step's commit verdict — confirm, roll the
+           live state back to its pre-step snapshot, or heal-recompute;
+        3. quorum (a membership change drains the pipeline on the quorum
+           thread before the PG reconfigures — see
+           Manager.register_quorum_change_hook);
+        4. dispatch this step and tentatively adopt its speculative
+           (params, opt_state) — the one-step-deep uncommitted window;
+        5. observe the PREVIOUS step's device completion: the readiness
+           round trip rides under THIS step's device execution instead of
+           serializing after it (the per-step RTT this mode kills);
+        6. vote with this step's device work still in flight.
+
+        The widened envelope vs the overlapped ordering: a post-vote
+        device failure can phantom-commit ONE step (the vote at N observed
+        completion only through N-1). The blast radius is bounded
+        accounting, not divergence — a failure discovered at vote N makes
+        commit N fail quorum-wide, every survivor rolls back N's
+        speculative update identically, and recovery for hard device
+        failures is the same supervisor-restart + heal path the
+        non-pipelined orderings document.
+        """
+        import time as _time
+
+        from torchft_tpu.ddp import ft_allreduce_gradients, prefetch_gradients
+        from torchft_tpu.futures import CommitPipeline
+
+        if self._pipeline is not None and len(self._pipeline):
+            self.flush_pipeline()
+        pipeline = CommitPipeline(depth)
+        self._pipeline = pipeline
+        if not self._pipeline_hooked:
+            self.manager.register_quorum_change_hook(
+                self._drain_pipeline_for_quorum_change
+            )
+            self.manager.register_shutdown_hook(
+                lambda: self.flush_pipeline(raise_on_error=False)
+            )
+            self._pipeline_hooked = True
+        self._next_pipelined_step = self.manager.current_step()
+        was_wire = [False]
+
+        def step_fn(*batch):
+            manager = self.manager
+            # Next-step dispatch before prior-step vote resolution: the
+            # wire path's forward/backward depends only on the (already
+            # adopted, speculative) params, so its device work and d2h
+            # staging start under the vote wait + quorum RPC. A rollback
+            # or heal below invalidates it — detected by identity on the
+            # exact params it read — and it is recomputed.
+            early = None
+            if was_wire[0]:
+                early_heal = self._heal_count
+                early_params = self.params
+                early = grad_fn(early_params, *batch)
+                prefetch_gradients(early[1])
+
+            prev = pipeline.oldest()
+            prev_committed = None
+            if prev is not None:
+                prev_committed = self._resolve_pipelined_record(prev)
+
+            self.begin_step()
+            if on_quorum is not None:
+                t0 = _time.monotonic()
+                manager.wait_quorum()
+                on_quorum(_time.monotonic() - t0)
+            else:
+                manager.wait_quorum()
+
+            heal_count = self._heal_count
+            pre_params, pre_opt = self.params, self.opt_state
+            lone = manager.errored() is None and manager.is_lone_replica()
+            was_wire[0] = not lone
+            if lone:
+                loss, spec_params, spec_opt = fused(pre_params, pre_opt, *batch)
+                spec = (spec_params, spec_opt)
+
+                def recompute(pre_params=pre_params, batch=batch):
+                    # Pre-heal grads apply to the healed state (reference
+                    # load_state_dict + optimizer.step() order).
+                    _, g = grad_fn(pre_params, *batch)
+                    return self._jit_update(g, self.opt_state, self.params)
+            else:
+                if (
+                    early is not None
+                    and early_heal == self._heal_count
+                    and early_params is pre_params
+                ):
+                    loss, grads = early
+                else:
+                    loss, grads = grad_fn(pre_params, *batch)
+                avg = ft_allreduce_gradients(manager, grads, should_quantize)
+                spec = self._jit_update(avg, pre_opt, pre_params)
+
+                def recompute(avg=avg):
+                    return self._jit_update(avg, self.opt_state, self.params)
+
+            # Tentative adoption — the uncommitted one-step window. Write-
+            # locked so a concurrent donor capture never reads a torn pair.
+            manager.disallow_state_dict_read()
+            try:
+                self.params, self.opt_state = spec
+            finally:
+                manager.allow_state_dict_read()
+            self._next_pipelined_step = manager.current_step() + 1
+
+            if prev is not None:
+                pipeline.remove(prev)
+                prev.bound_device(raise_on_error=True)
+
+            rec = _PendingStep(
+                manager=manager,
+                heal_count=heal_count,
+                loss=loss,
+                snapshot=(pre_params, pre_opt),
+                recompute=recompute,
+                commit_future=manager.should_commit_async(None),
+            )
+            pipeline.push(rec)
+            return loss, prev_committed
 
         return step_fn
 
