@@ -151,6 +151,47 @@ def _last_json_line(text: str) -> "str | None":
             continue
     return None
 
+def _ft_phase_fields() -> dict:
+    """Per-phase FT accounting from the in-process metrics registry
+    (torchft_tpu.metrics), flattened into ``ft_phase_*`` JSON fields —
+    the where-does-the-tax-go decomposition next to the end-to-end
+    ``ft_ddp_step_overhead_ms``. Purely additive: every pre-existing
+    bench key is untouched. The registry is reset after warmup so compile
+    time never contaminates the dispatch/sync means."""
+    from torchft_tpu import metrics
+
+    fields: dict = {}
+    for metric, short in (
+        ("tpuft_quorum_seconds", "quorum"),
+        ("tpuft_commit_barrier_seconds", "commit_barrier"),
+        ("tpuft_device_sync_seconds", "device_sync"),
+        ("tpuft_update_dispatch_seconds", "update_dispatch"),
+        ("tpuft_wire_bucket_seconds", "wire_bucket"),
+        ("tpuft_quantized_pipeline_seconds", "quantized_pipeline"),
+        ("tpuft_pg_configure_seconds", "pg_configure"),
+        ("tpuft_heal_send_seconds", "heal_send"),
+        ("tpuft_heal_recv_seconds", "heal_recv"),
+    ):
+        stats = metrics.histogram_stats(metric)
+        if stats["count"]:
+            fields[f"ft_phase_{short}_ms_mean"] = round(stats["mean"] * 1000, 3)
+            fields[f"ft_phase_{short}_count"] = stats["count"]
+    for counter, short in (
+        ("tpuft_commits_total", "commits"),
+        ("tpuft_commit_failures_total", "commit_failures"),
+        ("tpuft_rollbacks_total", "rollbacks"),
+        ("tpuft_phantom_commits_total", "phantom_commits"),
+        ("tpuft_heals_total", "heals"),
+        ("tpuft_errors_total", "errors"),
+        ("tpuft_wire_bytes_total", "wire_bytes"),
+    ):
+        total = metrics.counter_total(counter)
+        fields[f"ft_phase_{short}_total"] = (
+            int(total) if float(total).is_integer() else total
+        )
+    return fields
+
+
 STEPS = int(os.environ.get("TPUFT_BENCH_STEPS", "20"))
 WARMUP = 3
 BATCH = int(os.environ.get("TPUFT_BENCH_BATCH", "8"))
@@ -408,6 +449,12 @@ def main() -> None:
         _ = float(jax.tree_util.tree_leaves(pipe_opt.params)[0].sum())
         device_sync_rtt_ms = measure_device_sync_rtt()
         recording[0] = True
+        # Phase accounting starts clean here: the warmups above paid the
+        # jit compiles, and compile time inside the dispatch/sync timers
+        # would swamp the steady-state means the ft_phase_* fields report.
+        from torchft_tpu import metrics as ft_metrics
+
+        ft_metrics.REGISTRY.reset()
 
         def run_plain() -> None:
             nonlocal p, opt_state
@@ -468,6 +515,11 @@ def main() -> None:
     plain_tps, ddp_tps, diloco_tps = _tps("plain"), _tps("ddp"), _tps("diloco")
     ddp_pipe_tps = _tps("ddp_pipe")
     quorum_p50_ms = round(1000 * statistics.median(quorum_times), 2) if quorum_times else None
+
+    # Snapshot the phase breakdown BEFORE the two-group drill: its heals
+    # and kill-recovery commits belong to the drill's own fields, not to
+    # the steady-state step decomposition measured above.
+    ft_phase = _ft_phase_fields()
 
     # ---- 2-replica-group drill: wire sync cost + kill recovery ----
     two_group = _two_group_drill()
@@ -590,6 +642,7 @@ def main() -> None:
                 "ft_ddp_step_overhead_ms": ft_ddp_step_overhead_ms,
                 "ft_ddp_pipelined_step_overhead_ms": ft_ddp_pipelined_step_overhead_ms,
                 "device_sync_rtt_ms": device_sync_rtt_ms,
+                **ft_phase,
                 **({"cpu_full_reference": cpu_full_ref} if cpu_full_ref else {}),
                 **two_group,
             }

@@ -404,10 +404,24 @@ def bench_commit_pipeline(quick: bool = False) -> Dict[str, Any]:
     jax.block_until_ready(loss)
     compute_ms = (time.perf_counter() - t0) / steps * 1000
 
+    from torchft_tpu import metrics as ft_metrics
+
+    # The per-phase decomposition (torchft_tpu.metrics histograms) names
+    # WHICH phase each ordering pays per step: strict/overlapped keep the
+    # full device-sync RTT on the critical path, pipelined hides it under
+    # the next dispatch — the wall sweep shows THAT the pipeline wins,
+    # this shows WHERE.
+    PHASES = (
+        ("tpuft_device_sync_seconds", "device_sync"),
+        ("tpuft_commit_barrier_seconds", "commit_barrier"),
+        ("tpuft_update_dispatch_seconds", "update_dispatch"),
+    )
     real_sync = optim_mod._bound_device
     modes: Dict[str, Dict[str, float]] = {}
+    per_phase: Dict[str, Dict[str, Dict[str, float]]] = {}
     for mode in ("strict", "overlapped", "pipelined"):
         rows: Dict[str, float] = {}
+        phase_rows: Dict[str, Dict[str, float]] = {}
         for rtt in rtts:
             os.environ["TPUFT_STRICT_COMMIT"] = "1" if mode == "strict" else "0"
             manager = make_scripted_manager(1 if mode == "pipelined" else 0)
@@ -417,6 +431,9 @@ def bench_commit_pipeline(quick: bool = False) -> Dict[str, Any]:
                 step_fn = opt.make_step_fn(loss_fn)
                 for i in range(warmup):
                     step_fn(*batch_for(i))
+                # Phase histograms cover exactly the measured window (the
+                # warmup's compile dispatches would skew the means).
+                ft_metrics.REGISTRY.reset()
                 t0 = time.perf_counter()
                 for i in range(steps):
                     step_fn(*batch_for(i))
@@ -424,13 +441,24 @@ def bench_commit_pipeline(quick: bool = False) -> Dict[str, Any]:
                     # The trailing sync belongs to the measured window.
                     opt.flush_pipeline()
                 wall = time.perf_counter() - t0
+                phase_rows[f"{int(rtt)}ms"] = {
+                    short: round(
+                        ft_metrics.histogram_stats(name)["sum"] / steps * 1000, 2
+                    )
+                    for name, short in PHASES
+                }
             finally:
                 optim_mod._bound_device = real_sync
                 os.environ.pop("TPUFT_STRICT_COMMIT", None)
                 manager.shutdown(wait=False)
             rows[f"{int(rtt)}ms"] = round(wall / steps * 1000, 2)
         modes[mode] = rows
+        per_phase[mode] = phase_rows
         print(json.dumps({"commit_pipeline_mode": mode, "per_step_ms": rows}), flush=True)
+        print(
+            json.dumps({"commit_pipeline_mode": mode, "per_phase_ms": phase_rows}),
+            flush=True,
+        )
 
     lo, hi = f"{int(rtts[0])}ms", f"{int(rtts[-1])}ms"
     claims = {
@@ -443,6 +471,13 @@ def bench_commit_pipeline(quick: bool = False) -> Dict[str, Any]:
         "pipelined_inflation_ms_0_to_50": round(
             modes["pipelined"][hi] - modes["pipelined"][lo], 2
         ),
+        # The phase the pipeline removes, named: per-step observed
+        # device-sync time at the worst RTT, per ordering. Strict and
+        # overlapped carry ~RTT here; pipelined's sync resolves under the
+        # next step's dispatch so its observed wait collapses.
+        "device_sync_ms_per_step_at_50ms": {
+            mode: per_phase[mode][hi]["device_sync"] for mode in per_phase
+        },
     }
     return {
         "emulation": "netem.emulated_device_sync at optim._bound_device "
@@ -451,6 +486,7 @@ def bench_commit_pipeline(quick: bool = False) -> Dict[str, Any]:
         "control plane, commit RPC fixed at 1 ms",
         "device_rtt_sweep_ms": rtts,
         "per_step_ms": modes,
+        "per_phase_ms": per_phase,
         "claims": claims,
     }
 

@@ -1,0 +1,222 @@
+#!/usr/bin/env python
+"""Live fleet table: lighthouse membership joined with each replica's
+pushed metrics snapshot.
+
+Every Manager publishes its process metrics into its group store under
+``metrics/<replica_id>/<group_rank>`` (rate limited by
+``$TPUFT_METRICS_PUSH_SEC``; see Manager._push_metrics), and the
+lighthouse status reports each member's ``replica_id`` + store address —
+so one status RPC plus one store get per rank renders the whole fleet
+without touching any training process: step, step rate, commits, last
+commit age, heal-in-progress, heartbeat age.
+
+Pure Python (the lighthouse/store clients speak the framed-protobuf
+protocol directly); runs anywhere that can reach the lighthouse.
+
+Usage::
+
+    python scripts/fleet_status.py [--lighthouse host:port]   # one table
+    python scripts/fleet_status.py --watch 5                  # refresh loop
+    python scripts/fleet_status.py --json                     # machine form
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from torchft_tpu.coordination import LighthouseClient
+from torchft_tpu.parallel.store import create_store_client
+
+
+def _get_snapshot(store_addr: str, replica_id: str, rank: int) -> Optional[Dict[str, Any]]:
+    """One rank's pushed snapshot, or None (never raises: a dead group's
+    store refusing connections is exactly the state this table shows)."""
+    try:
+        client = create_store_client(store_addr, connect_timeout=2.0)
+    except Exception:
+        return None
+    try:
+        raw = client.get(f"metrics/{replica_id}/{rank}", timeout=2.0, wait=False)
+        if raw is None:
+            return None
+        return json.loads(raw.decode())
+    except Exception:
+        return None
+    finally:
+        try:
+            client.close()
+        except Exception:
+            pass
+
+
+def _counter_total(snapshot: Dict[str, Any], name: str) -> Optional[float]:
+    entries = (snapshot.get("metrics") or {}).get("counters", {}).get(name)
+    if not entries:
+        return None
+    return sum(e.get("value", 0.0) for e in entries)
+
+
+def _gauge(snapshot: Dict[str, Any], name: str) -> Optional[float]:
+    entries = (snapshot.get("metrics") or {}).get("gauges", {}).get(name)
+    if not entries:
+        return None
+    return entries[-1].get("value")
+
+
+def collect(lighthouse_addr: str, prev: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """One poll: lighthouse status + per-rank snapshots, as a JSON-safe
+    dict. ``prev`` (the previous poll) turns step deltas into step/s."""
+    client = LighthouseClient(lighthouse_addr, connect_timeout=5.0)
+    try:
+        status = client.status(timeout=5.0)
+    finally:
+        client.close()
+    now = time.time()
+    rows: List[Dict[str, Any]] = []
+    prev_rows = {(r["replica_id"], r["rank"]): r for r in (prev or {}).get("rows", [])}
+    for member_status in status.members:
+        member = member_status.member
+        for rank in range(max(1, member.world_size)):
+            snap = (
+                _get_snapshot(member.store_address, member.replica_id, rank)
+                if member.store_address
+                else None
+            )
+            row: Dict[str, Any] = {
+                "replica_id": member.replica_id,
+                "rank": rank,
+                "lighthouse_step": member.step,
+                "heartbeat_age_ms": round(member_status.heartbeat_age_ms, 1),
+                "joining": member_status.joining,
+            }
+            if snap is not None:
+                last_commit = _gauge(snap, "tpuft_last_commit_time")
+                row.update(
+                    step=snap.get("step"),
+                    batches_committed=snap.get("batches_committed"),
+                    healing=bool(snap.get("healing"))
+                    or _gauge(snap, "tpuft_healing") == 1,
+                    commits=_counter_total(snap, "tpuft_commits_total"),
+                    commit_failures=_counter_total(
+                        snap, "tpuft_commit_failures_total"
+                    ),
+                    heals=_counter_total(snap, "tpuft_heals_total"),
+                    push_age_s=round(now - snap["ts"], 1) if "ts" in snap else None,
+                    last_commit_age_s=(
+                        round(now - last_commit, 1) if last_commit else None
+                    ),
+                )
+                # Step rate needs two observations of the same (replica,
+                # rank); the first poll (and one-shot mode) shows "-".
+                before = prev_rows.get((member.replica_id, rank))
+                if (
+                    before
+                    and before.get("step") is not None
+                    and row.get("step") is not None
+                    and prev is not None
+                ):
+                    dt = now - prev["ts"]
+                    if dt > 0 and row["step"] >= before["step"]:
+                        row["steps_per_sec"] = round(
+                            (row["step"] - before["step"]) / dt, 3
+                        )
+            rows.append(row)
+    return {
+        "ts": now,
+        "lighthouse": lighthouse_addr,
+        "quorum_id": status.quorum_id,
+        "has_quorum": status.has_quorum,
+        "rows": rows,
+    }
+
+
+_COLUMNS = (
+    ("replica_id", "REPLICA"),
+    ("rank", "RANK"),
+    ("step", "STEP"),
+    ("steps_per_sec", "STEP/S"),
+    ("commits", "COMMITS"),
+    ("commit_failures", "FAILED"),
+    ("heals", "HEALS"),
+    ("last_commit_age_s", "LAST COMMIT"),
+    ("healing", "HEALING"),
+    ("heartbeat_age_ms", "HB AGE MS"),
+    ("push_age_s", "PUSH AGE"),
+)
+
+
+def _cell(row: Dict[str, Any], key: str) -> str:
+    value = row.get(key)
+    if value is None:
+        return "-"
+    if key == "last_commit_age_s" or key == "push_age_s":
+        return f"{value}s"
+    if isinstance(value, bool):
+        return "yes" if value else "no"
+    if isinstance(value, float) and value.is_integer():
+        return str(int(value))
+    return str(value)
+
+
+def render(table: Dict[str, Any]) -> str:
+    lines = [
+        f"lighthouse {table['lighthouse']}  quorum_id={table['quorum_id']}  "
+        f"has_quorum={table['has_quorum']}  replicas="
+        f"{len({r['replica_id'] for r in table['rows']})}"
+    ]
+    cells = [[header for _, header in _COLUMNS]] + [
+        [_cell(row, key) for key, _ in _COLUMNS] for row in table["rows"]
+    ]
+    widths = [max(len(r[i]) for r in cells) for i in range(len(_COLUMNS))]
+    for i, row in enumerate(cells):
+        lines.append("  ".join(c.ljust(w) for c, w in zip(row, widths)).rstrip())
+        if i == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    if not table["rows"]:
+        lines.append("(no members — is the fleet up and heartbeating?)")
+    return "\n".join(lines)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
+    parser.add_argument(
+        "--lighthouse",
+        default=os.environ.get("TPUFT_LIGHTHOUSE", ""),
+        help="lighthouse address (default: $TPUFT_LIGHTHOUSE)",
+    )
+    parser.add_argument(
+        "--watch", type=float, default=0.0, metavar="SEC",
+        help="refresh every SEC seconds (adds a step/s column from deltas)",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="print the raw dict as JSON"
+    )
+    args = parser.parse_args()
+    if not args.lighthouse:
+        parser.error("--lighthouse (or $TPUFT_LIGHTHOUSE) is required")
+
+    prev: Optional[Dict[str, Any]] = None
+    while True:
+        table = collect(args.lighthouse, prev=prev)
+        if args.json:
+            print(json.dumps(table), flush=True)
+        else:
+            if args.watch and sys.stdout.isatty():
+                print("\033[2J\033[H", end="")
+            print(render(table), flush=True)
+        if not args.watch:
+            break
+        prev = table
+        time.sleep(args.watch)
+
+
+if __name__ == "__main__":
+    main()

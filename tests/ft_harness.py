@@ -38,6 +38,57 @@ class InjectedFailure(Exception):
     pass
 
 
+# ---------------------------------------------------------------------------
+# Metrics-plane assertions (torchft_tpu.metrics counters across a drill)
+# ---------------------------------------------------------------------------
+
+FT_COUNTERS = (
+    "commits",
+    "commit_failures",
+    "rollbacks",
+    "heals_donor",
+    "heals_joiner",
+    "errors",
+    "phantom_commits",
+)
+
+
+def ft_counter_snapshot(replica_id: str = "") -> Dict[str, float]:
+    """Current totals of the FT phase counters, optionally filtered to one
+    STABLE replica id (the manager labels counters with the user prefix,
+    before the per-process uuid suffix, so totals accumulate across
+    simulated supervisor restarts — exactly what a drill wants to count).
+    Counters are process-global and tests share one process: assert on
+    DELTAS via :func:`ft_counter_delta`, never on absolute values."""
+    from torchft_tpu import metrics
+
+    label = {"replica_id": replica_id} if replica_id else {}
+    return {
+        "commits": metrics.counter_total("tpuft_commits_total", **label),
+        "commit_failures": metrics.counter_total(
+            "tpuft_commit_failures_total", **label
+        ),
+        "rollbacks": metrics.counter_total("tpuft_rollbacks_total", **label),
+        "phantom_commits": metrics.counter_total(
+            "tpuft_phantom_commits_total", **label
+        ),
+        "heals_donor": metrics.counter_total(
+            "tpuft_heals_total", role="donor", **label
+        ),
+        "heals_joiner": metrics.counter_total(
+            "tpuft_heals_total", role="joiner", **label
+        ),
+        "errors": metrics.counter_total("tpuft_errors_total", **label),
+    }
+
+
+def ft_counter_delta(
+    before: Dict[str, float], after: Dict[str, float]
+) -> Dict[str, float]:
+    """after - before, per counter (what one drill contributed)."""
+    return {key: after[key] - before[key] for key in after}
+
+
 class EventInjector:
     """Deterministic fault schedule keyed (replica_group, step)."""
 

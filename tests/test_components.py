@@ -749,6 +749,38 @@ def test_doctor_checks_pass_and_catch_problems(monkeypatch, capsys) -> None:
     assert not missing, f"doctor.KNOWN_ENV missing: {sorted(missing)}"
 
 
+def test_metric_names_match_registry_table() -> None:
+    """METRICS.md is the canonical metric registry: every name the
+    package emits (metrics.inc/observe/set_gauge/timer/counter/gauge/
+    histogram call sites) must have a table row, and every table row must
+    correspond to a live emission site — else dashboards and the bench's
+    ft_phase_* fields silently drift from the code."""
+    import re
+    from pathlib import Path
+
+    from torchft_tpu import doctor
+
+    repo = Path(doctor.__file__).parent.parent
+    emit_call = re.compile(
+        r"metrics\.(?:inc|observe|set_gauge|timer|counter|gauge|histogram)\(\s*"
+        r'"(tpuft_[a-z0-9_]+)"'
+    )
+    emitted = set()
+    for py in (repo / "torchft_tpu").rglob("*.py"):
+        emitted |= set(emit_call.findall(py.read_text()))
+    assert emitted, "no emission sites found — did the grep pattern rot?"
+
+    table = set(
+        re.findall(r"\| `(tpuft_[a-z0-9_]+)` \|", (repo / "METRICS.md").read_text())
+    )
+    assert emitted - table == set(), (
+        f"emitted but missing a METRICS.md row: {sorted(emitted - table)}"
+    )
+    assert table - emitted == set(), (
+        f"tabulated in METRICS.md but never emitted: {sorted(table - emitted)}"
+    )
+
+
 def test_netem_shim_pacing() -> None:
     """The emulated-DCN shim: disabled by default (zero-cost no-op), and
     when configured injects RTT/2 + bytes/bandwidth per message."""

@@ -1,0 +1,544 @@
+"""Fleet metrics plane: registry semantics, exporters, the three export
+surfaces (HTTP /metrics, snapshot/bench fields, store push), and the
+observability satellites (flight-recorder trailer, doctor probe,
+chrome-trace thread metadata, fleet table rendering).
+
+The registry replaces what the reference delegates to an external OTel
+collector (otel.py) — it must therefore be exactly right about the two
+things collectors normally own: concurrent-writer atomicity and the
+Prometheus exposition format.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import urllib.request
+
+import pytest
+
+from torchft_tpu import metrics
+from torchft_tpu.metrics import (
+    DEFAULT_TIME_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+)
+
+
+# ---------------------------------------------------------------------------
+# primitives
+# ---------------------------------------------------------------------------
+
+
+def test_counter_monotonic_and_rejects_negative() -> None:
+    c = Counter()
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    assert c.value == 3.5
+
+
+def test_gauge_set_and_inc() -> None:
+    g = Gauge()
+    g.set(7)
+    g.inc(-2)
+    assert g.value == 5.0
+
+
+def test_histogram_bucket_edges_le_semantics() -> None:
+    """Prometheus ``le`` semantics: a bucket counts observations <= its
+    edge; cumulative across edges; +Inf counts everything."""
+    h = Histogram(buckets=(0.1, 1.0, 10.0))
+    for v in (0.1, 0.05, 1.0, 5.0, 100.0):
+        h.observe(v)
+    stats = h.stats()
+    # 0.1 and 0.05 are <= 0.1; 1.0 lands exactly on the 1.0 edge; 5.0 in
+    # the 10.0 bucket; 100.0 only in +Inf.
+    assert stats["buckets"] == {"0.1": 2, "1": 3, "10": 4, "+Inf": 5}
+    assert stats["count"] == 5
+    assert stats["sum"] == pytest.approx(106.15)
+    assert stats["mean"] == pytest.approx(106.15 / 5)
+
+
+def test_histogram_requires_edges_and_sorts_them() -> None:
+    with pytest.raises(ValueError):
+        Histogram(buckets=())
+    h = Histogram(buckets=(5.0, 1.0))
+    assert h.edges == (1.0, 5.0)
+
+
+def test_default_time_buckets_cover_phase_range() -> None:
+    # Phases span acked-readiness probes (~100 us) to the 60 s RPC
+    # timeout ceiling; both ends must land inside the edge range.
+    assert DEFAULT_TIME_BUCKETS[0] <= 1e-4
+    assert DEFAULT_TIME_BUCKETS[-1] >= 60.0
+    assert list(DEFAULT_TIME_BUCKETS) == sorted(DEFAULT_TIME_BUCKETS)
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+
+def test_registry_get_or_create_identity_and_kind_conflict() -> None:
+    reg = Registry()
+    a = reg.counter("x_total", replica_id="r0")
+    b = reg.counter("x_total", replica_id="r0")
+    c = reg.counter("x_total", replica_id="r1")
+    assert a is b and a is not c
+    with pytest.raises(ValueError, match="already registered"):
+        reg.gauge("x_total")
+
+
+def test_registry_label_order_is_canonical() -> None:
+    reg = Registry()
+    a = reg.counter("y_total", a="1", b="2")
+    b = reg.counter("y_total", b="2", a="1")
+    assert a is b
+
+
+def test_counter_total_partial_label_filter() -> None:
+    reg = Registry()
+    reg.counter("c_total", replica_id="r0", role="donor").inc(2)
+    reg.counter("c_total", replica_id="r0", role="joiner").inc(3)
+    reg.counter("c_total", replica_id="r1", role="donor").inc(10)
+    assert reg.counter_total("c_total") == 15
+    assert reg.counter_total("c_total", replica_id="r0") == 5
+    assert reg.counter_total("c_total", role="donor") == 12
+    assert reg.counter_total("c_total", replica_id="r0", role="donor") == 2
+    assert reg.counter_total("missing_total") == 0
+
+
+def test_histogram_stats_aggregates_label_sets() -> None:
+    reg = Registry()
+    reg.histogram("h_seconds", rank="0").observe(1.0)
+    reg.histogram("h_seconds", rank="1").observe(3.0)
+    agg = reg.histogram_stats("h_seconds")
+    assert agg["count"] == 2 and agg["sum"] == 4.0 and agg["mean"] == 2.0
+    assert reg.histogram_stats("h_seconds", rank="1")["mean"] == 3.0
+    assert reg.histogram_stats("absent")["count"] == 0
+
+
+def test_concurrent_increments_lose_no_updates() -> None:
+    """The op-worker, quorum, and train-loop threads all write the same
+    counters; under the GIL a bare += can still lose updates across the
+    read-modify-write — the per-metric lock must not."""
+    reg = Registry()
+    n_threads, n_incs = 8, 2000
+    barrier = threading.Barrier(n_threads)
+
+    def worker(i: int) -> None:
+        barrier.wait()
+        for _ in range(n_incs):
+            reg.counter("races_total").inc()
+            reg.histogram("races_seconds").observe(0.001)
+            reg.gauge("races_gauge", thread=str(i)).inc()
+
+    threads = [
+        threading.Thread(target=worker, args=(i,), name=f"opworker{i}")
+        for i in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert reg.counter_total("races_total") == n_threads * n_incs
+    assert reg.histogram_stats("races_seconds")["count"] == n_threads * n_incs
+
+
+def test_registry_reset_drops_everything() -> None:
+    reg = Registry()
+    reg.counter("z_total").inc()
+    reg.reset()
+    assert reg.counter_total("z_total") == 0
+    # A reset also releases the kind reservation.
+    reg.gauge("z_total").set(1)
+
+
+# ---------------------------------------------------------------------------
+# exporters
+# ---------------------------------------------------------------------------
+
+
+def test_prometheus_text_golden() -> None:
+    reg = Registry()
+    reg.counter("tpuft_commits_total", replica_id="r0", group_rank="0").inc(4)
+    reg.gauge("tpuft_step").set(4)
+    reg.histogram("tpuft_quorum_seconds", buckets=(0.5, 1.0)).observe(0.25)
+    reg.histogram("tpuft_quorum_seconds", buckets=(0.5, 1.0)).observe(2.0)
+    assert reg.prometheus_text() == (
+        "# TYPE tpuft_commits_total counter\n"
+        'tpuft_commits_total{group_rank="0",replica_id="r0"} 4\n'
+        "# TYPE tpuft_quorum_seconds histogram\n"
+        'tpuft_quorum_seconds_bucket{le="0.5"} 1\n'
+        'tpuft_quorum_seconds_bucket{le="1"} 1\n'
+        'tpuft_quorum_seconds_bucket{le="+Inf"} 2\n'
+        "tpuft_quorum_seconds_sum 2.25\n"
+        "tpuft_quorum_seconds_count 2\n"
+        "# TYPE tpuft_step gauge\n"
+        "tpuft_step 4\n"
+    )
+
+
+def test_prometheus_text_escapes_label_values() -> None:
+    reg = Registry()
+    reg.counter("esc_total", path='we"ird\\x\n').inc()
+    text = reg.prometheus_text()
+    assert 'path="we\\"ird\\\\x\\n"' in text
+
+
+def test_snapshot_is_json_safe_and_structured() -> None:
+    reg = Registry()
+    reg.counter("a_total", k="v").inc(2)
+    reg.gauge("b").set(1.5)
+    reg.histogram("c_seconds").observe(0.01)
+    snap = json.loads(json.dumps(reg.snapshot()))
+    assert snap["counters"]["a_total"] == [{"labels": {"k": "v"}, "value": 2.0}]
+    assert snap["gauges"]["b"][0]["value"] == 1.5
+    assert snap["histograms"]["c_seconds"][0]["count"] == 1
+
+
+def test_timer_records_elapsed_into_histogram() -> None:
+    reg_before = metrics.histogram_stats("timer_test_seconds")["count"]
+    with metrics.timer("timer_test_seconds", where="here"):
+        pass
+    stats = metrics.histogram_stats("timer_test_seconds")
+    assert stats["count"] == reg_before + 1
+    assert 0 <= stats["sum"] < 5.0
+
+
+# ---------------------------------------------------------------------------
+# HTTP surfaces
+# ---------------------------------------------------------------------------
+
+
+def _http_get(url: str):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.status, resp.headers.get("Content-Type", ""), resp.read()
+
+
+def test_standalone_metrics_http_server() -> None:
+    reg = Registry()
+    reg.counter("tpuft_commits_total", replica_id="srv").inc(3)
+    server = metrics.start_http_server(0, registry=reg)
+    try:
+        status, ctype, body = _http_get(
+            f"http://127.0.0.1:{server.port}/metrics"
+        )
+        assert status == 200 and ctype.startswith("text/plain")
+        assert b'tpuft_commits_total{replica_id="srv"} 3' in body
+
+        status, ctype, body = _http_get(
+            f"http://127.0.0.1:{server.port}/metrics.json"
+        )
+        assert status == 200 and ctype == "application/json"
+        payload = json.loads(body)
+        assert payload["metrics"]["counters"]["tpuft_commits_total"]
+
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _http_get(f"http://127.0.0.1:{server.port}/other")
+        assert err.value.code == 404
+    finally:
+        server.shutdown()
+
+
+def test_maybe_start_http_server_env_gated(monkeypatch) -> None:
+    monkeypatch.setattr(metrics, "_HTTP_SERVER", None)
+    monkeypatch.delenv(metrics.ENV_PORT, raising=False)
+    assert metrics.maybe_start_http_server() is None
+
+    monkeypatch.setenv(metrics.ENV_PORT, "not-a-port")
+    assert metrics.maybe_start_http_server() is None  # logs, never raises
+
+    monkeypatch.setenv(metrics.ENV_PORT, "0")
+    server = metrics.maybe_start_http_server()
+    try:
+        assert server is not None
+        # Idempotent: a second call reuses the process server.
+        assert metrics.maybe_start_http_server() is server
+    finally:
+        if server is not None:
+            server.shutdown()
+        monkeypatch.setattr(metrics, "_HTTP_SERVER", None)
+
+
+def test_checkpoint_transport_serves_metrics_route() -> None:
+    """Every replica already listens on the checkpoint transport port for
+    heals — the same port must answer scrapes, no extra server."""
+    from torchft_tpu.checkpointing import HTTPTransport
+
+    metrics.counter("tpuft_commits_total", replica_id="ckpt").inc()
+    transport = HTTPTransport()
+    try:
+        port = transport._server.server_address[1]
+        status, ctype, body = _http_get(f"http://127.0.0.1:{port}/metrics")
+        assert status == 200 and ctype.startswith("text/plain")
+        assert b"tpuft_commits_total" in body
+        # Non-metrics routes still get the transport's own handling.
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _http_get(f"http://127.0.0.1:{port}/bogus")
+        assert err.value.code == 404
+    finally:
+        transport.shutdown()
+
+
+def test_push_interval_env(monkeypatch) -> None:
+    monkeypatch.delenv(metrics.ENV_PUSH_SEC, raising=False)
+    assert metrics.push_interval_sec() == 10.0
+    monkeypatch.setenv(metrics.ENV_PUSH_SEC, "2.5")
+    assert metrics.push_interval_sec() == 2.5
+    monkeypatch.setenv(metrics.ENV_PUSH_SEC, "junk")
+    assert metrics.push_interval_sec() == 10.0  # malformed -> default
+
+
+def test_manager_pushes_snapshot_into_group_store(monkeypatch) -> None:
+    """The fleet-table feed: a commit publishes this process's snapshot
+    under metrics/<full replica id>/<group_rank> — the key
+    scripts/fleet_status.py derives from the lighthouse member list."""
+    from test_manager import make_manager, make_quorum
+
+    from torchft_tpu.parallel.process_group import ProcessGroupDummy
+
+    monkeypatch.setenv(metrics.ENV_PUSH_SEC, "0.001")
+    manager, client, _, _ = make_manager(pg=ProcessGroupDummy())
+    client._quorum.return_value = make_quorum(
+        replica_world_size=2, max_world_size=2
+    )
+    client.should_commit.side_effect = lambda rank, step, vote, timeout: vote
+    manager.start_quorum()
+    assert manager.should_commit()
+    key = f"metrics/{manager._replica_id}/{manager._group_rank}"
+    raw = manager._store.data.get(key)
+    assert raw is not None, sorted(manager._store.data)
+    payload = json.loads(raw.decode())
+    assert payload["step"] == 1
+    assert payload["healing"] is False
+    commits = payload["metrics"]["counters"]["tpuft_commits_total"]
+    assert any(
+        e["labels"]["replica_id"] == "test_replica" and e["value"] >= 1
+        for e in commits
+    )
+
+
+def test_manager_push_disabled_and_failure_tolerant(monkeypatch) -> None:
+    from test_manager import make_manager, make_quorum
+
+    from torchft_tpu.parallel.process_group import ProcessGroupDummy
+
+    # Disabled: no metrics/ key ever lands.
+    monkeypatch.setenv(metrics.ENV_PUSH_SEC, "0")
+    manager, client, _, _ = make_manager(pg=ProcessGroupDummy())
+    client._quorum.return_value = make_quorum(
+        replica_world_size=2, max_world_size=2
+    )
+    client.should_commit.side_effect = lambda rank, step, vote, timeout: vote
+    manager.start_quorum()
+    assert manager.should_commit()
+    assert not [k for k in manager._store.data if k.startswith("metrics/")]
+
+    # A store that refuses writes must not poison the step.
+    monkeypatch.setenv(metrics.ENV_PUSH_SEC, "0.001")
+    manager, client, _, _ = make_manager(pg=ProcessGroupDummy())
+    client._quorum.return_value = make_quorum(
+        replica_world_size=2, max_world_size=2
+    )
+    client.should_commit.side_effect = lambda rank, step, vote, timeout: vote
+
+    def broken_set(key, value, timeout=0):
+        if key.startswith("metrics/"):
+            raise ConnectionError("store down")
+        manager._store.data[key] = value
+
+    manager._store.set = broken_set
+    manager.start_quorum()
+    assert manager.should_commit()  # the push failure is swallowed
+    assert manager.current_step() == 1
+
+
+# ---------------------------------------------------------------------------
+# satellites: flight recorder trailer, doctor probe, chrome-trace tids
+# ---------------------------------------------------------------------------
+
+
+def test_flight_recorder_dump_embeds_metrics_trailer(tmp_path) -> None:
+    from torchft_tpu.utils import flight_recorder as fr
+
+    metrics.counter("tpuft_commits_total", replica_id="frtest").inc(9)
+    fr.record("test", "pre-abort")
+    path = tmp_path / "fr.jsonl"
+    fr.dump(str(path), reason="unit")
+    lines = [json.loads(l) for l in path.read_text().splitlines()]
+    trailer = lines[-1]
+    assert "metrics" in trailer and "ts" in trailer
+    commits = trailer["metrics"]["counters"]["tpuft_commits_total"]
+    assert any(
+        e["labels"].get("replica_id") == "frtest" and e["value"] == 9.0
+        for e in commits
+    )
+    # Event entries still precede the trailer.
+    assert any(e.get("event") == "pre-abort" for e in lines[:-1])
+
+
+def test_flight_recorder_malformed_size_env_imports_cleanly() -> None:
+    """A typo'd TPUFT_FLIGHT_RECORDER_SIZE must not break package import
+    (the recorder is imported from failure paths)."""
+    env = dict(os.environ, TPUFT_FLIGHT_RECORDER_SIZE="not-a-number")
+    out = subprocess.run(
+        [
+            sys.executable,
+            "-c",
+            "from torchft_tpu.utils import flight_recorder as fr; "
+            "fr.record('t', 'ok'); print(fr._ring_size())",
+        ],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.strip() == "2048"
+
+
+def test_doctor_metrics_check(monkeypatch) -> None:
+    from torchft_tpu import doctor
+
+    # Feature off: PASS, never FAIL.
+    monkeypatch.delenv(metrics.ENV_PORT, raising=False)
+    status, detail = doctor._check_metrics()
+    assert status == "PASS" and "off" in detail
+
+    # Malformed port: WARN.
+    monkeypatch.setenv(metrics.ENV_PORT, "eighty")
+    status, _ = doctor._check_metrics()
+    assert status == "WARN"
+
+    # Configured but nothing listening: WARN, not FAIL.
+    monkeypatch.setenv(metrics.ENV_PORT, "1")  # privileged: bind fails fast
+    status, detail = doctor._check_metrics()
+    assert status == "WARN" and "1" in detail
+
+    # A live endpoint: PASS with a series count.
+    server = metrics.start_http_server(0)
+    try:
+        monkeypatch.setenv(metrics.ENV_PORT, str(server.port))
+        metrics.counter("tpuft_commits_total", replica_id="doctor").inc()
+        status, detail = doctor._check_metrics()
+        assert status == "PASS" and "serving" in detail
+    finally:
+        server.shutdown()
+
+
+def test_chrome_trace_thread_names_and_span_args(tmp_path) -> None:
+    """Chrome-trace events carry real tid metadata: one ``thread_name``
+    "M" event per emitting thread, and span args (step/quorum_id) land in
+    the event's args — without these the pipelined-commit spans (resolved
+    on the quorum/op-worker threads) interleave unreadably."""
+    from torchft_tpu.utils.profiling import chrome_trace, trace_span
+
+    path = tmp_path / "trace.json"
+    with chrome_trace(str(path)):
+        with trace_span("tpuft::test::main", step=3, quorum_id=7):
+            pass
+
+        def other_thread() -> None:
+            with trace_span("tpuft::test::worker", step=3):
+                pass
+
+        t = threading.Thread(target=other_thread, name="tpuft_quorum_0")
+        t.start()
+        t.join()
+
+    events = json.loads(path.read_text())["traceEvents"]
+    meta = [e for e in events if e["ph"] == "M" and e["name"] == "thread_name"]
+    spans = [e for e in events if e["ph"] == "X"]
+    assert len(meta) == 2  # one per emitting thread
+    assert {m["args"]["name"] for m in meta} >= {"tpuft_quorum_0"}
+    # tids are distinct and every span's tid has a name event.
+    assert {s["tid"] for s in spans} == {m["tid"] for m in meta}
+    main_span = next(s for s in spans if s["name"] == "tpuft::test::main")
+    assert main_span["args"] == {"step": 3, "quorum_id": 7}
+    worker_span = next(s for s in spans if s["name"] == "tpuft::test::worker")
+    assert worker_span["args"] == {"step": 3}
+
+
+# ---------------------------------------------------------------------------
+# fleet table (scripts/fleet_status.py — pure functions, no sockets)
+# ---------------------------------------------------------------------------
+
+
+def _load_fleet_status():
+    import importlib.util
+    from pathlib import Path
+
+    spec = importlib.util.spec_from_file_location(
+        "fleet_status",
+        Path(__file__).resolve().parent.parent / "scripts" / "fleet_status.py",
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_fleet_status_render_and_extractors() -> None:
+    fleet_status = _load_fleet_status()
+    snap = {
+        "ts": 100.0,
+        "step": 12,
+        "batches_committed": 24,
+        "healing": False,
+        "metrics": {
+            "counters": {
+                "tpuft_commits_total": [
+                    {"labels": {"replica_id": "r0", "group_rank": "0"}, "value": 12.0}
+                ]
+            },
+            "gauges": {
+                "tpuft_last_commit_time": [{"labels": {}, "value": 99.0}]
+            },
+            "histograms": {},
+        },
+    }
+    assert fleet_status._counter_total(snap, "tpuft_commits_total") == 12.0
+    assert fleet_status._counter_total(snap, "absent") is None
+    assert fleet_status._gauge(snap, "tpuft_last_commit_time") == 99.0
+
+    table = {
+        "ts": 100.0,
+        "lighthouse": "lh:1234",
+        "quorum_id": 3,
+        "has_quorum": True,
+        "rows": [
+            {
+                "replica_id": "train_0:uuid",
+                "rank": 0,
+                "step": 12,
+                "steps_per_sec": 1.25,
+                "commits": 12.0,
+                "commit_failures": 0.0,
+                "heals": 1.0,
+                "last_commit_age_s": 1.0,
+                "healing": False,
+                "heartbeat_age_ms": 52.1,
+                "push_age_s": 0.4,
+            },
+            {"replica_id": "train_1:uuid", "rank": 0},  # store unreachable
+        ],
+    }
+    text = fleet_status.render(table)
+    lines = text.splitlines()
+    assert "quorum_id=3" in lines[0] and "replicas=2" in lines[0]
+    assert lines[1].split() == [
+        "REPLICA", "RANK", "STEP", "STEP/S", "COMMITS", "FAILED", "HEALS",
+        "LAST", "COMMIT", "HEALING", "HB", "AGE", "MS", "PUSH", "AGE",
+    ]
+    assert "train_0:uuid" in text and "1.25" in text and "1.0s" in text
+    # The dead replica renders dashes, not a crash.
+    dead_row = next(l for l in lines if l.startswith("train_1"))
+    assert "-" in dead_row

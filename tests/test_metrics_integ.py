@@ -1,0 +1,167 @@
+"""Metrics-plane integration: the FT phase counters must agree exactly
+with the accounting the train loops observe, across a real (threads-as-
+replicas) kill/heal drill, with the commit pipeline both on and off.
+
+The counters are the operator's only view of a fleet (fleet_status.py,
+/metrics scrapes): a commit counter that drifts from the committed-step
+truth, or a heal counter that misses a recovery, makes every dashboard
+built on them lie. These tests pin the agreement under the exact fault
+the plane exists to observe.
+"""
+
+import pytest
+
+from torchft_tpu.coordination import LighthouseServer
+
+from ft_harness import (
+    EventInjector,
+    Runner,
+    ddp_train_loop,
+    ft_counter_delta,
+    ft_counter_snapshot,
+    pipelined_ddp_train_loop,
+    run_replica_groups,
+)
+
+
+@pytest.fixture()
+def lighthouse():
+    # Same sizing rationale as test_manager_integ.py: join timeout above
+    # worst-case GIL step skew, fast heartbeat expiry for dead replicas.
+    server = LighthouseServer(
+        min_replicas=1,
+        join_timeout_ms=10000,
+        heartbeat_timeout_ms=1000,
+        quorum_tick_ms=20,
+    )
+    yield server
+    server.shutdown()
+
+
+def test_counters_exact_after_kill_heal_strict_ordering(
+    lighthouse, monkeypatch
+) -> None:
+    """Strict (non-pipelined) ordering: kill group 1 at step 1, heal, run
+    to step 4. Commits, commit failures, and heal roles must match the
+    loop's own accounting exactly."""
+    monkeypatch.setenv("TPUFT_STRICT_COMMIT", "1")
+    num_steps = 4
+    before = {g: ft_counter_snapshot(f"ddp_{g}") for g in range(2)}
+    injector = EventInjector().fail_at(group=1, step=1)
+    runners = [
+        Runner(
+            replica_group=i,
+            lighthouse_addr=lighthouse.address(),
+            train_loop=ddp_train_loop,
+            num_steps=num_steps,
+            injector=injector,
+            # No step-0 init-sync mosaic: the only heal the counters see
+            # is the one the kill causes, so the counts below are exact.
+            train_loop_args={"init_sync": False},
+        )
+        for i in range(2)
+    ]
+    results = run_replica_groups(runners, timeout=180)
+    assert injector.count == 1
+    deltas = {
+        g: ft_counter_delta(before[g], ft_counter_snapshot(f"ddp_{g}"))
+        for g in range(2)
+    }
+
+    survivor, survivor_metrics = results[0][0], deltas[0]
+    # The survivor never heals and commits every step it advances: its
+    # step counter went 0 -> num_steps, one commit per increment.
+    assert survivor["manager_state"]["step"] == num_steps
+    assert survivor_metrics["commits"] == num_steps
+    assert survivor_metrics["commit_failures"] == survivor["failed_commits"]
+    assert survivor_metrics["heals_donor"] == 1  # one restart, one donation
+    assert survivor_metrics["heals_joiner"] == 0
+    assert survivor_metrics["rollbacks"] == 0  # pipeline off
+    assert survivor_metrics["phantom_commits"] == 0
+
+    killed_metrics = deltas[1]
+    # The killed group healed exactly once (one injected death, one
+    # restart). Its commits accumulate across both attempts: the steps it
+    # committed before dying plus the post-heal steps — the heal adopts
+    # the donor's step without committing, so the total can never exceed
+    # num_steps, and the post-heal stretch guarantees at least one.
+    assert killed_metrics["heals_joiner"] == 1
+    assert killed_metrics["heals_donor"] == 0
+    assert 1 <= killed_metrics["commits"] <= num_steps
+    assert killed_metrics["rollbacks"] == 0
+    assert killed_metrics["phantom_commits"] == 0
+
+
+def test_counters_exact_after_kill_heal_pipelined(lighthouse) -> None:
+    """Pipelined ordering (commit depth 1): the kill lands with the
+    survivor's speculative vote in flight, so the survivor's rollback
+    counter must match its reported rollback count exactly — plus the
+    same commit/heal agreement as the strict drill."""
+    num_steps = 5
+    before = {g: ft_counter_snapshot(f"ddp_{g}") for g in range(2)}
+    injector = EventInjector().fail_at(group=1, step=2)
+    runners = [
+        Runner(
+            replica_group=i,
+            lighthouse_addr=lighthouse.address(),
+            train_loop=pipelined_ddp_train_loop,
+            num_steps=num_steps,
+            injector=injector,
+            manager_args={"init_sync": False},
+        )
+        for i in range(2)
+    ]
+    results = run_replica_groups(runners, timeout=240)
+    assert injector.count == 1
+    deltas = {
+        g: ft_counter_delta(before[g], ft_counter_snapshot(f"ddp_{g}"))
+        for g in range(2)
+    }
+
+    survivor, survivor_metrics = results[0][0], deltas[0]
+    assert survivor["manager_state"]["step"] == num_steps
+    assert survivor_metrics["commits"] == num_steps
+    assert survivor_metrics["commit_failures"] == survivor["failed_commits"]
+    # The survivor discovered the death through a failed pipelined vote
+    # and rolled back its speculative update; its counter and its own
+    # accounting must agree exactly.
+    assert survivor_metrics["rollbacks"] == survivor["rollbacks"]
+    assert survivor["rollbacks"] >= 1
+    assert survivor_metrics["heals_donor"] == 1
+    assert survivor_metrics["heals_joiner"] == 0
+    assert survivor_metrics["phantom_commits"] == 0
+
+    killed, killed_metrics = results[1][0], deltas[1]
+    assert killed_metrics["heals_joiner"] == 1
+    assert killed_metrics["heals_donor"] == 0
+    assert 1 <= killed_metrics["commits"] <= num_steps
+    # The final attempt's rollbacks are reported; the dying attempt may
+    # have added more (its drained pipeline), never fewer.
+    assert killed_metrics["rollbacks"] >= killed["rollbacks"]
+    assert killed_metrics["phantom_commits"] == 0
+
+
+def test_counters_quiet_run_no_spurious_faults(lighthouse) -> None:
+    """A healthy 2-group run contributes commits and nothing else — no
+    heals, rollbacks, phantom commits, or errors (init-sync mosaic off).
+    Guards against instrumentation on a hot path misfiring."""
+    num_steps = 3
+    before = {g: ft_counter_snapshot(f"ddp_{g}") for g in range(2)}
+    runners = [
+        Runner(
+            replica_group=i,
+            lighthouse_addr=lighthouse.address(),
+            train_loop=ddp_train_loop,
+            num_steps=num_steps,
+            train_loop_args={"init_sync": False},
+        )
+        for i in range(2)
+    ]
+    results = run_replica_groups(runners)
+    for g in range(2):
+        delta = ft_counter_delta(before[g], ft_counter_snapshot(f"ddp_{g}"))
+        assert delta["commits"] == num_steps
+        assert delta["commit_failures"] == results[g][0]["failed_commits"]
+        assert delta["heals_donor"] == 0 and delta["heals_joiner"] == 0
+        assert delta["rollbacks"] == 0 and delta["phantom_commits"] == 0
+        assert delta["errors"] == 0

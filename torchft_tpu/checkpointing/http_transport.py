@@ -25,6 +25,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 import jax
 
+from torchft_tpu import metrics
 from torchft_tpu._safe_pickle import safe_loads
 from torchft_tpu.utils import netem
 from torchft_tpu.checkpointing import _serialization
@@ -68,6 +69,11 @@ class HTTPTransport(CheckpointTransport[Any]):
                 pass
 
             def do_GET(self) -> None:
+                # The transport's port doubles as this process's scrape
+                # endpoint: every training replica already listens here for
+                # heals, so /metrics needs no extra server or port.
+                if metrics._serve_metrics_http(self, metrics.REGISTRY, self.path):
+                    return
                 parts = self.path.strip("/").split("/")
                 if len(parts) != 3 or parts[0] != "checkpoint":
                     self.send_error(404, "unknown route")
@@ -77,6 +83,7 @@ class HTTPTransport(CheckpointTransport[Any]):
                 except ValueError:
                     self.send_error(400, "bad step")
                     return
+                stall_t0 = time.perf_counter()
                 with transport._cond:
                     transport._cond.wait_for(
                         lambda: transport._staged is not None
@@ -84,6 +91,12 @@ class HTTPTransport(CheckpointTransport[Any]):
                         timeout=transport._timeout,
                     )
                     staged = transport._staged
+                # Donor-side stall: how long this GET parked waiting for the
+                # trainer to stage the requested step.
+                metrics.observe(
+                    "tpuft_ckpt_donor_stall_seconds",
+                    time.perf_counter() - stall_t0,
+                )
                 if staged is None or staged.step != step:
                     self.send_error(
                         404,

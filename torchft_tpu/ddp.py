@@ -19,12 +19,14 @@ and the optimizer:
 from __future__ import annotations
 
 import threading
+import time
 import weakref
 from typing import Any, List
 
 import jax
 import numpy as np
 
+from torchft_tpu import metrics
 from torchft_tpu.manager import Manager
 from torchft_tpu.utils.transfer import prefetch_to_host
 from torchft_tpu.work import Work
@@ -160,14 +162,23 @@ def ft_allreduce_gradients(
             flat = np.concatenate(
                 [np.asarray(leaves[i]).reshape(-1) for i in members]
             )
+        metrics.inc("tpuft_wire_bytes_total", flat.nbytes, path="bucket")
         works.append(manager.allreduce(flat))
 
     # Stage 3: consume buckets in completion order; each averaged bucket's
     # host→device transfer dispatches (async) while later buckets are still
-    # on the wire.
+    # on the wire. The per-bucket wait below is the OBSERVED wire time —
+    # later buckets' waits overlap earlier returns, so the histogram reads
+    # as "time this bucket held the step up", not raw link occupancy.
     out: List[Any] = [None] * len(leaves)
     for members, work in zip(buckets, works):
+        wire_t0 = time.perf_counter()
         flat = np.asarray(work.wait())
+        metrics.observe(
+            "tpuft_wire_bucket_seconds",
+            time.perf_counter() - wire_t0,
+            path="bucket",
+        )
         offset = 0
         for i in members:
             orig = leaves[i]
@@ -282,7 +293,13 @@ def _ft_allreduce_gradients_fp8(manager: Manager, grads: Any) -> Any:
                 )
             )
         for (members, dequantize, _, _), future in zip(quantized, futures):
+            wire_t0 = time.perf_counter()
             result = future.result()
+            metrics.observe(
+                "tpuft_wire_bucket_seconds",
+                time.perf_counter() - wire_t0,
+                path="fp8",
+            )
             if result is None:
                 # Allreduce failed (error already reported; the step will
                 # not commit): hand back the local gradients, same contract

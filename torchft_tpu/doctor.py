@@ -33,6 +33,7 @@ KNOWN_ENV = {
     "TPUFT_FLIGHT_RECORDER", "TPUFT_FLIGHT_RECORDER_SIZE",
     "TPUFT_HEARTBEAT_INTERVAL", "TPUFT_INIT_SYNC", "TPUFT_STRICT_COMMIT",
     "TPUFT_COMMIT_PIPELINE", "TPUFT_EMULATED_DEVICE_RTT_MS",
+    "TPUFT_METRICS_PORT", "TPUFT_METRICS_PUSH_SEC",
     "TPUFT_BENCH_CHILD",
     "TPUFT_BENCH_MODEL", "TPUFT_BENCH_STEPS", "TPUFT_BENCH_BATCH",
     "TPUFT_BENCH_SEQ", "TPUFT_BENCH_SYNC_EVERY", "TPUFT_BENCH_SYNC_DELAY",
@@ -134,6 +135,41 @@ def _check_kernels() -> Tuple[str, str]:
     return "PASS", "host wire codecs (fp8/int8/int4) roundtrip ok"
 
 
+def _check_metrics() -> Tuple[str, str]:
+    """Probes the local /metrics endpoint when TPUFT_METRICS_PORT is set.
+    Never FAILs: the metrics plane is optional, and a dead scrape endpoint
+    must not block a launch the way a dead native plane should."""
+    from torchft_tpu import metrics
+
+    value = os.environ.get(metrics.ENV_PORT, "")
+    if not value:
+        return (
+            "PASS",
+            f"metrics export off (set {metrics.ENV_PORT} to serve /metrics)",
+        )
+    try:
+        port = int(value)
+    except ValueError:
+        return "WARN", f"{metrics.ENV_PORT}={value!r} is not an integer"
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5
+        ) as resp:
+            body = resp.read().decode(errors="replace")
+    except Exception as e:  # noqa: BLE001 — WARN, never FAIL, on any probe error
+        return (
+            "WARN",
+            f"no /metrics listener on 127.0.0.1:{port} ({e}) — is a "
+            "replica (or metrics.maybe_start_http_server) running here?",
+        )
+    n_series = sum(
+        1 for line in body.splitlines() if line and not line.startswith("#")
+    )
+    return "PASS", f"/metrics on :{port} serving {n_series} series"
+
+
 def _check_env() -> Tuple[str, str]:
     # Value validation first — a fatal misconfig must FAIL even when a
     # typo'd var would also WARN.
@@ -155,6 +191,7 @@ def run_checks(lighthouse: str, skip_device: bool = False) -> int:
         ("kv store", _check_store),
         ("wire codecs", _check_kernels),
         ("env vars", _check_env),
+        ("metrics", _check_metrics),
         ("lighthouse", lambda: _check_lighthouse(lighthouse)),
     ]
     if not skip_device:

@@ -207,6 +207,13 @@ class CommitPipeline:
         self._lock = threading.Lock()
         self._records: list = []
 
+    def _note_occupancy(self) -> None:
+        # Called under self._lock. Lazy import: futures is a leaf module
+        # metrics itself may one day time — keep the import edge one-way.
+        from torchft_tpu import metrics
+
+        metrics.set_gauge("tpuft_pipeline_pending", len(self._records))
+
     @property
     def depth(self) -> int:
         return self._depth
@@ -227,6 +234,10 @@ class CommitPipeline:
                     "oldest pending step before dispatching another"
                 )
             self._records.append(record)
+            from torchft_tpu import metrics
+
+            metrics.inc("tpuft_pipeline_steps_total")
+            self._note_occupancy()
 
     def oldest(self) -> Optional[Any]:
         with self._lock:
@@ -236,6 +247,7 @@ class CommitPipeline:
         with self._lock:
             if record in self._records:
                 self._records.remove(record)
+                self._note_occupancy()
 
     def pending(self) -> tuple:
         """Snapshot of the pending records, oldest first."""
@@ -248,4 +260,5 @@ class CommitPipeline:
         step protocols."""
         with self._lock:
             records, self._records = tuple(self._records), []
+            self._note_occupancy()
             return records

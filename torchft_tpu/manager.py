@@ -33,9 +33,11 @@ step as XLA psums over the device mesh (parallel/mesh.py).
 from __future__ import annotations
 
 import concurrent.futures
+import json
 import logging
 import os
 import socket
+import time
 import traceback
 import uuid
 from enum import Enum
@@ -43,6 +45,7 @@ from typing import Any, Callable, Dict, List, Optional, TypeVar, cast
 
 import numpy as np
 
+from torchft_tpu import metrics
 from torchft_tpu.checkpointing import CheckpointTransport, HTTPTransport
 from torchft_tpu.checkpointing._rwlock import RWLock
 from torchft_tpu.coordination import ManagerClient, ManagerServer
@@ -331,6 +334,18 @@ class Manager:
 
         self._logger = _ManagerLogger(self, self._replica_id, self._group_rank)
 
+        # Fleet metrics: every phase counter/histogram below is labeled with
+        # the STABLE replica id (the user prefix, without the per-process
+        # uuid suffix) so counters accumulate across supervised restarts of
+        # the same replica group — the operator-facing identity.
+        self._metric_labels = {
+            "replica_id": self._replica_id.split(":", 1)[0] or "replica",
+            "group_rank": str(self._group_rank),
+        }
+        self._metrics_push_interval = metrics.push_interval_sec()
+        self._metrics_last_push = 0.0
+        metrics.maybe_start_http_server()
+
     # ------------------------------------------------------------------
     # state dict registry
     # ------------------------------------------------------------------
@@ -421,7 +436,7 @@ class Manager:
         if self.errored():
             return _DummyWork(tensor)
 
-        with trace_span("tpuft::manager::allreduce"):
+        with trace_span("tpuft::manager::allreduce", step=self._step):
             return self._allreduce_impl(tensor, should_quantize, reduce_op)
 
     def _allreduce_impl(
@@ -486,7 +501,7 @@ class Manager:
                 )
         if self.errored():
             return _DummyWork(pytree)
-        with trace_span("tpuft::manager::allreduce_pytree"):
+        with trace_span("tpuft::manager::allreduce_pytree", step=self._step):
             self.wait_quorum()
             num_participants = self.num_participants()
             if self.is_lone_replica():
@@ -596,6 +611,7 @@ class Manager:
         """Records an error for this step: the step will not commit and the
         comm layer is reconfigured on the next quorum."""
         self._errored = ExceptionWithTraceback(e)
+        metrics.inc("tpuft_errors_total", **self._metric_labels)
         errors_logger.info(
             "error",
             extra={
@@ -696,13 +712,15 @@ class Manager:
     def wait_quorum(self) -> None:
         """Blocks until the quorum completes; the PG is healthy after."""
         assert self._quorum_future is not None, "must call start_quorum before wait_quorum"
-        with trace_span("tpuft::manager::wait_quorum"):
+        with trace_span("tpuft::manager::wait_quorum", step=self._step):
             self._quorum_future.result()
 
     def _async_quorum(
         self, allow_heal: bool, shrink_only: bool, quorum_timeout: float
     ) -> None:
-        with trace_span("tpuft::manager::_client::_quorum"):
+        with trace_span(
+            "tpuft::manager::_client::_quorum", step=self._step
+        ), metrics.timer("tpuft_quorum_seconds", **self._metric_labels):
             quorum = self._client._quorum(
                 group_rank=self._group_rank,
                 step=self._step,
@@ -734,7 +752,14 @@ class Manager:
             ):
                 self._participating_replica_rank = None
 
+        metrics.set_gauge(
+            "tpuft_participants",
+            self._participating_replica_world_size,
+            **self._metric_labels,
+        )
+
         if quorum.quorum_id != self._quorum_id:
+            metrics.inc("tpuft_quorum_changes_total", **self._metric_labels)
             quorums_logger.info(
                 "quorum",
                 extra={
@@ -764,13 +789,18 @@ class Manager:
                     )
                     self.report_error(e)
             try:
-                with trace_span("tpuft::manager::_pg::configure"):
+                with trace_span(
+                    "tpuft::manager::_pg::configure",
+                    quorum_id=quorum.quorum_id,
+                    step=self._step,
+                ), metrics.timer("tpuft_pg_configure_seconds", **self._metric_labels):
                     self._pg.configure(
                         store_prefixed_addr,
                         self._replica_id,
                         quorum.replica_rank,
                         quorum.replica_world_size,
                     )
+                metrics.inc("tpuft_pg_configure_total", **self._metric_labels)
                 self._quorum_id = quorum.quorum_id
             except Exception as e:  # noqa: BLE001
                 self._logger.exception(f"got exception in pg configure: {e}")
@@ -783,8 +813,15 @@ class Manager:
                     self._logger.info(
                         f"peers need recovery from us {quorum.recover_dst_replica_ranks}"
                     )
+                    metrics.inc(
+                        "tpuft_heals_total", role="donor", **self._metric_labels
+                    )
                     with trace_span(
-                        "tpuft::manager::_checkpoint_transport::send_checkpoint"
+                        "tpuft::manager::_checkpoint_transport::send_checkpoint",
+                        quorum_id=quorum.quorum_id,
+                        step=quorum.max_step,
+                    ), metrics.timer(
+                        "tpuft_heal_send_seconds", **self._metric_labels
                     ):
                         self._checkpoint_transport.send_checkpoint(
                             dst_ranks=quorum.recover_dst_replica_ranks,
@@ -795,6 +832,10 @@ class Manager:
 
                 if quorum.heal:
                     self._healing = True
+                    metrics.set_gauge("tpuft_healing", 1, **self._metric_labels)
+                    metrics.inc(
+                        "tpuft_heals_total", role="joiner", **self._metric_labels
+                    )
                     self._logger.info(
                         "healing required, fetching checkpoint metadata from "
                         f"{quorum.recover_src_manager_address} max_step={quorum.max_step}"
@@ -811,7 +852,11 @@ class Manager:
                         quorum.recover_src_replica_rank is not None
                     ), "must have a recover rank when healing"
                     with trace_span(
-                        "tpuft::manager::_checkpoint_transport::recv_checkpoint"
+                        "tpuft::manager::_checkpoint_transport::recv_checkpoint",
+                        quorum_id=quorum.quorum_id,
+                        step=quorum.max_step,
+                    ), metrics.timer(
+                        "tpuft_heal_recv_seconds", **self._metric_labels
                     ):
                         self._pending_state_dict = self._checkpoint_transport.recv_checkpoint(
                             src_rank=quorum.recover_src_replica_rank,
@@ -841,6 +886,7 @@ class Manager:
         for key, load_fn in self._load_state_dict_fns.items():
             load_fn(pending_user[key])
         self._pending_state_dict = None
+        metrics.set_gauge("tpuft_healing", 0, **self._metric_labels)
         self._logger.info("Loaded state dict.")
 
     # ------------------------------------------------------------------
@@ -885,7 +931,11 @@ class Manager:
 
         enough_replicas = self.num_participants() >= self._min_replica_size
         local_should_commit = enough_replicas and self._errored is None
-        with trace_span("tpuft::manager::should_commit"):
+        with trace_span(
+            "tpuft::manager::should_commit",
+            step=self._step,
+            quorum_id=self._quorum_id,
+        ), metrics.timer("tpuft_commit_barrier_seconds", **self._metric_labels):
             should_commit = self._client.should_commit(
                 self._group_rank,
                 self._step,
@@ -914,8 +964,19 @@ class Manager:
             self._step += 1
             self._batches_committed += self.num_participants()
             self._commit_failures = 0
+            metrics.inc("tpuft_commits_total", **self._metric_labels)
+            metrics.set_gauge(
+                "tpuft_last_commit_time", time.time(), **self._metric_labels
+            )
         else:
             self._commit_failures += 1
+            metrics.inc("tpuft_commit_failures_total", **self._metric_labels)
+        metrics.set_gauge("tpuft_step", self._step, **self._metric_labels)
+        metrics.set_gauge(
+            "tpuft_batches_committed", self._batches_committed, **self._metric_labels
+        )
+        self._push_metrics()
+        if not should_commit:
             if self._max_retries is not None and self._commit_failures > self._max_retries:
                 msg = (
                     f"should_commit failed {self._commit_failures} times consecutively, "
@@ -924,6 +985,43 @@ class Manager:
                 self._logger.exception(msg)
                 raise RuntimeError(msg)
         return should_commit
+
+    # ------------------------------------------------------------------
+    # metrics push (the fleet-table feed)
+    # ------------------------------------------------------------------
+
+    def _push_metrics(self, force: bool = False) -> None:
+        """Publishes this process's metrics snapshot into the group store
+        under ``metrics/<replica_id>/<group_rank>`` (rate-limited by
+        ``$TPUFT_METRICS_PUSH_SEC``). The replica id key is the FULL id
+        (uuid included) — exactly what the lighthouse status reports for
+        this group — so ``scripts/fleet_status.py`` can join lighthouse
+        members to their snapshots without a key-listing RPC the store
+        does not have. Best-effort: a push failure never poisons a step."""
+        interval = self._metrics_push_interval
+        if interval <= 0:
+            return
+        now = time.monotonic()
+        if not force and now - self._metrics_last_push < interval:
+            return
+        self._metrics_last_push = now
+        try:
+            payload = json.dumps(
+                {
+                    "ts": time.time(),
+                    "replica_id": self._replica_id,
+                    "group_rank": self._group_rank,
+                    "step": self._step,
+                    "batches_committed": self._batches_committed,
+                    "healing": self._healing,
+                    "metrics": metrics.snapshot(),
+                }
+            ).encode()
+            self._store.set(
+                f"metrics/{self._replica_id}/{self._group_rank}", payload
+            )
+        except Exception as e:  # noqa: BLE001 — observability must not wound
+            self._logger.warn(f"metrics push failed (ignored): {e}")
 
     # ------------------------------------------------------------------
     # state dict / accounting

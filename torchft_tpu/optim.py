@@ -29,12 +29,15 @@ from __future__ import annotations
 import logging
 import os
 import threading
+import time
 from typing import Any, Optional
 
 import jax
 import numpy as np
 
+from torchft_tpu import metrics
 from torchft_tpu.manager import Manager
+from torchft_tpu.utils.profiling import trace_span
 
 logger = logging.getLogger(__name__)
 
@@ -57,6 +60,26 @@ def _bound_device(x: Any) -> Any:
     round trip this machine's tunnel charges (~73 ms — the cost the
     pipelined mode exists to hide)."""
     return jax.block_until_ready(x)
+
+
+def _replica_labels(manager: Any) -> dict:
+    """The manager's stable replica labels for optimizer-side counters
+    (rollbacks, phantom commits), so drills can count them per replica
+    group; {} for scripted/mocked managers without the attribute."""
+    return getattr(manager, "_metric_labels", None) or {}
+
+
+def _sync_device(x: Any) -> Any:
+    """Every step's device sync, timed into ``tpuft_device_sync_seconds``.
+
+    Calls through the module global so spies and the netem shim that rebind
+    ``_bound_device`` still intercept the sync — and their emulated/observed
+    latency lands in the phase histogram like the real one."""
+    start = time.perf_counter()
+    try:
+        return _bound_device(x)
+    finally:
+        metrics.observe("tpuft_device_sync_seconds", time.perf_counter() - start)
 
 
 def make_microbatch_grad(loss_fn: Any, num_microbatches: int):
@@ -310,9 +333,14 @@ class _PendingStep:
             if not self._bound:
                 self._bound = True
                 try:
-                    _bound_device(self.loss)
+                    _sync_device(self.loss)
                 except BaseException as e:  # noqa: BLE001
                     self._bound_error = e
+                    if self.committed:
+                        metrics.inc(
+                            "tpuft_phantom_commits_total",
+                            **_replica_labels(self.manager),
+                        )
                     logger.error(
                         "pipelined step's device work failed after its commit "
                         "vote resolved committed=%s (a committed step here "
@@ -389,7 +417,7 @@ class Optimizer:
         # Bound the device work before voting: a replica whose math never
         # finished must not vote to commit (the stream-sync analogue of
         # reference manager.py:816-827).
-        grads = _bound_device(grads)
+        grads = _sync_device(grads)
         heal_count = self._heal_count
         # Snapshot the state refs, THEN launch the barrier: the RPC is in
         # flight while the update dispatches below. A concurrent heal can
@@ -398,7 +426,8 @@ class Optimizer:
         params, opt_state = self.params, self.opt_state
         commit_future = self.manager.should_commit_async(timeout)
         try:
-            spec = self._jit_update(grads, opt_state, params)
+            with metrics.timer("tpuft_update_dispatch_seconds"):
+                spec = self._jit_update(grads, opt_state, params)
         except BaseException:
             # The barrier is already in flight and may commit the step
             # (the vote was computed from pre-dispatch health); never leave
@@ -418,6 +447,11 @@ class Optimizer:
                     "dispatch failure; barrier outcome lost to the re-raise"
                 )
             else:
+                if barrier_result:
+                    metrics.inc(
+                        "tpuft_phantom_commits_total",
+                        **_replica_labels(self.manager),
+                    )
                 logger.error(
                     "optimizer dispatch failed with the commit barrier in "
                     "flight; barrier resolved committed=%s (a committed step "
@@ -498,25 +532,34 @@ class Optimizer:
         with rec._lock:
             if rec.committed is not None:
                 return rec.committed
-            committed = rec.commit_future.result()
-            self.manager.disallow_state_dict_read()
-            try:
-                if self._heal_count != rec.heal_count:
-                    # Healed mid-flight: the donor state is authoritative;
-                    # a committed step still owes its update (pre-heal
-                    # grads applied to the healed state — reference
-                    # load_state_dict + optimizer.step() order).
-                    if committed:
-                        self.params, self.opt_state = rec.recompute()
-                elif not committed:
-                    # Refuse to adopt: restore the pre-step state the
-                    # speculation was dispatched from.
-                    self.params, self.opt_state = rec.snapshot
-                    self.rollback_count += 1
-            finally:
-                self.manager.allow_state_dict_read()
-            rec.committed = committed
-            return committed
+            with trace_span(
+                "tpuft::optim::resolve_pipelined_commit",
+                step=self.manager.current_step(),
+            ):
+                committed = rec.commit_future.result()
+                self.manager.disallow_state_dict_read()
+                try:
+                    if self._heal_count != rec.heal_count:
+                        # Healed mid-flight: the donor state is
+                        # authoritative; a committed step still owes its
+                        # update (pre-heal grads applied to the healed state
+                        # — reference load_state_dict + optimizer.step()
+                        # order).
+                        if committed:
+                            self.params, self.opt_state = rec.recompute()
+                    elif not committed:
+                        # Refuse to adopt: restore the pre-step state the
+                        # speculation was dispatched from.
+                        self.params, self.opt_state = rec.snapshot
+                        self.rollback_count += 1
+                        metrics.inc(
+                            "tpuft_rollbacks_total",
+                            **_replica_labels(self.manager),
+                        )
+                finally:
+                    self.manager.allow_state_dict_read()
+                rec.committed = committed
+                return committed
 
     def flush_pipeline(self, raise_on_error: bool = True) -> Optional[bool]:
         """Resolves every pending pipelined step (vote + rollback + device
@@ -622,9 +665,10 @@ class Optimizer:
                 # reference keeps the pre-heal state alive for the rare
                 # heal-during-barrier recompute below.
                 pre_params = self.params
-                loss, spec_params, spec_opt_state = fused(
-                    self.params, self.opt_state, *batch
-                )
+                with metrics.timer("tpuft_update_dispatch_seconds"):
+                    loss, spec_params, spec_opt_state = fused(
+                        self.params, self.opt_state, *batch
+                    )
                 # Launch the barrier BEFORE the device sync so the commit
                 # RPC rides under the readiness wait instead of after it
                 # (on a high-latency device link the sync alone costs a
@@ -646,11 +690,11 @@ class Optimizer:
                 # any vote leaves, the pre-change semantics exactly.
                 strict = os.environ.get("TPUFT_STRICT_COMMIT", "0") == "1"
                 if strict:
-                    _bound_device(loss)
+                    _sync_device(loss)
                 commit_future = self.manager.should_commit_async(None)
                 if not strict:
                     try:
-                        _bound_device(loss)
+                        _sync_device(loss)
                     except BaseException:
                         try:
                             barrier_result = commit_future.result()
@@ -661,6 +705,11 @@ class Optimizer:
                                 "to the re-raise"
                             )
                         else:
+                            if barrier_result:
+                                metrics.inc(
+                                    "tpuft_phantom_commits_total",
+                                    **_replica_labels(self.manager),
+                                )
                             logger.error(
                                 "fused step sync failed with the commit barrier "
                                 "in flight; barrier resolved committed=%s (a "
@@ -774,7 +823,8 @@ class Optimizer:
             lone = manager.errored() is None and manager.is_lone_replica()
             was_wire[0] = not lone
             if lone:
-                loss, spec_params, spec_opt = fused(pre_params, pre_opt, *batch)
+                with metrics.timer("tpuft_update_dispatch_seconds"):
+                    loss, spec_params, spec_opt = fused(pre_params, pre_opt, *batch)
                 spec = (spec_params, spec_opt)
 
                 def recompute(pre_params=pre_params, batch=batch):

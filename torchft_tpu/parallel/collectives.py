@@ -17,11 +17,13 @@ on-device path).
 
 from __future__ import annotations
 
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import List, Sequence, Tuple
 
 import numpy as np
 
+from torchft_tpu import metrics
 from torchft_tpu.ops import quantization as q
 from torchft_tpu.parallel.process_group import ProcessGroup, ReduceOp
 from torchft_tpu.utils.transfer import prefetch_to_host
@@ -114,8 +116,14 @@ def allreduce_quantized(
         return Work.completed(result)
 
     wire_bufs, metas = _quantize_and_chunk(arrays, world_size, wire_dtype)
+    metrics.inc(
+        "tpuft_wire_bytes_total",
+        sum(buf.nbytes for buf in wire_bufs),
+        path="quantized",
+    )
 
     def pipeline() -> List[np.ndarray]:
+        pipeline_t0 = time.perf_counter()
         # 1. alltoall: rank r receives everyone's chunk r.
         received = pg.alltoall(wire_bufs).wait()
         # 2. fused dequant-reduce-requant per array chunk.
@@ -141,6 +149,9 @@ def allreduce_quantized(
             outputs.append(
                 q.dequantize_blocks(payload, scales, meta["shape"], meta["dtype"])
             )
+        metrics.observe(
+            "tpuft_quantized_pipeline_seconds", time.perf_counter() - pipeline_t0
+        )
         return outputs
 
     return Work(_PIPELINE_POOL.submit(pipeline))
@@ -238,6 +249,12 @@ def allreduce_quantized_wire(
             )
             for r in range(world_size)
         ]
+        metrics.inc(
+            "tpuft_wire_bytes_total",
+            sum(buf.nbytes for buf in wire_bufs),
+            path="quantized",
+        )
+        pipeline_t0 = time.perf_counter()
         received = pg.alltoall(wire_bufs).wait()
         payloads, chunk_scales = zip(
             *(q.unpack_arrays(buf, blocks_per_rank, wire=wire) for buf in received)
@@ -254,6 +271,9 @@ def allreduce_quantized_wire(
             full_scales.append(s_chunk)
         payload_out = np.concatenate(full_payloads)[:n_blocks]
         scales_out = np.concatenate(full_scales)[:n_blocks]
+        metrics.observe(
+            "tpuft_quantized_pipeline_seconds", time.perf_counter() - pipeline_t0
+        )
         return payload_out, scales_out
 
     return Work(_PIPELINE_POOL.submit(pipeline))

@@ -13,6 +13,12 @@ Export is opt-in via ``TPUFT_TELEMETRY``:
     the standard ``OTEL_*`` env, mirroring the reference's
     ``TORCHFT_USE_OTEL`` path, otel.py:42-79)
   - unset: records flow to whatever handlers the application configures.
+
+Telemetry narrates (one record per event, with ids); the fleet metrics
+plane (``torchft_tpu.metrics``) counts — per-phase histograms and
+commit/rollback/heal counters served on ``/metrics`` and pushed to the
+group store for ``scripts/fleet_status.py``. Correlate the two through
+quorum_id/step; docs/observability.md is the combined debugging guide.
 """
 
 from __future__ import annotations

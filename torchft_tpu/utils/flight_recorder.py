@@ -99,10 +99,26 @@ def _snapshot_meta() -> "tuple[List[Dict[str, Any]], bool]":
     return out, True
 
 
+def _metrics_trailer() -> Optional[Dict[str, Any]]:
+    """The process's metrics snapshot as a trailer record, so post-mortems
+    carry the phase counters (commits, rollbacks, heals, wire/sync
+    histograms) at time of abort next to the event ring. Never raises and
+    never imports eagerly — the recorder must stay a leaf module that
+    works during interpreter teardown."""
+    try:
+        from torchft_tpu import metrics
+
+        return {"metrics": metrics.snapshot(), "ts": time.time()}
+    except Exception:
+        return None
+
+
 def dump(path: Optional[str] = None, reason: str = "") -> Optional[str]:
     """Writes the ring as JSON lines. With no ``path``, uses a fresh
     ``$TPUFT_FLIGHT_RECORDER/tpuft_fr_<pid>_<ns>.jsonl`` — or does
-    nothing (returns None) when the env is unset. Returns the path."""
+    nothing (returns None) when the env is unset. Returns the path. The
+    last line is a ``{"metrics": ...}`` trailer record (counter state at
+    dump time)."""
     if path is None:
         directory = os.environ.get(ENV_DIR, "")
         if not directory:
@@ -114,6 +130,7 @@ def dump(path: Optional[str] = None, reason: str = "") -> Optional[str]:
             directory, f"tpuft_fr_{os.getpid()}_{time.time_ns()}.jsonl"
         )
     entries, truncated = _snapshot_meta()
+    trailer = _metrics_trailer()
     # Atomic: a chaos kill mid-dump must never leave a truncated JSONL at
     # the final name (the soak asserts every surviving dump parses).
     tmp = f"{path}.tmp.{os.getpid()}"
@@ -126,6 +143,8 @@ def dump(path: Optional[str] = None, reason: str = "") -> Optional[str]:
                 f.write(json.dumps(header) + "\n")
             for entry in entries:
                 f.write(json.dumps(entry) + "\n")
+            if trailer is not None:
+                f.write(json.dumps(trailer) + "\n")
         os.replace(tmp, path)
     return path
 

@@ -25,28 +25,69 @@ logger = logging.getLogger("torchft_tpu.trace")
 
 _LOG_SPANS = os.environ.get("TPUFT_TRACE_LOG", "") == "1"
 
-# Active chrome-trace capture: (event list, lock) or None.
-_CHROME: Optional[tuple] = None
+class _ChromeCapture:
+    """One active chrome-trace capture: the event list plus per-thread
+    bookkeeping so each thread's FIRST span also emits a ``thread_name``
+    metadata ("M") event — without it the pipelined-commit spans (which
+    resolve on the tpuft_quorum executor and the PG op-worker threads)
+    interleave as anonymous numeric tids in chrome://tracing."""
+
+    def __init__(self) -> None:
+        self.events: List[dict] = []
+        self.lock = threading.Lock()
+        self._named_tids: set = set()
+
+    def add_span(self, name: str, start: float, elapsed: float, args: dict) -> None:
+        thread = threading.current_thread()
+        tid = threading.get_ident() % 2**31
+        event = {
+            "name": name,
+            "ph": "X",
+            "ts": start * 1e6,
+            "dur": elapsed * 1e6,
+            "pid": os.getpid(),
+            "tid": tid,
+            "cat": "tpuft",
+        }
+        if args:
+            event["args"] = args
+        with self.lock:
+            if tid not in self._named_tids:
+                self._named_tids.add(tid)
+                self.events.append(
+                    {
+                        "name": "thread_name",
+                        "ph": "M",
+                        "pid": os.getpid(),
+                        "tid": tid,
+                        "args": {"name": thread.name},
+                    }
+                )
+            self.events.append(event)
+
+
+# Active chrome-trace capture, or None.
+_CHROME: Optional[_ChromeCapture] = None
 
 
 @contextmanager
 def chrome_trace(path: str) -> Generator[None, None, None]:
     """Captures every :func:`trace_span` in the with-body as chrome-trace
-    "X" (complete) events and writes them to ``path`` on exit. Captures may
+    "X" (complete) events — plus one ``thread_name`` metadata event per
+    emitting thread — and writes them to ``path`` on exit. Captures may
     nest/overlap (the previous capture is restored on exit); spans still
     open on other threads when the capture ends record into the old list
     harmlessly (they are not in the written file)."""
     global _CHROME
-    events: List[dict] = []
-    lock = threading.Lock()
+    capture = _ChromeCapture()
     previous = _CHROME
-    _CHROME = (events, lock)
+    _CHROME = capture
     try:
         yield
     finally:
         _CHROME = previous
-        with lock:
-            snapshot = list(events)
+        with capture.lock:
+            snapshot = list(capture.events)
         with open(path, "w") as f:
             json.dump({"traceEvents": snapshot, "displayTimeUnit": "ms"}, f)
         logger.info(
@@ -55,9 +96,12 @@ def chrome_trace(path: str) -> Generator[None, None, None]:
 
 
 @contextmanager
-def trace_span(name: str) -> Generator[None, None, None]:
+def trace_span(name: str, **args: "int | float | str") -> Generator[None, None, None]:
     """Marks a region on the jax profiler timeline (no-op cost when no
-    capture is active) and on any active :func:`chrome_trace` capture."""
+    capture is active) and on any active :func:`chrome_trace` capture.
+    ``args`` (e.g. ``step=``, ``quorum_id=``) land in the chrome event's
+    ``args`` dict so a merged kill/heal trace stays correlatable across
+    the train-loop / quorum / op-worker threads."""
     try:
         import jax.profiler
 
@@ -75,19 +119,7 @@ def trace_span(name: str) -> Generator[None, None, None]:
             annotation.__exit__(None, None, None)
         elapsed = time.monotonic() - start
         if chrome is not None:
-            events, lock = chrome
-            with lock:
-                events.append(
-                    {
-                        "name": name,
-                        "ph": "X",
-                        "ts": start * 1e6,
-                        "dur": elapsed * 1e6,
-                        "pid": os.getpid(),
-                        "tid": threading.get_ident() % 2**31,
-                        "cat": "tpuft",
-                    }
-                )
+            chrome.add_span(name, start, elapsed, args)
         if _LOG_SPANS:
             logger.info("%s took %.3fms", name, elapsed * 1000)
 
