@@ -1,0 +1,14 @@
+#!/usr/bin/env python
+"""scripts/ entry for the static analyzer — exactly
+``python -m torchft_tpu.analysis`` (one-line findings, exit code for CI).
+See docs/static_analysis.md."""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from torchft_tpu.analysis.__main__ import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -34,3 +34,30 @@ except AttributeError:  # older jax: the XLA_FLAGS fallback above covers it
 REPO_ROOT = Path(__file__).resolve().parent.parent
 if str(REPO_ROOT) not in sys.path:
     sys.path.insert(0, str(REPO_ROOT))
+
+import pytest  # noqa: E402
+
+
+# Turn NativeToolchainMissing (no cmake/ninja, no prebuilt libtpuft.so)
+# into a skip with a clear reason, wherever it surfaces — fixture setup or
+# the test body. Everything else passes through untouched.
+
+
+@pytest.hookimpl(wrapper=True)
+def pytest_runtest_setup(item):
+    from torchft_tpu._native import NativeToolchainMissing
+
+    try:
+        return (yield)
+    except NativeToolchainMissing as e:
+        pytest.skip(f"native toolchain absent: {e}")
+
+
+@pytest.hookimpl(wrapper=True)
+def pytest_runtest_call(item):
+    from torchft_tpu._native import NativeToolchainMissing
+
+    try:
+        return (yield)
+    except NativeToolchainMissing as e:
+        pytest.skip(f"native toolchain absent: {e}")

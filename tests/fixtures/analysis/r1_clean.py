@@ -1,0 +1,28 @@
+"""R1 clean twin: the same worker shapes, with errors funneled."""
+
+import logging
+import threading
+
+logger = logging.getLogger(__name__)
+
+
+def start_worker(sock, work, manager):
+    errors = []
+
+    def pump() -> None:
+        try:
+            sock.sendall(b"payload")
+        except Exception as e:
+            errors.append(e)
+
+    thread = threading.Thread(target=pump, daemon=True)
+    thread.start()
+
+    def on_done(fut) -> None:
+        try:
+            fut.result()
+        except Exception as e:
+            manager.report_error(e)
+
+    work.add_done_callback(on_done)
+    return thread, errors

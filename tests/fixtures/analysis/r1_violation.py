@@ -1,0 +1,17 @@
+"""R1 fixture: worker threads / done-callbacks that let errors escape the
+step boundary. Never imported — analyzed as AST only."""
+
+import threading
+
+
+def start_worker(sock, work):
+    def pump() -> None:
+        # VIOLATION: no try/except funnel around a call in a thread target.
+        sock.sendall(b"payload")
+
+    thread = threading.Thread(target=pump, daemon=True)
+    thread.start()
+
+    # VIOLATION: a lambda done-callback cannot funnel its errors.
+    work.add_done_callback(lambda fut: sock.close())
+    return thread

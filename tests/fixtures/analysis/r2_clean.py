@@ -1,0 +1,33 @@
+"""R2 clean twin: callbacks only transform their own completed result;
+multi-stage pipelines ride a dedicated pool (which may wait)."""
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+def chain_reduce(pg, arrays, pipeline_pool):
+    first = pg.allreduce(arrays)
+
+    def and_then(result):
+        # Transforming the delivered result is fine — no waiting.
+        return [r * 2 for r in result]
+
+    transformed = first.then(and_then)
+
+    def pipeline():
+        # A dedicated pool thread may block on PG work (the sanctioned
+        # pattern: parallel/collectives.py pipeline pool).
+        return pg.allgather(transformed.wait()).wait()
+
+    return pipeline_pool.submit(pipeline)
+
+
+def consume(work):
+    def on_done(fut):
+        try:
+            return fut.result()  # the callback's own completed future
+        except Exception as e:
+            logger.exception("op failed: %s", e)
+
+    work.add_done_callback(on_done)

@@ -1,0 +1,22 @@
+"""R2 fixture: blocking on PG work from code that runs on the op-worker
+thread (the parallel/collectives.py:42 deadlock class)."""
+
+
+def chain_reduce(pg, arrays):
+    first = pg.allreduce(arrays)
+
+    def and_then(result):
+        # VIOLATION: this callback runs on the op-worker thread and waits
+        # on a collective that same worker has to execute.
+        second = pg.allgather([result])
+        return second.wait()
+
+    return first.then(and_then)
+
+
+def enqueue_nested(epoch, pg, arrays):
+    def op():
+        # VIOLATION: submitted to the op-worker, then waits on PG work.
+        return pg.allreduce(arrays).wait()
+
+    return epoch.submit(op)

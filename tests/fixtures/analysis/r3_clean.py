@@ -1,0 +1,26 @@
+"""R3 clean twin: mutations under the writer, barrier outside it."""
+
+
+class GoodOptimizer:
+    def __init__(self, manager, params, opt_state):
+        self.manager = manager
+        self.params = params
+        self.opt_state = opt_state
+
+    def adopt(self, new_params, new_opt_state):
+        self.manager.disallow_state_dict_read()
+        try:
+            self.params = new_params
+            self.opt_state = new_opt_state
+        finally:
+            self.manager.allow_state_dict_read()
+
+    def sync(self, averaged):
+        committed = self.manager.should_commit()
+        if committed:
+            self.manager.disallow_state_dict_read()
+            try:
+                self.params = averaged
+            finally:
+                self.manager.allow_state_dict_read()
+        return committed

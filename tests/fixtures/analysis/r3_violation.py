@@ -1,0 +1,23 @@
+"""R3 fixture: registered-state mutations without the writer, and a commit
+barrier reached inside the write lock."""
+
+
+class BadOptimizer:
+    def __init__(self, manager, params, opt_state):
+        self.manager = manager
+        self.params = params  # __init__ is exempt (pre-sharing)
+        self.opt_state = opt_state
+
+    def adopt(self, new_params, new_opt_state):
+        # VIOLATION: rebinds registered state with no writer held.
+        self.params = new_params
+        self.opt_state = new_opt_state
+
+    def locked_barrier(self, averaged):
+        self.manager.disallow_state_dict_read()
+        try:
+            self.params = averaged
+            # VIOLATION: commit barrier inside the write lock.
+            return self.manager.should_commit()
+        finally:
+            self.manager.allow_state_dict_read()

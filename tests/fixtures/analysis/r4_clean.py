@@ -1,0 +1,22 @@
+"""R4 clean twin: every optax update rides one jitted dispatch."""
+
+import jax
+import optax
+
+
+def make_jit_step(tx):
+    def _update(grads, opt_state, params):
+        updates, new_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), new_state
+
+    return jax.jit(_update)
+
+
+def fused_factory(tx, loss_fn):
+    def fused(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        updates, new_state = tx.update(grads, opt_state, params)
+        return loss, optax.apply_updates(params, updates), new_state
+
+    fused_jit = jax.jit(fused)
+    return fused_jit

@@ -1,0 +1,11 @@
+"""R4 fixture: eager optax updates outside any jitted dispatch."""
+
+import optax
+
+
+def eager_step(tx, grads, opt_state, params):
+    # VIOLATION: unjitted transform update — hundreds of tiny device ops.
+    updates, new_state = tx.update(grads, opt_state, params)
+    # VIOLATION: unjitted apply_updates.
+    new_params = optax.apply_updates(params, updates)
+    return new_params, new_state

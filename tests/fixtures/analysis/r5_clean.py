@@ -1,0 +1,8 @@
+"""R5 clean twin: only intra-slice axes live in the Mesh; the replica axis
+stays virtual (parallel/mesh.py FTMesh)."""
+
+from jax.sharding import Mesh
+
+
+def build_mesh(device_grid):
+    return Mesh(device_grid, ("fsdp", "tp"))

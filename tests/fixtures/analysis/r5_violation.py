@@ -1,0 +1,9 @@
+"""R5 fixture: the replica axis as a jax Mesh dimension (recompiles on
+every membership change)."""
+
+from jax.sharding import Mesh
+
+
+def build_mesh(device_grid):
+    # VIOLATION: "replica" must never be a mesh dim.
+    return Mesh(device_grid, ("replica", "fsdp"))

@@ -1,0 +1,8 @@
+"""R6 clean twin: citations that parse, with a repo-internal anchor that
+resolves (ddp.py:10 lives in the package) and a well-formed range
+(reference manager.py:5-7 resolves against the synthetic snapshot when the
+test provides one, and skips cleanly when absent)."""
+
+
+def cited_helper():
+    """Mirrors the bucket path (ddp.py:10)."""

@@ -1,0 +1,11 @@
+"""R6 fixture: malformed and stale docstring citations.
+
+The inverted range below is a parse-level finding (reference-independent);
+the stale/unresolvable reference citations only fire when the test points
+the analyzer at its synthetic reference tree (reference manager.py:999 and
+reference nosuch_module.py:3).
+"""
+
+
+def cited_helper():
+    """Inverted range: see quorum.py:300-200 for details."""

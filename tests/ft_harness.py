@@ -21,7 +21,16 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from torchft_tpu.coordination import LighthouseServer
+from torchft_tpu.utils import lockcheck
+
+# Every kill/heal drill doubles as a race/deadlock probe: the runtime
+# lock-order detector is ON by default for threads-as-replicas tests
+# (export TPUFT_LOCK_CHECK=0 to opt out). A detected cycle or a lock held
+# across a commit barrier raises lockcheck.LockOrderError and fails the
+# drill. See docs/static_analysis.md.
+lockcheck.maybe_enable_from_env(default="1")
+
+from torchft_tpu.coordination import LighthouseServer  # noqa: E402
 from torchft_tpu.ddp import ft_allreduce_gradients
 from torchft_tpu.manager import Manager
 from torchft_tpu.optim import Optimizer

@@ -1,0 +1,193 @@
+"""Runtime lock-order detector (torchft_tpu.utils.lockcheck) tier-1 suite.
+
+The load-bearing case: a real A→B / B→A acquisition cycle across two
+threads is detected (and raised) at the second thread's closing acquire.
+Plus: the commit-barrier hold check, RWLock integration, creation-site
+filtering, and clean disable semantics.
+"""
+
+import threading
+
+import pytest
+
+from torchft_tpu.checkpointing._rwlock import RWLock
+from torchft_tpu.utils import lockcheck
+
+
+@pytest.fixture()
+def detector():
+    """Enables the detector with a clean graph; restores state after."""
+    was_enabled = lockcheck.enabled()
+    lockcheck.enable()
+    lockcheck.reset()
+    try:
+        yield lockcheck
+    finally:
+        lockcheck.reset()
+        if not was_enabled:
+            lockcheck.disable()
+
+
+def test_instrumented_creation_site_filter(detector) -> None:
+    # Created from a tests/ frame: instrumented proxy.
+    lock = threading.Lock()
+    assert "test_lockcheck" in repr(lock)
+    with lock:
+        pass  # acquire/release roundtrip works
+
+
+def test_cycle_across_two_threads_detected(detector) -> None:
+    lock_a = threading.Lock()
+    lock_b = threading.Lock()
+    # Distinct creation sites (one per line above) => distinct graph nodes.
+
+    t1_done = threading.Event()
+    errors = []
+
+    def t1() -> None:
+        # Establishes the order A -> B, then fully releases.
+        with lock_a:
+            with lock_b:
+                pass
+        t1_done.set()
+
+    def t2() -> None:
+        t1_done.wait(5)
+        # B -> A closes the cycle: the inner acquire must raise.
+        try:
+            with lock_b:
+                with lock_a:
+                    pass
+        except lockcheck.LockOrderError as e:
+            errors.append(e)
+
+    thread1 = threading.Thread(target=t1)
+    thread2 = threading.Thread(target=t2)
+    thread1.start()
+    thread1.join(5)
+    thread2.start()
+    thread2.join(5)
+    assert len(errors) == 1
+    assert "lock-order cycle" in str(errors[0])
+    assert lockcheck.violations()
+    # The failed acquire must have released the inner lock: both locks
+    # remain usable.
+    with lock_a:
+        pass
+    with lock_b:
+        pass
+
+
+def test_same_site_instances_do_not_false_positive(detector) -> None:
+    def make():
+        return threading.Lock()
+
+    first, second = make(), make()  # identical creation site
+    with first:
+        with second:
+            pass
+    with second:
+        with first:
+            pass  # reverse nesting of same-site instances: no order claim
+    assert lockcheck.violations() == []
+
+
+def test_barrier_check_flags_held_lock(detector) -> None:
+    lock = threading.Lock()
+    with pytest.raises(lockcheck.LockOrderError, match="commit barrier"):
+        with lock:
+            lockcheck.check_barrier("test-barrier")
+    assert any("test-barrier" in v for v in lockcheck.violations())
+    lockcheck.check_barrier("test-barrier")  # nothing held: clean
+
+
+def test_rwlock_logical_hold_reported(detector) -> None:
+    rwlock = RWLock()
+    assert rwlock.w_acquire(timeout=1)
+    try:
+        with pytest.raises(lockcheck.LockOrderError, match="RWLock"):
+            lockcheck.check_barrier("rwlock-barrier")
+    finally:
+        rwlock.w_release()
+    lockcheck.check_barrier("rwlock-barrier")  # released: clean
+
+    with rwlock.r_lock(timeout=1):
+        with pytest.raises(lockcheck.LockOrderError):
+            lockcheck.check_barrier("rwlock-read-barrier")
+    lockcheck.check_barrier("rwlock-read-barrier")
+
+
+def test_rwlock_in_cycle_with_plain_lock(detector) -> None:
+    rwlock = RWLock()
+    plain = threading.Lock()
+    order_set = threading.Event()
+    errors = []
+
+    def t1() -> None:
+        assert rwlock.w_acquire(timeout=1)
+        with plain:
+            pass
+        rwlock.w_release()
+        order_set.set()
+
+    def t2() -> None:
+        order_set.wait(5)
+        with plain:
+            try:
+                rwlock.w_acquire(timeout=1)
+                rwlock.w_release()
+            except lockcheck.LockOrderError as e:
+                errors.append(e)
+
+    thread1 = threading.Thread(target=t1)
+    thread2 = threading.Thread(target=t2)
+    thread1.start()
+    thread1.join(5)
+    thread2.start()
+    thread2.join(5)
+    assert len(errors) == 1
+    # The failed w_acquire rolled the writer state back: still acquirable.
+    assert rwlock.w_acquire(timeout=1)
+    rwlock.w_release()
+
+
+def test_condition_wait_releases_hold(detector) -> None:
+    cond = threading.Condition()
+    hits = []
+
+    def waiter() -> None:
+        with cond:
+            cond.wait_for(lambda: bool(hits), timeout=5)
+
+    thread = threading.Thread(target=waiter)
+    thread.start()
+    # While the waiter sleeps inside wait_for it must NOT count as holding
+    # the condition — this thread can acquire it.
+    acquired = cond.acquire(timeout=2)
+    assert acquired
+    hits.append(1)
+    cond.notify_all()
+    cond.release()
+    thread.join(5)
+
+
+def test_disable_restores_plain_locks(detector) -> None:
+    lockcheck.disable()
+    try:
+        lock = threading.Lock()
+        assert not isinstance(lock, lockcheck._InstrumentedLock)
+        lockcheck.check_barrier("noop")  # disabled: never raises
+    finally:
+        lockcheck.enable()
+
+
+def test_manager_should_commit_runs_barrier_check(detector, monkeypatch) -> None:
+    """The check is wired into the real Manager.should_commit (no native
+    plane needed: everything it touches before the check is stubbed)."""
+    from torchft_tpu.manager import Manager
+
+    manager = Manager.__new__(Manager)  # bypass __init__ (needs servers)
+    lock = threading.Lock()
+    with lock:
+        with pytest.raises(lockcheck.LockOrderError, match="should_commit"):
+            Manager.should_commit(manager)

@@ -1,0 +1,243 @@
+"""tpuft_check (torchft_tpu.analysis) tier-1 suite.
+
+Per-rule positive/negative fixture tests (tests/fixtures/analysis/), the
+suppression + baseline machinery, the CLI contract (one-line findings,
+exit code), and the load-bearing guarantee: the shipped package scans
+clean — CLAUDE.md's invariants hold as enforced properties.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from torchft_tpu.analysis import (
+    ALL_RULES,
+    RULES_BY_ID,
+    apply_baseline,
+    run_analysis,
+    save_baseline,
+)
+from torchft_tpu.analysis.core import REPO_ROOT
+
+FIXTURES = Path(__file__).resolve().parent / "fixtures" / "analysis"
+ABSENT_REFERENCE = Path("/nonexistent/tpuft-reference")
+
+
+def scan(name: str, rules=None, reference_root=ABSENT_REFERENCE):
+    return run_analysis(
+        paths=[FIXTURES / name], rules=rules, reference_root=reference_root
+    )
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ---------------------------------------------------------------------------
+# per-rule positive / negative fixtures
+# ---------------------------------------------------------------------------
+
+
+def test_r1_violation_fixture() -> None:
+    findings = scan("r1_violation.py", rules=["step-boundary-escape"])
+    assert len(findings) == 2  # unguarded thread target + lambda callback
+    assert rules_of(findings) == ["step-boundary-escape"]
+    lines = sorted(f.line for f in findings)
+    assert any("thread target" in f.message for f in findings)
+    assert any("lambda" in f.message for f in findings)
+    assert all(f.file.endswith("r1_violation.py") for f in findings)
+    assert lines == [10, 16]
+
+
+def test_r1_clean_fixture() -> None:
+    assert scan("r1_clean.py") == []
+
+
+def test_r2_violation_fixture() -> None:
+    findings = scan("r2_violation.py", rules=["op-worker-self-wait"])
+    assert len(findings) == 2  # .then callback wait + op-worker submit wait
+    assert {f.line for f in findings} == {12, 20}
+
+
+def test_r2_clean_fixture() -> None:
+    assert scan("r2_clean.py") == []
+
+
+def test_r3_violation_fixture() -> None:
+    findings = scan("r3_violation.py", rules=["lock-discipline"])
+    messages = [f.message for f in findings]
+    # Two unlocked mutations (params + opt_state lines) and one barrier
+    # inside the lock.
+    assert sum("without the state-dict writer" in m for m in messages) == 2
+    assert sum("barrier" in m for m in messages) == 1
+
+
+def test_r3_clean_fixture() -> None:
+    assert scan("r3_clean.py") == []
+
+
+def test_r4_violation_fixture() -> None:
+    findings = scan("r4_violation.py", rules=["unjitted-optax"])
+    assert len(findings) == 2
+    assert any(".update()" in f.message for f in findings)
+    assert any("apply_updates" in f.message for f in findings)
+
+
+def test_r4_clean_fixture() -> None:
+    assert scan("r4_clean.py") == []
+
+
+def test_r5_violation_fixture() -> None:
+    findings = scan("r5_violation.py", rules=["replica-axis-in-mesh"])
+    assert len(findings) == 1
+    assert "replica" in findings[0].message
+
+
+def test_r5_clean_fixture() -> None:
+    assert scan("r5_clean.py") == []
+
+
+def test_r6_violation_parse_level() -> None:
+    # Reference snapshot absent: only the parse-level (inverted range)
+    # finding fires; reference citations skip cleanly.
+    findings = scan("r6_violation.py", rules=["citation-lint"])
+    assert len(findings) == 1
+    assert "inverted" in findings[0].message
+
+
+def test_r6_violation_resolves_against_reference(tmp_path) -> None:
+    ref = tmp_path / "reference"
+    (ref / "torchft").mkdir(parents=True)
+    (ref / "torchft" / "manager.py").write_text("\n".join(f"# {i}" for i in range(10)))
+    findings = scan(
+        "r6_violation.py", rules=["citation-lint"], reference_root=ref
+    )
+    messages = sorted(f.message for f in findings)
+    assert len(findings) == 3
+    assert any("inverted" in m for m in messages)
+    assert any("manager.py:999" in m and "stale" in m for m in messages)
+    assert any("nosuch_module.py:3" in m and "resolves nowhere" in m for m in messages)
+
+
+def test_r6_clean_fixture(tmp_path) -> None:
+    # Clean with the snapshot absent...
+    assert scan("r6_clean.py") == []
+    # ...and with a synthetic snapshot present.
+    ref = tmp_path / "reference"
+    (ref / "torchft").mkdir(parents=True)
+    (ref / "torchft" / "manager.py").write_text("\n".join(f"# {i}" for i in range(10)))
+    assert scan("r6_clean.py", reference_root=ref) == []
+
+
+# ---------------------------------------------------------------------------
+# suppressions + baseline
+# ---------------------------------------------------------------------------
+
+
+def test_inline_suppression_needs_reason() -> None:
+    findings = scan("r5_suppressed.py")
+    # The justified violation is suppressed; the reason-less one surfaces
+    # BOTH as a malformed suppression and as the un-suppressed violation.
+    assert rules_of(findings) == ["replica-axis-in-mesh", "suppression"]
+    assert len(findings) == 2
+    by_rule = {f.rule: f for f in findings}
+    assert "missing its reason" in by_rule["suppression"].message
+    assert by_rule["replica-axis-in-mesh"].line == 13
+
+
+def test_baseline_roundtrip(tmp_path) -> None:
+    baseline = tmp_path / "baseline.json"
+    findings = scan("r5_violation.py")
+    assert findings
+    save_baseline(findings, baseline)
+    payload = json.loads(baseline.read_text())
+    assert payload["findings"]
+    fresh, suppressed = apply_baseline(findings, baseline)
+    assert fresh == []
+    assert suppressed == len(findings)
+    # A new finding (different fingerprint) is NOT masked by the baseline.
+    other = scan("r3_violation.py")
+    fresh, _ = apply_baseline(other, baseline)
+    assert fresh == other
+
+
+# ---------------------------------------------------------------------------
+# the shipped tree is clean + CLI contract
+# ---------------------------------------------------------------------------
+
+
+def test_package_scans_clean() -> None:
+    """CLAUDE.md's invariants hold over torchft_tpu/ with an EMPTY baseline
+    (reference resolution pinned absent so the result is deterministic on
+    boxes with and without the snapshot)."""
+    findings = run_analysis(reference_root=ABSENT_REFERENCE)
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+def test_rule_registry_covers_r1_to_r6() -> None:
+    assert len(ALL_RULES) == 6
+    assert set(RULES_BY_ID) == {
+        "step-boundary-escape",
+        "op-worker-self-wait",
+        "lock-discipline",
+        "unjitted-optax",
+        "replica-axis-in-mesh",
+        "citation-lint",
+    }
+
+
+def _run_cli(*args: str, env_extra=None):
+    import os
+
+    env = dict(os.environ)
+    env["TPUFT_ANALYSIS_REFERENCE"] = str(ABSENT_REFERENCE)
+    if env_extra:
+        env.update(env_extra)
+    return subprocess.run(
+        [sys.executable, "-m", "torchft_tpu.analysis", *args],
+        capture_output=True,
+        text=True,
+        cwd=str(REPO_ROOT),
+        env=env,
+        timeout=120,
+    )
+
+
+@pytest.mark.slow
+def test_cli_exit_codes() -> None:
+    clean = _run_cli()
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+    assert "0 finding(s)" in clean.stdout
+
+    dirty = _run_cli(str(FIXTURES / "r5_violation.py"))
+    assert dirty.returncode == 1
+    assert "replica-axis-in-mesh" in dirty.stdout
+
+    listing = _run_cli("--list-rules")
+    assert listing.returncode == 0
+    for rule in RULES_BY_ID:
+        assert rule in listing.stdout
+
+
+def test_cli_inprocess_contract() -> None:
+    """The same contract as test_cli_exit_codes without subprocess cost
+    (kept unconditionally in tier-1)."""
+    from torchft_tpu.analysis.__main__ import main
+
+    import os
+
+    old = os.environ.get("TPUFT_ANALYSIS_REFERENCE")
+    os.environ["TPUFT_ANALYSIS_REFERENCE"] = str(ABSENT_REFERENCE)
+    try:
+        assert main([]) == 0
+        assert main([str(FIXTURES / "r5_violation.py")]) == 1
+        assert main(["--list-rules"]) == 0
+        assert main(["--rules", "bogus-rule"]) == 2
+    finally:
+        if old is None:
+            os.environ.pop("TPUFT_ANALYSIS_REFERENCE", None)
+        else:
+            os.environ["TPUFT_ANALYSIS_REFERENCE"] = old

@@ -19,7 +19,15 @@ DiLoCo), ``torchft_tpu.parallel.mesh`` (FTMesh/HSDP), ``torchft_tpu.models``,
 ``torchft_tpu.checkpointing``, ``torchft_tpu.ops``.
 """
 
-from torchft_tpu.data import DevicePrefetcher, DistributedSampler
+# Honor $TPUFT_LOCK_CHECK for ANY entry point before lock-creating modules
+# import: the runtime lock-order detector only instruments locks created
+# AFTER enable() (docs/static_analysis.md). Off by default outside the
+# test harness.
+from torchft_tpu.utils import lockcheck as _lockcheck
+
+_lockcheck.maybe_enable_from_env(default="0")
+
+from torchft_tpu.data import DevicePrefetcher, DistributedSampler  # noqa: E402
 from torchft_tpu.ddp import DistributedDataParallel, ft_allreduce_gradients
 from torchft_tpu.manager import Manager, WorldSizeMode
 from torchft_tpu.optim import (

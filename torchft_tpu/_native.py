@@ -10,9 +10,11 @@ from __future__ import annotations
 
 import ctypes
 import os
+import shutil
 import subprocess
 import threading
 from pathlib import Path
+from typing import Tuple
 
 _REPO_ROOT = Path(__file__).resolve().parent.parent
 _NATIVE_DIR = _REPO_ROOT / "native"
@@ -21,6 +23,31 @@ _BUILD_DIR = _NATIVE_DIR / "build"
 _lib = None
 _lib_lock = threading.Lock()
 _has_sim_hooks = False
+
+
+class NativeToolchainMissing(RuntimeError):
+    """libtpuft.so is not prebuilt and the build toolchain (cmake/ninja) is
+    absent, so the native plane cannot come up. tests/conftest.py converts
+    this into a pytest skip ("native toolchain absent") instead of the
+    opaque FileNotFoundError subprocess used to raise; ``doctor`` reports
+    the same state in its toolchain check."""
+
+
+def toolchain_state() -> Tuple[bool, str]:
+    """(available, detail): whether the native plane can be loaded or built.
+
+    Available means a prebuilt libtpuft.so exists at any candidate path, or
+    both cmake and ninja are on PATH to build one."""
+    for path in _candidate_paths():
+        if path.exists():
+            return True, f"prebuilt libtpuft.so at {path}"
+    missing = [tool for tool in ("cmake", "ninja") if shutil.which(tool) is None]
+    if missing:
+        return False, (
+            f"no prebuilt libtpuft.so and {'/'.join(missing)} not on PATH "
+            "(native plane unbuildable)"
+        )
+    return True, "no prebuilt libtpuft.so; cmake+ninja available to build"
 
 
 def has_sim_hooks() -> bool:
@@ -41,10 +68,17 @@ def _candidate_paths() -> list[Path]:
 
 
 def ensure_built() -> Path:
-    """Returns the path to libtpuft.so, building it if necessary."""
+    """Returns the path to libtpuft.so, building it if necessary.
+
+    Raises :class:`NativeToolchainMissing` (not FileNotFoundError from a
+    doomed subprocess) when there is nothing to load and no toolchain to
+    build with — callers and the test suite key on that type."""
     for path in _candidate_paths():
         if path.exists():
             return path
+    available, detail = toolchain_state()
+    if not available:
+        raise NativeToolchainMissing(detail)
     # Build from source (dev / CI path).
     _BUILD_DIR.mkdir(parents=True, exist_ok=True)
     if not (_BUILD_DIR / "build.ninja").exists():

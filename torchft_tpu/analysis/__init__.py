@@ -1,0 +1,40 @@
+"""tpuft_check: static invariant analyzer for the Python coordination plane.
+
+The native plane has TSAN; this package is the Python side's mechanical
+check — six AST rules that turn CLAUDE.md's concurrency/architecture
+invariants into enforced properties (see docs/static_analysis.md for the
+rule table and suppression syntax). Runs in tier-1
+(tests/test_static_analysis.py) and as a CLI::
+
+    python -m torchft_tpu.analysis            # scan the package, exit != 0
+                                              # on unbaselined findings
+    python -m torchft_tpu.analysis --list-rules
+    python -m torchft_tpu.analysis path/...   # scan explicit files/dirs
+
+Runtime counterpart: :mod:`torchft_tpu.utils.lockcheck`
+(``TPUFT_LOCK_CHECK=1``; default-on in the ft_harness drills).
+"""
+
+from torchft_tpu.analysis.core import (
+    Finding,
+    Module,
+    apply_baseline,
+    load_baseline,
+    load_module,
+    run_analysis,
+    save_baseline,
+)
+from torchft_tpu.analysis.rules import ALL_RULES, RULES_BY_ID, Rule
+
+__all__ = [
+    "Finding",
+    "Module",
+    "Rule",
+    "ALL_RULES",
+    "RULES_BY_ID",
+    "run_analysis",
+    "load_module",
+    "load_baseline",
+    "save_baseline",
+    "apply_baseline",
+]

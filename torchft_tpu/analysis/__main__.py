@@ -1,0 +1,81 @@
+"""CLI for tpuft_check: one-line findings, non-zero exit for CI.
+
+    python -m torchft_tpu.analysis [paths...] [--rules id,id] [--list-rules]
+        [--baseline FILE] [--write-baseline] [--no-baseline]
+
+Env: ``TPUFT_ANALYSIS_REFERENCE`` (reference snapshot root, default
+/root/reference; citation resolution skips cleanly when absent) and
+``TPUFT_ANALYSIS_BASELINE`` (baseline path override).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from torchft_tpu.analysis import core, rules
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="python -m torchft_tpu.analysis")
+    parser.add_argument(
+        "paths", nargs="*", help="files/dirs to scan (default: the package)"
+    )
+    parser.add_argument(
+        "--rules", default=None, help="comma-separated rule ids (default: all)"
+    )
+    parser.add_argument("--list-rules", action="store_true")
+    parser.add_argument("--baseline", default=None, help="baseline file override")
+    parser.add_argument(
+        "--no-baseline", action="store_true", help="report baselined findings too"
+    )
+    parser.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="record the current findings as the new baseline",
+    )
+    parser.add_argument(
+        "--reference",
+        default=None,
+        help="reference snapshot root for citation-lint (default: "
+        f"${core.REFERENCE_ENV} or /root/reference)",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in rules.ALL_RULES:
+            print(f"{rule.id:22s} {rule.summary}  [{rule.anchor}]")
+        return 0
+
+    selected = args.rules.split(",") if args.rules else None
+    if selected:
+        unknown = [r for r in selected if r not in rules.RULES_BY_ID]
+        if unknown:
+            print(f"unknown rule(s): {', '.join(unknown)}", file=sys.stderr)
+            return 2
+
+    findings = core.run_analysis(
+        paths=[Path(p) for p in args.paths] or None,
+        rules=selected,
+        reference_root=Path(args.reference) if args.reference else None,
+    )
+
+    if args.write_baseline:
+        path = core.save_baseline(findings, args.baseline)
+        print(f"wrote {len(findings)} finding(s) to {path}")
+        return 0
+
+    baselined = 0
+    if not args.no_baseline:
+        findings, baselined = core.apply_baseline(findings, args.baseline)
+
+    for finding in findings:
+        print(finding.format())
+    tail = f" ({baselined} baselined)" if baselined else ""
+    print(f"tpuft_check: {len(findings)} finding(s){tail}")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

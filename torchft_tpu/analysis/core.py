@@ -1,0 +1,275 @@
+"""tpuft_check core: module loading, suppressions, baseline, rule driver.
+
+The analyzer turns CLAUDE.md's prose invariants into enforced properties:
+each rule in :mod:`torchft_tpu.analysis.rules` is a pure function over a
+parsed module (AST + source), returning :class:`Finding`\\ s. Three escape
+hatches keep it honest rather than noisy:
+
+- inline suppressions — ``# tpuft: allow(<rule-id>): <why>`` on the finding
+  line (or alone on the line above it). The reason is MANDATORY: a
+  suppression without one is itself reported.
+- a findings baseline (``baseline.json`` next to this file, or
+  ``$TPUFT_ANALYSIS_BASELINE``) for debt that is tracked but not yet fixed;
+  the shipped tree keeps it empty.
+- per-rule scoping: rules whose invariant only binds specific layers (e.g.
+  R1 over the comm layer) skip out-of-scope package files, but apply fully
+  to explicitly given paths (how the test fixtures exercise them).
+
+Runtime counterpart: :mod:`torchft_tpu.utils.lockcheck` checks the same
+lock-discipline invariants on live interleavings.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "Finding",
+    "Module",
+    "load_module",
+    "iter_package_files",
+    "run_analysis",
+    "load_baseline",
+    "save_baseline",
+    "apply_baseline",
+    "PACKAGE_ROOT",
+    "REPO_ROOT",
+    "REFERENCE_ENV",
+    "BASELINE_ENV",
+    "default_reference_root",
+]
+
+PACKAGE_ROOT = Path(__file__).resolve().parent.parent  # torchft_tpu/
+REPO_ROOT = PACKAGE_ROOT.parent
+
+REFERENCE_ENV = "TPUFT_ANALYSIS_REFERENCE"
+BASELINE_ENV = "TPUFT_ANALYSIS_BASELINE"
+_DEFAULT_REFERENCE = "/root/reference"
+_DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
+
+# ``# tpuft: allow(rule-id): reason`` — the reason is mandatory.
+_SUPPRESS_RE = re.compile(r"#\s*tpuft:\s*allow\(([\w-]+)\)\s*(?::\s*(\S.*))?")
+
+# Generated / vendored files the package scan never visits.
+_EXCLUDED_PARTS = ("__pycache__",)
+_EXCLUDED_NAMES = ("tpuft_pb2.py",)
+
+
+def default_reference_root() -> Path:
+    return Path(os.environ.get(REFERENCE_ENV, _DEFAULT_REFERENCE))
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation, stable enough to baseline across line drift."""
+
+    rule: str
+    file: str  # repo-root-relative when possible
+    line: int
+    message: str
+    context: str = ""  # stripped source line the finding anchors to
+
+    def format(self) -> str:
+        return f"{self.rule} {self.file}:{self.line} {self.message}"
+
+    @property
+    def fingerprint(self) -> str:
+        # File + rule + anchored source text: survives pure line drift,
+        # invalidates when the flagged code itself changes.
+        return f"{self.rule}::{self.file}::{self.context}"
+
+
+@dataclass
+class Module:
+    """A parsed source module plus everything rules need to scope and
+    suppress findings."""
+
+    path: Path
+    rel: str  # repo-root-relative posix path ("" prefix for external files)
+    source: str
+    lines: List[str]
+    tree: ast.AST
+    in_package: bool
+    suppressions: Dict[int, List[Tuple[str, str]]] = field(default_factory=dict)
+    # (start, end, rule): a suppression on (or just above) a ``def`` line
+    # covers the whole function body — for invariants like lock-discipline
+    # where one justification covers every mutation in a load fn.
+    span_suppressions: List[Tuple[int, int, str]] = field(default_factory=list)
+    malformed_suppressions: List[Tuple[int, str]] = field(default_factory=list)
+    parents: Dict[ast.AST, ast.AST] = field(default_factory=dict)
+
+    def line_at(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def is_suppressed(self, rule: str, lineno: int) -> bool:
+        for probe in (lineno, lineno - 1):
+            for rid, _reason in self.suppressions.get(probe, []):
+                if rid == rule:
+                    # A comment-only line suppresses the next line; an
+                    # end-of-line comment suppresses its own line.
+                    if probe == lineno or self.line_at(probe).startswith("#"):
+                        return True
+        return any(
+            start <= lineno <= end and rid == rule
+            for start, end, rid in self.span_suppressions
+        )
+
+
+def _collect_suppressions(module: Module) -> None:
+    for idx, raw in enumerate(module.lines, start=1):
+        match = _SUPPRESS_RE.search(raw)
+        if not match:
+            continue
+        rule, reason = match.group(1), (match.group(2) or "").strip()
+        if not reason:
+            module.malformed_suppressions.append(
+                (idx, f"suppression for {rule!r} is missing its reason")
+            )
+            continue
+        module.suppressions.setdefault(idx, []).append((rule, reason))
+
+
+def load_module(path: Path) -> Optional[Module]:
+    """Parses one file; returns None when it isn't valid Python (a syntax
+    error is a build problem, not an analysis finding)."""
+    path = Path(path).resolve()
+    try:
+        source = path.read_text(encoding="utf-8")
+        tree = ast.parse(source, filename=str(path))
+    except (OSError, SyntaxError, ValueError):
+        return None
+    try:
+        rel = path.relative_to(REPO_ROOT).as_posix()
+        in_package = path.is_relative_to(PACKAGE_ROOT)
+    except ValueError:
+        rel = path.name
+        in_package = False
+    module = Module(
+        path=path,
+        rel=rel,
+        source=source,
+        lines=source.splitlines(),
+        tree=tree,
+        in_package=in_package,
+    )
+    _collect_suppressions(module)
+    for parent in ast.walk(tree):
+        for child in ast.iter_child_nodes(parent):
+            module.parents[child] = parent
+    # Function-scoped suppressions: an allow comment on the def line (or
+    # comment-only just above it) covers the whole body.
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for probe in (node.lineno, node.lineno - 1):
+                for rid, _reason in module.suppressions.get(probe, []):
+                    if probe == node.lineno or module.line_at(probe).startswith("#"):
+                        module.span_suppressions.append(
+                            (node.lineno, getattr(node, "end_lineno", node.lineno), rid)
+                        )
+    return module
+
+
+def iter_package_files() -> Iterable[Path]:
+    for path in sorted(PACKAGE_ROOT.rglob("*.py")):
+        parts = set(path.parts)
+        if parts & set(_EXCLUDED_PARTS) or path.name in _EXCLUDED_NAMES:
+            continue
+        yield path
+
+
+def run_analysis(
+    paths: Optional[Sequence[Path]] = None,
+    rules: Optional[Sequence[str]] = None,
+    reference_root: Optional[Path] = None,
+) -> List[Finding]:
+    """Runs the (selected) rules over ``paths`` (default: the whole
+    package). Inline-suppressed findings are dropped; malformed
+    suppressions surface as ``suppression`` findings so a typo'd allow
+    cannot silently disable a rule."""
+    from torchft_tpu.analysis.rules import ALL_RULES
+
+    if reference_root is None:
+        reference_root = default_reference_root()
+    selected = [
+        rule
+        for rule in ALL_RULES
+        if rules is None or rule.id in rules
+    ]
+    targets = [Path(p) for p in paths] if paths is not None else list(iter_package_files())
+    findings: List[Finding] = []
+    for target in targets:
+        if target.is_dir():
+            files = sorted(target.rglob("*.py"))
+        else:
+            files = [target]
+        for file in files:
+            module = load_module(file)
+            if module is None:
+                continue
+            for lineno, msg in module.malformed_suppressions:
+                findings.append(
+                    Finding(
+                        rule="suppression",
+                        file=module.rel,
+                        line=lineno,
+                        message=msg,
+                        context=module.line_at(lineno),
+                    )
+                )
+            for rule in selected:
+                for finding in rule.check(module, reference_root=reference_root):
+                    if not module.is_suppressed(finding.rule, finding.line):
+                        findings.append(finding)
+    findings.sort(key=lambda f: (f.file, f.line, f.rule))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+
+def _baseline_path(path: Optional[Path] = None) -> Path:
+    if path is not None:
+        return Path(path)
+    return Path(os.environ.get(BASELINE_ENV, str(_DEFAULT_BASELINE)))
+
+
+def load_baseline(path: Optional[Path] = None) -> List[str]:
+    """Baselined finding fingerprints (empty when the file is absent)."""
+    baseline = _baseline_path(path)
+    if not baseline.exists():
+        return []
+    data = json.loads(baseline.read_text())
+    return list(data.get("findings", []))
+
+
+def save_baseline(findings: Sequence[Finding], path: Optional[Path] = None) -> Path:
+    baseline = _baseline_path(path)
+    payload = {
+        "comment": (
+            "tpuft_check findings baseline: tracked-but-unfixed debt. Ship "
+            "empty; every entry that stays needs an inline justification at "
+            "the flagged site."
+        ),
+        "findings": sorted(f.fingerprint for f in findings),
+    }
+    baseline.write_text(json.dumps(payload, indent=2) + "\n")
+    return baseline
+
+
+def apply_baseline(
+    findings: Sequence[Finding], path: Optional[Path] = None
+) -> Tuple[List[Finding], int]:
+    """(new findings, number suppressed by the baseline)."""
+    known = set(load_baseline(path))
+    fresh = [f for f in findings if f.fingerprint not in known]
+    return fresh, len(findings) - len(fresh)

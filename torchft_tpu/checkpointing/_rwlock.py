@@ -12,6 +12,8 @@ import threading
 from contextlib import contextmanager
 from typing import Generator
 
+from torchft_tpu.utils import lockcheck
+
 __all__ = ["RWLock"]
 
 
@@ -21,6 +23,11 @@ class RWLock:
         self._readers = 0
         self._writer = False
         self._writers_waiting = 0
+        # Lock-order-detector identity: every RWLock created at one source
+        # line shares a node in the order graph (lockcheck docs). The
+        # LOGICAL reader/writer holds are reported below — the internal
+        # condition's microsecond holds would hide the real hold window.
+        self._lc_site = lockcheck.creation_site(skip=2) + "[RWLock]"
 
     def r_acquire(self, timeout: float = -1) -> bool:
         with self._cond:
@@ -31,9 +38,15 @@ class RWLock:
             if not ok:
                 return False
             self._readers += 1
-            return True
+        try:
+            lockcheck.note_acquired(self, self._lc_site)
+        except BaseException:
+            self.r_release()
+            raise
+        return True
 
     def r_release(self) -> None:
+        lockcheck.note_released(self)
         with self._cond:
             assert self._readers > 0, "r_release without matching r_acquire"
             self._readers -= 1
@@ -51,11 +64,17 @@ class RWLock:
                 if not ok:
                     return False
                 self._writer = True
-                return True
             finally:
                 self._writers_waiting -= 1
+        try:
+            lockcheck.note_acquired(self, self._lc_site)
+        except BaseException:
+            self.w_release()
+            raise
+        return True
 
     def w_release(self) -> None:
+        lockcheck.note_released(self)
         with self._cond:
             assert self._writer, "w_release without matching w_acquire"
             self._writer = False

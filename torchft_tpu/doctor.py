@@ -41,6 +41,9 @@ KNOWN_ENV = {
     "TPUFT_BENCH_CPU_DEADLINE", "TPUFT_BENCH_CPU_FULL_DEADLINE",
     "TPUFT_BENCH_NO_PROBE",
     "TPUFT_EMULATED_RTT_MS", "TPUFT_EMULATED_GBPS",
+    # Correctness tooling: runtime lock-order detector + static analyzer
+    # (python -m torchft_tpu.analysis; docs/static_analysis.md).
+    "TPUFT_LOCK_CHECK", "TPUFT_ANALYSIS_REFERENCE", "TPUFT_ANALYSIS_BASELINE",
     # Repo tooling outside the package (tests/benchmarks/sentinel) — real
     # knobs a user may have exported; not typos.
     "TPUFT_SOAK_SECONDS", "TPUFT_REGEN_FIXTURES", "TPUFT_SENTINEL_INTERVAL",
@@ -52,10 +55,23 @@ KNOWN_ENV = {
 Check = Tuple[str, Callable[[], Tuple[str, str]]]  # name -> (status, detail)
 
 
+def _check_toolchain() -> Tuple[str, str]:
+    """Native build toolchain state. WARN, not FAIL, when absent: the
+    pure-python planes still work and the test suite skips (not errors) the
+    native-gated cases — but the operator should know why."""
+    from torchft_tpu import _native
+
+    available, detail = _native.toolchain_state()
+    return ("PASS" if available else "WARN"), detail
+
+
 def _check_native() -> Tuple[str, str]:
     from torchft_tpu import _native
 
-    path = _native.ensure_built()
+    try:
+        path = _native.ensure_built()
+    except _native.NativeToolchainMissing as e:
+        return "FAIL", f"native plane unavailable: {e}"
     return "PASS", f"libtpuft loaded ({path})"
 
 
@@ -187,6 +203,7 @@ def _check_env() -> Tuple[str, str]:
 
 def run_checks(lighthouse: str, skip_device: bool = False) -> int:
     checks: List[Check] = [
+        ("build toolchain", _check_toolchain),
         ("native plane", _check_native),
         ("kv store", _check_store),
         ("wire codecs", _check_kernels),

@@ -138,6 +138,7 @@ class LocalSGD:
     def _save_state(self) -> Dict[str, Any]:
         return {"params": self.params, "opt_state": self.opt_state}
 
+    # tpuft: allow(lock-discipline): heal apply — runs under the state-dict writer taken by Manager._apply_pending_state_dict
     def _load_state(self, state: Dict[str, Any]) -> None:
         # Sharding-preserving restore (see _restore_like).
         self.params = _restore_like(state["params"], self.params, device=True)
@@ -261,6 +262,15 @@ class _Fragment:
                     )
             self.backup = [np.array(x, copy=True) for x in initial_leaves]
         self.outer_opt_state = outer_tx.init(self.backup)
+        if not should_quantize:
+            from torchft_tpu.optim import make_jit_update
+
+            # The host path's outer step still goes through ONE jitted
+            # dispatch (the unjitted-optax invariant): an eager optax
+            # update issues hundreds of tiny ops on the default backend,
+            # which dominates on tunneled devices. The quantized path's
+            # outer step is fused into _jit_apply_outer below.
+            self._jit_outer_update = make_jit_update(outer_tx)
         self._work: Optional[Work] = None
         manager.register_state_dict_fn(
             f"StreamingDiLoCoFragment_{fragment_id}", self._load_state, self._save_state
@@ -321,6 +331,7 @@ class _Fragment:
             "outer_optimizer": self.outer_opt_state,
         }
 
+    # tpuft: allow(lock-discipline): heal apply — runs under the state-dict writer taken by Manager._apply_pending_state_dict
     def _load_state(self, state: Dict[str, Any]) -> None:
         # Healing must restore SHARDING, not just values: the joiner's
         # pre-heal backups carry the model's fsdp/tp shardings, and a plain
@@ -377,8 +388,6 @@ class _Fragment:
     def perform_sync(self, local_leaves: List[Any]) -> bool:
         """Waits for the allreduce, restores globals, commits, and on success
         applies the outer step + local/global merge (reference :423-476)."""
-        import optax
-
         assert self._work is not None, "perform_sync before prepare_sync"
         averaged = self._work.wait()
         self._work = None
@@ -452,10 +461,9 @@ class _Fragment:
                 for slot, i in enumerate(self.leaf_indices):
                     local_leaves[i] = merged[slot]
             else:
-                updates, self.outer_opt_state = self._outer_tx.update(
+                new_global, self.outer_opt_state = self._jit_outer_update(
                     averaged, self.outer_opt_state, self.backup
                 )
-                new_global = optax.apply_updates(self.backup, updates)
                 new_global = [np.asarray(g) for g in new_global]
                 self.backup = [np.array(g, copy=True) for g in new_global]
                 for slot, i in enumerate(self.leaf_indices):
@@ -570,6 +578,7 @@ class DiLoCo:
     def _save_inner(self) -> Dict[str, Any]:
         return {"leaves": list(self._leaves), "opt_state": self.inner_opt_state}
 
+    # tpuft: allow(lock-discipline): heal apply — runs under the state-dict writer taken by Manager._apply_pending_state_dict
     def _load_inner(self, state: Dict[str, Any]) -> None:
         # Restore onto the existing leaves' shardings (see
         # _restore_leaf_like): a healed joiner must end up with the same

@@ -52,6 +52,7 @@ from torchft_tpu.coordination import ManagerClient, ManagerServer
 from torchft_tpu.parallel.process_group import ProcessGroup, ReduceOp
 from torchft_tpu.parallel.store import StoreClient
 from torchft_tpu.telemetry import commits_logger, errors_logger, quorums_logger
+from torchft_tpu.utils import lockcheck
 from torchft_tpu.utils.profiling import trace_span
 from torchft_tpu.utils.transfer import prefetch_to_host
 from torchft_tpu.work import Work, _DummyWork
@@ -883,8 +884,17 @@ class Manager:
         self._logger.info("applying pending state dict")
         assert self._load_state_dict_fns, "user load_state_dict is not initialized"
         pending_user = cast(Dict[str, Any], self._pending_state_dict["user"])
-        for key, load_fn in self._load_state_dict_fns.items():
-            load_fn(pending_user[key])
+        # Healing rebinds registered state: take the writer so a checkpoint
+        # serve staging on another thread never captures a half-applied
+        # mosaic (the lock-discipline invariant R3 enforces statically —
+        # the load fns themselves are suppressed at their definition sites
+        # because THIS caller owns the lock).
+        self.disallow_state_dict_read()
+        try:
+            for key, load_fn in self._load_state_dict_fns.items():
+                load_fn(pending_user[key])
+        finally:
+            self.allow_state_dict_read()
         self._pending_state_dict = None
         metrics.set_gauge("tpuft_healing", 0, **self._metric_labels)
         self._logger.info("Loaded state dict.")
@@ -923,6 +933,10 @@ class Manager:
         Call after the step's math is complete (``jax.block_until_ready`` on
         the outputs) and step the optimizer only when this returns True.
         """
+        # The barrier must run unlocked: it may apply a healing state dict
+        # (write lock) and peer serve threads need the read lock meanwhile.
+        # No-op unless the lock-order detector is enabled (TPUFT_LOCK_CHECK).
+        lockcheck.check_barrier("Manager.should_commit")
         if err := self._pg.errored():
             self.report_error(err)
 

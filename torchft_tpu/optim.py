@@ -385,6 +385,7 @@ class Optimizer:
     def _state_dict(self) -> Any:
         return {"params": self.params, "opt_state": self.opt_state}
 
+    # tpuft: allow(lock-discipline): heal apply — the registered load fns run under the state-dict writer taken by Manager._apply_pending_state_dict
     def _load_state_dict(self, state: Any) -> None:
         # Restore against the CURRENT layouts so multi-host shardings are
         # reassembled locally (each rank received its own shards).

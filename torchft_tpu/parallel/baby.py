@@ -20,6 +20,7 @@ segment per scalar). The child deliberately imports only numpy-level deps
 
 from __future__ import annotations
 
+import logging
 import multiprocessing as mp
 import threading
 import weakref
@@ -33,6 +34,8 @@ import numpy as np
 from torchft_tpu.parallel.multiprocessing import _MonitoredPipe
 from torchft_tpu.parallel.process_group import ProcessGroup, ReduceOp
 from torchft_tpu.work import Work
+
+logger = logging.getLogger(__name__)
 
 __all__ = ["ProcessGroupBaby"]
 
@@ -204,8 +207,8 @@ def _baby_main(req_conn, resp_conn, store_addr, replica_id, rank, world_size, ti
                 work = getattr(pg, name)(*args, **kwargs)
 
                 def on_done(fut, op_id=op_id) -> None:
-                    err = fut.exception()
                     try:
+                        err = fut.exception()
                         if err is None:
                             segments: list = []
                             result = _stage_result(fut.result(), segments)
@@ -226,7 +229,18 @@ def _baby_main(req_conn, resp_conn, store_addr, replica_id, rank, world_size, ti
                         else:
                             resp.send(("error", op_id, RuntimeError(str(err))))
                     except (OSError, BrokenPipeError):
-                        pass
+                        pass  # parent is gone; nothing to report to
+                    except BaseException as e:  # noqa: BLE001
+                        # Result staging failed (e.g. shm exhaustion): the
+                        # parent must still get an answer or its future
+                        # hangs until timeout.
+                        try:
+                            resp.send(
+                                ("error", op_id,
+                                 RuntimeError(f"baby result staging failed: {e}"))
+                            )
+                        except Exception:  # noqa: BLE001
+                            pass
 
                 work.add_done_callback(on_done)
             except Exception as e:  # noqa: BLE001
@@ -309,24 +323,40 @@ class ProcessGroupBaby(ProcessGroup):
                 msg = resp.recv(timeout=3600.0)
             except (EOFError, OSError, TimeoutError):
                 return
+            except BaseException as e:  # noqa: BLE001 — undecodable message
+                # e.g. an unpicklable payload: the pipe is unusable; exit
+                # like EOF (pending ops fail via their own timeouts).
+                logger.exception("baby future-handler: pipe recv failed: %s", e)
+                return
             kind, op_id, payload = msg
-            with self._pending_lock:
-                fut = self._pending.pop(op_id, None)
-                segments = self._op_segments.pop(op_id, ())
-            # The op is complete: the request segments (this side created)
-            # can be released.
-            for shm in segments:
-                _cleanup_shm(shm, unlink=True)
-            if fut is None:
+            fut: Optional[Future] = None
+            try:
+                with self._pending_lock:
+                    fut = self._pending.pop(op_id, None)
+                    segments = self._op_segments.pop(op_id, ())
+                # The op is complete: the request segments (this side
+                # created) can be released.
+                for shm in segments:
+                    _cleanup_shm(shm, unlink=True)
+                if fut is None:
+                    if kind == "result":
+                        _discard_result(payload)
+                    continue
                 if kind == "result":
-                    _discard_result(payload)
-                continue
-            if kind == "result":
-                fut.set_result(_map_result(payload))
-            else:
-                if self._errored is None:
-                    self._errored = payload
-                fut.set_exception(payload)
+                    fut.set_result(_map_result(payload))
+                else:
+                    if self._errored is None:
+                        self._errored = payload
+                    fut.set_exception(payload)
+            except BaseException as e:  # noqa: BLE001 — handler must survive
+                # A result-mapping failure (e.g. a vanished shm segment)
+                # must fail ITS op, not kill the handler thread — a dead
+                # handler hangs every later op until timeout.
+                if fut is not None and not fut.done():
+                    fut.set_exception(
+                        RuntimeError(f"baby result handling failed: {e}")
+                    )
+                logger.exception("baby future-handler: op %s failed: %s", op_id, e)
 
     def _teardown_child(self, graceful: bool) -> None:
         proc, req = self._proc, self._req

@@ -164,10 +164,17 @@ class ProcessGroupNative(ProcessGroup):
 
     def _worker_loop(self, ops: "queue.Queue") -> None:
         while True:
-            item = ops.get()
-            if item is None:
-                return
-            item()
+            try:
+                item = ops.get()
+                if item is None:
+                    return
+                item()
+            except BaseException as e:  # noqa: BLE001 — worker must survive
+                # Ops capture their own exceptions into their Work future
+                # (_submit); anything landing here is a bug in that capture,
+                # and a dead worker would hang every later collective until
+                # timeout — log and keep serving.
+                logger.exception("native pg op-worker: op escaped its Work: %s", e)
 
     def _teardown(self) -> None:
         with self._lock:

@@ -318,10 +318,18 @@ class _Epoch:
 
     def _worker_loop(self) -> None:
         while True:
-            op = self.ops.get()
-            if op is None:
-                return
-            op()
+            try:
+                op = self.ops.get()
+                if op is None:
+                    return
+                op()
+            except BaseException as e:  # noqa: BLE001 — worker must survive
+                # Submitted ops capture their own exceptions into their
+                # Future (see submit); anything landing here is a bug in
+                # that capture — a dead worker would silently hang every
+                # later collective until its timeout, so log and keep
+                # serving (the op's Future still times out and reports).
+                logger.exception("pg op-worker: op escaped its Future: %s", e)
 
     def submit(self, fn: Callable[[], object]) -> Future:
         fut: Future = Future()

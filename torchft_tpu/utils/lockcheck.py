@@ -1,0 +1,390 @@
+"""Runtime lock-order detector for the Python coordination plane.
+
+The native C++ plane has a TSAN build; this is the Python-side analogue for
+the three interacting thread families (train loop, quorum thread, per-PG
+op-worker). When enabled (``TPUFT_LOCK_CHECK=1``; on by default in the
+``tests/ft_harness.py`` threads-as-replicas drills) it:
+
+- shims ``threading.Lock`` / ``threading.RLock`` / ``threading.Condition``
+  so locks *created at torchft_tpu (or tests/) call sites* record per-thread
+  acquisition order — locks created by the stdlib or third-party code are
+  left untouched (the creator's frame decides);
+- maintains the global lock-order graph keyed by **creation site**
+  (``file:line``), so every instance of e.g. the per-manager ``RWLock``
+  shares one node, the classic lock-order-checker identity;
+- fails the run (:class:`LockOrderError`) when an acquisition would close a
+  cycle in that graph — the static witness of an ABBA deadlock — or when a
+  commit barrier is entered with any instrumented lock held
+  (:func:`check_barrier`, called by ``Manager.should_commit``: the
+  "commit barriers run unlocked" invariant, CLAUDE.md architecture notes).
+
+The ``RWLock`` (checkpointing/_rwlock.py) reports its *logical* reader/
+writer holds through :func:`note_acquired` / :func:`note_released` — its
+internal ``Condition`` is only held for microseconds and would hide the
+actual hold window from the barrier check.
+
+Static counterpart: rule R3 (lock-discipline) in
+:mod:`torchft_tpu.analysis` proves the same invariant lexically; this
+module catches the interleavings the AST cannot see.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from typing import Dict, List, Optional, Set
+
+__all__ = [
+    "ENV",
+    "LockOrderError",
+    "enable",
+    "disable",
+    "enabled",
+    "reset",
+    "violations",
+    "check_barrier",
+    "note_acquired",
+    "note_released",
+    "creation_site",
+]
+
+ENV = "TPUFT_LOCK_CHECK"
+
+_enabled = False
+_orig: Dict[str, object] = {}
+
+# The global lock-order graph: edge a -> b means "some thread held a lock
+# created at site a while acquiring one created at site b".
+_graph_lock = threading.Lock()
+_edges: Dict[str, Set[str]] = {}
+_violations: List[str] = []
+
+_tls = threading.local()
+
+_THIS_FILE = os.path.abspath(__file__)
+_REPO_MARKERS = ("torchft_tpu", os.sep + "tests" + os.sep)
+
+
+class LockOrderError(RuntimeError):
+    """A lock-order cycle, or a lock held across a commit barrier."""
+
+
+class _Held:
+    __slots__ = ("obj", "site", "count")
+
+    def __init__(self, obj: object, site: str) -> None:
+        self.obj = obj
+        self.site = site
+        self.count = 1
+
+
+def _held_stack() -> List[_Held]:
+    held = getattr(_tls, "held", None)
+    if held is None:
+        held = []
+        _tls.held = held
+    return held
+
+
+def creation_site(skip: int = 1) -> str:
+    """``file:line`` of the first caller frame outside this module."""
+    frame = sys._getframe(skip)
+    while frame is not None and os.path.abspath(frame.f_code.co_filename) == _THIS_FILE:
+        frame = frame.f_back
+    if frame is None:
+        return "<unknown>"
+    fname = frame.f_code.co_filename
+    # Shorten to the repo-relative tail for readable reports.
+    for marker in ("torchft_tpu", "tests"):
+        idx = fname.rfind(os.sep + marker + os.sep)
+        if idx >= 0:
+            fname = fname[idx + 1 :]
+            break
+    return f"{fname}:{frame.f_lineno}"
+
+
+def _is_instrumented_frame(skip: int = 2) -> bool:
+    """True when the lock being created belongs to torchft_tpu or the test
+    suite (stdlib/third-party creation sites stay uninstrumented)."""
+    frame = sys._getframe(skip)
+    while frame is not None and os.path.abspath(frame.f_code.co_filename) == _THIS_FILE:
+        frame = frame.f_back
+    if frame is None:
+        return False
+    fname = frame.f_code.co_filename
+    return any(marker in fname for marker in _REPO_MARKERS)
+
+
+def _find_path(src: str, dst: str) -> Optional[List[str]]:
+    """DFS path src -> dst over _edges (caller holds _graph_lock)."""
+    stack = [(src, [src])]
+    seen = {src}
+    while stack:
+        node, path = stack.pop()
+        if node == dst:
+            return path
+        for nxt in _edges.get(node, ()):
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append((nxt, path + [nxt]))
+    return None
+
+
+def note_acquired(obj: object, site: str, raise_on_cycle: bool = True) -> None:
+    """Records that the calling thread now holds ``obj`` (created at
+    ``site``). Adds order-graph edges from every other lock the thread
+    holds; raises :class:`LockOrderError` (before recording the hold) if an
+    edge would close a cycle. No-op when the detector is disabled."""
+    if not _enabled:
+        return
+    held = _held_stack()
+    for rec in held:
+        if rec.obj is obj:
+            rec.count += 1  # reentrant (RLock / nested reader)
+            return
+    error = None
+    for rec in held:
+        if rec.site == site:
+            # Two instances from one creation site (e.g. two managers'
+            # RWLocks in a threads-as-replicas drill): no order is
+            # expressible between them, so no edge.
+            continue
+        with _graph_lock:
+            if site in _edges.get(rec.site, ()):
+                continue
+            back = _find_path(site, rec.site)
+            if back is not None:
+                msg = (
+                    f"lock-order cycle: thread {threading.current_thread().name!r} "
+                    f"acquires {site} while holding {rec.site}, but the "
+                    f"established order is {' -> '.join(back)} -> {site}"
+                )
+                _violations.append(msg)
+                error = LockOrderError(msg)
+                break
+            _edges.setdefault(rec.site, set()).add(site)
+    if error is not None:
+        raise error
+    held.append(_Held(obj, site))
+
+
+def note_released(obj: object) -> None:
+    """Drops ``obj`` from the calling thread's held set (reentrant-aware).
+    Unknown objects are ignored: the lock may predate enable()."""
+    held = getattr(_tls, "held", None)
+    if not held:
+        return
+    for index in range(len(held) - 1, -1, -1):
+        if held[index].obj is obj:
+            held[index].count -= 1
+            if held[index].count <= 0:
+                del held[index]
+            return
+
+
+def check_barrier(label: str) -> None:
+    """Fails the run if the calling thread enters a commit barrier while
+    holding any instrumented lock — the runtime form of the "commit
+    barriers run unlocked" invariant (a barrier may apply a healing state
+    dict, and peer serve threads need the state-dict read lock meanwhile;
+    holding a lock here is a cross-replica deadlock waiting for the right
+    interleaving)."""
+    if not _enabled:
+        return
+    held = getattr(_tls, "held", None)
+    if not held:
+        return
+    sites = ", ".join(rec.site for rec in held)
+    msg = (
+        f"lock held across commit barrier {label}: thread "
+        f"{threading.current_thread().name!r} holds [{sites}] — barriers "
+        "must run unlocked (CLAUDE.md invariant)"
+    )
+    _violations.append(msg)
+    raise LockOrderError(msg)
+
+
+def violations() -> List[str]:
+    """Violations recorded so far (cycles + locked barriers)."""
+    with _graph_lock:
+        return list(_violations)
+
+
+def reset() -> None:
+    """Clears the order graph, violations, and this thread's held set."""
+    with _graph_lock:
+        _edges.clear()
+        _violations.clear()
+    _tls.held = []
+
+
+# ---------------------------------------------------------------------------
+# threading shims
+# ---------------------------------------------------------------------------
+
+
+class _InstrumentedLock:
+    """Proxy over a real lock that reports acquire/release. On a detected
+    cycle the inner lock is released before the error propagates, so a
+    failing ``with`` statement cannot leak the hold."""
+
+    def __init__(self, inner: object, site: str) -> None:
+        self._inner = inner
+        self._site = site
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._inner.acquire(blocking, timeout)  # type: ignore[attr-defined]
+        if ok:
+            try:
+                note_acquired(self, self._site)
+            except BaseException:
+                self._inner.release()  # type: ignore[attr-defined]
+                raise
+        return ok
+
+    def release(self) -> None:
+        note_released(self)
+        self._inner.release()  # type: ignore[attr-defined]
+
+    def locked(self) -> bool:
+        return self._inner.locked()  # type: ignore[attr-defined]
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc: object) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"<instrumented {self._inner!r} from {self._site}>"
+
+
+class _InstrumentedCondition:
+    """Proxy over ``threading.Condition`` that tracks the underlying lock's
+    hold, releasing it (for tracking purposes) across ``wait``/``wait_for``
+    exactly as the real lock is released."""
+
+    def __init__(self, lock: object = None, site: str = "") -> None:
+        if isinstance(lock, _InstrumentedLock):
+            lock = lock._inner
+        self._inner = (
+            _orig["Condition"](lock) if lock is not None else _orig["Condition"]()  # type: ignore[operator]
+        )
+        self._site = site
+
+    def acquire(self, *args: object, **kwargs: object) -> bool:
+        ok = self._inner.acquire(*args, **kwargs)
+        if ok:
+            try:
+                note_acquired(self, self._site)
+            except BaseException:
+                self._inner.release()
+                raise
+        return ok
+
+    def release(self) -> None:
+        note_released(self)
+        self._inner.release()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        note_released(self)
+        try:
+            return self._inner.wait(timeout)
+        finally:
+            # Re-adding edges that already exist never raises; a genuinely
+            # new cycle on re-acquire is recorded without unwinding the
+            # wait (the lock IS held again — report, don't corrupt).
+            try:
+                note_acquired(self, self._site)
+            except LockOrderError:
+                pass
+
+    def wait_for(self, predicate, timeout: Optional[float] = None):
+        note_released(self)
+        try:
+            return self._inner.wait_for(predicate, timeout)
+        finally:
+            try:
+                note_acquired(self, self._site)
+            except LockOrderError:
+                pass
+
+    def notify(self, n: int = 1) -> None:
+        self._inner.notify(n)
+
+    def notify_all(self) -> None:
+        self._inner.notify_all()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc: object) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"<instrumented {self._inner!r} from {self._site}>"
+
+
+def _lock_factory():
+    inner = _orig["Lock"]()  # type: ignore[operator]
+    if not _enabled or not _is_instrumented_frame():
+        return inner
+    return _InstrumentedLock(inner, creation_site(skip=2))
+
+
+def _rlock_factory():
+    inner = _orig["RLock"]()  # type: ignore[operator]
+    if not _enabled or not _is_instrumented_frame():
+        return inner
+    return _InstrumentedLock(inner, creation_site(skip=2))
+
+
+def _condition_factory(lock: object = None):
+    if not _enabled or not _is_instrumented_frame():
+        if isinstance(lock, _InstrumentedLock):
+            lock = lock._inner
+        return _orig["Condition"](lock) if lock is not None else _orig["Condition"]()  # type: ignore[operator]
+    return _InstrumentedCondition(lock, creation_site(skip=2))
+
+
+def enable() -> None:
+    """Patches the ``threading`` lock constructors (idempotent). Only locks
+    created *after* this call, from torchft_tpu/tests frames, are
+    instrumented — module-level singletons created at import time stay
+    invisible, which is the intended noise bound."""
+    global _enabled
+    if _enabled:
+        return
+    if not _orig:
+        _orig["Lock"] = threading.Lock
+        _orig["RLock"] = threading.RLock
+        _orig["Condition"] = threading.Condition
+    _enabled = True
+    threading.Lock = _lock_factory  # type: ignore[misc,assignment]
+    threading.RLock = _rlock_factory  # type: ignore[misc,assignment]
+    threading.Condition = _condition_factory  # type: ignore[misc,assignment]
+
+
+def disable() -> None:
+    """Restores the original constructors. Already-instrumented locks keep
+    working (their note_* calls become no-ops)."""
+    global _enabled
+    if not _enabled:
+        return
+    _enabled = False
+    threading.Lock = _orig["Lock"]  # type: ignore[misc,assignment]
+    threading.RLock = _orig["RLock"]  # type: ignore[misc,assignment]
+    threading.Condition = _orig["Condition"]  # type: ignore[misc,assignment]
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def maybe_enable_from_env(default: str = "0") -> bool:
+    """Enables the detector when ``$TPUFT_LOCK_CHECK`` (default: ``default``)
+    is truthy; returns the resulting enabled state."""
+    if os.environ.get(ENV, default) not in ("0", "", "false", "no"):
+        enable()
+    return _enabled
