@@ -26,3 +26,21 @@ def start_worker(sock, work, manager):
 
     work.add_done_callback(on_done)
     return thread, errors
+
+
+def start_heal_recv_worker(transport, manager):
+    """Heal-plane twin: the recv worker funnels every failure (donor
+    death, checksum mismatch, watchdog fence) into report_error, so a
+    failed heal refuses the commit instead of vanishing with the
+    thread."""
+
+    def recv_worker() -> None:
+        try:
+            state = transport.recv_checkpoint(0, "http://donor:0", 3, 10.0)
+            manager.apply_pending(state)
+        except Exception as e:
+            manager.report_error(e)
+
+    thread = threading.Thread(target=recv_worker, daemon=True, name="heal-recv")
+    thread.start()
+    return thread

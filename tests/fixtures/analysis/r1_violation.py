@@ -15,3 +15,20 @@ def start_worker(sock, work):
     # VIOLATION: a lambda done-callback cannot funnel its errors.
     work.add_done_callback(lambda fut: sock.close())
     return thread
+
+
+def start_heal_recv_worker(transport, manager):
+    """The heal-plane shape: a joiner pulling a checkpoint on its own
+    thread. A recv failure (dead donor, checksum mismatch, watchdog
+    fence) MUST funnel into report_error — raising kills the thread
+    silently and the heal just never lands."""
+
+    def recv_worker() -> None:
+        # VIOLATION: the heal fetch can raise (donor death, corrupt
+        # stream) with no funnel to the manager's error state.
+        state = transport.recv_checkpoint(0, "http://donor:0", 3, 10.0)
+        manager.apply_pending(state)
+
+    thread = threading.Thread(target=recv_worker, daemon=True, name="heal-recv")
+    thread.start()
+    return thread

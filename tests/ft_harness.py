@@ -59,6 +59,13 @@ FT_COUNTERS = (
     "heals_joiner",
     "errors",
     "phantom_commits",
+    "heal_retries",
+    "donor_failovers",
+    "checksum_failures",
+    "chunk_refetches",
+    "resumed_bytes",
+    "stalled_fetches",
+    "era_rejects",
 )
 
 
@@ -68,7 +75,12 @@ def ft_counter_snapshot(replica_id: str = "") -> Dict[str, float]:
     before the per-process uuid suffix, so totals accumulate across
     simulated supervisor restarts — exactly what a drill wants to count).
     Counters are process-global and tests share one process: assert on
-    DELTAS via :func:`ft_counter_delta`, never on absolute values."""
+    DELTAS via :func:`ft_counter_delta`, never on absolute values.
+
+    The heal-transport counters (checksum failures, chunk re-fetches,
+    resumed bytes, stalled fetches, era rejects) are emitted below the
+    manager and carry no replica labels — they are always process-global,
+    regardless of ``replica_id``."""
     from torchft_tpu import metrics
 
     label = {"replica_id": replica_id} if replica_id else {}
@@ -88,6 +100,23 @@ def ft_counter_snapshot(replica_id: str = "") -> Dict[str, float]:
             "tpuft_heals_total", role="joiner", **label
         ),
         "errors": metrics.counter_total("tpuft_errors_total", **label),
+        "heal_retries": metrics.counter_total(
+            "tpuft_heal_retries_total", **label
+        ),
+        "donor_failovers": metrics.counter_total(
+            "tpuft_heal_donor_failovers_total", **label
+        ),
+        "checksum_failures": metrics.counter_total(
+            "tpuft_heal_checksum_failures_total"
+        ),
+        "chunk_refetches": metrics.counter_total(
+            "tpuft_heal_chunk_refetches_total"
+        ),
+        "resumed_bytes": metrics.counter_total("tpuft_heal_resumed_bytes_total"),
+        "stalled_fetches": metrics.counter_total(
+            "tpuft_heal_stalled_fetches_total"
+        ),
+        "era_rejects": metrics.counter_total("tpuft_heal_era_rejects_total"),
     }
 
 
@@ -240,9 +269,18 @@ def ddp_train_loop(
     store_addr: str,
     min_replica_size: int = 1,
     init_sync: bool = True,
+    transport_factory: Optional[Callable[[Runner, int], Any]] = None,
 ) -> Dict[str, Any]:
-    """Returns {"state_dict": final state, "history": {step: params}}."""
+    """Returns {"state_dict": final state, "history": {step: params}}.
+
+    ``transport_factory(runner, rank)`` (via ``train_loop_args``) supplies
+    a per-rank CheckpointTransport — heal-path drills use it to hand the
+    donor side a fault-injecting transport (see HTTPTransport._fault_hook).
+    """
     pg = FakeProcessGroupWrapper(ProcessGroupTCP(timeout=10.0))
+    manager_args = dict(runner.manager_args)
+    if transport_factory is not None:
+        manager_args["checkpoint_transport"] = transport_factory(runner, rank)
     manager = Manager(
         pg=pg,
         min_replica_size=min_replica_size,
@@ -257,7 +295,7 @@ def ddp_train_loop(
         timeout=10.0,
         quorum_timeout=20.0,
         init_sync=init_sync,
-        **runner.manager_args,
+        **manager_args,
     )
     opt = Optimizer(manager, optax.sgd(0.05), _init_model_params())
 

@@ -1,14 +1,18 @@
 """Chaos soak: a real multi-process 2-group job under the full fault menu
-(exit / segfault / deadlock / partition), driven by the punisher against a
-live lighthouse — the CI promotion of the reference's slurm/monarch chaos
-drives (punisher.py + failure.py:25-100).
+(exit / segfault / deadlock / partition + the heal-plane modes
+kill_donor_mid_heal / corrupt_stream / stall_donor), driven by the
+punisher against a live lighthouse — the CI promotion of the reference's
+slurm/monarch chaos drives (punisher.py + failure.py:25-100).
 
 ON by default (a soak that never runs automatically is a soak that rots —
 round-2 verdict weak #5): every full-suite run pays the ~2 minutes.
 TPUFT_SOAK=0 opts out for quick iteration; TPUFT_SOAK_SECONDS controls the
 fault window (default 40; VERDICT's 10-minute soak = TPUFT_SOAK_SECONDS=600).
-The master invariant: after every group finishes, committed states are
-bitwise identical across groups.
+TPUFT_SOAK_SEED pins the fault schedule's RNG (the seed in use is logged
+on entry, so any soak failure is reproducible). The master invariant:
+after every group finishes, committed states are bitwise identical across
+groups — which is exactly what proves a corrupted heal stream was never
+adopted and a stalled donor was fenced, not waited out.
 """
 
 import json
@@ -103,18 +107,29 @@ def test_chaos_soak_full_fault_menu(tmp_path) -> None:
     from tests.test_lighthouse_failure import _spawn_lighthouse
     from torchft_tpu.coordination import LighthouseClient
     from torchft_tpu.launch import supervise
-    from torchft_tpu.punisher import FAULT_MODES, kill_one
+    from torchft_tpu.punisher import ALL_FAULT_MODES, inject_fault
+    from torchft_tpu.utils import faultinject
 
     # 40s default: enough for the full fault menu to fire several times
     # (~1 fault/5s) while keeping the whole suite near its 12-minute
     # budget; raise via env for a real soak (VERDICT's 10-minute run =
     # TPUFT_SOAK_SECONDS=600).
     soak_seconds = float(os.environ.get("TPUFT_SOAK_SECONDS", "40"))
+    # The fault schedule is seeded and the seed is logged on entry, so a
+    # failing soak replays exactly with TPUFT_SOAK_SEED=<logged seed>.
+    soak_seed = int(os.environ.get("TPUFT_SOAK_SEED", "1234"))
+    print(
+        f"[soak] fault rng seed={soak_seed} "
+        f"(reproduce with TPUFT_SOAK_SEED={soak_seed})",
+        flush=True,
+    )
     repo = str(pathlib.Path(__file__).resolve().parents[1])
     script = tmp_path / "soak_job.py"
     script.write_text(_TRAIN_SCRIPT.replace("@REPO@", repo))
     out_dir = tmp_path / "out"
     out_dir.mkdir()
+    # Stream-fault arming channel shared with the job's donor transports.
+    fault_file = str(tmp_path / "fault_cmd")
 
     # The lighthouse is a REAL subprocess daemon on a fixed port so the
     # fault menu can include its own death: the punisher SIGKILLs and
@@ -137,7 +152,7 @@ def test_chaos_soak_full_fault_menu(tmp_path) -> None:
 
     def punish() -> None:
         client = LighthouseClient(lh_addr)
-        rng = random.Random(1234)
+        rng = random.Random(soak_seed)
         deadline = time.monotonic() + soak_seconds
         lh_kill_at = time.monotonic() + soak_seconds / 2  # mid-window
         # Wait for the job to form a quorum before the first fault.
@@ -170,10 +185,13 @@ def test_chaos_soak_full_fault_menu(tmp_path) -> None:
                 except Exception as e:  # noqa: BLE001
                     print(f"[soak] lighthouse restart failed: {e}")
                 continue
-            mode = rng.choice(list(FAULT_MODES))
+            mode = rng.choice(list(ALL_FAULT_MODES))
             try:
-                kill_one(client, rng, mode=mode)
-                faults["count"] += 1
+                # Heal-plane modes can legitimately no-op (no heal in
+                # flight to target); only delivered faults count toward
+                # the injection floor asserted below.
+                if inject_fault(client, rng, mode, fault_file=fault_file):
+                    faults["count"] += 1
             except Exception as e:  # noqa: BLE001
                 print(f"[soak] fault injection ended with: {e}")
 
@@ -199,6 +217,9 @@ def test_chaos_soak_full_fault_menu(tmp_path) -> None:
                 # Flight recorder armed: injected faults must leave
                 # post-mortem dumps behind (asserted below).
                 "TPUFT_FLIGHT_RECORDER": str(out_dir / "fr"),
+                # Donor transports consume punisher-armed stream faults
+                # (corrupt_stream / stall_donor) from this file.
+                faultinject.ENV_FAULT_FILE: fault_file,
             },
         )
     finally:

@@ -531,3 +531,324 @@ def test_full_restart_resumes_from_disk(tmp_path) -> None:
         np.testing.assert_array_equal(
             phase_b[0]["params"][name], phase_b[1]["params"][name]
         )
+
+
+# -- heal-path hardening: integrity, resume, era fencing --------------------
+# (pure-Python: two in-process HTTPTransports, no native plane)
+
+
+def chunked_state() -> dict:
+    """Five leaves (sorted flatten order: b, tag, u, v, w) so a 4-chunk
+    round-robin split gives chunk 0 array payload (b + w — where the
+    payload-corruption tests flip bits) and chunk 1 a header-only chunk
+    ('tag' rides the pickled header — the header-corruption case)."""
+    return {
+        "w": np.arange(16384, dtype=np.float32).reshape(128, 128),
+        "b": np.ones(512, dtype=np.float64),
+        "u": np.full(300, 2.0, dtype=np.float32),
+        "v": np.linspace(0, 1, 257, dtype=np.float32),
+        "tag": "heal-me",
+    }
+
+
+def heal_counters() -> dict:
+    from torchft_tpu import metrics
+
+    return {
+        "checksum": metrics.counter_total("tpuft_heal_checksum_failures_total"),
+        "refetch": metrics.counter_total("tpuft_heal_chunk_refetches_total"),
+        "resumed": metrics.counter_total("tpuft_heal_resumed_bytes_total"),
+        "stalled": metrics.counter_total("tpuft_heal_stalled_fetches_total"),
+        "era": metrics.counter_total("tpuft_heal_era_rejects_total"),
+    }
+
+
+def test_meta_carries_integrity_and_era_fields() -> None:
+    """/meta is the integrity root: per-chunk checksums, the
+    whole-checkpoint digest binding them, and the staged quorum era."""
+    import urllib.request
+
+    from torchft_tpu._safe_pickle import safe_loads
+    from torchft_tpu.checkpointing.http_transport import _checkpoint_digest
+
+    donor = HTTPTransport(num_chunks=4)
+    try:
+        donor.send_checkpoint(
+            [1], step=5, state_dict=chunked_state(), timeout=10, quorum_id=11
+        )
+        raw = urllib.request.urlopen(
+            donor.metadata() + "/checkpoint/5/meta", timeout=5
+        ).read()
+        meta = safe_loads(raw)
+        assert meta["format"] == 2
+        assert meta["step"] == 5
+        assert meta["quorum_id"] == 11
+        assert meta["num_chunks"] == len(meta["chunk_crcs"])
+        assert all(isinstance(c, int) for c in meta["chunk_crcs"])
+        assert meta["digest"] == _checkpoint_digest(
+            5, meta["crc_algo"], meta["chunk_crcs"]
+        )
+    finally:
+        donor.shutdown()
+
+
+def test_stale_era_meta_rejected() -> None:
+    """A donor staged for quorum era 3 must not heal a joiner healing in
+    era 4 — stale-era state could walk the joiner backwards."""
+    from torchft_tpu.checkpointing import HealEraMismatch
+
+    donor = HTTPTransport(num_chunks=2)
+    joiner = HTTPTransport()
+    try:
+        donor.send_checkpoint(
+            [1], step=5, state_dict=chunked_state(), timeout=10, quorum_id=3
+        )
+        before = heal_counters()
+        with pytest.raises(HealEraMismatch):
+            joiner.recv_checkpoint(
+                0, donor.metadata(), 5, timeout=5, quorum_id=4
+            )
+        assert heal_counters()["era"] - before["era"] == 1
+        # Same era heals fine (nothing about the data is wrong).
+        out = joiner.recv_checkpoint(
+            0, donor.metadata(), 5, timeout=10, quorum_id=3
+        )
+        assert_state_equal(chunked_state(), out)
+    finally:
+        donor.shutdown()
+        joiner.shutdown()
+
+
+def test_stale_era_chunk_409_fails_heal_cleanly() -> None:
+    """The donor re-stages a NEWER era between the joiner's /meta and chunk
+    GETs: the era-tagged chunk URL answers 409 (not stale bytes), and the
+    joiner fails the heal cleanly instead of mixing eras."""
+    import urllib.error
+
+    donor = HTTPTransport(num_chunks=2)
+    joiner = HTTPTransport()
+    try:
+        donor.send_checkpoint(
+            [1], step=5, state_dict=chunked_state(), timeout=10, quorum_id=3
+        )
+        # Sabotage: once /meta is read, move the stage to era 4. The chunk
+        # fetches still carry ?quorum_id=3 and must be refused.
+        real_fetch = joiner.recv_checkpoint
+
+        from torchft_tpu.checkpointing import http_transport as ht
+
+        orig = ht._fetch_retry
+        state = {"restaged": False}
+
+        def restaging_fetch(url, timeout, consume=None):
+            result = orig(url, timeout, consume=consume)
+            if url.endswith("/meta") and not state["restaged"]:
+                state["restaged"] = True
+                donor.send_checkpoint(
+                    [1], step=5, state_dict=chunked_state(), timeout=10,
+                    quorum_id=4,
+                )
+            return result
+
+        ht._fetch_retry = restaging_fetch
+        try:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                real_fetch(0, donor.metadata(), 5, timeout=3, quorum_id=3)
+            assert err.value.code == 409
+        finally:
+            ht._fetch_retry = orig
+    finally:
+        donor.shutdown()
+        joiner.shutdown()
+
+
+def test_bit_flipped_payload_chunk_rejected_and_refetched() -> None:
+    """A payload bit flip is caught by the per-chunk checksum, the chunk is
+    re-fetched within its bounded window, and the heal completes — with
+    the checksum-failure counter matching the injected count exactly."""
+    state = chunked_state()
+    donor = HTTPTransport(num_chunks=4)
+    joiner = HTTPTransport()
+    try:
+        donor.send_checkpoint([1], step=5, state_dict=state, timeout=10, quorum_id=7)
+        injected = []
+
+        def corrupt_once(step: int, index: int):
+            if index == 0 and not injected:
+                injected.append(1)
+                return "corrupt_stream"
+            return None
+
+        donor._fault_hook = corrupt_once
+        before = heal_counters()
+        out = joiner.recv_checkpoint(
+            0, donor.metadata(), 5, timeout=10, quorum_id=7
+        )
+        after = heal_counters()
+        assert_state_equal(state, out)
+        assert len(injected) == 1
+        assert after["checksum"] - before["checksum"] == 1  # exact
+        assert after["refetch"] - before["refetch"] == 1
+    finally:
+        donor.shutdown()
+        joiner.shutdown()
+
+
+def test_bit_flipped_header_chunk_still_caught_by_checksum() -> None:
+    """A bit flip landing in the pickled chunk HEADER crashes the decoder
+    before any checksum comparison — the joiner must still classify it as
+    corruption (drain + checksum arbitration) and re-fetch, not surface an
+    UnpicklingError. Regression: a 3-leaf state's middle chunk is
+    header-only ('tag' is a non-array leaf), so the corrupting last-byte
+    flip lands on the pickle STOP opcode."""
+    state = {
+        "b": np.ones(7, dtype=np.float64),
+        "tag": "header-only-chunk",
+        "w": np.arange(12, dtype=np.float32),
+    }
+    donor = HTTPTransport(num_chunks=3)
+    joiner = HTTPTransport()
+    try:
+        donor.send_checkpoint([1], step=5, state_dict=state, timeout=10, quorum_id=7)
+        injected = []
+
+        def corrupt_once(step: int, index: int):
+            if index == 1 and not injected:
+                injected.append(1)
+                return "corrupt_stream"
+            return None
+
+        donor._fault_hook = corrupt_once
+        before = heal_counters()
+        out = joiner.recv_checkpoint(
+            0, donor.metadata(), 5, timeout=10, quorum_id=7
+        )
+        assert_state_equal(state, out)
+        assert heal_counters()["checksum"] - before["checksum"] == 1
+    finally:
+        donor.shutdown()
+        joiner.shutdown()
+
+
+def test_truncated_stream_never_adopted() -> None:
+    """A donor that truncates every chunk serve: the joiner retries within
+    the bounded window, then fails the heal — corrupt/partial state is
+    never returned, and the failure is prompt (window-bounded), not a
+    hang."""
+    donor = HTTPTransport(num_chunks=2)
+    joiner = HTTPTransport()
+    try:
+        donor.send_checkpoint(
+            [1], step=5, state_dict=chunked_state(), timeout=10, quorum_id=7
+        )
+        donor._fault_hook = lambda step, index: "truncate"
+        t0 = time.monotonic()
+        with pytest.raises(EOFError):
+            joiner.recv_checkpoint(
+                0, donor.metadata(), 5, timeout=2, quorum_id=7
+            )
+        assert time.monotonic() - t0 < 20  # bounded, generous GIL margin
+    finally:
+        donor.shutdown()
+        joiner.shutdown()
+
+
+def test_digest_mismatch_refused_before_any_transfer(monkeypatch) -> None:
+    """/meta whose digest does not bind its own chunk checksums is refused
+    outright (HealIntegrityError) — nothing is fetched, nothing adopted."""
+    from torchft_tpu.checkpointing import HealIntegrityError
+    from torchft_tpu.checkpointing import http_transport as ht
+
+    # The donor stages with a corrupted digest computation.
+    monkeypatch.setattr(
+        ht, "_checkpoint_digest", lambda *a, **k: "deadbeef" * 8
+    )
+    donor = HTTPTransport(num_chunks=2)
+    joiner = HTTPTransport()
+    try:
+        donor.send_checkpoint(
+            [1], step=5, state_dict=chunked_state(), timeout=10, quorum_id=7
+        )
+        # Restore the real digest on the joiner side so the mismatch is
+        # donor-vs-joiner, not joiner-vs-itself.
+        monkeypatch.undo()
+        with pytest.raises(HealIntegrityError):
+            joiner.recv_checkpoint(
+                0, donor.metadata(), 5, timeout=5, quorum_id=7
+            )
+    finally:
+        donor.shutdown()
+        joiner.shutdown()
+
+
+def test_fetch_retry_rides_out_connection_refused(monkeypatch) -> None:
+    """A dying/restarting donor surfaces as URLError(ConnectionRefusedError)
+    or a reset mid-body: both retry within the same bounded window as 404
+    (satellite fix — previously only 404 retried, so a donor restart
+    mid-fetch failed the heal immediately)."""
+    import io
+    import types
+    import urllib.error
+
+    from torchft_tpu.checkpointing import http_transport as ht
+
+    clock = types.SimpleNamespace(t=0.0)
+    fake_time = types.SimpleNamespace(
+        monotonic=lambda: clock.t,
+        sleep=lambda s: setattr(clock, "t", clock.t + s),
+        perf_counter=lambda: clock.t,
+    )
+    calls = []
+
+    def fake_urlopen(url, timeout=None):
+        calls.append(clock.t)
+        clock.t += 0.1
+        if len(calls) == 1:
+            raise urllib.error.URLError(ConnectionRefusedError(111, "refused"))
+        if len(calls) == 2:
+            raise ConnectionResetError(104, "reset mid-body")
+        return io.BytesIO(b"served")
+
+    monkeypatch.setattr(ht, "time", fake_time)
+    monkeypatch.setattr(
+        ht,
+        "urllib",
+        types.SimpleNamespace(
+            request=types.SimpleNamespace(urlopen=fake_urlopen),
+            error=urllib.error,
+        ),
+    )
+    assert ht._fetch_retry("http://fake/x", timeout=5.0) == b"served"
+    assert len(calls) == 3
+
+
+def test_fetch_retry_timeout_and_4xx_still_fail_fast(monkeypatch) -> None:
+    """Non-retryable failures stay non-retryable: a socket timeout (the
+    per-recv inactivity bound) and a 409 era rejection surface on the
+    first attempt instead of burning the retry window."""
+    import types
+    import urllib.error
+
+    from torchft_tpu.checkpointing import http_transport as ht
+
+    for exc in (
+        urllib.error.URLError(TimeoutError("timed out")),
+        urllib.error.HTTPError("http://fake/x", 409, "stale era", None, None),
+    ):
+        calls = []
+
+        def fake_urlopen(url, timeout=None, _exc=exc):
+            calls.append(url)
+            raise _exc
+
+        monkeypatch.setattr(
+            ht,
+            "urllib",
+            types.SimpleNamespace(
+                request=types.SimpleNamespace(urlopen=fake_urlopen),
+                error=urllib.error,
+            ),
+        )
+        with pytest.raises(type(exc)):
+            ht._fetch_retry("http://fake/x", timeout=5.0)
+        assert len(calls) == 1, f"{exc} should not retry"

@@ -1,0 +1,358 @@
+"""Heal-path hardening drills (pure Python — these carry tier-1 in a
+container without the native toolchain):
+
+- transport-level acceptance drills: donor death mid-stream → failover to
+  a second donor resumes with ONLY the missing chunks re-transferred;
+  corrupt-stream injection → checksum-failure counter matches the
+  injected count exactly; gray (drip-feeding) donor → fenced within the
+  watchdog window, not the full fetch timeout;
+- manager-level failover orchestration against a mocked coordination
+  plane: retry/failover accounting, the one-shot fail-fast skip of a
+  just-failed donor, bounded attempts escalating HealExhaustedError, and
+  the quorum era flowing into both transport directions.
+
+The native-gated threads-as-replicas versions live in
+tests/test_manager_integ.py (donor killed mid-heal drill).
+"""
+
+import time
+from unittest.mock import patch
+
+import numpy as np
+import pytest
+
+from test_checkpointing import assert_state_equal, chunked_state, heal_counters
+from test_manager import make_manager, make_quorum
+from torchft_tpu.checkpointing import (
+    HealStalledError,
+    HTTPTransport,
+)
+from torchft_tpu.manager import HealExhaustedError
+from torchft_tpu.parallel.process_group import ProcessGroupDummy
+
+
+# ---------------------------------------------------------------------------
+# Transport-level acceptance drills
+# ---------------------------------------------------------------------------
+
+
+def test_donor_death_mid_heal_failover_resumes_missing_chunks_only() -> None:
+    """Donor A dies mid-stream (connection cut while chunks are in flight):
+    the heal fails cleanly with the verified chunks cached; a second donor
+    completes it — and the re-fetch counter moves by EXACTLY the missing
+    chunks (resume actually resumed), with zero checksum failures."""
+    state = chunked_state()
+    donor_a = HTTPTransport(num_chunks=4)
+    donor_b = HTTPTransport(num_chunks=4)
+    joiner = HTTPTransport()
+    try:
+        donor_a.send_checkpoint(
+            [1], step=5, state_dict=state, timeout=10, quorum_id=7
+        )
+        # Chunks 0 and 1 serve; chunks 2 and 3 cut the connection — the
+        # donor "dies" partway through the transfer.
+        donor_a._fault_hook = lambda step, index: "die" if index >= 2 else None
+        before = heal_counters()
+        with pytest.raises(Exception):
+            joiner.recv_checkpoint(
+                0, donor_a.metadata(), 5, timeout=1.5, quorum_id=7
+            )
+        mid = heal_counters()
+        # The failed attempt transferred the surviving chunks once — no
+        # re-fetches yet, nothing resumed yet.
+        assert mid["refetch"] - before["refetch"] == 0
+
+        # Failover: a different donor, even a different quorum era — the
+        # (step, digest) key proves the bytes are the same checkpoint.
+        donor_b.send_checkpoint(
+            [1], step=5, state_dict=state, timeout=10, quorum_id=8
+        )
+        out = joiner.recv_checkpoint(
+            0, donor_b.metadata(), 5, timeout=10, quorum_id=8
+        )
+        after = heal_counters()
+        assert_state_equal(state, out)
+        # Exactly the 2 missing chunks were re-transferred...
+        assert after["refetch"] - mid["refetch"] == 2
+        # ...the cached ones were resumed, not re-sent...
+        assert after["resumed"] - mid["resumed"] > 0
+        # ...and nothing about the data was ever wrong.
+        assert after["checksum"] - before["checksum"] == 0
+    finally:
+        donor_a.shutdown()
+        donor_b.shutdown()
+        joiner.shutdown()
+
+
+def test_corrupt_stream_counter_matches_injected_count_exactly() -> None:
+    """N injected bit flips → exactly N checksum failures, and the healed
+    state is byte-identical to the donor's (corruption never adopted)."""
+    state = chunked_state()
+    donor = HTTPTransport(num_chunks=4)
+    joiner = HTTPTransport()
+    try:
+        donor.send_checkpoint(
+            [1], step=5, state_dict=state, timeout=10, quorum_id=7
+        )
+        injected = []
+
+        def corrupt_twice(step: int, index: int):
+            # Flip bits on the first serve of chunks 0 and 3; retries and
+            # all other chunks serve clean.
+            if index in (0, 3) and injected.count(index) == 0:
+                injected.append(index)
+                return "corrupt_stream"
+            return None
+
+        donor._fault_hook = corrupt_twice
+        before = heal_counters()
+        out = joiner.recv_checkpoint(
+            0, donor.metadata(), 5, timeout=10, quorum_id=7
+        )
+        after = heal_counters()
+        assert_state_equal(state, out)
+        assert len(injected) == 2
+        assert after["checksum"] - before["checksum"] == 2  # exact
+        assert after["refetch"] - before["refetch"] == 2
+    finally:
+        donor.shutdown()
+        joiner.shutdown()
+
+
+def test_gray_donor_fenced_within_watchdog_window(monkeypatch) -> None:
+    """A drip-feeding donor (far below the bytes/s floor) is fenced within
+    the watchdog window — the stall time is asserted against the watchdog
+    bound, not a sleep, and is far below the 60 s fetch timeout the old
+    single-timeout design would have burned."""
+    from torchft_tpu.checkpointing import http_transport as ht
+
+    state = chunked_state()
+    donor = HTTPTransport(num_chunks=2)
+    joiner = HTTPTransport()
+    monkeypatch.setenv(ht.ENV_HEAL_MIN_BPS, "100000")
+    try:
+        donor.send_checkpoint(
+            [1], step=5, state_dict=state, timeout=10, quorum_id=7
+        )
+        donor._fault_hook = lambda step, index: "stall_donor"
+        before = heal_counters()
+        t0 = time.monotonic()
+        with pytest.raises(HealStalledError):
+            joiner.recv_checkpoint(
+                0, donor.metadata(), 5, timeout=60, quorum_id=7
+            )
+        elapsed = time.monotonic() - t0
+        # Watchdog bound: one window to observe the drip + scheduling
+        # margin on the GIL-loaded box. The property under test is
+        # "seconds, not the 60 s fetch timeout".
+        assert elapsed < 6 * ht._WATCHDOG_WINDOW_SEC, elapsed
+        assert heal_counters()["stalled"] - before["stalled"] >= 1
+    finally:
+        donor.shutdown()
+        joiner.shutdown()
+
+
+def test_watchdog_off_when_floor_disabled(monkeypatch) -> None:
+    """TPUFT_HEAL_MIN_BYTES_PER_SEC <= 0 disables fencing: a slow donor is
+    tolerated (the emulated-slow-link case) and the heal completes."""
+    from torchft_tpu.checkpointing import http_transport as ht
+
+    donor = HTTPTransport(num_chunks=1)
+    joiner = HTTPTransport()
+    monkeypatch.setenv(ht.ENV_HEAL_MIN_BPS, "0")
+    try:
+        # Small state so even the 256 B/s drip completes fast enough.
+        state = {"w": np.arange(32, dtype=np.float32)}
+        donor.send_checkpoint(
+            [1], step=5, state_dict=state, timeout=10, quorum_id=7
+        )
+        donor._fault_hook = lambda step, index: "stall_donor"
+        out = joiner.recv_checkpoint(
+            0, donor.metadata(), 5, timeout=30, quorum_id=7
+        )
+        assert_state_equal(state, out)
+    finally:
+        donor.shutdown()
+        joiner.shutdown()
+
+
+def test_punisher_file_armed_fault_consumed_by_donor(tmp_path, monkeypatch) -> None:
+    """The punisher's file-armed corrupt_stream reaches a real donor serve
+    (no test hook): exactly one chunk GET consumes the arm, the joiner
+    rejects + re-fetches, and the arm does not re-fire."""
+    from torchft_tpu.punisher import arm_stream_fault
+    from torchft_tpu.utils import faultinject
+
+    fault_file = str(tmp_path / "fault_cmd")
+    monkeypatch.setenv(faultinject.ENV_FAULT_FILE, fault_file)
+    state = chunked_state()
+    donor = HTTPTransport(num_chunks=2)
+    joiner = HTTPTransport()
+    try:
+        donor.send_checkpoint(
+            [1], step=5, state_dict=state, timeout=10, quorum_id=7
+        )
+        assert arm_stream_fault("corrupt_stream", fault_file)
+        before = heal_counters()
+        out = joiner.recv_checkpoint(
+            0, donor.metadata(), 5, timeout=10, quorum_id=7
+        )
+        after = heal_counters()
+        assert_state_equal(state, out)
+        assert after["checksum"] - before["checksum"] == 1  # one arm, one fault
+        # Consumed: a second heal is clean.
+        before = heal_counters()
+        out = joiner.recv_checkpoint(
+            0, donor.metadata(), 5, timeout=10, quorum_id=7
+        )
+        assert heal_counters()["checksum"] - before["checksum"] == 0
+    finally:
+        donor.shutdown()
+        joiner.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Manager-level failover orchestration (mocked coordination plane)
+# ---------------------------------------------------------------------------
+
+
+def heal_quorum(addr: str, quorum_id: int = 2):
+    return make_quorum(
+        quorum_id=quorum_id,
+        replica_rank=1,
+        replica_world_size=2,
+        heal=True,
+        max_step=3,
+        recover_src_manager_address=addr,
+        recover_src_replica_rank=0,
+    )
+
+
+def test_manager_heal_failover_accounting_and_bounded_attempts() -> None:
+    """Across quorum rounds: donor A fails → one-shot fail-fast skip of A
+    → donor B attempted (failover counted) → attempts exhaust into
+    HealExhaustedError out of the quorum future."""
+    from torchft_tpu import metrics
+
+    manager, client, _, transport = make_manager(
+        pg=ProcessGroupDummy(), min_replica_size=1, heal_max_attempts=2
+    )
+    labels = manager._metric_labels
+    transport.recv_checkpoint.side_effect = RuntimeError("donor died")
+
+    def failovers() -> float:
+        return metrics.counter_total(
+            "tpuft_heal_donor_failovers_total", **labels
+        )
+
+    def retries() -> float:
+        return metrics.counter_total("tpuft_heal_retries_total", **labels)
+
+    f0, r0 = failovers(), retries()
+    with patch("torchft_tpu.manager.ManagerClient", autospec=True) as mc:
+        mc.return_value._checkpoint_metadata.return_value = "http://donor:0"
+        # Round 1: donor A attempted, fails (transfer error funnels).
+        client._quorum.return_value = heal_quorum("donor_a:1")
+        manager.start_quorum()
+        assert manager.errored() is not None
+        assert manager._heal_attempts == 1
+        assert transport.recv_checkpoint.call_count == 1
+
+        # Round 2: donor A reassigned — one-shot fail-fast skip, NO
+        # transfer attempted, attempt budget NOT burned.
+        manager.start_quorum()
+        assert manager.errored() is not None
+        assert transport.recv_checkpoint.call_count == 1
+        assert manager._heal_attempts == 1
+
+        # Round 3: donor B assigned — failover counted, attempted, fails;
+        # the attempt budget (2) is exhausted and escalates.
+        client._quorum.return_value = heal_quorum("donor_b:1")
+        with pytest.raises(HealExhaustedError):
+            manager.start_quorum()
+        assert transport.recv_checkpoint.call_count == 2
+        assert failovers() - f0 == 1
+        # Rounds 2 and 3 were retries of the original heal.
+        assert retries() - r0 == 2
+    manager.shutdown(wait=False)
+
+
+def test_manager_heal_success_resets_failover_state() -> None:
+    """A heal that lands clears the attempt counter and the failed-donor
+    memory — the next incident starts from a clean slate."""
+    manager, client, _, transport = make_manager(
+        pg=ProcessGroupDummy(), min_replica_size=1, heal_max_attempts=2
+    )
+    transport.recv_checkpoint.side_effect = [
+        RuntimeError("first donor died"),
+        {
+            "user": {"model": {"w": np.full(2, 9.0)}},
+            "tpuft": {"step": 3, "batches_committed": 6},
+        },
+    ]
+    with patch("torchft_tpu.manager.ManagerClient", autospec=True) as mc:
+        mc.return_value._checkpoint_metadata.return_value = "http://donor:0"
+        client._quorum.return_value = heal_quorum("donor_a:1")
+        manager.start_quorum()
+        assert manager._heal_attempts == 1
+
+        client._quorum.return_value = heal_quorum("donor_b:1")
+        manager.start_quorum()
+    assert manager.errored() is None
+    assert manager._heal_attempts == 0
+    assert manager._heal_failed_donors == {}
+    assert manager.current_step() == 3
+    manager.shutdown(wait=False)
+
+
+def test_manager_threads_quorum_era_through_both_transport_directions() -> None:
+    """The quorum era reaches the transport on both sides: the donor's
+    send_checkpoint stages it (it lands in /meta and fences chunk URLs)
+    and the joiner's recv_checkpoint enforces it."""
+    # Donor direction.
+    manager, client, _, transport = make_manager(pg=ProcessGroupDummy())
+    client._quorum.return_value = make_quorum(
+        quorum_id=13, recover_dst_replica_ranks=[1]
+    )
+    manager.start_quorum()
+    manager.wait_quorum()
+    assert transport.send_checkpoint.call_args[1]["quorum_id"] == 13
+    manager.shutdown(wait=False)
+
+    # Joiner direction.
+    manager, client, _, transport = make_manager(
+        pg=ProcessGroupDummy(), min_replica_size=1
+    )
+    transport.recv_checkpoint.return_value = {
+        "user": {"model": {"w": np.zeros(2)}},
+        "tpuft": {"step": 3, "batches_committed": 6},
+    }
+    with patch("torchft_tpu.manager.ManagerClient", autospec=True) as mc:
+        mc.return_value._checkpoint_metadata.return_value = "http://donor:0"
+        client._quorum.return_value = heal_quorum("donor_a:1", quorum_id=21)
+        manager.start_quorum()
+    assert transport.recv_checkpoint.call_args[1]["quorum_id"] == 21
+    manager.shutdown(wait=False)
+
+
+def test_manager_heal_failure_leaves_registered_state_untouched() -> None:
+    """A failed heal (e.g. digest mismatch) funnels into report_error and
+    never touches registered user state: the load fns are not called and
+    the commit is refused — the step boundary holds."""
+    from torchft_tpu.checkpointing import HealIntegrityError
+
+    manager, client, _, transport = make_manager(
+        pg=ProcessGroupDummy(), min_replica_size=1
+    )
+    transport.recv_checkpoint.side_effect = HealIntegrityError(
+        "whole-checkpoint digest mismatch"
+    )
+    with patch("torchft_tpu.manager.ManagerClient", autospec=True) as mc:
+        mc.return_value._checkpoint_metadata.return_value = "http://donor:0"
+        client._quorum.return_value = heal_quorum("donor_a:1")
+        manager.start_quorum()
+    assert manager.errored() is not None
+    manager._load_state_dict_fns["model"].assert_not_called()
+    client.should_commit.side_effect = lambda rank, step, vote, timeout: vote
+    assert manager.should_commit() is False
+    manager.shutdown(wait=False)

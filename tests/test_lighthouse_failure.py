@@ -209,6 +209,11 @@ def _spawn_lighthouse(
     """Starts the real `python -m torchft_tpu.lighthouse` daemon and blocks
     until it accepts TCP connections (observed readiness, not a sleep).
     Also used by the chaos soak's lighthouse-restart fault."""
+    # Fail as NativeToolchainMissing (-> a clean conftest skip) instead of
+    # an opaque child rc=1 when the native plane cannot build here.
+    from torchft_tpu import _native
+
+    _native.ensure_built()
     proc = subprocess.Popen(
         [
             sys.executable,

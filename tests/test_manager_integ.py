@@ -503,3 +503,178 @@ def test_shrink_only_quorum_blocks_new_joiner(lighthouse) -> None:
             store_b.shutdown()
         mgr_a.shutdown(wait=False)
         store_a.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Heal-path hardening drills (threads-as-replicas; see also the pure-Python
+# transport-level versions in tests/test_heal_hardening.py, which carry the
+# same properties in containers without the native toolchain).
+# ---------------------------------------------------------------------------
+
+
+def test_donor_dies_mid_heal_joiner_fails_over_and_resumes(lighthouse) -> None:
+    """Kill one of three groups, then cut the donor's heal stream partway
+    through (chunks 2+ of 4 die for longer than the joiner's fetch
+    window — the SIGKILLed-donor shape as seen from the wire): the joiner
+    must fail the attempt cleanly, re-enter quorum as joining, and
+    complete the heal on a later assignment by re-fetching ONLY the
+    missing chunks (the re-fetch counter pins that resume actually
+    resumed). min_replica_size=3 freezes the survivors' commits while the
+    joiner is out, so the heal target (step, digest) stays stable across
+    attempts — the case resume exists for.
+
+    Zero replica divergence is the master assertion, as always."""
+    import threading
+    import time as _time
+
+    from ft_harness import ft_counter_delta, ft_counter_snapshot
+    from torchft_tpu.checkpointing import HTTPTransport
+
+    class DyingDonorHook:
+        """Dies on chunks >= 2 for ``window`` seconds from the first death
+        — longer than the joiner's 10 s fetch window, so heal attempt 1
+        conclusively fails with chunks 0-1 verified and cached."""
+
+        def __init__(self, window: float = 12.0) -> None:
+            self.first_die = None
+            self.window = window
+            self.lock = threading.Lock()
+
+        def __call__(self, step: int, index: int):
+            if index < 2:
+                return None
+            with self.lock:
+                now = _time.monotonic()
+                if self.first_die is None:
+                    self.first_die = now
+                if now - self.first_die <= self.window:
+                    return "die"
+            return None
+
+    hook = DyingDonorHook()
+
+    def faulty_donor_transport(runner, rank):
+        transport = HTTPTransport(num_chunks=4)
+        if runner.replica_group != 2:  # healthy groups serve; 2 is killed
+            transport._fault_hook = hook
+        return transport
+
+    injector = EventInjector().fail_at(group=2, step=1)
+    runners = [
+        Runner(
+            replica_group=i,
+            lighthouse_addr=lighthouse.address(),
+            train_loop=ddp_train_loop,
+            num_steps=4,
+            injector=injector,
+            train_loop_args={
+                "min_replica_size": 3,
+                "transport_factory": faulty_donor_transport,
+            },
+        )
+        for i in range(3)
+    ]
+    before = ft_counter_snapshot()
+    results = run_replica_groups(runners, timeout=240)
+    delta = ft_counter_delta(before, ft_counter_snapshot())
+    assert injector.count == 1
+    assert_groups_converged(results, 4)
+    assert hook.first_die is not None, "the donor fault never fired"
+    # Resume exactness: chunks 0-1 were cached by the failed attempt, so
+    # only the 2 missing chunks were ever re-transferred — dying-donor
+    # connection cuts never reach the wire-transfer counter.
+    assert delta["chunk_refetches"] == 2, delta
+    assert delta["resumed_bytes"] > 0, delta
+    # The data itself was never wrong.
+    assert delta["checksum_failures"] == 0, delta
+
+
+def test_corrupt_heal_stream_rejected_exactly_and_never_adopted(lighthouse) -> None:
+    """Kill one of two groups and bit-flip the donor's first chunk-0 serve
+    during the heal: the joiner must reject + re-fetch (checksum counter
+    moves by EXACTLY the injected count) and both groups must end bitwise
+    identical — corrupt state never enters committed history."""
+    from ft_harness import ft_counter_delta, ft_counter_snapshot
+    from torchft_tpu.checkpointing import HTTPTransport
+
+    injected = []
+
+    def corrupt_once(step: int, index: int):
+        if index == 0 and not injected:
+            injected.append(1)
+            return "corrupt_stream"
+        return None
+
+    def faulty_donor_transport(runner, rank):
+        transport = HTTPTransport(num_chunks=4)
+        if runner.replica_group == 0:  # the survivor = the donor
+            transport._fault_hook = corrupt_once
+        return transport
+
+    injector = EventInjector().fail_at(group=1, step=1)
+    runners = [
+        Runner(
+            replica_group=i,
+            lighthouse_addr=lighthouse.address(),
+            train_loop=ddp_train_loop,
+            num_steps=4,
+            injector=injector,
+            train_loop_args={"transport_factory": faulty_donor_transport},
+        )
+        for i in range(2)
+    ]
+    before = ft_counter_snapshot()
+    results = run_replica_groups(runners, timeout=240)
+    delta = ft_counter_delta(before, ft_counter_snapshot())
+    assert injector.count == 1
+    assert_groups_converged(results, 4)
+    assert len(injected) == 1
+    assert delta["checksum_failures"] == 1, delta  # exactly the injection
+
+
+def test_drip_feeding_donor_fenced_by_watchdog(lighthouse, monkeypatch) -> None:
+    """Kill one of two groups and make the donor's first heal serve drip
+    below the progress floor: the joiner must fence it within the
+    watchdog window (seconds) instead of stalling for the full fetch
+    timeout, then complete the heal on a later clean serve. The drill's
+    liveness bound IS the assertion: with a 10 s fetch timeout per chunk
+    and a 240 s drill budget, an unfenced drip (256 B/s against ~16 KB of
+    chunks = minutes per serve) would blow the budget."""
+    from ft_harness import ft_counter_delta, ft_counter_snapshot
+    from torchft_tpu.checkpointing import HTTPTransport
+    from torchft_tpu.checkpointing import http_transport as ht
+
+    monkeypatch.setenv(ht.ENV_HEAL_MIN_BPS, "100000")
+    stalled = []
+
+    def stall_once(step: int, index: int):
+        if index == 0 and not stalled:
+            stalled.append(1)
+            return "stall_donor"
+        return None
+
+    def faulty_donor_transport(runner, rank):
+        transport = HTTPTransport(num_chunks=4)
+        if runner.replica_group == 0:
+            transport._fault_hook = stall_once
+        return transport
+
+    injector = EventInjector().fail_at(group=1, step=1)
+    runners = [
+        Runner(
+            replica_group=i,
+            lighthouse_addr=lighthouse.address(),
+            train_loop=ddp_train_loop,
+            num_steps=4,
+            injector=injector,
+            train_loop_args={"transport_factory": faulty_donor_transport},
+        )
+        for i in range(2)
+    ]
+    before = ft_counter_snapshot()
+    results = run_replica_groups(runners, timeout=240)
+    delta = ft_counter_delta(before, ft_counter_snapshot())
+    assert injector.count == 1
+    assert_groups_converged(results, 4)
+    assert len(stalled) == 1
+    assert delta["stalled_fetches"] >= 1, delta

@@ -42,14 +42,18 @@ def rules_of(findings):
 
 
 def test_r1_violation_fixture() -> None:
+    # Unguarded thread target + lambda callback + unguarded heal/recv
+    # worker (the heal-plane shape: a joiner's checkpoint fetch thread
+    # must funnel donor-death/checksum/watchdog failures).
     findings = scan("r1_violation.py", rules=["step-boundary-escape"])
-    assert len(findings) == 2  # unguarded thread target + lambda callback
+    assert len(findings) == 3
     assert rules_of(findings) == ["step-boundary-escape"]
     lines = sorted(f.line for f in findings)
     assert any("thread target" in f.message for f in findings)
     assert any("lambda" in f.message for f in findings)
+    assert any("recv_worker" in f.message for f in findings)
     assert all(f.file.endswith("r1_violation.py") for f in findings)
-    assert lines == [10, 16]
+    assert lines == [10, 16, 29]
 
 
 def test_r1_clean_fixture() -> None:

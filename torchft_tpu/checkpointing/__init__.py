@@ -7,8 +7,22 @@ Two axes (reference: SURVEY.md §5 checkpoint/resume):
      state_dict (step/batches_committed), e.g. with orbax.
 """
 
-from torchft_tpu.checkpointing.http_transport import HTTPTransport
+from torchft_tpu.checkpointing.http_transport import (
+    HealChecksumError,
+    HealEraMismatch,
+    HealIntegrityError,
+    HealStalledError,
+    HTTPTransport,
+)
 from torchft_tpu.checkpointing.pg_transport import PGTransport
 from torchft_tpu.checkpointing.transport import CheckpointTransport
 
-__all__ = ["CheckpointTransport", "HTTPTransport", "PGTransport"]
+__all__ = [
+    "CheckpointTransport",
+    "HTTPTransport",
+    "PGTransport",
+    "HealChecksumError",
+    "HealEraMismatch",
+    "HealIntegrityError",
+    "HealStalledError",
+]

@@ -8,41 +8,339 @@ state. Chunked mode splits flattened pytree leaves round-robin into N
 independently-fetchable chunks pulled in parallel.
 
 Routes: ``/checkpoint/{step}/meta``, ``/checkpoint/{step}/full``,
-``/checkpoint/{step}/{chunk_index}``.
+``/checkpoint/{step}/{chunk_index}`` (chunk URLs accept a
+``?quorum_id=N`` era tag; a mismatch against the staged era answers 409).
+
+Heal-path hardening (beyond the reference, which trusts the stream):
+
+- **Integrity**: the donor stages a per-chunk CRC32C (crc32 fallback when
+  google_crc32c is absent) plus a whole-checkpoint digest, served in
+  ``/meta``; the joiner checksums every chunk on receive. A mismatched
+  chunk is re-fetched within its bounded retry window; an exhausted
+  window raises — corrupt state is never adopted (the caller funnels the
+  error into Manager.report_error).
+- **Resume + donor failover**: verified chunks are cached keyed by
+  ``(step, digest)``. When the donor dies mid-stream the heal fails
+  cleanly; the next attempt — any donor, any quorum era — re-fetches only
+  the missing chunks (committed state at a step is bitwise identical
+  across donors, and the digest proves it).
+- **Gray-failure fencing**: every chunk stream runs under a
+  minimum-progress watchdog (``$TPUFT_HEAL_MIN_BYTES_PER_SEC``, default
+  1024): a hung or drip-feeding donor is fenced within the watchdog
+  window instead of stalling the joiner for the full fetch timeout.
+  (A netem-emulated link below the floor would self-fence: raise the
+  floor env accordingly for extreme emulations.)
+- **Era fencing**: ``/meta`` carries the staged ``quorum_id``; a joiner
+  healing in era E rejects a donor staged for era != E instead of
+  healing backwards from a stale survivor.
 """
 
 from __future__ import annotations
 
+import hashlib
+import os
 import pickle
 import socket
 import time
 import threading
 import urllib.error
+import urllib.parse
 import urllib.request
+import zlib
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 import jax
 
 from torchft_tpu import metrics
 from torchft_tpu._safe_pickle import safe_loads
-from torchft_tpu.utils import netem
+from torchft_tpu.utils import faultinject, netem
 from torchft_tpu.checkpointing import _serialization
 from torchft_tpu.checkpointing.transport import CheckpointTransport
 
-__all__ = ["HTTPTransport"]
+__all__ = [
+    "HTTPTransport",
+    "HealIntegrityError",
+    "HealChecksumError",
+    "HealEraMismatch",
+    "HealStalledError",
+]
+
+ENV_HEAL_MIN_BPS = "TPUFT_HEAL_MIN_BYTES_PER_SEC"
+
+# Sliding window the progress watchdog averages over; fencing decisions
+# never fire before one full window has elapsed, so a legit slow start
+# (TLS, first-byte latency) is not a stall.
+_WATCHDOG_WINDOW_SEC = 2.0
+
+
+class HealIntegrityError(RuntimeError):
+    """Checkpoint integrity verification failed; the state was NOT adopted."""
+
+
+class HealChecksumError(HealIntegrityError):
+    """One chunk's checksum mismatched (retryable within the fetch window)."""
+
+
+class HealEraMismatch(RuntimeError):
+    """The donor's staged checkpoint belongs to a different quorum era."""
+
+
+class HealStalledError(RuntimeError):
+    """The heal stream fell below the minimum-progress floor (gray donor)."""
+
+
+# ---------------------------------------------------------------------------
+# Checksums: CRC32C when google_crc32c is importable, zlib crc32 otherwise.
+# Donor and joiner agree via the /meta "crc_algo" field, so a mixed fleet
+# verifies with the donor's algorithm or fails loudly (never silently).
+# ---------------------------------------------------------------------------
+
+# google_crc32c's C extension only takes `bytes`; feed it bounded slices so
+# checksumming never materializes a payload-sized copy.
+_CRC_SLICE = 1 << 20
+
+
+def _crc32_update(crc: int, data: Any) -> int:
+    return zlib.crc32(data, crc) & 0xFFFFFFFF
+
+
+try:  # pragma: no cover - exercised via whichever algo the box has
+    import google_crc32c as _google_crc32c
+
+    def _crc32c_update(crc: int, data: Any) -> int:
+        if isinstance(data, bytes):
+            return _google_crc32c.extend(crc, data)
+        mv = memoryview(data)
+        if mv.format != "B" or mv.ndim != 1:
+            mv = mv.cast("B")
+        for off in range(0, len(mv), _CRC_SLICE):
+            crc = _google_crc32c.extend(crc, mv[off : off + _CRC_SLICE].tobytes())
+        return crc
+
+    _CRC_UPDATERS: Dict[str, Callable[[int, Any], int]] = {
+        "crc32c": _crc32c_update,
+        "crc32": _crc32_update,
+    }
+    _CRC_ALGO = "crc32c"
+except ImportError:  # pragma: no cover
+    _CRC_UPDATERS = {"crc32": _crc32_update}
+    _CRC_ALGO = "crc32"
+
+
+class _CRCWriter:
+    """File-like sink that checksums everything written through it (used to
+    stage per-chunk CRCs without a serialized copy)."""
+
+    __slots__ = ("crc", "_update")
+
+    def __init__(self, update: Callable[[int, Any], int]) -> None:
+        self.crc = 0
+        self._update = update
+
+    def write(self, data: Any) -> None:
+        self.crc = self._update(self.crc, data)
+
+
+def _checkpoint_digest(step: int, algo: str, chunk_crcs: List[int]) -> str:
+    """Whole-checkpoint digest binding the per-chunk checksums to (step,
+    algo). Deliberately quorum-era independent: committed state at a step
+    is bitwise identical across donors and eras, which is exactly what
+    makes cross-donor resume valid."""
+    h = hashlib.sha256()
+    h.update(
+        f"{step}:{algo}:{','.join(str(c) for c in chunk_crcs)}".encode()
+    )
+    return h.hexdigest()
+
+
+def _heal_min_bps(default: float = 1024.0) -> float:
+    """Minimum-progress floor (bytes/s) from ``$TPUFT_HEAL_MIN_BYTES_PER_SEC``
+    (<= 0 disables the watchdog; malformed values fall back)."""
+    try:
+        return float(os.environ.get(ENV_HEAL_MIN_BPS, str(default)))
+    except ValueError:
+        return default
+
+
+class _GuardedReader:
+    """Wraps an HTTP response stream: checksums bytes on the fly and fences
+    the fetch when progress falls below the bytes/s floor for a full
+    watchdog window (the gray-failure case a per-recv socket timeout
+    cannot see — a dripping donor resets that timeout with every byte)."""
+
+    def __init__(
+        self,
+        raw: Any,
+        crc_update: Optional[Callable[[int, Any], int]] = None,
+        min_bps: float = 0.0,
+        window: float = _WATCHDOG_WINDOW_SEC,
+    ) -> None:
+        self._raw = raw
+        self._update = crc_update
+        self.crc = 0
+        self.total = 0
+        self._min_bps = float(min_bps)
+        self._window = float(window)
+        self._start = time.monotonic()
+        self._events: deque = deque()  # (t, nbytes) inside the window
+
+    def _read1(self, n: int) -> bytes:
+        # read1 returns whatever ONE underlying read yields; plain read(n)
+        # on a BufferedReader loops until n bytes arrived, which would let
+        # a dripping donor hide from the watchdog inside one giant read.
+        read1 = getattr(self._raw, "read1", None)
+        return read1(n) if read1 is not None else self._raw.read(n)
+
+    def read(self, n: int = -1) -> bytes:
+        parts: List[bytes] = []
+        want = n
+        while want != 0:
+            data = self._read1(want if want > 0 else _CRC_SLICE)
+            if not data:
+                break
+            if self._update is not None:
+                self.crc = self._update(self.crc, data)
+            self._account(len(data))
+            parts.append(data)
+            if want > 0:
+                want -= len(data)
+        return b"".join(parts)
+
+    def readinto(self, buf: Any) -> int:
+        # Single bounded-granularity read; callers (_serialization) loop.
+        readinto1 = getattr(self._raw, "readinto1", None)
+        n = readinto1(buf) if readinto1 is not None else self._raw.readinto(buf)
+        if n:
+            if self._update is not None:
+                self.crc = self._update(self.crc, memoryview(buf)[:n])
+            self._account(n)
+        return n
+
+    def _account(self, n: int) -> None:
+        self.total += n
+        if self._min_bps <= 0:
+            return
+        now = time.monotonic()
+        self._events.append((now, n))
+        cutoff = now - self._window
+        while self._events and self._events[0][0] < cutoff:
+            self._events.popleft()
+        if now - self._start >= self._window:
+            rate = sum(nb for _, nb in self._events) / self._window
+            if rate < self._min_bps:
+                metrics.inc("tpuft_heal_stalled_fetches_total")
+                raise HealStalledError(
+                    f"heal stream below the progress floor: {rate:.0f} B/s < "
+                    f"{self._min_bps:.0f} B/s over the last {self._window:.1f}s "
+                    f"(floor from ${ENV_HEAL_MIN_BPS}); fencing the donor"
+                )
+
+
+# ---------------------------------------------------------------------------
+# Donor-side fault writers (chaos drills; see torchft_tpu/utils/faultinject).
+# ---------------------------------------------------------------------------
+
+
+class _CorruptingWriter:
+    """Flips one bit of the byte at ``flip_at`` — the injected fault the
+    joiner's per-chunk checksum must catch."""
+
+    def __init__(self, raw: Any, flip_at: int) -> None:
+        self._raw = raw
+        self._off = 0
+        self._flip_at = flip_at
+        self.flipped = False
+
+    def write(self, data: Any) -> None:
+        mv = memoryview(data)
+        if mv.format != "B" or mv.ndim != 1:
+            mv = mv.cast("B")
+        n = len(mv)
+        if not self.flipped and self._off <= self._flip_at < self._off + n:
+            buf = bytearray(mv)
+            buf[self._flip_at - self._off] ^= 0x01
+            self.flipped = True
+            self._raw.write(bytes(buf))
+        else:
+            self._raw.write(mv)
+        self._off += n
+
+
+class _DripWriter:
+    """Serves at a trickle (default 256 B/s) — the gray donor the joiner's
+    minimum-progress watchdog must fence."""
+
+    def __init__(self, raw: Any, bps: float = 256.0, slice_bytes: int = 64) -> None:
+        self._raw = raw
+        self._delay = slice_bytes / float(bps)
+        self._slice = slice_bytes
+
+    def write(self, data: Any) -> None:
+        mv = memoryview(data)
+        if mv.format != "B" or mv.ndim != 1:
+            mv = mv.cast("B")
+        for off in range(0, len(mv), self._slice):
+            self._raw.write(mv[off : off + self._slice])
+            time.sleep(self._delay)
+
+
+class _TruncatingWriter:
+    """Writes only the first ``limit`` bytes then swallows the rest — with
+    the connection closed after the handler returns, the joiner sees a
+    truncated stream (EOF mid-chunk)."""
+
+    def __init__(self, raw: Any, limit: int) -> None:
+        self._raw = raw
+        self._left = limit
+
+    def write(self, data: Any) -> None:
+        if self._left <= 0:
+            return
+        mv = memoryview(data)
+        if mv.format != "B" or mv.ndim != 1:
+            mv = mv.cast("B")
+        take = mv[: self._left]
+        self._left -= len(take)
+        self._raw.write(take)
 
 
 class _Staged:
     """Prepared (header + host leaves) per chunk — ONE host copy total; the
     HTTP handlers stream straight from these buffers (no serialized copy,
-    the round-1 2x-peak-memory finding)."""
+    the round-1 2x-peak-memory finding). Integrity sidecar: per-chunk
+    checksums + the whole-checkpoint digest, computed once at stage time."""
 
-    def __init__(self, step: int, chunks: List[Any], treedef: Any) -> None:
+    def __init__(
+        self,
+        step: int,
+        chunks: List[Any],
+        treedef: Any,
+        quorum_id: Optional[int] = None,
+    ) -> None:
         self.step = step
         self.chunks = chunks  # List[_serialization.Prepared]
         self.treedef = treedef
+        self.quorum_id = quorum_id
+        self.crc_algo = _CRC_ALGO
+        self.chunk_crcs: List[int] = []
+        for chunk in chunks:
+            w = _CRCWriter(_CRC_UPDATERS[_CRC_ALGO])
+            _serialization.write_prepared(chunk, w)
+            self.chunk_crcs.append(w.crc)
+        self.digest = _checkpoint_digest(step, self.crc_algo, self.chunk_crcs)
+
+
+class _HealCacheEntry:
+    """Joiner-side resume state for one (step, digest): verified chunks (so
+    a failover re-fetches only what is missing) and which chunk indices
+    ever started transferring (so the re-fetch counter stays exact)."""
+
+    def __init__(self) -> None:
+        self.chunks: Dict[int, Tuple[Any, int]] = {}  # index -> (chunk, nbytes)
+        self.attempted: Set[int] = set()
 
 
 class HTTPTransport(CheckpointTransport[Any]):
@@ -59,6 +357,14 @@ class HTTPTransport(CheckpointTransport[Any]):
         self._cond = threading.Condition()
         self._staged: Optional[_Staged] = None
         self._served_event = threading.Event()
+        # Joiner-side resume cache, at most one (step, digest) entry: the
+        # verified chunks of the last failed heal, reusable against ANY
+        # donor serving the same digest.
+        self._heal_cache: Dict[Tuple[int, str], _HealCacheEntry] = {}
+        # Chaos seam: tests set a callable (step, chunk_index) -> mode to
+        # inject donor-side stream faults deterministically; when unset the
+        # punisher's file-armed faults apply (faultinject.consume).
+        self._fault_hook: Optional[Callable[[int, int], Optional[str]]] = None
 
         transport = self
 
@@ -74,7 +380,8 @@ class HTTPTransport(CheckpointTransport[Any]):
                 # heals, so /metrics needs no extra server or port.
                 if metrics._serve_metrics_http(self, metrics.REGISTRY, self.path):
                     return
-                parts = self.path.strip("/").split("/")
+                split = urllib.parse.urlsplit(self.path)
+                parts = split.path.strip("/").split("/")
                 if len(parts) != 3 or parts[0] != "checkpoint":
                     self.send_error(404, "unknown route")
                     return
@@ -104,8 +411,35 @@ class HTTPTransport(CheckpointTransport[Any]):
                         + (f" (have {staged.step})" if staged else ""),
                     )
                     return
+                # Era fence: a joiner tags its chunk fetches with the quorum
+                # era it is healing in; serving a different staged era would
+                # hand it bytes its /meta checksums do not describe (the
+                # stage could have moved between its meta and chunk GETs).
+                want_era = urllib.parse.parse_qs(split.query).get("quorum_id")
+                if (
+                    want_era
+                    and staged.quorum_id is not None
+                    and str(staged.quorum_id) != want_era[0]
+                ):
+                    self.send_error(
+                        409,
+                        f"stale quorum era: staged {staged.quorum_id}, "
+                        f"joiner wants {want_era[0]}",
+                    )
+                    return
                 if parts[2] == "meta":
-                    body = pickle.dumps((len(staged.chunks), staged.treedef))
+                    body = pickle.dumps(
+                        {
+                            "format": 2,
+                            "num_chunks": len(staged.chunks),
+                            "treedef": staged.treedef,
+                            "step": staged.step,
+                            "quorum_id": staged.quorum_id,
+                            "crc_algo": staged.crc_algo,
+                            "chunk_crcs": staged.chunk_crcs,
+                            "digest": staged.digest,
+                        }
+                    )
                     self.send_response(200)
                     self.send_header("Content-Type", "application/octet-stream")
                     self.send_header("Content-Length", str(len(body)))
@@ -121,14 +455,26 @@ class HTTPTransport(CheckpointTransport[Any]):
                     if netem.enabled():  # emulated-DCN heal path
                         netem.pace_latency()
                         out = netem.PacingWriter(out)
-                    for chunk in staged.chunks:
-                        out.write(chunk.total_size.to_bytes(8, "big"))
-                        _serialization.write_prepared(chunk, out)
+                    try:
+                        for chunk in staged.chunks:
+                            out.write(chunk.total_size.to_bytes(8, "big"))
+                            _serialization.write_prepared(chunk, out)
+                    except (ConnectionError, TimeoutError, OSError):
+                        # The joiner went away (fenced us, failed over, or
+                        # died); serving is best-effort, never donor-fatal.
+                        self.close_connection = True
                 else:
                     try:
-                        chunk = staged.chunks[int(parts[2])]
+                        index = int(parts[2])
+                        chunk = staged.chunks[index]
                     except (ValueError, IndexError):
                         self.send_error(400, "bad chunk index")
+                        return
+                    fault = transport._chunk_fault(step, index)
+                    if fault == "die":
+                        # A donor dying mid-heal: cut the connection before
+                        # (or instead of) the body.
+                        self.close_connection = True
                         return
                     self.send_response(200)
                     self.send_header("Content-Type", "application/octet-stream")
@@ -141,8 +487,21 @@ class HTTPTransport(CheckpointTransport[Any]):
                         # one up-front sleep would hold the wire silent
                         # past the joiner's per-recv inactivity timeout.
                         out = netem.PacingWriter(out)
-                    # Streams directly from the staged host arrays.
-                    _serialization.write_prepared(chunk, out)
+                    if fault == "corrupt_stream":
+                        # Flip a payload bit (the LAST byte is raw array
+                        # data whenever the chunk carries arrays): the
+                        # joiner's CRC must reject and re-fetch.
+                        out = _CorruptingWriter(out, chunk.total_size - 1)
+                    elif fault == "stall_donor":
+                        out = _DripWriter(out)
+                    elif fault == "truncate":
+                        out = _TruncatingWriter(out, chunk.total_size // 2)
+                        self.close_connection = True
+                    try:
+                        # Streams directly from the staged host arrays.
+                        _serialization.write_prepared(chunk, out)
+                    except (ConnectionError, TimeoutError, OSError):
+                        self.close_connection = True
                 transport._served_event.set()
 
         class DualStackServer(ThreadingHTTPServer):
@@ -155,6 +514,12 @@ class HTTPTransport(CheckpointTransport[Any]):
         )
         self._thread.start()
 
+    def _chunk_fault(self, step: int, index: int) -> Optional[str]:
+        hook = self._fault_hook
+        if hook is not None:
+            return hook(step, index)
+        return faultinject.consume("heal_stream")
+
     # -- CheckpointTransport -----------------------------------------------
 
     def metadata(self) -> str:
@@ -163,10 +528,16 @@ class HTTPTransport(CheckpointTransport[Any]):
         return f"http://{host}:{port}"
 
     def send_checkpoint(
-        self, dst_ranks: List[int], step: int, state_dict: Any, timeout: float
+        self,
+        dst_ranks: List[int],
+        step: int,
+        state_dict: Any,
+        timeout: float,
+        quorum_id: Optional[int] = None,
     ) -> None:
         """Stages host copies of the state and starts serving them for
-        ``step``. Serving continues until :meth:`disallow_checkpoint`."""
+        ``step`` (tagged with ``quorum_id`` when the manager provides the
+        era). Serving continues until :meth:`disallow_checkpoint`."""
         leaves, treedef = jax.tree_util.tree_flatten(state_dict)
         leaves = [_serialization._to_host(leaf) for leaf in leaves]
         n = self._num_chunks if self._num_chunks > 0 else 1
@@ -177,8 +548,9 @@ class HTTPTransport(CheckpointTransport[Any]):
         # prepare() keeps the host leaves + a small header per chunk; the
         # serialized bytes never exist as a second whole-payload copy.
         chunks = [_serialization.prepare(chunk) for chunk in chunk_dicts]
+        staged = _Staged(step, chunks, treedef, quorum_id=quorum_id)
         with self._cond:
-            self._staged = _Staged(step, chunks, treedef)
+            self._staged = staged
             self._cond.notify_all()
 
     def disallow_checkpoint(self) -> None:
@@ -186,41 +558,173 @@ class HTTPTransport(CheckpointTransport[Any]):
             self._staged = None
 
     def recv_checkpoint(
-        self, src_rank: int, metadata: str, step: int, timeout: float
+        self,
+        src_rank: int,
+        metadata: str,
+        step: int,
+        timeout: float,
+        quorum_id: Optional[int] = None,
     ) -> Any:
         base = f"{metadata}/checkpoint/{step}"
-        num_chunks, treedef = safe_loads(_fetch_retry_404(f"{base}/meta", timeout))
+        meta = safe_loads(_fetch_retry(f"{base}/meta", timeout))
+        if not isinstance(meta, dict) or meta.get("format") != 2:
+            raise HealIntegrityError(
+                f"unrecognized checkpoint /meta format from {metadata}: "
+                f"{type(meta).__name__}"
+            )
+        num_chunks: int = meta["num_chunks"]
+        treedef = meta["treedef"]
+        chunk_crcs: Optional[List[int]] = meta.get("chunk_crcs")
+        digest: Optional[str] = meta.get("digest")
+        algo: str = meta.get("crc_algo", "crc32")
+        donor_era = meta.get("quorum_id")
 
-        def fetch_chunk(i: int) -> Any:
-            # Stream-decode straight off the socket into final buffers: peak
-            # memory = final leaves + one in-flight read window per chunk.
-            # Same 404 retry as the meta fetch: the donor's serve window can
-            # close (commit -> disallow) BETWEEN our meta and chunk requests
-            # — nothing pins the staged object across GETs — and reopen on
-            # its retry round.
-            return _fetch_retry_404(
-                f"{base}/{i}", timeout, consume=_serialization.load_state_dict
+        # Era fence: never heal backwards from a survivor still staged for
+        # an older quorum (its state may predate commits we must match).
+        if (
+            quorum_id is not None
+            and donor_era is not None
+            and donor_era != quorum_id
+        ):
+            metrics.inc("tpuft_heal_era_rejects_total")
+            raise HealEraMismatch(
+                f"donor staged quorum era {donor_era}, joiner is healing in "
+                f"era {quorum_id}: rejecting the stale-era heal"
             )
 
-        if num_chunks == 1:
-            chunks = [fetch_chunk(0)]
-        else:
-            with ThreadPoolExecutor(max_workers=min(num_chunks, 8)) as pool:
-                futs = [pool.submit(fetch_chunk, i) for i in range(num_chunks)]
+        crc_update = _CRC_UPDATERS.get(algo)
+        if chunk_crcs is not None and crc_update is None:
+            raise HealIntegrityError(
+                f"donor checksums use {algo!r}, unavailable on this host"
+            )
+        # The digest must be exactly the checksums' binding — verified
+        # BEFORE any transfer so a tampered/buggy meta never costs a
+        # payload fetch and mismatched state is never adopted.
+        if digest is not None and chunk_crcs is not None:
+            if _checkpoint_digest(step, algo, chunk_crcs) != digest:
+                raise HealIntegrityError(
+                    "whole-checkpoint digest does not match the per-chunk "
+                    "checksums in /meta: refusing the heal"
+                )
+
+        # Resume: reuse verified chunks from a previous failed attempt at
+        # the same (step, digest) — valid across donors and quorum eras
+        # because committed state at a step is bitwise identical.
+        key = (step, digest) if digest is not None else None
+        entry = self._heal_cache.get(key) if key is not None else None
+        if entry is None:
+            entry = _HealCacheEntry()
+        # One entry total: stale (step, digest) partials are dropped here.
+        self._heal_cache = {key: entry} if key is not None else {}
+        missing = [i for i in range(num_chunks) if i not in entry.chunks]
+        resumed = bool(entry.chunks)
+        if resumed:
+            for _chunk, nbytes in entry.chunks.values():
+                metrics.inc("tpuft_heal_resumed_bytes_total", nbytes)
+
+        era_tag = f"?quorum_id={quorum_id}" if quorum_id is not None else ""
+        min_bps = _heal_min_bps()
+
+        def fetch_chunk(i: int) -> None:
+            # Stream-decode straight off the socket into final buffers: peak
+            # memory = final leaves + one in-flight read window per chunk.
+            expected = chunk_crcs[i] if chunk_crcs is not None else None
+            attempts = [0]
+
+            def consume(resp: Any) -> Tuple[Any, int]:
+                attempts[0] += 1
+                # A re-fetch is any transfer the first clean pass would not
+                # have needed: a retry within this call's window, a chunk
+                # that already streamed bytes in a failed attempt, or any
+                # transfer of a RESUMED heal (the drill invariant: after a
+                # failover this counter moves by exactly the missing
+                # chunks). The not-yet-staged 404 race never reaches here,
+                # so it never inflates the counter.
+                if resumed or i in entry.attempted or attempts[0] > 1:
+                    metrics.inc("tpuft_heal_chunk_refetches_total")
+                entry.attempted.add(i)
+                reader = _GuardedReader(
+                    resp,
+                    crc_update=crc_update if expected is not None else None,
+                    min_bps=min_bps,
+                )
+                t0 = time.perf_counter()
                 try:
-                    chunks = [f.result() for f in futs]
+                    chunk = _serialization.load_state_dict(reader)
+                except (HealStalledError, EOFError, ConnectionError):
+                    # Fence and truncation classify themselves; the retry
+                    # loop already knows which of them to re-try.
+                    raise
+                except Exception as decode_err:
+                    # The decoder crashed mid-stream (e.g. a bit flip inside
+                    # the pickled header renders it unreadable before any
+                    # checksum comparison). Drain the rest of the body and
+                    # let the checksum arbitrate: a mismatch is corruption
+                    # (counted + re-fetched), a match is a real protocol
+                    # bug that retrying cannot fix.
+                    if expected is None:
+                        raise
+                    try:
+                        while reader.read(1 << 16):
+                            pass
+                    except Exception:  # noqa: BLE001 — the CRC decides
+                        pass
+                    if reader.crc != expected:
+                        metrics.inc("tpuft_heal_checksum_failures_total")
+                        raise HealChecksumError(
+                            f"chunk {i} stream corrupt (decode failed: "
+                            f"{decode_err}; checksum {reader.crc:#010x} != "
+                            f"{expected:#010x}); discarding the chunk"
+                        ) from decode_err
+                    raise
+                if expected is not None and reader.crc != expected:
+                    metrics.inc("tpuft_heal_checksum_failures_total")
+                    raise HealChecksumError(
+                        f"chunk {i} checksum mismatch: got {reader.crc:#010x}, "
+                        f"want {expected:#010x} ({algo}); discarding the chunk"
+                    )
+                elapsed = time.perf_counter() - t0
+                if elapsed > 0:
+                    metrics.histogram(
+                        "tpuft_heal_stream_bytes_per_sec",
+                        buckets=metrics.DEFAULT_BYTES_PER_SEC_BUCKETS,
+                    ).observe(reader.total / elapsed)
+                return chunk, reader.total
+
+            # Same bounded retry as the meta fetch — the donor's serve
+            # window can close and reopen between our GETs — widened to the
+            # retryable failure set (404, connection refused/reset from a
+            # restarting donor, truncation, checksum mismatch).
+            entry.chunks[i] = _fetch_retry(
+                f"{base}/{i}{era_tag}", timeout, consume=consume
+            )
+
+        if len(missing) <= 1:
+            for i in missing:
+                fetch_chunk(i)
+        else:
+            with ThreadPoolExecutor(max_workers=min(len(missing), 8)) as pool:
+                futs = [pool.submit(fetch_chunk, i) for i in missing]
+                try:
+                    for f in futs:
+                        f.result()
                 except BaseException:
                     # Fail fast: without this, the pool's __exit__ would run
                     # every QUEUED fetch to completion — each burning its
                     # own full retry window against a donor that may be
-                    # gone — before the error reaches the manager.
+                    # gone — before the error reaches the manager. Verified
+                    # chunks stay in the resume cache for the next attempt.
                     pool.shutdown(wait=False, cancel_futures=True)
                     raise
+
         merged: Dict[int, Any] = {}
-        for chunk in chunks:
+        for chunk, _nbytes in entry.chunks.values():
             merged.update(chunk)
         leaves = [merged[i] for i in range(len(merged))]
-        return jax.tree_util.tree_unflatten(treedef, leaves)
+        result = jax.tree_util.tree_unflatten(treedef, leaves)
+        if key is not None:
+            self._heal_cache.pop(key, None)
+        return result
 
     def shutdown(self, wait: bool = True) -> None:
         self._server.shutdown()
@@ -229,25 +733,50 @@ class HTTPTransport(CheckpointTransport[Any]):
             self._thread.join(timeout=5)
 
 
-def _fetch_retry_404(
+def _is_retryable_fetch_error(e: BaseException) -> bool:
+    """Failures worth re-trying against the same URL within the bounded
+    window: not-yet-staged (404), a dying/restarting donor (refused, reset,
+    truncated stream), and a checksum mismatch (re-fetch the chunk). A
+    watchdog fence is NOT retryable — a gray donor will drip again and the
+    whole point is failing over fast — and neither are timeouts (the
+    per-recv inactivity bound) or other HTTP statuses (400/409 are
+    protocol-level rejections, e.g. a stale era)."""
+    if isinstance(e, HealStalledError):
+        return False
+    if isinstance(e, urllib.error.HTTPError):
+        return e.code == 404
+    if isinstance(e, HealChecksumError):
+        return True
+    if isinstance(e, urllib.error.URLError):
+        return isinstance(e.reason, ConnectionError)
+    # RemoteDisconnected/IncompleteRead surface as ConnectionError
+    # subclasses; EOFError is _serialization's truncated-stream signal.
+    return isinstance(e, (ConnectionError, EOFError))
+
+
+def _fetch_retry(
     url: str,
     timeout: float,
     consume: Optional[Callable[[Any], Any]] = None,
 ) -> Any:
-    """Fetch with bounded retry on 404; ``consume`` (default: read all
-    bytes) processes the open response, letting chunk fetches stream-decode
-    off the socket through the same retry loop as the meta fetch.
+    """Fetch with bounded retry on transient failures; ``consume`` (default:
+    read all bytes) processes the open response, letting chunk fetches
+    stream-decode off the socket through the same retry loop as the meta
+    fetch.
 
-    A 404 from the donor means "nothing staged for this step" — which is
-    often *not yet*: the joiner's fetch races the donor staging inside its
-    own quorum round, and under a loaded host (many GIL-scheduled ranks)
-    the donor's serve window can even close (commit → disallow) and REOPEN
-    on the retry round — up to a training step later — before a slow
-    fetcher gets through. Retrying turns both races into a wait; a real
-    wrong-step/never-staged fetch still fails when the window expires.
+    Retryable failures (see :func:`_is_retryable_fetch_error`): a 404 from
+    the donor means "nothing staged for this step" — often *not yet*: the
+    joiner's fetch races the donor staging inside its own quorum round, and
+    under a loaded host the donor's serve window can even close (commit →
+    disallow) and REOPEN on the retry round before a slow fetcher gets
+    through. A connection refused/reset or truncated stream means the donor
+    is dying or restarting mid-heal — the same bounded window covers its
+    supervised comeback instead of failing the heal on the first dropped
+    byte. A checksum mismatch re-fetches the chunk. A real wrong-step/
+    never-staged/corrupt-forever fetch still fails when the window expires.
 
-    The retry window is PER FETCH and opens at this fetch's FIRST 404, so
-    time spent actually transferring bytes (legitimate on a slow link)
+    The retry window is PER FETCH and opens at this fetch's FIRST failure,
+    so time spent actually transferring bytes (legitimate on a slow link)
     never charges anyone's retry budget, and a chunk whose turn in the
     fetch pool comes late gets a full window against the reopen race —
     leftovers of a window shared with the meta fetch could not span the
@@ -263,11 +792,16 @@ def _fetch_retry_404(
         try:
             with urllib.request.urlopen(url, timeout=timeout) as resp:
                 return consume(resp) if consume is not None else resp.read()
-        except urllib.error.HTTPError as e:
+        except Exception as e:
             now = time.monotonic()
             if retry_deadline is None:
                 retry_deadline = now + timeout
-            if e.code != 404 or now + delay >= retry_deadline:
+            if not _is_retryable_fetch_error(e) or now + delay >= retry_deadline:
                 raise
         time.sleep(delay)
         delay = min(delay * 1.5, 1.0)
+
+
+# Historical name (the loop originally retried 404s only); kept so older
+# callers/tests keep importing.
+_fetch_retry_404 = _fetch_retry

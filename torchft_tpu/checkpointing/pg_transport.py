@@ -61,8 +61,17 @@ class PGTransport(CheckpointTransport[Any]):
         return "<pg>"
 
     def send_checkpoint(
-        self, dst_ranks: List[int], step: int, state_dict: Any, timeout: float
+        self,
+        dst_ranks: List[int],
+        step: int,
+        state_dict: Any,
+        timeout: float,
+        quorum_id: Optional[int] = None,
     ) -> None:
+        # quorum_id is accepted for CheckpointTransport API parity and
+        # ignored: PG send/recv pairs are matched inside one already-
+        # configured (single-era) process group, so a cross-era transfer
+        # cannot form in the first place.
         treedef, metas, leaves = _serialization.state_dict_meta(state_dict)
         meta = _StateDictMeta(
             step=step,
@@ -86,7 +95,12 @@ class PGTransport(CheckpointTransport[Any]):
                 self._pg.send([arr], dst).wait(timeout)
 
     def recv_checkpoint(
-        self, src_rank: int, metadata: str, step: int, timeout: float
+        self,
+        src_rank: int,
+        metadata: str,
+        step: int,
+        timeout: float,
+        quorum_id: Optional[int] = None,
     ) -> Any:
         (length_arr,) = self._pg.recv([np.empty(1, dtype=np.int64)], src_rank).wait(timeout)
         (meta_buf,) = self._pg.recv(

@@ -3,7 +3,7 @@
 from __future__ import annotations
 
 from abc import ABC, abstractmethod
-from typing import Generic, List, TypeVar
+from typing import Generic, List, Optional, TypeVar
 
 T = TypeVar("T")
 
@@ -15,6 +15,11 @@ class CheckpointTransport(ABC, Generic[T]):
 
     The donor stages its state and serves it without pausing training; the
     joiner fetches and applies it before its first committed step.
+
+    ``quorum_id`` (optional on both sides) tags the transfer with the
+    quorum era it belongs to: transports that can carry it (HTTPTransport)
+    fence a joiner from adopting a stale-era donor's state; transports
+    that cannot simply ignore it.
     """
 
     @abstractmethod
@@ -24,12 +29,24 @@ class CheckpointTransport(ABC, Generic[T]):
 
     @abstractmethod
     def send_checkpoint(
-        self, dst_ranks: List[int], step: int, state_dict: T, timeout: float
+        self,
+        dst_ranks: List[int],
+        step: int,
+        state_dict: T,
+        timeout: float,
+        quorum_id: Optional[int] = None,
     ) -> None:
         """Stages/sends ``state_dict`` for ``dst_ranks`` at ``step``."""
 
     @abstractmethod
-    def recv_checkpoint(self, src_rank: int, metadata: str, step: int, timeout: float) -> T:
+    def recv_checkpoint(
+        self,
+        src_rank: int,
+        metadata: str,
+        step: int,
+        timeout: float,
+        quorum_id: Optional[int] = None,
+    ) -> T:
         """Fetches the state for ``step`` from ``src_rank``."""
 
     def disallow_checkpoint(self) -> None:

@@ -33,6 +33,10 @@ KNOWN_ENV = {
     "TPUFT_FLIGHT_RECORDER", "TPUFT_FLIGHT_RECORDER_SIZE",
     "TPUFT_HEARTBEAT_INTERVAL", "TPUFT_INIT_SYNC", "TPUFT_STRICT_COMMIT",
     "TPUFT_COMMIT_PIPELINE", "TPUFT_EMULATED_DEVICE_RTT_MS",
+    # Heal-path hardening: joiner-side progress floor, bounded failover
+    # attempts, and the punisher's stream-fault arming channel.
+    "TPUFT_HEAL_MIN_BYTES_PER_SEC", "TPUFT_HEAL_MAX_ATTEMPTS",
+    "TPUFT_FAULT_FILE",
     "TPUFT_METRICS_PORT", "TPUFT_METRICS_PUSH_SEC",
     "TPUFT_BENCH_CHILD",
     "TPUFT_BENCH_MODEL", "TPUFT_BENCH_STEPS", "TPUFT_BENCH_BATCH",
@@ -46,7 +50,8 @@ KNOWN_ENV = {
     "TPUFT_LOCK_CHECK", "TPUFT_ANALYSIS_REFERENCE", "TPUFT_ANALYSIS_BASELINE",
     # Repo tooling outside the package (tests/benchmarks/sentinel) — real
     # knobs a user may have exported; not typos.
-    "TPUFT_SOAK_SECONDS", "TPUFT_REGEN_FIXTURES", "TPUFT_SENTINEL_INTERVAL",
+    "TPUFT_SOAK_SECONDS", "TPUFT_SOAK_SEED",
+    "TPUFT_REGEN_FIXTURES", "TPUFT_SENTINEL_INTERVAL",
     "TPUFT_TRANSPORT_BENCH_GB", "TPUFT_TRANSPORT_BENCH_MODE",
     "TPUFT_TRANSPORT_BENCH_DEADLINE", "TPUFT_TRANSPORT_RSS_BOUND",
     "TPUFT_CPS_REPLICAS", "TPUFT_CPS_ROUNDS", "TPUFT_CPS_GROUP_WORLD_SIZE",

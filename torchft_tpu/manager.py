@@ -61,7 +61,12 @@ T = TypeVar("T")
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["Manager", "WorldSizeMode", "ExceptionWithTraceback"]
+__all__ = [
+    "Manager",
+    "WorldSizeMode",
+    "ExceptionWithTraceback",
+    "HealExhaustedError",
+]
 
 # Env overrides (reference: manager.py:82-89).
 TIMEOUT_SEC_ENV = "TPUFT_TIMEOUT_SEC"
@@ -71,6 +76,7 @@ QUORUM_RETRIES_ENV = "TPUFT_QUORUM_RETRIES"
 LIGHTHOUSE_ENV = "TPUFT_LIGHTHOUSE"
 MANAGER_PORT_ENV = "TPUFT_MANAGER_PORT"
 COMMIT_PIPELINE_ENV = "TPUFT_COMMIT_PIPELINE"
+HEAL_MAX_ATTEMPTS_ENV = "TPUFT_HEAL_MAX_ATTEMPTS"
 
 
 def _env_timeout(env: str, default: float) -> float:
@@ -90,6 +96,22 @@ class WorldSizeMode(Enum):
 
     DYNAMIC = 0
     FIXED_WITH_SPARES = 1
+
+
+class HealExhaustedError(RuntimeError):
+    """Raised out of the quorum future (``wait_quorum``/``start_quorum``)
+    when ``TPUFT_HEAL_MAX_ATTEMPTS`` consecutive heal attempts all failed:
+    this replica cannot catch up from any donor it is being assigned, so —
+    like a quorum timeout or the ``max_retries`` commit RuntimeError — it
+    escalates past the step boundary into supervisor-restart territory
+    instead of looping on a heal that will never land."""
+
+
+class _DonorRecentlyFailed(Exception):
+    """Internal: the assigned recovery donor failed us on the immediately
+    preceding attempt; fail this heal round fast (no transfer) so the next
+    quorum round can rotate the assignment. One-shot per failure — a
+    consecutive reassignment of the same donor is attempted for real."""
 
 
 class ExceptionWithTraceback(Exception):
@@ -196,6 +218,15 @@ class Manager:
             before the next dispatch; 1 opts into the pipelined-commit
             schedule (``$TPUFT_COMMIT_PIPELINE`` overrides; see
             optim.Optimizer.make_step_fn for the widened envelope).
+        heal_max_attempts: consecutive failed heal attempts tolerated
+            before :class:`HealExhaustedError` escalates out of the quorum
+            future (``$TPUFT_HEAL_MAX_ATTEMPTS`` overrides). Each failed
+            attempt funnels into :meth:`report_error` (the step does not
+            commit, the joiner re-enters the next quorum still joining);
+            a DEAD donor leaves the pool via heartbeat expiry, so the next
+            assignment naturally excludes it, and the transport's resume
+            cache re-fetches only the chunks the failed attempt did not
+            verify.
     """
 
     def __init__(
@@ -223,6 +254,7 @@ class Manager:
         max_retries: Optional[int] = None,
         quorum_retries: int = 0,
         commit_pipeline_depth: int = 0,
+        heal_max_attempts: int = 5,
     ) -> None:
         self._pg = pg
         self._min_replica_size = min_replica_size
@@ -287,6 +319,17 @@ class Manager:
         self._healing = False
         self._pending_state_dict: Optional[Dict[str, Any]] = None
         self._pending_commit_future: Optional[_TrackedCommitFuture] = None
+
+        # Heal failover accounting (spans quorum rounds; reset on a heal
+        # that lands): consecutive failed attempts, the donor that failed
+        # last (for the failover counter), and per-donor one-shot
+        # fail-fast skips (addr -> skip_pending).
+        self._heal_max_attempts = max(
+            1, int(os.environ.get(HEAL_MAX_ATTEMPTS_ENV, str(heal_max_attempts)))
+        )
+        self._heal_attempts = 0
+        self._heal_last_failed_donor: Optional[str] = None
+        self._heal_failed_donors: Dict[str, bool] = {}
 
         # Quorum state.
         self._quorum_id = -1
@@ -809,8 +852,8 @@ class Manager:
                 return
 
         if allow_heal:
-            try:
-                if quorum.recover_dst_replica_ranks:
+            if quorum.recover_dst_replica_ranks:
+                try:
                     self._logger.info(
                         f"peers need recovery from us {quorum.recover_dst_replica_ranks}"
                     )
@@ -829,49 +872,103 @@ class Manager:
                             step=quorum.max_step,
                             state_dict=self._manager_state_dict(),
                             timeout=self._timeout,
+                            quorum_id=quorum.quorum_id,
                         )
+                except Exception as e:  # noqa: BLE001
+                    self._logger.exception(f"got exception in donor send: {e}")
+                    self.report_error(e)
 
-                if quorum.heal:
-                    self._healing = True
-                    metrics.set_gauge("tpuft_healing", 1, **self._metric_labels)
-                    metrics.inc(
-                        "tpuft_heals_total", role="joiner", **self._metric_labels
-                    )
-                    self._logger.info(
-                        "healing required, fetching checkpoint metadata from "
-                        f"{quorum.recover_src_manager_address} max_step={quorum.max_step}"
-                    )
-                    primary_client = ManagerClient(
-                        quorum.recover_src_manager_address,
-                        connect_timeout=self._connect_timeout,
-                    )
-                    checkpoint_metadata = primary_client._checkpoint_metadata(
-                        self._group_rank, timeout=self._timeout
-                    )
-                    primary_client.close()
-                    assert (
-                        quorum.recover_src_replica_rank is not None
-                    ), "must have a recover rank when healing"
-                    with trace_span(
-                        "tpuft::manager::_checkpoint_transport::recv_checkpoint",
-                        quorum_id=quorum.quorum_id,
-                        step=quorum.max_step,
-                    ), metrics.timer(
-                        "tpuft_heal_recv_seconds", **self._metric_labels
-                    ):
-                        self._pending_state_dict = self._checkpoint_transport.recv_checkpoint(
-                            src_rank=quorum.recover_src_replica_rank,
-                            metadata=checkpoint_metadata,
-                            step=quorum.max_step,
-                            timeout=self._timeout,
-                        )
-                    # Restore manager accounting immediately; user state is
-                    # applied from the main thread when safe.
-                    self.load_state_dict(self._pending_state_dict["tpuft"])
-                    self._step = quorum.max_step
-            except Exception as e:  # noqa: BLE001
-                self._logger.exception(f"got exception in recovery: {e}")
-                self.report_error(e)
+            if quorum.heal:
+                self._heal_as_joiner(quorum)
+
+    def _heal_as_joiner(self, quorum: Any) -> None:
+        """One heal attempt against the quorum's assigned donor, with the
+        failover accounting around it: a failed transfer funnels into
+        :meth:`report_error` (clean fail — the joiner re-enters the next
+        quorum still joining and the transport's resume cache keeps the
+        verified chunks), the donor is marked for a one-shot fail-fast skip
+        (a dead donor also leaves via heartbeat expiry, so the next
+        assignment excludes it), and once ``heal_max_attempts`` consecutive
+        attempts have failed :class:`HealExhaustedError` escalates out of
+        the quorum future to the supervisor."""
+        self._healing = True
+        metrics.set_gauge("tpuft_healing", 1, **self._metric_labels)
+        metrics.inc("tpuft_heals_total", role="joiner", **self._metric_labels)
+        src_addr = quorum.recover_src_manager_address
+        try:
+            if self._heal_attempts > 0:
+                metrics.inc("tpuft_heal_retries_total", **self._metric_labels)
+            if self._heal_failed_donors.get(src_addr, False):
+                # One-shot fail-fast: this donor failed us on the previous
+                # attempt; skip the transfer (no window burned against
+                # fresh evidence) so the next quorum round can rotate the
+                # assignment. If it assigns the same donor again, attempt
+                # it for real — it may have recovered.
+                self._heal_failed_donors[src_addr] = False
+                raise _DonorRecentlyFailed(
+                    f"donor {src_addr} failed the previous heal attempt; "
+                    "skipping one round to let the assignment rotate"
+                )
+            if (
+                self._heal_last_failed_donor is not None
+                and src_addr != self._heal_last_failed_donor
+            ):
+                metrics.inc(
+                    "tpuft_heal_donor_failovers_total", **self._metric_labels
+                )
+                self._logger.info(
+                    f"heal failover: donor {self._heal_last_failed_donor} "
+                    f"failed, retrying from {src_addr}"
+                )
+            self._logger.info(
+                "healing required, fetching checkpoint metadata from "
+                f"{src_addr} max_step={quorum.max_step}"
+            )
+            primary_client = ManagerClient(
+                src_addr,
+                connect_timeout=self._connect_timeout,
+            )
+            checkpoint_metadata = primary_client._checkpoint_metadata(
+                self._group_rank, timeout=self._timeout
+            )
+            primary_client.close()
+            assert (
+                quorum.recover_src_replica_rank is not None
+            ), "must have a recover rank when healing"
+            with trace_span(
+                "tpuft::manager::_checkpoint_transport::recv_checkpoint",
+                quorum_id=quorum.quorum_id,
+                step=quorum.max_step,
+            ), metrics.timer(
+                "tpuft_heal_recv_seconds", **self._metric_labels
+            ):
+                self._pending_state_dict = self._checkpoint_transport.recv_checkpoint(
+                    src_rank=quorum.recover_src_replica_rank,
+                    metadata=checkpoint_metadata,
+                    step=quorum.max_step,
+                    timeout=self._timeout,
+                    quorum_id=quorum.quorum_id,
+                )
+            # Restore manager accounting immediately; user state is
+            # applied from the main thread when safe.
+            self.load_state_dict(self._pending_state_dict["tpuft"])
+            self._step = quorum.max_step
+            self._heal_attempts = 0
+            self._heal_last_failed_donor = None
+            self._heal_failed_donors.clear()
+        except Exception as e:  # noqa: BLE001
+            if not isinstance(e, _DonorRecentlyFailed):
+                self._heal_attempts += 1
+                self._heal_last_failed_donor = src_addr
+                self._heal_failed_donors[src_addr] = True
+            self._logger.exception(f"got exception in recovery: {e}")
+            self.report_error(e)
+            if self._heal_attempts >= self._heal_max_attempts:
+                raise HealExhaustedError(
+                    f"{self._heal_attempts} consecutive heal attempts failed "
+                    f"(last donor {src_addr}); escalating to the supervisor "
+                    f"(bound from ${HEAL_MAX_ATTEMPTS_ENV})"
+                ) from e
 
     def _apply_pending_state_dict(self) -> None:
         assert self._healing, "must be in healing state"

@@ -45,6 +45,7 @@ __all__ = [
     "Registry",
     "REGISTRY",
     "DEFAULT_TIME_BUCKETS",
+    "DEFAULT_BYTES_PER_SEC_BUCKETS",
     "ENV_PORT",
     "ENV_PUSH_SEC",
     "counter",
@@ -71,6 +72,13 @@ ENV_PUSH_SEC = "TPUFT_METRICS_PUSH_SEC"
 DEFAULT_TIME_BUCKETS: Tuple[float, ...] = (
     0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
     0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+# Byte-rate phases (heal stream throughput): a fenced gray donor drips at
+# ~100 B/s, a healthy DCN heal runs at GB/s — same 1-2.5-5 ladder.
+DEFAULT_BYTES_PER_SEC_BUCKETS: Tuple[float, ...] = (
+    1e2, 2.5e2, 5e2, 1e3, 2.5e3, 5e3, 1e4, 2.5e4, 5e4, 1e5, 2.5e5, 5e5,
+    1e6, 2.5e6, 5e6, 1e7, 2.5e7, 5e7, 1e8, 2.5e8, 5e8, 1e9, 2.5e9, 5e9,
 )
 
 LabelItems = Tuple[Tuple[str, str], ...]

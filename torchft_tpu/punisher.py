@@ -3,14 +3,28 @@
 Role-equivalent of the reference's ``examples/slurm/punisher.py`` kill CLI
 plus the monarch failure menu (examples/monarch/utils/failure.py:25-100):
 resolves the current quorum from the lighthouse and fires fault RPCs at
-member managers. Modes: exit (process death), segfault (crash with core),
-deadlock (coordination wedges while heartbeats continue), partition
-(heartbeats + RPC serving stop).
+member managers. Process-level modes: exit (process death), segfault
+(crash with core), deadlock (coordination wedges while heartbeats
+continue), partition (heartbeats + RPC serving stop).
+
+Heal-path modes target the recovery plane itself:
+
+- ``kill_donor_mid_heal``: when the lighthouse shows a joining member, a
+  non-joining (donor-capable) member is killed — the joiner must fail
+  over and resume the heal from another donor.
+- ``corrupt_stream`` / ``stall_donor``: armed through the fault file
+  (``$TPUFT_FAULT_FILE`` / ``--fault-file``,
+  torchft_tpu/utils/faultinject.py); the next donor chunk-serve consumes
+  the arm and flips a payload bit / drips below the joiner's
+  minimum-progress floor. Exactly one serve consumes each arm, so
+  injected-fault counts stay exact.
 
     python -m torchft_tpu.punisher --lighthouse host:29510 kill_one
     python -m torchft_tpu.punisher --lighthouse host:29510 fault_one --mode deadlock
+    python -m torchft_tpu.punisher --lighthouse host:29510 --fault-file /tmp/f \
+        fault_one --mode corrupt_stream
     python -m torchft_tpu.punisher --lighthouse host:29510 kill_loop --mtbf 60 \
-        --menu exit,segfault,deadlock,partition
+        --menu exit,segfault,deadlock,partition,kill_donor_mid_heal
 """
 
 from __future__ import annotations
@@ -19,10 +33,22 @@ import argparse
 import os
 import random
 import time
+from typing import Optional
 
 from torchft_tpu.coordination import LighthouseClient
+from torchft_tpu.utils import faultinject
 
-__all__ = ["kill_one", "kill_all", "kill_loop", "main"]
+__all__ = [
+    "kill_one",
+    "kill_all",
+    "kill_loop",
+    "kill_donor_mid_heal",
+    "inject_fault",
+    "main",
+    "FAULT_MODES",
+    "HEAL_FAULT_MODES",
+    "ALL_FAULT_MODES",
+]
 
 
 def _members(client: LighthouseClient):
@@ -30,22 +56,83 @@ def _members(client: LighthouseClient):
     return [m.member.replica_id for m in status.members if not m.joining]
 
 
+# Modes the native manager's kill RPC executes in-process.
 FAULT_MODES = ("exit", "segfault", "deadlock", "partition")
+# Heal-plane modes delivered outside the kill RPC (status-targeted kill /
+# file-armed stream faults).
+HEAL_FAULT_MODES = ("kill_donor_mid_heal", "corrupt_stream", "stall_donor")
+ALL_FAULT_MODES = FAULT_MODES + HEAL_FAULT_MODES
 
 
 def kill_one(
     client: LighthouseClient, rng: random.Random, mode: str = "exit"
-) -> None:
+) -> bool:
     members = _members(client)
     if not members:
         print("[punisher] no quorum members to kill")
-        return
+        return False
     victim = rng.choice(members)
     print(f"[punisher] injecting {mode} into {victim}")
     try:
         client.kill(victim, mode=mode)
     except Exception as e:  # noqa: BLE001  — victim may die before replying
         print(f"[punisher] kill rpc ended with: {e}")
+    return True
+
+
+def kill_donor_mid_heal(client: LighthouseClient, rng: random.Random) -> bool:
+    """Kills a donor-capable member while a heal is in flight (a joining
+    member is visible in the lighthouse status). No heal in flight = no-op:
+    this fault only makes sense against recovery traffic."""
+    try:
+        status = client.status()
+    except Exception as e:  # noqa: BLE001
+        print(f"[punisher] status rpc ended with: {e}")
+        return False
+    joining = [m.member.replica_id for m in status.members if m.joining]
+    donors = [m.member.replica_id for m in status.members if not m.joining]
+    if not joining or not donors:
+        print("[punisher] no heal in flight; skipping kill_donor_mid_heal")
+        return False
+    victim = rng.choice(donors)
+    print(
+        f"[punisher] killing donor-side member {victim} while "
+        f"{joining} heal(s)"
+    )
+    try:
+        client.kill(victim, mode="exit")
+    except Exception as e:  # noqa: BLE001
+        print(f"[punisher] kill rpc ended with: {e}")
+    return True
+
+
+def arm_stream_fault(mode: str, fault_file: Optional[str] = None) -> bool:
+    """Arms a donor-stream fault (``corrupt_stream``/``stall_donor``) via
+    the fault file; the next donor chunk-serve consumes it."""
+    try:
+        path = faultinject.arm(mode, path=fault_file, site="heal_stream")
+    except ValueError as e:
+        print(f"[punisher] cannot arm {mode}: {e}")
+        return False
+    print(f"[punisher] armed {mode} at {path}")
+    return True
+
+
+def inject_fault(
+    client: LighthouseClient,
+    rng: random.Random,
+    mode: str,
+    fault_file: Optional[str] = None,
+) -> bool:
+    """Dispatches one fault from the full menu; returns whether a fault was
+    actually delivered (heal-plane modes no-op without their trigger)."""
+    if mode in FAULT_MODES:
+        return kill_one(client, rng, mode=mode)
+    if mode == "kill_donor_mid_heal":
+        return kill_donor_mid_heal(client, rng)
+    if mode in ("corrupt_stream", "stall_donor"):
+        return arm_stream_fault(mode, fault_file)
+    raise ValueError(f"unknown fault mode {mode!r}")
 
 
 def kill_all(client: LighthouseClient, rng: random.Random) -> None:
@@ -63,6 +150,7 @@ def kill_loop(
     mtbf: float,
     menu: tuple = ("exit",),
     deadline: float = float("inf"),
+    fault_file: Optional[str] = None,
 ) -> None:
     """Poisson-ish fault schedule with mean time between failures ``mtbf``,
     drawing each fault from ``menu``."""
@@ -70,7 +158,7 @@ def kill_loop(
         delay = rng.expovariate(1.0 / mtbf) if mtbf > 0 else 1.0
         print(f"[punisher] next fault in {delay:.1f}s")
         time.sleep(delay)
-        kill_one(client, rng, mode=rng.choice(list(menu)))
+        inject_fault(client, rng, rng.choice(list(menu)), fault_file=fault_file)
 
 
 def main() -> None:
@@ -81,17 +169,23 @@ def main() -> None:
         required=os.environ.get("TPUFT_LIGHTHOUSE") is None,
     )
     parser.add_argument("--seed", type=int, default=None)
+    parser.add_argument(
+        "--fault-file",
+        default=os.environ.get(faultinject.ENV_FAULT_FILE),
+        help="file the stream faults are armed through (the job must run "
+        f"with ${faultinject.ENV_FAULT_FILE} pointing at the same path)",
+    )
     sub = parser.add_subparsers(dest="cmd", required=True)
     sub.add_parser("kill_one")
     sub.add_parser("kill_all")
     fault = sub.add_parser("fault_one")
-    fault.add_argument("--mode", choices=FAULT_MODES, default="exit")
+    fault.add_argument("--mode", choices=ALL_FAULT_MODES, default="exit")
     loop = sub.add_parser("kill_loop")
     loop.add_argument("--mtbf", type=float, default=60.0, help="mean seconds between faults")
     loop.add_argument(
         "--menu",
         default="exit",
-        help="comma-separated fault modes to draw from: " + ",".join(FAULT_MODES),
+        help="comma-separated fault modes to draw from: " + ",".join(ALL_FAULT_MODES),
     )
     args = parser.parse_args()
 
@@ -102,13 +196,13 @@ def main() -> None:
     elif args.cmd == "kill_all":
         kill_all(client, rng)
     elif args.cmd == "fault_one":
-        kill_one(client, rng, mode=args.mode)
+        inject_fault(client, rng, args.mode, fault_file=args.fault_file)
     else:
         menu = tuple(m.strip() for m in args.menu.split(",") if m.strip())
         for m in menu:
-            if m not in FAULT_MODES:
+            if m not in ALL_FAULT_MODES:
                 parser.error(f"unknown fault mode {m!r}")
-        kill_loop(client, rng, args.mtbf, menu=menu)
+        kill_loop(client, rng, args.mtbf, menu=menu, fault_file=args.fault_file)
 
 
 if __name__ == "__main__":

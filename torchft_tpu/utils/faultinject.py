@@ -1,0 +1,67 @@
+"""File-armed fault injection for chaos drills.
+
+The punisher's heal-path fault modes (``corrupt_stream``, ``stall_donor``)
+cannot ride the native kill RPC — they must misbehave *inside* a healthy
+process's serving path. Instead the punisher arms a fault by writing the
+mode name into ``$TPUFT_FAULT_FILE``; the first instrumented site that
+matches the fault's target claims it atomically (``os.replace`` of the
+file — losers of the race see it gone), so each arm injects **exactly
+one** fault. An optional ``mode:site`` form restricts the fault to one
+instrumentation site.
+
+Production cost when unarmed: one env lookup per check (no filesystem
+touch unless the env var is set). This module is a chaos tool, not a
+control plane: a fault that is never consumed is harmless, and consuming
+is best-effort (any OSError reads as "nothing armed").
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+__all__ = ["ENV_FAULT_FILE", "arm", "consume"]
+
+ENV_FAULT_FILE = "TPUFT_FAULT_FILE"
+
+
+def arm(mode: str, path: Optional[str] = None, site: str = "") -> str:
+    """Arms ``mode`` (optionally scoped to ``site``) by atomically writing
+    the fault file. Returns the path written. Raises ValueError when no
+    path is given and ``$TPUFT_FAULT_FILE`` is unset."""
+    path = path or os.environ.get(ENV_FAULT_FILE)
+    if not path:
+        raise ValueError(
+            f"no fault file: pass path= or set ${ENV_FAULT_FILE}"
+        )
+    payload = f"{mode}:{site}" if site else mode
+    tmp = f"{path}.arming.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(payload)
+    os.replace(tmp, path)  # atomic vs concurrent consume()
+    return path
+
+
+def consume(site: str) -> Optional[str]:
+    """Returns (and atomically claims) the armed fault mode matching
+    ``site``, or None when nothing is armed for it."""
+    path = os.environ.get(ENV_FAULT_FILE)
+    if not path:
+        return None
+    try:
+        with open(path, "r") as f:
+            content = f.read().strip()
+    except OSError:
+        return None
+    if not content:
+        return None
+    mode, _, target = content.partition(":")
+    if target and target != site:
+        return None
+    try:
+        # The rename IS the claim: exactly one concurrent consumer wins,
+        # the rest see FileNotFoundError and report nothing armed.
+        os.replace(path, f"{path}.consumed")
+    except OSError:
+        return None
+    return mode
