@@ -14,6 +14,21 @@ Two modes:
   the template-identity in-place assertion, which needs both ends in one
   address space).
 
+Donor-stall legs (SURVEY §7 "healing without stopping donors") run in BOTH
+serve modes — inline and the serve-child sidecar
+(``TPUFT_HEAL_SERVE_MODE=child``, checkpointing/serve_child.py) — twice
+each: **unpaced** (the serve runs flat out against a verifying receiver;
+on this 1-core box donor, sidecar, and receiver all fight for the same
+core, so this is the worst-case upper bound) and **paced** (the sidecar's
+egress bound ``TPUFT_HEAL_SERVE_GBPS`` throttles serving to a realistic
+DCN share and the receiver is a deprioritized raw drain, which isolates
+the quantity under test — what serving costs the DONOR — from the
+bench-box artifact of colocating the remote joiner on the same core; in
+production the joiner decodes on its own host). The staging window is
+instrumented with a fine-grained donor step (restaged repeatedly when one
+window is too short to contain a step) so ``donor_step_ms_while_staging``
+is measured, not null.
+
 Usage: python benchmarks/transport_bench.py  → one JSON line on stdout.
 Env: TPUFT_TRANSPORT_BENCH_GB (default 12), TPUFT_TRANSPORT_BENCH_MODE.
 """
@@ -141,11 +156,17 @@ class _StepWorker:
     staging, and while SERVING a heal — SURVEY §7's "healing without
     stopping donors" (the reference serves from staged CPU copies on a side
     stream, reference http_transport.py:226-242; here the staged host
-    copies play that role). On this 1-core box the serve thread contends
-    for the only core, so the serving inflation is an upper bound — on a
-    real TPU host the step math runs on the device."""
+    copies play that role). On this 1-core box anything serving in-process
+    contends for the only core, so the inline serving inflation is an
+    upper bound — on a real TPU host the step math runs on the device.
 
-    DIM = 256
+    DIM is sized for a ~30 ms step: long enough that one scheduler
+    slice granted to a deprioritized serving process cannot double a
+    step's wall time (which would make worst-step a measurement of CFS
+    granularity, not of serving), short enough that every measurement
+    window holds hundreds of samples."""
+
+    DIM = 1024
 
     def __init__(self) -> None:
         import jax
@@ -178,30 +199,87 @@ class _StepWorker:
 
     def wall_ms(self, t_from: float, t_to: float):
         """(mean_ms, max_ms) over the window, or (None, None) when the
-        window is too short to contain a completed step (e.g. staging,
-        which holds only references and finishes in ~1 ms)."""
-        walls = [w for t, w in self.samples if t_from <= t <= t_to]
+        window contains no completed step."""
+        return self.wall_ms_windows([(t_from, t_to)])
+
+    def wall_ms_windows(self, windows):
+        """(mean_ms, max_ms) over the union of windows — the staging
+        instrument: short staging windows accumulate across restages
+        until they contain real samples."""
+        walls = self._walls(windows)
         if not walls:
             return None, None
         return float(np.mean(walls) * 1000), float(np.max(walls) * 1000)
 
+    def p99_ms(self, t_from: float, t_to: float):
+        walls = self._walls([(t_from, t_to)])
+        if not walls:
+            return None
+        return float(np.percentile(walls, 99) * 1000)
 
-def role_http_donor(total_bytes: int, with_stepper: bool = True) -> None:
+    def over_threshold(self, t_from: float, t_to: float, threshold_s: float):
+        """(count over threshold, total samples) in the window — "how many
+        steps did serving actually disturb" without letting one ambient
+        outlier stand for the whole distribution."""
+        walls = self._walls([(t_from, t_to)])
+        return sum(1 for w in walls if w > threshold_s), len(walls)
+
+    def _walls(self, windows):
+        return [
+            w
+            for t, w in self.samples
+            if any(a <= t <= b for a, b in windows)
+        ]
+
+
+def role_http_donor(
+    total_bytes: int, with_stepper: bool = True, serve_mode: str = "inline"
+) -> None:
     _force_cpu()
     from torchft_tpu.checkpointing.http_transport import HTTPTransport
 
     state = synth_state(total_bytes)
+    # Construct (and in child mode, spawn the sidecar) BEFORE the baseline
+    # window: transport construction is one-time setup, not the per-heal
+    # cost under measurement, and the spawned child's interpreter boot
+    # would otherwise pollute the baseline tail.
+    donor = HTTPTransport(timeout=600.0, num_chunks=8, serve_mode=serve_mode)
     stepper = None
     t_base0 = time.monotonic()
     if with_stepper:
         stepper = _StepWorker()
         stepper.start()
-        time.sleep(1.5)  # collect the baseline step cadence
-    donor = HTTPTransport(timeout=600.0, num_chunks=8)
+        t_base0 = time.monotonic()
+        # Long enough to see the baseline TAIL too: this box's scheduler/
+        # XLA noise alone spikes an idle ~33 ms step to ~55 ms, and the
+        # worst-while-serving number is only meaningful next to it.
+        time.sleep(8.0)
     t_stage0 = time.monotonic()
     donor.send_checkpoint([1], step=7, state_dict=state, timeout=600.0)
     t_stage1 = time.monotonic()
     stage_s = t_stage1 - t_stage0
+    stage_windows = [(t_stage0, t_stage1)]
+    if stepper is not None:
+        # Fine-grained staging instrument: when one staging window is too
+        # short to contain a completed step (small payloads; staging
+        # holds references + one CRC pass), RE-STAGE until the union of
+        # windows holds enough samples for a real number.
+        def _staging_samples() -> int:
+            return sum(
+                1
+                for t, _ in stepper.samples
+                if any(a <= t <= b for a, b in stage_windows)
+            )
+
+        deadline = time.monotonic() + 60.0
+        while (
+            _staging_samples() < 5
+            and len(stage_windows) < 300
+            and time.monotonic() < deadline
+        ):
+            a = time.monotonic()
+            donor.send_checkpoint([1], step=7, state_dict=state, timeout=600.0)
+            stage_windows.append((a, time.monotonic()))
     _emit(
         {
             "addr": donor.metadata(),
@@ -211,14 +289,15 @@ def role_http_donor(total_bytes: int, with_stepper: bool = True) -> None:
     )
     sys.stdin.readline()  # parent signals when the receiver is done
     t_serve1 = time.monotonic()
+    serve_from = stage_windows[-1][1]
     donor.shutdown()
     if stepper is None:
         _emit({"peak_rss": _rss_bytes()})
         return
     stepper.stop()
-    base_ms, _ = stepper.wall_ms(t_base0, t_stage0)
-    staging_ms, staging_max = stepper.wall_ms(t_stage0, t_stage1)
-    serving_ms, serving_max = stepper.wall_ms(t_stage1, t_serve1)
+    base_ms, base_max = stepper.wall_ms(t_base0, t_stage0)
+    staging_ms, staging_max = stepper.wall_ms_windows(stage_windows)
+    serving_ms, serving_max = stepper.wall_ms(serve_from, t_serve1)
 
     def _round(v, nd=2):
         return round(v, nd) if v is not None else None
@@ -229,18 +308,35 @@ def role_http_donor(total_bytes: int, with_stepper: bool = True) -> None:
     _emit(
         {
             "peak_rss": _rss_bytes(),
+            "serve_mode": serve_mode,
+            "step_dim": _StepWorker.DIM,
             "step_ms_baseline": _round(base_ms),
+            "step_ms_worst_baseline": _round(base_max),
             "step_ms_while_staging": _round(staging_ms),
+            "staging_windows": len(stage_windows),
             "step_ms_while_serving": _round(serving_ms),
             # The operator question "does the donor STOP?": the longest
             # single step while serving. The double-buffered design (serve
-            # from staged host copies, never the live state) means no step
-            # ever blocks on the transfer — only on this box's single
-            # core.
+            # from staged host copies — in child mode from a snapshot a
+            # separate process owns — never the live state) means no step
+            # ever blocks on the transfer; inline mode still pays GIL/core
+            # contention on this box's single core.
             "step_ms_worst_while_serving": _round(serving_max),
+            # Tail context: this shared box's scheduler noise alone spikes
+            # the IDLE baseline's worst step ~2-4x its mean, so the p99
+            # and the baseline's own worst are reported next to the max.
+            "step_ms_p99_while_serving": _round(
+                stepper.p99_ms(serve_from, t_serve1)
+            ),
+            "step_ms_p99_baseline": _round(stepper.p99_ms(t_base0, t_stage0)),
+            "steps_over_2x_baseline_while_serving": (
+                stepper.over_threshold(
+                    serve_from, t_serve1, 2 * base_ms / 1000.0
+                )
+                if base_ms
+                else None
+            ),
             "donor_step_inflation_pct": _infl(serving_ms),
-            # Staging holds only references (~1 ms); a window with no
-            # completed step reports null rather than a fake number.
             "donor_step_inflation_staging_pct": _infl(staging_ms),
             "stage_s": round(stage_s, 3),
             # The serve window opens when the parent has the address and
@@ -265,6 +361,34 @@ def role_http_receiver(addr: str) -> None:
         {
             "fetch_s": round(fetch_s, 3),
             "digests": state_digests(received),
+            "peak_rss": _rss_bytes(),
+        }
+    )
+
+
+def role_http_drain(addr: str) -> None:
+    """Raw heal drain for the PACED donor-stall legs: streams /full and
+    discards it. Content equality is proven by the clean leg's verifying
+    receiver; this role isolates what serving costs the DONOR from the
+    bench-box artifact of running the joiner's 12 GB decode on the same
+    single core (in production the joiner decodes on its own host). The
+    parent deprioritizes this whole process at spawn (preexec nice) for
+    the same reason."""
+    import urllib.request
+
+    t0 = time.monotonic()
+    total = 0
+    with urllib.request.urlopen(f"{addr}/checkpoint/7/full", timeout=600.0) as resp:
+        while True:
+            data = resp.read(1 << 22)
+            if not data:
+                break
+            total += len(data)
+    fetch_s = time.monotonic() - t0
+    _emit(
+        {
+            "fetch_s": round(fetch_s, 3),
+            "drained_bytes": total,
             "peak_rss": _rss_bytes(),
         }
     )
@@ -326,13 +450,32 @@ def role_pg_receiver(total_bytes: int, store_addr: str) -> None:
 # ---------------------------------------------------------------------------
 
 
-def _spawn(role: str, *args: str) -> subprocess.Popen:
+def _spawn(
+    role: str, *args: str, env: dict | None = None, nice: int = 0
+) -> subprocess.Popen:
+    child_env = dict(os.environ)
+    if env:
+        child_env.update(env)
     return subprocess.Popen(
         [sys.executable, os.path.abspath(__file__), "--role", role, *args],
         stdout=subprocess.PIPE,
         stdin=subprocess.PIPE,
         text=True,
+        env=child_env,
+        # Deprioritize BEFORE the interpreter boots: a niced drain whose
+        # numpy import still ran at nice 0 would steal full-priority CPU
+        # bursts right inside the measured serve window. SCHED_BATCH
+        # additionally stops it wakeup-preempting the donor's step.
+        preexec_fn=(lambda: _deprioritize(nice)) if nice > 0 else None,
     )
+
+
+def _deprioritize(nice: int) -> None:
+    os.nice(nice)
+    try:
+        os.sched_setscheduler(0, os.SCHED_BATCH, os.sched_param(0))
+    except (AttributeError, OSError, PermissionError):
+        pass
 
 
 def _read_json(proc: subprocess.Popen, deadline: float) -> dict:
@@ -366,13 +509,32 @@ def _read_json(proc: subprocess.Popen, deadline: float) -> dict:
 
 
 def bench_http_multiproc(
-    total_bytes: int, deadline: float, with_stepper: bool = True
+    total_bytes: int,
+    deadline: float,
+    with_stepper: bool = True,
+    serve_mode: str = "inline",
+    serve_gbps: float = 0.0,
+    serve_nice: int | None = None,
+    drain_receiver: bool = False,
+    receiver_nice: int = 0,
 ) -> dict:
-    donor = _spawn("http-donor", str(total_bytes), "1" if with_stepper else "0")
+    donor_env = {"TPUFT_HEAL_SERVE_GBPS": str(serve_gbps)}
+    if serve_nice is not None:
+        donor_env["TPUFT_HEAL_SERVE_NICE"] = str(serve_nice)
+    donor = _spawn(
+        "http-donor",
+        str(total_bytes),
+        "1" if with_stepper else "0",
+        serve_mode,
+        env=donor_env,
+    )
     receiver = None
     try:
         staged = _read_json(donor, deadline)
-        receiver = _spawn("http-receiver", staged["addr"])
+        if drain_receiver:
+            receiver = _spawn("http-drain", staged["addr"], nice=receiver_nice)
+        else:
+            receiver = _spawn("http-receiver", staged["addr"])
         fetched = _read_json(receiver, deadline)
         receiver.wait(timeout=30)
         donor.stdin.write("done\n")
@@ -383,7 +545,8 @@ def bench_http_multiproc(
         for p in (donor, receiver):
             if p is not None and p.poll() is None:
                 p.kill()
-    assert staged["digests"] == fetched["digests"], "HTTP content mismatch"
+    if not drain_receiver:
+        assert staged["digests"] == fetched["digests"], "HTTP content mismatch"
     out = {
         "http_stage_s": staged["stage_s"],
         "http_fetch_s": fetched["fetch_s"],
@@ -393,11 +556,29 @@ def bench_http_multiproc(
     if "step_ms_baseline" in donor_final:
         out.update(
             {
+                "serve_mode": serve_mode,
+                "serve_gbps": serve_gbps,
+                "receiver": (
+                    f"drain(nice {receiver_nice})"
+                    if drain_receiver
+                    else "verify(nice 0)"
+                ),
                 "donor_step_ms_baseline": donor_final["step_ms_baseline"],
+                "donor_step_ms_worst_baseline": donor_final[
+                    "step_ms_worst_baseline"
+                ],
                 "donor_step_ms_while_staging": donor_final["step_ms_while_staging"],
+                "donor_staging_windows": donor_final["staging_windows"],
                 "donor_step_ms_while_serving": donor_final["step_ms_while_serving"],
                 "donor_step_ms_worst_while_serving": donor_final[
                     "step_ms_worst_while_serving"
+                ],
+                "donor_step_ms_p99_while_serving": donor_final[
+                    "step_ms_p99_while_serving"
+                ],
+                "donor_step_ms_p99_baseline": donor_final["step_ms_p99_baseline"],
+                "donor_steps_over_2x_baseline_while_serving": donor_final[
+                    "steps_over_2x_baseline_while_serving"
                 ],
                 "donor_step_inflation_pct": donor_final["donor_step_inflation_pct"],
                 "donor_step_inflation_staging_pct": donor_final[
@@ -536,18 +717,93 @@ def main() -> None:
     # the two don't compete for a core).
     out.update(bench_http_multiproc(total, deadline, with_stepper=False))
     out["http_goodput_gbps"] = round(8 * payload / (1 << 30) / out["http_fetch_s"], 2)
-    out.update(bench_pg_multiproc(total, deadline))
-    out["pg_goodput_gbps"] = round(8 * payload / (1 << 30) / out["pg_heal_s"], 2)
+    try:
+        out.update(bench_pg_multiproc(total, deadline))
+        out["pg_goodput_gbps"] = round(
+            8 * payload / (1 << 30) / out["pg_heal_s"], 2
+        )
+    except Exception as e:  # noqa: BLE001 — e.g. native toolchain absent
+        # The PG transport needs the native KV store for rendezvous; on a
+        # box without the toolchain the HTTP legs (the serve-mode story)
+        # still measure.
+        out["pg_skipped"] = f"{type(e).__name__}: {e}"[:200]
 
-    # Donor-stall leg: same transfer with a jitted step loop running on
-    # the donor throughout (SURVEY §7 "healing without stopping donors").
-    stall = bench_http_multiproc(total, deadline, with_stepper=True)
-    out["donor_stall"] = {
-        k: v
-        for k, v in stall.items()
-        if k.startswith("donor_step") or k == "donor_stall_single_core_upper_bound"
-    }
-    out["donor_stall"]["http_fetch_s_while_stepping"] = stall["http_fetch_s"]
+    # Donor-stall legs: same transfer with a jitted step loop running on
+    # the donor throughout (SURVEY §7 "healing without stopping donors"),
+    # in BOTH serve modes, unpaced (worst-case: donor, serving, and the
+    # colocated verifying receiver all fight for this box's single core)
+    # and paced (the serve-rate bound + a deprioritized raw drain isolate
+    # the donor-side serving cost — the quantity the reference's
+    # "serving never perturbs the donor" claim is about).
+    def _stall_fields(stall: dict) -> dict:
+        picked = {
+            k: v
+            for k, v in stall.items()
+            if k.startswith("donor_step")
+            or k
+            in (
+                "serve_mode",
+                "serve_gbps",
+                "receiver",
+                "donor_staging_windows",
+                "donor_stall_single_core_upper_bound",
+            )
+        }
+        picked["http_fetch_s_while_stepping"] = stall["http_fetch_s"]
+        return picked
+
+    pace_gbps = float(os.environ.get("TPUFT_TRANSPORT_BENCH_PACE_GBPS", "0.4"))
+    # Serving child + drain both yield to the stepping donor; nice 10
+    # still leaves them enough share to sustain the paced rate (donor
+    # inflation tracks the CPU they actually consume, not their weight).
+    stall_nice = 10
+    out["donor_stall"] = _stall_fields(
+        bench_http_multiproc(total, deadline, with_stepper=True)
+    )
+    out["donor_stall_child_unpaced"] = _stall_fields(
+        bench_http_multiproc(total, deadline, with_stepper=True, serve_mode="child")
+    )
+    out["donor_stall_paced"] = _stall_fields(
+        bench_http_multiproc(
+            total,
+            deadline,
+            with_stepper=True,
+            serve_gbps=pace_gbps,
+            drain_receiver=True,
+            receiver_nice=stall_nice,
+        )
+    )
+    out["donor_stall_child"] = _stall_fields(
+        bench_http_multiproc(
+            total,
+            deadline,
+            with_stepper=True,
+            serve_mode="child",
+            serve_gbps=pace_gbps,
+            serve_nice=stall_nice,
+            drain_receiver=True,
+            receiver_nice=stall_nice,
+        )
+    )
+    child = out["donor_stall_child"]
+    base = child.get("donor_step_ms_baseline")
+    if base:
+        if child.get("donor_step_ms_worst_while_serving"):
+            child["worst_step_x_baseline"] = round(
+                child["donor_step_ms_worst_while_serving"] / base, 2
+            )
+        if child.get("donor_step_ms_p99_while_serving"):
+            child["p99_step_x_baseline"] = round(
+                child["donor_step_ms_p99_while_serving"] / base, 2
+            )
+        if child.get("donor_step_ms_worst_baseline"):
+            # ≤1 means serving added NOTHING beyond the box's own ambient
+            # worst-case step — the structural-isolation claim.
+            child["worst_step_x_worst_baseline"] = round(
+                child["donor_step_ms_worst_while_serving"]
+                / child["donor_step_ms_worst_baseline"],
+                2,
+            )
 
     # A python+numpy+jax process is ~0.3 GB before it touches the payload;
     # fold that fixed floor into the budget so the flag is meaningful at
@@ -560,6 +816,8 @@ def main() -> None:
         "pg_sender_rss",
         "pg_receiver_rss",
     ):
+        if side_key not in out:  # pg leg skipped (toolchain absent)
+            continue
         rss = out.pop(side_key)
         out[side_key + "_multiple"] = round(rss / payload, 2)
         worst = max(worst, (rss - fixed_floor) / payload)
@@ -584,9 +842,15 @@ if __name__ == "__main__":
     if len(sys.argv) > 2 and sys.argv[1] == "--role":
         role, args = sys.argv[2], sys.argv[3:]
         if role == "http-donor":
-            role_http_donor(int(args[0]), args[1] == "1" if len(args) > 1 else True)
+            role_http_donor(
+                int(args[0]),
+                args[1] == "1" if len(args) > 1 else True,
+                args[2] if len(args) > 2 else "inline",
+            )
         elif role == "http-receiver":
             role_http_receiver(args[0])
+        elif role == "http-drain":
+            role_http_drain(args[0])
         elif role == "pg-sender":
             role_pg_sender(int(args[0]), args[1])
         elif role == "pg-receiver":

@@ -71,6 +71,19 @@ def _gauge(snapshot: Dict[str, Any], name: str) -> Optional[float]:
     return entries[-1].get("value")
 
 
+def _serve_state(snapshot: Dict[str, Any]) -> Optional[str]:
+    """Heal-serving state from the pushed gauges: which serve mode the
+    replica runs and, in child mode, whether its sidecar is alive
+    ("child!" = crashed/degraded — heals fall back to inline serving)."""
+    mode = _gauge(snapshot, "tpuft_heal_serve_mode")
+    if mode is None:
+        return None
+    if mode != 1:
+        return "inline"
+    up = _gauge(snapshot, "tpuft_heal_serve_child_up")
+    return "child" if up == 1 else "child!"
+
+
 def collect(lighthouse_addr: str, prev: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
     """One poll: lighthouse status + per-rank snapshots, as a JSON-safe
     dict. ``prev`` (the previous poll) turns step deltas into step/s."""
@@ -109,6 +122,7 @@ def collect(lighthouse_addr: str, prev: Optional[Dict[str, Any]] = None) -> Dict
                         snap, "tpuft_commit_failures_total"
                     ),
                     heals=_counter_total(snap, "tpuft_heals_total"),
+                    serve=_serve_state(snap),
                     push_age_s=round(now - snap["ts"], 1) if "ts" in snap else None,
                     last_commit_age_s=(
                         round(now - last_commit, 1) if last_commit else None
@@ -146,6 +160,7 @@ _COLUMNS = (
     ("commits", "COMMITS"),
     ("commit_failures", "FAILED"),
     ("heals", "HEALS"),
+    ("serve", "SERVE"),
     ("last_commit_age_s", "LAST COMMIT"),
     ("healing", "HEALING"),
     ("heartbeat_age_ms", "HB AGE MS"),

@@ -44,3 +44,21 @@ def start_heal_recv_worker(transport, manager):
     thread = threading.Thread(target=recv_worker, daemon=True, name="heal-recv")
     thread.start()
     return thread
+
+
+def start_serve_child_watcher(proc, manager):
+    """Serve-sidecar supervisor twin: the watcher funnels an observed
+    child death into report_error (the crash poisons the step; the donor
+    process itself never raises) and its own failures into the log."""
+
+    def watch_child() -> None:
+        try:
+            rc = proc.wait()
+            if rc != 0:
+                manager.report_error(RuntimeError(f"serve child died rc={rc}"))
+        except Exception as e:
+            logger.exception(f"serve-child watcher failed: {e}")
+
+    thread = threading.Thread(target=watch_child, daemon=True, name="serve-watch")
+    thread.start()
+    return thread

@@ -32,3 +32,21 @@ def start_heal_recv_worker(transport, manager):
     thread = threading.Thread(target=recv_worker, daemon=True, name="heal-recv")
     thread.start()
     return thread
+
+
+def start_serve_child_watcher(proc, manager):
+    """The serve-sidecar supervisor shape: the donor's watcher thread
+    detects the serving child's death. A crash it observes MUST funnel
+    into report_error — a watcher that raises dies silently and the
+    donor's fleet view never learns the sidecar is gone."""
+
+    def watch_child() -> None:
+        # VIOLATION: proc.wait()/respawn can raise (and the observed
+        # crash is handled by raising) with no funnel to the manager.
+        rc = proc.wait()
+        if rc != 0:
+            raise RuntimeError(f"serve child died rc={rc}")
+
+    thread = threading.Thread(target=watch_child, daemon=True, name="serve-watch")
+    thread.start()
+    return thread

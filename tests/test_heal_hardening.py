@@ -26,9 +26,23 @@ from test_manager import make_manager, make_quorum
 from torchft_tpu.checkpointing import (
     HealStalledError,
     HTTPTransport,
+    ServeChildCrashed,
 )
 from torchft_tpu.manager import HealExhaustedError
 from torchft_tpu.parallel.process_group import ProcessGroupDummy
+from torchft_tpu.utils import faultinject
+
+
+def bulky_state(n_leaves: int = 6, leaf_mb: float = 2.0) -> dict:
+    """N sizeable same-shape leaves → N round-robin chunks that take long
+    enough on the wire that a mid-serve process kill reliably cuts SOME
+    streams while at least one (the kill-consuming serve completes its
+    chunk before dying) lands in the resume cache."""
+    n = int(leaf_mb * (1 << 20) / 4)
+    return {
+        f"w{i}": np.full(n, float(i + 1), dtype=np.float32)
+        for i in range(n_leaves)
+    }
 
 
 # ---------------------------------------------------------------------------
@@ -209,6 +223,124 @@ def test_punisher_file_armed_fault_consumed_by_donor(tmp_path, monkeypatch) -> N
     finally:
         donor.shutdown()
         joiner.shutdown()
+
+
+def test_kill_serve_child_mid_heal_fails_over_with_exact_resume(
+    tmp_path, monkeypatch
+) -> None:
+    """The serve-sidecar chaos drill (faultinject-armed, no native plane):
+    the donor's serving child is killed mid-heal by the punisher's
+    file-armed kill_serve_child; the joiner's attempt fails cleanly with
+    its verified chunks cached, a failover donor completes the heal with
+    the re-fetch counter moving by EXACTLY the missing chunks, nothing
+    checksum-failed — and the donor process observes the crash only
+    through its registered error callback (report_error's funnel)."""
+    monkeypatch.setenv(faultinject.ENV_FAULT_FILE, str(tmp_path / "fault_cmd"))
+    state = bulky_state()
+    n_chunks = len(state)
+    joiner = HTTPTransport()
+    donor_errors: list = []
+    donor_a = None
+    try:
+        # The kill-consuming serve finishes its chunk then dies, so which
+        # concurrent streams survive is a scheduler race; re-arm on the
+        # (rare) run where every stream finished before the exit.
+        for _attempt in range(3):
+            donor_a = HTTPTransport(num_chunks=n_chunks, serve_mode="child")
+            donor_a.register_error_callback(donor_errors.append)
+            donor_a.send_checkpoint(
+                [1], step=5, state_dict=state, timeout=10, quorum_id=7
+            )
+            faultinject.arm("kill_serve_child", site="serve_child")
+            try:
+                joiner.recv_checkpoint(
+                    0, donor_a.metadata(), 5, timeout=2.0, quorum_id=7
+                )
+            except Exception:
+                break  # the kill landed mid-heal
+            donor_a.shutdown()
+            donor_a = None
+        else:
+            pytest.fail("kill_serve_child never interrupted the heal")
+
+        # The crash reached the donor ONLY through the error funnel.
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not donor_errors:
+            time.sleep(0.05)
+        assert donor_errors and isinstance(donor_errors[0], ServeChildCrashed)
+        # The donor-side transport is still operable (step loop undisturbed).
+        donor_a.disallow_checkpoint()
+
+        mid = heal_counters()
+        (entry,) = joiner._heal_cache.values()
+        cached = len(entry.chunks)
+        missing = n_chunks - cached
+        assert cached >= 1, "kill-consuming serve should complete its chunk"
+        assert missing >= 1, "kill should cut at least one stream"
+
+        # Failover donor (inline — any donor serving the same (step,
+        # digest) continues the heal); only the missing chunks transfer.
+        donor_b = HTTPTransport(num_chunks=n_chunks)
+        try:
+            donor_b.send_checkpoint(
+                [1], step=5, state_dict=state, timeout=10, quorum_id=8
+            )
+            out = joiner.recv_checkpoint(
+                0, donor_b.metadata(), 5, timeout=10, quorum_id=8
+            )
+        finally:
+            donor_b.shutdown()
+        after = heal_counters()
+        assert_state_equal(state, out)
+        assert after["refetch"] - mid["refetch"] == missing
+        assert after["resumed"] - mid["resumed"] > 0
+        # The failover pass itself is clean. (The kill CAN cut a stream
+        # inside a chunk header, which the joiner deliberately arbitrates
+        # via CRC — that counts a checksum failure during the FAILED
+        # attempt, and that chunk is never cached, let alone adopted.)
+        assert after["checksum"] - mid["checksum"] == 0
+    finally:
+        if donor_a is not None:
+            donor_a.shutdown()
+        joiner.shutdown()
+
+
+@pytest.mark.parametrize("depth", [0, 1], ids=["strict", "pipelined"])
+def test_serve_child_crash_poisons_step_in_both_commit_orderings(
+    depth, monkeypatch
+) -> None:
+    """A sidecar crash behaves like every other heal-plane failure at the
+    step boundary in BOTH commit orderings: report_error poisons the
+    step, the commit barrier refuses it, and the next healthy round
+    commits again. (The pipelined drain-before-reconfigure ordering
+    itself is pinned by the PR-1 tests in test_ddp.py; here the crash
+    enters through the transport's error callback.)"""
+    monkeypatch.delenv("TPUFT_COMMIT_PIPELINE", raising=False)
+    manager, client, pg, transport = make_manager(
+        pg=ProcessGroupDummy(), min_replica_size=1, commit_pipeline_depth=depth
+    )
+    try:
+        assert manager.commit_pipeline_depth == depth
+        (cb,) = transport.register_error_callback.call_args[0]
+        client._quorum.return_value = make_quorum(
+            quorum_id=3, replica_rank=0, replica_world_size=1
+        )
+        client.should_commit.side_effect = (
+            lambda rank, step, vote, timeout: vote
+        )
+        manager.start_quorum()
+        manager.wait_quorum()
+        # The watcher funnels the crash mid-step.
+        cb(ServeChildCrashed("sidecar died rc=-9"))
+        assert manager.errored() is not None
+        assert manager.should_commit() is False
+        # Next round: flags wiped, healthy commit.
+        manager.start_quorum()
+        manager.wait_quorum()
+        assert manager.errored() is None
+        assert manager.should_commit() is True
+    finally:
+        manager.shutdown(wait=False)
 
 
 # ---------------------------------------------------------------------------

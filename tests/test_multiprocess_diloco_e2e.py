@@ -112,10 +112,14 @@ def digest_leaves(leaves):
 
 # Gradients keyed on (committed step, position in cycle) — observed state,
 # identical across groups, self-realigning after the heal.
-# Paced (0.5s/step) so the survivor is still training when the killed
-# group's restart (~15s of jax startup) rejoins: the restarted group must
-# LIVE-HEAL into the run, which the committed-steps assertion below
-# verifies — a from-scratch solo replay would commit from step 1.
+# Observed-status pacing (CLAUDE.md: gate on state, not sleeps): the
+# survivor must still be training when the killed group's restart (~15s
+# of jax startup) rejoins, so inner steps are paced ONLY while the fleet
+# is degraded (participants < 2 — the restart/heal window the kill
+# opens; before the first quorum num_participants() is 0, which also
+# paces the pre-kill warmup safely). The restarted group must LIVE-HEAL
+# into the run, which the committed-steps assertion below verifies — a
+# from-scratch solo replay would commit from step 1.
 committed_steps = []
 loop_started_unix = time.time()
 while manager.current_step() < N_SYNCS:
@@ -125,7 +129,8 @@ while manager.current_step() < N_SYNCS:
         os.kill(os.getpid(), signal.SIGKILL)  # hard death, no cleanup
     if algo.step(grad_for(step, algo._local_step)):
         committed_steps.append(manager.current_step())
-    time.sleep(0.5)
+    if manager.num_participants() < 2:
+        time.sleep(0.5)
 
 (out_dir / f"g{group}_r{rank}.json").write_text(
     json.dumps(

@@ -1,0 +1,391 @@
+"""Donor sidecar (out-of-process heal serving) unit suite — pure Python,
+tier-1 in a container without the native toolchain.
+
+Covers the process-lifecycle plane the subsystem adds: snapshot handoff
+through shared-memory files, era fencing verified IN the child, epoch
+swap + cleanup on restage/disallow, crash detection funneling into the
+registered error callback with bounded respawn, inline fallback when the
+child is unavailable, and the child-registry scrape merged into the
+donor's /metrics. The kill-mid-heal chaos drill lives in
+tests/test_heal_hardening.py next to the other heal drills.
+"""
+
+import os
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from test_checkpointing import assert_state_equal, chunked_state, heal_counters
+from torchft_tpu import metrics
+from torchft_tpu.checkpointing import (
+    HealEraMismatch,
+    HTTPTransport,
+    ServeChildCrashed,
+)
+from torchft_tpu.checkpointing import serve_child as sc
+
+
+def make_child_transport(**kw):
+    kw.setdefault("num_chunks", 4)
+    return HTTPTransport(serve_mode="child", **kw)
+
+
+def wait_until(predicate, deadline_s: float = 10.0, what: str = "condition"):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def test_child_mode_roundtrip_preserves_integrity_meta() -> None:
+    """A heal served by the sidecar is byte-identical and its /meta is the
+    same format-2 integrity root the inline handler serves (per-chunk
+    CRCs, digest, staged era)."""
+    from torchft_tpu._safe_pickle import safe_loads
+
+    state = chunked_state()
+    donor = make_child_transport()
+    joiner = HTTPTransport()
+    try:
+        assert donor.serve_mode == "child"
+        assert donor._child_serving()
+        donor.send_checkpoint(
+            [1], step=5, state_dict=state, timeout=10, quorum_id=7
+        )
+        raw = urllib.request.urlopen(
+            donor.metadata() + "/checkpoint/5/meta", timeout=5
+        ).read()
+        meta = safe_loads(raw)
+        assert meta["format"] == 2
+        assert meta["quorum_id"] == 7
+        assert len(meta["chunk_crcs"]) == meta["num_chunks"] == 4
+        assert meta["digest"]
+        out = joiner.recv_checkpoint(
+            0, donor.metadata(), 5, timeout=10, quorum_id=7
+        )
+        assert_state_equal(state, out)
+    finally:
+        donor.shutdown()
+        joiner.shutdown()
+
+
+def test_child_full_route_streams_all_chunks() -> None:
+    """/full from the sidecar: 8-byte size prefix + serialized chunk per
+    chunk, wire-identical to inline (the paced bench legs drain it)."""
+    state = chunked_state()
+    donor = make_child_transport()
+    inline = HTTPTransport(num_chunks=4)
+    try:
+        donor.send_checkpoint(
+            [1], step=5, state_dict=state, timeout=10, quorum_id=7
+        )
+        inline.send_checkpoint(
+            [1], step=5, state_dict=state, timeout=10, quorum_id=7
+        )
+        child_bytes = urllib.request.urlopen(
+            donor.metadata() + "/checkpoint/5/full", timeout=10
+        ).read()
+        inline_bytes = urllib.request.urlopen(
+            inline.metadata() + "/checkpoint/5/full", timeout=10
+        ).read()
+        assert child_bytes == inline_bytes
+    finally:
+        donor.shutdown()
+        inline.shutdown()
+
+
+def test_child_era_fence_at_meta_and_chunk() -> None:
+    """Era fencing holds ACROSS the process boundary: the child answers a
+    mismatched-era chunk GET 409, and a joiner healing in a different era
+    rejects the child's /meta outright."""
+    state = chunked_state()
+    donor = make_child_transport()
+    joiner = HTTPTransport()
+    try:
+        donor.send_checkpoint(
+            [1], step=5, state_dict=state, timeout=10, quorum_id=7
+        )
+        before = metrics.counter_total("tpuft_heal_era_rejects_total")
+        with pytest.raises(HealEraMismatch):
+            joiner.recv_checkpoint(
+                0, donor.metadata(), 5, timeout=5, quorum_id=9
+            )
+        assert (
+            metrics.counter_total("tpuft_heal_era_rejects_total") - before == 1
+        )
+        # Chunk URLs are era-fenced in-child (the stale-era-after-quorum-
+        # change failure row: a sidecar left behind answers 409, never
+        # bytes its /meta does not describe).
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(
+                donor.metadata() + "/checkpoint/5/0?quorum_id=9", timeout=5
+            )
+        assert err.value.code == 409
+    finally:
+        donor.shutdown()
+        joiner.shutdown()
+
+
+def test_child_restage_swaps_epoch_and_deletes_old(tmp_path) -> None:
+    """A quorum change re-stages a fresh epoch; the child atomically swaps
+    and deletes the previous snapshot (no unbounded tmpfs growth)."""
+    state = chunked_state()
+    donor = make_child_transport()
+    joiner = HTTPTransport()
+    try:
+        root = donor._serve_child._root
+        donor.send_checkpoint(
+            [1], step=5, state_dict=state, timeout=10, quorum_id=7
+        )
+        wait_until(
+            lambda: sorted(p.name for p in root.iterdir()) == ["epoch-000001"],
+            what="first epoch staged",
+        )
+        state2 = {**state, "w": state["w"] + 1.0}
+        donor.send_checkpoint(
+            [1], step=6, state_dict=state2, timeout=10, quorum_id=8
+        )
+        wait_until(
+            lambda: sorted(p.name for p in root.iterdir()) == ["epoch-000002"],
+            what="old epoch deleted after restage",
+        )
+        out = joiner.recv_checkpoint(
+            0, donor.metadata(), 6, timeout=10, quorum_id=8
+        )
+        assert_state_equal(state2, out)
+    finally:
+        donor.shutdown()
+        joiner.shutdown()
+
+
+def test_child_disallow_stops_serving_and_deletes_snapshot() -> None:
+    state = chunked_state()
+    donor = make_child_transport()
+    joiner = HTTPTransport()
+    try:
+        root = donor._serve_child._root
+        donor.send_checkpoint(
+            [1], step=5, state_dict=state, timeout=10, quorum_id=7
+        )
+        donor.disallow_checkpoint()
+        wait_until(
+            lambda: not any(root.iterdir()), what="snapshot deleted on disallow"
+        )
+        # Later GETs park (nothing staged) until the joiner's own fetch
+        # timeout expires — same contract as inline.
+        with pytest.raises(Exception):
+            joiner.recv_checkpoint(
+                0, donor.metadata(), 5, timeout=1.0, quorum_id=7
+            )
+    finally:
+        donor.shutdown()
+        joiner.shutdown()
+
+
+def test_child_crash_funnels_error_and_respawns() -> None:
+    """An unexpected child death reaches the registered error callback as
+    ServeChildCrashed (the Manager funnels this into report_error), the
+    crash/restart counters move, a fresh child respawns, and the next
+    stage serves heals again — the donor process itself never raises."""
+    state = chunked_state()
+    errors = []
+    donor = make_child_transport()
+    donor.register_error_callback(errors.append)
+    joiner = HTTPTransport()
+    try:
+        donor.send_checkpoint(
+            [1], step=5, state_dict=state, timeout=10, quorum_id=7
+        )
+        c0 = metrics.counter_total("tpuft_heal_serve_child_crashes_total")
+        old_pid = donor._serve_child._proc.pid
+        donor._serve_child._proc.kill()
+        wait_until(lambda: errors, what="crash funneled into the callback")
+        assert isinstance(errors[0], ServeChildCrashed)
+        assert (
+            metrics.counter_total("tpuft_heal_serve_child_crashes_total") - c0
+            == 1
+        )
+        wait_until(lambda: donor._serve_child.alive(), what="respawn")
+        assert donor._serve_child._proc.pid != old_pid
+        # The respawned child starts empty; a restage serves again.
+        donor.send_checkpoint(
+            [1], step=6, state_dict=state, timeout=10, quorum_id=8
+        )
+        out = joiner.recv_checkpoint(
+            0, donor.metadata(), 6, timeout=10, quorum_id=8
+        )
+        assert_state_equal(state, out)
+    finally:
+        donor.shutdown()
+        joiner.shutdown()
+
+
+def test_child_spawn_failure_degrades_to_inline(monkeypatch) -> None:
+    """A box where the sidecar cannot spawn still heals: construction
+    falls back to inline serving (counter + in-process address)."""
+    from torchft_tpu.checkpointing import http_transport as ht
+
+    def boom(*a, **kw):
+        raise OSError("no fork for you")
+
+    monkeypatch.setattr(ht, "ServeChild", boom)
+    before = metrics.counter_total("tpuft_heal_serve_fallbacks_total")
+    state = chunked_state()
+    donor = HTTPTransport(num_chunks=4, serve_mode="child")
+    joiner = HTTPTransport()
+    try:
+        assert donor._serve_child is None
+        assert not donor._child_serving()
+        assert (
+            metrics.counter_total("tpuft_heal_serve_fallbacks_total") - before
+            == 1
+        )
+        donor.send_checkpoint(
+            [1], step=5, state_dict=state, timeout=10, quorum_id=7
+        )
+        out = joiner.recv_checkpoint(
+            0, donor.metadata(), 5, timeout=10, quorum_id=7
+        )
+        assert_state_equal(state, out)
+    finally:
+        donor.shutdown()
+        joiner.shutdown()
+
+
+def test_child_metrics_merged_into_donor_scrape() -> None:
+    """The donor's /metrics (transport port) carries the child-side
+    serve counters labeled process="serve_child"; /metrics.json gains a
+    serve_child section (docs/observability.md)."""
+    import json
+
+    state = chunked_state()
+    donor = make_child_transport()
+    joiner = HTTPTransport()
+    try:
+        donor.send_checkpoint(
+            [1], step=5, state_dict=state, timeout=10, quorum_id=7
+        )
+        joiner.recv_checkpoint(0, donor.metadata(), 5, timeout=10, quorum_id=7)
+        port = donor._server.server_address[1]
+        text = urllib.request.urlopen(
+            f"http://localhost:{port}/metrics", timeout=5
+        ).read().decode()
+        assert 'process="serve_child"' in text
+        assert "tpuft_heal_serve_requests_total" in text
+        assert "tpuft_heal_serve_bytes_total" in text
+        payload = json.loads(
+            urllib.request.urlopen(
+                f"http://localhost:{port}/metrics.json", timeout=5
+            ).read().decode()
+        )
+        child = payload["serve_child"]
+        reqs = child["counters"]["tpuft_heal_serve_requests_total"]
+        assert sum(e["value"] for e in reqs) >= 5  # 1 meta + 4 chunks
+    finally:
+        donor.shutdown()
+        joiner.shutdown()
+
+
+def test_file_armed_corrupt_stream_consumed_by_child(
+    tmp_path, monkeypatch
+) -> None:
+    """The punisher's fault-file arming crosses the process boundary: the
+    CHILD's chunk serve consumes corrupt_stream, the joiner's checksum
+    rejects + re-fetches exactly once, and corruption is never adopted."""
+    from torchft_tpu.punisher import arm_stream_fault
+    from torchft_tpu.utils import faultinject
+
+    fault_file = str(tmp_path / "fault_cmd")
+    monkeypatch.setenv(faultinject.ENV_FAULT_FILE, fault_file)
+    state = chunked_state()
+    donor = make_child_transport(num_chunks=2)
+    joiner = HTTPTransport()
+    try:
+        donor.send_checkpoint(
+            [1], step=5, state_dict=state, timeout=10, quorum_id=7
+        )
+        assert arm_stream_fault("corrupt_stream", fault_file)
+        before = heal_counters()
+        out = joiner.recv_checkpoint(
+            0, donor.metadata(), 5, timeout=10, quorum_id=7
+        )
+        after = heal_counters()
+        assert_state_equal(state, out)
+        assert after["checksum"] - before["checksum"] == 1
+        assert after["refetch"] - before["refetch"] == 1
+    finally:
+        donor.shutdown()
+        joiner.shutdown()
+
+
+def test_serve_mode_env_selection_and_validation(monkeypatch) -> None:
+    monkeypatch.setenv(sc.ENV_SERVE_MODE, "child")
+    donor = HTTPTransport(num_chunks=2)
+    try:
+        assert donor.serve_mode == "child"
+        assert donor._serve_child is not None
+    finally:
+        donor.shutdown()
+    monkeypatch.delenv(sc.ENV_SERVE_MODE)
+    with pytest.raises(ValueError):
+        HTTPTransport(serve_mode="sideways")
+    inline = HTTPTransport()
+    try:
+        assert inline.serve_mode == "inline"
+        assert inline._serve_child is None
+        assert metrics.gauge_value("tpuft_heal_serve_mode") == 0
+    finally:
+        inline.shutdown()
+
+
+def test_manager_wires_report_error_into_transport() -> None:
+    """The Manager registers report_error as the transport's error
+    callback at construction, so a sidecar crash poisons the step instead
+    of raising past the step boundary."""
+    from test_manager import make_manager
+    from torchft_tpu.parallel.process_group import ProcessGroupDummy
+
+    manager, _client, _pg, transport = make_manager(pg=ProcessGroupDummy())
+    try:
+        transport.register_error_callback.assert_called_once()
+        (cb,) = transport.register_error_callback.call_args[0]
+        assert manager.errored() is None
+        cb(ServeChildCrashed("sidecar died"))
+        err = manager.errored()
+        assert err is not None
+        assert isinstance(err.original_exception, ServeChildCrashed)
+    finally:
+        manager.shutdown(wait=False)
+
+
+def test_serve_dir_root_resolution(monkeypatch, tmp_path) -> None:
+    monkeypatch.setenv(sc.ENV_SERVE_DIR, str(tmp_path))
+    assert sc.serve_dir_root() == str(tmp_path)
+    monkeypatch.delenv(sc.ENV_SERVE_DIR)
+    root = sc.serve_dir_root()
+    assert os.path.isdir(root)
+
+
+def test_serve_rate_pacer_bounds_throughput() -> None:
+    """The egress bound (TPUFT_HEAL_SERVE_GBPS) paces writes: 1 MB at
+    8 Gbps must take ~1 ms of injected sleep, not zero."""
+
+    class Sink:
+        def __init__(self) -> None:
+            self.n = 0
+
+        def write(self, data) -> None:
+            self.n += len(data)
+
+    sink = Sink()
+    w = sc._RateWriter(sink, gbps=8.0)
+    t0 = time.perf_counter()
+    w.write(b"\0" * (1 << 20))
+    elapsed = time.perf_counter() - t0
+    assert sink.n == 1 << 20
+    assert elapsed >= 0.0008

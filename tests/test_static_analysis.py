@@ -44,16 +44,21 @@ def rules_of(findings):
 def test_r1_violation_fixture() -> None:
     # Unguarded thread target + lambda callback + unguarded heal/recv
     # worker (the heal-plane shape: a joiner's checkpoint fetch thread
-    # must funnel donor-death/checksum/watchdog failures).
+    # must funnel donor-death/checksum/watchdog failures) + unguarded
+    # serve-child supervisor watcher (the sidecar shape: child death must
+    # funnel into report_error, not kill the watcher thread). Golden
+    # count updated DELIBERATELY with the serve-child subsystem — the
+    # new shape is pinned, not baselined away.
     findings = scan("r1_violation.py", rules=["step-boundary-escape"])
-    assert len(findings) == 3
+    assert len(findings) == 4
     assert rules_of(findings) == ["step-boundary-escape"]
     lines = sorted(f.line for f in findings)
     assert any("thread target" in f.message for f in findings)
     assert any("lambda" in f.message for f in findings)
     assert any("recv_worker" in f.message for f in findings)
+    assert any("watch_child" in f.message for f in findings)
     assert all(f.file.endswith("r1_violation.py") for f in findings)
-    assert lines == [10, 16, 29]
+    assert lines == [10, 16, 29, 46]
 
 
 def test_r1_clean_fixture() -> None:

@@ -15,6 +15,11 @@ from torchft_tpu.checkpointing.http_transport import (
     HTTPTransport,
 )
 from torchft_tpu.checkpointing.pg_transport import PGTransport
+from torchft_tpu.checkpointing.serve_child import (
+    ServeChild,
+    ServeChildCrashed,
+    ServeChildUnavailable,
+)
 from torchft_tpu.checkpointing.transport import CheckpointTransport
 
 __all__ = [
@@ -25,4 +30,7 @@ __all__ = [
     "HealEraMismatch",
     "HealIntegrityError",
     "HealStalledError",
+    "ServeChild",
+    "ServeChildCrashed",
+    "ServeChildUnavailable",
 ]

@@ -33,11 +33,25 @@ Heal-path hardening (beyond the reference, which trusts the stream):
 - **Era fencing**: ``/meta`` carries the staged ``quorum_id``; a joiner
   healing in era E rejects a donor staged for era != E instead of
   healing backwards from a stale survivor.
+
+Serve modes (``$TPUFT_HEAL_SERVE_MODE`` / the ``serve_mode`` ctor arg):
+
+- ``inline`` (default): today's in-process serving, unchanged — the
+  threaded server above answers heal traffic from the donor process.
+- ``child``: a pre-spawned serving child (checkpointing/serve_child.py)
+  owns an immutable snapshot of the staged checkpoint (serialized once
+  into shared-memory-backed files, integrity metadata computed in the
+  same pass) and answers all heal traffic from its own process, so
+  GIL/core contention from serving structurally cannot touch the
+  donor's step loop. The in-process server remains as the fallback: a
+  crashed-out child degrades serving back to inline (reported through
+  the registered error callback), never to "no heals".
 """
 
 from __future__ import annotations
 
 import hashlib
+import logging
 import os
 import pickle
 import socket
@@ -58,6 +72,15 @@ from torchft_tpu import metrics
 from torchft_tpu._safe_pickle import safe_loads
 from torchft_tpu.utils import faultinject, netem
 from torchft_tpu.checkpointing import _serialization
+from torchft_tpu.checkpointing.serve_child import (
+    ENV_SERVE_MODE,
+    ServeChild,
+    ServeChildUnavailable,
+    _CorruptingWriter,
+    _DripWriter,
+    _TruncatingWriter,
+    maybe_pace_serve,
+)
 from torchft_tpu.checkpointing.transport import CheckpointTransport
 
 __all__ = [
@@ -69,6 +92,8 @@ __all__ = [
 ]
 
 ENV_HEAL_MIN_BPS = "TPUFT_HEAL_MIN_BYTES_PER_SEC"
+
+logger = logging.getLogger(__name__)
 
 # Sliding window the progress watchdog averages over; fencing decisions
 # never fire before one full window has elapsed, so a legit slow start
@@ -239,72 +264,27 @@ class _GuardedReader:
                 )
 
 
-# ---------------------------------------------------------------------------
-# Donor-side fault writers (chaos drills; see torchft_tpu/utils/faultinject).
-# ---------------------------------------------------------------------------
+# Donor-side fault writers (chaos drills) live in serve_child.py so the
+# serving child shares the exact same seams; imported above for the
+# inline handler (and for tests that reach them via this module).
 
 
-class _CorruptingWriter:
-    """Flips one bit of the byte at ``flip_at`` — the injected fault the
-    joiner's per-chunk checksum must catch."""
+class _TeeCRCWriter:
+    """File sink that also checksums everything written through it — the
+    child-mode staging path computes the PR-4 per-chunk CRC in the same
+    single pass that serializes the chunk into shared memory (no second
+    pass over the payload, matching inline's one-CRC-pass staging cost)."""
 
-    def __init__(self, raw: Any, flip_at: int) -> None:
+    __slots__ = ("crc", "_raw", "_update")
+
+    def __init__(self, raw: Any, update: Callable[[int, Any], int]) -> None:
+        self.crc = 0
         self._raw = raw
-        self._off = 0
-        self._flip_at = flip_at
-        self.flipped = False
+        self._update = update
 
     def write(self, data: Any) -> None:
-        mv = memoryview(data)
-        if mv.format != "B" or mv.ndim != 1:
-            mv = mv.cast("B")
-        n = len(mv)
-        if not self.flipped and self._off <= self._flip_at < self._off + n:
-            buf = bytearray(mv)
-            buf[self._flip_at - self._off] ^= 0x01
-            self.flipped = True
-            self._raw.write(bytes(buf))
-        else:
-            self._raw.write(mv)
-        self._off += n
-
-
-class _DripWriter:
-    """Serves at a trickle (default 256 B/s) — the gray donor the joiner's
-    minimum-progress watchdog must fence."""
-
-    def __init__(self, raw: Any, bps: float = 256.0, slice_bytes: int = 64) -> None:
-        self._raw = raw
-        self._delay = slice_bytes / float(bps)
-        self._slice = slice_bytes
-
-    def write(self, data: Any) -> None:
-        mv = memoryview(data)
-        if mv.format != "B" or mv.ndim != 1:
-            mv = mv.cast("B")
-        for off in range(0, len(mv), self._slice):
-            self._raw.write(mv[off : off + self._slice])
-            time.sleep(self._delay)
-
-
-class _TruncatingWriter:
-    """Writes only the first ``limit`` bytes then swallows the rest — with
-    the connection closed after the handler returns, the joiner sees a
-    truncated stream (EOF mid-chunk)."""
-
-    def __init__(self, raw: Any, limit: int) -> None:
-        self._raw = raw
-        self._left = limit
-
-    def write(self, data: Any) -> None:
-        if self._left <= 0:
-            return
-        mv = memoryview(data)
-        if mv.format != "B" or mv.ndim != 1:
-            mv = mv.cast("B")
-        take = mv[: self._left]
-        self._left -= len(take)
-        self._raw.write(take)
+        self._raw.write(data)
+        self.crc = self._update(self.crc, data)
 
 
 class _Staged:
@@ -332,6 +312,44 @@ class _Staged:
             self.chunk_crcs.append(w.crc)
         self.digest = _checkpoint_digest(step, self.crc_algo, self.chunk_crcs)
 
+    def meta_bytes(self) -> bytes:
+        return _meta_bytes(
+            step=self.step,
+            quorum_id=self.quorum_id,
+            num_chunks=len(self.chunks),
+            treedef=self.treedef,
+            crc_algo=self.crc_algo,
+            chunk_crcs=self.chunk_crcs,
+            digest=self.digest,
+        )
+
+
+def _meta_bytes(
+    step: int,
+    quorum_id: Optional[int],
+    num_chunks: int,
+    treedef: Any,
+    crc_algo: str,
+    chunk_crcs: List[int],
+    digest: str,
+) -> bytes:
+    """The exact ``/meta`` response body. Built once per stage in BOTH
+    serve modes (the serving child receives these bytes pre-pickled over
+    the control pipe and serves them verbatim — it never needs to
+    unpickle a treedef, so it never needs jax)."""
+    return pickle.dumps(
+        {
+            "format": 2,
+            "num_chunks": num_chunks,
+            "treedef": treedef,
+            "step": step,
+            "quorum_id": quorum_id,
+            "crc_algo": crc_algo,
+            "chunk_crcs": chunk_crcs,
+            "digest": digest,
+        }
+    )
+
 
 class _HealCacheEntry:
     """Joiner-side resume state for one (step, digest): verified chunks (so
@@ -347,9 +365,43 @@ class HTTPTransport(CheckpointTransport[Any]):
     """Serves the staged checkpoint over HTTP; IPv6 dual-stack like the
     reference so it works across heterogeneous TPU pods."""
 
-    def __init__(self, timeout: float = 60.0, num_chunks: int = 0) -> None:
+    def __init__(
+        self,
+        timeout: float = 60.0,
+        num_chunks: int = 0,
+        serve_mode: Optional[str] = None,
+    ) -> None:
         self._timeout = timeout
         self._num_chunks = num_chunks
+        serve_mode = serve_mode or os.environ.get(ENV_SERVE_MODE, "inline")
+        if serve_mode not in ("inline", "child"):
+            raise ValueError(
+                f"{ENV_SERVE_MODE} must be 'inline' or 'child', got {serve_mode!r}"
+            )
+        self._serve_mode = serve_mode
+        # Donor sidecar (serve_mode="child"): pre-spawned serving child;
+        # heal traffic goes to ITS address (see metadata()) so serving
+        # contention structurally cannot touch this process. Spawn
+        # failure degrades to inline — serving must never be the reason
+        # a fleet cannot heal.
+        self._serve_child: Optional[ServeChild] = None
+        self._child_staged = False
+        self._child_degraded = False
+        self._error_cb: Optional[Callable[[Exception], None]] = None
+        metrics.set_gauge(
+            "tpuft_heal_serve_mode", 1 if serve_mode == "child" else 0
+        )
+        if serve_mode == "child":
+            try:
+                self._serve_child = ServeChild(
+                    timeout=timeout, on_error=self._dispatch_serve_error
+                )
+            except Exception as e:  # noqa: BLE001 — degrade, never fail init
+                logger.warning(
+                    "heal-serve child spawn failed (%s); serving inline", e
+                )
+                metrics.inc("tpuft_heal_serve_fallbacks_total")
+                self._child_degraded = True
         # Condition gates serving: a GET for step S parks until the trainer
         # stages S (send_checkpoint) — the reference's RWLock allow/disallow
         # gate (http_transport.py:182-242). Without this the joiner's fetch
@@ -377,8 +429,16 @@ class HTTPTransport(CheckpointTransport[Any]):
             def do_GET(self) -> None:
                 # The transport's port doubles as this process's scrape
                 # endpoint: every training replica already listens here for
-                # heals, so /metrics needs no extra server or port.
-                if metrics._serve_metrics_http(self, metrics.REGISTRY, self.path):
+                # heals, so /metrics needs no extra server or port. In
+                # child mode the serving child's registry is scraped and
+                # merged in (labeled process="serve_child").
+                if metrics._serve_metrics_http(
+                    self,
+                    metrics.REGISTRY,
+                    self.path,
+                    extra_text=transport._child_metrics_text,
+                    extra_json=transport._child_metrics_json,
+                ):
                     return
                 split = urllib.parse.urlsplit(self.path)
                 parts = split.path.strip("/").split("/")
@@ -428,18 +488,7 @@ class HTTPTransport(CheckpointTransport[Any]):
                     )
                     return
                 if parts[2] == "meta":
-                    body = pickle.dumps(
-                        {
-                            "format": 2,
-                            "num_chunks": len(staged.chunks),
-                            "treedef": staged.treedef,
-                            "step": staged.step,
-                            "quorum_id": staged.quorum_id,
-                            "crc_algo": staged.crc_algo,
-                            "chunk_crcs": staged.chunk_crcs,
-                            "digest": staged.digest,
-                        }
-                    )
+                    body = staged.meta_bytes()
                     self.send_response(200)
                     self.send_header("Content-Type", "application/octet-stream")
                     self.send_header("Content-Length", str(len(body)))
@@ -455,6 +504,7 @@ class HTTPTransport(CheckpointTransport[Any]):
                     if netem.enabled():  # emulated-DCN heal path
                         netem.pace_latency()
                         out = netem.PacingWriter(out)
+                    out = maybe_pace_serve(out)
                     try:
                         for chunk in staged.chunks:
                             out.write(chunk.total_size.to_bytes(8, "big"))
@@ -487,6 +537,7 @@ class HTTPTransport(CheckpointTransport[Any]):
                         # one up-front sleep would hold the wire silent
                         # past the joiner's per-recv inactivity timeout.
                         out = netem.PacingWriter(out)
+                    out = maybe_pace_serve(out)
                     if fault == "corrupt_stream":
                         # Flip a payload bit (the LAST byte is raw array
                         # data whenever the chunk carries arrays): the
@@ -520,9 +571,120 @@ class HTTPTransport(CheckpointTransport[Any]):
             return hook(step, index)
         return faultinject.consume("heal_stream")
 
+    # -- serve-child plumbing ----------------------------------------------
+
+    def register_error_callback(self, cb: Callable[[Exception], None]) -> None:
+        """Funnel for serving-plane failures (serve-child crashes): the
+        manager registers :meth:`Manager.report_error` here so the step
+        loop observes a crashed sidecar only as a poisoned step, never as
+        an exception past the step boundary."""
+        self._error_cb = cb
+
+    def _dispatch_serve_error(self, e: Exception) -> None:
+        cb = self._error_cb
+        if cb is not None:
+            cb(e)
+        else:
+            logger.warning("heal-serve child error (no callback bound): %s", e)
+
+    @property
+    def serve_mode(self) -> str:
+        return self._serve_mode
+
+    def _child_serving(self) -> bool:
+        child = self._serve_child
+        return child is not None and child.alive() and not self._child_degraded
+
+    def _child_metrics_text(self) -> Optional[str]:
+        child = self._serve_child
+        if child is None:
+            return None
+        snap = child.fetch_metrics_snapshot()
+        if snap is None:
+            return None
+        return metrics.snapshot_to_prometheus(
+            snap.get("metrics", {}),
+            extra_labels={"process": "serve_child"},
+            skip_type_names=metrics.REGISTRY.metric_names(),
+        )
+
+    def _child_metrics_json(self) -> Optional[Dict[str, Any]]:
+        child = self._serve_child
+        if child is None:
+            return None
+        snap = child.fetch_metrics_snapshot()
+        if snap is None:
+            return None
+        return {"serve_child": snap.get("metrics", {})}
+
+    def _stage_to_child(
+        self, step: int, state_dict: Any, quorum_id: Optional[int]
+    ) -> None:
+        """Child-mode staging: serialize each chunk ONCE into a fresh
+        epoch directory on the shared-memory filesystem (tmpfs pages, so
+        this is a memcpy + C-speed CRC, not disk I/O), computing the
+        per-chunk CRCs in the same pass, then hand the file names + the
+        pre-pickled /meta bytes to the serving child. The Prepared chunk
+        (and its host leaf refs) is dropped as soon as its file is
+        written, so donor peak memory stays at one chunk beyond the
+        caller's state."""
+        child = self._serve_child
+        if child is None or not child.alive():
+            raise ServeChildUnavailable("no live serving child")
+        leaves, treedef = jax.tree_util.tree_flatten(state_dict)
+        leaves = [_serialization._to_host(leaf) for leaf in leaves]
+        n = self._num_chunks if self._num_chunks > 0 else 1
+        n = min(n, max(len(leaves), 1))
+        chunk_dicts: List[Dict[int, Any]] = [dict() for _ in range(n)]
+        for i, leaf in enumerate(leaves):
+            chunk_dicts[i % n][i] = leaf
+        del leaves
+        epoch, epoch_dir = child.new_epoch_dir()
+        update = _CRC_UPDATERS[_CRC_ALGO]
+        files: List[str] = []
+        sizes: List[int] = []
+        crcs: List[int] = []
+        for i, chunk_dict in enumerate(chunk_dicts):
+            prepared = _serialization.prepare(chunk_dict)
+            name = f"chunk{i}.bin"
+            with open(epoch_dir / name, "wb") as f:
+                w = _TeeCRCWriter(f, update)
+                _serialization.write_prepared(prepared, w)
+            files.append(name)
+            sizes.append(prepared.total_size)
+            crcs.append(w.crc)
+            chunk_dicts[i] = None  # type: ignore[call-overload]
+            del prepared
+        digest = _checkpoint_digest(step, _CRC_ALGO, crcs)
+        meta = _meta_bytes(
+            step=step,
+            quorum_id=quorum_id,
+            num_chunks=n,
+            treedef=treedef,
+            crc_algo=_CRC_ALGO,
+            chunk_crcs=crcs,
+            digest=digest,
+        )
+        child.stage(
+            step=step,
+            quorum_id=quorum_id,
+            epoch=epoch,
+            epoch_dir=epoch_dir,
+            files=files,
+            sizes=sizes,
+            meta_bytes=meta,
+        )
+        self._child_staged = True
+
     # -- CheckpointTransport -----------------------------------------------
 
     def metadata(self) -> str:
+        # In child mode peers heal from the SIDECAR's address; re-fetched
+        # every quorum round, so a respawned (new port) or degraded
+        # (fallen back to inline) sidecar is re-advertised within one
+        # round.
+        if self._child_serving():
+            return self._serve_child.address()  # type: ignore[union-attr]
         host = socket.gethostname()
         port = self._server.server_address[1]
         return f"http://{host}:{port}"
@@ -537,18 +699,39 @@ class HTTPTransport(CheckpointTransport[Any]):
     ) -> None:
         """Stages host copies of the state and starts serving them for
         ``step`` (tagged with ``quorum_id`` when the manager provides the
-        era). Serving continues until :meth:`disallow_checkpoint`."""
-        leaves, treedef = jax.tree_util.tree_flatten(state_dict)
-        leaves = [_serialization._to_host(leaf) for leaf in leaves]
-        n = self._num_chunks if self._num_chunks > 0 else 1
-        n = min(n, max(len(leaves), 1))
-        chunk_dicts: List[Dict[int, Any]] = [dict() for _ in range(n)]
-        for i, leaf in enumerate(leaves):
-            chunk_dicts[i % n][i] = leaf
-        # prepare() keeps the host leaves + a small header per chunk; the
-        # serialized bytes never exist as a second whole-payload copy.
-        chunks = [_serialization.prepare(chunk) for chunk in chunk_dicts]
-        staged = _Staged(step, chunks, treedef, quorum_id=quorum_id)
+        era). Serving continues until :meth:`disallow_checkpoint`. In
+        child mode the snapshot is handed to the serving child; any
+        failure on that path degrades THIS stage (and the advertised
+        address, from the next quorum round) to inline serving."""
+        if self._serve_child is not None:
+            try:
+                with metrics.timer(
+                    "tpuft_heal_serve_stage_seconds", mode="child"
+                ):
+                    self._stage_to_child(step, state_dict, quorum_id)
+                self._child_degraded = False
+                metrics.inc("tpuft_heal_serve_stages_total", mode="child")
+                return
+            except Exception as e:  # noqa: BLE001 — degrade to inline serving
+                logger.warning(
+                    "child-mode stage failed (%s); staging inline instead", e
+                )
+                metrics.inc("tpuft_heal_serve_fallbacks_total")
+                self._child_degraded = True
+        with metrics.timer("tpuft_heal_serve_stage_seconds", mode="inline"):
+            leaves, treedef = jax.tree_util.tree_flatten(state_dict)
+            leaves = [_serialization._to_host(leaf) for leaf in leaves]
+            n = self._num_chunks if self._num_chunks > 0 else 1
+            n = min(n, max(len(leaves), 1))
+            chunk_dicts: List[Dict[int, Any]] = [dict() for _ in range(n)]
+            for i, leaf in enumerate(leaves):
+                chunk_dicts[i % n][i] = leaf
+            # prepare() keeps the host leaves + a small header per chunk;
+            # the serialized bytes never exist as a second whole-payload
+            # copy.
+            chunks = [_serialization.prepare(chunk) for chunk in chunk_dicts]
+            staged = _Staged(step, chunks, treedef, quorum_id=quorum_id)
+        metrics.inc("tpuft_heal_serve_stages_total", mode="inline")
         with self._cond:
             self._staged = staged
             self._cond.notify_all()
@@ -556,6 +739,9 @@ class HTTPTransport(CheckpointTransport[Any]):
     def disallow_checkpoint(self) -> None:
         with self._cond:
             self._staged = None
+        if self._serve_child is not None and self._child_staged:
+            self._child_staged = False
+            self._serve_child.disallow()
 
     def recv_checkpoint(
         self,
@@ -727,6 +913,8 @@ class HTTPTransport(CheckpointTransport[Any]):
         return result
 
     def shutdown(self, wait: bool = True) -> None:
+        if self._serve_child is not None:
+            self._serve_child.shutdown(wait=wait)
         self._server.shutdown()
         self._server.server_close()
         if wait:

@@ -1,0 +1,753 @@
+"""Donor sidecar: out-of-process heal serving.
+
+The reference's heal design rests on "serving never perturbs the donor"
+(reference http_transport.py:226-242 stages CPU copies precisely so the
+step loop keeps running), but in-process serving still shares the donor's
+GIL and, on a core-starved host, its CPU: TRANSPORT_BENCH_12GB measured a
+1088% donor step inflation while serving a 12 GB heal from the inline
+threads. This module makes the isolation *structural*: a pre-spawned
+**serving child process** takes ownership of an immutable snapshot of the
+staged checkpoint and answers all ``/meta``, ``/chunk``, ``/full`` and
+``/metrics`` heal traffic from its own interpreter, so GIL or core
+contention from serving cannot touch the donor's step loop even on a
+one-core box.
+
+Snapshot handoff is POSIX shared memory by way of the filesystem: the
+donor serializes each staged chunk once into a file under a
+shared-memory-backed directory (``$TPUFT_HEAL_SERVE_DIR``, default
+``/dev/shm`` when present — tmpfs pages, i.e. RAM, not disk), computing
+the PR-4 integrity metadata (per-chunk CRCs + whole-checkpoint digest +
+staged ``quorum_id``) in the same single pass, and hands the child the
+file names plus the exact pre-pickled ``/meta`` bytes over a stdin/stdout
+JSON control pipe. The child never unpickles anything (it needs neither
+jax nor numpy — it is spawned as a plain script and stays import-light),
+it just era-fences and streams bytes; the joiner-side verification path
+is unchanged, so a corrupt, stale, or crashed child can never produce
+adopted state that the inline mode would have refused.
+
+Lifecycle (the donor-side :class:`ServeChild` handle):
+
+- **spawn**: at transport construction (pre-spawned, so its address is
+  known before the first quorum advertises metadata);
+- **restage**: every ``send_checkpoint`` writes a fresh epoch directory
+  and the child atomically swaps to it (deleting the old epoch), so a
+  quorum change re-stages the era the same way the inline path does —
+  and the manager's quorum-change drain hooks run *before* the donor
+  send, so the child never sees speculative pipelined state;
+- **disallow**: forwarded at the commit boundary; the child drops (and
+  deletes) its snapshot, later GETs park/404 exactly like inline;
+- **crash**: a watcher thread funnels unexpected child death into the
+  registered error callback (Manager.report_error) — never raises past
+  the step boundary — and respawns up to ``$TPUFT_HEAL_SERVE_MAX_RESTARTS``
+  times; while degraded the transport falls back to inline serving so
+  heals keep working;
+- **shutdown**: control-pipe shutdown, bounded wait, then SIGKILL; the
+  donor removes the serve directory.
+
+The child deprioritizes itself (``os.nice``, ``$TPUFT_HEAL_SERVE_NICE``,
+default 10) and can bound its egress rate (``$TPUFT_HEAL_SERVE_GBPS``):
+recovery traffic yields to training for CPU and for the wire, which is
+the same isolation highly-available DP training systems apply to their
+recovery planes (PAPERS.md: HA data-parallel training on mesh networks;
+Prime's collective communications library).
+"""
+
+from __future__ import annotations
+
+import base64
+import importlib.util
+import json
+import logging
+import os
+import shutil
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.parse
+from pathlib import Path
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+__all__ = [
+    "ServeChild",
+    "ServeChildCrashed",
+    "ServeChildUnavailable",
+    "ENV_SERVE_MODE",
+    "ENV_SERVE_DIR",
+    "ENV_SERVE_NICE",
+    "ENV_SERVE_GBPS",
+    "ENV_SERVE_MAX_RESTARTS",
+    "serve_dir_root",
+    "serve_rate_gbps",
+    "maybe_pace_serve",
+]
+
+ENV_SERVE_MODE = "TPUFT_HEAL_SERVE_MODE"
+ENV_SERVE_DIR = "TPUFT_HEAL_SERVE_DIR"
+ENV_SERVE_NICE = "TPUFT_HEAL_SERVE_NICE"
+ENV_SERVE_GBPS = "TPUFT_HEAL_SERVE_GBPS"
+ENV_SERVE_MAX_RESTARTS = "TPUFT_HEAL_SERVE_MAX_RESTARTS"
+
+logger = logging.getLogger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# Dual-context imports: in the donor this module is part of the package;
+# in the spawned child it runs as a bare script (``python serve_child.py``)
+# and must NOT import torchft_tpu/__init__ (which pulls jax — seconds of
+# import and a backend the serving plane has no use for). The three
+# runtime deps (metrics / faultinject / netem) are stdlib-only modules, so
+# the child loads them straight from their files.
+# ---------------------------------------------------------------------------
+
+
+def _load_by_path(name: str, path: Path):
+    spec = importlib.util.spec_from_file_location(name, path)
+    assert spec is not None and spec.loader is not None, path
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+if __package__:
+    from torchft_tpu import metrics
+    from torchft_tpu.utils import faultinject, netem
+else:  # pragma: no cover - exercised only inside the spawned child
+    _PKG = Path(__file__).resolve().parent.parent
+    metrics = _load_by_path("tpuft_serve_metrics", _PKG / "metrics.py")
+    faultinject = _load_by_path(
+        "tpuft_serve_faultinject", _PKG / "utils" / "faultinject.py"
+    )
+    netem = _load_by_path("tpuft_serve_netem", _PKG / "utils" / "netem.py")
+
+
+class ServeChildCrashed(RuntimeError):
+    """The serving child died unexpectedly; funneled into report_error by
+    the watcher (the step loop itself never observes the crash)."""
+
+
+class ServeChildUnavailable(RuntimeError):
+    """No live serving child to hand a snapshot to (crashed out of its
+    respawn budget, or still degraded); callers fall back to inline."""
+
+
+def serve_dir_root() -> str:
+    """Root for serve snapshots: ``$TPUFT_HEAL_SERVE_DIR``, else the
+    shared-memory tmpfs when the platform has one (RAM-backed — staging a
+    snapshot is a memcpy, not disk I/O), else the temp dir."""
+    configured = os.environ.get(ENV_SERVE_DIR)
+    if configured:
+        return configured
+    if os.path.isdir("/dev/shm"):
+        return "/dev/shm"
+    return tempfile.gettempdir()
+
+
+def serve_rate_gbps(default: float = 0.0) -> float:
+    """Egress bound for heal serving (``$TPUFT_HEAL_SERVE_GBPS``; <= 0 =
+    unthrottled). Applies in BOTH serve modes at the chunk/full write
+    seam, so recovery traffic can be bounded away from the training
+    wire's share."""
+    try:
+        return float(os.environ.get(ENV_SERVE_GBPS, str(default)))
+    except ValueError:
+        return default
+
+
+class _RateWriter:
+    """Paces writes to ``bytes/s`` in bounded slices (sleep released
+    between slices, so a paced serve is IO-bound, not a CPU hog)."""
+
+    def __init__(self, raw: Any, gbps: float, slice_bytes: int = 1 << 18) -> None:
+        self._raw = raw
+        self._spb = 8.0 / (gbps * 1e9)
+        self._slice = slice_bytes
+
+    def write(self, data: Any) -> None:
+        mv = memoryview(data)
+        if mv.format != "B" or mv.ndim != 1:
+            mv = mv.cast("B")
+        for off in range(0, len(mv), self._slice):
+            part = mv[off : off + self._slice]
+            self._raw.write(part)
+            time.sleep(len(part) * self._spb)
+
+
+def maybe_pace_serve(out: Any) -> Any:
+    """Wraps ``out`` with the serve-rate bound when configured."""
+    gbps = serve_rate_gbps()
+    if gbps > 0:
+        return _RateWriter(out, gbps)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Donor-side fault writers (chaos drills). Shared by the inline handler
+# (http_transport) and the serving child; stdlib-only by construction.
+# ---------------------------------------------------------------------------
+
+
+class _CorruptingWriter:
+    """Flips one bit of the byte at ``flip_at`` — the injected fault the
+    joiner's per-chunk checksum must catch."""
+
+    def __init__(self, raw: Any, flip_at: int) -> None:
+        self._raw = raw
+        self._off = 0
+        self._flip_at = flip_at
+        self.flipped = False
+
+    def write(self, data: Any) -> None:
+        mv = memoryview(data)
+        if mv.format != "B" or mv.ndim != 1:
+            mv = mv.cast("B")
+        n = len(mv)
+        if not self.flipped and self._off <= self._flip_at < self._off + n:
+            buf = bytearray(mv)
+            buf[self._flip_at - self._off] ^= 0x01
+            self.flipped = True
+            self._raw.write(bytes(buf))
+        else:
+            self._raw.write(mv)
+        self._off += n
+
+
+class _DripWriter:
+    """Serves at a trickle (default 256 B/s) — the gray donor the joiner's
+    minimum-progress watchdog must fence."""
+
+    def __init__(self, raw: Any, bps: float = 256.0, slice_bytes: int = 64) -> None:
+        self._raw = raw
+        self._delay = slice_bytes / float(bps)
+        self._slice = slice_bytes
+
+    def write(self, data: Any) -> None:
+        mv = memoryview(data)
+        if mv.format != "B" or mv.ndim != 1:
+            mv = mv.cast("B")
+        for off in range(0, len(mv), self._slice):
+            self._raw.write(mv[off : off + self._slice])
+            time.sleep(self._delay)
+
+
+class _TruncatingWriter:
+    """Writes only the first ``limit`` bytes then swallows the rest — with
+    the connection closed after the handler returns, the joiner sees a
+    truncated stream (EOF mid-chunk)."""
+
+    def __init__(self, raw: Any, limit: int) -> None:
+        self._raw = raw
+        self._left = limit
+
+    def write(self, data: Any) -> None:
+        if self._left <= 0:
+            return
+        mv = memoryview(data)
+        if mv.format != "B" or mv.ndim != 1:
+            mv = mv.cast("B")
+        take = mv[: self._left]
+        self._left -= len(take)
+        self._raw.write(take)
+
+
+# ---------------------------------------------------------------------------
+# Child process (runs as a bare script; stdlib + the path-loaded modules).
+# ---------------------------------------------------------------------------
+
+
+class _FileStaged:
+    """One immutable staged snapshot: epoch directory of serialized chunk
+    files + the exact pre-pickled /meta bytes + the era tag."""
+
+    def __init__(self, cmd: Dict[str, Any]) -> None:
+        self.epoch: int = cmd["epoch"]
+        self.step: int = cmd["step"]
+        self.quorum_id: Optional[int] = cmd["quorum_id"]
+        self.dir = Path(cmd["dir"])
+        self.files: List[str] = cmd["files"]
+        self.sizes: List[int] = cmd["sizes"]
+        self.meta_bytes: bytes = base64.b64decode(cmd["meta_b64"])
+
+    def delete(self) -> None:
+        shutil.rmtree(self.dir, ignore_errors=True)
+
+
+def _child_stream_file(path: Path, out: Any, slice_bytes: int = 1 << 20) -> int:
+    total = 0
+    with open(path, "rb") as f:
+        while True:
+            data = f.read(slice_bytes)
+            if not data:
+                return total
+            out.write(data)
+            total += len(data)
+
+
+def _child_main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    parser = argparse.ArgumentParser(description="tpuft heal-serving child")
+    parser.add_argument("--timeout", type=float, default=60.0)
+    parser.add_argument("--nice", type=int, default=0)
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.WARNING, format="[tpuft-serve-child %(levelname)s] %(message)s"
+    )
+    if args.nice > 0:
+        try:
+            os.nice(args.nice)
+        except OSError:
+            pass
+    # Batch scheduling where available: serving is throughput work; it
+    # must never wakeup-preempt a training step mid-flight on a shared
+    # core (it still gets its nice-weighted share).
+    try:
+        os.sched_setscheduler(0, os.SCHED_BATCH, os.sched_param(0))
+    except (AttributeError, OSError, PermissionError):
+        pass
+
+    cond = threading.Condition()
+    state: Dict[str, Any] = {"staged": None, "closing": False}
+
+    def wait_for_staged(step: int) -> Optional[_FileStaged]:
+        t0 = time.perf_counter()
+        with cond:
+            cond.wait_for(
+                lambda: (
+                    state["staged"] is not None and state["staged"].step == step
+                )
+                or state["closing"],
+                timeout=args.timeout,
+            )
+            staged = state["staged"]
+        metrics.observe(
+            "tpuft_ckpt_donor_stall_seconds", time.perf_counter() - t0
+        )
+        return staged
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt: str, *a: Any) -> None:  # silence
+            pass
+
+        def do_GET(self) -> None:
+            if metrics._serve_metrics_http(self, metrics.REGISTRY, self.path):
+                return
+            split = urllib.parse.urlsplit(self.path)
+            parts = split.path.strip("/").split("/")
+            if len(parts) != 3 or parts[0] != "checkpoint":
+                self.send_error(404, "unknown route")
+                return
+            try:
+                step = int(parts[1])
+            except ValueError:
+                self.send_error(400, "bad step")
+                return
+            staged = wait_for_staged(step)
+            if staged is None or staged.step != step:
+                self.send_error(
+                    404,
+                    f"no checkpoint staged for step {step}"
+                    + (f" (have {staged.step})" if staged else ""),
+                )
+                return
+            # Era fence, verified IN-CHILD: the snapshot carries the
+            # quorum era it was staged for, so even a child left behind
+            # by a quorum change answers a mismatched joiner 409 instead
+            # of bytes its /meta does not describe.
+            want_era = urllib.parse.parse_qs(split.query).get("quorum_id")
+            if (
+                want_era
+                and staged.quorum_id is not None
+                and str(staged.quorum_id) != want_era[0]
+            ):
+                metrics.inc("tpuft_heal_serve_era_rejects_total")
+                self.send_error(
+                    409,
+                    f"stale quorum era: staged {staged.quorum_id}, "
+                    f"joiner wants {want_era[0]}",
+                )
+                return
+            route = parts[2] if parts[2] in ("meta", "full") else "chunk"
+            metrics.inc("tpuft_heal_serve_requests_total", route=route)
+            if route == "meta":
+                body = staged.meta_bytes
+                self.send_response(200)
+                self.send_header("Content-Type", "application/octet-stream")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                metrics.inc("tpuft_heal_serve_bytes_total", len(body))
+                return
+            if route == "full":
+                total = sum(8 + size for size in staged.sizes)
+                self.send_response(200)
+                self.send_header("Content-Type", "application/octet-stream")
+                self.send_header("Content-Length", str(total))
+                self.end_headers()
+                out = self.wfile
+                if netem.enabled():
+                    netem.pace_latency()
+                    out = netem.PacingWriter(out)
+                out = maybe_pace_serve(out)
+                try:
+                    for name, size in zip(staged.files, staged.sizes):
+                        out.write(size.to_bytes(8, "big"))
+                        _child_stream_file(staged.dir / name, out)
+                    metrics.inc("tpuft_heal_serve_bytes_total", total)
+                except (ConnectionError, TimeoutError, OSError):
+                    self.close_connection = True
+                return
+            try:
+                index = int(parts[2])
+                name, size = staged.files[index], staged.sizes[index]
+            except (ValueError, IndexError):
+                self.send_error(400, "bad chunk index")
+                return
+            # Chaos seams. kill_serve_child serves this chunk COMPLETELY
+            # and then dies (flush + immediate exit): the drill gets at
+            # least one verified chunk in the joiner's resume cache while
+            # concurrent streams are cut mid-flight — the donor process
+            # observes the death only through its watcher's report_error.
+            die_after = (
+                faultinject.consume("serve_child") == "kill_serve_child"
+            )
+            fault = faultinject.consume("heal_stream")
+            self.send_response(200)
+            self.send_header("Content-Type", "application/octet-stream")
+            self.send_header("Content-Length", str(size))
+            self.end_headers()
+            out = self.wfile
+            if netem.enabled():
+                netem.pace_latency()
+                out = netem.PacingWriter(out)
+            out = maybe_pace_serve(out)
+            if fault == "corrupt_stream":
+                out = _CorruptingWriter(out, size - 1)
+            elif fault == "stall_donor":
+                out = _DripWriter(out)
+            elif fault == "truncate":
+                out = _TruncatingWriter(out, size // 2)
+                self.close_connection = True
+            try:
+                sent = _child_stream_file(staged.dir / name, out)
+                metrics.inc("tpuft_heal_serve_bytes_total", sent)
+            except (ConnectionError, TimeoutError, OSError):
+                self.close_connection = True
+                return
+            if die_after:
+                try:
+                    self.wfile.flush()
+                except OSError:
+                    pass
+                os._exit(3)
+
+    class DualStackServer(ThreadingHTTPServer):
+        address_family = socket.AF_INET6
+        daemon_threads = True
+
+        def handle_error(self, request: Any, client_address: Any) -> None:
+            # Joiners being fenced / failing over close connections mid
+            # stream; that is routine, not stderr-traceback-worthy.
+            pass
+
+    server = DualStackServer(("::", 0), Handler)
+    server_thread = threading.Thread(
+        target=server.serve_forever, daemon=True, name="tpuft-serve-child-http"
+    )
+    server_thread.start()
+    sys.stdout.write(
+        json.dumps(
+            {"event": "ready", "port": server.server_address[1], "pid": os.getpid()}
+        )
+        + "\n"
+    )
+    sys.stdout.flush()
+
+    def _emit(event: Dict[str, Any]) -> None:
+        try:
+            sys.stdout.write(json.dumps(event) + "\n")
+            sys.stdout.flush()
+        except OSError:
+            pass
+
+    # Control loop on the MAIN thread; stdin EOF (donor died, even by
+    # SIGKILL) is the orphan guard: clean up the snapshot and exit.
+    try:
+        for line in sys.stdin:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                cmd = json.loads(line)
+            except json.JSONDecodeError:
+                logging.warning("bad control line: %r", line[:200])
+                continue
+            op = cmd.get("cmd")
+            if op == "stage":
+                staged = _FileStaged(cmd)
+                with cond:
+                    old, state["staged"] = state["staged"], staged
+                    cond.notify_all()
+                if old is not None:
+                    old.delete()
+                _emit({"event": "staged", "step": staged.step, "epoch": staged.epoch})
+            elif op == "disallow":
+                with cond:
+                    old, state["staged"] = state["staged"], None
+                    cond.notify_all()
+                if old is not None:
+                    old.delete()
+                _emit({"event": "disallowed"})
+            elif op == "shutdown":
+                break
+            else:
+                logging.warning("unknown control cmd: %r", op)
+    finally:
+        with cond:
+            old, state["staged"] = state["staged"], None
+            state["closing"] = True
+            cond.notify_all()
+        if old is not None:
+            old.delete()
+        server.shutdown()
+        server.server_close()
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# Donor-side handle.
+# ---------------------------------------------------------------------------
+
+
+class ServeChild:
+    """Owns the serving child's lifecycle from the donor process.
+
+    Not thread-safe for concurrent stage() calls (the manager stages from
+    its single quorum thread); disallow()/shutdown()/the watcher may run
+    from other threads and take the control lock.
+    """
+
+    def __init__(
+        self,
+        timeout: float = 60.0,
+        on_error: Optional[Callable[[Exception], None]] = None,
+        root_dir: Optional[str] = None,
+        nice: Optional[int] = None,
+        max_restarts: Optional[int] = None,
+        ready_timeout: float = 20.0,
+    ) -> None:
+        self._timeout = timeout
+        self._on_error = on_error
+        self._nice = (
+            nice
+            if nice is not None
+            else int(os.environ.get(ENV_SERVE_NICE, "10") or 0)
+        )
+        self._max_restarts = (
+            max_restarts
+            if max_restarts is not None
+            else int(os.environ.get(ENV_SERVE_MAX_RESTARTS, "5") or 0)
+        )
+        self._ready_timeout = ready_timeout
+        self._root = Path(
+            tempfile.mkdtemp(prefix="tpuft-serve-", dir=root_dir or serve_dir_root())
+        )
+        self._lock = threading.Lock()
+        self._ready = threading.Event()
+        self._proc: Optional[subprocess.Popen] = None
+        self._port: Optional[int] = None
+        self._epoch = 0
+        self._staged_epoch: Optional[int] = None
+        self._closing = False
+        self._restarts = 0
+        self.crashes = 0
+        try:
+            self._spawn()
+        except Exception:
+            shutil.rmtree(self._root, ignore_errors=True)
+            raise
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _spawn(self) -> None:
+        self._ready.clear()
+        proc = subprocess.Popen(
+            [
+                sys.executable,
+                str(Path(__file__).resolve()),
+                "--timeout",
+                str(self._timeout),
+                "--nice",
+                str(self._nice),
+            ],
+            stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE,
+            stderr=None,  # child logs ride the donor's stderr
+            text=True,
+        )
+        self._proc = proc
+        watcher = threading.Thread(
+            target=self._watch, args=(proc,), daemon=True, name="tpuft-serve-watch"
+        )
+        watcher.start()
+        if not self._ready.wait(self._ready_timeout):
+            proc.kill()
+            raise ServeChildUnavailable(
+                f"serving child not ready within {self._ready_timeout}s"
+            )
+        metrics.set_gauge("tpuft_heal_serve_child_up", 1)
+
+    def _watch(self, proc: subprocess.Popen) -> None:
+        try:
+            assert proc.stdout is not None
+            for line in proc.stdout:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    event = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if event.get("event") == "ready":
+                    self._port = int(event["port"])
+                    self._ready.set()
+            rc = proc.wait()
+            with self._lock:
+                if self._closing or proc is not self._proc:
+                    return
+                self._staged_epoch = None
+            self.crashes += 1
+            metrics.inc("tpuft_heal_serve_child_crashes_total")
+            metrics.set_gauge("tpuft_heal_serve_child_up", 0)
+            crash = ServeChildCrashed(
+                f"heal-serving child exited rc={rc} with a heal window "
+                f"possibly open; joiners fail over via the resume cache"
+            )
+            cb = self._on_error
+            if cb is not None:
+                cb(crash)
+            else:
+                logger.warning("%s", crash)
+            if self._restarts < self._max_restarts:
+                self._restarts += 1
+                metrics.inc("tpuft_heal_serve_child_restarts_total")
+                self._spawn()
+        except Exception as e:  # noqa: BLE001 — watcher must not die silently
+            logger.exception(f"serve-child watcher failed: {e}")
+
+    def alive(self) -> bool:
+        proc = self._proc
+        return (
+            proc is not None
+            and proc.poll() is None
+            and self._ready.is_set()
+            and not self._closing
+        )
+
+    def address(self) -> str:
+        return f"http://{socket.gethostname()}:{self._port}"
+
+    @property
+    def port(self) -> Optional[int]:
+        return self._port
+
+    def shutdown(self, wait: bool = True) -> None:
+        with self._lock:
+            self._closing = True
+            proc = self._proc
+        if proc is not None and proc.poll() is None:
+            try:
+                self._send({"cmd": "shutdown"})
+                assert proc.stdin is not None
+                proc.stdin.close()
+            except OSError:
+                pass
+            try:
+                proc.wait(timeout=5 if wait else 0.5)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        metrics.set_gauge("tpuft_heal_serve_child_up", 0)
+        shutil.rmtree(self._root, ignore_errors=True)
+
+    # -- control -----------------------------------------------------------
+
+    def _send(self, cmd: Dict[str, Any]) -> None:
+        proc = self._proc
+        if proc is None or proc.stdin is None:
+            raise ServeChildUnavailable("no serving child process")
+        with self._lock:
+            proc.stdin.write(json.dumps(cmd) + "\n")
+            proc.stdin.flush()
+
+    def new_epoch_dir(self) -> Tuple[int, Path]:
+        """Fresh directory for the next snapshot's chunk files."""
+        self._epoch += 1
+        path = self._root / f"epoch-{self._epoch:06d}"
+        path.mkdir(parents=True, exist_ok=True)
+        return self._epoch, path
+
+    def stage(
+        self,
+        step: int,
+        quorum_id: Optional[int],
+        epoch: int,
+        epoch_dir: Path,
+        files: List[str],
+        sizes: List[int],
+        meta_bytes: bytes,
+    ) -> None:
+        """Hands the snapshot to the child (which owns — and eventually
+        deletes — the epoch directory from here on)."""
+        if not self.alive():
+            raise ServeChildUnavailable("serving child is not alive")
+        try:
+            self._send(
+                {
+                    "cmd": "stage",
+                    "epoch": epoch,
+                    "step": step,
+                    "quorum_id": quorum_id,
+                    "dir": str(epoch_dir),
+                    "files": files,
+                    "sizes": sizes,
+                    "meta_b64": base64.b64encode(meta_bytes).decode(),
+                }
+            )
+        except OSError as e:
+            raise ServeChildUnavailable(f"serving child pipe broken: {e}") from e
+        self._staged_epoch = epoch
+
+    def disallow(self) -> None:
+        if self._staged_epoch is None:
+            return
+        self._staged_epoch = None
+        try:
+            self._send({"cmd": "disallow"})
+        except (OSError, ServeChildUnavailable):
+            pass  # child death is the watcher's to report
+
+    def fetch_metrics_snapshot(self, timeout: float = 1.0) -> Optional[Dict[str, Any]]:
+        """The child's /metrics.json snapshot (merged into the donor's
+        scrape), or None when unreachable."""
+        if not self.alive():
+            return None
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(
+                f"http://localhost:{self._port}/metrics.json", timeout=timeout
+            ) as resp:
+                return json.loads(resp.read().decode())
+        except Exception:  # noqa: BLE001 — scrape merge is best-effort
+            return None
+
+
+if __name__ == "__main__":
+    sys.exit(_child_main())

@@ -3,7 +3,7 @@
 from __future__ import annotations
 
 from abc import ABC, abstractmethod
-from typing import Generic, List, Optional, TypeVar
+from typing import Callable, Generic, List, Optional, TypeVar
 
 T = TypeVar("T")
 
@@ -51,6 +51,12 @@ class CheckpointTransport(ABC, Generic[T]):
 
     def disallow_checkpoint(self) -> None:
         """Stops serving the staged checkpoint (called at commit)."""
+
+    def register_error_callback(self, cb: Callable[[Exception], None]) -> None:
+        """Funnel for asynchronous serving-plane failures (e.g. a
+        heal-serving sidecar crash). The manager registers
+        ``report_error`` here; transports without background serving
+        machinery have nothing to report and keep this default no-op."""
 
     def shutdown(self, wait: bool = True) -> None:
         """Tears the transport down."""

@@ -37,6 +37,11 @@ KNOWN_ENV = {
     # attempts, and the punisher's stream-fault arming channel.
     "TPUFT_HEAL_MIN_BYTES_PER_SEC", "TPUFT_HEAL_MAX_ATTEMPTS",
     "TPUFT_FAULT_FILE",
+    # Donor sidecar (out-of-process heal serving, checkpointing/
+    # serve_child.py): mode switch, snapshot dir (shared-memory tmpfs),
+    # child niceness, egress bound, respawn budget.
+    "TPUFT_HEAL_SERVE_MODE", "TPUFT_HEAL_SERVE_DIR", "TPUFT_HEAL_SERVE_NICE",
+    "TPUFT_HEAL_SERVE_GBPS", "TPUFT_HEAL_SERVE_MAX_RESTARTS",
     "TPUFT_METRICS_PORT", "TPUFT_METRICS_PUSH_SEC",
     "TPUFT_BENCH_CHILD",
     "TPUFT_BENCH_MODEL", "TPUFT_BENCH_STEPS", "TPUFT_BENCH_BATCH",
@@ -54,6 +59,7 @@ KNOWN_ENV = {
     "TPUFT_REGEN_FIXTURES", "TPUFT_SENTINEL_INTERVAL",
     "TPUFT_TRANSPORT_BENCH_GB", "TPUFT_TRANSPORT_BENCH_MODE",
     "TPUFT_TRANSPORT_BENCH_DEADLINE", "TPUFT_TRANSPORT_RSS_BOUND",
+    "TPUFT_TRANSPORT_BENCH_PACE_GBPS",
     "TPUFT_CPS_REPLICAS", "TPUFT_CPS_ROUNDS", "TPUFT_CPS_GROUP_WORLD_SIZE",
 }
 
@@ -191,6 +197,46 @@ def _check_metrics() -> Tuple[str, str]:
     return "PASS", f"/metrics on :{port} serving {n_series} series"
 
 
+def _check_heal_serve() -> Tuple[str, str]:
+    """Heal-serving sidecar preflight: validates the mode switch and
+    probes the shared-memory snapshot directory (a write + unlink).
+    WARN, never FAIL: inline serving always remains as the fallback, so
+    a missing tmpfs must not block a launch."""
+    import tempfile
+
+    from torchft_tpu.checkpointing import serve_child
+
+    mode = os.environ.get(serve_child.ENV_SERVE_MODE, "inline")
+    if mode not in ("inline", "child"):
+        return (
+            "WARN",
+            f"{serve_child.ENV_SERVE_MODE}={mode!r} is not inline|child "
+            "(transports will refuse it; unset or fix)",
+        )
+    root = serve_child.serve_dir_root()
+    shm = "shared-memory tmpfs" if root.startswith("/dev/shm") else "plain dir"
+    try:
+        with tempfile.NamedTemporaryFile(dir=root, prefix="tpuft-doctor-"):
+            pass
+        import shutil
+
+        free_gb = shutil.disk_usage(root).free / (1 << 30)
+        detail = (
+            f"serve mode {mode}; snapshot dir {root} ({shm}) writable, "
+            f"{free_gb:.1f} GB free"
+        )
+        if mode == "child" and free_gb < 1.0:
+            return "WARN", detail + " — low for a checkpoint snapshot"
+        return "PASS", detail
+    except OSError as e:
+        status = "WARN" if mode == "child" else "PASS"
+        return (
+            status,
+            f"serve mode {mode}; snapshot dir {root} not writable ({e}) — "
+            "child mode would degrade to inline serving",
+        )
+
+
 def _check_env() -> Tuple[str, str]:
     # Value validation first — a fatal misconfig must FAIL even when a
     # typo'd var would also WARN.
@@ -214,6 +260,7 @@ def run_checks(lighthouse: str, skip_device: bool = False) -> int:
         ("wire codecs", _check_kernels),
         ("env vars", _check_env),
         ("metrics", _check_metrics),
+        ("heal serving", _check_heal_serve),
         ("lighthouse", lambda: _check_lighthouse(lighthouse)),
     ]
     if not skip_device:

@@ -297,6 +297,11 @@ class Manager:
             if checkpoint_transport is not None
             else HTTPTransport(timeout=self._timeout)
         )
+        # Serving-plane failures (e.g. a heal-serve sidecar crash,
+        # TPUFT_HEAL_SERVE_MODE=child) funnel into report_error: the step
+        # does not commit and the supervisor-visible error log carries the
+        # crash — the train loop itself never observes it.
+        self._checkpoint_transport.register_error_callback(self.report_error)
 
         # State-dict function registry under a readers-writer lock: readers
         # are checkpoint serves, the writer is the optimizer step
@@ -853,6 +858,11 @@ class Manager:
 
         if allow_heal:
             if quorum.recover_dst_replica_ranks:
+                # Ordering note: on a membership change the quorum-change
+                # drain hooks above already ran (pipelined speculative
+                # state resolved) BEFORE this donor send — so in child
+                # serve mode the sidecar's restaged snapshot can never
+                # contain uncommitted state either.
                 try:
                     self._logger.info(
                         f"peers need recovery from us {quorum.recover_dst_replica_ranks}"

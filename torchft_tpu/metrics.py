@@ -36,7 +36,7 @@ import os
 import threading
 import time
 from contextlib import contextmanager
-from typing import Any, Dict, Generator, Iterable, List, Optional, Tuple
+from typing import Any, Dict, Generator, Iterable, List, Optional, Set, Tuple
 
 __all__ = [
     "Counter",
@@ -56,6 +56,7 @@ __all__ = [
     "observe",
     "timer",
     "snapshot",
+    "snapshot_to_prometheus",
     "prometheus_text",
     "counter_total",
     "gauge_value",
@@ -243,6 +244,12 @@ class Registry:
 
     # -- reads -------------------------------------------------------------
 
+    def metric_names(self) -> Set[str]:
+        """Names currently registered (used to avoid duplicate TYPE lines
+        when a merged child snapshot shares a name with this registry)."""
+        with self._lock:
+            return {name for name, _ in self._metrics}
+
     def _items(self) -> List[Tuple[str, LabelItems, str, Any]]:
         with self._lock:
             return [
@@ -369,6 +376,52 @@ def snapshot() -> Dict[str, Any]:
     return REGISTRY.snapshot()
 
 
+def snapshot_to_prometheus(
+    snap: Dict[str, Any],
+    extra_labels: Optional[Dict[str, str]] = None,
+    skip_type_names: Iterable[str] = (),
+) -> str:
+    """Renders a :func:`snapshot`-shaped dict (possibly from ANOTHER
+    process, e.g. the heal-serving child's scraped registry) into the
+    Prometheus exposition format, adding ``extra_labels`` to every series
+    so merged foreign series stay distinguishable. Names in
+    ``skip_type_names`` suppress the ``# TYPE`` line (already emitted by
+    the local registry). Best-effort on malformed input: bad entries are
+    skipped, never raised."""
+    extra = tuple(sorted((extra_labels or {}).items()))
+    skip = set(skip_type_names)
+    kind_of = {"counters": "counter", "gauges": "gauge", "histograms": "histogram"}
+    lines: List[str] = []
+    seen_type: set = set()
+    for section, kind in kind_of.items():
+        for name, entries in sorted((snap.get(section) or {}).items()):
+            for entry in entries:
+                try:
+                    items = _label_items({**entry.get("labels", {}), **dict(extra)})
+                    if name not in seen_type and name not in skip:
+                        seen_type.add(name)
+                        lines.append(f"# TYPE {name} {kind}")
+                    if kind == "histogram":
+                        for le, count in entry.get("buckets", {}).items():
+                            bucket_items = items + (("le", str(le)),)
+                            lines.append(
+                                f"{name}_bucket{_label_str(bucket_items)} {count}"
+                            )
+                        lines.append(
+                            f"{name}_sum{_label_str(items)} {_fmt(entry['sum'])}"
+                        )
+                        lines.append(
+                            f"{name}_count{_label_str(items)} {entry['count']}"
+                        )
+                    else:
+                        lines.append(
+                            f"{name}{_label_str(items)} {_fmt(entry['value'])}"
+                        )
+                except (KeyError, TypeError, ValueError):
+                    continue
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
 def prometheus_text() -> str:
     return REGISTRY.prometheus_text()
 
@@ -388,20 +441,41 @@ def histogram_stats(name: str, **label_filter: Any) -> Dict[str, Any]:
 # -- HTTP exposition --------------------------------------------------------
 
 
-def _serve_metrics_http(handler: Any, registry: Registry, path: str) -> bool:
+def _serve_metrics_http(
+    handler: Any,
+    registry: Registry,
+    path: str,
+    extra_text: Optional[Any] = None,
+    extra_json: Optional[Any] = None,
+) -> bool:
     """Shared route logic for any BaseHTTPRequestHandler: serves
     ``/metrics`` (Prometheus text) and ``/metrics.json`` (snapshot);
     returns False when the path is not a metrics route. Reused by the
     checkpoint transport's server so every replica already listening for
-    heals answers scrapes on the same port."""
+    heals answers scrapes on the same port. ``extra_text``/``extra_json``
+    (callables) let a caller merge foreign series — e.g. the donor merges
+    its heal-serving child's scraped registry; both are best-effort and
+    never fail the scrape."""
     route = path.split("?", 1)[0].rstrip("/")
     if route == "/metrics":
-        body = registry.prometheus_text().encode()
+        body_text = registry.prometheus_text()
+        if extra_text is not None:
+            try:
+                body_text += extra_text() or ""
+            except Exception:  # noqa: BLE001 — merge is best-effort
+                pass
+        body = body_text.encode()
         content_type = "text/plain; version=0.0.4; charset=utf-8"
     elif route == "/metrics.json":
-        body = json.dumps(
-            {"ts": time.time(), "metrics": registry.snapshot()}
-        ).encode()
+        payload = {"ts": time.time(), "metrics": registry.snapshot()}
+        if extra_json is not None:
+            try:
+                extra = extra_json()
+                if extra:
+                    payload.update(extra)
+            except Exception:  # noqa: BLE001 — merge is best-effort
+                pass
+        body = json.dumps(payload).encode()
         content_type = "application/json"
     else:
         return False

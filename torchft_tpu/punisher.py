@@ -18,6 +18,11 @@ Heal-path modes target the recovery plane itself:
   the arm and flips a payload bit / drips below the joiner's
   minimum-progress floor. Exactly one serve consumes each arm, so
   injected-fault counts stay exact.
+- ``kill_serve_child``: armed the same way at the ``serve_child`` site;
+  the donor's heal-serving sidecar (``TPUFT_HEAL_SERVE_MODE=child``)
+  consumes it at its next chunk serve, finishes that chunk, and dies —
+  the joiner must fail over via the resume cache and the donor's step
+  loop must observe nothing but a ``report_error``.
 
     python -m torchft_tpu.punisher --lighthouse host:29510 kill_one
     python -m torchft_tpu.punisher --lighthouse host:29510 fault_one --mode deadlock
@@ -59,8 +64,13 @@ def _members(client: LighthouseClient):
 # Modes the native manager's kill RPC executes in-process.
 FAULT_MODES = ("exit", "segfault", "deadlock", "partition")
 # Heal-plane modes delivered outside the kill RPC (status-targeted kill /
-# file-armed stream faults).
-HEAL_FAULT_MODES = ("kill_donor_mid_heal", "corrupt_stream", "stall_donor")
+# file-armed stream faults / the serve-sidecar kill).
+HEAL_FAULT_MODES = (
+    "kill_donor_mid_heal",
+    "corrupt_stream",
+    "stall_donor",
+    "kill_serve_child",
+)
 ALL_FAULT_MODES = FAULT_MODES + HEAL_FAULT_MODES
 
 
@@ -107,14 +117,17 @@ def kill_donor_mid_heal(client: LighthouseClient, rng: random.Random) -> bool:
 
 
 def arm_stream_fault(mode: str, fault_file: Optional[str] = None) -> bool:
-    """Arms a donor-stream fault (``corrupt_stream``/``stall_donor``) via
-    the fault file; the next donor chunk-serve consumes it."""
+    """Arms a donor-serve fault via the fault file: stream faults
+    (``corrupt_stream``/``stall_donor``) are consumed by the next donor
+    chunk-serve in EITHER serve mode; ``kill_serve_child`` is consumed
+    only by a serving sidecar (site ``serve_child``) and kills it."""
+    site = "serve_child" if mode == "kill_serve_child" else "heal_stream"
     try:
-        path = faultinject.arm(mode, path=fault_file, site="heal_stream")
+        path = faultinject.arm(mode, path=fault_file, site=site)
     except ValueError as e:
         print(f"[punisher] cannot arm {mode}: {e}")
         return False
-    print(f"[punisher] armed {mode} at {path}")
+    print(f"[punisher] armed {mode} at {path} (site {site})")
     return True
 
 
@@ -130,7 +143,7 @@ def inject_fault(
         return kill_one(client, rng, mode=mode)
     if mode == "kill_donor_mid_heal":
         return kill_donor_mid_heal(client, rng)
-    if mode in ("corrupt_stream", "stall_donor"):
+    if mode in ("corrupt_stream", "stall_donor", "kill_serve_child"):
         return arm_stream_fault(mode, fault_file)
     raise ValueError(f"unknown fault mode {mode!r}")
 
