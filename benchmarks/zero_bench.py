@@ -1,0 +1,217 @@
+"""ZeRO plane bench: per-replica optimizer-state bytes and heal-payload
+bytes at N ∈ {1, 2, 4}, on the 27M-param CPU bench config.
+
+Usage::
+
+    python benchmarks/zero_bench.py          # -> ZERO_BENCH.json (repo root)
+    TPUFT_ZERO_BENCH_ELEMS=100000 python benchmarks/zero_bench.py  # quick
+
+No training steps and no coordination plane: the bench measures the
+*state geometry* — what each replica persists (f32 masters + adam
+moments for its owned shards) and what the heal plane moves (the staged
+checkpoint's chunk sizes through the REAL part-aware HTTPTransport
+staging path, plus one live skip-parts fetch to validate the wire
+numbers). Shapes come from bench.py's representative 27M config; set
+``TPUFT_ZERO_BENCH_ELEMS`` to bench a synthetic tree of that many
+elements instead (fast smoke). Runtime well under the default-workload
+trap documented in CLAUDE.md — nothing here steps the model.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+from torchft_tpu import metrics  # noqa: E402
+from torchft_tpu.checkpointing.http_transport import HTTPTransport  # noqa: E402
+from torchft_tpu.zero import (  # noqa: E402
+    DEFAULT_NUM_SHARDS,
+    ShardSpec,
+    shard_assignment,
+    shard_part_name,
+)
+
+OUT = Path(__file__).resolve().parent.parent / "ZERO_BENCH.json"
+
+
+def _bench_params():
+    elems = os.environ.get("TPUFT_ZERO_BENCH_ELEMS")
+    if elems:
+        n = int(elems)
+        # Synthetic stand-in with the same dtype story (bf16 model params).
+        return {
+            "w0": jnp.ones((n // 2,), jnp.bfloat16),
+            "w1": jnp.ones((n - n // 2,), jnp.bfloat16),
+        }, f"synthetic-{n}"
+    try:
+        from torchft_tpu.models.llama import Llama, LlamaConfig
+
+        seq = 512
+        config = LlamaConfig(
+            vocab_size=8192, dim=512, n_layers=6, n_heads=8, n_kv_heads=4,
+            ffn_hidden=1536, max_seq_len=seq, dtype=jnp.bfloat16,
+        )
+        model = Llama(config)
+        tokens = jnp.zeros((2, seq), dtype=jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), tokens)
+        return params, "llama-27M (bench.py cpu-full config)"
+    except Exception as e:  # noqa: BLE001 — e.g. jax too old for the model
+        # Same leaf geometry as the 27M config, built without the model
+        # (this container's jax 0.4.37 lacks APIs the model needs). The
+        # flat-plane byte math is shape-exact either way.
+        vocab, dim, layers, ffn, kv_dim = 8192, 512, 6, 1536, 256
+        tree = {"embed": jnp.zeros((vocab, dim), jnp.bfloat16),
+                "output": jnp.zeros((dim, vocab), jnp.bfloat16),
+                "final_norm": jnp.zeros((dim,), jnp.bfloat16)}
+        for i in range(layers):
+            tree[f"layer_{i}"] = {
+                "wq": jnp.zeros((dim, dim), jnp.bfloat16),
+                "wk": jnp.zeros((dim, kv_dim), jnp.bfloat16),
+                "wv": jnp.zeros((dim, kv_dim), jnp.bfloat16),
+                "wo": jnp.zeros((dim, dim), jnp.bfloat16),
+                "w1": jnp.zeros((dim, ffn), jnp.bfloat16),
+                "w2": jnp.zeros((ffn, dim), jnp.bfloat16),
+                "w3": jnp.zeros((dim, ffn), jnp.bfloat16),
+                "attn_norm": jnp.zeros((dim,), jnp.bfloat16),
+                "ffn_norm": jnp.zeros((dim,), jnp.bfloat16),
+            }
+        return tree, f"llama-27M shapes (model init unavailable: {e})"
+
+
+def _tree_bytes(tree) -> int:
+    return sum(int(np.asarray(x).nbytes) for x in jax.tree_util.tree_leaves(tree))
+
+
+def main() -> None:
+    t0 = time.time()
+    params, config_name = _bench_params()
+    n_params = sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
+    params_bytes = _tree_bytes(params)
+    tx = optax.adam(1e-3)
+    num_shards = int(os.environ.get("TPUFT_ZERO_SHARDS", str(DEFAULT_NUM_SHARDS)))
+    spec = ShardSpec(params, num_shards)
+    flat = np.asarray(spec.pack(params), dtype=np.float32)
+
+    # One shard's persisted state (all shards are equal ranges): the f32
+    # master plus adam's mu/nu moments for that range.
+    shard_opt = tx.init(jnp.zeros((spec.shard_len,), jnp.float32))
+    per_shard_bytes = spec.shard_len * 4 + _tree_bytes(shard_opt)
+
+    # The unsharded baseline every replica pays today: full-tree moments
+    # (adam on the model dtype tree).
+    baseline_opt_bytes = _tree_bytes(tx.init(params))
+
+    results = {}
+    for n in (1, 2, 4):
+        owners = shard_assignment(num_shards, n)
+        owned = [s for s in range(num_shards) if owners[s] == 0]
+        opt_bytes = len(owned) * per_shard_bytes
+
+        # Stage rank 0's checkpoint through the real part-aware transport
+        # and read the chunk geometry: what a full fetch vs a
+        # skip-all-shards fetch moves.
+        shards = {}
+        for s in range(num_shards):
+            if s in owned:
+                start, stop = spec.shard_range(s)
+                shards[shard_part_name(s)] = {
+                    "step": 0,
+                    "master": flat[start:stop],
+                    "opt": shard_opt,
+                }
+            else:
+                shards[shard_part_name(s)] = None
+        state_dict = {
+            "user": {
+                "zero": {
+                    "params": params,
+                    "zero": {"num_shards": num_shards, "step": 0},
+                    "shards": shards,
+                }
+            },
+            "tpuft": {"step": 0, "batches_committed": 0},
+        }
+        transport = HTTPTransport(timeout=30.0)
+        try:
+            transport.send_checkpoint(
+                [1], step=0, state_dict=state_dict, timeout=30.0
+            )
+            staged = transport._staged
+            full_bytes = sum(c.total_size for c in staged.chunks)
+            shard_part_bytes = sum(
+                info["nbytes"] for info in staged.parts.values()
+            )
+            joiner_fetch_bytes = full_bytes - shard_part_bytes
+
+            # Validate on the wire once per N: a live skip-parts fetch
+            # must move exactly joiner_fetch_bytes of chunk payload.
+            saved_before = metrics.counter_total(
+                "tpuft_zero_heal_bytes_saved_total"
+            )
+            fetcher = HTTPTransport(timeout=30.0)
+            try:
+                fetcher.recv_checkpoint(
+                    0,
+                    transport.metadata(),
+                    0,
+                    30.0,
+                    skip_parts=set(staged.parts),
+                )
+            finally:
+                fetcher.shutdown()
+            saved = (
+                metrics.counter_total("tpuft_zero_heal_bytes_saved_total")
+                - saved_before
+            )
+        finally:
+            transport.shutdown()
+
+        results[str(n)] = {
+            "owned_shards": len(owned),
+            "per_replica_opt_state_bytes": opt_bytes,
+            "opt_state_vs_n1": round(
+                opt_bytes / (num_shards * per_shard_bytes), 4
+            ),
+            "donor_checkpoint_bytes": full_bytes,
+            "shard_part_bytes": shard_part_bytes,
+            "joiner_fetch_bytes_skip_parts": joiner_fetch_bytes,
+            "heal_bytes_saved_measured": int(saved),
+        }
+
+    out = {
+        "bench": "zero_bench",
+        "config": config_name,
+        "n_params": n_params,
+        "num_shards": num_shards,
+        "params_bytes": params_bytes,
+        "per_shard_state_bytes": per_shard_bytes,
+        "baseline_unsharded_opt_state_bytes": baseline_opt_bytes,
+        "per_n": results,
+        "wall_time_s": round(time.time() - t0, 2),
+        "notes": (
+            "per_replica_opt_state_bytes = f32 masters + adam moments for "
+            "owned shards (scales ~1/N); donor_checkpoint_bytes = staged "
+            "heal payload (params + the donor's 1/N of opt state); "
+            "joiner_fetch_bytes_skip_parts = what a skip-all-shards joiner "
+            "actually moves (shards re-balance from survivors over the PG)"
+        ),
+    }
+    OUT.write_text(json.dumps(out, indent=2) + "\n")
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -71,6 +71,18 @@ def _gauge(snapshot: Dict[str, Any], name: str) -> Optional[float]:
     return entries[-1].get("value")
 
 
+def _shard_state(snapshot: Dict[str, Any]) -> Optional[str]:
+    """ZeRO ownership from the pushed gauges: "owned/num_shards" (e.g.
+    "2/8"), or None when the replica doesn't run the ZeRO plane. A
+    replica showing 0 owned shards while peers own some is either healing
+    (re-balance pending) or a spare."""
+    num = _gauge(snapshot, "tpuft_zero_num_shards")
+    if num is None:
+        return None
+    owned = _gauge(snapshot, "tpuft_zero_owned_shards")
+    return f"{int(owned) if owned is not None else 0}/{int(num)}"
+
+
 def _serve_state(snapshot: Dict[str, Any]) -> Optional[str]:
     """Heal-serving state from the pushed gauges: which serve mode the
     replica runs and, in child mode, whether its sidecar is alive
@@ -123,6 +135,7 @@ def collect(lighthouse_addr: str, prev: Optional[Dict[str, Any]] = None) -> Dict
                     ),
                     heals=_counter_total(snap, "tpuft_heals_total"),
                     serve=_serve_state(snap),
+                    shard=_shard_state(snap),
                     push_age_s=round(now - snap["ts"], 1) if "ts" in snap else None,
                     last_commit_age_s=(
                         round(now - last_commit, 1) if last_commit else None
@@ -161,6 +174,7 @@ _COLUMNS = (
     ("commit_failures", "FAILED"),
     ("heals", "HEALS"),
     ("serve", "SERVE"),
+    ("shard", "SHARD"),
     ("last_commit_age_s", "LAST COMMIT"),
     ("healing", "HEALING"),
     ("heartbeat_age_ms", "HB AGE MS"),

@@ -1,0 +1,21 @@
+"""R5 clean twin for the ZeRO shard plane: shard ownership is plain
+python range bookkeeping over a flat buffer — the replica axis never
+appears in any Mesh, so membership changes recompile nothing. A Mesh may
+still exist for INTRA-slice axes alongside the shard math."""
+
+import numpy as np
+from jax.sharding import Mesh
+
+
+def shard_ranges(total, num_shards):
+    bounds = np.linspace(0, total, num_shards + 1, dtype=np.int64)
+    return [(int(bounds[i]), int(bounds[i + 1])) for i in range(num_shards)]
+
+
+def shard_owners(num_shards, num_participants):
+    return np.arange(num_shards) % num_participants
+
+
+def build_intra_slice_mesh(device_grid):
+    # Fine: fsdp/tp are intra-slice axes; the replica axis stays virtual.
+    return Mesh(device_grid, ("fsdp", "tp"))

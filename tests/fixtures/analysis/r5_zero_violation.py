@@ -1,0 +1,23 @@
+"""R5 fixture: shard-spec code leaking the replica axis into a jax Mesh.
+The tempting-but-wrong way to build a ZeRO plane — putting the replica
+dimension in the Mesh makes every membership change a recompile of every
+XLA program (the exact failure the virtual shard plane exists to avoid)."""
+
+import numpy as np
+from jax.sharding import Mesh
+
+
+def shard_owners(num_shards, num_participants):
+    return np.arange(num_shards) % num_participants
+
+
+def build_zero_mesh(device_grid):
+    # VIOLATION: sharding the optimizer update over a "replica" Mesh axis
+    # recompiles on every quorum change.
+    return Mesh(device_grid, ("replica", "fsdp"))
+
+
+def shard_update_sharding(mesh):
+    # The spec plumbing downstream of the bad mesh (names here are data,
+    # not Mesh axes — only the Mesh construction above must fire).
+    return {"masters": ("replica",), "moments": ("replica",)}

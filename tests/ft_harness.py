@@ -66,6 +66,10 @@ FT_COUNTERS = (
     "resumed_bytes",
     "stalled_fetches",
     "era_rejects",
+    "zero_rebalances",
+    "zero_shards_moved",
+    "zero_shard_reinits",
+    "zero_heal_bytes_saved",
 )
 
 
@@ -117,6 +121,18 @@ def ft_counter_snapshot(replica_id: str = "") -> Dict[str, float]:
             "tpuft_heal_stalled_fetches_total"
         ),
         "era_rejects": metrics.counter_total("tpuft_heal_era_rejects_total"),
+        "zero_rebalances": metrics.counter_total(
+            "tpuft_zero_rebalance_total", **label
+        ),
+        "zero_shards_moved": metrics.counter_total(
+            "tpuft_zero_shards_moved_total", **label
+        ),
+        "zero_shard_reinits": metrics.counter_total(
+            "tpuft_zero_shard_reinits_total", **label
+        ),
+        "zero_heal_bytes_saved": metrics.counter_total(
+            "tpuft_zero_heal_bytes_saved_total"
+        ),
     }
 
 
@@ -392,6 +408,102 @@ def pipelined_ddp_train_loop(
                 failed_commits += 1
         return {
             "state_dict": {"params": opt.params, "opt_state": opt.opt_state},
+            "manager_state": manager.state_dict(),
+            "failed_commits": failed_commits,
+            "rollbacks": opt.rollback_count,
+        }
+    finally:
+        try:
+            opt.flush_pipeline(raise_on_error=False)
+        except Exception:
+            pass
+        manager.shutdown(wait=False)
+        pg.shutdown()
+
+
+def zero_ddp_train_loop(
+    runner: Runner,
+    rank: int,
+    store_client: StoreClient,
+    store_addr: str,
+    min_replica_size: int = 1,
+    num_shards: int = 4,
+    pipelined: bool = False,
+) -> Dict[str, Any]:
+    """The DDP loop with the ZeRO plane (torchft_tpu.zero.ZeroOptimizer):
+    reduce-scattered grads, sharded update, allgathered params. Returns
+    ``{"state_dict", "history", "held_shards", ...}`` — the drills assert
+    bitwise-identical params across groups at every committed step and
+    that shard ownership re-balances across kill/rejoin. ``pipelined``
+    runs the same loop under ``commit_pipeline_depth=1`` (batches keyed
+    on ``opt.next_pipelined_step()``, see pipelined_ddp_train_loop)."""
+    from torchft_tpu.zero import ZeroOptimizer
+
+    pg = FakeProcessGroupWrapper(ProcessGroupTCP(timeout=10.0))
+    manager = Manager(
+        pg=pg,
+        min_replica_size=min_replica_size,
+        store=store_client,
+        store_addr=store_addr,
+        use_async_quorum=runner.use_async_quorum,
+        group_rank=rank,
+        group_world_size=runner.world_size,
+        lighthouse_addr=runner.lighthouse_addr,
+        replica_id=f"zero_{runner.replica_group}",
+        heartbeat_interval=0.05,
+        timeout=10.0,
+        quorum_timeout=20.0,
+        commit_pipeline_depth=1 if pipelined else 0,
+        **runner.manager_args,
+    )
+    opt = ZeroOptimizer(
+        manager, optax.adam(0.05), _init_model_params(), num_shards=num_shards
+    )
+
+    history: Dict[int, Any] = {}
+    failed_commits = 0
+
+    def record() -> None:
+        history[manager.current_step()] = jax.tree_util.tree_map(
+            lambda a: np.asarray(a), opt.params
+        )
+
+    try:
+        if pipelined:
+            step_fn = opt.make_step_fn(_loss_fn)
+            while manager.current_step() < runner.num_steps:
+                while opt.next_pipelined_step() < runner.num_steps:
+                    step = opt.next_pipelined_step()
+                    if runner.injector is not None:
+                        runner.injector.check(runner.replica_group, step, pg)
+                    x, y = _batch_for(step, runner.replica_group)
+                    _, prev_committed = step_fn(x, y)
+                    if prev_committed is False:
+                        failed_commits += 1
+                if opt.flush_pipeline() is False:
+                    failed_commits += 1
+        else:
+            while manager.current_step() < runner.num_steps:
+                step = manager.current_step()
+                if runner.injector is not None:
+                    runner.injector.check(runner.replica_group, step, pg)
+                opt.begin_step()
+                manager.wait_quorum()
+                x, y = _batch_for(step, runner.replica_group)
+                # ZeroOptimizer.step takes LOCAL grads: the cross-replica
+                # reduction IS the sharded reduce-scatter inside.
+                grads = _grad_fn(opt.params, x, y)
+                if opt.step(grads):
+                    record()
+                else:
+                    failed_commits += 1
+        return {
+            "state_dict": {
+                "params": opt.params,
+                "held_shards": sorted(opt.opt_state.held),
+                "opt_bytes": opt.opt_state.owned_bytes(),
+            },
+            "history": history,
             "manager_state": manager.state_dict(),
             "failed_commits": failed_commits,
             "rollbacks": opt.rollback_count,

@@ -500,7 +500,9 @@ def test_fleet_status_render_and_extractors() -> None:
                 ]
             },
             "gauges": {
-                "tpuft_last_commit_time": [{"labels": {}, "value": 99.0}]
+                "tpuft_last_commit_time": [{"labels": {}, "value": 99.0}],
+                "tpuft_zero_num_shards": [{"labels": {}, "value": 8.0}],
+                "tpuft_zero_owned_shards": [{"labels": {}, "value": 2.0}],
             },
             "histograms": {},
         },
@@ -508,6 +510,9 @@ def test_fleet_status_render_and_extractors() -> None:
     assert fleet_status._counter_total(snap, "tpuft_commits_total") == 12.0
     assert fleet_status._counter_total(snap, "absent") is None
     assert fleet_status._gauge(snap, "tpuft_last_commit_time") == 99.0
+    # ZeRO ownership column: "owned/num_shards"; None without the plane.
+    assert fleet_status._shard_state(snap) == "2/8"
+    assert fleet_status._shard_state({"metrics": {"gauges": {}}}) is None
 
     table = {
         "ts": 100.0,
@@ -536,7 +541,8 @@ def test_fleet_status_render_and_extractors() -> None:
     assert "quorum_id=3" in lines[0] and "replicas=2" in lines[0]
     assert lines[1].split() == [
         "REPLICA", "RANK", "STEP", "STEP/S", "COMMITS", "FAILED", "HEALS",
-        "SERVE", "LAST", "COMMIT", "HEALING", "HB", "AGE", "MS", "PUSH", "AGE",
+        "SERVE", "SHARD", "LAST", "COMMIT", "HEALING", "HB", "AGE", "MS",
+        "PUSH", "AGE",
     ]
     assert "train_0:uuid" in text and "1.25" in text and "1.0s" in text
     # The dead replica renders dashes, not a crash.

@@ -109,6 +109,24 @@ def test_r5_clean_fixture() -> None:
     assert scan("r5_clean.py") == []
 
 
+def test_r5_zero_violation_fixture() -> None:
+    # Shard-spec-shaped code (the ZeRO plane, torchft_tpu/zero.py)
+    # leaking the replica axis into a Mesh: exactly ONE finding, at the
+    # Mesh construction — the downstream spec dicts naming "replica" as
+    # data are not Mesh axes and must not fire. Golden count added
+    # DELIBERATELY with the ZeRO subsystem: the new shard-plane shape is
+    # pinned, not baselined away.
+    findings = scan("r5_zero_violation.py", rules=["replica-axis-in-mesh"])
+    assert len(findings) == 1
+    assert "replica" in findings[0].message
+    assert findings[0].file.endswith("r5_zero_violation.py")
+
+
+def test_r5_zero_clean_fixture() -> None:
+    # The real plane's shape: range bookkeeping + an intra-slice Mesh.
+    assert scan("r5_zero_clean.py") == []
+
+
 def test_r6_violation_parse_level() -> None:
     # Reference snapshot absent: only the parse-level (inverted range)
     # finding fires; reference citations skip cleanly.

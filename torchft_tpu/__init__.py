@@ -15,8 +15,9 @@ Public surface (parity with the reference's ``torchft/__init__.py``)::
     )
 
 Heavier pieces import from their modules: ``torchft_tpu.local_sgd`` (LocalSGD,
-DiLoCo), ``torchft_tpu.parallel.mesh`` (FTMesh/HSDP), ``torchft_tpu.models``,
-``torchft_tpu.checkpointing``, ``torchft_tpu.ops``.
+DiLoCo), ``torchft_tpu.zero`` (ZeroOptimizer — cross-replica optimizer-state
+sharding, docs/zero.md), ``torchft_tpu.parallel.mesh`` (FTMesh/HSDP),
+``torchft_tpu.models``, ``torchft_tpu.checkpointing``, ``torchft_tpu.ops``.
 """
 
 # Honor $TPUFT_LOCK_CHECK for ANY entry point before lock-creating modules

@@ -81,7 +81,10 @@ from torchft_tpu.checkpointing.serve_child import (
     _TruncatingWriter,
     maybe_pace_serve,
 )
-from torchft_tpu.checkpointing.transport import CheckpointTransport
+from torchft_tpu.checkpointing.transport import (
+    HEAL_PART_PREFIX,
+    CheckpointTransport,
+)
 
 __all__ = [
     "HTTPTransport",
@@ -299,12 +302,17 @@ class _Staged:
         chunks: List[Any],
         treedef: Any,
         quorum_id: Optional[int] = None,
+        parts: Optional[Dict[str, int]] = None,
     ) -> None:
         self.step = step
         self.chunks = chunks  # List[_serialization.Prepared]
         self.treedef = treedef
         self.quorum_id = quorum_id
         self.crc_algo = _CRC_ALGO
+        self.parts = {
+            name: {"chunk": index, "nbytes": chunks[index].total_size}
+            for name, index in (parts or {}).items()
+        }
         self.chunk_crcs: List[int] = []
         for chunk in chunks:
             w = _CRCWriter(_CRC_UPDATERS[_CRC_ALGO])
@@ -321,6 +329,7 @@ class _Staged:
             crc_algo=self.crc_algo,
             chunk_crcs=self.chunk_crcs,
             digest=self.digest,
+            parts=self.parts,
         )
 
 
@@ -332,11 +341,14 @@ def _meta_bytes(
     crc_algo: str,
     chunk_crcs: List[int],
     digest: str,
+    parts: Optional[Dict[str, Dict[str, int]]] = None,
 ) -> bytes:
     """The exact ``/meta`` response body. Built once per stage in BOTH
     serve modes (the serving child receives these bytes pre-pickled over
     the control pipe and serves them verbatim — it never needs to
-    unpickle a treedef, so it never needs jax)."""
+    unpickle a treedef, so it never needs jax). ``parts`` maps heal-part
+    name -> {"chunk", "nbytes"} so a joiner can address (or skip) exactly
+    one part's payload."""
     return pickle.dumps(
         {
             "format": 2,
@@ -347,8 +359,52 @@ def _meta_bytes(
             "crc_algo": crc_algo,
             "chunk_crcs": chunk_crcs,
             "digest": digest,
+            "parts": parts or {},
         }
     )
+
+
+def _plan_chunks(
+    state_dict: Any, num_chunks: int
+) -> Tuple[Any, List[Dict[int, Any]], Dict[str, int]]:
+    """Splits a state dict's leaves into servable chunks, part-aware.
+
+    Leaves under a dict key starting with :data:`HEAL_PART_PREFIX` form a
+    named *part* and get their own dedicated chunk (appended after the
+    base chunks), so a joiner can address — or skip — exactly that
+    payload; everything else round-robins into ``num_chunks`` base chunks
+    exactly as before (with no part keys the layout is bit-identical to
+    the pre-part format). Returns ``(treedef, chunk_dicts, parts)`` where
+    ``parts`` maps part name -> chunk index.
+    """
+    leaves_with_paths, treedef = jax.tree_util.tree_flatten_with_path(state_dict)
+
+    def part_of(path: Any) -> Optional[str]:
+        for entry in path:
+            key = getattr(entry, "key", None)
+            if isinstance(key, str) and key.startswith(HEAL_PART_PREFIX):
+                return key
+        return None
+
+    rest: List[int] = []
+    part_members: Dict[str, List[int]] = {}
+    for index, (path, _leaf) in enumerate(leaves_with_paths):
+        name = part_of(path)
+        if name is None:
+            rest.append(index)
+        else:
+            part_members.setdefault(name, []).append(index)
+    leaves = [_serialization._to_host(leaf) for _path, leaf in leaves_with_paths]
+    n = num_chunks if num_chunks > 0 else 1
+    n = min(n, max(len(rest), 1))
+    chunk_dicts: List[Dict[int, Any]] = [dict() for _ in range(n)]
+    for slot, index in enumerate(rest):
+        chunk_dicts[slot % n][index] = leaves[index]
+    parts: Dict[str, int] = {}
+    for name in sorted(part_members):
+        parts[name] = len(chunk_dicts)
+        chunk_dicts.append({i: leaves[i] for i in part_members[name]})
+    return treedef, chunk_dicts, parts
 
 
 class _HealCacheEntry:
@@ -631,14 +687,7 @@ class HTTPTransport(CheckpointTransport[Any]):
         child = self._serve_child
         if child is None or not child.alive():
             raise ServeChildUnavailable("no live serving child")
-        leaves, treedef = jax.tree_util.tree_flatten(state_dict)
-        leaves = [_serialization._to_host(leaf) for leaf in leaves]
-        n = self._num_chunks if self._num_chunks > 0 else 1
-        n = min(n, max(len(leaves), 1))
-        chunk_dicts: List[Dict[int, Any]] = [dict() for _ in range(n)]
-        for i, leaf in enumerate(leaves):
-            chunk_dicts[i % n][i] = leaf
-        del leaves
+        treedef, chunk_dicts, parts = _plan_chunks(state_dict, self._num_chunks)
         epoch, epoch_dir = child.new_epoch_dir()
         update = _CRC_UPDATERS[_CRC_ALGO]
         files: List[str] = []
@@ -659,11 +708,15 @@ class HTTPTransport(CheckpointTransport[Any]):
         meta = _meta_bytes(
             step=step,
             quorum_id=quorum_id,
-            num_chunks=n,
+            num_chunks=len(files),
             treedef=treedef,
             crc_algo=_CRC_ALGO,
             chunk_crcs=crcs,
             digest=digest,
+            parts={
+                name: {"chunk": index, "nbytes": sizes[index]}
+                for name, index in parts.items()
+            },
         )
         child.stage(
             step=step,
@@ -719,18 +772,16 @@ class HTTPTransport(CheckpointTransport[Any]):
                 metrics.inc("tpuft_heal_serve_fallbacks_total")
                 self._child_degraded = True
         with metrics.timer("tpuft_heal_serve_stage_seconds", mode="inline"):
-            leaves, treedef = jax.tree_util.tree_flatten(state_dict)
-            leaves = [_serialization._to_host(leaf) for leaf in leaves]
-            n = self._num_chunks if self._num_chunks > 0 else 1
-            n = min(n, max(len(leaves), 1))
-            chunk_dicts: List[Dict[int, Any]] = [dict() for _ in range(n)]
-            for i, leaf in enumerate(leaves):
-                chunk_dicts[i % n][i] = leaf
+            treedef, chunk_dicts, parts = _plan_chunks(
+                state_dict, self._num_chunks
+            )
             # prepare() keeps the host leaves + a small header per chunk;
             # the serialized bytes never exist as a second whole-payload
             # copy.
             chunks = [_serialization.prepare(chunk) for chunk in chunk_dicts]
-            staged = _Staged(step, chunks, treedef, quorum_id=quorum_id)
+            staged = _Staged(
+                step, chunks, treedef, quorum_id=quorum_id, parts=parts
+            )
         metrics.inc("tpuft_heal_serve_stages_total", mode="inline")
         with self._cond:
             self._staged = staged
@@ -750,6 +801,7 @@ class HTTPTransport(CheckpointTransport[Any]):
         step: int,
         timeout: float,
         quorum_id: Optional[int] = None,
+        skip_parts: Optional[Set[str]] = None,
     ) -> Any:
         base = f"{metadata}/checkpoint/{step}"
         meta = safe_loads(_fetch_retry(f"{base}/meta", timeout))
@@ -802,7 +854,29 @@ class HTTPTransport(CheckpointTransport[Any]):
             entry = _HealCacheEntry()
         # One entry total: stale (step, digest) partials are dropped here.
         self._heal_cache = {key: entry} if key is not None else {}
-        missing = [i for i in range(num_chunks) if i not in entry.chunks]
+        # Shard-addressable skip: parts the joiner reconstructs through a
+        # cheaper plane (ZeRO shard re-balance) are never fetched at all —
+        # their chunks' leaves come back as None and the saved wire bytes
+        # are pinned in tpuft_zero_heal_bytes_saved_total.
+        parts_meta: Dict[str, Any] = meta.get("parts") or {}
+        skipped_chunks: Dict[int, int] = {}
+        if skip_parts:
+            for name in skip_parts:
+                info = parts_meta.get(name)
+                if info is not None:
+                    skipped_chunks[int(info["chunk"])] = int(
+                        info.get("nbytes", 0)
+                    )
+            if skipped_chunks:
+                metrics.inc(
+                    "tpuft_zero_heal_bytes_saved_total",
+                    sum(skipped_chunks.values()),
+                )
+        missing = [
+            i
+            for i in range(num_chunks)
+            if i not in entry.chunks and i not in skipped_chunks
+        ]
         resumed = bool(entry.chunks)
         if resumed:
             for _chunk, nbytes in entry.chunks.values():
@@ -906,7 +980,12 @@ class HTTPTransport(CheckpointTransport[Any]):
         merged: Dict[int, Any] = {}
         for chunk, _nbytes in entry.chunks.values():
             merged.update(chunk)
-        leaves = [merged[i] for i in range(len(merged))]
+        if skipped_chunks:
+            # Skipped parts' leaves substitute as None (the part owner
+            # reconstructs them; see CheckpointTransport.recv_checkpoint).
+            leaves = [merged.get(i) for i in range(treedef.num_leaves)]
+        else:
+            leaves = [merged[i] for i in range(len(merged))]
         result = jax.tree_util.tree_unflatten(treedef, leaves)
         if key is not None:
             self._heal_cache.pop(key, None)

@@ -3,11 +3,22 @@
 from __future__ import annotations
 
 from abc import ABC, abstractmethod
-from typing import Callable, Generic, List, Optional, TypeVar
+from typing import Callable, Generic, List, Optional, Set, TypeVar
 
 T = TypeVar("T")
 
-__all__ = ["CheckpointTransport"]
+__all__ = ["CheckpointTransport", "HEAL_PART_PREFIX"]
+
+# Heal-part naming convention: a dict key anywhere in a staged state dict
+# that starts with this prefix marks its subtree as an independently
+# addressable *part* — transports that support parts (HTTPTransport) stage
+# each part as its own integrity-checked chunk and advertise a part ->
+# chunk map in /meta, so a joiner can skip parts it can reconstruct more
+# cheaply elsewhere (the ZeRO plane's shard-wise heal,
+# torchft_tpu/zero.py). Transports without part support simply treat the
+# keys as ordinary dict keys — the format degrades to a full fetch, never
+# to a wrong one.
+HEAL_PART_PREFIX = "heal_part:"
 
 
 class CheckpointTransport(ABC, Generic[T]):
@@ -46,8 +57,17 @@ class CheckpointTransport(ABC, Generic[T]):
         step: int,
         timeout: float,
         quorum_id: Optional[int] = None,
+        skip_parts: Optional[Set[str]] = None,
     ) -> T:
-        """Fetches the state for ``step`` from ``src_rank``."""
+        """Fetches the state for ``step`` from ``src_rank``.
+
+        ``skip_parts``: names of :data:`HEAL_PART_PREFIX` parts whose
+        payloads the joiner does not need (it reconstructs them through a
+        cheaper plane — e.g. the ZeRO re-balance exchange). A part-aware
+        transport substitutes ``None`` for every leaf of a skipped part;
+        transports without part support MUST ignore the argument and
+        fetch everything — skipping is an optimization, never a
+        correctness requirement."""
 
     def disallow_checkpoint(self) -> None:
         """Stops serving the staged checkpoint (called at commit)."""

@@ -43,6 +43,11 @@ KNOWN_ENV = {
     "TPUFT_HEAL_SERVE_MODE", "TPUFT_HEAL_SERVE_DIR", "TPUFT_HEAL_SERVE_NICE",
     "TPUFT_HEAL_SERVE_GBPS", "TPUFT_HEAL_SERVE_MAX_RESTARTS",
     "TPUFT_METRICS_PORT", "TPUFT_METRICS_PUSH_SEC",
+    # ZeRO plane (torchft_tpu/zero.py): enable flag for the harness/bench
+    # loops, fleet-wide shard count, assignment policy, joiner heal
+    # policy for shard parts, bench sizing.
+    "TPUFT_ZERO", "TPUFT_ZERO_SHARDS", "TPUFT_ZERO_REBALANCE",
+    "TPUFT_ZERO_HEAL_SHARDS", "TPUFT_ZERO_BENCH_ELEMS",
     "TPUFT_BENCH_CHILD",
     "TPUFT_BENCH_MODEL", "TPUFT_BENCH_STEPS", "TPUFT_BENCH_BATCH",
     "TPUFT_BENCH_SEQ", "TPUFT_BENCH_SYNC_EVERY", "TPUFT_BENCH_SYNC_DELAY",
@@ -237,6 +242,59 @@ def _check_heal_serve() -> Tuple[str, str]:
         )
 
 
+def _check_zero(lighthouse: str) -> Tuple[str, str]:
+    """ZeRO plane preflight. WARN, never FAIL: the plane degrades to
+    unsharded math, it never breaks training — but an operator who set
+    TPUFT_ZERO expecting 1/N memory should hear that a cohort of one (or
+    a bad knob) silently degenerates to full state on every replica."""
+    from torchft_tpu import zero
+
+    enabled = os.environ.get(zero.ENV_ZERO, "0") not in ("", "0")
+    shards_raw = os.environ.get(zero.ENV_ZERO_SHARDS)
+    if not enabled and shards_raw is None:
+        return "PASS", f"ZeRO off (set {zero.ENV_ZERO}=1 to shard the update)"
+    try:
+        num_shards = int(shards_raw) if shards_raw else zero.DEFAULT_NUM_SHARDS
+        if num_shards < 1:
+            raise ValueError
+    except ValueError:
+        return "WARN", f"{zero.ENV_ZERO_SHARDS}={shards_raw!r} is not a positive int"
+    policy = os.environ.get(zero.ENV_ZERO_REBALANCE, "block")
+    if policy not in ("block", "strided"):
+        return "WARN", f"{zero.ENV_ZERO_REBALANCE}={policy!r} is not block|strided"
+    heal = os.environ.get(zero.ENV_ZERO_HEAL_SHARDS, "skip")
+    if heal not in ("skip", "fetch"):
+        return "WARN", f"{zero.ENV_ZERO_HEAL_SHARDS}={heal!r} is not skip|fetch"
+    if not lighthouse:
+        return (
+            "PASS",
+            f"ZeRO on: {num_shards} shards, policy {policy} (no lighthouse "
+            "to probe cohort size)",
+        )
+    try:
+        from torchft_tpu.coordination import LighthouseClient
+
+        client = LighthouseClient(lighthouse, connect_timeout=5.0)
+        try:
+            members = len(client.status(timeout=5.0).members)
+        finally:
+            client.close()
+    except Exception as e:  # noqa: BLE001 — WARN-never-FAIL probe
+        return "WARN", f"ZeRO on but lighthouse probe failed ({e})"
+    if members <= 1:
+        return (
+            "WARN",
+            f"ZeRO on with a cohort of {members}: one replica owns all "
+            f"{num_shards} shards — memory/heal savings silently degenerate "
+            "to unsharded until more replicas join",
+        )
+    return (
+        "PASS",
+        f"ZeRO on: {num_shards} shards over {members} replicas "
+        f"(~1/{members} opt state each), policy {policy}",
+    )
+
+
 def _check_env() -> Tuple[str, str]:
     # Value validation first — a fatal misconfig must FAIL even when a
     # typo'd var would also WARN.
@@ -261,6 +319,7 @@ def run_checks(lighthouse: str, skip_device: bool = False) -> int:
         ("env vars", _check_env),
         ("metrics", _check_metrics),
         ("heal serving", _check_heal_serve),
+        ("zero plane", lambda: _check_zero(lighthouse)),
         ("lighthouse", lambda: _check_lighthouse(lighthouse)),
     ]
     if not skip_device:

@@ -321,6 +321,7 @@ class Manager:
         self._errored: Optional[ExceptionWithTraceback] = None
         self._shutdown_hooks: List[Callable[[], None]] = []
         self._quorum_change_hooks: List[Callable[[], None]] = []
+        self._heal_parts_filters: List[Callable[[], Any]] = []
         self._healing = False
         self._pending_state_dict: Optional[Dict[str, Any]] = None
         self._pending_commit_future: Optional[_TrackedCommitFuture] = None
@@ -438,6 +439,26 @@ class Manager:
         funnel into :meth:`report_error` (the step will not commit) rather
         than aborting the reconfigure."""
         self._quorum_change_hooks.append(hook)
+
+    def register_heal_parts_filter(self, fn: Callable[[], Any]) -> None:
+        """Registers a callable returning the set of heal-part names
+        (``checkpointing.transport.HEAL_PART_PREFIX`` keys) this replica
+        does NOT need a donor to stream — it reconstructs them through a
+        cheaper plane instead (the ZeRO optimizer re-balances its shard
+        states from survivors over the PG). The union of all filters is
+        passed to ``recv_checkpoint(skip_parts=...)`` on every heal;
+        filter errors are ignored (skipping is an optimization — the
+        fallback is simply fetching everything)."""
+        self._heal_parts_filters.append(fn)
+
+    def _heal_skip_parts(self) -> Optional[set]:
+        skip: set = set()
+        for fn in self._heal_parts_filters:
+            try:
+                skip |= set(fn() or ())
+            except Exception:  # noqa: BLE001 — skip is best-effort
+                self._logger.exception("heal parts filter failed (ignored)")
+        return skip or None
 
     def register_shutdown_hook(self, hook: Callable[[], None]) -> None:
         """Runs ``hook`` during :meth:`shutdown` (before the executor stops).
@@ -958,6 +979,7 @@ class Manager:
                     step=quorum.max_step,
                     timeout=self._timeout,
                     quorum_id=quorum.quorum_id,
+                    skip_parts=self._heal_skip_parts(),
                 )
             # Restore manager accounting immediately; user state is
             # applied from the main thread when safe.

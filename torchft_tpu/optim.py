@@ -45,6 +45,7 @@ __all__ = [
     "Optimizer",
     "OptimizerWrapper",
     "make_jit_update",
+    "make_jit_shard_update",
     "make_jit_fused_step",
     "make_microbatch_grad",
 ]
@@ -188,6 +189,30 @@ def make_jit_update(tx: Any):
     def _update(grads: Any, opt_state: Any, params: Any):
         updates, new_state = tx.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), new_state
+
+    return jax.jit(_update)
+
+
+def make_jit_shard_update(tx: Any):
+    """One fused-dispatch optax update over a LIST of optimizer shards:
+    ``(avg_shards, shard_states, master_shards) -> (new_masters,
+    new_states)`` where each position is one ZeRO shard's flat f32 range
+    (torchft_tpu.zero). Each shard keeps its OWN optax state (``tx.init``
+    per shard — shard states must stay independently addressable for the
+    re-balance exchange and the shard-wise heal), but all owned shards
+    update inside ONE jitted program, so the per-step dispatch count stays
+    constant regardless of how many shards a replica owns (the
+    unjitted-optax invariant: eager per-shard updates would issue hundreds
+    of tiny device ops on high-latency links)."""
+    import optax
+
+    def _update(avg_shards: Any, shard_states: Any, master_shards: Any):
+        new_masters, new_states = [], []
+        for grad, state, master in zip(avg_shards, shard_states, master_shards):
+            updates, next_state = tx.update(grad, state, master)
+            new_masters.append(optax.apply_updates(master, updates))
+            new_states.append(next_state)
+        return new_masters, new_states
 
     return jax.jit(_update)
 
@@ -368,7 +393,7 @@ class Optimizer:
         self.tx = tx
         self.params = params
         self._heal_count = 0
-        self.opt_state = _align_opt_state(tx.init(params), params)
+        self.opt_state = self._init_state(tx, params)
         manager.register_state_dict_fn(
             register_key, self._load_state_dict, self._state_dict
         )
@@ -381,6 +406,13 @@ class Optimizer:
         self._pipeline_hooked = False
         self._next_pipelined_step = 0
         self.rollback_count = 0
+
+    def _init_state(self, tx: Any, params: Any) -> Any:
+        """Builds the initial optimizer state this wrapper owns. The ZeRO
+        subclass (torchft_tpu.zero.ZeroOptimizer) overrides this to hold
+        only its 1/N shard of the state; everything downstream (snapshots,
+        rollback, heal re-binding) treats ``opt_state`` as opaque."""
+        return _align_opt_state(tx.init(params), params)
 
     def _state_dict(self) -> Any:
         return {"params": self.params, "opt_state": self.opt_state}
@@ -631,8 +663,6 @@ class Optimizer:
         the loop boundary for the final step's. ``TPUFT_STRICT_COMMIT=1``
         overrides the pipeline back to the strict per-step ordering.
         """
-        from torchft_tpu.ddp import ft_allreduce_gradients
-
         fused = make_jit_fused_step(self.tx, loss_fn)
         grad_fn = jax.jit(jax.value_and_grad(loss_fn))
 
@@ -662,14 +692,9 @@ class Optimizer:
                 self.manager.wait_quorum()
             if self.manager.errored() is None and self.manager.is_lone_replica():
                 heal_count = self._heal_count
-                # Heals rebind self.params (never mutate buffers), so this
-                # reference keeps the pre-heal state alive for the rare
-                # heal-during-barrier recompute below.
-                pre_params = self.params
-                with metrics.timer("tpuft_update_dispatch_seconds"):
-                    loss, spec_params, spec_opt_state = fused(
-                        self.params, self.opt_state, *batch
-                    )
+                loss, spec, recompute = self._lone_dispatch(
+                    fused, grad_fn, batch
+                )
                 # Launch the barrier BEFORE the device sync so the commit
                 # RPC rides under the readiness wait instead of after it
                 # (on a high-latency device link the sync alone costs a
@@ -720,26 +745,72 @@ class Optimizer:
                             )
                         raise
 
-                def recompute():
-                    # Same semantics as :meth:`step` (and the reference's
-                    # load_state_dict + optimizer.step() sequence): the
-                    # gradients computed on the PRE-heal params apply to the
-                    # healed state.
-                    _, grads = grad_fn(pre_params, *batch)
-                    return self._jit_update(grads, self.opt_state, self.params)
-
                 committed = self._commit_and_adopt(
-                    heal_count, (spec_params, spec_opt_state), recompute, None,
+                    heal_count, spec, recompute, None,
                     commit_future=commit_future,
                 )
                 return loss, committed
-            loss, grads = grad_fn(self.params, *batch)
-            committed = self.step(
-                ft_allreduce_gradients(self.manager, grads, should_quantize)
-            )
-            return loss, committed
+            return self._wire_step(grad_fn, batch, should_quantize)
 
         return step_fn
+
+    # ------------------------------------------------------------------
+    # make_step_fn seams (overridden by zero.ZeroOptimizer)
+    # ------------------------------------------------------------------
+
+    def _lone_dispatch(self, fused: Any, grad_fn: Any, batch: Any):
+        """Dispatches the lone-replica step's device work; returns
+        ``(loss, speculation, recompute)``. The caller owns the barrier
+        ordering (strict/overlapped/pipelined) around the returned loss.
+        Base: the whole loss+grad+update as ONE fused XLA program."""
+        # Heals rebind self.params (never mutate buffers), so this
+        # reference keeps the pre-heal state alive for the rare
+        # heal-during-barrier recompute below.
+        pre_params = self.params
+        with metrics.timer("tpuft_update_dispatch_seconds"):
+            loss, spec_params, spec_opt_state = fused(
+                self.params, self.opt_state, *batch
+            )
+
+        def recompute():
+            # Same semantics as :meth:`step` (and the reference's
+            # load_state_dict + optimizer.step() sequence): the
+            # gradients computed on the PRE-heal params apply to the
+            # healed state.
+            _, grads = grad_fn(pre_params, *batch)
+            return self._jit_update(grads, self.opt_state, self.params)
+
+        return loss, (spec_params, spec_opt_state), recompute
+
+    def _wire_step(self, grad_fn: Any, batch: Any, should_quantize: bool):
+        """The non-pipelined step with other replica groups participating:
+        grad dispatch, cross-replica sync, :meth:`step`. Base: bucketed
+        gradient allreduce, then the standard averaged-grads step."""
+        from torchft_tpu.ddp import ft_allreduce_gradients
+
+        loss, grads = grad_fn(self.params, *batch)
+        committed = self.step(
+            ft_allreduce_gradients(self.manager, grads, should_quantize)
+        )
+        return loss, committed
+
+    def _wire_speculate(self, grads: Any, pre_opt: Any, pre_params: Any,
+                        should_quantize: bool):
+        """The pipelined wire path's speculative update: syncs ``grads``
+        across replicas and computes the speculative ``(params,
+        opt_state)`` from the PRE-step state; returns ``(speculation,
+        recompute)``. Must complete its collectives before returning —
+        the caller launches the commit vote right after, and a rank whose
+        sync failed must not vote commit."""
+        from torchft_tpu.ddp import ft_allreduce_gradients
+
+        avg = ft_allreduce_gradients(self.manager, grads, should_quantize)
+        spec = self._jit_update(avg, pre_opt, pre_params)
+
+        def recompute(avg=avg):
+            return self._jit_update(avg, self.opt_state, self.params)
+
+        return spec, recompute
 
     def _make_pipelined_step_fn(
         self, fused: Any, grad_fn: Any, should_quantize: bool,
@@ -773,7 +844,7 @@ class Optimizer:
         """
         import time as _time
 
-        from torchft_tpu.ddp import ft_allreduce_gradients, prefetch_gradients
+        from torchft_tpu.ddp import prefetch_gradients
         from torchft_tpu.futures import CommitPipeline
 
         if self._pipeline is not None and len(self._pipeline):
@@ -824,15 +895,9 @@ class Optimizer:
             lone = manager.errored() is None and manager.is_lone_replica()
             was_wire[0] = not lone
             if lone:
-                with metrics.timer("tpuft_update_dispatch_seconds"):
-                    loss, spec_params, spec_opt = fused(pre_params, pre_opt, *batch)
-                spec = (spec_params, spec_opt)
-
-                def recompute(pre_params=pre_params, batch=batch):
-                    # Pre-heal grads apply to the healed state (reference
-                    # load_state_dict + optimizer.step() order).
-                    _, g = grad_fn(pre_params, *batch)
-                    return self._jit_update(g, self.opt_state, self.params)
+                loss, spec, recompute = self._lone_dispatch(
+                    fused, grad_fn, batch
+                )
             else:
                 if (
                     early is not None
@@ -842,11 +907,9 @@ class Optimizer:
                     loss, grads = early
                 else:
                     loss, grads = grad_fn(pre_params, *batch)
-                avg = ft_allreduce_gradients(manager, grads, should_quantize)
-                spec = self._jit_update(avg, pre_opt, pre_params)
-
-                def recompute(avg=avg):
-                    return self._jit_update(avg, self.opt_state, self.params)
+                spec, recompute = self._wire_speculate(
+                    grads, pre_opt, pre_params, should_quantize
+                )
 
             # Tentative adoption — the uncommitted one-step window. Write-
             # locked so a concurrent donor capture never reads a torn pair.
