@@ -8,7 +8,12 @@ Every Manager publishes its process metrics into its group store under
 lighthouse status reports each member's ``replica_id`` + store address —
 so one status RPC plus one store get per rank renders the whole fleet
 without touching any training process: step, step rate, commits, last
-commit age, heal-in-progress, heartbeat age.
+commit age, heal-in-progress, heartbeat age. The LAG column derives
+straggler attribution from the trace plane's pushed per-step phase
+durations (``trace/<replica_id>/<rank>``): at the latest shared step, the
+rank that waited least in the commit barrier entered it last — its lag is
+how long it held everyone else up (``--watch`` keeps it live; see
+``scripts/fleet_trace.py --explain-step`` for the full causal story).
 
 Pure Python (the lighthouse/store clients speak the framed-protobuf
 protocol directly); runs anywhere that can reach the lighthouse.
@@ -55,6 +60,52 @@ def _get_snapshot(store_addr: str, replica_id: str, rank: int) -> Optional[Dict[
             client.close()
         except Exception:
             pass
+
+
+def _get_trace_phases(
+    store_addr: str, replica_id: str, rank: int
+) -> Optional[List[Dict[str, Any]]]:
+    """The replica's pushed per-step phase rollup (trace/<replica>/<rank>,
+    Manager._push_trace), or None. Never raises."""
+    try:
+        client = create_store_client(store_addr, connect_timeout=2.0)
+    except Exception:
+        return None
+    try:
+        raw = client.get(f"trace/{replica_id}/{rank}", timeout=2.0, wait=False)
+        if raw is None:
+            return None
+        return json.loads(raw.decode()).get("phases")
+    except Exception:
+        return None
+    finally:
+        try:
+            client.close()
+        except Exception:
+            pass
+
+
+def _annotate_straggler_lag(rows: List[Dict[str, Any]]) -> None:
+    """Derives the STRAGGLER/LAG column from the store-pushed per-step
+    phase durations: at the latest step two or more rows share, the commit
+    barrier released everyone together, so the rank that WAITED least in
+    it entered LAST — its lag is (longest wait - its wait). Durations are
+    local monotonic, so no clock alignment is needed."""
+    waits_by_step: Dict[int, Dict[int, float]] = {}
+    for index, row in enumerate(rows):
+        for entry in row.pop("_trace_phases", None) or []:
+            wait = (entry.get("phases") or {}).get("commit_barrier")
+            if wait is not None and entry.get("step") is not None:
+                waits_by_step.setdefault(int(entry["step"]), {})[index] = float(wait)
+    shared = [s for s, waits in waits_by_step.items() if len(waits) >= 2]
+    if not shared:
+        return
+    step = max(shared)
+    waits = waits_by_step[step]
+    longest = max(waits.values())
+    for index, wait in waits.items():
+        rows[index]["lag_s"] = round(longest - wait, 3)
+        rows[index]["lag_step"] = step
 
 
 def _counter_total(snapshot: Dict[str, Any], name: str) -> Optional[float]:
@@ -121,6 +172,11 @@ def collect(lighthouse_addr: str, prev: Optional[Dict[str, Any]] = None) -> Dict
                 "lighthouse_step": member.step,
                 "heartbeat_age_ms": round(member_status.heartbeat_age_ms, 1),
                 "joining": member_status.joining,
+                "_trace_phases": (
+                    _get_trace_phases(member.store_address, member.replica_id, rank)
+                    if member.store_address
+                    else None
+                ),
             }
             if snap is not None:
                 last_commit = _gauge(snap, "tpuft_last_commit_time")
@@ -156,6 +212,7 @@ def collect(lighthouse_addr: str, prev: Optional[Dict[str, Any]] = None) -> Dict
                             (row["step"] - before["step"]) / dt, 3
                         )
             rows.append(row)
+    _annotate_straggler_lag(rows)
     return {
         "ts": now,
         "lighthouse": lighthouse_addr,
@@ -175,6 +232,7 @@ _COLUMNS = (
     ("heals", "HEALS"),
     ("serve", "SERVE"),
     ("shard", "SHARD"),
+    ("lag_s", "LAG"),
     ("last_commit_age_s", "LAST COMMIT"),
     ("healing", "HEALING"),
     ("heartbeat_age_ms", "HB AGE MS"),
@@ -186,7 +244,7 @@ def _cell(row: Dict[str, Any], key: str) -> str:
     value = row.get(key)
     if value is None:
         return "-"
-    if key == "last_commit_age_s" or key == "push_age_s":
+    if key == "last_commit_age_s" or key == "push_age_s" or key == "lag_s":
         return f"{value}s"
     if isinstance(value, bool):
         return "yes" if value else "no"

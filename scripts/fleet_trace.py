@@ -1,0 +1,585 @@
+#!/usr/bin/env python
+"""Fleet trace: merge every replica's step-event journal into ONE causally
+ordered timeline — a perfetto-loadable chrome trace — and explain a step.
+
+Sources (any mix):
+
+- ``--dir DIR``: offline journal dumps (``tpuft_trace_*.jsonl``, written
+  under ``$TPUFT_FLIGHT_RECORDER`` by incident auto-capture or
+  ``TraceJournal.dump``) and saved ``/trace.json`` payloads;
+- ``--url http://host:port[,...]``: live pulls of ``GET /trace.json`` from
+  each replica's metrics HTTP surface (the checkpoint-transport port or
+  ``$TPUFT_METRICS_PORT``);
+- ``--lighthouse host:port``: discover members and read each group store's
+  pushed ``trace/<replica_id>/<rank>`` segments (recent events only — the
+  incremental push window; use ``--url`` or dumps for full rings).
+
+Clock alignment (wall clocks across hosts are NOT trusted):
+
+1. coarse — store-mediated beacon samples (``clock_sample`` events,
+   tracing.StoreClockSampler) bound gross skew to the push cadence;
+2. fine — barrier simultaneity anchors: every participant's
+   ``commit_barrier`` span for the same ``(step, quorum_id)`` ENDS at the
+   same quorum-wide release instant (within RPC fanout skew), so the
+   median end-to-end delta per process pins its offset to ~ms;
+3. ordering — ``(step, quorum_id, seq)`` is the hybrid logical clock:
+   after wall alignment, a stable sort by quorum era repairs any residual
+   cross-process inversions (quorum ids are fleet-monotone; events inside
+   one era keep their aligned-wall order, and per-process ``seq`` order is
+   always preserved).
+
+``--explain-step N`` prints a causal narrative for one step: straggler
+attribution per phase (who entered the commit barrier last and by how
+much), who voted abort and the linked ``report_error``, heal progress at
+that instant, and the surrounding quorum transitions.
+
+Usage::
+
+    python scripts/fleet_trace.py --dir /tmp/fr --out merged_trace.json
+    python scripts/fleet_trace.py --dir /tmp/fr --explain-step 12
+    python scripts/fleet_trace.py --url http://h1:8080,http://h2:8080 ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import statistics
+import sys
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Tuple
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+ProcKey = Tuple[str, int]
+
+
+# ---------------------------------------------------------------------------
+# loading
+# ---------------------------------------------------------------------------
+
+
+def _normalize(event: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """One journal event with identity; returns None for non-events
+    (headers, malformed lines)."""
+    if not isinstance(event, dict) or "name" not in event or "seq" not in event:
+        return None
+    event.setdefault("replica_id", "proc")
+    event.setdefault("group_rank", 0)
+    event.setdefault("step", None)
+    event.setdefault("quorum_id", -1)
+    return event
+
+
+def load_events_from_payload(payload: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Events from a ``/trace.json`` payload or a store-pushed segment."""
+    ident = {
+        "replica_id": payload.get("replica_id", "proc"),
+        "group_rank": payload.get("group_rank", 0),
+    }
+    out = []
+    for event in payload.get("events", []):
+        normalized = _normalize({**ident, **event})
+        if normalized is not None:
+            out.append(normalized)
+    return out
+
+
+def load_jsonl(path: str) -> List[Dict[str, Any]]:
+    """One journal dump: a ``trace_header`` line then one event per line.
+    The header's identity backfills events that lack one."""
+    events: List[Dict[str, Any]] = []
+    ident: Dict[str, Any] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if rec.get("trace_header"):
+                ident = {
+                    "replica_id": rec.get("replica_id", "proc"),
+                    "group_rank": rec.get("group_rank", 0),
+                }
+                continue
+            normalized = _normalize({**ident, **rec})
+            if normalized is not None:
+                events.append(normalized)
+    return events
+
+
+def load_dir(directory: str) -> List[Dict[str, Any]]:
+    """Every journal dump and saved /trace.json payload under a directory
+    (the offline incident-ingestion path)."""
+    events: List[Dict[str, Any]] = []
+    for path in sorted(glob.glob(os.path.join(directory, "tpuft_trace_*.jsonl"))):
+        events.extend(load_jsonl(path))
+    for path in sorted(glob.glob(os.path.join(directory, "*.trace.json"))):
+        try:
+            with open(path) as f:
+                events.extend(load_events_from_payload(json.load(f)))
+        except (OSError, json.JSONDecodeError, AttributeError):
+            continue
+    return events
+
+
+def load_url(url: str, timeout: float = 5.0) -> List[Dict[str, Any]]:
+    import urllib.request
+
+    with urllib.request.urlopen(f"{url.rstrip('/')}/trace.json", timeout=timeout) as r:
+        return load_events_from_payload(json.loads(r.read().decode()))
+
+
+def load_lighthouse(lighthouse_addr: str) -> List[Dict[str, Any]]:
+    """Pull the store-pushed segments for every lighthouse member (the
+    live, no-training-process-touched path fleet_status also uses)."""
+    from torchft_tpu.coordination import LighthouseClient
+    from torchft_tpu.parallel.store import create_store_client
+
+    client = LighthouseClient(lighthouse_addr, connect_timeout=5.0)
+    try:
+        status = client.status(timeout=5.0)
+    finally:
+        client.close()
+    events: List[Dict[str, Any]] = []
+    for member_status in status.members:
+        member = member_status.member
+        if not member.store_address:
+            continue
+        for rank in range(max(1, member.world_size)):
+            try:
+                store = create_store_client(member.store_address, connect_timeout=2.0)
+            except Exception:  # noqa: BLE001 — a dead store is a dead member
+                continue
+            try:
+                raw = store.get(
+                    f"trace/{member.replica_id}/{rank}", timeout=2.0, wait=False
+                )
+                if raw is not None:
+                    events.extend(load_events_from_payload(json.loads(raw.decode())))
+            except Exception:  # noqa: BLE001
+                pass
+            finally:
+                try:
+                    store.close()
+                except Exception:  # noqa: BLE001
+                    pass
+    return events
+
+
+# ---------------------------------------------------------------------------
+# merge
+# ---------------------------------------------------------------------------
+
+
+def proc_key(event: Dict[str, Any]) -> ProcKey:
+    return (str(event.get("replica_id", "proc")), int(event.get("group_rank", 0)))
+
+
+def proc_label(key: ProcKey) -> str:
+    return f"{key[0]}/{key[1]}"
+
+
+def estimate_offsets(events: List[Dict[str, Any]]) -> Dict[ProcKey, float]:
+    """Per-process wall offsets (seconds to SUBTRACT from ``t_wall`` to
+    land in the reference frame; reference offset = 0). Fine estimate from
+    commit-barrier simultaneity anchors when processes share steps, coarse
+    from store clock samples otherwise, 0 as the last resort."""
+    by_proc: Dict[ProcKey, List[Dict[str, Any]]] = {}
+    for event in events:
+        by_proc.setdefault(proc_key(event), []).append(event)
+    if not by_proc:
+        return {}
+    # Reference: the process with the most events (stable tiebreak).
+    ref = max(sorted(by_proc), key=lambda k: len(by_proc[k]))
+
+    # Coarse: each process's median sampled offset vs the shared beacon.
+    coarse: Dict[ProcKey, float] = {}
+    for key, evs in by_proc.items():
+        samples = [
+            e["args"]["offset_s"]
+            for e in evs
+            if e.get("name") == "clock_sample"
+            and isinstance(e.get("args"), dict)
+            and isinstance(e["args"].get("offset_s"), (int, float))
+        ]
+        if samples:
+            coarse[key] = statistics.median(samples)
+
+    # Fine: barrier-release anchors shared with the reference.
+    anchors: Dict[Tuple[int, int], Dict[ProcKey, float]] = {}
+    for event in events:
+        if event.get("name") != "commit_barrier" or event.get("ph") != "X":
+            continue
+        step, quorum = event.get("step"), event.get("quorum_id")
+        if step is None:
+            continue
+        end_wall = float(event["t_wall"]) + float(event.get("dur", 0.0))
+        anchors.setdefault((step, quorum), {})[proc_key(event)] = end_wall
+
+    offsets: Dict[ProcKey, float] = {ref: 0.0}
+    for key in by_proc:
+        if key == ref:
+            continue
+        deltas = [
+            ends[key] - ends[ref]
+            for ends in anchors.values()
+            if key in ends and ref in ends
+        ]
+        if deltas:
+            offsets[key] = statistics.median(deltas)
+        elif key in coarse and ref in coarse:
+            offsets[key] = coarse[key] - coarse[ref]
+        elif key in coarse:
+            offsets[key] = coarse[key]
+        else:
+            offsets[key] = 0.0
+    return offsets
+
+
+def merge_events(
+    events: List[Dict[str, Any]],
+    offsets: Optional[Dict[ProcKey, float]] = None,
+) -> List[Dict[str, Any]]:
+    """Dedups (by per-process ``seq``), aligns wall clocks, and returns one
+    causally ordered list. Each returned event gains ``t_aligned`` (wall in
+    the reference frame). Ordering: aligned wall first, then a stable pass
+    by quorum era — the ``(step, quorum_id, seq)`` hybrid logical clock —
+    so residual skew cannot invert cross-era causality (a kill in era q is
+    never sorted after era q+1's heal), while per-process ``seq`` order is
+    always preserved."""
+    seen: set = set()
+    unique: List[Dict[str, Any]] = []
+    for event in events:
+        key = (proc_key(event), event.get("seq"))
+        if key in seen:
+            continue
+        seen.add(key)
+        unique.append(dict(event))
+    if offsets is None:
+        offsets = estimate_offsets(unique)
+    for event in unique:
+        event["t_aligned"] = float(event.get("t_wall", 0.0)) - offsets.get(
+            proc_key(event), 0.0
+        )
+    # Effective era per event: each process's quorum id carried forward in
+    # seq order (an era-less event — a device sync, a heal chunk recorded
+    # before the journal learned the id — belongs to whatever era its
+    # process was in, never to a global "era -1" bucket that would tear it
+    # out of sequence).
+    by_proc: Dict[ProcKey, List[Dict[str, Any]]] = {}
+    for event in unique:
+        by_proc.setdefault(proc_key(event), []).append(event)
+    for evs in by_proc.values():
+        evs.sort(key=lambda e: e["seq"])
+        era = -1
+        for event in evs:
+            era = max(era, int(event.get("quorum_id", -1) or -1))
+            event["_era"] = era
+    unique.sort(key=lambda e: (e["t_aligned"], proc_label(proc_key(e)), e["seq"]))
+    # Stable era pass: events keep their aligned-wall order inside one
+    # quorum era; eras themselves sort by id (fleet-monotone), so residual
+    # skew cannot invert cross-era causality.
+    unique.sort(key=lambda e: e.pop("_era"))
+    return unique
+
+
+# ---------------------------------------------------------------------------
+# chrome trace export
+# ---------------------------------------------------------------------------
+
+
+def to_chrome(merged: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """A self-contained chrome trace (``chrome://tracing`` / perfetto):
+    one process track per (replica, rank) — spans shifted into the
+    reference clock frame — one thread track per recording thread."""
+    trace_events: List[Dict[str, Any]] = []
+    pids: Dict[ProcKey, int] = {}
+    tids: Dict[Tuple[ProcKey, str], int] = {}
+    for event in merged:
+        key = proc_key(event)
+        if key not in pids:
+            pids[key] = len(pids) + 1
+            trace_events.append(
+                {
+                    "name": "process_name",
+                    "ph": "M",
+                    "pid": pids[key],
+                    "args": {"name": proc_label(key)},
+                }
+            )
+        pid = pids[key]
+        thread = str(event.get("thread", "main"))
+        tkey = (key, thread)
+        if tkey not in tids:
+            tids[tkey] = len([t for t in tids if t[0] == key]) + 1
+            trace_events.append(
+                {
+                    "name": "thread_name",
+                    "ph": "M",
+                    "pid": pid,
+                    "tid": tids[tkey],
+                    "args": {"name": thread},
+                }
+            )
+        out: Dict[str, Any] = {
+            "name": event["name"],
+            "cat": str(event.get("cat", "ft")),
+            "pid": pid,
+            "tid": tids[tkey],
+            "ts": event["t_aligned"] * 1e6,
+            "args": {
+                "step": event.get("step"),
+                "quorum_id": event.get("quorum_id"),
+                "seq": event.get("seq"),
+                **(event.get("args") or {}),
+            },
+        }
+        if event.get("ph") == "X":
+            out["ph"] = "X"
+            out["dur"] = float(event.get("dur", 0.0)) * 1e6
+        else:
+            out["ph"] = "i"
+            out["s"] = "t"  # thread-scoped instant
+        trace_events.append(out)
+    return {"traceEvents": trace_events, "displayTimeUnit": "ms"}
+
+
+# ---------------------------------------------------------------------------
+# step postmortem
+# ---------------------------------------------------------------------------
+
+
+def _fmt_ms(seconds: float) -> str:
+    return f"{seconds * 1e3:.1f}ms"
+
+
+def explain_step(merged: List[Dict[str, Any]], step: int) -> str:
+    """The causal narrative for one step, from a merged timeline."""
+    at_step = [e for e in merged if e.get("step") == step]
+    lines: List[str] = [f"== step {step} postmortem =="]
+    if not at_step:
+        steps = sorted({e.get("step") for e in merged if e.get("step") is not None})
+        lines.append(
+            f"no events at step {step}; journal covers steps "
+            f"{steps[0]}..{steps[-1]}" if steps else "no step events at all"
+        )
+        return "\n".join(lines)
+
+    procs = sorted({proc_key(e) for e in at_step})
+    quorums = sorted(
+        {e.get("quorum_id") for e in at_step if e.get("quorum_id", -1) >= 0}
+    )
+    lines.append(
+        f"replicas: {', '.join(proc_label(p) for p in procs)}"
+        + (f"   quorum era(s): {', '.join(str(q) for q in quorums)}" if quorums else "")
+    )
+
+    # Per-phase durations per replica (+ the straggler delta per phase:
+    # this replica's duration minus the fleet-fastest).
+    phase_names = [
+        "quorum", "pg_configure", "wire_bucket", "device_sync",
+        "update_dispatch", "commit_barrier", "heal_send", "heal_recv",
+        "zero_rebalance",
+    ]
+    durations: Dict[ProcKey, Dict[str, float]] = {p: {} for p in procs}
+    for event in at_step:
+        if event.get("ph") == "X" and event["name"] in phase_names:
+            slot = durations[proc_key(event)]
+            slot[event["name"]] = slot.get(event["name"], 0.0) + float(
+                event.get("dur", 0.0)
+            )
+    lines.append("phases (duration, +delta vs fleet-fastest):")
+    for name in phase_names:
+        having = {p: d[name] for p, d in durations.items() if name in d}
+        if not having:
+            continue
+        fastest = min(having.values())
+        cells = ", ".join(
+            f"{proc_label(p)} {_fmt_ms(d)}"
+            + (f" (+{_fmt_ms(d - fastest)})" if d - fastest > 1e-9 else "")
+            for p, d in sorted(having.items())
+        )
+        lines.append(f"  {name:16s} {cells}")
+
+    # Straggler attribution at the commit barrier: the barrier releases
+    # everyone together, so enter_lag = (longest wait) - (my wait); the
+    # replica with the largest lag entered LAST and held everyone up.
+    waits = {
+        p: d["commit_barrier"] for p, d in durations.items() if "commit_barrier" in d
+    }
+    if len(waits) >= 2:
+        max_wait = max(waits.values())
+        lags = {p: max_wait - w for p, w in waits.items()}
+        straggler = max(sorted(lags), key=lambda p: lags[p])
+        lines.append(
+            f"commit barrier: {proc_label(straggler)} entered last, "
+            f"+{_fmt_ms(lags[straggler])} after the first enterer"
+        )
+        lines.append(
+            "  enter lag: "
+            + ", ".join(
+                f"{proc_label(p)} +{_fmt_ms(lag)}" for p, lag in sorted(lags.items())
+            )
+        )
+
+    # Votes + linked errors.
+    votes = [e for e in at_step if e["name"] == "vote_send"]
+    for vote in votes:
+        p = proc_key(vote)
+        args = vote.get("args") or {}
+        if args.get("vote") in (False, "False"):
+            linked = [
+                e for e in at_step
+                if e["name"] == "report_error" and proc_key(e) == p
+                and e["seq"] < vote["seq"]
+            ]
+            reason = ""
+            if linked:
+                last_error = (linked[-1].get("args") or {}).get("error", "")
+                reason = f' <- report_error: "{last_error}"'
+            lines.append(f"abort vote: {proc_label(p)} voted False{reason}")
+
+    errors = [e for e in at_step if e["name"] == "report_error"]
+    if errors and not any(
+        (v.get("args") or {}).get("vote") in (False, "False") for v in votes
+    ):
+        for e in errors:
+            lines.append(
+                f"errored: {proc_label(proc_key(e))} "
+                f"report_error: \"{(e.get('args') or {}).get('error', '')}\""
+            )
+
+    # Commit outcome.
+    commits = [e for e in at_step if e["name"] == "commit"]
+    failed = [e for e in at_step if e["name"] == "commit_failed"]
+    if commits:
+        lines.append(
+            f"result: committed on {len({proc_key(e) for e in commits})} replica(s)"
+        )
+    if failed:
+        lines.append(
+            f"result: commit FAILED on {len({proc_key(e) for e in failed})} replica(s)"
+        )
+    if not commits and not failed:
+        lines.append("result: no commit event recorded at this step (never voted?)")
+
+    # Heal activity touching this step.
+    heal_spans = [e for e in at_step if e["name"] in ("heal_recv", "heal_send")]
+    for e in heal_spans:
+        args = e.get("args") or {}
+        who = proc_label(proc_key(e))
+        if e["name"] == "heal_recv":
+            lines.append(
+                f"heal: {who} received checkpoint from {args.get('donor', '?')} "
+                f"({_fmt_ms(float(e.get('dur', 0.0)))}, attempt {args.get('attempt', 0)})"
+            )
+        else:
+            lines.append(
+                f"heal: {who} served checkpoint to ranks {args.get('dst_ranks', '?')} "
+                f"({_fmt_ms(float(e.get('dur', 0.0)))})"
+            )
+    chunks = [e for e in at_step if e["name"] == "heal_chunk_recv"]
+    if chunks:
+        last = chunks[-1]
+        args = last.get("args") or {}
+        lines.append(
+            f"heal progress: {len(chunks)} chunk(s) verified, last chunk "
+            f"{args.get('chunk')} of {args.get('total_chunks')}"
+        )
+    fails = [e for e in at_step if e["name"] == "heal_attempt_failed"]
+    for e in fails:
+        args = e.get("args") or {}
+        lines.append(
+            f"heal FAILED: {proc_label(proc_key(e))} attempt "
+            f"{args.get('attempt')} from {args.get('donor')}: {args.get('error')}"
+        )
+
+    # Surrounding quorum transitions (step-1 .. step+1).
+    transitions = [
+        e for e in merged
+        if e["name"] == "quorum_change"
+        and e.get("step") is not None
+        and abs(e["step"] - step) <= 1
+    ]
+    for e in transitions:
+        args = e.get("args") or {}
+        lines.append(
+            f"quorum transition: q{args.get('old_quorum_id')} -> "
+            f"q{e.get('quorum_id')} observed by {proc_label(proc_key(e))} "
+            f"at step {e.get('step')} ({args.get('participants')} participants)"
+        )
+
+    incidents = sorted(
+        {
+            (e.get("args") or {}).get("incident")
+            for e in at_step
+            if e["name"] == "incident"
+        }
+        - {None}
+    )
+    if incidents:
+        lines.append(f"incidents: {', '.join(incidents)}")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
+    parser.add_argument("--dir", default="", help="journal dump directory")
+    parser.add_argument(
+        "--url", default="", help="comma-separated /trace.json endpoints"
+    )
+    parser.add_argument(
+        "--lighthouse",
+        default=os.environ.get("TPUFT_LIGHTHOUSE", ""),
+        help="lighthouse address for store-segment pulls",
+    )
+    parser.add_argument("--out", default="", help="write the merged chrome trace here")
+    parser.add_argument(
+        "--explain-step", type=int, default=None, metavar="N",
+        help="print the causal postmortem for step N",
+    )
+    args = parser.parse_args()
+
+    events: List[Dict[str, Any]] = []
+    if args.dir:
+        events.extend(load_dir(args.dir))
+    for url in filter(None, args.url.split(",")):
+        events.extend(load_url(url))
+    if args.lighthouse and not (args.dir or args.url):
+        events.extend(load_lighthouse(args.lighthouse))
+    if not events:
+        parser.error("no events loaded; pass --dir, --url, or --lighthouse")
+
+    offsets = estimate_offsets(events)
+    merged = merge_events(events, offsets)
+    procs = sorted({proc_key(e) for e in merged})
+    print(
+        f"merged {len(merged)} events from {len(procs)} process(es); "
+        "offsets: "
+        + ", ".join(f"{proc_label(p)}={offsets.get(p, 0.0) * 1e3:.1f}ms" for p in procs),
+        file=sys.stderr,
+    )
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(to_chrome(merged), f)
+        print(f"chrome trace written to {args.out}", file=sys.stderr)
+    if args.explain_step is not None:
+        print(explain_step(merged, args.explain_step))
+    elif not args.out:
+        for event in merged:
+            print(json.dumps(event))
+
+
+if __name__ == "__main__":
+    main()

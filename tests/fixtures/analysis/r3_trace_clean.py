@@ -1,0 +1,28 @@
+"""R3 clean twin (trace plane): the pattern the tree actually uses —
+journal events recorded around the barrier and inside the locked region
+(a lock-free deque append, fine either way), while the barrier itself
+stays outside the writer and every registered-state rebind stays
+inside it."""
+
+
+class GoodTracedOptimizer:
+    def __init__(self, manager, journal, params, opt_state):
+        self.manager = manager
+        self.journal = journal
+        self.params = params
+        self.opt_state = opt_state
+
+    def traced_sync(self, averaged):
+        self.journal.record("vote_send", step=1, vote=True)
+        with self.journal.span("commit_barrier", step=1):
+            committed = self.manager.should_commit()
+        if committed:
+            self.manager.disallow_state_dict_read()
+            try:
+                self.journal.record("adopt", step=1)
+                self.params = averaged
+            finally:
+                self.manager.allow_state_dict_read()
+        else:
+            self.journal.record("rollback", step=1)
+        return committed

@@ -1,0 +1,30 @@
+"""R3 fixture (trace plane): journal recording wrapped around a commit
+barrier reached INSIDE the state-dict write lock. Recording itself is a
+lock-free deque append and is always safe; what R3 must still catch is
+the barrier being held under the writer — a tracing span is not a license
+to move the barrier inside the locked region."""
+
+
+class BadTracedOptimizer:
+    def __init__(self, manager, journal, params, opt_state):
+        self.manager = manager
+        self.journal = journal
+        self.params = params  # __init__ is exempt (pre-sharing)
+        self.opt_state = opt_state
+
+    def traced_locked_barrier(self, averaged):
+        self.manager.disallow_state_dict_read()
+        try:
+            self.params = averaged
+            with self.journal.span("commit_barrier", step=1):
+                # VIOLATION: the barrier runs while the writer is held —
+                # the span around it changes nothing.
+                return self.manager.should_commit()
+        finally:
+            self.manager.allow_state_dict_read()
+
+    def traced_unlocked_mutation(self, averaged):
+        self.journal.record("rollback", step=2)
+        # VIOLATION: rebinds registered state with no writer held (the
+        # preceding journal append does not count as a lock).
+        self.params = averaged

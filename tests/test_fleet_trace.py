@@ -1,0 +1,561 @@
+"""Fleet trace merger tests (scripts/fleet_trace.py).
+
+Three layers, all pure python (no native toolchain):
+
+- unit: dedup/merge, barrier-anchor + clock-sample offset estimation, and
+  chrome-trace validity of the merged output;
+- golden: ``--explain-step`` on a recorded kill/heal fixture
+  (tests/fixtures/trace/ — regenerate with TPUFT_REGEN_FIXTURES=1);
+- drill: a threads-as-replicas kill/heal run (ft_harness style: real
+  Managers over a loopback PG, scripted coordination clients, one journal
+  per replica thread with a deliberately skewed wall clock) asserting the
+  merged timeline orders kill -> quorum change -> heal -> commit correctly
+  and that --explain-step names the killed replica, the quorum transition,
+  and the straggler deltas.
+"""
+
+import importlib.util
+import json
+import os
+import threading
+from pathlib import Path
+from typing import Any, Dict, List
+from unittest.mock import patch
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from test_manager import make_manager, make_quorum
+from test_zero import _LoopbackWorld, LoopbackPG
+
+from torchft_tpu import tracing
+from torchft_tpu.ddp import ft_allreduce_gradients
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURE_DIR = Path(__file__).resolve().parent / "fixtures" / "trace"
+REGEN = os.environ.get("TPUFT_REGEN_FIXTURES", "0") == "1"
+
+
+def _load_fleet_trace():
+    spec = importlib.util.spec_from_file_location(
+        "fleet_trace", REPO / "scripts" / "fleet_trace.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+fleet_trace = _load_fleet_trace()
+
+
+# ---------------------------------------------------------------------------
+# synthetic fixture: a deterministic two-replica kill/heal story
+# ---------------------------------------------------------------------------
+
+BASE = 1_700_000_000.0
+FIXTURE_SKEW = 30.0  # train_1's wall clock runs 30 s ahead of train_0's
+
+
+class _Journal:
+    def __init__(self, replica: str, skew: float, mono_base: float) -> None:
+        self.replica = replica
+        self.skew = skew
+        self.mono_base = mono_base
+        self.events: List[Dict[str, Any]] = []
+
+    def ev(self, name, t, ph="i", dur=None, step=None, q=-1, **args):
+        event = {
+            "job_id": "job",
+            "replica_id": self.replica,
+            "group_rank": 0,
+            "seq": len(self.events),
+            "name": name,
+            "ph": ph,
+            "cat": "ft",
+            "t_wall": round(BASE + t + self.skew, 6),
+            "t_mono": round(self.mono_base + t, 6),
+            "thread": "main",
+            "step": step,
+            "quorum_id": q,
+        }
+        if dur is not None:
+            event["dur"] = dur
+        if args:
+            event["args"] = args
+        self.events.append(event)
+
+
+def _build_fixture() -> Dict[str, List[Dict[str, Any]]]:
+    """Two journals telling one story: healthy steps 0-1, train_1 killed
+    at step 2, train_0 continues alone under q2, train_1 heals back under
+    q3 at step 3 (straggling into the commit barrier by 140 ms)."""
+    r0 = _Journal("train_0", 0.0, 100.0)
+    r1 = _Journal("train_1", FIXTURE_SKEW, 500.0)
+
+    # steps 0-1: healthy two-replica quorum q1. Barrier releases both
+    # replicas at the same fleet instant (the fine clock anchor).
+    for step, t0 in ((0, 0.0), (1, 0.3)):
+        for j, q_dur, wire_dur in ((r0, 0.005, 0.020), (r1, 0.003, 0.030)):
+            j.ev("quorum", t0, ph="X", dur=q_dur, step=step, q=1)
+        if step == 0:
+            for j in (r0, r1):
+                j.ev("quorum_change", t0 + 0.049, step=step, q=1,
+                     old_quorum_id=-1, participants=2)
+                j.ev("pg_configure", t0 + 0.05, ph="X", dur=0.002, step=step, q=1)
+        r0.ev("wire_bucket", t0 + 0.10, ph="X", dur=0.020, step=step, q=1,
+              bucket=0, bytes=4096, path="bucket")
+        r1.ev("wire_bucket", t0 + 0.10, ph="X", dur=0.030, step=step, q=1,
+              bucket=0, bytes=4096, path="bucket")
+        for j in (r0, r1):
+            j.ev("vote_send", t0 + 0.148, step=step, q=1, vote=True,
+                 enough_replicas=True, errored=False)
+        barrier_end = t0 + 0.200
+        r0.ev("commit_barrier", t0 + 0.150, ph="X", dur=barrier_end - (t0 + 0.150),
+              step=step, q=1, vote=True)
+        r1.ev("commit_barrier", t0 + 0.190, ph="X", dur=barrier_end - (t0 + 0.190),
+              step=step, q=1, vote=True)
+        for j in (r0, r1):
+            j.ev("commit", barrier_end + 0.001, step=step, q=1)
+
+    # step 2: train_1 dies mid-step; train_0's next quorum drops to one
+    # participant (q2) and commits alone.
+    r1.ev("report_error", 0.60, step=2, q=1,
+          error="InjectedFailure: killed replica train_1",
+          error_type="InjectedFailure")
+    r0.ev("quorum", 0.70, ph="X", dur=0.010, step=2, q=2)
+    r0.ev("quorum_change", 0.71, step=2, q=2, old_quorum_id=1, participants=1)
+    r0.ev("pg_configure", 0.711, ph="X", dur=0.002, step=2, q=2)
+    r0.ev("vote_send", 0.719, step=2, q=2, vote=True, enough_replicas=True,
+          errored=False)
+    r0.ev("commit_barrier", 0.72, ph="X", dur=0.020, step=2, q=2, vote=True)
+    r0.ev("commit", 0.741, step=2, q=2)
+
+    # step 3: train_1 rejoins under q3, heals from train_0, both commit.
+    r0.ev("quorum", 0.90, ph="X", dur=0.010, step=3, q=3)
+    r0.ev("quorum_change", 0.91, step=3, q=3, old_quorum_id=2, participants=2)
+    r0.ev("pg_configure", 0.911, ph="X", dur=0.002, step=3, q=3)
+    r1.ev("quorum", 0.90, ph="X", dur=0.012, step=2, q=3)
+    r1.ev("quorum_change", 0.912, step=2, q=3, old_quorum_id=-1, participants=2)
+    r1.ev("pg_configure", 0.913, ph="X", dur=0.002, step=2, q=3)
+    r0.ev("heal_send", 0.92, ph="X", dur=0.140, step=3, q=3, dst_ranks="[1]")
+    r1.ev("heal_recv", 0.92, ph="X", dur=0.150, step=3, q=3,
+          donor="train_0:29000", attempt=0)
+    for chunk, t in ((0, 0.95), (1, 0.99), (2, 1.03)):
+        r1.ev("heal_chunk_recv", t, step=3, q=3, chunk=chunk, bytes=1 << 20,
+              total_chunks=3)
+    r0.ev("wire_bucket", 1.10, ph="X", dur=0.020, step=3, q=3, bucket=0,
+          bytes=4096, path="bucket")
+    r1.ev("wire_bucket", 1.10, ph="X", dur=0.025, step=3, q=3, bucket=0,
+          bytes=4096, path="bucket")
+    for j in (r0, r1):
+        j.ev("vote_send", 1.148, step=3, q=3, vote=True, enough_replicas=True,
+             errored=False)
+    r0.ev("commit_barrier", 1.150, ph="X", dur=0.150, step=3, q=3, vote=True)
+    r1.ev("commit_barrier", 1.290, ph="X", dur=0.010, step=3, q=3, vote=True)
+    for j in (r0, r1):
+        j.ev("commit", 1.301, step=3, q=3)
+    return {"train_0": r0.events, "train_1": r1.events}
+
+
+def _fixture_paths() -> Dict[str, Path]:
+    return {
+        replica: FIXTURE_DIR / f"tpuft_trace_{replica}_0_killheal.jsonl"
+        for replica in ("train_0", "train_1")
+    }
+
+
+def _materialize_fixture() -> None:
+    FIXTURE_DIR.mkdir(parents=True, exist_ok=True)
+    for replica, events in _build_fixture().items():
+        path = _fixture_paths()[replica]
+        header = {
+            "trace_header": True,
+            "job_id": "job",
+            "replica_id": replica,
+            "group_rank": 0,
+            "reason": "fixture",
+            "incident": None,
+            "wall": BASE,
+            "mono": 0.0,
+            "clock_offset_s": None,
+            "dropped": 0,
+        }
+        with open(path, "w") as f:
+            f.write(json.dumps(header) + "\n")
+            for event in events:
+                f.write(json.dumps(event) + "\n")
+
+
+@pytest.fixture(scope="module")
+def fixture_events() -> List[Dict[str, Any]]:
+    if REGEN or not all(p.exists() for p in _fixture_paths().values()):
+        _materialize_fixture()
+    return fleet_trace.load_dir(str(FIXTURE_DIR))
+
+
+# ---------------------------------------------------------------------------
+# merge + offsets + chrome validity
+# ---------------------------------------------------------------------------
+
+
+def test_fixture_files_match_builder(fixture_events) -> None:
+    """The checked-in fixture IS the deterministic builder's output (so
+    the golden below is reviewable; regenerate with
+    TPUFT_REGEN_FIXTURES=1)."""
+    built = [e for events in _build_fixture().values() for e in events]
+    by_key = lambda e: (e["replica_id"], e["seq"])  # noqa: E731
+    assert sorted(fixture_events, key=by_key) == sorted(built, key=by_key)
+
+
+def test_offsets_recovered_from_barrier_anchors(fixture_events) -> None:
+    """train_1's 30 s wall skew is invisible to the merge: the shared
+    commit-barrier release instants pin its offset exactly."""
+    offsets = fleet_trace.estimate_offsets(fixture_events)
+    assert offsets[("train_0", 0)] == 0.0
+    assert offsets[("train_1", 0)] == pytest.approx(FIXTURE_SKEW, abs=1e-6)
+
+
+def test_offsets_fall_back_to_clock_samples() -> None:
+    """Processes that never share a barrier (disjoint quorums, or a dump
+    cut short) still align coarsely through their store beacon samples."""
+    events = []
+    for replica, offset in (("a", 2.0), ("b", 12.0)):
+        events.append(
+            {
+                "replica_id": replica, "group_rank": 0, "seq": 0,
+                "name": "clock_sample", "ph": "i", "cat": "clock",
+                "t_wall": BASE + offset, "t_mono": 0.0, "thread": "main",
+                "step": None, "quorum_id": -1,
+                "args": {"offset_s": offset, "window_s": 0.1},
+            }
+        )
+        # 'a' gets more events so it becomes the reference.
+        if replica == "a":
+            events.append({**events[-1], "seq": 1})
+    offsets = fleet_trace.estimate_offsets(events)
+    assert offsets[("a", 0)] == 0.0
+    assert offsets[("b", 0)] == pytest.approx(10.0)
+
+
+def test_merge_dedups_and_orders_causally(fixture_events) -> None:
+    """Dedup by (process, seq); the merged order tells the kill/heal story
+    despite the 30 s skew: kill -> quorum shrink -> heal -> commit."""
+    merged = fleet_trace.merge_events(fixture_events + fixture_events[:10])
+    assert len(merged) == len(fixture_events)
+
+    def index(predicate):
+        return next(i for i, e in enumerate(merged) if predicate(e))
+
+    kill = index(lambda e: e["name"] == "report_error")
+    shrink = index(
+        lambda e: e["name"] == "quorum_change" and e["quorum_id"] == 2
+    )
+    heal = index(lambda e: e["name"] == "heal_recv")
+    commit3 = index(lambda e: e["name"] == "commit" and e["step"] == 3)
+    assert kill < shrink < heal < commit3
+    # Aligned wall: the skewed replica's events land in the reference
+    # frame (kill at ~BASE+0.60, not BASE+30.60).
+    kill_event = merged[kill]
+    assert kill_event["t_aligned"] == pytest.approx(BASE + 0.60, abs=1e-3)
+    # Per-process seq order survives every sort pass.
+    last_seq: Dict[Any, int] = {}
+    for event in merged:
+        key = (event["replica_id"], event["group_rank"])
+        assert last_seq.get(key, -1) < event["seq"]
+        last_seq[key] = event["seq"]
+
+
+def test_chrome_export_is_valid_and_loadable(fixture_events, tmp_path) -> None:
+    """The merged output is a structurally valid chrome trace (the format
+    perfetto/chrome://tracing load): traceEvents array, process/thread
+    metadata naming every track, X events with ts+dur, instants with a
+    scope."""
+    merged = fleet_trace.merge_events(fixture_events)
+    chrome = fleet_trace.to_chrome(merged)
+    path = tmp_path / "merged_trace.json"
+    path.write_text(json.dumps(chrome))
+    loaded = json.loads(path.read_text())
+    events = loaded["traceEvents"]
+    assert isinstance(events, list) and events
+    assert loaded["displayTimeUnit"] == "ms"
+    phases = {e["ph"] for e in events}
+    assert phases <= {"X", "i", "M"}
+    proc_names = {
+        e["args"]["name"] for e in events
+        if e["ph"] == "M" and e["name"] == "process_name"
+    }
+    assert proc_names == {"train_0/0", "train_1/0"}
+    pids = {e["pid"] for e in events if e["ph"] != "M"}
+    assert len(pids) == 2  # one track per replica
+    for event in events:
+        if event["ph"] == "X":
+            assert isinstance(event["ts"], float) and event["dur"] >= 0
+        if event["ph"] == "i":
+            assert event["s"] == "t"
+    # Spans carry the causal tuple for perfetto's args pane.
+    span = next(e for e in events if e["ph"] == "X")
+    assert "step" in span["args"] and "quorum_id" in span["args"]
+
+
+def test_explain_step_golden(fixture_events) -> None:
+    """--explain-step 3 on the recorded fixture: the full causal
+    narrative, pinned as a golden (TPUFT_REGEN_FIXTURES=1 rewrites)."""
+    merged = fleet_trace.merge_events(fixture_events)
+    text = fleet_trace.explain_step(merged, 3)
+    golden_path = FIXTURE_DIR / "killheal_explain_step3.txt"
+    if REGEN or not golden_path.exists():
+        golden_path.write_text(text + "\n")
+    assert text + "\n" == golden_path.read_text()
+    # And the load-bearing facts, independent of formatting:
+    assert "train_1/0 entered last, +140.0ms" in text
+    assert "heal: train_1/0 received checkpoint from train_0:29000" in text
+    assert "q2 -> q3" in text
+    assert "committed on 2 replica(s)" in text
+
+
+def test_explain_step_kill_step(fixture_events) -> None:
+    merged = fleet_trace.merge_events(fixture_events)
+    text = fleet_trace.explain_step(merged, 2)
+    assert "killed replica train_1" in text  # the report_error narrative
+    assert "q1 -> q2" in text
+    assert "committed on 1 replica(s)" in text
+
+
+def test_explain_step_out_of_range(fixture_events) -> None:
+    merged = fleet_trace.merge_events(fixture_events)
+    text = fleet_trace.explain_step(merged, 99)
+    assert "no events at step 99" in text
+    assert "0..3" in text
+
+
+# ---------------------------------------------------------------------------
+# the drill: threads-as-replicas kill/heal over a loopback PG
+# ---------------------------------------------------------------------------
+
+DRILL_SKEW = 120.0  # train_1's wall clock runs 2 minutes ahead
+
+
+def _drill_manager(tag: str, pg, journal, **kwargs):
+    """A real Manager over the loopback PG with a scripted coordination
+    client, identity pinned to ``tag``, journal = the calling thread's."""
+    with tracing.use_journal(journal):
+        manager, client, _pg, transport = make_manager(
+            pg=pg, min_replica_size=1, **kwargs
+        )
+        manager._replica_id = f"{tag}:uuid"
+        manager._metric_labels = {"replica_id": tag, "group_rank": "0"}
+        manager._trace.configure(replica_id=tag, group_rank=0)
+        client.should_commit.side_effect = (
+            lambda rank, step, vote, timeout: vote
+        )
+    return manager, client, transport
+
+
+def test_kill_heal_drill_merged_timeline() -> None:
+    """The tier-1 acceptance drill: two thread-replicas train over a
+    loopback PG, replica train_1 is killed at step 2 (report_error funnel,
+    ft_harness style), train_0 shrinks to a one-replica quorum and keeps
+    committing, a restarted train_1 heals back in under a new quorum, and
+    both commit step 3+ together. Each replica records into its own
+    journal with train_1's wall clock 120 s ahead; the merged timeline
+    must still read kill -> quorum change -> heal -> commit, and
+    --explain-step must name the killed replica, the quorum transition,
+    and the straggler deltas."""
+    world = _LoopbackWorld(2, timeout=60.0)
+    j0 = tracing.TraceJournal(maxlen=4096)
+    j1 = tracing.TraceJournal(
+        maxlen=4096, wall=lambda: __import__("time").time() + DRILL_SKEW
+    )
+    killed = threading.Event()
+    donor_state = {
+        "user": {"model": {"w": np.full(2, 7.0)}},
+        "tpuft": {"step": 3, "batches_committed": 6},
+    }
+    grads = {"g": jnp.ones((4,), jnp.float32)}
+    errors: List[BaseException] = []
+
+    def quorum_script(results):
+        it = iter(results)
+        return lambda **kwargs: next(it)
+
+    # Managers are constructed sequentially on this thread: make_manager's
+    # ManagerClient patch is process-global, so two replica threads
+    # patching concurrently would race (one manager would capture the real
+    # class). The journal is passed explicitly, so capture still lands on
+    # the right replica timeline.
+    manager_a, client_a, _transport_a = _drill_manager(
+        "train_0", LoopbackPG(world, 0), j0
+    )
+    manager_b0, client_b0, _transport_b0 = _drill_manager(
+        "train_1", LoopbackPG(world, 1), j1
+    )
+
+    def run_a():
+        with tracing.use_journal(j0):
+            manager, client = manager_a, client_a
+            client._quorum.side_effect = quorum_script(
+                [
+                    make_quorum(quorum_id=1, replica_rank=0,
+                                replica_world_size=2, max_rank=0,
+                                max_world_size=2),
+                    make_quorum(quorum_id=1, replica_rank=0,
+                                replica_world_size=2, max_rank=0,
+                                max_world_size=2),
+                    make_quorum(quorum_id=2, replica_rank=0,
+                                replica_world_size=1, max_rank=0,
+                                max_world_size=1),
+                    make_quorum(quorum_id=3, replica_rank=0,
+                                replica_world_size=2, max_rank=0,
+                                max_world_size=2,
+                                recover_dst_replica_ranks=[1], max_step=3),
+                    make_quorum(quorum_id=3, replica_rank=0,
+                                replica_world_size=2, max_rank=0,
+                                max_world_size=2),
+                ]
+            )
+            for step in range(5):
+                if step == 2:
+                    killed.wait(timeout=30)  # the kill precedes the shrink
+                manager.start_quorum()
+                manager.wait_quorum()
+                if manager.num_participants() == 2:
+                    ft_allreduce_gradients(manager, grads)
+                assert manager.should_commit()
+
+    def run_b():
+        with tracing.use_journal(j1):
+            manager, client = manager_b0, client_b0
+            client._quorum.side_effect = quorum_script(
+                [
+                    make_quorum(quorum_id=1, replica_rank=1,
+                                replica_world_size=2, max_rank=1,
+                                max_world_size=2),
+                    make_quorum(quorum_id=1, replica_rank=1,
+                                replica_world_size=2, max_rank=1,
+                                max_world_size=2),
+                ]
+            )
+            for step in range(2):
+                manager.start_quorum()
+                manager.wait_quorum()
+                ft_allreduce_gradients(manager, grads)
+                assert manager.should_commit()
+            # The injected kill: the comm-layer funnel records it, then the
+            # "process" dies (thread keeps running to play the restart).
+            manager.report_error(
+                RuntimeError("InjectedFailure: killed replica train_1")
+            )
+            manager.shutdown(wait=False)
+            killed.set()
+
+            # Supervised restart: a fresh Manager on the same journal heals
+            # from train_0 under quorum 3 and rejoins the wire.
+            manager, client, transport = _drill_manager(
+                "train_1", LoopbackPG(world, 1), j1
+            )
+            transport.recv_checkpoint.return_value = donor_state
+            client._quorum.side_effect = quorum_script(
+                [
+                    make_quorum(quorum_id=3, replica_rank=1,
+                                replica_world_size=2, max_rank=1,
+                                max_world_size=2, heal=True, max_step=3,
+                                recover_src_manager_address="train_0:1",
+                                recover_src_replica_rank=0),
+                    make_quorum(quorum_id=3, replica_rank=1,
+                                replica_world_size=2, max_rank=1,
+                                max_world_size=2),
+                ]
+            )
+            with patch(
+                "torchft_tpu.manager.ManagerClient", autospec=True
+            ) as primary_cls:
+                primary_cls.return_value._checkpoint_metadata.return_value = (
+                    "http://train_0:0"
+                )
+                manager.start_quorum()  # sync quorum: heal applies eagerly
+            assert manager.current_step() == 3
+            for _ in range(2):  # steps 3, 4 back on the wire
+                ft_allreduce_gradients(manager, grads)
+                assert manager.should_commit()
+                if manager.current_step() < 5:
+                    manager.start_quorum()
+                    manager.wait_quorum()
+
+    def runner(fn):
+        try:
+            fn()
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+            killed.set()  # never deadlock the peer
+
+    threads = [
+        threading.Thread(target=runner, args=(fn,), name=name)
+        for fn, name in ((run_a, "replica_a"), (run_b, "replica_b"))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=90)
+    assert not errors, errors[0]
+
+    events = j0.snapshot() + j1.snapshot()
+    offsets = fleet_trace.estimate_offsets(events)
+    # Barrier anchors recover the 2-minute skew to well under a second
+    # (residual = thread scheduling jitter between the two mocked barrier
+    # returns).
+    assert offsets[("train_1", 0)] == pytest.approx(DRILL_SKEW, abs=2.0)
+
+    merged = fleet_trace.merge_events(events, offsets)
+
+    def index(predicate):
+        matches = [i for i, e in enumerate(merged) if predicate(e)]
+        assert matches, "event missing from merged timeline"
+        return matches[0]
+
+    kill = index(
+        lambda e: e["name"] == "report_error"
+        and "InjectedFailure" in (e.get("args") or {}).get("error", "")
+    )
+    shrink = index(
+        lambda e: e["name"] == "quorum_change" and e["quorum_id"] == 2
+    )
+    heal = index(lambda e: e["name"] == "heal_recv")
+    commit3 = index(
+        lambda e: e["name"] == "commit" and e["step"] == 3
+        and e["replica_id"] == "train_1"
+    )
+    assert kill < shrink < heal < commit3, (
+        "merged timeline must order kill -> quorum change -> heal -> commit"
+    )
+
+    # --explain-step on the drill: the kill step names the killed replica
+    # and the quorum transition...
+    text_kill = fleet_trace.explain_step(merged, 2)
+    assert "train_1/0" in text_kill and "InjectedFailure" in text_kill
+    assert "q1 -> q2" in text_kill
+
+    # ...and a shared step attributes the straggler with the right delta
+    # (computed independently from the journals here).
+    shared_step = 4
+    waits = {}
+    for e in merged:
+        if (
+            e["name"] == "commit_barrier"
+            and e.get("ph") == "X"
+            and e["step"] == shared_step
+        ):
+            waits[(e["replica_id"], e["group_rank"])] = e["dur"]
+    assert len(waits) == 2
+    straggler = min(waits, key=lambda k: waits[k])  # least wait = last in
+    lag = max(waits.values()) - waits[straggler]
+    text_shared = fleet_trace.explain_step(merged, shared_step)
+    assert (
+        f"{straggler[0]}/{straggler[1]} entered last, "
+        f"+{lag * 1e3:.1f}ms" in text_shared
+    )
+    assert "committed on 2 replica(s)" in text_shared
+
+    # Heal narrative present at step 3.
+    text_heal = fleet_trace.explain_step(merged, 3)
+    assert "received checkpoint from train_0:1" in text_heal

@@ -463,9 +463,21 @@ def test_chrome_trace_thread_names_and_span_args(tmp_path) -> None:
     # tids are distinct and every span's tid has a name event.
     assert {s["tid"] for s in spans} == {m["tid"] for m in meta}
     main_span = next(s for s in spans if s["name"] == "tpuft::test::main")
+    # Fleet-merge metadata (trace-plane satellite): every span also carries
+    # the replica identity so a single-process capture drops cleanly into
+    # a merged fleet trace.
+    replica = main_span["args"].pop("replica_id")
     assert main_span["args"] == {"step": 3, "quorum_id": 7}
     worker_span = next(s for s in spans if s["name"] == "tpuft::test::worker")
+    assert worker_span["args"].pop("replica_id") == replica
     assert worker_span["args"] == {"step": 3}
+    payload = json.loads(path.read_text())
+    assert payload["otherData"]["replica_id"] == replica
+    assert "clock_offset_ms" in payload["otherData"]
+    proc_meta = [
+        e for e in events if e["ph"] == "M" and e["name"] == "process_name"
+    ]
+    assert len(proc_meta) == 1 and replica in proc_meta[0]["args"]["name"]
 
 
 # ---------------------------------------------------------------------------
@@ -541,8 +553,8 @@ def test_fleet_status_render_and_extractors() -> None:
     assert "quorum_id=3" in lines[0] and "replicas=2" in lines[0]
     assert lines[1].split() == [
         "REPLICA", "RANK", "STEP", "STEP/S", "COMMITS", "FAILED", "HEALS",
-        "SERVE", "SHARD", "LAST", "COMMIT", "HEALING", "HB", "AGE", "MS",
-        "PUSH", "AGE",
+        "SERVE", "SHARD", "LAG", "LAST", "COMMIT", "HEALING", "HB", "AGE",
+        "MS", "PUSH", "AGE",
     ]
     assert "train_0:uuid" in text and "1.25" in text and "1.0s" in text
     # The dead replica renders dashes, not a crash.

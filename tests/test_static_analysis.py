@@ -88,6 +88,24 @@ def test_r3_clean_fixture() -> None:
     assert scan("r3_clean.py") == []
 
 
+def test_r3_trace_violation_fixture() -> None:
+    """The trace-plane lock invariant: journal recording sites never hold
+    the state-dict lock across a commit barrier. A tracing span wrapped
+    around a barrier inside the writer is still a barrier inside the
+    writer, and a journal append before an unlocked rebind is not a
+    lock."""
+    findings = scan("r3_trace_violation.py", rules=["lock-discipline"])
+    messages = [f.message for f in findings]
+    assert sum("barrier" in m for m in messages) == 1
+    assert sum("without the state-dict writer" in m for m in messages) == 1
+
+
+def test_r3_trace_clean_fixture() -> None:
+    """Recording around the barrier (and inside the locked adopt) is the
+    shipped pattern — a lock-free deque append, clean under R3."""
+    assert scan("r3_trace_clean.py") == []
+
+
 def test_r4_violation_fixture() -> None:
     findings = scan("r4_violation.py", rules=["unjitted-optax"])
     assert len(findings) == 2

@@ -68,7 +68,7 @@ from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 import jax
 
-from torchft_tpu import metrics
+from torchft_tpu import metrics, tracing
 from torchft_tpu._safe_pickle import safe_loads
 from torchft_tpu.utils import faultinject, netem
 from torchft_tpu.checkpointing import _serialization
@@ -609,6 +609,15 @@ class HTTPTransport(CheckpointTransport[Any]):
                         _serialization.write_prepared(chunk, out)
                     except (ConnectionError, TimeoutError, OSError):
                         self.close_connection = True
+                    else:
+                        # Donor-side heal progress for the fleet timeline
+                        # (pairs with the joiner's heal_chunk_recv).
+                        tracing.record(
+                            "heal_chunk_serve",
+                            step=step,
+                            chunk=index,
+                            bytes=int(chunk.total_size),
+                        )
                 transport._served_event.set()
 
         class DualStackServer(ThreadingHTTPServer):
@@ -957,6 +966,16 @@ class HTTPTransport(CheckpointTransport[Any]):
             # restarting donor, truncation, checksum mismatch).
             entry.chunks[i] = _fetch_retry(
                 f"{base}/{i}{era_tag}", timeout, consume=consume
+            )
+            # Heal progress in the fleet timeline: one instant per verified
+            # chunk, so --explain-step can show how far along a heal was at
+            # any moment (and which chunk a stall died on).
+            tracing.record(
+                "heal_chunk_recv",
+                step=step,
+                chunk=i,
+                bytes=int(entry.chunks[i][1]),
+                total_chunks=num_chunks,
             )
 
         if len(missing) <= 1:

@@ -113,7 +113,7 @@ def _load_by_path(name: str, path: Path):
 
 
 if __package__:
-    from torchft_tpu import metrics
+    from torchft_tpu import metrics, tracing
     from torchft_tpu.utils import faultinject, netem
 else:  # pragma: no cover - exercised only inside the spawned child
     _PKG = Path(__file__).resolve().parent.parent
@@ -603,6 +603,10 @@ class ServeChild:
                 f"serving child not ready within {self._ready_timeout}s"
             )
         metrics.set_gauge("tpuft_heal_serve_child_up", 1)
+        tracing.record(
+            "serve_child_spawn", cat="serve_child",
+            pid=proc.pid, port=self._port,
+        )
 
     def _watch(self, proc: subprocess.Popen) -> None:
         try:
@@ -626,6 +630,9 @@ class ServeChild:
             self.crashes += 1
             metrics.inc("tpuft_heal_serve_child_crashes_total")
             metrics.set_gauge("tpuft_heal_serve_child_up", 0)
+            tracing.record(
+                "serve_child_crash", cat="serve_child", rc=rc, pid=proc.pid
+            )
             crash = ServeChildCrashed(
                 f"heal-serving child exited rc={rc} with a heal window "
                 f"possibly open; joiners fail over via the resume cache"
@@ -638,7 +645,16 @@ class ServeChild:
             if self._restarts < self._max_restarts:
                 self._restarts += 1
                 metrics.inc("tpuft_heal_serve_child_restarts_total")
+                tracing.record(
+                    "serve_child_respawn", cat="serve_child",
+                    restart=self._restarts,
+                )
                 self._spawn()
+            else:
+                tracing.record(
+                    "serve_child_degraded", cat="serve_child",
+                    restarts=self._restarts,
+                )
         except Exception as e:  # noqa: BLE001 — watcher must not die silently
             logger.exception(f"serve-child watcher failed: {e}")
 

@@ -26,7 +26,7 @@ from typing import Any, List
 import jax
 import numpy as np
 
-from torchft_tpu import metrics
+from torchft_tpu import metrics, tracing
 from torchft_tpu.manager import Manager
 from torchft_tpu.utils.transfer import prefetch_to_host
 from torchft_tpu.work import Work
@@ -171,13 +171,15 @@ def ft_allreduce_gradients(
     # later buckets' waits overlap earlier returns, so the histogram reads
     # as "time this bucket held the step up", not raw link occupancy.
     out: List[Any] = [None] * len(leaves)
-    for members, work in zip(buckets, works):
+    journal = getattr(manager, "_trace", None) or tracing.current()
+    for bucket_index, (members, work) in enumerate(zip(buckets, works)):
         wire_t0 = time.perf_counter()
         flat = np.asarray(work.wait())
-        metrics.observe(
-            "tpuft_wire_bucket_seconds",
-            time.perf_counter() - wire_t0,
-            path="bucket",
+        wire_dt = time.perf_counter() - wire_t0
+        metrics.observe("tpuft_wire_bucket_seconds", wire_dt, path="bucket")
+        journal.record(
+            "wire_bucket", ph="X", dur=wire_dt,
+            bucket=bucket_index, bytes=int(flat.nbytes), path="bucket",
         )
         offset = 0
         for i in members:
@@ -292,13 +294,17 @@ def _ft_allreduce_gradients_fp8(manager: Manager, grads: Any) -> Any:
                     ).wait()
                 )
             )
-        for (members, dequantize, _, _), future in zip(quantized, futures):
+        journal = getattr(manager, "_trace", None) or tracing.current()
+        for bucket_index, ((members, dequantize, _, _), future) in enumerate(
+            zip(quantized, futures)
+        ):
             wire_t0 = time.perf_counter()
             result = future.result()
-            metrics.observe(
-                "tpuft_wire_bucket_seconds",
-                time.perf_counter() - wire_t0,
-                path="fp8",
+            wire_dt = time.perf_counter() - wire_t0
+            metrics.observe("tpuft_wire_bucket_seconds", wire_dt, path="fp8")
+            journal.record(
+                "wire_bucket", ph="X", dur=wire_dt,
+                bucket=bucket_index, path="fp8",
             )
             if result is None:
                 # Allreduce failed (error already reported; the step will

@@ -58,6 +58,9 @@ KNOWN_ENV = {
     # Correctness tooling: runtime lock-order detector + static analyzer
     # (python -m torchft_tpu.analysis; docs/static_analysis.md).
     "TPUFT_LOCK_CHECK", "TPUFT_ANALYSIS_REFERENCE", "TPUFT_ANALYSIS_BASELINE",
+    # Fleet trace plane (torchft_tpu/tracing.py): recording switch, journal
+    # ring size, store clock-beacon sampling switch.
+    "TPUFT_TRACE", "TPUFT_TRACE_SIZE", "TPUFT_TRACE_CLOCK",
     # Repo tooling outside the package (tests/benchmarks/sentinel) — real
     # knobs a user may have exported; not typos.
     "TPUFT_SOAK_SECONDS", "TPUFT_SOAK_SEED",
@@ -202,6 +205,55 @@ def _check_metrics() -> Tuple[str, str]:
     return "PASS", f"/metrics on :{port} serving {n_series} series"
 
 
+def _check_trace() -> Tuple[str, str]:
+    """Fleet trace plane preflight: validates the TPUFT_TRACE* knobs and
+    probes the local /trace.json surface when a metrics port is up.
+    WARN, never FAIL: the trace plane is observability — a dead journal
+    endpoint must not block a launch."""
+    from torchft_tpu import tracing
+
+    if os.environ.get(tracing.ENV_TRACE, "1") == "0":
+        return "PASS", f"trace plane off ({tracing.ENV_TRACE}=0)"
+    size_raw = os.environ.get(tracing.ENV_SIZE)
+    if size_raw is not None:
+        try:
+            if int(size_raw) < 1:
+                raise ValueError
+        except ValueError:
+            return "WARN", f"{tracing.ENV_SIZE}={size_raw!r} is not a positive int"
+    value = os.environ.get("TPUFT_METRICS_PORT", "")
+    if not value:
+        return (
+            "PASS",
+            "trace plane on (journal in-process; set TPUFT_METRICS_PORT to "
+            "also serve GET /trace.json)",
+        )
+    try:
+        port = int(value)
+    except ValueError:
+        return "PASS", "trace plane on (metrics port unparseable; see metrics check)"
+    import json as _json
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/trace.json", timeout=5
+        ) as resp:
+            payload = _json.loads(resp.read().decode(errors="replace"))
+    except Exception as e:  # noqa: BLE001 — WARN, never FAIL, on any probe error
+        return (
+            "WARN",
+            f"no /trace.json listener on 127.0.0.1:{port} ({e}) — is a "
+            "replica (or metrics.maybe_start_http_server) running here?",
+        )
+    n_events = len(payload.get("events", []))
+    return (
+        "PASS",
+        f"/trace.json on :{port} serving {n_events} journal events "
+        f"(replica {payload.get('replica_id')}/{payload.get('group_rank')})",
+    )
+
+
 def _check_heal_serve() -> Tuple[str, str]:
     """Heal-serving sidecar preflight: validates the mode switch and
     probes the shared-memory snapshot directory (a write + unlink).
@@ -318,6 +370,7 @@ def run_checks(lighthouse: str, skip_device: bool = False) -> int:
         ("wire codecs", _check_kernels),
         ("env vars", _check_env),
         ("metrics", _check_metrics),
+        ("trace plane", _check_trace),
         ("heal serving", _check_heal_serve),
         ("zero plane", lambda: _check_zero(lighthouse)),
         ("lighthouse", lambda: _check_lighthouse(lighthouse)),

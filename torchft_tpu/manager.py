@@ -45,7 +45,7 @@ from typing import Any, Callable, Dict, List, Optional, TypeVar, cast
 
 import numpy as np
 
-from torchft_tpu import metrics
+from torchft_tpu import metrics, tracing
 from torchft_tpu.checkpointing import CheckpointTransport, HTTPTransport
 from torchft_tpu.checkpointing._rwlock import RWLock
 from torchft_tpu.coordination import ManagerClient, ManagerServer
@@ -396,6 +396,26 @@ class Manager:
         self._metrics_last_push = 0.0
         metrics.maybe_start_http_server()
 
+        # Trace plane: this manager's journal is whatever journal is
+        # current on the CONSTRUCTING thread (threads-as-replicas drills
+        # install one per replica thread; real processes get the process
+        # default), captured here so events recorded from the quorum
+        # thread still land in this replica's journal. Identity uses the
+        # stable replica id — restarts of the same group continue one
+        # timeline, exactly like the metric labels.
+        self._trace = tracing.current()
+        self._trace.configure(
+            job_id=os.environ.get("JOB_ID", "unknown"),
+            replica_id=self._metric_labels["replica_id"],
+            group_rank=self._group_rank,
+        )
+        self._trace.set_step(self._step, self._quorum_id)
+        self._trace_clock = tracing.StoreClockSampler(
+            self._trace,
+            owner_key=f"{self._metric_labels['replica_id']}/{self._group_rank}",
+            claim=self._group_rank == 0,
+        )
+
     # ------------------------------------------------------------------
     # state dict registry
     # ------------------------------------------------------------------
@@ -682,6 +702,13 @@ class Manager:
         comm layer is reconfigured on the next quorum."""
         self._errored = ExceptionWithTraceback(e)
         metrics.inc("tpuft_errors_total", **self._metric_labels)
+        self._trace.record(
+            "report_error",
+            step=self._step,
+            quorum_id=self._quorum_id,
+            error=str(e),
+            error_type=type(e).__name__,
+        )
         errors_logger.info(
             "error",
             extra={
@@ -788,18 +815,37 @@ class Manager:
     def _async_quorum(
         self, allow_heal: bool, shrink_only: bool, quorum_timeout: float
     ) -> None:
-        with trace_span(
-            "tpuft::manager::_client::_quorum", step=self._step
-        ), metrics.timer("tpuft_quorum_seconds", **self._metric_labels):
-            quorum = self._client._quorum(
-                group_rank=self._group_rank,
-                step=self._step,
-                checkpoint_metadata=self._checkpoint_transport.metadata(),
-                shrink_only=shrink_only,
-                init_sync=self._init_sync,
-                commit_failures=self._commit_failures,
-                timeout=quorum_timeout,
+        try:
+            with trace_span(
+                "tpuft::manager::_client::_quorum", step=self._step
+            ), metrics.timer(
+                "tpuft_quorum_seconds", **self._metric_labels
+            ), self._trace.span("quorum", step=self._step):
+                quorum = self._client._quorum(
+                    group_rank=self._group_rank,
+                    step=self._step,
+                    checkpoint_metadata=self._checkpoint_transport.metadata(),
+                    shrink_only=shrink_only,
+                    init_sync=self._init_sync,
+                    commit_failures=self._commit_failures,
+                    timeout=quorum_timeout,
+                )
+        except Exception as e:
+            # A quorum that never resolves is supervisor-restart territory
+            # (the exception escalates out of the quorum future): stamp the
+            # shared incident id so every process that timed out on the
+            # same quorum dumps a correlatable journal + flight-recorder
+            # ring under $TPUFT_FLIGHT_RECORDER.
+            kind = (
+                "quorum_timeout"
+                if isinstance(e, TimeoutError) or "timed out" in str(e).lower()
+                else "quorum_error"
             )
+            tracing.open_incident(
+                kind, self._step, self._quorum_id,
+                journal=self._trace, reason=str(e),
+            )
+            raise
 
         # Participation bookkeeping: async quorum means a healing replica
         # sits out this step (max-step cohort participates); sync quorum
@@ -827,9 +873,23 @@ class Manager:
             self._participating_replica_world_size,
             **self._metric_labels,
         )
+        self._trace.record(
+            "quorum_ready",
+            step=self._step,
+            quorum_id=quorum.quorum_id,
+            participants=self._participating_replica_world_size,
+            heal=bool(quorum.heal),
+        )
 
         if quorum.quorum_id != self._quorum_id:
             metrics.inc("tpuft_quorum_changes_total", **self._metric_labels)
+            self._trace.record(
+                "quorum_change",
+                step=self._step,
+                quorum_id=quorum.quorum_id,
+                old_quorum_id=self._quorum_id,
+                participants=self._participating_replica_world_size,
+            )
             quorums_logger.info(
                 "quorum",
                 extra={
@@ -863,7 +923,11 @@ class Manager:
                     "tpuft::manager::_pg::configure",
                     quorum_id=quorum.quorum_id,
                     step=self._step,
-                ), metrics.timer("tpuft_pg_configure_seconds", **self._metric_labels):
+                ), metrics.timer(
+                    "tpuft_pg_configure_seconds", **self._metric_labels
+                ), self._trace.span(
+                    "pg_configure", step=self._step, quorum_id=quorum.quorum_id
+                ):
                     self._pg.configure(
                         store_prefixed_addr,
                         self._replica_id,
@@ -872,6 +936,7 @@ class Manager:
                     )
                 metrics.inc("tpuft_pg_configure_total", **self._metric_labels)
                 self._quorum_id = quorum.quorum_id
+                self._trace.set_step(self._step, self._quorum_id)
             except Exception as e:  # noqa: BLE001
                 self._logger.exception(f"got exception in pg configure: {e}")
                 self.report_error(e)
@@ -897,6 +962,11 @@ class Manager:
                         step=quorum.max_step,
                     ), metrics.timer(
                         "tpuft_heal_send_seconds", **self._metric_labels
+                    ), self._trace.span(
+                        "heal_send",
+                        step=quorum.max_step,
+                        quorum_id=quorum.quorum_id,
+                        dst_ranks=str(list(quorum.recover_dst_replica_ranks)),
                     ):
                         self._checkpoint_transport.send_checkpoint(
                             dst_ranks=quorum.recover_dst_replica_ranks,
@@ -972,6 +1042,12 @@ class Manager:
                 step=quorum.max_step,
             ), metrics.timer(
                 "tpuft_heal_recv_seconds", **self._metric_labels
+            ), self._trace.span(
+                "heal_recv",
+                step=quorum.max_step,
+                quorum_id=quorum.quorum_id,
+                donor=src_addr,
+                attempt=self._heal_attempts,
             ):
                 self._pending_state_dict = self._checkpoint_transport.recv_checkpoint(
                     src_rank=quorum.recover_src_replica_rank,
@@ -985,6 +1061,7 @@ class Manager:
             # applied from the main thread when safe.
             self.load_state_dict(self._pending_state_dict["tpuft"])
             self._step = quorum.max_step
+            self._trace.set_step(self._step)
             self._heal_attempts = 0
             self._heal_last_failed_donor = None
             self._heal_failed_donors.clear()
@@ -994,8 +1071,21 @@ class Manager:
                 self._heal_last_failed_donor = src_addr
                 self._heal_failed_donors[src_addr] = True
             self._logger.exception(f"got exception in recovery: {e}")
+            self._trace.record(
+                "heal_attempt_failed",
+                step=quorum.max_step,
+                quorum_id=quorum.quorum_id,
+                donor=src_addr,
+                attempt=self._heal_attempts,
+                error=str(e),
+            )
             self.report_error(e)
             if self._heal_attempts >= self._heal_max_attempts:
+                tracing.open_incident(
+                    "heal_exhausted", quorum.max_step, quorum.quorum_id,
+                    journal=self._trace,
+                    reason=f"{self._heal_attempts} attempts, last donor {src_addr}",
+                )
                 raise HealExhaustedError(
                     f"{self._heal_attempts} consecutive heal attempts failed "
                     f"(last donor {src_addr}); escalating to the supervisor "
@@ -1074,17 +1164,43 @@ class Manager:
 
         enough_replicas = self.num_participants() >= self._min_replica_size
         local_should_commit = enough_replicas and self._errored is None
+        self._trace.record(
+            "vote_send",
+            step=self._step,
+            quorum_id=self._quorum_id,
+            vote=local_should_commit,
+            enough_replicas=enough_replicas,
+            errored=self._errored is not None,
+        )
+        barrier_t0 = time.perf_counter()
         with trace_span(
             "tpuft::manager::should_commit",
             step=self._step,
             quorum_id=self._quorum_id,
-        ), metrics.timer("tpuft_commit_barrier_seconds", **self._metric_labels):
+        ), metrics.timer(
+            "tpuft_commit_barrier_seconds", **self._metric_labels
+        ), self._trace.span(
+            "commit_barrier",
+            step=self._step,
+            quorum_id=self._quorum_id,
+            vote=local_should_commit,
+        ):
             should_commit = self._client.should_commit(
                 self._group_rank,
                 self._step,
                 local_should_commit,
                 timeout=timeout or self._timeout,
             )
+        # The barrier releases every local rank together, so the rank that
+        # entered LAST waited LEAST — fleet_status derives its STRAGGLER/
+        # LAG column from this gauge across the pushed snapshots, and
+        # fleet_trace uses the barrier-release instant as its fine clock
+        # anchor.
+        metrics.set_gauge(
+            "tpuft_trace_barrier_wait_seconds",
+            time.perf_counter() - barrier_t0,
+            **self._metric_labels,
+        )
         self._logger.info(
             f"should_commit={should_commit} enough_replicas={enough_replicas}, "
             f"errored={self._errored}"
@@ -1104,6 +1220,9 @@ class Manager:
         self._checkpoint_transport.disallow_checkpoint()
 
         if should_commit:
+            self._trace.record(
+                "commit", step=self._step, quorum_id=self._quorum_id
+            )
             self._step += 1
             self._batches_committed += self.num_participants()
             self._commit_failures = 0
@@ -1111,9 +1230,19 @@ class Manager:
             metrics.set_gauge(
                 "tpuft_last_commit_time", time.time(), **self._metric_labels
             )
+            # A committed step closes any open incident window: later dumps
+            # get fresh ids instead of riding a resolved incident.
+            tracing.clear_incident(self._trace)
         else:
             self._commit_failures += 1
             metrics.inc("tpuft_commit_failures_total", **self._metric_labels)
+            self._trace.record(
+                "commit_failed",
+                step=self._step,
+                quorum_id=self._quorum_id,
+                consecutive_failures=self._commit_failures,
+            )
+        self._trace.set_step(self._step, self._quorum_id)
         metrics.set_gauge("tpuft_step", self._step, **self._metric_labels)
         metrics.set_gauge(
             "tpuft_batches_committed", self._batches_committed, **self._metric_labels
@@ -1165,6 +1294,38 @@ class Manager:
             )
         except Exception as e:  # noqa: BLE001 — observability must not wound
             self._logger.warn(f"metrics push failed (ignored): {e}")
+        self._push_trace()
+
+    def _push_trace(self) -> None:
+        """Publishes this process's journal segment (events since the last
+        push) plus its per-step phase rollup into the group store under
+        ``trace/<replica_id>/<group_rank>``, and runs one clock-beacon
+        sampling round — both riding the metrics-push cadence. The rollup
+        feeds fleet_status's STRAGGLER/LAG column; the segments (and the
+        fuller ``/trace.json`` surface) feed scripts/fleet_trace.py.
+        Best-effort: a push failure never poisons a step."""
+        try:
+            segment = self._trace.drain_segment()
+            payload = json.dumps(
+                {
+                    "ts": time.time(),
+                    "replica_id": self._replica_id,
+                    "group_rank": self._group_rank,
+                    "job_id": self._trace.job_id,
+                    "wall": time.time(),
+                    "mono": time.monotonic(),
+                    "clock_offset_s": self._trace.clock_offset_s,
+                    "events": segment,
+                    "phases": self._trace.phase_rollup(),
+                }
+            ).encode()
+            self._store.set(
+                f"{tracing.STORE_PREFIX}/{self._replica_id}/{self._group_rank}",
+                payload,
+            )
+            self._trace_clock.tick(self._store)
+        except Exception as e:  # noqa: BLE001 — observability must not wound
+            self._logger.warn(f"trace push failed (ignored): {e}")
 
     # ------------------------------------------------------------------
     # state dict / accounting

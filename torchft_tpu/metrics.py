@@ -457,7 +457,19 @@ def _serve_metrics_http(
     its heal-serving child's scraped registry; both are best-effort and
     never fail the scrape."""
     route = path.split("?", 1)[0].rstrip("/")
-    if route == "/metrics":
+    if route == "/trace.json":
+        # The fleet trace plane's pull surface: the process journal's full
+        # ring + clock info, merged across replicas by scripts/
+        # fleet_trace.py. Lazy import keeps metrics a leaf module.
+        try:
+            from torchft_tpu import tracing
+
+            payload = tracing.trace_json_payload()
+        except Exception as e:  # noqa: BLE001 — scrape must never fail
+            payload = {"error": str(e)}
+        body = json.dumps(payload).encode()
+        content_type = "application/json"
+    elif route == "/metrics":
         body_text = registry.prometheus_text()
         if extra_text is not None:
             try:

@@ -35,7 +35,7 @@ from typing import Any, Optional
 import jax
 import numpy as np
 
-from torchft_tpu import metrics
+from torchft_tpu import metrics, tracing
 from torchft_tpu.manager import Manager
 from torchft_tpu.utils.profiling import trace_span
 
@@ -70,6 +70,13 @@ def _replica_labels(manager: Any) -> dict:
     return getattr(manager, "_metric_labels", None) or {}
 
 
+def _trace_of(manager: Any) -> "tracing.TraceJournal":
+    """The manager's trace journal (so optimizer events land in the same
+    per-replica timeline its manager records into), falling back to the
+    thread's current journal for scripted/mocked managers."""
+    return getattr(manager, "_trace", None) or tracing.current()
+
+
 def _sync_device(x: Any) -> Any:
     """Every step's device sync, timed into ``tpuft_device_sync_seconds``.
 
@@ -78,7 +85,8 @@ def _sync_device(x: Any) -> Any:
     latency lands in the phase histogram like the real one."""
     start = time.perf_counter()
     try:
-        return _bound_device(x)
+        with tracing.span("device_sync"):
+            return _bound_device(x)
     finally:
         metrics.observe("tpuft_device_sync_seconds", time.perf_counter() - start)
 
@@ -366,6 +374,9 @@ class _PendingStep:
                             "tpuft_phantom_commits_total",
                             **_replica_labels(self.manager),
                         )
+                        _trace_of(self.manager).record(
+                            "phantom_commit", error=str(e)
+                        )
                     logger.error(
                         "pipelined step's device work failed after its commit "
                         "vote resolved committed=%s (a committed step here "
@@ -459,7 +470,9 @@ class Optimizer:
         params, opt_state = self.params, self.opt_state
         commit_future = self.manager.should_commit_async(timeout)
         try:
-            with metrics.timer("tpuft_update_dispatch_seconds"):
+            with metrics.timer("tpuft_update_dispatch_seconds"), _trace_of(
+                self.manager
+            ).span("update_dispatch"):
                 spec = self._jit_update(grads, opt_state, params)
         except BaseException:
             # The barrier is already in flight and may commit the step
@@ -485,6 +498,7 @@ class Optimizer:
                         "tpuft_phantom_commits_total",
                         **_replica_labels(self.manager),
                     )
+                    _trace_of(self.manager).record("phantom_commit")
                 logger.error(
                     "optimizer dispatch failed with the commit barrier in "
                     "flight; barrier resolved committed=%s (a committed step "
@@ -570,6 +584,7 @@ class Optimizer:
                 step=self.manager.current_step(),
             ):
                 committed = rec.commit_future.result()
+                rolled_back = False
                 self.manager.disallow_state_dict_read()
                 try:
                     if self._heal_count != rec.heal_count:
@@ -585,12 +600,31 @@ class Optimizer:
                         # speculation was dispatched from.
                         self.params, self.opt_state = rec.snapshot
                         self.rollback_count += 1
+                        rolled_back = True
                         metrics.inc(
                             "tpuft_rollbacks_total",
                             **_replica_labels(self.manager),
                         )
                 finally:
                     self.manager.allow_state_dict_read()
+                if rolled_back:
+                    # Incident capture runs OUTSIDE the writer: dumping
+                    # journals is file I/O a concurrent checkpoint serve
+                    # must not wait on. Quorum-wide refusal means every
+                    # survivor rolls this step back identically and derives
+                    # the SAME incident id — the fleet's journals + flight
+                    # recorders dump under one correlatable stamp.
+                    journal = _trace_of(self.manager)
+                    rolled_step = self.manager.current_step()
+                    rolled_quorum = getattr(self.manager, "_quorum_id", -1)
+                    journal.record(
+                        "rollback", step=rolled_step, quorum_id=rolled_quorum
+                    )
+                    tracing.open_incident(
+                        "rollback", rolled_step, rolled_quorum,
+                        journal=journal,
+                        reason="speculative step refused by the commit barrier",
+                    )
                 rec.committed = committed
                 return committed
 
@@ -736,6 +770,7 @@ class Optimizer:
                                     "tpuft_phantom_commits_total",
                                     **_replica_labels(self.manager),
                                 )
+                                _trace_of(self.manager).record("phantom_commit")
                             logger.error(
                                 "fused step sync failed with the commit barrier "
                                 "in flight; barrier resolved committed=%s (a "
@@ -767,7 +802,9 @@ class Optimizer:
         # reference keeps the pre-heal state alive for the rare
         # heal-during-barrier recompute below.
         pre_params = self.params
-        with metrics.timer("tpuft_update_dispatch_seconds"):
+        with metrics.timer("tpuft_update_dispatch_seconds"), _trace_of(
+            self.manager
+        ).span("update_dispatch", fused=True):
             loss, spec_params, spec_opt_state = fused(
                 self.params, self.opt_state, *batch
             )

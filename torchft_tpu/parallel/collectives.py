@@ -23,7 +23,7 @@ from typing import List, Sequence, Tuple
 
 import numpy as np
 
-from torchft_tpu import metrics
+from torchft_tpu import metrics, tracing
 from torchft_tpu.ops import quantization as q
 from torchft_tpu.parallel.process_group import ProcessGroup, ReduceOp
 from torchft_tpu.utils.transfer import prefetch_to_host
@@ -149,8 +149,10 @@ def allreduce_quantized(
             outputs.append(
                 q.dequantize_blocks(payload, scales, meta["shape"], meta["dtype"])
             )
-        metrics.observe(
-            "tpuft_quantized_pipeline_seconds", time.perf_counter() - pipeline_t0
+        pipeline_dt = time.perf_counter() - pipeline_t0
+        metrics.observe("tpuft_quantized_pipeline_seconds", pipeline_dt)
+        tracing.record(
+            "wire_bucket", ph="X", dur=pipeline_dt, path="quantized"
         )
         return outputs
 
@@ -271,8 +273,10 @@ def allreduce_quantized_wire(
             full_scales.append(s_chunk)
         payload_out = np.concatenate(full_payloads)[:n_blocks]
         scales_out = np.concatenate(full_scales)[:n_blocks]
-        metrics.observe(
-            "tpuft_quantized_pipeline_seconds", time.perf_counter() - pipeline_t0
+        pipeline_dt = time.perf_counter() - pipeline_t0
+        metrics.observe("tpuft_quantized_pipeline_seconds", pipeline_dt)
+        tracing.record(
+            "wire_bucket", ph="X", dur=pipeline_dt, path="quantized"
         )
         return payload_out, scales_out
 

@@ -1,0 +1,635 @@
+"""Fleet trace plane: per-process step-event journals that merge into one
+causally ordered cross-replica timeline.
+
+The other observability surfaces are *per-process*: metrics count
+(metrics.py), telemetry narrates single records (telemetry.py), the flight
+recorder rings raw PG events (utils/flight_recorder.py), chrome spans show
+one process's overlap (utils/profiling.py). None of them answers "who
+stalled step N's commit barrier?" across a fleet with unsynchronized wall
+clocks. This module adds the missing layer:
+
+- :class:`TraceJournal` — a bounded ring of structured span/instant events,
+  one journal per process (threads-as-replicas tests get one per replica
+  thread via :func:`use_journal`). Every FT phase records here: quorum
+  begin/end, ``pg.configure``, update dispatch, device sync, vote
+  send/resolve, commit/rollback, heal chunk progress, serve-child
+  lifecycle, ZeRO re-balance. Each event carries the full causal tuple
+  ``(job_id, replica_id, group_rank, step, quorum_id, seq, t_mono,
+  t_wall)`` — ``(step, quorum_id, seq)`` is the hybrid logical clock that
+  keeps merged timelines causally ordered even when wall clocks drift.
+- clock alignment — :class:`StoreClockSampler` samples a coarse wall-clock
+  offset against a store-mediated beacon key (``trace/clockref``), riding
+  the metrics-push cadence; precision is bounded by that cadence, so it
+  catches *gross* skew (unsynced hosts seconds/minutes apart). Fine
+  alignment happens at merge time from barrier-simultaneity anchors
+  (``scripts/fleet_trace.py``): every participant's commit-barrier release
+  is quorum-wide simultaneous within RPC fanout skew.
+- fleet collection — each Manager pushes journal segments to its group
+  store at ``trace/<replica_id>/<group_rank>`` (same cadence as the
+  metrics push) and every metrics HTTP surface serves the full ring as
+  ``GET /trace.json``.
+- incident auto-capture — :func:`open_incident` stamps a *deterministic*
+  incident id (pure function of kind/step/quorum_id, so every process
+  observing the same quorum-wide event derives the same id with zero
+  coordination) and dumps journal + flight-recorder ring under
+  ``$TPUFT_FLIGHT_RECORDER``. Triggers: rollback, quorum timeout,
+  ``HealExhaustedError``.
+
+Recording is always on (a dict build + deque append per event — the
+per-event cost is pinned by a unit test); ``TPUFT_TRACE=0`` disables it.
+The ring holds ``TPUFT_TRACE_SIZE`` events (default 8192).
+``TPUFT_TRACE_CLOCK=0`` disables the store beacon sampling.
+
+Journal recording NEVER takes the state-dict lock — recording sites are
+plain deque appends, safe inside any phase including the commit barrier
+(the R3 lock-discipline fixtures pin the pattern).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Callable, Deque, Dict, Generator, List, Optional
+
+import collections
+
+from torchft_tpu import metrics
+
+__all__ = [
+    "TraceJournal",
+    "StoreClockSampler",
+    "ENV_TRACE",
+    "ENV_SIZE",
+    "ENV_CLOCK",
+    "CLOCK_REF_KEY",
+    "STORE_PREFIX",
+    "default",
+    "current",
+    "use_journal",
+    "configure",
+    "set_step",
+    "record",
+    "span",
+    "incident_id",
+    "open_incident",
+    "active_incident",
+    "clear_incident",
+    "trace_json_payload",
+]
+
+ENV_TRACE = "TPUFT_TRACE"
+ENV_SIZE = "TPUFT_TRACE_SIZE"
+ENV_CLOCK = "TPUFT_TRACE_CLOCK"
+
+# Well-known store keys: the beacon every process samples against, and the
+# per-process segment keys fleet_status/fleet_trace read.
+CLOCK_REF_KEY = "trace/clockref"
+STORE_PREFIX = "trace"
+
+# Span names the per-step phase rollup aggregates (the STRAGGLER/LAG feed
+# for scripts/fleet_status.py and --explain-step's phase deltas).
+PHASE_SPANS = (
+    "quorum",
+    "pg_configure",
+    "wire_bucket",
+    "device_sync",
+    "update_dispatch",
+    "commit_barrier",
+    "heal_send",
+    "heal_recv",
+    "zero_rebalance",
+)
+
+
+def _enabled_from_env() -> bool:
+    return os.environ.get(ENV_TRACE, "1") != "0"
+
+
+def _ring_size() -> int:
+    try:
+        return max(64, int(os.environ.get(ENV_SIZE, "8192")))
+    except ValueError:
+        return 8192  # malformed env must not break package import
+
+
+def _jsonable(value: Any) -> Any:
+    if isinstance(value, (str, int, float, bool, type(None))):
+        return value
+    try:
+        return repr(value)
+    except Exception:  # pathological __repr__
+        return f"<unreprable {type(value).__name__}>"
+
+
+class TraceJournal:
+    """Bounded per-process journal of structured FT-phase events.
+
+    Thread-safe the cheap way: deque appends are atomic, ``seq`` comes from
+    ``itertools.count`` (atomic in CPython), and identity/step fields are
+    plain attribute reads — races on them only mislabel an event's step by
+    one, which the merge's hybrid logical clock tolerates. ``wall``/``mono``
+    are injectable so tests can skew clocks per journal and prove the
+    alignment machinery recovers them.
+    """
+
+    def __init__(
+        self,
+        maxlen: Optional[int] = None,
+        wall: Callable[[], float] = time.time,
+        mono: Callable[[], float] = time.monotonic,
+        enabled: Optional[bool] = None,
+    ) -> None:
+        self._ring: Deque[Dict[str, Any]] = collections.deque(
+            maxlen=maxlen or _ring_size()
+        )
+        self._seq = itertools.count()
+        self._last_seq = -1  # last seq handed out (for drop accounting)
+        self._last_drained = -1
+        self._wall = wall
+        self._mono = mono
+        self._enabled = _enabled_from_env() if enabled is None else enabled
+        # Identity (stamped onto events at export, not per append).
+        self.job_id = "unknown"
+        self.replica_id = "proc"
+        self.group_rank = 0
+        # Hybrid-logical-clock context, maintained by the Manager.
+        self.step = 0
+        self.quorum_id = -1
+        # Last coarse store-sampled clock offset (seconds, my_wall - ref_wall).
+        self.clock_offset_s: Optional[float] = None
+        self.active_incident: Optional[str] = None
+
+    # -- configuration ------------------------------------------------------
+
+    def configure(
+        self,
+        job_id: Optional[str] = None,
+        replica_id: Optional[str] = None,
+        group_rank: Optional[int] = None,
+    ) -> None:
+        if job_id is not None:
+            self.job_id = str(job_id)
+        if replica_id is not None:
+            self.replica_id = str(replica_id)
+        if group_rank is not None:
+            self.group_rank = int(group_rank)
+
+    def set_step(
+        self, step: Optional[int] = None, quorum_id: Optional[int] = None
+    ) -> None:
+        if step is not None:
+            self.step = int(step)
+        if quorum_id is not None:
+            self.quorum_id = int(quorum_id)
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    # -- recording ----------------------------------------------------------
+
+    def record(
+        self,
+        name: str,
+        ph: str = "i",
+        cat: str = "ft",
+        dur: Optional[float] = None,
+        step: Optional[int] = None,
+        quorum_id: Optional[int] = None,
+        t_wall: Optional[float] = None,
+        t_mono: Optional[float] = None,
+        **args: Any,
+    ) -> None:
+        """Appends one event. ``ph`` is chrome-trace-flavored: ``"X"`` a
+        complete span (``dur`` seconds; stamps default to *start* = now -
+        dur), ``"i"`` an instant. Never raises — recording sites include
+        failure paths that must stay clean."""
+        if not self._enabled:
+            return
+        try:
+            seq = next(self._seq)
+            self._last_seq = seq
+            now_wall = self._wall()
+            now_mono = self._mono()
+            back = dur or 0.0
+            event: Dict[str, Any] = {
+                "seq": seq,
+                "name": name,
+                "ph": ph,
+                "cat": cat,
+                "t_wall": now_wall - back if t_wall is None else t_wall,
+                "t_mono": now_mono - back if t_mono is None else t_mono,
+                "thread": threading.current_thread().name,
+                "step": self.step if step is None else step,
+                "quorum_id": self.quorum_id if quorum_id is None else quorum_id,
+            }
+            if dur is not None:
+                event["dur"] = dur
+            if args:
+                event["args"] = {k: _jsonable(v) for k, v in args.items()}
+            self._ring.append(event)
+        except Exception:  # noqa: BLE001 — observability must not wound
+            pass
+
+    @contextmanager
+    def span(
+        self,
+        name: str,
+        cat: str = "ft",
+        step: Optional[int] = None,
+        quorum_id: Optional[int] = None,
+        **args: Any,
+    ) -> Generator[None, None, None]:
+        """Times the with-body into one ``"X"`` event (recorded at exit
+        with the *start* timestamps, so merged timelines sort by entry)."""
+        if not self._enabled:
+            yield
+            return
+        start_wall = self._wall()
+        start_mono = self._mono()
+        try:
+            yield
+        finally:
+            self.record(
+                name,
+                ph="X",
+                cat=cat,
+                dur=self._mono() - start_mono,
+                step=step,
+                quorum_id=quorum_id,
+                t_wall=start_wall,
+                t_mono=start_mono,
+                **args,
+            )
+
+    # -- export -------------------------------------------------------------
+
+    def _identity(self) -> Dict[str, Any]:
+        return {
+            "job_id": self.job_id,
+            "replica_id": self.replica_id,
+            "group_rank": self.group_rank,
+        }
+
+    def _copy_ring(self) -> List[Dict[str, Any]]:
+        # Deque appends are atomic but iteration can race a concurrent
+        # append; retry then fall back to an index walk (flight-recorder
+        # pattern — a slightly short sample is fine for observability).
+        for _ in range(4):
+            try:
+                return list(self._ring)
+            except RuntimeError:
+                continue
+        out: List[Dict[str, Any]] = []
+        for i in range(len(self._ring)):
+            try:
+                out.append(self._ring[i])
+            except IndexError:
+                break
+        return out
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        """Identity-stamped copies of every ring event (oldest first)."""
+        ident = self._identity()
+        return [{**ident, **event} for event in self._copy_ring()]
+
+    def dropped(self) -> int:
+        """Events overwritten by the ring bound so far."""
+        maxlen = self._ring.maxlen or 0
+        return max(0, (self._last_seq + 1) - maxlen) if maxlen else 0
+
+    def drain_segment(self) -> List[Dict[str, Any]]:
+        """Events recorded since the last drain (identity-stamped) — the
+        incremental store-push payload. Counts exported events and any that
+        fell off the ring un-exported into the ``tpuft_trace_*`` metrics."""
+        ring = self._copy_ring()
+        previous = self._last_drained
+        segment = [e for e in ring if e["seq"] > previous]
+        if segment:
+            self._last_drained = segment[-1]["seq"]
+        # Events that fell off the ring BEFORE any drain exported them
+        # (ring-bound drops of already-drained events are benign — they
+        # were pushed).
+        oldest = ring[0]["seq"] if ring else self._last_seq + 1
+        missed = max(0, oldest - (previous + 1))
+        if missed > 0:
+            metrics.inc("tpuft_trace_dropped_total", missed)
+        if segment:
+            metrics.inc("tpuft_trace_events_total", len(segment))
+        ident = self._identity()
+        return [{**ident, **event} for event in segment]
+
+    def phase_rollup(self, max_steps: int = 4) -> List[Dict[str, Any]]:
+        """Per-step phase durations from the ring (latest ``max_steps``
+        steps): ``{"step", "quorum_id", "phases": {span: seconds},
+        "committed": bool|None}``. Durations are *local monotonic* — clock-
+        free, so fleet_status can compare them across replicas directly
+        (the straggler entered the commit barrier last and therefore
+        waited in it least)."""
+        by_step: Dict[int, Dict[str, Any]] = {}
+        for event in self._copy_ring():
+            step = event.get("step")
+            if step is None:
+                continue
+            name = event.get("name")
+            slot = by_step.setdefault(
+                step,
+                {"step": step, "quorum_id": event.get("quorum_id"),
+                 "phases": {}, "committed": None},
+            )
+            if event.get("ph") == "X" and name in PHASE_SPANS:
+                slot["phases"][name] = round(
+                    slot["phases"].get(name, 0.0) + float(event.get("dur", 0.0)), 6
+                )
+                slot["quorum_id"] = event.get("quorum_id", slot["quorum_id"])
+            elif name == "commit":
+                slot["committed"] = True
+            elif name == "commit_failed":
+                slot["committed"] = False
+        steps = sorted(by_step)[-max_steps:]
+        return [by_step[s] for s in steps]
+
+    # -- dump ---------------------------------------------------------------
+
+    def dump(self, path: Optional[str] = None, reason: str = "") -> Optional[str]:
+        """Writes the ring as JSON lines (header first). With no ``path``,
+        uses ``$TPUFT_FLIGHT_RECORDER/tpuft_trace_<replica>_<rank>_<pid>_
+        <ns>[_<incident>].jsonl`` — or returns None when the env is unset.
+        Atomic (tmp + replace): a chaos kill mid-dump must never leave a
+        truncated JSONL at the final name."""
+        if path is None:
+            directory = os.environ.get("TPUFT_FLIGHT_RECORDER", "")
+            if not directory:
+                return None
+            os.makedirs(directory, exist_ok=True)
+            suffix = f"_{self.active_incident}" if self.active_incident else ""
+            path = os.path.join(
+                directory,
+                f"tpuft_trace_{sanitize(self.replica_id)}_{self.group_rank}"
+                f"_{os.getpid()}_{time.time_ns()}{suffix}.jsonl",
+            )
+        header = {
+            "trace_header": True,
+            **self._identity(),
+            "reason": reason,
+            "incident": self.active_incident,
+            "wall": self._wall(),
+            "mono": self._mono(),
+            "clock_offset_s": self.clock_offset_s,
+            "dropped": self.dropped(),
+        }
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(json.dumps(header) + "\n")
+            for event in self.snapshot():
+                f.write(json.dumps(event) + "\n")
+        os.replace(tmp, path)
+        return path
+
+
+def sanitize(name: str) -> str:
+    """Filesystem-safe identity fragment for dump filenames."""
+    return (
+        "".join(c if (c.isalnum() or c in "-._") else "-" for c in str(name))
+        or "proc"
+    )
+
+
+# ---------------------------------------------------------------------------
+# process default + per-thread journals (threads-as-replicas drills)
+# ---------------------------------------------------------------------------
+
+_PROCESS = TraceJournal()
+_TLS = threading.local()
+
+
+def default() -> TraceJournal:
+    """The process-wide journal (one real deployment process = one rank)."""
+    return _PROCESS
+
+
+def current() -> TraceJournal:
+    """The journal for this thread: a :func:`use_journal` override when one
+    is active (threads-as-replicas tests give each replica thread its own
+    journal), else the process journal. A Manager captures ``current()`` at
+    construction, so events it records from its quorum thread still land in
+    its replica's journal."""
+    return getattr(_TLS, "journal", None) or _PROCESS
+
+
+@contextmanager
+def use_journal(journal: TraceJournal) -> Generator[TraceJournal, None, None]:
+    previous = getattr(_TLS, "journal", None)
+    _TLS.journal = journal
+    try:
+        yield journal
+    finally:
+        _TLS.journal = previous
+
+
+def configure(**kwargs: Any) -> None:
+    current().configure(**kwargs)
+
+
+def set_step(step: Optional[int] = None, quorum_id: Optional[int] = None) -> None:
+    current().set_step(step, quorum_id)
+
+
+def record(name: str, **kwargs: Any) -> None:
+    current().record(name, **kwargs)
+
+
+def span(name: str, **kwargs: Any):
+    return current().span(name, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# incidents
+# ---------------------------------------------------------------------------
+
+
+def incident_id(kind: str, step: int, quorum_id: int) -> str:
+    """Deterministic incident id: every process observing the same
+    quorum-wide event (a rollback at step N under quorum Q, a heal
+    exhaustion) derives the SAME id with no coordination, so offline dumps
+    from N hosts correlate by filename alone."""
+    return f"inc-{kind}-q{quorum_id}-s{step}"
+
+
+def open_incident(
+    kind: str,
+    step: int,
+    quorum_id: int,
+    journal: Optional[TraceJournal] = None,
+    reason: str = "",
+) -> str:
+    """Stamps an incident: records the event, marks the id active (flight-
+    recorder dumps reuse it in their filenames until the next commit
+    clears it), dumps this journal AND the flight-recorder ring under
+    ``$TPUFT_FLIGHT_RECORDER`` (no-ops when unset). Never raises."""
+    j = journal or current()
+    iid = incident_id(kind, step, quorum_id)
+    try:
+        j.record(
+            "incident", cat="incident", step=step, quorum_id=quorum_id,
+            kind=kind, incident=iid, reason=reason,
+        )
+        j.active_incident = iid
+        metrics.inc("tpuft_trace_incidents_total", kind=kind)
+        j.dump(reason=f"{kind}: {reason}")
+        from torchft_tpu.utils import flight_recorder
+
+        # Bind the journal as this thread's current while the flight
+        # recorder dumps: incidents often open on the quorum thread, and
+        # the FR filename reads identity + incident from tracing.current().
+        with use_journal(j):
+            flight_recorder.dump_on_failure("tracing", f"incident {iid}: {reason}")
+    except Exception:  # noqa: BLE001 — incident capture must never wound
+        pass
+    return iid
+
+
+def active_incident(journal: Optional[TraceJournal] = None) -> Optional[str]:
+    return (journal or current()).active_incident
+
+
+def clear_incident(journal: Optional[TraceJournal] = None) -> None:
+    (journal or current()).active_incident = None
+
+
+# ---------------------------------------------------------------------------
+# /trace.json payload (served by every metrics HTTP surface)
+# ---------------------------------------------------------------------------
+
+
+def trace_json_payload(journal: Optional[TraceJournal] = None) -> Dict[str, Any]:
+    j = journal or default()
+    return {
+        "ts": time.time(),
+        "job_id": j.job_id,
+        "replica_id": j.replica_id,
+        "group_rank": j.group_rank,
+        "step": j.step,
+        "quorum_id": j.quorum_id,
+        "clock": {
+            "wall": j._wall(),
+            "mono": j._mono(),
+            "offset_s": j.clock_offset_s,
+        },
+        "incident": j.active_incident,
+        "dropped": j.dropped(),
+        "events": j.snapshot(),
+        "phases": j.phase_rollup(),
+    }
+
+
+# ---------------------------------------------------------------------------
+# store-mediated coarse clock sampling
+# ---------------------------------------------------------------------------
+
+
+class StoreClockSampler:
+    """Coarse wall-clock offset sampling through a shared KV store.
+
+    Protocol (pure get/set — the store has no server clock or listing):
+    rank-0 managers race to own the ``trace/clockref`` beacon; ownership
+    converges to the smallest owner key (each claimer only overwrites when
+    it sorts at-or-below the current owner), with stale takeover when the
+    beacon's counter stops advancing (dead owner). Everyone else samples:
+    a beacon whose counter ADVANCED since our previous read was written
+    inside our (prev_read, now] window, so
+    ``offset = now - window/2 - beacon.wall`` with error ± window/2 —
+    bounded by the push cadence. That catches gross skew (hosts seconds or
+    minutes apart); fine alignment comes from barrier anchors at merge
+    time (scripts/fleet_trace.py). Best-effort everywhere: a dead store
+    never wounds a step.
+    """
+
+    STALE_TAKEOVER_READS = 3
+
+    def __init__(
+        self,
+        journal: TraceJournal,
+        owner_key: str,
+        claim: bool = False,
+        key: str = CLOCK_REF_KEY,
+    ) -> None:
+        self._journal = journal
+        self._owner_key = str(owner_key)
+        self._claim = claim
+        self._key = key
+        self._n = 0
+        self._last_seen_n: Optional[int] = None
+        self._stale_reads = 0
+        self._last_read_wall: Optional[float] = None
+        self._enabled = os.environ.get(ENV_CLOCK, "1") != "0"
+        self.last_offset_s: Optional[float] = None
+
+    def tick(self, store: Any) -> None:
+        """One sampling round (call at the metrics-push cadence)."""
+        if not self._enabled:
+            return
+        try:
+            self._tick(store)
+        except Exception:  # noqa: BLE001 — observability must not wound
+            pass
+
+    def _tick(self, store: Any) -> None:
+        now = self._journal._wall()
+        raw = store.get(self._key, timeout=2.0, wait=False)
+        beacon = json.loads(raw.decode()) if raw else None
+
+        should_claim = False
+        if self._claim:
+            if beacon is None:
+                should_claim = True
+            else:
+                owner = str(beacon.get("owner", ""))
+                if owner == self._owner_key or self._owner_key < owner:
+                    should_claim = True
+                elif beacon.get("n") == self._last_seen_n:
+                    self._stale_reads += 1
+                    if self._stale_reads >= self.STALE_TAKEOVER_READS:
+                        should_claim = True  # owner stopped heartbeating
+                else:
+                    self._stale_reads = 0
+
+        if beacon is not None and str(beacon.get("owner")) != self._owner_key:
+            n = beacon.get("n")
+            if n != self._last_seen_n and self._last_read_wall is not None:
+                # The write landed between our previous read and this one
+                # (in OUR clock): midpoint estimate, error ± window/2.
+                window = max(0.0, now - self._last_read_wall)
+                offset = (now - window / 2.0) - float(beacon.get("wall", now))
+                self.last_offset_s = offset
+                self._journal.clock_offset_s = offset
+                self._journal.record(
+                    "clock_sample",
+                    cat="clock",
+                    ref_owner=str(beacon.get("owner")),
+                    ref_n=n,
+                    ref_wall=float(beacon.get("wall", now)),
+                    window_s=round(window, 6),
+                    offset_s=offset,
+                )
+                metrics.set_gauge("tpuft_trace_clock_offset_ms", offset * 1e3)
+            self._last_seen_n = n
+        elif beacon is not None:
+            # We are the owner: our frame IS the beacon frame.
+            self.last_offset_s = 0.0
+            self._journal.clock_offset_s = 0.0
+        self._last_read_wall = now
+
+        if should_claim:
+            self._n += 1
+            store.set(
+                self._key,
+                json.dumps(
+                    {"owner": self._owner_key, "n": self._n,
+                     "wall": self._journal._wall()}
+                ).encode(),
+            )

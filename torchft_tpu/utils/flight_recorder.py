@@ -11,9 +11,15 @@ and dumps it as JSON lines when things go wrong.
 
 Always on (a deque append per event is noise next to any wire op); the
 DUMP is opt-in: set ``TPUFT_FLIGHT_RECORDER`` to a directory and every
-abort / reported error writes a fresh ``tpuft_fr_<pid>_<ns>.jsonl`` there. ``dump()``
-can also be called explicitly with a path (e.g. from a debugger or a
-supervisor's crash handler).
+abort / reported error writes a fresh
+``tpuft_fr_<replica>_<rank>_<pid>_<ns>[_<incident>].jsonl`` there — the
+replica identity comes from the trace plane (``torchft_tpu.tracing``),
+because a pid alone cannot be correlated across hosts, and when an
+incident is active (a rollback, quorum timeout, or heal exhaustion
+stamped its deterministic id) the filename carries it so one fleet-wide
+event's dumps from N hosts correlate by name alone. ``dump()`` can also
+be called explicitly with a path (e.g. from a debugger or a supervisor's
+crash handler).
 """
 
 from __future__ import annotations
@@ -113,12 +119,30 @@ def _metrics_trailer() -> Optional[Dict[str, Any]]:
         return None
 
 
+def _trace_identity() -> "tuple[str, Optional[str]]":
+    """(filename fragment, active incident id) from the trace plane's
+    per-thread journal — identity so dumps correlate across hosts, the
+    incident id so every process stamping the same quorum-wide event
+    (deterministic id, tracing.incident_id) dumps under one name. Never
+    raises and imports lazily — this module must stay a leaf that works
+    during interpreter teardown."""
+    try:
+        from torchft_tpu import tracing
+
+        journal = tracing.current()
+        fragment = f"{tracing.sanitize(journal.replica_id)}_{journal.group_rank}"
+        return fragment, journal.active_incident
+    except Exception:  # noqa: BLE001
+        return "proc_0", None
+
+
 def dump(path: Optional[str] = None, reason: str = "") -> Optional[str]:
     """Writes the ring as JSON lines. With no ``path``, uses a fresh
-    ``$TPUFT_FLIGHT_RECORDER/tpuft_fr_<pid>_<ns>.jsonl`` — or does
-    nothing (returns None) when the env is unset. Returns the path. The
-    last line is a ``{"metrics": ...}`` trailer record (counter state at
-    dump time)."""
+    ``$TPUFT_FLIGHT_RECORDER/tpuft_fr_<replica>_<rank>_<pid>_<ns>
+    [_<incident>].jsonl`` — or does nothing (returns None) when the env is
+    unset. Returns the path. The last line is a ``{"metrics": ...}``
+    trailer record (counter state at dump time)."""
+    identity, incident = _trace_identity()
     if path is None:
         directory = os.environ.get(ENV_DIR, "")
         if not directory:
@@ -126,8 +150,10 @@ def dump(path: Optional[str] = None, reason: str = "") -> Optional[str]:
         os.makedirs(directory, exist_ok=True)
         # Unique per dump: a later failure must not overwrite the first
         # (root-cause) trace — the ring has usually wrapped by then.
+        suffix = f"_{incident}" if incident else ""
         path = os.path.join(
-            directory, f"tpuft_fr_{os.getpid()}_{time.time_ns()}.jsonl"
+            directory,
+            f"tpuft_fr_{identity}_{os.getpid()}_{time.time_ns()}{suffix}.jsonl",
         )
     entries, truncated = _snapshot_meta()
     trailer = _metrics_trailer()
@@ -136,10 +162,12 @@ def dump(path: Optional[str] = None, reason: str = "") -> Optional[str]:
     tmp = f"{path}.tmp.{os.getpid()}"
     with _DUMP_LOCK:
         with open(tmp, "w") as f:
-            if reason or truncated:
+            if reason or truncated or incident:
                 header: Dict[str, Any] = {"flight_recorder_dump_reason": reason}
                 if truncated:
                     header["truncated"] = True
+                if incident:
+                    header["incident"] = incident
                 f.write(json.dumps(header) + "\n")
             for entry in entries:
                 f.write(json.dumps(entry) + "\n")

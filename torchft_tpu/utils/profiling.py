@@ -88,8 +88,54 @@ def chrome_trace(path: str) -> Generator[None, None, None]:
         _CHROME = previous
         with capture.lock:
             snapshot = list(capture.events)
+        # Fleet-merge metadata: stamp the trace plane's replica identity
+        # and last store-sampled clock offset onto the capture, so a
+        # single-process chrome trace drops cleanly into a merged fleet
+        # timeline (scripts/fleet_trace.py shifts by clock_offset_ms and
+        # keys tracks by replica_id) instead of arriving as an anonymous
+        # pid with an unaligned clock.
+        other_data: dict = {}
+        try:
+            from torchft_tpu import tracing
+
+            journal = tracing.current()
+            offset_ms = (
+                round(journal.clock_offset_s * 1e3, 3)
+                if journal.clock_offset_s is not None
+                else None
+            )
+            other_data = {
+                "replica_id": journal.replica_id,
+                "group_rank": journal.group_rank,
+                "clock_offset_ms": offset_ms,
+            }
+            snapshot.insert(
+                0,
+                {
+                    "name": "process_name",
+                    "ph": "M",
+                    "pid": os.getpid(),
+                    "args": {
+                        "name": f"{journal.replica_id}/{journal.group_rank}"
+                    },
+                },
+            )
+            for event in snapshot:
+                if event.get("ph") == "X":
+                    event.setdefault("args", {}).setdefault(
+                        "replica_id", journal.replica_id
+                    )
+        except Exception:  # noqa: BLE001 — profiling must never break training
+            pass
         with open(path, "w") as f:
-            json.dump({"traceEvents": snapshot, "displayTimeUnit": "ms"}, f)
+            json.dump(
+                {
+                    "traceEvents": snapshot,
+                    "displayTimeUnit": "ms",
+                    "otherData": other_data,
+                },
+                f,
+            )
         logger.info(
             "chrome trace with %d events written to %s", len(snapshot), path
         )

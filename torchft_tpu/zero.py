@@ -70,6 +70,7 @@ from torchft_tpu.optim import (
     _as_device_tree,
     _replica_labels,
     _sync_device,
+    _trace_of,
     make_jit_shard_update,
 )
 from torchft_tpu.parallel.process_group import ReduceOp
@@ -515,14 +516,20 @@ class ZeroOptimizer(Optimizer):
         if pg_world <= 1:
             # Alone on the wire: no exchange partner. Keep fresh held
             # shards, bootstrap the rest from the replicated params.
-            self._adopt_rebalanced(
-                state, owned, {}, key, labels, ranks_identical=True
-            )
+            with _trace_of(self.manager).span(
+                "zero_rebalance", owned=len(owned), wire=False
+            ):
+                self._adopt_rebalanced(
+                    state, owned, {}, key, labels, ranks_identical=True
+                )
             return
         try:
-            self._rebalance_over_wire(
-                state, owners, owned, pg_rank, key, labels
-            )
+            with _trace_of(self.manager).span(
+                "zero_rebalance", owned=len(owned), wire=True
+            ):
+                self._rebalance_over_wire(
+                    state, owners, owned, pg_rank, key, labels
+                )
         except Exception as e:  # noqa: BLE001 — poison the step, never raise
             # Comm-layer errors funnel into report_error: the step will
             # not commit and the next quorum reconfigures the wire; the
