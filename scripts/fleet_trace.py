@@ -359,6 +359,13 @@ def _fmt_ms(seconds: float) -> str:
     return f"{seconds * 1e3:.1f}ms"
 
 
+def _fmt_mb(nbytes: Any) -> str:
+    try:
+        return f"{float(nbytes) / (1 << 20):.1f} MB"
+    except (TypeError, ValueError):
+        return "? MB"
+
+
 def explain_step(merged: List[Dict[str, Any]], step: int) -> str:
     """The causal narrative for one step, from a merged timeline."""
     at_step = [e for e in merged if e.get("step") == step]
@@ -491,6 +498,40 @@ def explain_step(merged: List[Dict[str, Any]], step: int) -> str:
         lines.append(
             f"heal progress: {len(chunks)} chunk(s) verified, last chunk "
             f"{args.get('chunk')} of {args.get('total_chunks')}"
+        )
+    # Striped-heal breakdown: one line per donor stripe (who served how
+    # much), one per reassignment (which donor's stripe moved and why),
+    # one for the delta-rejoin savings.
+    for e in at_step:
+        if e["name"] != "heal_stripe":
+            continue
+        args = e.get("args") or {}
+        fenced = " [FENCED]" if args.get("fenced") in (True, "True") else ""
+        lines.append(
+            f"heal stripe: {proc_label(proc_key(e))} fetched "
+            f"{args.get('chunks', 0)} chunk(s) "
+            f"({_fmt_mb(args.get('bytes', 0))}) from {args.get('donor', '?')} "
+            f"in {float(args.get('duration_s', 0.0)):.2f}s{fenced}"
+        )
+    for e in at_step:
+        if e["name"] != "heal_stripe_reassign":
+            continue
+        args = e.get("args") or {}
+        lines.append(
+            f"stripe REASSIGNED: donor {args.get('donor', '?')} failed "
+            f"({args.get('reason', '?')}); {args.get('chunks', 0)} chunk(s) "
+            f"({_fmt_mb(args.get('bytes', 0))}) redistributed to "
+            f"{args.get('survivors', 0)} survivor(s)"
+        )
+    for e in at_step:
+        if e["name"] != "heal_delta":
+            continue
+        args = e.get("args") or {}
+        lines.append(
+            f"delta rejoin: {proc_label(proc_key(e))} matched "
+            f"{args.get('matched', 0)}/{args.get('total_chunks', 0)} "
+            f"chunk(s) locally ({_fmt_mb(args.get('bytes_saved', 0))} not "
+            "fetched)"
         )
     fails = [e for e in at_step if e["name"] == "heal_attempt_failed"]
     for e in fails:

@@ -133,6 +133,22 @@ def ft_counter_snapshot(replica_id: str = "") -> Dict[str, float]:
         "zero_heal_bytes_saved": metrics.counter_total(
             "tpuft_zero_heal_bytes_saved_total"
         ),
+        "stripe_chunks": metrics.counter_total("tpuft_heal_stripe_chunks_total"),
+        "stripe_donor_failures": metrics.counter_total(
+            "tpuft_heal_stripe_donor_failures_total"
+        ),
+        "stripe_reassigned_chunks": metrics.counter_total(
+            "tpuft_heal_stripe_reassigned_chunks_total"
+        ),
+        "stripe_refetched_bytes": metrics.counter_total(
+            "tpuft_heal_stripe_refetched_bytes_total"
+        ),
+        "delta_chunks_matched": metrics.counter_total(
+            "tpuft_heal_delta_chunks_matched_total"
+        ),
+        "delta_bytes_saved": metrics.counter_total(
+            "tpuft_heal_delta_bytes_saved_total"
+        ),
     }
 
 

@@ -168,31 +168,31 @@ def _http_404_server(n_404s: int, body: bytes = b"staged"):
         server.server_close()
 
 
-def test_fetch_retry_404_bounded_when_never_staged() -> None:
+def test_fetch_retry_bounded_when_never_staged() -> None:
     """A never-staged fetch fails once its retry window (opened at the
     first 404) expires — retries are bounded, not forever."""
     import urllib.error
 
-    from torchft_tpu.checkpointing.http_transport import _fetch_retry_404
+    from torchft_tpu.checkpointing.http_transport import _fetch_retry
 
     with _http_404_server(n_404s=-1) as (url, _):
         t0 = time.monotonic()
         with pytest.raises(urllib.error.HTTPError):
-            _fetch_retry_404(url, timeout=0.4)
+            _fetch_retry(url, timeout=0.4)
         assert time.monotonic() - t0 < 10  # bounded, generous GIL margin
 
 
-def test_fetch_retry_404_retries_until_staged() -> None:
-    """_fetch_retry_404 rides out 404s (donor hasn't staged yet / serve
+def test_fetch_retry_retries_until_staged() -> None:
+    """_fetch_retry rides out 404s (donor hasn't staged yet / serve
     window reopening) and returns the body once the server serves."""
-    from torchft_tpu.checkpointing.http_transport import _fetch_retry_404
+    from torchft_tpu.checkpointing.http_transport import _fetch_retry
 
     with _http_404_server(n_404s=2) as (url, hits):
-        assert _fetch_retry_404(url, timeout=5.0) == b"staged"
+        assert _fetch_retry(url, timeout=5.0) == b"staged"
         assert len(hits) == 3  # two 404 rounds, then success
 
 
-def test_fetch_retry_404_window_opens_at_first_404(monkeypatch) -> None:
+def test_fetch_retry_window_opens_at_first_404(monkeypatch) -> None:
     """Deterministic (virtual-clock) pin of the lazy window: the retry
     deadline opens at the fetch's FIRST 404, not at the fetch's start, so
     server/transfer time before and between 404s never drains the budget.
@@ -229,7 +229,7 @@ def test_fetch_retry_404_window_opens_at_first_404(monkeypatch) -> None:
             error=urllib.error,
         ),
     )
-    assert ht._fetch_retry_404("http://fake/x", timeout=2.0) == b"staged"
+    assert ht._fetch_retry("http://fake/x", timeout=2.0) == b"staged"
     assert len(calls) == 3  # an eager window would have raised after call 2
 
 
@@ -640,8 +640,8 @@ def test_stale_era_chunk_409_fails_heal_cleanly() -> None:
         orig = ht._fetch_retry
         state = {"restaged": False}
 
-        def restaging_fetch(url, timeout, consume=None):
-            result = orig(url, timeout, consume=consume)
+        def restaging_fetch(url, timeout, consume=None, retryable=None):
+            result = orig(url, timeout, consume=consume, retryable=retryable)
             if url.endswith("/meta") and not state["restaged"]:
                 state["restaged"] = True
                 donor.send_checkpoint(

@@ -329,6 +329,31 @@ def test_explain_step_out_of_range(fixture_events) -> None:
     assert "0..3" in text
 
 
+def test_explain_step_names_stripe_reassignment_and_delta() -> None:
+    """A striped heal in the postmortem: one line per donor stripe (who
+    served how much, fenced or not), the reassignment line naming which
+    donor's stripe moved and why, and the delta-rejoin savings line."""
+    j = _Journal("train_2", 0.0, 900.0)
+    j.ev("heal_recv", 0.1, ph="X", dur=0.5, step=4, q=5,
+         donor="train_0:29000", donors=2, delta=True, attempt=0)
+    j.ev("heal_delta", 0.12, step=4, q=5, matched=48, total_chunks=64,
+         bytes_saved=9 << 30)
+    j.ev("heal_stripe_reassign", 0.3, step=4, q=5, donor="http://d1:2",
+         chunks=5, bytes=1 << 30, survivors=1,
+         reason="ConnectionError: donor died")
+    j.ev("heal_stripe", 0.55, step=4, q=5, donor="http://d0:1", chunks=13,
+         bytes=3 << 30, duration_s=0.44, fenced=False)
+    j.ev("heal_stripe", 0.56, step=4, q=5, donor="http://d1:2", chunks=3,
+         bytes=1 << 29, duration_s=0.2, fenced=True)
+    merged = fleet_trace.merge_events(j.events)
+    text = fleet_trace.explain_step(merged, 4)
+    assert "heal stripe: train_2/0 fetched 13 chunk(s) (3072.0 MB) from http://d0:1" in text
+    assert "[FENCED]" in text
+    assert "stripe REASSIGNED: donor http://d1:2 failed (ConnectionError: donor died)" in text
+    assert "5 chunk(s) (1024.0 MB) redistributed to 1 survivor(s)" in text
+    assert "delta rejoin: train_2/0 matched 48/64 chunk(s) locally (9216.0 MB not" in text
+
+
 # ---------------------------------------------------------------------------
 # the drill: threads-as-replicas kill/heal over a loopback PG
 # ---------------------------------------------------------------------------

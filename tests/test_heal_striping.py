@@ -1,0 +1,1118 @@
+"""Multi-donor striped heal + delta rejoin drills (pure Python — these
+carry tier-1 in a container without the native toolchain):
+
+- stripe-planner units: deterministic, complete, byte-balanced partitions;
+- transport-level acceptance: a heal striped across donors lands bitwise
+  identical; a donor that dies / serves a stale era / corrupts mid-stripe
+  is fenced and its unfetched ranges reassign to the survivors with EXACT
+  re-fetch accounting; all donors dead fails cleanly with the per-chunk
+  resume cache intact;
+- delta rejoin: a stale rejoiner fetches only chunks whose (crc, size)
+  differs from the donor manifest, composes with the ZeRO skip_parts
+  filter, and falls back to the full fetch on any layout mismatch; the
+  donor-side /delta manifest-diff route answers era-fenced diffs;
+- manager-level donor-set plumbing against a mocked coordination plane:
+  resolution, rotation, best-effort failures, the step-0 mosaic guard,
+  and co-staging by non-assigned max-step members;
+- threads-as-replicas rejoin drills (loopback, ft_harness style): a stale
+  rejoiner heals striped+delta from two real donor transports, fetches
+  measurably less than the full payload, lands bitwise identical, and
+  stays green in strict AND pipelined commit orderings; corrupt/stale/
+  dead-donor stripe variants never adopt bad state (report_error funnel
+  preserved).
+"""
+
+import threading
+import urllib.error
+import urllib.parse
+import urllib.request
+from unittest.mock import MagicMock, patch
+
+import numpy as np
+import pytest
+
+from test_checkpointing import assert_state_equal, chunked_state, heal_counters
+from test_manager import make_manager, make_quorum
+from torchft_tpu import metrics
+from torchft_tpu.checkpointing import HTTPTransport
+from torchft_tpu.checkpointing import http_transport as ht
+from torchft_tpu.coordination import Quorum, QuorumMember
+from torchft_tpu.parallel.process_group import ProcessGroupDummy
+
+
+def stripe_counters() -> dict:
+    base = heal_counters()
+    base.update(
+        {
+            "stripe_chunks": metrics.counter_total(
+                "tpuft_heal_stripe_chunks_total"
+            ),
+            "stripe_bytes": metrics.counter_total("tpuft_heal_stripe_bytes_total"),
+            "donor_failures": metrics.counter_total(
+                "tpuft_heal_stripe_donor_failures_total"
+            ),
+            "reassigned_chunks": metrics.counter_total(
+                "tpuft_heal_stripe_reassigned_chunks_total"
+            ),
+            "reassigned_bytes": metrics.counter_total(
+                "tpuft_heal_stripe_reassigned_bytes_total"
+            ),
+            "refetched_bytes": metrics.counter_total(
+                "tpuft_heal_stripe_refetched_bytes_total"
+            ),
+            "delta_matched": metrics.counter_total(
+                "tpuft_heal_delta_chunks_matched_total"
+            ),
+            "delta_bytes_saved": metrics.counter_total(
+                "tpuft_heal_delta_bytes_saved_total"
+            ),
+            "delta_fallbacks": metrics.counter_total(
+                "tpuft_heal_delta_fallbacks_total"
+            ),
+        }
+    )
+    return base
+
+
+def wide_state(n_leaves: int = 6, leaf_kb: int = 256) -> dict:
+    """N sizeable distinct leaves → N round-robin chunks, big enough that
+    byte accounting dominates header noise but small enough to stay fast
+    on the 1-core box."""
+    n = leaf_kb * 1024 // 4
+    return {
+        f"w{i}": np.full(n, float(i + 1), dtype=np.float32)
+        for i in range(n_leaves)
+    }
+
+
+# ---------------------------------------------------------------------------
+# stripe planner (pure function)
+# ---------------------------------------------------------------------------
+
+
+def test_plan_stripes_deterministic_complete_and_balanced() -> None:
+    chunks = list(range(9))
+    sizes = [10, 80, 20, 70, 30, 60, 40, 50, 90]
+    for donors in (1, 2, 3, 4):
+        a = ht._plan_stripes(chunks, sizes, donors)
+        b = ht._plan_stripes(chunks, sizes, donors)
+        assert a == b  # deterministic: no negotiation, no randomness
+        flat = sorted(i for stripe in a for i in stripe)
+        assert flat == chunks  # complete, no chunk assigned twice
+        loads = [sum(sizes[i] for i in stripe) for stripe in a]
+        # Byte-balanced: no stripe exceeds the ideal share by more than
+        # the largest single chunk (the LPT bound).
+        assert max(loads) - min(loads) <= max(sizes)
+        for stripe in a:
+            assert stripe == sorted(stripe)
+
+
+def test_plan_stripes_without_sizes_round_robins() -> None:
+    stripes = ht._plan_stripes([3, 5, 7, 9, 11], None, 2)
+    assert stripes == [[3, 7, 11], [5, 9]]
+
+
+def test_plan_stripes_more_donors_than_chunks() -> None:
+    stripes = ht._plan_stripes([0, 1], [4, 4], 4)
+    assert sorted(i for s in stripes for i in s) == [0, 1]
+    assert sum(1 for s in stripes if s) == 2
+
+
+# ---------------------------------------------------------------------------
+# transport-level striping
+# ---------------------------------------------------------------------------
+
+
+def test_striped_heal_across_donors_lands_bitwise_identical() -> None:
+    """Three donors serving the same committed state: the joiner stripes
+    the fetch across all of them, every chunk rides the stripe path, and
+    the result is bitwise identical — with zero re-fetches (striping is
+    not failover) and zero checksum failures."""
+    state = wide_state()
+    donors = [HTTPTransport(num_chunks=6) for _ in range(3)]
+    joiner = HTTPTransport()
+    try:
+        for d in donors:
+            d.send_checkpoint([1], step=5, state_dict=state, timeout=10,
+                              quorum_id=7)
+        before = stripe_counters()
+        out = joiner.recv_checkpoint(
+            0,
+            donors[0].metadata(),
+            5,
+            timeout=10,
+            quorum_id=7,
+            donors=[d.metadata() for d in donors[1:]],
+        )
+        after = stripe_counters()
+        assert_state_equal(state, out)
+        assert after["stripe_chunks"] - before["stripe_chunks"] == 6
+        assert after["stripe_bytes"] - before["stripe_bytes"] > 0
+        assert after["refetch"] - before["refetch"] == 0
+        assert after["checksum"] - before["checksum"] == 0
+        assert after["donor_failures"] - before["donor_failures"] == 0
+        # Every donor actually served something.
+        for d in donors:
+            assert d._served_event.is_set()
+    finally:
+        for d in donors:
+            d.shutdown()
+        joiner.shutdown()
+
+
+def test_single_donor_degrades_to_exactly_todays_path() -> None:
+    """One healthy donor (no extras advertised): the stripe counters do
+    not move — byte-for-byte the pre-striping fetch path."""
+    state = chunked_state()
+    donor = HTTPTransport(num_chunks=4)
+    joiner = HTTPTransport()
+    try:
+        donor.send_checkpoint([1], step=5, state_dict=state, timeout=10,
+                              quorum_id=7)
+        before = stripe_counters()
+        out = joiner.recv_checkpoint(
+            0, donor.metadata(), 5, timeout=10, quorum_id=7, donors=[]
+        )
+        after = stripe_counters()
+        assert_state_equal(state, out)
+        assert after["stripe_chunks"] - before["stripe_chunks"] == 0
+        assert after["refetch"] - before["refetch"] == 0
+    finally:
+        donor.shutdown()
+        joiner.shutdown()
+
+
+def test_stripe_env_kill_switch(monkeypatch) -> None:
+    """TPUFT_HEAL_STRIPE=0: advertised extra donors are ignored — the
+    whole fetch runs single-donor (and a DEAD extra donor is never even
+    contacted)."""
+    monkeypatch.setenv(ht.ENV_HEAL_STRIPE, "0")
+    state = chunked_state()
+    donor = HTTPTransport(num_chunks=4)
+    joiner = HTTPTransport()
+    try:
+        donor.send_checkpoint([1], step=5, state_dict=state, timeout=10,
+                              quorum_id=7)
+        before = stripe_counters()
+        out = joiner.recv_checkpoint(
+            0,
+            donor.metadata(),
+            5,
+            timeout=10,
+            quorum_id=7,
+            donors=["http://localhost:1"],  # nothing listens here
+        )
+        after = stripe_counters()
+        assert_state_equal(state, out)
+        assert after["stripe_chunks"] - before["stripe_chunks"] == 0
+    finally:
+        donor.shutdown()
+        joiner.shutdown()
+
+
+def test_donor_dies_mid_stripe_reassigned_with_exact_refetch() -> None:
+    """One of two donors cuts every stream: its whole stripe reassigns to
+    the survivor WITHIN the same attempt, the heal completes, and the
+    refetched bytes equal exactly the dead donor's unverified remainder
+    (the acceptance invariant, pinned via the stripe counters)."""
+    state = wide_state()
+    donor_a = HTTPTransport(num_chunks=6)
+    donor_b = HTTPTransport(num_chunks=6)
+    joiner = HTTPTransport()
+    try:
+        for d in (donor_a, donor_b):
+            d.send_checkpoint([1], step=5, state_dict=state, timeout=10,
+                              quorum_id=7)
+        donor_b._fault_hook = lambda step, index: "die"
+        before = stripe_counters()
+        out = joiner.recv_checkpoint(
+            0,
+            donor_a.metadata(),
+            5,
+            timeout=10,
+            quorum_id=7,
+            donors=[donor_b.metadata()],
+        )
+        after = stripe_counters()
+        assert_state_equal(state, out)
+        assert after["donor_failures"] - before["donor_failures"] == 1
+        reassigned = after["reassigned_chunks"] - before["reassigned_chunks"]
+        assert reassigned >= 1  # donor B owned at least one chunk
+        # Exactness: bytes re-fetched == the dead donor's unverified
+        # remainder, to the byte.
+        assert (
+            after["refetched_bytes"] - before["refetched_bytes"]
+            == after["reassigned_bytes"] - before["reassigned_bytes"]
+            > 0
+        )
+        # All six chunks landed, none corrupt.
+        assert after["stripe_chunks"] - before["stripe_chunks"] == 6
+        assert after["checksum"] - before["checksum"] == 0
+    finally:
+        donor_a.shutdown()
+        donor_b.shutdown()
+        joiner.shutdown()
+
+
+def test_stale_era_donor_inside_stripe_set_fenced_not_adopted() -> None:
+    """A stripe donor still staged for an older quorum era answers 409 on
+    its era-tagged chunk URLs: it is fenced out of the stripe set, its
+    chunks reassign to the in-era survivor, and the heal completes with
+    the correct state."""
+    state = wide_state()
+    donor_a = HTTPTransport(num_chunks=6)
+    donor_b = HTTPTransport(num_chunks=6)
+    joiner = HTTPTransport()
+    try:
+        donor_a.send_checkpoint([1], step=5, state_dict=state, timeout=10,
+                                quorum_id=7)
+        donor_b.send_checkpoint([1], step=5, state_dict=state, timeout=10,
+                                quorum_id=6)  # one era behind
+        before = stripe_counters()
+        out = joiner.recv_checkpoint(
+            0,
+            donor_a.metadata(),
+            5,
+            timeout=10,
+            quorum_id=7,
+            donors=[donor_b.metadata()],
+        )
+        after = stripe_counters()
+        assert_state_equal(state, out)
+        assert after["donor_failures"] - before["donor_failures"] == 1
+        assert after["reassigned_chunks"] - before["reassigned_chunks"] >= 1
+    finally:
+        donor_a.shutdown()
+        donor_b.shutdown()
+        joiner.shutdown()
+
+
+def test_corrupting_stripe_donor_fenced_never_adopted() -> None:
+    """A donor that corrupts EVERY serve: its chunks fail checksum until
+    the (short) per-fetch window expires, the donor is fenced, and the
+    survivor completes the heal — corrupt bytes never adopted (the final
+    state is bitwise identical to the committed one)."""
+    state = wide_state()
+    donor_a = HTTPTransport(num_chunks=6)
+    donor_b = HTTPTransport(num_chunks=6)
+    joiner = HTTPTransport()
+    try:
+        for d in (donor_a, donor_b):
+            d.send_checkpoint([1], step=5, state_dict=state, timeout=10,
+                              quorum_id=7)
+        donor_b._fault_hook = lambda step, index: "corrupt_stream"
+        before = stripe_counters()
+        out = joiner.recv_checkpoint(
+            0,
+            donor_a.metadata(),
+            5,
+            timeout=2.0,  # short window: the corrupt donor fences fast
+            quorum_id=7,
+            donors=[donor_b.metadata()],
+        )
+        after = stripe_counters()
+        assert_state_equal(state, out)
+        assert after["checksum"] - before["checksum"] >= 1
+        assert after["donor_failures"] - before["donor_failures"] == 1
+    finally:
+        donor_a.shutdown()
+        donor_b.shutdown()
+        joiner.shutdown()
+
+
+def test_all_stripe_donors_dead_fails_cleanly_resume_cache_kept() -> None:
+    """Every donor dies mid-stripe: the heal raises (the manager funnels
+    it into report_error) with the verified chunks cached per chunk; a
+    later fresh donor completes the heal re-fetching ONLY the missing
+    chunks."""
+    state = wide_state()
+    donor_a = HTTPTransport(num_chunks=6)
+    donor_b = HTTPTransport(num_chunks=6)
+    joiner = HTTPTransport()
+    try:
+        for d in (donor_a, donor_b):
+            d.send_checkpoint([1], step=5, state_dict=state, timeout=10,
+                              quorum_id=7)
+        # A serves its first chunk then dies; B dies immediately.
+        served_a: list = []
+
+        def a_fault(step, index):
+            if served_a:
+                return "die"
+            served_a.append(index)
+            return None
+
+        donor_a._fault_hook = a_fault
+        donor_b._fault_hook = lambda step, index: "die"
+        with pytest.raises(Exception):
+            joiner.recv_checkpoint(
+                0,
+                donor_a.metadata(),
+                5,
+                timeout=5,
+                quorum_id=7,
+                donors=[donor_b.metadata()],
+            )
+        (entry,) = joiner._heal_cache.values()
+        cached = len(entry.chunks)
+        assert 1 <= cached < 6
+        missing = 6 - cached
+
+        donor_c = HTTPTransport(num_chunks=6)
+        try:
+            donor_c.send_checkpoint([1], step=5, state_dict=state,
+                                    timeout=10, quorum_id=8)
+            mid = stripe_counters()
+            out = joiner.recv_checkpoint(
+                0, donor_c.metadata(), 5, timeout=10, quorum_id=8
+            )
+            after = stripe_counters()
+        finally:
+            donor_c.shutdown()
+        assert_state_equal(state, out)
+        assert after["refetch"] - mid["refetch"] == missing
+        assert after["resumed"] - mid["resumed"] > 0
+    finally:
+        donor_a.shutdown()
+        donor_b.shutdown()
+        joiner.shutdown()
+
+
+def test_gray_stripe_donor_fences_only_its_own_stripe(monkeypatch) -> None:
+    """A drip-feeding donor inside a stripe set is fenced by the progress
+    watchdog per stripe — the healthy donor's stripe keeps flowing and
+    the heal completes in the same attempt."""
+    monkeypatch.setenv(ht.ENV_HEAL_MIN_BPS, "100000")
+    state = wide_state()
+    donor_a = HTTPTransport(num_chunks=6)
+    donor_b = HTTPTransport(num_chunks=6)
+    joiner = HTTPTransport()
+    try:
+        for d in (donor_a, donor_b):
+            d.send_checkpoint([1], step=5, state_dict=state, timeout=10,
+                              quorum_id=7)
+        donor_b._fault_hook = lambda step, index: "stall_donor"
+        before = stripe_counters()
+        out = joiner.recv_checkpoint(
+            0,
+            donor_a.metadata(),
+            5,
+            timeout=60,
+            quorum_id=7,
+            donors=[donor_b.metadata()],
+        )
+        after = stripe_counters()
+        assert_state_equal(state, out)
+        assert after["stalled"] - before["stalled"] >= 1
+        assert after["donor_failures"] - before["donor_failures"] == 1
+    finally:
+        donor_a.shutdown()
+        donor_b.shutdown()
+        joiner.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# delta rejoin
+# ---------------------------------------------------------------------------
+
+
+def test_delta_rejoin_fetches_only_differing_chunks() -> None:
+    """A rejoiner whose local state differs in exactly one leaf fetches
+    exactly that chunk: the other chunks delta-match ((crc, size) equal)
+    and never cross the wire; the healed state is bitwise the donor's."""
+    state = wide_state(n_leaves=6)
+    stale = {k: v.copy() for k, v in state.items()}
+    stale["w3"] = stale["w3"] + 1.0  # one leaf diverged
+    donor = HTTPTransport(num_chunks=6)
+    joiner = HTTPTransport()
+    try:
+        donor.send_checkpoint([1], step=5, state_dict=state, timeout=10,
+                              quorum_id=7)
+        before = stripe_counters()
+        out = joiner.recv_checkpoint(
+            0,
+            donor.metadata(),
+            5,
+            timeout=10,
+            quorum_id=7,
+            local_state=stale,
+        )
+        after = stripe_counters()
+        assert_state_equal(state, out)
+        assert after["delta_matched"] - before["delta_matched"] == 5
+        saved = after["delta_bytes_saved"] - before["delta_bytes_saved"]
+        assert saved > 4 * 256 * 1024  # ~5 of 6 leaves stayed local
+        assert after["refetch"] - before["refetch"] == 0
+        assert after["delta_fallbacks"] - before["delta_fallbacks"] == 0
+    finally:
+        donor.shutdown()
+        joiner.shutdown()
+
+
+def test_delta_identical_state_fetches_nothing() -> None:
+    """The degenerate best case — a rejoiner already at the committed
+    state (e.g. it crashed after the commit landed): every chunk matches,
+    nothing is fetched, and the result is still bitwise correct."""
+    state = wide_state(n_leaves=4)
+    donor = HTTPTransport(num_chunks=4)
+    joiner = HTTPTransport()
+    try:
+        donor.send_checkpoint([1], step=5, state_dict=state, timeout=10,
+                              quorum_id=7)
+        before = stripe_counters()
+        out = joiner.recv_checkpoint(
+            0, donor.metadata(), 5, timeout=10, quorum_id=7,
+            local_state={k: v.copy() for k, v in state.items()},
+        )
+        after = stripe_counters()
+        assert_state_equal(state, out)
+        assert after["delta_matched"] - before["delta_matched"] == 4
+    finally:
+        donor.shutdown()
+        joiner.shutdown()
+
+
+def test_delta_layout_mismatch_falls_back_to_full_fetch() -> None:
+    """Local state with a different tree (an extra key) cannot be diffed:
+    one fallback is counted, nothing is matched, and the heal degrades to
+    the full fetch — never a wrong adoption."""
+    state = wide_state(n_leaves=4)
+    stale = {k: v.copy() for k, v in state.items()}
+    stale["extra"] = np.zeros(8, dtype=np.float32)
+    donor = HTTPTransport(num_chunks=4)
+    joiner = HTTPTransport()
+    try:
+        donor.send_checkpoint([1], step=5, state_dict=state, timeout=10,
+                              quorum_id=7)
+        before = stripe_counters()
+        out = joiner.recv_checkpoint(
+            0, donor.metadata(), 5, timeout=10, quorum_id=7,
+            local_state=stale,
+        )
+        after = stripe_counters()
+        assert_state_equal(state, out)
+        assert after["delta_fallbacks"] - before["delta_fallbacks"] == 1
+        assert after["delta_matched"] - before["delta_matched"] == 0
+    finally:
+        donor.shutdown()
+        joiner.shutdown()
+
+
+def test_delta_env_kill_switch(monkeypatch) -> None:
+    monkeypatch.setenv(ht.ENV_HEAL_DELTA, "0")
+    state = wide_state(n_leaves=4)
+    donor = HTTPTransport(num_chunks=4)
+    joiner = HTTPTransport()
+    try:
+        donor.send_checkpoint([1], step=5, state_dict=state, timeout=10,
+                              quorum_id=7)
+        before = stripe_counters()
+        out = joiner.recv_checkpoint(
+            0, donor.metadata(), 5, timeout=10, quorum_id=7,
+            local_state={k: v.copy() for k, v in state.items()},
+        )
+        after = stripe_counters()
+        assert_state_equal(state, out)
+        assert after["delta_matched"] - before["delta_matched"] == 0
+    finally:
+        donor.shutdown()
+        joiner.shutdown()
+
+
+def test_delta_composes_with_zero_skip_parts() -> None:
+    """A ZeRO rejoiner fetches neither shard parts (skip_parts) nor
+    unchanged chunks (delta): only the genuinely-different non-part chunk
+    crosses the wire; part leaves come back None for the shard plane to
+    reconstruct."""
+    from torchft_tpu.checkpointing.transport import HEAL_PART_PREFIX
+
+    part_key = f"{HEAL_PART_PREFIX}zero_shard_0"
+    state = wide_state(n_leaves=4)
+    state[part_key] = {"m": np.full(64, 3.0, dtype=np.float32)}
+    stale = {
+        k: (v.copy() if hasattr(v, "copy") else v)
+        for k, v in state.items()
+        if k != part_key
+    }
+    stale[part_key] = {"m": np.zeros(64, dtype=np.float32)}  # stale shard
+    stale["w1"] = stale["w1"] * 2.0  # one diverged non-part leaf
+    donor = HTTPTransport(num_chunks=4)
+    joiner = HTTPTransport()
+    try:
+        donor.send_checkpoint([1], step=5, state_dict=state, timeout=10,
+                              quorum_id=7)
+        before = stripe_counters()
+        out = joiner.recv_checkpoint(
+            0,
+            donor.metadata(),
+            5,
+            timeout=10,
+            quorum_id=7,
+            skip_parts={part_key},
+            local_state=stale,
+        )
+        after = stripe_counters()
+        # Non-part leaves bitwise identical; the skipped part is None.
+        for k in ("w0", "w1", "w2", "w3"):
+            np.testing.assert_array_equal(out[k], state[k])
+        assert out[part_key]["m"] is None
+        # 3 of 4 non-part chunks matched; the part chunk was skipped, so
+        # it was neither fetched nor matched.
+        assert after["delta_matched"] - before["delta_matched"] == 3
+        assert after["refetch"] - before["refetch"] == 0
+    finally:
+        donor.shutdown()
+        joiner.shutdown()
+
+
+def test_delta_endpoint_answers_manifest_diff_and_era_fence() -> None:
+    """GET /checkpoint/{step}/delta: the donor diffs the caller's CRC
+    manifest against the staged chunks (the curl-able twin of the joiner
+    side match), and the route sits behind the same era fence as every
+    other stripe route."""
+    import json as _json
+
+    from torchft_tpu._safe_pickle import safe_loads
+
+    state = wide_state(n_leaves=4)
+    donor = HTTPTransport(num_chunks=4)
+    try:
+        donor.send_checkpoint([1], step=5, state_dict=state, timeout=10,
+                              quorum_id=7)
+        base = f"{donor.metadata()}/checkpoint/5"
+        meta = safe_loads(urllib.request.urlopen(f"{base}/meta", timeout=5).read())
+        crcs = list(meta["chunk_crcs"])
+        crcs[2] ^= 0xDEAD  # my local chunk 2 differs
+        query = urllib.parse.urlencode(
+            {"crcs": ",".join(str(c) for c in crcs), "algo": meta["crc_algo"]}
+        )
+        with urllib.request.urlopen(f"{base}/delta?{query}", timeout=5) as resp:
+            body = _json.loads(resp.read().decode())
+        assert body["compatible"] is True
+        assert body["differing"] == [2]
+        assert body["differing_bytes"] == meta["chunk_sizes"][2]
+        # Wrong-length manifest: explicitly incompatible, not a guess.
+        query = urllib.parse.urlencode({"crcs": "1,2", "algo": meta["crc_algo"]})
+        with urllib.request.urlopen(f"{base}/delta?{query}", timeout=5) as resp:
+            assert _json.loads(resp.read().decode())["compatible"] is False
+        # Era fence holds on this route too.
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(
+                f"{base}/delta?quorum_id=99&crcs=1&algo=crc32c", timeout=5
+            )
+        assert err.value.code == 409
+    finally:
+        donor.shutdown()
+
+
+def test_delta_endpoint_served_by_serve_child_sidecar() -> None:
+    """Child serve mode answers /delta too (the CRCs ride the stage
+    command in the clear — the jax-free child never unpickles /meta)."""
+    import json as _json
+
+    from torchft_tpu._safe_pickle import safe_loads
+
+    state = wide_state(n_leaves=4)
+    donor = HTTPTransport(num_chunks=4, serve_mode="child")
+    try:
+        donor.send_checkpoint([1], step=5, state_dict=state, timeout=10,
+                              quorum_id=7)
+        base = f"{donor.metadata()}/checkpoint/5"
+        meta = safe_loads(urllib.request.urlopen(f"{base}/meta", timeout=10).read())
+        crcs = list(meta["chunk_crcs"])
+        crcs[0] ^= 1
+        query = urllib.parse.urlencode(
+            {"crcs": ",".join(str(c) for c in crcs), "algo": meta["crc_algo"]}
+        )
+        with urllib.request.urlopen(f"{base}/delta?{query}", timeout=10) as resp:
+            body = _json.loads(resp.read().decode())
+        assert body["compatible"] is True
+        assert body["differing"] == [0]
+    finally:
+        donor.shutdown()
+
+
+def test_punisher_corrupt_stripe_targets_one_donor(tmp_path, monkeypatch) -> None:
+    """The punisher's site-tagged corrupt_stripe arm hits exactly the
+    targeted donor's serve (by port tag) — the untargeted donor's stripe
+    serves clean, the corrupt one is re-fetched after its CRC rejects."""
+    from torchft_tpu.punisher import arm_stream_fault
+    from torchft_tpu.utils import faultinject
+
+    fault_file = str(tmp_path / "fault_cmd")
+    monkeypatch.setenv(faultinject.ENV_FAULT_FILE, fault_file)
+    state = wide_state()
+    donor_a = HTTPTransport(num_chunks=6)
+    donor_b = HTTPTransport(num_chunks=6)
+    joiner = HTTPTransport()
+    try:
+        for d in (donor_a, donor_b):
+            d.send_checkpoint([1], step=5, state_dict=state, timeout=10,
+                              quorum_id=7)
+        b_port = donor_b._server.server_address[1]
+        assert arm_stream_fault("corrupt_stripe", fault_file,
+                                donor_tag=str(b_port))
+        before = stripe_counters()
+        out = joiner.recv_checkpoint(
+            0,
+            donor_a.metadata(),
+            5,
+            timeout=10,
+            quorum_id=7,
+            donors=[donor_b.metadata()],
+        )
+        after = stripe_counters()
+        assert_state_equal(state, out)
+        # Exactly one arm, one corrupt serve, one clean re-fetch; donor A
+        # (untagged) never consumed the fault.
+        assert after["checksum"] - before["checksum"] == 1
+    finally:
+        donor_a.shutdown()
+        donor_b.shutdown()
+        joiner.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# manager-level donor-set plumbing (mocked coordination plane)
+# ---------------------------------------------------------------------------
+
+
+def member(replica_id: str, address: str, step: int) -> QuorumMember:
+    return QuorumMember(replica_id=replica_id, address=address, step=step)
+
+
+def stripe_quorum(max_step: int = 3, quorum_id: int = 2, participants=None):
+    return make_quorum(
+        quorum_id=quorum_id,
+        replica_rank=1,
+        replica_world_size=2,
+        heal=True,
+        max_step=max_step,
+        recover_src_manager_address="donor_a:1",
+        recover_src_replica_rank=0,
+        quorum=Quorum(quorum_id=quorum_id, participants=participants or []),
+    )
+
+
+def patched_manager_client(url_by_addr):
+    """Patch torchft_tpu.manager.ManagerClient so _checkpoint_metadata
+    resolves per manager address (the striped donor resolution path)."""
+
+    def factory(addr, connect_timeout=None):
+        client = MagicMock()
+        if addr not in url_by_addr:
+            raise ConnectionError(f"no route to {addr}")
+        client._checkpoint_metadata.return_value = url_by_addr[addr]
+        return client
+
+    return patch("torchft_tpu.manager.ManagerClient", side_effect=factory)
+
+
+def test_manager_passes_resolved_donor_set_to_transport() -> None:
+    """_heal_as_joiner resolves every max-step participant (except the
+    assigned donor and itself), rotates by group rank, tolerates a
+    donor that fails resolution, and excludes stale-step members."""
+    manager, client, _, transport = make_manager(
+        pg=ProcessGroupDummy(), min_replica_size=1
+    )
+    transport.recv_checkpoint.return_value = {
+        "user": {"model": {"w": np.zeros(2)}},
+        "tpuft": {"step": 3, "batches_committed": 6},
+    }
+    participants = [
+        member("ra", "donor_a:1", 3),        # assigned donor: excluded
+        member("rb", "donor_b:1", 3),
+        member("rc", "donor_c:1", 3),
+        member("rd", "donor_d:1", 3),        # resolution will fail
+        member("stale", "stale:1", 1),       # behind max_step: excluded
+        member(manager._replica_id, "me:1", 0),  # self: excluded
+    ]
+    with patched_manager_client(
+        {
+            "donor_a:1": "http://a:0",
+            "donor_b:1": "http://b:0",
+            "donor_c:1": "http://c:0",
+            # donor_d unresolvable
+        }
+    ):
+        client._quorum.return_value = stripe_quorum(participants=participants)
+        manager.start_quorum()
+    assert manager.errored() is None
+    kwargs = transport.recv_checkpoint.call_args[1]
+    assert kwargs["metadata"] == "http://a:0"
+    # group_rank=1 rotates [b, c, d] -> [c, d, b]; d fails resolution.
+    assert kwargs["donors"] == ["http://c:0", "http://b:0"]
+    assert (
+        metrics.gauge_value(
+            "tpuft_heal_stripe_donors", **manager._metric_labels
+        )
+        == 3.0
+    )
+    manager.shutdown(wait=False)
+
+
+def test_manager_skips_striping_for_step_zero_mosaic() -> None:
+    """max_step == 0 is the init_sync per-rank mosaic: state is NOT
+    bitwise identical across replicas yet, so no donor set is built."""
+    manager, client, _, transport = make_manager(
+        pg=ProcessGroupDummy(), min_replica_size=1
+    )
+    transport.recv_checkpoint.return_value = {
+        "user": {"model": {"w": np.zeros(2)}},
+        "tpuft": {"step": 0, "batches_committed": 0},
+    }
+    with patched_manager_client({"donor_a:1": "http://a:0"}):
+        client._quorum.return_value = stripe_quorum(
+            max_step=0,
+            participants=[member("ra", "donor_a:1", 0),
+                          member("rb", "donor_b:1", 0)],
+        )
+        manager.start_quorum()
+    assert transport.recv_checkpoint.call_args[1]["donors"] == []
+    manager.shutdown(wait=False)
+
+
+def test_manager_delta_local_state_only_with_real_progress() -> None:
+    """local_state rides the heal only when the rejoiner has committed
+    progress (step > 0): a fresh joiner diffs nothing."""
+    manager, client, _, transport = make_manager(
+        pg=ProcessGroupDummy(), min_replica_size=1
+    )
+    transport.recv_checkpoint.return_value = {
+        "user": {"model": {"w": np.zeros(2)}},
+        "tpuft": {"step": 3, "batches_committed": 6},
+    }
+    with patched_manager_client({"donor_a:1": "http://a:0"}):
+        client._quorum.return_value = stripe_quorum(participants=[])
+        manager.start_quorum()
+        assert transport.recv_checkpoint.call_args[1]["local_state"] is None
+
+        # Now the manager has real progress: the next heal diffs it.
+        assert manager.current_step() == 3
+        client._quorum.return_value = stripe_quorum(
+            max_step=5, quorum_id=3, participants=[]
+        )
+        transport.recv_checkpoint.return_value = {
+            "user": {"model": {"w": np.zeros(2)}},
+            "tpuft": {"step": 5, "batches_committed": 10},
+        }
+        manager.start_quorum()
+    local = transport.recv_checkpoint.call_args[1]["local_state"]
+    assert local is not None
+    assert local["tpuft"]["step"] == 3  # the stale snapshot, pre-heal
+    manager.shutdown(wait=False)
+
+
+def test_manager_costages_when_a_peer_heals() -> None:
+    """A non-assigned member standing at max_step stages its checkpoint
+    when the quorum shows a healing peer — the striped donor set is the
+    whole max-step cohort, not just the assigned donor."""
+    manager, client, _, transport = make_manager(pg=ProcessGroupDummy())
+    manager._step = 3
+    before = metrics.counter_total(
+        "tpuft_heal_stripe_costages_total", **manager._metric_labels
+    )
+    client._quorum.return_value = make_quorum(
+        quorum_id=4,
+        max_step=3,
+        quorum=Quorum(
+            quorum_id=4,
+            participants=[
+                member(manager._replica_id, "me:1", 3),
+                member("joiner", "joiner:1", 1),  # healing peer
+            ],
+        ),
+    )
+    manager.start_quorum()
+    manager.wait_quorum()
+    transport.send_checkpoint.assert_called_once()
+    kwargs = transport.send_checkpoint.call_args[1]
+    assert kwargs["step"] == 3 and kwargs["quorum_id"] == 4
+    assert (
+        metrics.counter_total(
+            "tpuft_heal_stripe_costages_total", **manager._metric_labels
+        )
+        - before
+        == 1
+    )
+    manager.shutdown(wait=False)
+
+
+def test_manager_does_not_costage_without_healing_peer() -> None:
+    """No joiner in the quorum → no co-stage (the common healthy round
+    stays zero-cost)."""
+    manager, client, _, transport = make_manager(pg=ProcessGroupDummy())
+    manager._step = 3
+    client._quorum.return_value = make_quorum(
+        quorum_id=4,
+        max_step=3,
+        quorum=Quorum(
+            quorum_id=4,
+            participants=[
+                member(manager._replica_id, "me:1", 3),
+                member("peer", "peer:1", 3),
+            ],
+        ),
+    )
+    manager.start_quorum()
+    manager.wait_quorum()
+    transport.send_checkpoint.assert_not_called()
+    manager.shutdown(wait=False)
+
+
+# ---------------------------------------------------------------------------
+# threads-as-replicas rejoin drills (loopback, both commit orderings)
+# ---------------------------------------------------------------------------
+
+
+def committed_state_dict(params: dict, step: int) -> dict:
+    # Mirrors the rejoiner's registered state exactly: make_manager
+    # registers a small "model" entry, make_rejoiner adds "params" — the
+    # donor's staged tree must be the same shape for the delta manifest
+    # to be diffable.
+    return {
+        "user": {"model": {"w": np.ones(2)}, "params": params},
+        "tpuft": {"step": step, "batches_committed": step * 2},
+    }
+
+
+def make_rejoiner(depth: int, stale_params: dict, stale_step: int):
+    """A rejoining replica with REAL heal transport + registered stale
+    state, in the requested commit ordering."""
+    transport = HTTPTransport()
+    manager, client, _, _ = make_manager(
+        pg=ProcessGroupDummy(),
+        min_replica_size=1,
+        commit_pipeline_depth=depth,
+        checkpoint_transport=transport,
+    )
+    assert manager.commit_pipeline_depth == depth
+    holder = {"params": stale_params}
+    healed: list = []
+
+    def load(state):
+        holder["params"] = state
+        healed.append(state)
+
+    manager.register_state_dict_fn(
+        "params", load_state_dict=load, state_dict=lambda: holder["params"]
+    )
+    manager._step = stale_step
+    return manager, client, transport, holder, healed
+
+
+@pytest.mark.parametrize("depth", [0, 1], ids=["strict", "pipelined"])
+def test_stale_rejoiner_striped_delta_drill(depth, monkeypatch) -> None:
+    """The flagship rejoin drill, threads-as-replicas over loopback HTTP:
+    a stale rejoiner (2 of 6 leaves behind the committed state) heals
+    striped across TWO real donor transports with delta rejoin on — it
+    fetches measurably less than the full payload, both donors serve, the
+    post-heal state is bitwise identical to the committed one, and the
+    next round commits cleanly in strict AND pipelined orderings."""
+    monkeypatch.delenv("TPUFT_COMMIT_PIPELINE", raising=False)
+    committed = wide_state(n_leaves=6)
+    stale = {k: v.copy() for k, v in committed.items()}
+    stale["w1"] = stale["w1"] * 0.5
+    stale["w4"] = stale["w4"] - 1.0
+    payload = sum(v.nbytes for v in committed.values())
+
+    donor_a = HTTPTransport(num_chunks=16)
+    donor_b = HTTPTransport(num_chunks=16)
+    manager = None
+    try:
+        for d in (donor_a, donor_b):
+            d.send_checkpoint(
+                [1], step=7, state_dict=committed_state_dict(committed, 7),
+                timeout=10, quorum_id=2,
+            )
+        manager, client, transport, holder, healed = make_rejoiner(
+            depth, stale, stale_step=3
+        )
+        before = stripe_counters()
+        with patched_manager_client(
+            {"donor_a:1": donor_a.metadata(), "donor_b:1": donor_b.metadata()}
+        ):
+            client._quorum.return_value = make_quorum(
+                quorum_id=2,
+                replica_rank=1,
+                replica_world_size=2,
+                heal=True,
+                max_step=7,
+                recover_src_manager_address="donor_a:1",
+                recover_src_replica_rank=0,
+                quorum=Quorum(
+                    quorum_id=2,
+                    participants=[
+                        member("ra", "donor_a:1", 7),
+                        member("rb", "donor_b:1", 7),
+                        member(manager._replica_id, "me:1", 3),
+                    ],
+                ),
+            )
+            manager.start_quorum()
+        after = stripe_counters()
+        assert manager.errored() is None, manager.errored()
+        assert manager.current_step() == 7
+        # Healed state adopted through the registered load fn, bitwise
+        # identical to the committed state.
+        assert len(healed) == 1
+        assert_state_equal(committed, holder["params"])
+        # Delta rejoin did real work: most leaves never crossed the wire.
+        saved = after["delta_bytes_saved"] - before["delta_bytes_saved"]
+        fetched = after["stripe_bytes"] - before["stripe_bytes"]
+        assert saved > payload / 2, (saved, payload)
+        assert 0 < fetched < payload / 2, (fetched, payload)
+        # ...and the fetch that did happen was striped across both donors.
+        assert after["stripe_chunks"] - before["stripe_chunks"] >= 2
+        assert donor_a._served_event.is_set()
+        assert donor_b._served_event.is_set()
+        assert after["checksum"] - before["checksum"] == 0
+
+        # The next healthy round commits in this ordering.
+        client._quorum.return_value = make_quorum(
+            quorum_id=3, replica_rank=0, replica_world_size=1,
+            max_step=7, max_rank=0, max_world_size=1,
+        )
+        client.should_commit.side_effect = (
+            lambda rank, step, vote, timeout: vote
+        )
+        manager.start_quorum()
+        manager.wait_quorum()
+        assert manager.should_commit() is True
+    finally:
+        donor_a.shutdown()
+        donor_b.shutdown()
+        if manager is not None:
+            manager.shutdown(wait=False)
+
+
+@pytest.mark.parametrize("fault", ["die", "corrupt_stream"],
+                         ids=["dead_donor", "corrupt_donor"])
+@pytest.mark.parametrize("depth", [0, 1], ids=["strict", "pipelined"])
+def test_rejoiner_drill_survives_stripe_donor_fault(
+    depth, fault, monkeypatch
+) -> None:
+    """Same drill with one donor of the stripe set dying / corrupting
+    mid-stripe: the heal still lands bitwise identical IN the same
+    attempt (reassignment, not cross-round failover), and bad bytes are
+    never adopted."""
+    monkeypatch.delenv("TPUFT_COMMIT_PIPELINE", raising=False)
+    committed = wide_state(n_leaves=6)
+    stale = {k: v.copy() for k, v in committed.items()}
+    stale["w0"] = stale["w0"] + 2.0
+    stale["w2"] = stale["w2"] + 2.0
+    stale["w5"] = stale["w5"] + 2.0
+
+    donor_a = HTTPTransport(num_chunks=16)
+    donor_b = HTTPTransport(num_chunks=16)
+    manager = None
+    try:
+        for d in (donor_a, donor_b):
+            d.send_checkpoint(
+                [1], step=7, state_dict=committed_state_dict(committed, 7),
+                timeout=10, quorum_id=2,
+            )
+        donor_b._fault_hook = lambda step, index: fault
+        manager, client, transport, holder, healed = make_rejoiner(
+            depth, stale, stale_step=3
+        )
+        # Short transport timeout so the corrupt donor's checksum-retry
+        # window expires in test time (manager timeout also bounds the
+        # whole recv).
+        manager._timeout = 3.0
+        before = stripe_counters()
+        with patched_manager_client(
+            {"donor_a:1": donor_a.metadata(), "donor_b:1": donor_b.metadata()}
+        ):
+            client._quorum.return_value = make_quorum(
+                quorum_id=2,
+                replica_rank=1,
+                replica_world_size=2,
+                heal=True,
+                max_step=7,
+                recover_src_manager_address="donor_a:1",
+                recover_src_replica_rank=0,
+                quorum=Quorum(
+                    quorum_id=2,
+                    participants=[
+                        member("ra", "donor_a:1", 7),
+                        member("rb", "donor_b:1", 7),
+                        member(manager._replica_id, "me:1", 3),
+                    ],
+                ),
+            )
+            manager.start_quorum()
+        after = stripe_counters()
+        assert manager.errored() is None, manager.errored()
+        assert manager.current_step() == 7
+        assert_state_equal(committed, holder["params"])
+        assert after["donor_failures"] - before["donor_failures"] >= 1
+    finally:
+        donor_a.shutdown()
+        donor_b.shutdown()
+        if manager is not None:
+            manager.shutdown(wait=False)
+
+
+@pytest.mark.parametrize("depth", [0, 1], ids=["strict", "pipelined"])
+def test_rejoiner_drill_all_donors_dead_funnels_report_error(
+    depth, monkeypatch
+) -> None:
+    """Every stripe donor dead: the heal fails THROUGH report_error (the
+    step boundary holds, stale state is never replaced by a partial
+    adoption) in both commit orderings."""
+    monkeypatch.delenv("TPUFT_COMMIT_PIPELINE", raising=False)
+    committed = wide_state(n_leaves=6)
+    stale = {k: v.copy() for k, v in committed.items()}
+    stale["w1"] = stale["w1"] * 3.0
+
+    donor_a = HTTPTransport(num_chunks=16)
+    donor_b = HTTPTransport(num_chunks=16)
+    manager = None
+    try:
+        for d in (donor_a, donor_b):
+            d.send_checkpoint(
+                [1], step=7, state_dict=committed_state_dict(committed, 7),
+                timeout=10, quorum_id=2,
+            )
+            d._fault_hook = lambda step, index: "die"
+        manager, client, transport, holder, healed = make_rejoiner(
+            depth, stale, stale_step=3
+        )
+        manager._timeout = 3.0
+        with patched_manager_client(
+            {"donor_a:1": donor_a.metadata(), "donor_b:1": donor_b.metadata()}
+        ):
+            client._quorum.return_value = make_quorum(
+                quorum_id=2,
+                replica_rank=1,
+                replica_world_size=2,
+                heal=True,
+                max_step=7,
+                recover_src_manager_address="donor_a:1",
+                recover_src_replica_rank=0,
+                quorum=Quorum(
+                    quorum_id=2,
+                    participants=[
+                        member("ra", "donor_a:1", 7),
+                        member("rb", "donor_b:1", 7),
+                        member(manager._replica_id, "me:1", 3),
+                    ],
+                ),
+            )
+            manager.start_quorum()
+        assert manager.errored() is not None
+        # Nothing adopted: the stale params are untouched.
+        assert not healed
+        np.testing.assert_array_equal(
+            holder["params"]["w1"], committed["w1"] * 3.0
+        )
+        client.should_commit.side_effect = (
+            lambda rank, step, vote, timeout: vote
+        )
+        assert manager.should_commit() is False
+    finally:
+        donor_a.shutdown()
+        donor_b.shutdown()
+        if manager is not None:
+            manager.shutdown(wait=False)

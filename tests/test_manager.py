@@ -45,6 +45,7 @@ def make_quorum(
     recover_src_manager_address: str = "",
     recover_src_replica_rank: Optional[int] = None,
     recover_dst_replica_ranks=(),
+    quorum=None,
 ) -> QuorumResult:
     if max_rank is None and not heal:
         max_rank = replica_rank
@@ -60,6 +61,7 @@ def make_quorum(
         max_rank=max_rank,
         max_world_size=max_world_size,
         heal=heal,
+        quorum=quorum,
     )
 
 
@@ -72,8 +74,10 @@ def make_manager(
     **kwargs,
 ):
     pg = pg if pg is not None else create_autospec(ProcessGroup, instance=True)
-    transport = create_autospec(CheckpointTransport, instance=True)
-    transport.metadata.return_value = "http://fake:0"
+    transport = kwargs.pop("checkpoint_transport", None)
+    if transport is None:
+        transport = create_autospec(CheckpointTransport, instance=True)
+        transport.metadata.return_value = "http://fake:0"
     with patch("torchft_tpu.manager.ManagerClient", autospec=True) as client_cls:
         manager = Manager(
             pg=pg,

@@ -383,9 +383,36 @@ def test_serve_rate_pacer_bounds_throughput() -> None:
             self.n += len(data)
 
     sink = Sink()
-    w = sc._RateWriter(sink, gbps=8.0)
+    w = sc._RateWriter(sink, sc._ServePacer(8.0))
     t0 = time.perf_counter()
     w.write(b"\0" * (1 << 20))
     elapsed = time.perf_counter() - t0
     assert sink.n == 1 << 20
     assert elapsed >= 0.0008
+
+
+def test_serve_rate_bound_is_process_aggregate() -> None:
+    """The egress bound is an AGGREGATE bound: two streams writing through
+    the same pacer share the configured rate (a striped or pooled joiner
+    cannot multiply a donor's egress by its connection count)."""
+    import threading as _threading
+
+    class Sink:
+        def write(self, data) -> None:
+            pass
+
+    pacer = sc._ServePacer(8.0)  # 1 GB/s -> 2 MB total = ~2 ms minimum
+    writers = [sc._RateWriter(Sink(), pacer) for _ in range(2)]
+    t0 = time.perf_counter()
+    threads = [
+        _threading.Thread(target=lambda w=w: w.write(b"\0" * (1 << 20)))
+        for w in writers
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - t0
+    # Per-connection pacing would finish both in ~1 ms wall; the shared
+    # bucket needs ~2 ms for 2 MB.
+    assert elapsed >= 0.0016

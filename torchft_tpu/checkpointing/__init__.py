@@ -13,6 +13,9 @@ from torchft_tpu.checkpointing.http_transport import (
     HealIntegrityError,
     HealStalledError,
     HTTPTransport,
+    heal_delta_enabled,
+    heal_stripe_enabled,
+    heal_stripe_max_donors,
 )
 from torchft_tpu.checkpointing.pg_transport import PGTransport
 from torchft_tpu.checkpointing.serve_child import (
@@ -33,4 +36,7 @@ __all__ = [
     "ServeChild",
     "ServeChildCrashed",
     "ServeChildUnavailable",
+    "heal_delta_enabled",
+    "heal_stripe_enabled",
+    "heal_stripe_max_donors",
 ]

@@ -19,11 +19,33 @@ Heal-path hardening (beyond the reference, which trusts the stream):
   chunk is re-fetched within its bounded retry window; an exhausted
   window raises — corrupt state is never adopted (the caller funnels the
   error into Manager.report_error).
-- **Resume + donor failover**: verified chunks are cached keyed by
-  ``(step, digest)``. When the donor dies mid-stream the heal fails
-  cleanly; the next attempt — any donor, any quorum era — re-fetches only
-  the missing chunks (committed state at a step is bitwise identical
-  across donors, and the digest proves it).
+- **Resume + donor failover**: verified chunks are cached per chunk,
+  keyed by ``(step, digest)``. When a donor dies mid-stream the heal
+  fails cleanly; the next attempt — any donor, any quorum era —
+  re-fetches only the missing chunks (committed state at a step is
+  bitwise identical across donors, and the digest proves it).
+- **Multi-donor striping** (``$TPUFT_HEAL_STRIPE``, default on): when
+  the manager hands ``recv_checkpoint`` more than one donor address,
+  the chunk index is partitioned byte-balanced across the donor set and
+  fetched by one worker per donor in parallel — recovery bandwidth
+  scales with healthy-donor count instead of being bounded by one
+  donor's egress. Every chunk still verifies independently (the CRC +
+  progress watchdog apply per stripe, so a gray donor fences only its
+  own stripe); a donor that dies, stalls, serves a stale era, or
+  corrupts a chunk mid-stripe has its unfetched ranges reassigned to
+  the surviving donors, and the per-chunk resume cache guarantees only
+  missing chunks are ever re-fetched. One healthy donor degrades to
+  exactly the single-donor path.
+- **Delta rejoin** (``$TPUFT_HEAL_DELTA``, default on): a rejoiner that
+  still holds stale-but-recent state passes it as ``local_state``; the
+  transport plans it into the donor's exact chunk layout, checksums
+  each local chunk, and adopts chunks whose ``(crc, size)`` matches the
+  donor's ``/meta`` manifest WITHOUT fetching them — composing with the
+  ZeRO ``skip_parts`` filter so a rejoiner fetches neither shard parts
+  nor unchanged chunks. A layout mismatch (different tree, chunking, or
+  checksum algo) falls back to the full fetch, never to a wrong one.
+  The donor side serves the symmetric ``/checkpoint/{step}/delta``
+  manifest-diff endpoint for operators and drills.
 - **Gray-failure fencing**: every chunk stream runs under a
   minimum-progress watchdog (``$TPUFT_HEAL_MIN_BYTES_PER_SEC``, default
   1024): a hung or drip-feeding donor is fenced within the watchdog
@@ -79,6 +101,7 @@ from torchft_tpu.checkpointing.serve_child import (
     _CorruptingWriter,
     _DripWriter,
     _TruncatingWriter,
+    _delta_response,
     maybe_pace_serve,
 )
 from torchft_tpu.checkpointing.transport import (
@@ -95,6 +118,41 @@ __all__ = [
 ]
 
 ENV_HEAL_MIN_BPS = "TPUFT_HEAL_MIN_BYTES_PER_SEC"
+# Multi-donor striping: enable switch + a cap on how many donors one
+# joiner stripes across (each extra donor costs one metadata-resolution
+# RPC and one worker thread; past ~8 the joiner's ingress is the
+# bottleneck anyway).
+ENV_HEAL_STRIPE = "TPUFT_HEAL_STRIPE"
+ENV_HEAL_STRIPE_MAX_DONORS = "TPUFT_HEAL_STRIPE_MAX_DONORS"
+# Delta rejoin: adopt local chunks whose (crc, size) matches the donor's
+# manifest instead of fetching them.
+ENV_HEAL_DELTA = "TPUFT_HEAL_DELTA"
+
+
+def _env_flag(env: str, default: bool = True) -> bool:
+    value = os.environ.get(env)
+    if value is None:
+        return default
+    return value.strip().lower() not in ("0", "false", "off", "no", "")
+
+
+def heal_stripe_enabled() -> bool:
+    """Multi-donor striped heals (``$TPUFT_HEAL_STRIPE``, default on)."""
+    return _env_flag(ENV_HEAL_STRIPE, True)
+
+
+def heal_stripe_max_donors(default: int = 8) -> int:
+    """Donor-set cap for one striped heal (``$TPUFT_HEAL_STRIPE_MAX_DONORS``)."""
+    try:
+        n = int(os.environ.get(ENV_HEAL_STRIPE_MAX_DONORS, str(default)))
+    except ValueError:
+        return default
+    return max(1, n)
+
+
+def heal_delta_enabled() -> bool:
+    """Delta rejoin (``$TPUFT_HEAL_DELTA``, default on)."""
+    return _env_flag(ENV_HEAL_DELTA, True)
 
 logger = logging.getLogger(__name__)
 
@@ -294,7 +352,8 @@ class _Staged:
     """Prepared (header + host leaves) per chunk — ONE host copy total; the
     HTTP handlers stream straight from these buffers (no serialized copy,
     the round-1 2x-peak-memory finding). Integrity sidecar: per-chunk
-    checksums + the whole-checkpoint digest, computed once at stage time."""
+    checksums + sizes + the whole-checkpoint digest, computed once at
+    stage time."""
 
     def __init__(
         self,
@@ -313,6 +372,7 @@ class _Staged:
             name: {"chunk": index, "nbytes": chunks[index].total_size}
             for name, index in (parts or {}).items()
         }
+        self.chunk_sizes = [int(chunk.total_size) for chunk in chunks]
         self.chunk_crcs: List[int] = []
         for chunk in chunks:
             w = _CRCWriter(_CRC_UPDATERS[_CRC_ALGO])
@@ -330,6 +390,7 @@ class _Staged:
             chunk_crcs=self.chunk_crcs,
             digest=self.digest,
             parts=self.parts,
+            chunk_sizes=self.chunk_sizes,
         )
 
 
@@ -342,13 +403,15 @@ def _meta_bytes(
     chunk_crcs: List[int],
     digest: str,
     parts: Optional[Dict[str, Dict[str, int]]] = None,
+    chunk_sizes: Optional[List[int]] = None,
 ) -> bytes:
     """The exact ``/meta`` response body. Built once per stage in BOTH
     serve modes (the serving child receives these bytes pre-pickled over
     the control pipe and serves them verbatim — it never needs to
     unpickle a treedef, so it never needs jax). ``parts`` maps heal-part
     name -> {"chunk", "nbytes"} so a joiner can address (or skip) exactly
-    one part's payload."""
+    one part's payload; ``chunk_sizes`` lets the stripe planner balance
+    donors by bytes and pins the reassigned-remainder accounting exactly."""
     return pickle.dumps(
         {
             "format": 2,
@@ -360,6 +423,7 @@ def _meta_bytes(
             "chunk_crcs": chunk_crcs,
             "digest": digest,
             "parts": parts or {},
+            "chunk_sizes": chunk_sizes,
         }
     )
 
@@ -407,14 +471,47 @@ def _plan_chunks(
     return treedef, chunk_dicts, parts
 
 
+def _plan_stripes(
+    chunks: List[int], sizes: Optional[List[int]], num_donors: int
+) -> List[List[int]]:
+    """Partitions chunk indices across ``num_donors`` stripes, byte-balanced
+    when ``sizes`` is known (greedy longest-processing-time: biggest chunk
+    to the currently lightest stripe, ties to the lowest donor slot) and
+    count-balanced round-robin otherwise. Pure and deterministic — the
+    same inputs always yield the same plan, so drills can pin exactly
+    which donor owned which chunks. Within a stripe, chunks fetch in
+    ascending index order."""
+    num_donors = max(1, num_donors)
+    stripes: List[List[int]] = [[] for _ in range(num_donors)]
+    if sizes is None:
+        for slot, index in enumerate(chunks):
+            stripes[slot % num_donors].append(index)
+        return stripes
+    loads = [0] * num_donors
+    by_weight = sorted(chunks, key=lambda i: (-sizes[i], i))
+    for index in by_weight:
+        slot = min(range(num_donors), key=lambda d: (loads[d], d))
+        stripes[slot].append(index)
+        loads[slot] += sizes[index]
+    for stripe in stripes:
+        stripe.sort()
+    return stripes
+
+
 class _HealCacheEntry:
-    """Joiner-side resume state for one (step, digest): verified chunks (so
-    a failover re-fetches only what is missing) and which chunk indices
-    ever started transferring (so the re-fetch counter stays exact)."""
+    """Joiner-side per-chunk resume/accounting state for one (step,
+    digest): verified chunks (so a failover re-fetches only what is
+    missing), which chunk indices ever started transferring (so the
+    re-fetch counter stays exact), and where each verified chunk came
+    from (a donor URL, or ``"delta"`` for chunks adopted from the
+    rejoiner's own stale state). ``lock`` guards mutation — striped
+    heals verify chunks from several per-donor workers concurrently."""
 
     def __init__(self) -> None:
+        self.lock = threading.Lock()
         self.chunks: Dict[int, Tuple[Any, int]] = {}  # index -> (chunk, nbytes)
         self.attempted: Set[int] = set()
+        self.sources: Dict[int, str] = {}  # index -> donor url | "delta"
 
 
 class HTTPTransport(CheckpointTransport[Any]):
@@ -465,9 +562,11 @@ class HTTPTransport(CheckpointTransport[Any]):
         self._cond = threading.Condition()
         self._staged: Optional[_Staged] = None
         self._served_event = threading.Event()
-        # Joiner-side resume cache, at most one (step, digest) entry: the
-        # verified chunks of the last failed heal, reusable against ANY
-        # donor serving the same digest.
+        # Joiner-side resume cache: per-chunk accounting for the one
+        # (step, digest) heal currently in flight — each verified chunk
+        # (fetched from any donor, or delta-matched from local state) is
+        # reusable against ANY donor serving the same digest. Partials of
+        # an older (step, digest) are dropped when a new heal starts.
         self._heal_cache: Dict[Tuple[int, str], _HealCacheEntry] = {}
         # Chaos seam: tests set a callable (step, chunk_index) -> mode to
         # inject donor-side stream faults deterministically; when unset the
@@ -547,6 +646,24 @@ class HTTPTransport(CheckpointTransport[Any]):
                     body = staged.meta_bytes()
                     self.send_response(200)
                     self.send_header("Content-Type", "application/octet-stream")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif parts[2] == "delta":
+                    # Delta-manifest diff: the caller passes its local
+                    # per-chunk CRCs (?crcs=a,b,...&algo=...) and gets back
+                    # which chunks differ from the staged checkpoint —
+                    # the operator-facing twin of the joiner-side delta
+                    # match (same era fence as every other route).
+                    body = _delta_response(
+                        split.query,
+                        crc_algo=staged.crc_algo,
+                        chunk_crcs=staged.chunk_crcs,
+                        chunk_sizes=staged.chunk_sizes,
+                        digest=staged.digest,
+                    )
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
@@ -634,7 +751,12 @@ class HTTPTransport(CheckpointTransport[Any]):
         hook = self._fault_hook
         if hook is not None:
             return hook(step, index)
-        return faultinject.consume("heal_stream")
+        # The serve port tags this donor's fault site, so the punisher can
+        # target one donor of a stripe set (`heal_stream:<port>`); an
+        # untargeted `heal_stream` arm still matches by site-family prefix.
+        return faultinject.consume(
+            f"heal_stream:{self._server.server_address[1]}"
+        )
 
     # -- serve-child plumbing ----------------------------------------------
 
@@ -726,6 +848,7 @@ class HTTPTransport(CheckpointTransport[Any]):
                 name: {"chunk": index, "nbytes": sizes[index]}
                 for name, index in parts.items()
             },
+            chunk_sizes=sizes,
         )
         child.stage(
             step=step,
@@ -735,6 +858,9 @@ class HTTPTransport(CheckpointTransport[Any]):
             files=files,
             sizes=sizes,
             meta_bytes=meta,
+            crc_algo=_CRC_ALGO,
+            crcs=crcs,
+            digest=digest,
         )
         self._child_staged = True
 
@@ -811,48 +937,37 @@ class HTTPTransport(CheckpointTransport[Any]):
         timeout: float,
         quorum_id: Optional[int] = None,
         skip_parts: Optional[Set[str]] = None,
+        donors: Optional[List[str]] = None,
+        local_state: Optional[Any] = None,
     ) -> Any:
-        base = f"{metadata}/checkpoint/{step}"
-        meta = safe_loads(_fetch_retry(f"{base}/meta", timeout))
-        if not isinstance(meta, dict) or meta.get("format") != 2:
-            raise HealIntegrityError(
-                f"unrecognized checkpoint /meta format from {metadata}: "
-                f"{type(meta).__name__}"
-            )
+        # Donor set: the assigned donor first (it is the one the quorum
+        # proved holds max_step state), then every other advertised donor,
+        # deduped and capped. The digest is donor-independent by design,
+        # so any of them can serve any chunk.
+        donor_urls = [metadata]
+        if donors and heal_stripe_enabled():
+            for url in donors:
+                if url and url not in donor_urls:
+                    donor_urls.append(url)
+            donor_urls = donor_urls[: heal_stripe_max_donors()]
+        meta, meta_url = self._fetch_meta(donor_urls, step, timeout, quorum_id)
+        if meta_url != donor_urls[0]:
+            # Donors whose /meta failed (dead, stale era, corrupt) are
+            # dropped from the stripe set — their chunks would only burn
+            # a reassignment cycle.
+            donor_urls = donor_urls[donor_urls.index(meta_url):]
         num_chunks: int = meta["num_chunks"]
         treedef = meta["treedef"]
         chunk_crcs: Optional[List[int]] = meta.get("chunk_crcs")
+        chunk_sizes: Optional[List[int]] = meta.get("chunk_sizes")
         digest: Optional[str] = meta.get("digest")
         algo: str = meta.get("crc_algo", "crc32")
-        donor_era = meta.get("quorum_id")
-
-        # Era fence: never heal backwards from a survivor still staged for
-        # an older quorum (its state may predate commits we must match).
-        if (
-            quorum_id is not None
-            and donor_era is not None
-            and donor_era != quorum_id
-        ):
-            metrics.inc("tpuft_heal_era_rejects_total")
-            raise HealEraMismatch(
-                f"donor staged quorum era {donor_era}, joiner is healing in "
-                f"era {quorum_id}: rejecting the stale-era heal"
-            )
 
         crc_update = _CRC_UPDATERS.get(algo)
         if chunk_crcs is not None and crc_update is None:
             raise HealIntegrityError(
                 f"donor checksums use {algo!r}, unavailable on this host"
             )
-        # The digest must be exactly the checksums' binding — verified
-        # BEFORE any transfer so a tampered/buggy meta never costs a
-        # payload fetch and mismatched state is never adopted.
-        if digest is not None and chunk_crcs is not None:
-            if _checkpoint_digest(step, algo, chunk_crcs) != digest:
-                raise HealIntegrityError(
-                    "whole-checkpoint digest does not match the per-chunk "
-                    "checksums in /meta: refusing the heal"
-                )
 
         # Resume: reuse verified chunks from a previous failed attempt at
         # the same (step, digest) — valid across donors and quorum eras
@@ -861,8 +976,14 @@ class HTTPTransport(CheckpointTransport[Any]):
         entry = self._heal_cache.get(key) if key is not None else None
         if entry is None:
             entry = _HealCacheEntry()
-        # One entry total: stale (step, digest) partials are dropped here.
+        # One in-flight heal total: stale (step, digest) partials are
+        # dropped here; the surviving entry keeps per-chunk state.
         self._heal_cache = {key: entry} if key is not None else {}
+        # Resumed-ness is decided by what a PREVIOUS attempt left behind,
+        # before this attempt's delta matching adds local chunks (else a
+        # delta match would make every genuine first fetch count as a
+        # re-fetch and break the drills' exactness).
+        resumed = bool(entry.chunks)
         # Shard-addressable skip: parts the joiner reconstructs through a
         # cheaper plane (ZeRO shard re-balance) are never fetched at all —
         # their chunks' leaves come back as None and the saved wire bytes
@@ -881,20 +1002,42 @@ class HTTPTransport(CheckpointTransport[Any]):
                     "tpuft_zero_heal_bytes_saved_total",
                     sum(skipped_chunks.values()),
                 )
+        if resumed:
+            for _chunk, nbytes in entry.chunks.values():
+                metrics.inc("tpuft_heal_resumed_bytes_total", nbytes)
+
+        # Delta rejoin: adopt chunks whose (crc, size) matches the donor's
+        # manifest from the caller's stale-but-recent local state instead
+        # of fetching them. Composes with skip_parts (neither shard parts
+        # nor unchanged chunks cross the wire) and with the resume cache
+        # (already-verified chunks are never re-checksummed).
+        if (
+            local_state is not None
+            and heal_delta_enabled()
+            and chunk_crcs is not None
+            and crc_update is not None
+        ):
+            self._delta_match(
+                entry=entry,
+                local_state=local_state,
+                meta=meta,
+                crc_update=crc_update,
+                skipped_chunks=skipped_chunks,
+                step=step,
+            )
+
         missing = [
             i
             for i in range(num_chunks)
             if i not in entry.chunks and i not in skipped_chunks
         ]
-        resumed = bool(entry.chunks)
-        if resumed:
-            for _chunk, nbytes in entry.chunks.values():
-                metrics.inc("tpuft_heal_resumed_bytes_total", nbytes)
 
         era_tag = f"?quorum_id={quorum_id}" if quorum_id is not None else ""
         min_bps = _heal_min_bps()
 
-        def fetch_chunk(i: int) -> None:
+        def fetch_chunk(
+            i: int, base: str, stripe_retry: bool = False
+        ) -> int:
             # Stream-decode straight off the socket into final buffers: peak
             # memory = final leaves + one in-flight read window per chunk.
             expected = chunk_crcs[i] if chunk_crcs is not None else None
@@ -909,9 +1052,10 @@ class HTTPTransport(CheckpointTransport[Any]):
                 # failover this counter moves by exactly the missing
                 # chunks). The not-yet-staged 404 race never reaches here,
                 # so it never inflates the counter.
-                if resumed or i in entry.attempted or attempts[0] > 1:
-                    metrics.inc("tpuft_heal_chunk_refetches_total")
-                entry.attempted.add(i)
+                with entry.lock:
+                    if resumed or i in entry.attempted or attempts[0] > 1:
+                        metrics.inc("tpuft_heal_chunk_refetches_total")
+                    entry.attempted.add(i)
                 reader = _GuardedReader(
                     resp,
                     crc_update=crc_update if expected is not None else None,
@@ -963,10 +1107,19 @@ class HTTPTransport(CheckpointTransport[Any]):
             # Same bounded retry as the meta fetch — the donor's serve
             # window can close and reopen between our GETs — widened to the
             # retryable failure set (404, connection refused/reset from a
-            # restarting donor, truncation, checksum mismatch).
-            entry.chunks[i] = _fetch_retry(
-                f"{base}/{i}{era_tag}", timeout, consume=consume
+            # restarting donor, truncation, checksum mismatch). Striped
+            # workers narrow it: with other donors standing by, a dying
+            # donor is fenced and reassigned instead of betting the window
+            # on its supervised comeback.
+            verified = _fetch_retry(
+                f"{base}/checkpoint/{step}/{i}{era_tag}",
+                timeout,
+                consume=consume,
+                retryable=_stripe_retryable if stripe_retry else None,
             )
+            with entry.lock:
+                entry.chunks[i] = verified
+                entry.sources[i] = base
             # Heal progress in the fleet timeline: one instant per verified
             # chunk, so --explain-step can show how far along a heal was at
             # any moment (and which chunk a stall died on).
@@ -974,16 +1127,30 @@ class HTTPTransport(CheckpointTransport[Any]):
                 "heal_chunk_recv",
                 step=step,
                 chunk=i,
-                bytes=int(entry.chunks[i][1]),
+                bytes=int(verified[1]),
                 total_chunks=num_chunks,
+                donor=base,
             )
+            return int(verified[1])
 
-        if len(missing) <= 1:
+        if len(donor_urls) > 1 and len(missing) > 1:
+            # Striped heal: one worker per donor over a byte-balanced
+            # partition of the missing chunks; a failed donor's unfetched
+            # ranges reassign to the survivors.
+            self._striped_fetch(
+                donor_urls=donor_urls,
+                missing=missing,
+                chunk_sizes=chunk_sizes,
+                fetch_chunk=fetch_chunk,
+                step=step,
+            )
+        elif len(missing) <= 1:
             for i in missing:
-                fetch_chunk(i)
+                fetch_chunk(i, donor_urls[0])
         else:
+            base = donor_urls[0]
             with ThreadPoolExecutor(max_workers=min(len(missing), 8)) as pool:
-                futs = [pool.submit(fetch_chunk, i) for i in missing]
+                futs = [pool.submit(fetch_chunk, i, base) for i in missing]
                 try:
                     for f in futs:
                         f.result()
@@ -1009,6 +1176,287 @@ class HTTPTransport(CheckpointTransport[Any]):
         if key is not None:
             self._heal_cache.pop(key, None)
         return result
+
+    def _fetch_meta(
+        self,
+        donor_urls: List[str],
+        step: int,
+        timeout: float,
+        quorum_id: Optional[int],
+    ) -> Tuple[Dict[str, Any], str]:
+        """Fetches and validates ``/meta`` from the first donor that serves
+        an acceptable one (format, quorum era, digest binding). With one
+        donor this is exactly the old behavior — the first failure raises;
+        with a stripe set a dead or stale-era primary falls through to the
+        next donor (the digest is donor-independent, so whichever meta
+        wins describes every donor's bytes)."""
+        last: Optional[BaseException] = None
+        for url in donor_urls:
+            try:
+                meta = safe_loads(
+                    _fetch_retry(f"{url}/checkpoint/{step}/meta", timeout)
+                )
+                if not isinstance(meta, dict) or meta.get("format") != 2:
+                    raise HealIntegrityError(
+                        f"unrecognized checkpoint /meta format from {url}: "
+                        f"{type(meta).__name__}"
+                    )
+                donor_era = meta.get("quorum_id")
+                # Era fence: never heal backwards from a survivor still
+                # staged for an older quorum (its state may predate
+                # commits we must match).
+                if (
+                    quorum_id is not None
+                    and donor_era is not None
+                    and donor_era != quorum_id
+                ):
+                    metrics.inc("tpuft_heal_era_rejects_total")
+                    raise HealEraMismatch(
+                        f"donor staged quorum era {donor_era}, joiner is "
+                        f"healing in era {quorum_id}: rejecting the "
+                        "stale-era heal"
+                    )
+                digest = meta.get("digest")
+                chunk_crcs = meta.get("chunk_crcs")
+                # The digest must be exactly the checksums' binding —
+                # verified BEFORE any transfer so a tampered/buggy meta
+                # never costs a payload fetch and mismatched state is
+                # never adopted.
+                if digest is not None and chunk_crcs is not None:
+                    algo = meta.get("crc_algo", "crc32")
+                    if _checkpoint_digest(step, algo, chunk_crcs) != digest:
+                        raise HealIntegrityError(
+                            "whole-checkpoint digest does not match the "
+                            "per-chunk checksums in /meta: refusing the heal"
+                        )
+                return meta, url
+            except Exception as e:  # noqa: BLE001 — re-raised when last
+                last = e
+                if url != donor_urls[-1]:
+                    logger.warning(
+                        "heal /meta from %s failed (%s); trying the next "
+                        "donor in the stripe set",
+                        url,
+                        e,
+                    )
+        assert last is not None
+        raise last
+
+    def _delta_match(
+        self,
+        entry: _HealCacheEntry,
+        local_state: Any,
+        meta: Dict[str, Any],
+        crc_update: Callable[[int, Any], int],
+        skipped_chunks: Dict[int, int],
+        step: int,
+    ) -> None:
+        """Delta rejoin: plans ``local_state`` into the donor's exact chunk
+        layout, checksums each still-needed local chunk, and adopts those
+        whose (crc, size) matches the donor's manifest — serialized-byte
+        equality implies bitwise-equal leaves, so the post-heal state is
+        identical to a full fetch. Any layout mismatch (different tree,
+        chunk count, part map, or a failed local plan) falls back to the
+        full fetch: matching is an optimization, never a correctness
+        dependency."""
+        num_chunks: int = meta["num_chunks"]
+        chunk_crcs: List[int] = meta["chunk_crcs"]
+        chunk_sizes: Optional[List[int]] = meta.get("chunk_sizes")
+        parts_meta: Dict[str, Any] = meta.get("parts") or {}
+        t0 = time.perf_counter()
+
+        def fall_back(reason: str) -> None:
+            metrics.inc("tpuft_heal_delta_fallbacks_total")
+            logger.warning(
+                "delta rejoin manifest mismatch (%s); falling back to the "
+                "full fetch",
+                reason,
+            )
+
+        try:
+            base_n = num_chunks - len(parts_meta)
+            treedef, chunk_dicts, local_parts = _plan_chunks(
+                local_state, base_n
+            )
+        except Exception as e:  # noqa: BLE001 — never fail the heal here
+            fall_back(f"local chunk plan failed: {e}")
+            return
+        donor_parts = {
+            name: int(info["chunk"]) for name, info in parts_meta.items()
+        }
+        if (
+            treedef != meta["treedef"]
+            or len(chunk_dicts) != num_chunks
+            or local_parts != donor_parts
+        ):
+            fall_back(
+                "local state plans into a different chunk layout than the "
+                "donor's manifest"
+            )
+            return
+        matched = 0
+        saved = 0
+        for i, chunk_dict in enumerate(chunk_dicts):
+            if i in skipped_chunks or i in entry.chunks:
+                continue
+            prepared = _serialization.prepare(chunk_dict)
+            w = _CRCWriter(crc_update)
+            _serialization.write_prepared(prepared, w)
+            if w.crc == chunk_crcs[i] and (
+                chunk_sizes is None
+                or int(prepared.total_size) == int(chunk_sizes[i])
+            ):
+                with entry.lock:
+                    entry.chunks[i] = (chunk_dict, int(prepared.total_size))
+                    entry.sources[i] = "delta"
+                matched += 1
+                saved += int(prepared.total_size)
+        metrics.observe(
+            "tpuft_heal_delta_manifest_seconds", time.perf_counter() - t0
+        )
+        if matched:
+            metrics.inc("tpuft_heal_delta_chunks_matched_total", matched)
+            metrics.inc("tpuft_heal_delta_bytes_saved_total", saved)
+        tracing.record(
+            "heal_delta",
+            step=step,
+            matched=matched,
+            total_chunks=num_chunks,
+            bytes_saved=saved,
+        )
+
+    def _striped_fetch(
+        self,
+        donor_urls: List[str],
+        missing: List[int],
+        chunk_sizes: Optional[List[int]],
+        fetch_chunk: Callable[..., int],
+        step: int,
+    ) -> None:
+        """Fetches ``missing`` striped across ``donor_urls``: one worker per
+        donor walks its byte-balanced stripe; each chunk verifies through
+        the same CRC + progress-watchdog path as a single-donor heal (a
+        gray donor fences only its own stripe). A donor that fails mid-
+        stripe has its unfetched chunks reassigned round-robin to the
+        surviving donors; when the last donor dies the remaining error
+        raises to the caller (the resume cache keeps everything already
+        verified)."""
+        cond = threading.Condition()
+        stripes = _plan_stripes(missing, chunk_sizes, len(donor_urls))
+        queues: Dict[str, deque] = {
+            url: deque(stripe) for url, stripe in zip(donor_urls, stripes)
+        }
+        live: Set[str] = set(donor_urls)
+        state = {"inflight": 0, "error": None}
+        reassigned: Set[int] = set()
+
+        def size_of(i: int) -> int:
+            return int(chunk_sizes[i]) if chunk_sizes is not None else 0
+
+        def work_left() -> bool:
+            return state["inflight"] > 0 or any(queues.values())
+
+        def worker(url: str) -> None:
+            fetched = 0
+            fetched_bytes = 0
+            t0 = time.perf_counter()
+            while True:
+                with cond:
+                    if state["error"] is not None:
+                        break
+                    queue = queues[url]
+                    if queue:
+                        i = queue.popleft()
+                        state["inflight"] += 1
+                    elif url not in live or not work_left():
+                        cond.notify_all()
+                        break
+                    else:
+                        # Park until a reassignment lands in our queue or
+                        # the heal completes; the timeout is a liveness
+                        # backstop, not a pacing decision.
+                        cond.wait(0.1)
+                        continue
+                try:
+                    nbytes = fetch_chunk(i, url, stripe_retry=True)
+                except BaseException as e:  # noqa: BLE001 — donor-fatal
+                    with cond:
+                        state["inflight"] -= 1
+                        live.discard(url)
+                        orphans = [i] + list(queues[url])
+                        queues[url].clear()
+                        orphan_bytes = sum(size_of(c) for c in orphans)
+                        metrics.inc("tpuft_heal_stripe_donor_failures_total")
+                        metrics.inc(
+                            "tpuft_heal_stripe_reassigned_chunks_total",
+                            len(orphans),
+                        )
+                        if orphan_bytes:
+                            metrics.inc(
+                                "tpuft_heal_stripe_reassigned_bytes_total",
+                                orphan_bytes,
+                            )
+                        tracing.record(
+                            "heal_stripe_reassign",
+                            step=step,
+                            donor=url,
+                            chunks=len(orphans),
+                            bytes=orphan_bytes,
+                            survivors=len(live),
+                            reason=f"{type(e).__name__}: {e}"[:200],
+                        )
+                        logger.warning(
+                            "striped heal: donor %s failed mid-stripe (%s); "
+                            "reassigning %d chunk(s) to %d survivor(s)",
+                            url,
+                            e,
+                            len(orphans),
+                            len(live),
+                        )
+                        if live:
+                            reassigned.update(orphans)
+                            targets = sorted(live)
+                            for j, c in enumerate(orphans):
+                                queues[targets[j % len(targets)]].append(c)
+                        else:
+                            state["error"] = e
+                        cond.notify_all()
+                    break
+                with cond:
+                    state["inflight"] -= 1
+                    fetched += 1
+                    fetched_bytes += nbytes
+                    metrics.inc("tpuft_heal_stripe_chunks_total")
+                    metrics.inc("tpuft_heal_stripe_bytes_total", nbytes)
+                    if i in reassigned:
+                        # The acceptance invariant: bytes re-fetched after
+                        # a donor death equal exactly its unverified
+                        # remainder — this counter is the observable side.
+                        metrics.inc(
+                            "tpuft_heal_stripe_refetched_bytes_total", nbytes
+                        )
+                    if not work_left():
+                        cond.notify_all()
+            # One span per donor stripe for the fleet timeline: who served
+            # how much, and how long their stripe ran.
+            tracing.record(
+                "heal_stripe",
+                step=step,
+                donor=url,
+                chunks=fetched,
+                bytes=fetched_bytes,
+                duration_s=round(time.perf_counter() - t0, 6),
+                fenced=url not in live,
+            )
+
+        with ThreadPoolExecutor(
+            max_workers=len(donor_urls), thread_name_prefix="tpuft-stripe"
+        ) as pool:
+            futs = [pool.submit(worker, url) for url in donor_urls]
+            for f in futs:
+                f.result()
+        if state["error"] is not None:
+            raise state["error"]
 
     def shutdown(self, wait: bool = True) -> None:
         if self._serve_child is not None:
@@ -1040,15 +1488,33 @@ def _is_retryable_fetch_error(e: BaseException) -> bool:
     return isinstance(e, (ConnectionError, EOFError))
 
 
+def _stripe_retryable(e: BaseException) -> bool:
+    """Retry policy for a fetch inside a STRIPE set: with other donors
+    standing by, a dying/refusing/truncating donor is fenced immediately
+    and its chunks reassigned — betting the bounded window on its
+    supervised comeback (the single-donor rationale) would stall the whole
+    stripe on one dead peer. Only the staging race (404: the donor has not
+    staged this step yet) and a transient checksum mismatch re-try against
+    the same donor."""
+    if isinstance(e, HealStalledError):
+        return False
+    if isinstance(e, urllib.error.HTTPError):
+        return e.code == 404
+    return isinstance(e, HealChecksumError)
+
+
 def _fetch_retry(
     url: str,
     timeout: float,
     consume: Optional[Callable[[Any], Any]] = None,
+    retryable: Optional[Callable[[BaseException], bool]] = None,
 ) -> Any:
     """Fetch with bounded retry on transient failures; ``consume`` (default:
     read all bytes) processes the open response, letting chunk fetches
     stream-decode off the socket through the same retry loop as the meta
-    fetch.
+    fetch. ``retryable`` overrides the failure classification (default
+    :func:`_is_retryable_fetch_error`; striped fetches pass the narrower
+    :func:`_stripe_retryable`).
 
     Retryable failures (see :func:`_is_retryable_fetch_error`): a 404 from
     the donor means "nothing staged for this step" — often *not yet*: the
@@ -1074,6 +1540,7 @@ def _fetch_retry(
     inactivity bound, not a wall-time bound)."""
     delay = 0.05
     retry_deadline: Optional[float] = None
+    is_retryable = retryable if retryable is not None else _is_retryable_fetch_error
     while True:
         try:
             with urllib.request.urlopen(url, timeout=timeout) as resp:
@@ -1082,12 +1549,7 @@ def _fetch_retry(
             now = time.monotonic()
             if retry_deadline is None:
                 retry_deadline = now + timeout
-            if not _is_retryable_fetch_error(e) or now + delay >= retry_deadline:
+            if not is_retryable(e) or now + delay >= retry_deadline:
                 raise
         time.sleep(delay)
         delay = min(delay * 1.5, 1.0)
-
-
-# Historical name (the loop originally retried 404s only); kept so older
-# callers/tests keep importing.
-_fetch_retry_404 = _fetch_retry

@@ -157,13 +157,49 @@ def serve_rate_gbps(default: float = 0.0) -> float:
         return default
 
 
-class _RateWriter:
-    """Paces writes to ``bytes/s`` in bounded slices (sleep released
-    between slices, so a paced serve is IO-bound, not a CPU hog)."""
+class _ServePacer:
+    """Process-wide token bucket for the serve-egress bound: every paced
+    stream debits the SAME clock, so N parallel chunk streams (a striped
+    or pooled joiner) share the configured rate instead of each getting
+    it — ``TPUFT_HEAL_SERVE_GBPS`` bounds the donor's aggregate egress,
+    like the NIC share it stands for."""
 
-    def __init__(self, raw: Any, gbps: float, slice_bytes: int = 1 << 18) -> None:
-        self._raw = raw
+    def __init__(self, gbps: float) -> None:
+        self.gbps = gbps
         self._spb = 8.0 / (gbps * 1e9)
+        self._lock = threading.Lock()
+        self._ready = time.monotonic()
+
+    def debit(self, nbytes: int) -> float:
+        """Charges ``nbytes`` against the bucket; returns how long the
+        caller must sleep so the aggregate rate holds."""
+        with self._lock:
+            now = time.monotonic()
+            start = self._ready if self._ready > now else now
+            self._ready = start + nbytes * self._spb
+            return max(self._ready - now, 0.0)
+
+
+_pacer: Optional[_ServePacer] = None
+_pacer_lock = threading.Lock()
+
+
+def _shared_pacer(gbps: float) -> _ServePacer:
+    global _pacer
+    with _pacer_lock:
+        if _pacer is None or _pacer.gbps != gbps:
+            _pacer = _ServePacer(gbps)
+        return _pacer
+
+
+class _RateWriter:
+    """Paces writes through the process-wide bucket in bounded slices
+    (sleep released between slices, so a paced serve is IO-bound, not a
+    CPU hog)."""
+
+    def __init__(self, raw: Any, pacer: _ServePacer, slice_bytes: int = 1 << 18) -> None:
+        self._raw = raw
+        self._pacer = pacer
         self._slice = slice_bytes
 
     def write(self, data: Any) -> None:
@@ -173,15 +209,62 @@ class _RateWriter:
         for off in range(0, len(mv), self._slice):
             part = mv[off : off + self._slice]
             self._raw.write(part)
-            time.sleep(len(part) * self._spb)
+            delay = self._pacer.debit(len(part))
+            if delay > 0:
+                time.sleep(delay)
 
 
 def maybe_pace_serve(out: Any) -> Any:
-    """Wraps ``out`` with the serve-rate bound when configured."""
+    """Wraps ``out`` with the (process-aggregate) serve-rate bound when
+    configured."""
     gbps = serve_rate_gbps()
     if gbps > 0:
-        return _RateWriter(out, gbps)
+        return _RateWriter(out, _shared_pacer(gbps))
     return out
+
+
+def _delta_response(
+    query: str,
+    crc_algo: str,
+    chunk_crcs: Optional[List[int]],
+    chunk_sizes: Optional[List[int]],
+    digest: Optional[str],
+) -> bytes:
+    """The ``/checkpoint/{step}/delta`` manifest-diff body, shared by the
+    inline handler and the serving child (stdlib-only by construction):
+    the caller sends its local per-chunk CRCs (``?crcs=a,b,...&algo=...``)
+    and gets back which chunk indices differ from the staged checkpoint —
+    the donor-side twin of the joiner's delta-rejoin match, usable from
+    curl when debugging why a delta rejoin fetched more than expected."""
+    params = urllib.parse.parse_qs(query)
+    algo = params.get("algo", [crc_algo])[0]
+    try:
+        crcs = [
+            int(c) for c in params.get("crcs", [""])[0].split(",") if c
+        ]
+    except ValueError:
+        crcs = None  # type: ignore[assignment]
+    body: Dict[str, Any] = {
+        "crc_algo": crc_algo,
+        "num_chunks": len(chunk_crcs) if chunk_crcs is not None else 0,
+        "digest": digest,
+    }
+    if (
+        crcs is None
+        or chunk_crcs is None
+        or algo != crc_algo
+        or len(crcs) != len(chunk_crcs)
+    ):
+        # A manifest the staged layout cannot be diffed against: the
+        # caller must fall back to the full fetch.
+        body["compatible"] = False
+    else:
+        differing = [i for i, (a, b) in enumerate(zip(crcs, chunk_crcs)) if a != b]
+        body["compatible"] = True
+        body["differing"] = differing
+        if chunk_sizes is not None:
+            body["differing_bytes"] = sum(chunk_sizes[i] for i in differing)
+    return json.dumps(body).encode()
 
 
 # ---------------------------------------------------------------------------
@@ -260,7 +343,9 @@ class _TruncatingWriter:
 
 class _FileStaged:
     """One immutable staged snapshot: epoch directory of serialized chunk
-    files + the exact pre-pickled /meta bytes + the era tag."""
+    files + the exact pre-pickled /meta bytes + the era tag + the chunk
+    checksums (so the child can answer /delta without unpickling /meta,
+    which would need jax for the treedef)."""
 
     def __init__(self, cmd: Dict[str, Any]) -> None:
         self.epoch: int = cmd["epoch"]
@@ -270,6 +355,9 @@ class _FileStaged:
         self.files: List[str] = cmd["files"]
         self.sizes: List[int] = cmd["sizes"]
         self.meta_bytes: bytes = base64.b64decode(cmd["meta_b64"])
+        self.crc_algo: str = cmd.get("crc_algo", "crc32")
+        self.chunk_crcs: Optional[List[int]] = cmd.get("crcs")
+        self.digest: Optional[str] = cmd.get("digest")
 
     def delete(self) -> None:
         shutil.rmtree(self.dir, ignore_errors=True)
@@ -374,7 +462,7 @@ def _child_main(argv: Optional[List[str]] = None) -> int:
                     f"joiner wants {want_era[0]}",
                 )
                 return
-            route = parts[2] if parts[2] in ("meta", "full") else "chunk"
+            route = parts[2] if parts[2] in ("meta", "full", "delta") else "chunk"
             metrics.inc("tpuft_heal_serve_requests_total", route=route)
             if route == "meta":
                 body = staged.meta_bytes
@@ -384,6 +472,21 @@ def _child_main(argv: Optional[List[str]] = None) -> int:
                 self.end_headers()
                 self.wfile.write(body)
                 metrics.inc("tpuft_heal_serve_bytes_total", len(body))
+                return
+            if route == "delta":
+                # Manifest diff, era-fenced like every stripe route above.
+                body = _delta_response(
+                    split.query,
+                    crc_algo=staged.crc_algo,
+                    chunk_crcs=staged.chunk_crcs,
+                    chunk_sizes=staged.sizes,
+                    digest=staged.digest,
+                )
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
                 return
             if route == "full":
                 total = sum(8 + size for size in staged.sizes)
@@ -418,7 +521,12 @@ def _child_main(argv: Optional[List[str]] = None) -> int:
             die_after = (
                 faultinject.consume("serve_child") == "kill_serve_child"
             )
-            fault = faultinject.consume("heal_stream")
+            # The serve port tags this donor's fault site so the punisher
+            # can target ONE donor of a stripe set (`heal_stream:<port>`);
+            # an untargeted `heal_stream` arm matches by site-family prefix.
+            fault = faultinject.consume(
+                f"heal_stream:{self.server.server_address[1]}"
+            )
             self.send_response(200)
             self.send_header("Content-Type", "application/octet-stream")
             self.send_header("Content-Length", str(size))
@@ -718,9 +826,14 @@ class ServeChild:
         files: List[str],
         sizes: List[int],
         meta_bytes: bytes,
+        crc_algo: str = "crc32",
+        crcs: Optional[List[int]] = None,
+        digest: Optional[str] = None,
     ) -> None:
         """Hands the snapshot to the child (which owns — and eventually
-        deletes — the epoch directory from here on)."""
+        deletes — the epoch directory from here on). ``crcs``/``digest``
+        ride along in the clear (not only inside the pickled meta) so the
+        jax-free child can answer ``/delta`` manifest diffs."""
         if not self.alive():
             raise ServeChildUnavailable("serving child is not alive")
         try:
@@ -734,6 +847,9 @@ class ServeChild:
                     "files": files,
                     "sizes": sizes,
                     "meta_b64": base64.b64encode(meta_bytes).decode(),
+                    "crc_algo": crc_algo,
+                    "crcs": crcs,
+                    "digest": digest,
                 }
             )
         except OSError as e:

@@ -58,6 +58,8 @@ class CheckpointTransport(ABC, Generic[T]):
         timeout: float,
         quorum_id: Optional[int] = None,
         skip_parts: Optional[Set[str]] = None,
+        donors: Optional[List[str]] = None,
+        local_state: Optional[T] = None,
     ) -> T:
         """Fetches the state for ``step`` from ``src_rank``.
 
@@ -67,7 +69,18 @@ class CheckpointTransport(ABC, Generic[T]):
         transport substitutes ``None`` for every leaf of a skipped part;
         transports without part support MUST ignore the argument and
         fetch everything — skipping is an optimization, never a
-        correctness requirement."""
+        correctness requirement.
+
+        ``donors``: additional transport addresses serving the same
+        committed state; a stripe-capable transport (HTTPTransport)
+        partitions the fetch across them, others MUST ignore the
+        argument and fetch from ``metadata`` alone.
+
+        ``local_state``: the joiner's stale-but-recent state for delta
+        rejoin; a delta-capable transport adopts provably identical
+        pieces locally instead of fetching them, others MUST ignore it.
+        Both are optimizations with the same contract as ``skip_parts``:
+        degrading means a full single-donor fetch, never a wrong one."""
 
     def disallow_checkpoint(self) -> None:
         """Stops serving the staged checkpoint (called at commit)."""

@@ -37,6 +37,9 @@ KNOWN_ENV = {
     # attempts, and the punisher's stream-fault arming channel.
     "TPUFT_HEAL_MIN_BYTES_PER_SEC", "TPUFT_HEAL_MAX_ATTEMPTS",
     "TPUFT_FAULT_FILE",
+    # Multi-donor striped heal + delta rejoin (checkpointing/
+    # http_transport.py): stripe switch, donor-set cap, delta switch.
+    "TPUFT_HEAL_STRIPE", "TPUFT_HEAL_STRIPE_MAX_DONORS", "TPUFT_HEAL_DELTA",
     # Donor sidecar (out-of-process heal serving, checkpointing/
     # serve_child.py): mode switch, snapshot dir (shared-memory tmpfs),
     # child niceness, egress bound, respawn budget.
@@ -67,7 +70,7 @@ KNOWN_ENV = {
     "TPUFT_REGEN_FIXTURES", "TPUFT_SENTINEL_INTERVAL",
     "TPUFT_TRANSPORT_BENCH_GB", "TPUFT_TRANSPORT_BENCH_MODE",
     "TPUFT_TRANSPORT_BENCH_DEADLINE", "TPUFT_TRANSPORT_RSS_BOUND",
-    "TPUFT_TRANSPORT_BENCH_PACE_GBPS",
+    "TPUFT_TRANSPORT_BENCH_PACE_GBPS", "TPUFT_TRANSPORT_BENCH_STRIPE_GBPS",
     "TPUFT_CPS_REPLICAS", "TPUFT_CPS_ROUNDS", "TPUFT_CPS_GROUP_WORLD_SIZE",
 }
 
@@ -347,6 +350,58 @@ def _check_zero(lighthouse: str) -> Tuple[str, str]:
     )
 
 
+def _check_heal_stripe(lighthouse: str) -> Tuple[str, str]:
+    """Striped-heal preflight. WARN, never FAIL: the heal plane degrades
+    to the single-donor path, it never breaks recovery — but an operator
+    expecting recovery bandwidth to scale with fleet size should hear
+    that the donor set is degenerate (striping off, cap of one, or a
+    fleet with at most one donor-capable member)."""
+    from torchft_tpu.checkpointing import http_transport as ht
+
+    stripe = ht.heal_stripe_enabled()
+    delta = ht.heal_delta_enabled()
+    cap = ht.heal_stripe_max_donors()
+    knobs = f"stripe={'on' if stripe else 'off'}, cap={cap}, delta={'on' if delta else 'off'}"
+    if not stripe:
+        return (
+            "WARN",
+            f"{knobs}: heals run single-donor — recovery time will not "
+            f"improve with fleet size (unset {ht.ENV_HEAL_STRIPE}=0 to "
+            "re-enable)",
+        )
+    if cap <= 1:
+        return (
+            "WARN",
+            f"{knobs}: {ht.ENV_HEAL_STRIPE_MAX_DONORS}={cap} caps every "
+            "stripe set to the assigned donor — striping is effectively off",
+        )
+    if not lighthouse:
+        return "PASS", f"{knobs} (no lighthouse to probe the donor set)"
+    try:
+        from torchft_tpu.coordination import LighthouseClient
+
+        client = LighthouseClient(lighthouse, connect_timeout=5.0)
+        try:
+            members = client.status(timeout=5.0).members
+        finally:
+            client.close()
+    except Exception as e:  # noqa: BLE001 — WARN-never-FAIL probe
+        return "WARN", f"{knobs} but lighthouse probe failed ({e})"
+    donors = sum(1 for m in members if not m.joining)
+    if donors <= 1:
+        return (
+            "WARN",
+            f"{knobs}: only {donors} donor-capable member(s) in the fleet "
+            "— heals degrade to the single-donor path until more replicas "
+            "join",
+        )
+    return (
+        "PASS",
+        f"{knobs}: {min(donors, cap)} donors available per striped heal "
+        f"({donors} donor-capable members)",
+    )
+
+
 def _check_env() -> Tuple[str, str]:
     # Value validation first — a fatal misconfig must FAIL even when a
     # typo'd var would also WARN.
@@ -372,6 +427,7 @@ def run_checks(lighthouse: str, skip_device: bool = False) -> int:
         ("metrics", _check_metrics),
         ("trace plane", _check_trace),
         ("heal serving", _check_heal_serve),
+        ("heal striping", lambda: _check_heal_stripe(lighthouse)),
         ("zero plane", lambda: _check_zero(lighthouse)),
         ("lighthouse", lambda: _check_lighthouse(lighthouse)),
     ]

@@ -46,7 +46,13 @@ from typing import Any, Callable, Dict, List, Optional, TypeVar, cast
 import numpy as np
 
 from torchft_tpu import metrics, tracing
-from torchft_tpu.checkpointing import CheckpointTransport, HTTPTransport
+from torchft_tpu.checkpointing import (
+    CheckpointTransport,
+    HTTPTransport,
+    heal_delta_enabled,
+    heal_stripe_enabled,
+    heal_stripe_max_donors,
+)
 from torchft_tpu.checkpointing._rwlock import RWLock
 from torchft_tpu.coordination import ManagerClient, ManagerServer
 from torchft_tpu.parallel.process_group import ProcessGroup, ReduceOp
@@ -943,19 +949,50 @@ class Manager:
                 return
 
         if allow_heal:
-            if quorum.recover_dst_replica_ranks:
+            # Striped heals fetch from EVERY max-step member, not only the
+            # assigned donor: when a heal is in flight anywhere in the
+            # quorum, each member whose state matches max_step co-stages
+            # the same committed bytes so joiners can partition the fetch
+            # across the whole donor set. The digest is donor-independent
+            # (bitwise-identical committed state), which is what makes the
+            # co-staged copies interchangeable.
+            stripe_costage = (
+                heal_stripe_enabled()
+                and not quorum.recover_dst_replica_ranks
+                and quorum.max_step > 0
+                and self._step == quorum.max_step
+                and not quorum.heal
+                and quorum.quorum is not None
+                and any(
+                    member.step < quorum.max_step
+                    for member in quorum.quorum.participants
+                )
+            )
+            if quorum.recover_dst_replica_ranks or stripe_costage:
                 # Ordering note: on a membership change the quorum-change
                 # drain hooks above already ran (pipelined speculative
                 # state resolved) BEFORE this donor send — so in child
                 # serve mode the sidecar's restaged snapshot can never
                 # contain uncommitted state either.
                 try:
-                    self._logger.info(
-                        f"peers need recovery from us {quorum.recover_dst_replica_ranks}"
-                    )
-                    metrics.inc(
-                        "tpuft_heals_total", role="donor", **self._metric_labels
-                    )
+                    if stripe_costage:
+                        self._logger.info(
+                            "a peer is healing; co-staging our checkpoint "
+                            "for the striped donor set"
+                        )
+                        metrics.inc(
+                            "tpuft_heal_stripe_costages_total",
+                            **self._metric_labels,
+                        )
+                    else:
+                        self._logger.info(
+                            f"peers need recovery from us {quorum.recover_dst_replica_ranks}"
+                        )
+                        metrics.inc(
+                            "tpuft_heals_total",
+                            role="donor",
+                            **self._metric_labels,
+                        )
                     with trace_span(
                         "tpuft::manager::_checkpoint_transport::send_checkpoint",
                         quorum_id=quorum.quorum_id,
@@ -983,15 +1020,25 @@ class Manager:
                 self._heal_as_joiner(quorum)
 
     def _heal_as_joiner(self, quorum: Any) -> None:
-        """One heal attempt against the quorum's assigned donor, with the
-        failover accounting around it: a failed transfer funnels into
-        :meth:`report_error` (clean fail — the joiner re-enters the next
-        quorum still joining and the transport's resume cache keeps the
-        verified chunks), the donor is marked for a one-shot fail-fast skip
-        (a dead donor also leaves via heartbeat expiry, so the next
-        assignment excludes it), and once ``heal_max_attempts`` consecutive
-        attempts have failed :class:`HealExhaustedError` escalates out of
-        the quorum future to the supervisor."""
+        """One heal attempt against the quorum's donor set, with the
+        failover accounting around it.
+
+        The assigned donor stays the anchor (its /meta is fetched first,
+        and the single-donor path is byte-identical to the pre-striping
+        behavior), but the transfer itself stripes across every max-step
+        participant the quorum advertises (:meth:`_resolve_stripe_donors`)
+        and diffs against the local stale state when there is one
+        (:meth:`_delta_local_state`) — donor death/stall/staleness inside
+        the stripe set is handled *inside* the attempt by reassignment.
+        Only when the whole attempt fails does the cross-round machinery
+        here engage: the failure funnels into :meth:`report_error` (clean
+        fail — the joiner re-enters the next quorum still joining and the
+        transport's per-chunk resume cache keeps the verified chunks), the
+        donor is marked for a one-shot fail-fast skip (a dead donor also
+        leaves via heartbeat expiry, so the next assignment excludes it),
+        and once ``heal_max_attempts`` consecutive attempts have failed
+        :class:`HealExhaustedError` escalates out of the quorum future to
+        the supervisor."""
         self._healing = True
         metrics.set_gauge("tpuft_healing", 1, **self._metric_labels)
         metrics.inc("tpuft_heals_total", role="joiner", **self._metric_labels)
@@ -1036,6 +1083,8 @@ class Manager:
             assert (
                 quorum.recover_src_replica_rank is not None
             ), "must have a recover rank when healing"
+            donor_urls = self._resolve_stripe_donors(quorum)
+            local_state = self._delta_local_state(quorum)
             with trace_span(
                 "tpuft::manager::_checkpoint_transport::recv_checkpoint",
                 quorum_id=quorum.quorum_id,
@@ -1047,6 +1096,8 @@ class Manager:
                 step=quorum.max_step,
                 quorum_id=quorum.quorum_id,
                 donor=src_addr,
+                donors=len(donor_urls) + 1,
+                delta=local_state is not None,
                 attempt=self._heal_attempts,
             ):
                 self._pending_state_dict = self._checkpoint_transport.recv_checkpoint(
@@ -1056,6 +1107,8 @@ class Manager:
                     timeout=self._timeout,
                     quorum_id=quorum.quorum_id,
                     skip_parts=self._heal_skip_parts(),
+                    donors=donor_urls,
+                    local_state=local_state,
                 )
             # Restore manager accounting immediately; user state is
             # applied from the main thread when safe.
@@ -1091,6 +1144,86 @@ class Manager:
                     f"(last donor {src_addr}); escalating to the supervisor "
                     f"(bound from ${HEAL_MAX_ATTEMPTS_ENV})"
                 ) from e
+
+    def _resolve_stripe_donors(self, quorum: Any) -> List[str]:
+        """Extra donor addresses for a striped heal: every quorum
+        participant standing at ``max_step`` holds bitwise-identical
+        committed state (and co-stages it when it sees a joiner — see
+        ``_async_quorum``), so its transport can serve any stripe of the
+        fetch. Each candidate's manager resolves to its checkpoint
+        transport address; resolution is best-effort per donor — a peer
+        that cannot be resolved is simply left out of the stripe set,
+        never a reason to fail the heal. The extras rotate by group rank
+        so concurrent joiners spread their stripe order across the donor
+        set instead of all hammering it in the same sequence.
+
+        Striping is skipped entirely at ``max_step == 0``: the init_sync
+        heal is a per-LOCAL-rank mosaic (state is intentionally NOT
+        identical across replicas yet), so only the assigned donor is
+        valid there."""
+        if not heal_stripe_enabled() or quorum.max_step <= 0:
+            return []
+        q = quorum.quorum
+        if q is None:
+            return []
+        candidates = [
+            member.address
+            for member in q.participants
+            if member.address
+            and member.address != quorum.recover_src_manager_address
+            and member.replica_id != self._replica_id
+            and member.step >= quorum.max_step
+        ]
+        # The cap minus the assigned donor; the transport re-applies it
+        # after deduping, this just avoids pointless resolution RPCs.
+        candidates = candidates[: max(0, heal_stripe_max_donors() - 1)]
+        if not candidates:
+            return []
+        rotate = self._group_rank % len(candidates)
+        candidates = candidates[rotate:] + candidates[:rotate]
+        urls: List[str] = []
+        for addr in candidates:
+            try:
+                client = ManagerClient(
+                    addr, connect_timeout=self._connect_timeout
+                )
+                try:
+                    urls.append(
+                        client._checkpoint_metadata(
+                            self._group_rank, timeout=self._timeout
+                        )
+                    )
+                finally:
+                    client.close()
+            except Exception as e:  # noqa: BLE001 — best-effort per donor
+                self._logger.warn(
+                    f"stripe donor {addr} metadata resolution failed ({e}); "
+                    "striping without it"
+                )
+        metrics.set_gauge(
+            "tpuft_heal_stripe_donors", len(urls) + 1, **self._metric_labels
+        )
+        return urls
+
+    def _delta_local_state(self, quorum: Any) -> Optional[Dict[str, Any]]:
+        """The joiner's stale-but-recent state for delta rejoin, or None
+        when there is nothing worth diffing: delta disabled, no real local
+        progress (``step == 0`` — freshly initialized state, and the
+        init_sync mosaic owns step-0 heals anyway), or no registered user
+        state yet. Building it costs one host snapshot; the transport pays
+        one serialize+CRC pass only after the donor's manifest proves the
+        layouts comparable."""
+        if not heal_delta_enabled() or self._step <= 0 or quorum.max_step <= 0:
+            return None
+        if not self._user_state_dicts:
+            return None
+        try:
+            return self._manager_state_dict()
+        except Exception as e:  # noqa: BLE001 — delta is an optimization
+            self._logger.warn(
+                f"delta-rejoin local state unavailable ({e}); full fetch"
+            )
+            return None
 
     def _apply_pending_state_dict(self) -> None:
         assert self._healing, "must be in healing state"

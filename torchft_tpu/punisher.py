@@ -23,6 +23,16 @@ Heal-path modes target the recovery plane itself:
   consumes it at its next chunk serve, finishes that chunk, and dies —
   the joiner must fail over via the resume cache and the donor's step
   loop must observe nothing but a ``report_error``.
+- ``kill_donor_mid_stripe``: like ``kill_donor_mid_heal`` but armed only
+  when the stripe set survives the kill (a joining member AND at least
+  two donor-capable members visible) — the joiner must reassign the dead
+  donor's unfetched stripe to the survivors and finish the heal in the
+  SAME attempt, re-fetching exactly the dead donor's unverified
+  remainder.
+- ``corrupt_stripe``: the ``corrupt_stream`` bit-flip, site-tagged to ONE
+  donor of a stripe set (``heal_stream:<donor tag>``, usually the serve
+  port) so the drill proves a corrupting donor is fenced out of the
+  stripe while its peers keep serving.
 
     python -m torchft_tpu.punisher --lighthouse host:29510 kill_one
     python -m torchft_tpu.punisher --lighthouse host:29510 fault_one --mode deadlock
@@ -48,6 +58,8 @@ __all__ = [
     "kill_all",
     "kill_loop",
     "kill_donor_mid_heal",
+    "kill_donor_mid_stripe",
+    "arm_stream_fault",
     "inject_fault",
     "main",
     "FAULT_MODES",
@@ -64,12 +76,15 @@ def _members(client: LighthouseClient):
 # Modes the native manager's kill RPC executes in-process.
 FAULT_MODES = ("exit", "segfault", "deadlock", "partition")
 # Heal-plane modes delivered outside the kill RPC (status-targeted kill /
-# file-armed stream faults / the serve-sidecar kill).
+# file-armed stream faults / the serve-sidecar kill / the stripe-targeted
+# variants).
 HEAL_FAULT_MODES = (
     "kill_donor_mid_heal",
     "corrupt_stream",
     "stall_donor",
     "kill_serve_child",
+    "kill_donor_mid_stripe",
+    "corrupt_stripe",
 )
 ALL_FAULT_MODES = FAULT_MODES + HEAL_FAULT_MODES
 
@@ -116,14 +131,60 @@ def kill_donor_mid_heal(client: LighthouseClient, rng: random.Random) -> bool:
     return True
 
 
-def arm_stream_fault(mode: str, fault_file: Optional[str] = None) -> bool:
+def kill_donor_mid_stripe(client: LighthouseClient, rng: random.Random) -> bool:
+    """Kills one of N active donors while a STRIPED heal is in flight: a
+    joining member must be visible AND at least two donor-capable members
+    must remain serving, so the joiner's stripe reassignment (not the
+    cross-attempt failover) is the mechanism under test. Fewer donors =
+    no-op (kill_donor_mid_heal covers the single-donor failover path)."""
+    try:
+        status = client.status()
+    except Exception as e:  # noqa: BLE001
+        print(f"[punisher] status rpc ended with: {e}")
+        return False
+    joining = [m.member.replica_id for m in status.members if m.joining]
+    donors = [m.member.replica_id for m in status.members if not m.joining]
+    if not joining or len(donors) < 2:
+        print(
+            "[punisher] no striped heal in flight "
+            f"({len(joining)} joining, {len(donors)} donors); "
+            "skipping kill_donor_mid_stripe"
+        )
+        return False
+    victim = rng.choice(donors)
+    print(
+        f"[punisher] killing stripe donor {victim} "
+        f"({len(donors) - 1} donors survive for {joining})"
+    )
+    try:
+        client.kill(victim, mode="exit")
+    except Exception as e:  # noqa: BLE001
+        print(f"[punisher] kill rpc ended with: {e}")
+    return True
+
+
+def arm_stream_fault(
+    mode: str,
+    fault_file: Optional[str] = None,
+    donor_tag: Optional[str] = None,
+) -> bool:
     """Arms a donor-serve fault via the fault file: stream faults
     (``corrupt_stream``/``stall_donor``) are consumed by the next donor
     chunk-serve in EITHER serve mode; ``kill_serve_child`` is consumed
-    only by a serving sidecar (site ``serve_child``) and kills it."""
-    site = "serve_child" if mode == "kill_serve_child" else "heal_stream"
+    only by a serving sidecar (site ``serve_child``) and kills it;
+    ``corrupt_stripe`` is the same bit-flip as ``corrupt_stream`` but
+    site-tagged to one donor of a stripe set (``--donor-tag``, usually
+    the victim's serve port — untagged it behaves like corrupt_stream,
+    hitting whichever stripe serves next)."""
+    if mode == "kill_serve_child":
+        site, armed_mode = "serve_child", mode
+    elif mode == "corrupt_stripe":
+        site = f"heal_stream:{donor_tag}" if donor_tag else "heal_stream"
+        armed_mode = "corrupt_stream"  # the serve seam knows one bit-flip
+    else:
+        site, armed_mode = "heal_stream", mode
     try:
-        path = faultinject.arm(mode, path=fault_file, site=site)
+        path = faultinject.arm(armed_mode, path=fault_file, site=site)
     except ValueError as e:
         print(f"[punisher] cannot arm {mode}: {e}")
         return False
@@ -143,7 +204,14 @@ def inject_fault(
         return kill_one(client, rng, mode=mode)
     if mode == "kill_donor_mid_heal":
         return kill_donor_mid_heal(client, rng)
-    if mode in ("corrupt_stream", "stall_donor", "kill_serve_child"):
+    if mode == "kill_donor_mid_stripe":
+        return kill_donor_mid_stripe(client, rng)
+    if mode in (
+        "corrupt_stream",
+        "stall_donor",
+        "kill_serve_child",
+        "corrupt_stripe",
+    ):
         return arm_stream_fault(mode, fault_file)
     raise ValueError(f"unknown fault mode {mode!r}")
 
@@ -193,6 +261,12 @@ def main() -> None:
     sub.add_parser("kill_all")
     fault = sub.add_parser("fault_one")
     fault.add_argument("--mode", choices=ALL_FAULT_MODES, default="exit")
+    fault.add_argument(
+        "--donor-tag",
+        default=None,
+        help="corrupt_stripe only: target one donor of a stripe set by its "
+        "serve-site tag (usually the serve port)",
+    )
     loop = sub.add_parser("kill_loop")
     loop.add_argument("--mtbf", type=float, default=60.0, help="mean seconds between faults")
     loop.add_argument(
@@ -209,7 +283,12 @@ def main() -> None:
     elif args.cmd == "kill_all":
         kill_all(client, rng)
     elif args.cmd == "fault_one":
-        inject_fault(client, rng, args.mode, fault_file=args.fault_file)
+        if args.mode == "corrupt_stripe" and args.donor_tag:
+            arm_stream_fault(
+                args.mode, args.fault_file, donor_tag=args.donor_tag
+            )
+        else:
+            inject_fault(client, rng, args.mode, fault_file=args.fault_file)
     else:
         menu = tuple(m.strip() for m in args.menu.split(",") if m.strip())
         for m in menu:

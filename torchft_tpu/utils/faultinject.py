@@ -7,7 +7,11 @@ mode name into ``$TPUFT_FAULT_FILE``; the first instrumented site that
 matches the fault's target claims it atomically (``os.replace`` of the
 file — losers of the race see it gone), so each arm injects **exactly
 one** fault. An optional ``mode:site`` form restricts the fault to one
-instrumentation site.
+instrumentation site. Sites form ``:``-separated families: an arm
+targeted at ``heal_stream`` matches any site under it (e.g. a donor's
+port-tagged ``heal_stream:58311``), while an arm targeted at the full
+tagged site hits exactly that donor — how the stripe drills corrupt one
+donor of a multi-donor heal without touching its peers.
 
 Production cost when unarmed: one env lookup per check (no filesystem
 touch unless the env var is set). This module is a chaos tool, not a
@@ -44,7 +48,9 @@ def arm(mode: str, path: Optional[str] = None, site: str = "") -> str:
 
 def consume(site: str) -> Optional[str]:
     """Returns (and atomically claims) the armed fault mode matching
-    ``site``, or None when nothing is armed for it."""
+    ``site``, or None when nothing is armed for it. The armed target
+    matches its whole site family: target ``a`` claims sites ``a`` and
+    ``a:anything``; target ``a:b`` claims only ``a:b`` (and deeper)."""
     path = os.environ.get(ENV_FAULT_FILE)
     if not path:
         return None
@@ -56,7 +62,7 @@ def consume(site: str) -> Optional[str]:
     if not content:
         return None
     mode, _, target = content.partition(":")
-    if target and target != site:
+    if target and site != target and not site.startswith(target + ":"):
         return None
     try:
         # The rename IS the claim: exactly one concurrent consumer wins,
