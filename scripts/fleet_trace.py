@@ -476,6 +476,47 @@ def explain_step(merged: List[Dict[str, Any]], step: int) -> str:
     if not commits and not failed:
         lines.append("result: no commit event recorded at this step (never voted?)")
 
+    # Speculative-window state: how deep the commit pipeline ran while
+    # this step dispatched, and what any rollback unwound.
+    speculates = [e for e in at_step if e["name"] == "speculate"]
+    for e in speculates:
+        args = e.get("args") or {}
+        lines.append(
+            f"window: {proc_label(proc_key(e))} dispatched speculatively "
+            f"with {args.get('window', '?')} uncommitted step(s) in flight "
+            f"(depth {args.get('depth', '?')})"
+        )
+    for e in at_step:
+        if e["name"] != "rollback":
+            continue
+        args = e.get("args") or {}
+        discarded = args.get("discarded", 0)
+        suffix = (
+            f"; {discarded} younger speculative step(s) discarded with it"
+            if discarded not in (0, "0", None)
+            else ""
+        )
+        lines.append(
+            f"rollback: {proc_label(proc_key(e))} unwound the live state to "
+            f"committed step {args.get('unwound_to', '?')}{suffix}"
+        )
+    for e in at_step:
+        if e["name"] != "speculation_discarded":
+            continue
+        lines.append(
+            f"discarded: {proc_label(proc_key(e))} consumed step "
+            f"{e.get('step')}'s in-flight vote without adopting it "
+            "(an older slot's refusal unwound the window)"
+        )
+    for e in at_step:
+        if e["name"] != "pipeline_depth":
+            continue
+        args = e.get("args") or {}
+        lines.append(
+            f"adaptive: {proc_label(proc_key(e))} moved the window depth "
+            f"to {args.get('depth', '?')}"
+        )
+
     # Heal activity touching this step.
     heal_spans = [e for e in at_step if e["name"] in ("heal_recv", "heal_send")]
     for e in heal_spans:

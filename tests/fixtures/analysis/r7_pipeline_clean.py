@@ -1,0 +1,41 @@
+"""R7 fixture (clean): both drain shapes the manager uses — the inline
+quorum-change-hooks loop and the named drain helper — lexically precede
+every wire reconfigure / donor send / sidecar staging call."""
+
+
+class Manager:
+    def _run_quorum_drain_hooks(self):
+        for hook in self._quorum_change_hooks:
+            try:
+                hook()
+            except Exception as e:  # noqa: BLE001
+                self.report_error(e)
+
+    def _async_quorum(self, quorum):
+        if quorum.quorum_id != self._quorum_id:
+            # Inline drain shape: every registered hook resolves the
+            # pipelined window before the wire reconfigures.
+            for hook in self._quorum_change_hooks:
+                try:
+                    hook()
+                except Exception as e:  # noqa: BLE001
+                    self.report_error(e)
+            self._pg.configure(
+                quorum.store_address, self._replica_id,
+                quorum.replica_rank, quorum.replica_world_size,
+            )
+            self._quorum_id = quorum.quorum_id
+        if quorum.recover_dst_replica_ranks:
+            # Named-helper drain shape before any donor-facing staging.
+            self._run_quorum_drain_hooks()
+            self._checkpoint_transport.send_checkpoint(
+                dst_ranks=quorum.recover_dst_replica_ranks,
+                step=quorum.max_step,
+                state_dict=self._manager_state_dict(),
+                timeout=self._timeout,
+            )
+            self._serve_child.stage(
+                step=quorum.max_step,
+                state_dict=self._manager_state_dict(),
+                quorum_id=quorum.quorum_id,
+            )

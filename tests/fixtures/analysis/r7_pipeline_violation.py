@@ -1,0 +1,29 @@
+"""R7 fixture (violations): wire reconfigure, donor send, and sidecar
+heal staging all reachable with NO speculative-window drain before them —
+a joiner could heal from (and the PG reconfigure under) uncommitted
+speculative state."""
+
+
+class Manager:
+    def _async_quorum(self, quorum):
+        if quorum.quorum_id != self._quorum_id:
+            # Reconfigures the replica wire with the window undrained.
+            self._pg.configure(
+                quorum.store_address, self._replica_id,
+                quorum.replica_rank, quorum.replica_world_size,
+            )
+            self._quorum_id = quorum.quorum_id
+        if quorum.recover_dst_replica_ranks:
+            # Serves a joiner from (possibly speculative) live state.
+            self._checkpoint_transport.send_checkpoint(
+                dst_ranks=quorum.recover_dst_replica_ranks,
+                step=quorum.max_step,
+                state_dict=self._manager_state_dict(),
+                timeout=self._timeout,
+            )
+            # Hands the sidecar a snapshot of the same undrained state.
+            self._serve_child.stage(
+                step=quorum.max_step,
+                state_dict=self._manager_state_dict(),
+                quorum_id=quorum.quorum_id,
+            )

@@ -372,14 +372,15 @@ def pipelined_ddp_train_loop(
     store_client: StoreClient,
     store_addr: str,
     min_replica_size: int = 1,
+    depth: int = 1,
 ) -> Dict[str, Any]:
     """The DDP loop under the pipelined-commit schedule
-    (commit_pipeline_depth=1): step N's device sync + vote resolve while
-    step N+1 is dispatched. Batches are keyed on
-    ``opt.next_pipelined_step()`` — ``manager.current_step()`` advances on
-    the executor while a vote is in flight, so it cannot key a lockstep
-    data stream (see Optimizer.next_pipelined_step). Returns the same
-    shape as ddp_train_loop plus rollback accounting."""
+    (``commit_pipeline_depth=depth``): up to ``depth`` steps' device syncs
+    + votes resolve while younger steps are dispatched. Batches are keyed
+    on ``opt.next_pipelined_step()`` — ``manager.current_step()`` advances
+    while votes are in flight, so it cannot key a lockstep data stream
+    (see Optimizer.next_pipelined_step). Returns the same shape as
+    ddp_train_loop plus rollback accounting."""
     pg = FakeProcessGroupWrapper(ProcessGroupTCP(timeout=10.0))
     manager = Manager(
         pg=pg,
@@ -394,7 +395,7 @@ def pipelined_ddp_train_loop(
         heartbeat_interval=0.05,
         timeout=10.0,
         quorum_timeout=20.0,
-        commit_pipeline_depth=1,
+        commit_pipeline_depth=depth,
         **runner.manager_args,
     )
     opt = Optimizer(manager, optax.sgd(0.05), _init_model_params())

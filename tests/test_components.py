@@ -146,6 +146,24 @@ def test_commit_pipeline_depth_bookkeeping() -> None:
     assert deep.pending() == (rec_a, rec_b)  # oldest first
     assert deep.drain() == (rec_a, rec_b)
 
+    # Dynamic re-bounding (the adaptive controller's lever): growing
+    # admits more slots immediately; shrinking never evicts — admission
+    # respects the new bound while existing records drain normally.
+    sized = ft_futures.CommitPipeline(1)
+    sized.push(rec_a)
+    sized.set_depth(2)
+    assert sized.depth == 2
+    sized.push(rec_b)
+    assert sized.pending() == (rec_a, rec_b)
+    sized.set_depth(1)
+    assert len(sized) == 2  # no eviction on shrink
+    with pytest.raises(RuntimeError, match="pipeline full"):
+        sized.push(object())
+    sized.remove(rec_a)
+    sized.remove(rec_b)
+    with pytest.raises(ValueError):
+        sized.set_depth(0)
+
 
 def test_watchdog_exits_on_stalled_scheduler(monkeypatch) -> None:
     """Parity with the reference's watchdog sys.exit test (futures_test.py:97):

@@ -354,6 +354,31 @@ def test_explain_step_names_stripe_reassignment_and_delta() -> None:
     assert "delta rejoin: train_2/0 matched 48/64 chunk(s) locally (9216.0 MB not" in text
 
 
+def test_explain_step_names_window_occupancy_and_rollback_unwind() -> None:
+    """The depth-N speculative window in the postmortem: how many
+    uncommitted steps were in flight when this step dispatched, which
+    committed step a rollback unwound the live state to (and how many
+    younger speculations died with it), the discarded-slot consumption,
+    and an adaptive depth move."""
+    j = _Journal("train_0", 0.0, 900.0)
+    j.ev("speculate", 0.1, step=7, q=3, window=3, depth=3)
+    j.ev("rollback", 0.3, step=7, q=3, unwound_to=5, discarded=2)
+    j.ev("speculation_discarded", 0.35, step=7)
+    j.ev("pipeline_depth", 0.4, step=7, q=3, depth=2)
+    merged = fleet_trace.merge_events(j.events)
+    text = fleet_trace.explain_step(merged, 7)
+    assert (
+        "window: train_0/0 dispatched speculatively with 3 uncommitted "
+        "step(s) in flight (depth 3)" in text
+    )
+    assert (
+        "rollback: train_0/0 unwound the live state to committed step 5; "
+        "2 younger speculative step(s) discarded with it" in text
+    )
+    assert "discarded: train_0/0 consumed step 7's in-flight vote" in text
+    assert "adaptive: train_0/0 moved the window depth to 2" in text
+
+
 # ---------------------------------------------------------------------------
 # the drill: threads-as-replicas kill/heal over a loopback PG
 # ---------------------------------------------------------------------------

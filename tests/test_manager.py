@@ -576,21 +576,44 @@ def test_start_quorum_propagates_unconsumed_barrier_exception_once() -> None:
 
 
 def test_commit_pipeline_depth_env_and_validation(monkeypatch) -> None:
-    """TPUFT_COMMIT_PIPELINE overrides the ctor depth; only 0/1 are legal
-    (the bounded envelope is one step deep)."""
+    """Depth plumbing: any int >= 0 is a legal window depth (an N-step
+    bounded envelope), "auto" selects the adaptive controller starting at
+    depth 1, TPUFT_COMMIT_PIPELINE_DEPTH wins over the legacy
+    TPUFT_COMMIT_PIPELINE, and junk raises."""
     manager, _, _, _ = make_manager(pg=ProcessGroupDummy())
     assert manager.commit_pipeline_depth == 0
+    assert not manager.commit_pipeline_adaptive
 
     manager, _, _, _ = make_manager(pg=ProcessGroupDummy(), commit_pipeline_depth=1)
     assert manager.commit_pipeline_depth == 1
+
+    manager, _, _, _ = make_manager(pg=ProcessGroupDummy(), commit_pipeline_depth=4)
+    assert manager.commit_pipeline_depth == 4
+
+    manager, _, _, _ = make_manager(
+        pg=ProcessGroupDummy(), commit_pipeline_depth="auto"
+    )
+    assert manager.commit_pipeline_adaptive
+    assert manager.commit_pipeline_depth == 1  # deepens as evidence arrives
 
     monkeypatch.setenv("TPUFT_COMMIT_PIPELINE", "1")
     manager, _, _, _ = make_manager(pg=ProcessGroupDummy())
     assert manager.commit_pipeline_depth == 1
 
-    monkeypatch.setenv("TPUFT_COMMIT_PIPELINE", "2")
+    # The new var wins over the legacy one.
+    monkeypatch.setenv("TPUFT_COMMIT_PIPELINE_DEPTH", "3")
+    manager, _, _, _ = make_manager(pg=ProcessGroupDummy())
+    assert manager.commit_pipeline_depth == 3
+    monkeypatch.setenv("TPUFT_COMMIT_PIPELINE_DEPTH", "auto")
+    manager, _, _, _ = make_manager(pg=ProcessGroupDummy())
+    assert manager.commit_pipeline_adaptive
+    monkeypatch.delenv("TPUFT_COMMIT_PIPELINE_DEPTH")
+    monkeypatch.delenv("TPUFT_COMMIT_PIPELINE")
+
     with pytest.raises(ValueError, match="commit_pipeline_depth"):
-        make_manager(pg=ProcessGroupDummy())
+        make_manager(pg=ProcessGroupDummy(), commit_pipeline_depth=-1)
+    with pytest.raises(ValueError, match="commit_pipeline_depth"):
+        make_manager(pg=ProcessGroupDummy(), commit_pipeline_depth="bogus")
 
 
 def test_quorum_change_hook_runs_before_reconfigure() -> None:

@@ -142,6 +142,62 @@ def test_ddp_pipelined_kill_rolls_back_uncommitted_step(lighthouse) -> None:
     assert survivor["failed_commits"] <= 2, survivor
 
 
+def test_ddp_pipelined_depth2_two_groups_healthy(lighthouse) -> None:
+    """Depth-2 speculative window across two replica groups: verdicts
+    resolve TWO steps late, batches ride the dispatch prediction, and the
+    groups still end bitwise identical at exactly num_steps."""
+    import functools
+
+    runners = [
+        Runner(
+            replica_group=i,
+            lighthouse_addr=lighthouse.address(),
+            train_loop=functools.partial(pipelined_ddp_train_loop, depth=2),
+            num_steps=5,
+        )
+        for i in range(2)
+    ]
+    results = run_replica_groups(runners, timeout=180)
+    assert_groups_converged(results, 5)
+    for group_result in results:
+        assert group_result[0]["rollbacks"] == 0
+        assert group_result[0]["failed_commits"] == 0
+
+
+def test_ddp_pipelined_depth2_kill_drains_full_window(lighthouse) -> None:
+    """Kill one replica group with the survivor holding a TWO-deep
+    speculative window (votes in flight for both uncommitted steps): the
+    refused commit must unwind the window — rollback + discard of the
+    younger speculation — and the membership change must drain the FULL
+    window before the PG reconfigures and the donor serves the rejoiner
+    (the R7 invariant, exercised end to end). Both groups bitwise
+    identical at the target step proves no speculative state leaked into
+    committed history or the heal."""
+    import functools
+
+    injector = EventInjector().fail_at(group=1, step=2)
+    runners = [
+        Runner(
+            replica_group=i,
+            lighthouse_addr=lighthouse.address(),
+            train_loop=functools.partial(pipelined_ddp_train_loop, depth=2),
+            num_steps=6,
+            injector=injector,
+        )
+        for i in range(2)
+    ]
+    results = run_replica_groups(runners, timeout=240)
+    assert injector.count == 1
+    assert_groups_converged(results, 6)
+    survivor = results[0][0]
+    # The survivor discovered the dead peer through a failed pipelined
+    # commit and unwound its window (>= 1 rollback); with two speculative
+    # steps in flight it loses at most the whole window.
+    assert survivor["rollbacks"] >= 1, survivor
+    assert survivor["failed_commits"] >= 1, survivor
+    assert survivor["failed_commits"] <= 3, survivor
+
+
 def test_quorum_latency_north_star(lighthouse) -> None:
     """BASELINE.md north star: steady-state (fast-quorum) latency p50 stays
     within 2x the lighthouse tick. The first step is excluded — it includes

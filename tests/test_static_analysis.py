@@ -167,6 +167,29 @@ def test_r6_violation_resolves_against_reference(tmp_path) -> None:
     assert any("nosuch_module.py:3" in m and "resolves nowhere" in m for m in messages)
 
 
+def test_r7_violation_fixture() -> None:
+    # The manager's quorum-path shape with the drain REMOVED: a wire
+    # reconfigure, a donor checkpoint send, and a sidecar heal staging,
+    # all reachable inside an undrained speculative window — three
+    # findings, one per unsafe call. Golden count added DELIBERATELY with
+    # the depth-N window generalization: the speculation-discipline shape
+    # is pinned, not baselined away.
+    findings = scan("r7_pipeline_violation.py", rules=["speculation-discipline"])
+    assert len(findings) == 3
+    assert rules_of(findings) == ["speculation-discipline"]
+    messages = sorted(f.message for f in findings)
+    assert sum("pg.configure" in m for m in messages) == 1
+    assert sum("send_checkpoint" in m for m in messages) == 1
+    assert sum("stage" in m and "send_checkpoint" not in m for m in messages) == 1
+    assert all("drain" in m for m in messages)
+
+
+def test_r7_clean_fixture() -> None:
+    # Both drain shapes (the inline quorum-change-hooks loop and the named
+    # helper) lexically precede every unsafe call — clean under all rules.
+    assert scan("r7_pipeline_clean.py") == []
+
+
 def test_r6_clean_fixture(tmp_path) -> None:
     # Clean with the snapshot absent...
     assert scan("r6_clean.py") == []
@@ -222,8 +245,8 @@ def test_package_scans_clean() -> None:
     assert findings == [], "\n".join(f.format() for f in findings)
 
 
-def test_rule_registry_covers_r1_to_r6() -> None:
-    assert len(ALL_RULES) == 6
+def test_rule_registry_covers_r1_to_r7() -> None:
+    assert len(ALL_RULES) == 7
     assert set(RULES_BY_ID) == {
         "step-boundary-escape",
         "op-worker-self-wait",
@@ -231,6 +254,7 @@ def test_rule_registry_covers_r1_to_r6() -> None:
         "unjitted-optax",
         "replica-axis-in-mesh",
         "citation-lint",
+        "speculation-discipline",
     }
 
 

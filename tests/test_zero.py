@@ -185,11 +185,12 @@ def _loss(p, batch):
 _BATCHES = [jnp.full((3,), 0.1 * i, jnp.float32) for i in range(5)]
 
 
+_DEPTH_OF = {"strict": 0, "overlapped": 0, "pipelined": 1, "pipelined-deep": 3}
+
+
 def _run_zero(mode, monkeypatch, tx=None, num_shards=4):
     monkeypatch.setenv("TPUFT_STRICT_COMMIT", "1" if mode == "strict" else "0")
-    manager = scripted_manager(
-        commit_pipeline_depth=1 if mode == "pipelined" else 0
-    )
+    manager = scripted_manager(commit_pipeline_depth=_DEPTH_OF[mode])
     opt = ZeroOptimizer(
         manager, tx or optax.sgd(0.2, momentum=0.9), _PARAMS,
         num_shards=num_shards,
@@ -199,7 +200,7 @@ def _run_zero(mode, monkeypatch, tx=None, num_shards=4):
     for batch in _BATCHES:
         loss, _committed = step_fn(batch)
         losses.append(float(loss))
-    if mode == "pipelined":
+    if _DEPTH_OF[mode]:
         assert opt.flush_pipeline() is True
     return np.asarray(opt.params["w"]), losses, manager.current_step(), opt
 
@@ -228,11 +229,14 @@ def test_zero_lone_replica_matches_plain_optimizer(monkeypatch) -> None:
     assert sorted(opt.opt_state.held) == [0, 1, 2, 3]
 
 
-@pytest.mark.parametrize("mode", ["strict", "overlapped", "pipelined"])
+@pytest.mark.parametrize(
+    "mode", ["strict", "overlapped", "pipelined", "pipelined-deep"]
+)
 def test_zero_orderings_produce_identical_trajectories(monkeypatch, mode) -> None:
-    """The sharded step commits bitwise-identical params under all three
-    commit orderings (rollback snapshots of a sharded opt_state included
-    in the pipelined machinery)."""
+    """The sharded step commits bitwise-identical params under all four
+    commit orderings — strict / overlapped / pipelined depth 1 / depth 3
+    (rollback snapshots of a sharded opt_state included in the pipelined
+    window machinery at every depth)."""
     w_ref, losses_ref, _, _ = _run_zero("strict", monkeypatch)
     w, losses, step, _ = _run_zero(mode, monkeypatch)
     np.testing.assert_array_equal(w, w_ref)

@@ -1,4 +1,4 @@
-"""tpuft_check rules R1–R6: CLAUDE.md invariants as AST properties.
+"""tpuft_check rules R1–R7: CLAUDE.md invariants as AST properties.
 
 Each rule is deliberately *lexical*: it proves what can be proven from one
 function's source order and flags the rest, so a clean run is a real
@@ -22,6 +22,8 @@ how the per-rule fixture tests drive them.
 | replica-axis-in-mesh| the replica axis is never a jax Mesh dim            |
 | citation-lint       | docstring ``file.py:line`` citations parse and      |
 |                     | resolve (reference tree when present)               |
+| speculation-        | no pg.configure / send_checkpoint / sidecar staging |
+| discipline          | reachable inside an undrained speculative window    |
 """
 
 from __future__ import annotations
@@ -339,7 +341,7 @@ _R3_REGISTERED_ATTRS = {
 }
 _R3_ACQUIRES = {"disallow_state_dict_read", "w_acquire", "w_lock"}
 _R3_RELEASES = {"allow_state_dict_read", "w_release"}
-_R3_BARRIERS = {"should_commit", "should_commit_async"}
+_R3_BARRIERS = {"should_commit", "should_commit_async", "speculative_commit_async"}
 
 
 def _check_r3(module: Module, reference_root: Optional[Path] = None) -> List[Finding]:
@@ -690,6 +692,81 @@ def _check_r6(module: Module, reference_root: Optional[Path] = None) -> List[Fin
 
 
 # ---------------------------------------------------------------------------
+# R7 speculation-discipline
+# ---------------------------------------------------------------------------
+
+# The invariant (CLAUDE.md pipelined-commit paragraph): a joiner must never
+# heal from — and the wire must never reconfigure under — an undrained
+# speculative window. Lexically: inside any function that reconfigures the
+# replica PG, serves a donor checkpoint, or stages a heal-serving sidecar
+# snapshot, a window drain must come FIRST. Scoped to the manager (the one
+# place those calls legitimately live on the quorum path); fixtures and
+# explicit CLI paths are always in scope.
+_R7_SCOPE_FILES = ("torchft_tpu/manager.py",)
+_R7_DRAIN_CALLS = {
+    "_run_quorum_drain_hooks",
+    "_drain_pipeline_for_quorum_change",
+    "flush_pipeline",
+}
+_R7_HOOK_ITER_MARK = "quorum_change_hook"
+_R7_PG_RECEIVERS = {"pg", "_pg"}
+_R7_UNSAFE_CALLS = {"send_checkpoint", "stage"}  # stage = sidecar heal-part staging
+
+
+def _check_r7(module: Module, reference_root: Optional[Path] = None) -> List[Finding]:
+    if module.in_package and module.rel not in _R7_SCOPE_FILES:
+        return []
+    findings: List[Finding] = []
+    for fn in _func_defs(module.tree):
+        drains: List[int] = []
+        unsafe: List[Tuple[int, str]] = []
+        for node in ast.walk(fn):
+            enclosing = _enclosing_functions(module, node)
+            if enclosing and enclosing[0] is not fn:
+                continue  # nested defs run on their caller's schedule
+            if isinstance(node, ast.For):
+                # The manager's inline drain shape: iterating the
+                # registered quorum-change hooks and calling each.
+                iter_name = _terminal_name(node.iter) or ""
+                if _R7_HOOK_ITER_MARK in iter_name and any(
+                    isinstance(inner, ast.Call) for inner in ast.walk(node)
+                ):
+                    drains.append(node.lineno)
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            cname = _terminal_name(node.func)
+            if cname in _R7_DRAIN_CALLS:
+                drains.append(node.lineno)
+            elif (
+                cname == "configure"
+                and isinstance(node.func, ast.Attribute)
+                and _terminal_name(node.func.value) in _R7_PG_RECEIVERS
+            ):
+                unsafe.append((node.lineno, "pg.configure (wire reconfigure)"))
+            elif cname in _R7_UNSAFE_CALLS:
+                unsafe.append((node.lineno, f"{cname} (donor/heal staging)"))
+        for lineno, what in unsafe:
+            if any(drain_line < lineno for drain_line in drains):
+                continue
+            findings.append(
+                _finding(
+                    module,
+                    "speculation-discipline",
+                    lineno,
+                    f"{fn.name} reaches {what} with no speculative-window "  # type: ignore[union-attr]
+                    "drain before it: a membership change or donor send "
+                    "inside an undrained commit-pipeline window lets a "
+                    "joiner heal from (or the wire reconfigure under) "
+                    "uncommitted speculative state — drain first "
+                    "(Manager._run_quorum_drain_hooks; CLAUDE.md pipelined-"
+                    "commit invariant)",
+                )
+            )
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # registry
 # ---------------------------------------------------------------------------
 
@@ -729,6 +806,12 @@ ALL_RULES: Sequence[Rule] = (
         summary="docstring file.py:line citations parse and resolve",
         anchor="CLAUDE.md conventions ('Docstrings cite reference behavior')",
         checker=_check_r6,
+    ),
+    Rule(
+        id="speculation-discipline",
+        summary="no pg.configure / donor send / heal staging inside an undrained speculative window",
+        anchor="CLAUDE.md 'quorum membership changes drain the FULL window ... BEFORE pg.configure / any donor send'",
+        checker=_check_r7,
     ),
 )
 

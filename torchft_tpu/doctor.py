@@ -33,6 +33,9 @@ KNOWN_ENV = {
     "TPUFT_FLIGHT_RECORDER", "TPUFT_FLIGHT_RECORDER_SIZE",
     "TPUFT_HEARTBEAT_INTERVAL", "TPUFT_INIT_SYNC", "TPUFT_STRICT_COMMIT",
     "TPUFT_COMMIT_PIPELINE", "TPUFT_EMULATED_DEVICE_RTT_MS",
+    # Depth-N commit pipelining: window depth (int or "auto") and the
+    # adaptive controller's depth ceiling.
+    "TPUFT_COMMIT_PIPELINE_DEPTH", "TPUFT_COMMIT_PIPELINE_ADAPTIVE",
     # Heal-path hardening: joiner-side progress floor, bounded failover
     # attempts, and the punisher's stream-fault arming channel.
     "TPUFT_HEAL_MIN_BYTES_PER_SEC", "TPUFT_HEAL_MAX_ATTEMPTS",
@@ -402,6 +405,71 @@ def _check_heal_stripe(lighthouse: str) -> Tuple[str, str]:
     )
 
 
+def _check_commit_pipeline() -> Tuple[str, str]:
+    """Commit-pipeline window preflight. WARN, never FAIL: any depth
+    trains correctly — but the snapshot ring holds one full
+    ``(params, opt_state)`` copy per window slot (resident bytes ~=
+    depth x (params + optimizer state); watch
+    ``tpuft_pipeline_snapshot_bytes``), so an operator who set a deep
+    window should hear the memory formula before HBM does."""
+    from torchft_tpu import manager as mgr
+
+    raw = os.environ.get(mgr.COMMIT_PIPELINE_DEPTH_ENV)
+    legacy = os.environ.get(mgr.COMMIT_PIPELINE_ENV)
+    if raw is None:
+        raw = legacy
+    adaptive_raw = os.environ.get(mgr.COMMIT_PIPELINE_ADAPTIVE_ENV)
+    adaptive_max = mgr.DEFAULT_ADAPTIVE_MAX_DEPTH
+    if adaptive_raw is not None:
+        try:
+            adaptive_max = int(adaptive_raw)
+            if adaptive_max < 1:
+                raise ValueError
+        except ValueError:
+            return (
+                "WARN",
+                f"{mgr.COMMIT_PIPELINE_ADAPTIVE_ENV}={adaptive_raw!r} is not "
+                "a positive int (the adaptive depth ceiling)",
+            )
+    if raw is None:
+        return (
+            "PASS",
+            "commit pipeline off (set "
+            f"{mgr.COMMIT_PIPELINE_DEPTH_ENV}=N|auto to hide commit RTTs "
+            "behind an N-step speculative window)",
+        )
+    if raw.strip().lower() == "auto":
+        depth = adaptive_max  # the ceiling is what bounds the ring
+        label = f"auto (ceiling {adaptive_max})"
+    else:
+        try:
+            depth = int(raw)
+            if depth < 0:
+                raise ValueError
+        except ValueError:
+            return (
+                "WARN",
+                f"commit pipeline depth {raw!r} is not an int >= 0 or "
+                "'auto' (Manager will refuse it)",
+            )
+        label = str(depth)
+    if depth > 8:
+        return (
+            "WARN",
+            f"commit pipeline depth {label}: the rollback snapshot ring "
+            f"holds {depth} full (params, opt_state) copies — resident "
+            f"bytes ~= {depth} x (params + optimizer state). Past ~8 the "
+            "memory bill usually dwarfs the hidden RTT; watch "
+            "tpuft_pipeline_snapshot_bytes and size against HBM",
+        )
+    return (
+        "PASS",
+        f"commit pipeline depth {label} (phantom-commit envelope <= "
+        f"{depth} step(s); snapshot ring ~= {max(depth, 1)} x "
+        "(params + opt_state) resident)",
+    )
+
+
 def _check_env() -> Tuple[str, str]:
     # Value validation first — a fatal misconfig must FAIL even when a
     # typo'd var would also WARN.
@@ -424,6 +492,7 @@ def run_checks(lighthouse: str, skip_device: bool = False) -> int:
         ("kv store", _check_store),
         ("wire codecs", _check_kernels),
         ("env vars", _check_env),
+        ("commit pipeline", _check_commit_pipeline),
         ("metrics", _check_metrics),
         ("trace plane", _check_trace),
         ("heal serving", _check_heal_serve),

@@ -186,18 +186,22 @@ def stream_timeout(callback: Callable[[], None], timeout: float) -> _TimerHandle
 
 
 class CommitPipeline:
-    """Depth-bounded chain of pending pipelined-commit steps — the future
-    chain behind ``Manager(commit_pipeline_depth=...)``.
+    """Depth-bounded queue of pending pipelined-commit steps — the
+    speculative window behind ``Manager(commit_pipeline_depth=...)``.
 
     At most ``depth`` steps may be awaiting their commit verdict at once:
     the owner (optim.Optimizer's pipelined step_fn) pushes one record per
-    dispatched step and must fully resolve the oldest before pushing the
-    next. Records are opaque beyond the two idempotent phases every
-    pipelined step has — a vote resolution (owner-driven, may roll state
-    back) and a device bound (``bound_device(raise_on_error=...)``, safe
-    from any thread). The chain itself only does thread-safe bookkeeping:
-    the manager's quorum-change drain and the optimizer's step loop touch
-    it from different threads.
+    dispatched step and must resolve enough of the oldest records to make
+    room before pushing past ``depth``. The bound is dynamic
+    (:meth:`set_depth`) so the adaptive controller can deepen or shrink
+    the window between steps; records already admitted are never evicted
+    by a shrink — the owner drains down to the new bound. Records are
+    opaque beyond the two idempotent phases every pipelined step has — a
+    vote resolution (owner-driven, may roll state back) and a device
+    bound (``bound_device(raise_on_error=...)``, safe from any thread).
+    The queue itself only does thread-safe bookkeeping: the manager's
+    quorum-change drain and the optimizer's step loop touch it from
+    different threads.
     """
 
     def __init__(self, depth: int = 1) -> None:
@@ -217,6 +221,15 @@ class CommitPipeline:
     @property
     def depth(self) -> int:
         return self._depth
+
+    def set_depth(self, depth: int) -> None:
+        """Rebounds the window (the adaptive controller's lever). Growing
+        takes effect on the next push; shrinking never evicts — the owner
+        resolves oldest records until occupancy fits the new bound."""
+        if depth < 1:
+            raise ValueError(f"pipeline depth must be >= 1, got {depth}")
+        with self._lock:
+            self._depth = depth
 
     def __len__(self) -> int:
         with self._lock:

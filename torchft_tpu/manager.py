@@ -35,8 +35,10 @@ from __future__ import annotations
 import concurrent.futures
 import json
 import logging
+import math
 import os
 import socket
+import threading
 import time
 import traceback
 import uuid
@@ -82,7 +84,14 @@ QUORUM_RETRIES_ENV = "TPUFT_QUORUM_RETRIES"
 LIGHTHOUSE_ENV = "TPUFT_LIGHTHOUSE"
 MANAGER_PORT_ENV = "TPUFT_MANAGER_PORT"
 COMMIT_PIPELINE_ENV = "TPUFT_COMMIT_PIPELINE"
+COMMIT_PIPELINE_DEPTH_ENV = "TPUFT_COMMIT_PIPELINE_DEPTH"
+COMMIT_PIPELINE_ADAPTIVE_ENV = "TPUFT_COMMIT_PIPELINE_ADAPTIVE"
 HEAL_MAX_ATTEMPTS_ENV = "TPUFT_HEAL_MAX_ATTEMPTS"
+
+# Adaptive-mode ceiling when $TPUFT_COMMIT_PIPELINE_ADAPTIVE is unset. The
+# snapshot ring holds one (params, opt_state) copy per window slot, so the
+# ceiling is a memory bound, not a latency one — doctor warns past 8.
+DEFAULT_ADAPTIVE_MAX_DEPTH = 4
 
 
 def _env_timeout(env: str, default: float) -> float:
@@ -203,6 +212,70 @@ class _TrackedCommitFuture:
         self._inner.add_done_callback(lambda _inner: fn(self))
 
 
+class _SpeculativeCommitFuture:
+    """Verdict future for one slot of the depth-N speculative window.
+
+    The barrier RPC rides the manager's commit pool so the whole window's
+    votes overlap on the wire (the single-thread quorum executor would
+    serialize them — the depth-1 path keeps it for its FIFO ordering
+    guarantees). The step/commit ACCOUNTING that ``should_commit`` applies
+    inline is deferred to the first ``result()`` delivery: the pipelined
+    optimizer resolves records oldest-first, so accounting applies in
+    window order on the consuming thread. ``discard()`` consumes the
+    verdict WITHOUT accounting — a rollback unwound this slot, so quorum-
+    wide the step never happened (every survivor discards the same
+    suffix, keeping fleet accounting in lockstep)."""
+
+    __slots__ = (
+        "_manager", "_inner", "claimed_step", "local_vote",
+        "_participants", "_lock", "_settled",
+    )
+
+    def __init__(
+        self,
+        manager: "Manager",
+        inner: concurrent.futures.Future,
+        claimed_step: int,
+        local_vote: bool,
+        participants: int,
+    ) -> None:
+        self._manager = manager
+        self._inner = inner
+        self.claimed_step = claimed_step
+        self.local_vote = local_vote
+        self._participants = participants
+        self._lock = threading.Lock()
+        self._settled = False
+
+    def result(self, timeout: Optional[float] = None) -> bool:
+        verdict = bool(self._inner.result(timeout))
+        with self._lock:
+            settle = not self._settled
+            self._settled = True
+        if settle:
+            # May raise (max_retries escalation) — after marking settled,
+            # so a re-read returns the verdict instead of double-counting.
+            self._manager._speculative_commit_resolved(
+                self.claimed_step, verdict, self._participants
+            )
+        return verdict
+
+    def done(self) -> bool:
+        return self._inner.done()
+
+    def discard(self) -> None:
+        """Consumes the barrier verdict with NO step accounting (and no
+        exception): the window unwound past this slot. Best-effort
+        bounded wait — an unreachable barrier here is already a poisoned
+        step through the normal error funnels."""
+        with self._lock:
+            self._settled = True
+        try:
+            self._inner.result(self._manager._timeout)
+        except Exception:  # noqa: BLE001 — the slot is unwound either way
+            pass
+
+
 class Manager:
     """Fault tolerance manager for one rank of one replica group.
 
@@ -221,9 +294,17 @@ class Manager:
         group_rank/group_world_size: this process's coordinates inside the
             replica group (host index / hosts per group).
         commit_pipeline_depth: 0 (default) resolves every step's commit
-            before the next dispatch; 1 opts into the pipelined-commit
-            schedule (``$TPUFT_COMMIT_PIPELINE`` overrides; see
-            optim.Optimizer.make_step_fn for the widened envelope).
+            before the next dispatch; N >= 1 opts into the pipelined-commit
+            schedule with an N-step bounded speculative window (the
+            phantom-commit envelope grows with N — see
+            optim.Optimizer.make_step_fn); the string ``"auto"`` picks the
+            depth adaptively per quorum era from the measured control-plane
+            RTT vs step time (capped by
+            ``$TPUFT_COMMIT_PIPELINE_ADAPTIVE``, default
+            ``DEFAULT_ADAPTIVE_MAX_DEPTH``).
+            ``$TPUFT_COMMIT_PIPELINE_DEPTH`` overrides (int or ``auto``);
+            the legacy ``$TPUFT_COMMIT_PIPELINE`` is honored when the new
+            var is unset.
         heal_max_attempts: consecutive failed heal attempts tolerated
             before :class:`HealExhaustedError` escalates out of the quorum
             future (``$TPUFT_HEAL_MAX_ATTEMPTS`` overrides). Each failed
@@ -259,7 +340,7 @@ class Manager:
         init_sync: bool = True,
         max_retries: Optional[int] = None,
         quorum_retries: int = 0,
-        commit_pipeline_depth: int = 0,
+        commit_pipeline_depth: Any = 0,
         heal_max_attempts: int = 5,
     ) -> None:
         self._pg = pg
@@ -270,19 +351,68 @@ class Manager:
         self._quorum_retries = int(
             os.environ.get(QUORUM_RETRIES_ENV, str(quorum_retries))
         )
-        # Pipelined commit (opt-in): step N's device sync + commit vote may
-        # resolve while step N+1 is already dispatched — optim.make_step_fn
-        # reads this depth and runs its pipelined schedule. Depth 1 is the
-        # supported window (a one-step-deep bounded-accounting envelope,
-        # see optim.py); TPUFT_STRICT_COMMIT=1 overrides it back to 0.
-        self._commit_pipeline_depth = int(
-            os.environ.get(COMMIT_PIPELINE_ENV, str(commit_pipeline_depth))
+        # Pipelined commit (opt-in): up to depth-N steps' device syncs +
+        # commit votes may resolve while younger steps are already
+        # dispatched — optim.make_step_fn reads this depth and runs its
+        # pipelined schedule over an N-step bounded speculative window
+        # (rollback snapshots become a ring, the phantom-commit envelope
+        # grows to at most N steps; see optim.py). "auto" picks the depth
+        # per quorum era from the measured control-plane RTT vs step time;
+        # TPUFT_STRICT_COMMIT=1 overrides any depth back to 0.
+        raw_depth: Any = os.environ.get(COMMIT_PIPELINE_DEPTH_ENV)
+        if raw_depth is None:
+            raw_depth = os.environ.get(COMMIT_PIPELINE_ENV)
+        if raw_depth is None:
+            raw_depth = commit_pipeline_depth
+        self._commit_pipeline_adaptive = (
+            isinstance(raw_depth, str) and raw_depth.strip().lower() == "auto"
         )
-        if self._commit_pipeline_depth not in (0, 1):
-            raise ValueError(
-                "commit_pipeline_depth must be 0 (off) or 1 (one uncommitted "
-                f"step in flight); got {self._commit_pipeline_depth}"
+        try:
+            self._adaptive_max_depth = max(
+                1,
+                int(
+                    os.environ.get(
+                        COMMIT_PIPELINE_ADAPTIVE_ENV,
+                        str(DEFAULT_ADAPTIVE_MAX_DEPTH),
+                    )
+                ),
             )
+        except ValueError:
+            self._adaptive_max_depth = DEFAULT_ADAPTIVE_MAX_DEPTH
+        if self._commit_pipeline_adaptive:
+            self._commit_pipeline_depth = 1  # deepens as evidence arrives
+        else:
+            try:
+                self._commit_pipeline_depth = int(raw_depth)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    "commit_pipeline_depth must be an int >= 0 (0 = off, "
+                    "N = an N-step speculative window) or 'auto'; got "
+                    f"{raw_depth!r}"
+                ) from None
+            if self._commit_pipeline_depth < 0:
+                raise ValueError(
+                    "commit_pipeline_depth must be an int >= 0 (0 = off, "
+                    "N = an N-step speculative window) or 'auto'; got "
+                    f"{self._commit_pipeline_depth}"
+                )
+        # Adaptive-controller observations (EWMAs over the pipelined loop's
+        # reports; see observe_pipeline_step / _adapt_pipeline_depth).
+        self._pipeline_interval_ewma: Optional[float] = None
+        self._pipeline_stall_ewma: Optional[float] = None
+        self._barrier_rtt_ewma: Optional[float] = None
+        self._pipeline_last_obs: Optional[float] = None
+        self._pipeline_obs_count = 0
+        # Trial bookkeeping: a deepen is an experiment — (old depth, old
+        # per-step interval) to judge it against; _adapt_hold freezes the
+        # controller after a deepen that did not pay, until the next era.
+        self._adapt_trial_from: Optional[tuple] = None
+        self._adapt_hold = False
+        # Speculative-vote pool (depth >= 2 / adaptive): the barrier RPCs
+        # for the window's steps must overlap ON THE WIRE, which the
+        # single-thread quorum executor cannot do. Lazily created.
+        self._commit_pool: Optional[concurrent.futures.ThreadPoolExecutor] = None
+        self._commit_pool_lock = threading.Lock()
         self._use_async_quorum = use_async_quorum
         self._replica_world_size_mode = world_size_mode
         self._init_sync = init_sync
@@ -401,6 +531,11 @@ class Manager:
         self._metrics_push_interval = metrics.push_interval_sec()
         self._metrics_last_push = 0.0
         metrics.maybe_start_http_server()
+        metrics.set_gauge(
+            "tpuft_pipeline_depth",
+            self._commit_pipeline_depth,
+            **self._metric_labels,
+        )
 
         # Trace plane: this manager's journal is whatever journal is
         # current on the CONSTRUCTING thread (threads-as-replicas drills
@@ -450,8 +585,125 @@ class Manager:
     @property
     def commit_pipeline_depth(self) -> int:
         """How many uncommitted steps the train loop may keep in flight
-        (0 = resolve every commit before the next dispatch)."""
+        (0 = resolve every commit before the next dispatch). In adaptive
+        mode this is the CURRENT depth — the controller moves it between 1
+        and the adaptive ceiling as the measured RTT/step ratio changes;
+        the pipelined step_fn re-reads it every call."""
         return self._commit_pipeline_depth
+
+    @property
+    def commit_pipeline_adaptive(self) -> bool:
+        return self._commit_pipeline_adaptive
+
+    # ------------------------------------------------------------------
+    # adaptive depth controller
+    # ------------------------------------------------------------------
+
+    _ADAPT_EVERY_OBS = 4  # re-evaluate cadence, in pipelined-step reports
+    _EWMA_ALPHA = 0.3
+
+    def _ewma(self, prev: Optional[float], value: float) -> float:
+        if prev is None:
+            return value
+        return prev + self._EWMA_ALPHA * (value - prev)
+
+    def observe_pipeline_step(self, stall_s: float) -> None:
+        """Per-resolution report from the pipelined step loop: ``stall_s``
+        is how long the train thread sat blocked on this step's verdict +
+        device bound (the serialized latency the window failed to hide).
+        Feeds the adaptive controller's EWMAs; every few reports the
+        controller runs one trial-and-judge round:
+
+        - measurable stall remaining -> DEEPEN one slot as a trial;
+        - at the next round, keep the deepen only if the per-step wall
+          actually improved (>= 5%) — stall that deepening cannot remove
+          (a compute-throughput backlog looks exactly like an unhidden
+          round trip from the train thread) reverts the trial and holds
+          the controller until the next quorum era.
+
+        Shrinking below a kept depth happens only at era boundaries
+        (:meth:`_adapt_pipeline_depth`), so a noisy fast step cannot
+        oscillate the window against a slow link."""
+        now = time.monotonic()
+        if self._pipeline_last_obs is not None:
+            self._pipeline_interval_ewma = self._ewma(
+                self._pipeline_interval_ewma, now - self._pipeline_last_obs
+            )
+        self._pipeline_last_obs = now
+        self._pipeline_stall_ewma = self._ewma(
+            self._pipeline_stall_ewma, max(stall_s, 0.0)
+        )
+        self._pipeline_obs_count += 1
+        if not self._commit_pipeline_adaptive:
+            return
+        if self._pipeline_obs_count % self._ADAPT_EVERY_OBS:
+            return
+        interval = self._pipeline_interval_ewma or 0.0
+        stall = self._pipeline_stall_ewma or 0.0
+        if interval <= 0.0:
+            return
+        if self._adapt_trial_from is not None:
+            prev_depth, prev_interval = self._adapt_trial_from
+            self._adapt_trial_from = None
+            if interval >= 0.95 * prev_interval:
+                # The deepen did not pay: revert and hold this era.
+                self._adapt_hold = True
+                self._set_pipeline_depth(prev_depth)
+                return
+        if self._adapt_hold:
+            return
+        if (
+            stall > 0.15 * interval
+            and self._commit_pipeline_depth < self._adaptive_max_depth
+        ):
+            self._adapt_trial_from = (self._commit_pipeline_depth, interval)
+            self._set_pipeline_depth(self._commit_pipeline_depth + 1)
+
+    def _adapt_pipeline_depth(self) -> None:
+        """Quorum-era re-evaluation (called on a quorum_id change, after
+        the drain hooks emptied the window): clear any hold/trial and
+        re-derive the depth from the measured control-plane RTT vs step
+        time — ``ceil(barrier_rtt / step_compute)`` where step_compute is
+        the inter-step interval minus the observed stall (what the loop
+        spends NOT waiting on verdicts). This is where the window can
+        SHRINK; a link that degrades mid-era deepens it through
+        :meth:`observe_pipeline_step`'s trial rounds instead of stalling
+        the fleet."""
+        if not self._commit_pipeline_adaptive:
+            return
+        self._adapt_trial_from = None
+        self._adapt_hold = False
+        rtt = self._barrier_rtt_ewma
+        interval = self._pipeline_interval_ewma
+        if rtt is None or interval is None:
+            return  # no evidence yet: keep the current depth
+        compute = max(interval - (self._pipeline_stall_ewma or 0.0), 1e-4)
+        ideal = int(math.ceil(rtt / compute))
+        self._set_pipeline_depth(max(1, min(ideal, self._adaptive_max_depth)))
+
+    def _set_pipeline_depth(self, depth: int) -> None:
+        if depth == self._commit_pipeline_depth:
+            return
+        self._logger.info(
+            f"adaptive commit pipeline: depth {self._commit_pipeline_depth} "
+            f"-> {depth} (barrier_rtt={self._barrier_rtt_ewma}, "
+            f"interval={self._pipeline_interval_ewma}, "
+            f"stall={self._pipeline_stall_ewma})"
+        )
+        self._commit_pipeline_depth = depth
+        # Re-measure under the new depth: stall/interval evidence gathered
+        # at the old depth would keep re-triggering the deepen rule after
+        # the window already absorbed the latency (observed as runaway
+        # deepening at RTT 0). The barrier-RTT EWMA stays — the wire's
+        # round trip is depth-independent.
+        self._pipeline_stall_ewma = None
+        self._pipeline_interval_ewma = None
+        self._pipeline_last_obs = None
+        metrics.set_gauge("tpuft_pipeline_depth", depth, **self._metric_labels)
+        self._trace.record(
+            "pipeline_depth", step=self._step, quorum_id=self._quorum_id,
+            depth=depth,
+        )
 
     def register_quorum_change_hook(self, hook: Callable[[], None]) -> None:
         """Runs ``hook`` on the quorum thread whenever the quorum id
@@ -465,6 +717,22 @@ class Manager:
         funnel into :meth:`report_error` (the step will not commit) rather
         than aborting the reconfigure."""
         self._quorum_change_hooks.append(hook)
+
+    def _run_quorum_drain_hooks(self) -> None:
+        """Runs the registered quorum-change (speculative-window drain)
+        hooks on the calling (quorum) thread. Idempotent by contract —
+        every registered hook resolves records in place — so it runs on a
+        quorum-id change AND again before any donor send, making "no
+        ``pg.configure`` / ``send_checkpoint`` inside an undrained window"
+        structural (tpuft_check rule R7 pins the ordering lexically).
+        Hook errors funnel into :meth:`report_error` (the step will not
+        commit) rather than aborting the reconfigure or the serve."""
+        for hook in self._quorum_change_hooks:
+            try:
+                hook()
+            except Exception as e:  # noqa: BLE001
+                self._logger.exception(f"quorum-change drain hook failed: {e}")
+                self.report_error(e)
 
     def register_heal_parts_filter(self, fn: Callable[[], Any]) -> None:
         """Registers a callable returning the set of heal-part names
@@ -507,6 +775,10 @@ class Manager:
         if self._manager is not None:
             self._manager.shutdown()
         self._executor.shutdown(wait=wait)
+        with self._commit_pool_lock:
+            if self._commit_pool is not None:
+                self._commit_pool.shutdown(wait=wait)
+                self._commit_pool = None
         self._client.close()
 
     # ------------------------------------------------------------------
@@ -916,14 +1188,16 @@ class Manager:
             # still has in flight BEFORE reconfiguring the wire or serving
             # a donor checkpoint — the new quorum era (and any joiner
             # healing from this replica) must observe committed state only.
-            for hook in self._quorum_change_hooks:
-                try:
-                    hook()
-                except Exception as e:  # noqa: BLE001
-                    self._logger.exception(
-                        f"quorum-change drain hook failed: {e}"
-                    )
-                    self.report_error(e)
+            # With a depth-N window this resolves the FULL window (the
+            # committed step may advance past quorum.max_step here — the
+            # donor send below stages the drained committed step honestly,
+            # so a first heal round against a deep window can fail cleanly
+            # and succeed next round, never serving mislabeled bytes).
+            self._run_quorum_drain_hooks()
+            # Era boundary: the adaptive controller re-derives its depth
+            # from the measured barrier RTT vs step time (the only point
+            # the window may SHRINK — see _adapt_pipeline_depth).
+            self._adapt_pipeline_depth()
             try:
                 with trace_span(
                     "tpuft::manager::_pg::configure",
@@ -969,11 +1243,29 @@ class Manager:
                 )
             )
             if quorum.recover_dst_replica_ranks or stripe_costage:
-                # Ordering note: on a membership change the quorum-change
-                # drain hooks above already ran (pipelined speculative
-                # state resolved) BEFORE this donor send — so in child
-                # serve mode the sidecar's restaged snapshot can never
-                # contain uncommitted state either.
+                # A donor send must NEVER sample speculative state, even
+                # when the quorum id did not move (e.g. a repeated heal
+                # round inside one era): drain the full window here too —
+                # idempotent, the membership-change path above already ran
+                # the hooks when the id changed. In child serve mode the
+                # sidecar's restaged snapshot therefore can never contain
+                # uncommitted state either.
+                self._run_quorum_drain_hooks()
+                serve_step = quorum.max_step
+                if self._step > serve_step:
+                    # Draining a depth-N window advanced our committed
+                    # step past the quorum's (pre-drain-reported)
+                    # max_step. Stage what we actually hold — a joiner
+                    # that asked for max_step fails this round cleanly
+                    # and re-heals next round once the fleet's reported
+                    # steps catch up; mislabeling committed bytes with an
+                    # older step would break the (step, digest) chain.
+                    self._logger.info(
+                        f"donor staging drained step {self._step} "
+                        f"(quorum max_step={serve_step}): a deep window "
+                        "resolved during the drain"
+                    )
+                    serve_step = self._step
                 try:
                     if stripe_costage:
                         self._logger.info(
@@ -996,18 +1288,18 @@ class Manager:
                     with trace_span(
                         "tpuft::manager::_checkpoint_transport::send_checkpoint",
                         quorum_id=quorum.quorum_id,
-                        step=quorum.max_step,
+                        step=serve_step,
                     ), metrics.timer(
                         "tpuft_heal_send_seconds", **self._metric_labels
                     ), self._trace.span(
                         "heal_send",
-                        step=quorum.max_step,
+                        step=serve_step,
                         quorum_id=quorum.quorum_id,
                         dst_ranks=str(list(quorum.recover_dst_replica_ranks)),
                     ):
                         self._checkpoint_transport.send_checkpoint(
                             dst_ranks=quorum.recover_dst_replica_ranks,
-                            step=quorum.max_step,
+                            step=serve_step,
                             state_dict=self._manager_state_dict(),
                             timeout=self._timeout,
                             quorum_id=quorum.quorum_id,
@@ -1390,6 +1682,162 @@ class Manager:
                 self._logger.exception(msg)
                 raise RuntimeError(msg)
         return should_commit
+
+    # ------------------------------------------------------------------
+    # speculative commits (the depth-N pipelined window)
+    # ------------------------------------------------------------------
+
+    def speculative_commit_async(
+        self, claimed_step: int, timeout: Optional[float] = None
+    ) -> _SpeculativeCommitFuture:
+        """Commit-barrier vote for the speculative step ``claimed_step``
+        (committed step + window offset) — the depth>=2 / adaptive vote
+        path of the pipelined commit schedule.
+
+        Split-phase ``should_commit``: the LOCAL phase (pg error read,
+        pending-heal apply, vote computation) runs here on the caller
+        thread, so the vote reflects exactly this step's error/heal flags
+        before the next ``start_quorum`` wipes them — the property
+        ``_drain_pending_commit`` enforces by blocking on the depth<=1
+        path. The barrier RPC rides the commit pool so every window
+        slot's vote overlaps on the wire, and the step/batch accounting
+        defers to the first ``result()`` delivery (the pipelined
+        optimizer resolves oldest-first, keeping accounting in step
+        order; see :class:`_SpeculativeCommitFuture`).
+        ``should_commit_async`` remains the depth<=1 path: its
+        quorum-executor FIFO ordering is what the depth-1 tests pin."""
+        lockcheck.check_barrier("Manager.speculative_commit_async")
+        if err := self._pg.errored():
+            self.report_error(err)
+        if self._healing:
+            self._apply_pending_state_dict()
+        participants = self.num_participants()
+        enough_replicas = participants >= self._min_replica_size
+        local_should_commit = enough_replicas and self._errored is None
+        self._trace.record(
+            "vote_send",
+            step=claimed_step,
+            quorum_id=self._quorum_id,
+            vote=local_should_commit,
+            enough_replicas=enough_replicas,
+            errored=self._errored is not None,
+            speculative=True,
+        )
+        inner = self._commit_executor().submit(
+            self._speculative_barrier, claimed_step, local_should_commit, timeout
+        )
+        return _SpeculativeCommitFuture(
+            self, inner, claimed_step, local_should_commit, participants
+        )
+
+    def _commit_executor(self) -> concurrent.futures.ThreadPoolExecutor:
+        with self._commit_pool_lock:
+            if self._commit_pool is None:
+                depth_bound = (
+                    self._adaptive_max_depth
+                    if self._commit_pipeline_adaptive
+                    else self._commit_pipeline_depth
+                )
+                self._commit_pool = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=max(2, min(int(depth_bound), 16)),
+                    thread_name_prefix="tpuft_commit",
+                )
+            return self._commit_pool
+
+    def _speculative_barrier(
+        self, step: int, vote: bool, timeout: Optional[float]
+    ) -> bool:
+        """The barrier RPC leg of one speculative vote (commit-pool
+        thread). Also the adaptive controller's RTT sensor: measured here
+        the barrier round trip is UNHIDDEN, unlike the stall the train
+        thread observes once the window covers it."""
+        barrier_t0 = time.perf_counter()
+        try:
+            with trace_span(
+                "tpuft::manager::speculative_commit",
+                step=step,
+                quorum_id=self._quorum_id,
+            ), metrics.timer(
+                "tpuft_commit_barrier_seconds", **self._metric_labels
+            ), self._trace.span(
+                "commit_barrier", step=step, quorum_id=self._quorum_id, vote=vote
+            ):
+                return self._client.should_commit(
+                    self._group_rank, step, vote, timeout=timeout or self._timeout
+                )
+        finally:
+            elapsed = time.perf_counter() - barrier_t0
+            self._barrier_rtt_ewma = self._ewma(self._barrier_rtt_ewma, elapsed)
+            metrics.set_gauge(
+                "tpuft_trace_barrier_wait_seconds", elapsed, **self._metric_labels
+            )
+
+    def _speculative_commit_resolved(
+        self, step: int, should_commit: bool, participants: int
+    ) -> None:
+        """Deferred accounting tail of one speculative vote (mirrors
+        :meth:`should_commit`'s inline tail), applied in window order on
+        the consuming thread. ``participants`` was captured at vote
+        launch — re-reading it here could block on the CURRENT quorum
+        future from the quorum thread itself (the drain hook runs inside
+        ``_async_quorum``)."""
+        self._logger.info(
+            f"speculative should_commit={should_commit} step={step} "
+            f"errored={self._errored}"
+        )
+        commits_logger.info(
+            "commit",
+            extra={
+                "job_id": os.environ.get("JOB_ID", "unknown"),
+                "replica_id": self._replica_id,
+                "rank": self._group_rank,
+                "quorum_id": self._quorum_id,
+                "step": step,
+                "commit_result": should_commit,
+            },
+        )
+        self._checkpoint_transport.disallow_checkpoint()
+        if should_commit:
+            self._trace.record("commit", step=step, quorum_id=self._quorum_id)
+            if step != self._step:
+                # Resolution is oldest-first by construction; a mismatch
+                # means the owner broke window order — keep accounting
+                # monotone and loud rather than silently double-counting.
+                self._logger.warn(
+                    f"speculative commit for step {step} resolved at "
+                    f"committed step {self._step} (window order violated?)"
+                )
+            self._step = max(self._step, step + 1)
+            self._batches_committed += participants
+            self._commit_failures = 0
+            metrics.inc("tpuft_commits_total", **self._metric_labels)
+            metrics.set_gauge(
+                "tpuft_last_commit_time", time.time(), **self._metric_labels
+            )
+            tracing.clear_incident(self._trace)
+        else:
+            self._commit_failures += 1
+            metrics.inc("tpuft_commit_failures_total", **self._metric_labels)
+            self._trace.record(
+                "commit_failed",
+                step=step,
+                quorum_id=self._quorum_id,
+                consecutive_failures=self._commit_failures,
+            )
+        self._trace.set_step(self._step, self._quorum_id)
+        metrics.set_gauge("tpuft_step", self._step, **self._metric_labels)
+        metrics.set_gauge(
+            "tpuft_batches_committed", self._batches_committed, **self._metric_labels
+        )
+        self._push_metrics()
+        if not should_commit:
+            if self._max_retries is not None and self._commit_failures > self._max_retries:
+                msg = (
+                    f"should_commit failed {self._commit_failures} times consecutively, "
+                    f"exceeding max_retries={self._max_retries}"
+                )
+                self._logger.exception(msg)
+                raise RuntimeError(msg)
 
     # ------------------------------------------------------------------
     # metrics push (the fleet-table feed)

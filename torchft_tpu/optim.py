@@ -313,11 +313,22 @@ def _as_device_tree(tree: Any, like: Any = None) -> Any:
     )
 
 
+# Rollback-unwind depth is a small count (1..window depth), not seconds:
+# its histogram gets count-shaped edges instead of the shared time ladder.
+_UNWIND_DEPTH_BUCKETS = (1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0)
+
+
 class _PendingStep:
-    """One uncommitted pipelined step: the speculative ``(params,
-    opt_state)`` is already adopted as the live state (so the next step
-    could dispatch on it), and this record carries everything needed to
-    confirm, roll back, or re-derive it once its commit verdict lands.
+    """One slot of the speculative commit window: the slot's speculative
+    ``(params, opt_state)`` is already adopted as the live state (so
+    younger steps could dispatch on it), and this record carries
+    everything needed to confirm, roll back, or re-derive it once its
+    commit verdict lands — plus the window bookkeeping the depth-N
+    generalization needs: ``claimed_step`` (the step this slot
+    speculates), ``gen`` (the speculation generation at dispatch; a
+    rollback bumps the owner's generation, turning every younger
+    undrained slot into a discard), and ``snapshot_bytes`` (this slot's
+    share of the snapshot ring, for the resident-bytes gauge).
 
     Both phases are idempotent and lock-guarded because two threads may
     reach them: the train loop (the normal resolution path) and the
@@ -331,6 +342,10 @@ class _PendingStep:
         "recompute",
         "commit_future",
         "committed",
+        "gen",
+        "claimed_step",
+        "discarded",
+        "snapshot_bytes",
         "_bound",
         "_bound_error",
         "_lock",
@@ -338,7 +353,8 @@ class _PendingStep:
 
     def __init__(
         self, manager: Manager, heal_count: int, loss: Any, snapshot: Any,
-        recompute: Any, commit_future: Any,
+        recompute: Any, commit_future: Any, gen: int = 0,
+        claimed_step: int = -1, snapshot_bytes: int = 0,
     ) -> None:
         self.manager = manager
         self.heal_count = heal_count
@@ -347,6 +363,10 @@ class _PendingStep:
         self.recompute = recompute
         self.commit_future = commit_future
         self.committed: Optional[bool] = None  # set by the vote resolution
+        self.gen = gen
+        self.claimed_step = claimed_step
+        self.discarded = False
+        self.snapshot_bytes = snapshot_bytes
         self._bound = False
         self._bound_error: Optional[BaseException] = None
         self._lock = threading.Lock()
@@ -381,7 +401,8 @@ class _PendingStep:
                         "pipelined step's device work failed after its commit "
                         "vote resolved committed=%s (a committed step here "
                         "advanced the step counter without a verified update "
-                        "— the depth-1 phantom-commit envelope)",
+                        "— the bounded phantom-commit envelope, at most "
+                        "window-depth steps)",
                         self.committed,
                     )
                     if isinstance(e, Exception):
@@ -412,11 +433,18 @@ class Optimizer:
         self._jit_update = make_jit_update(tx)
 
         # Pipelined-commit state (populated by make_step_fn when the
-        # manager's commit_pipeline_depth >= 1).
+        # manager's commit_pipeline_depth >= 1; the depth-N window keeps
+        # up to N of these records in flight at once).
         self._pipeline: Optional[Any] = None
         self._pipeline_hooked = False
         self._next_pipelined_step = 0
         self.rollback_count = 0
+        # Speculation generation: bumped by a rollback so every younger
+        # undrained window slot resolves as a discard (the step never
+        # happened, quorum-wide) instead of adopting state computed on a
+        # refused speculation.
+        self._speculation_gen = 0
+        self._snapshot_ring_bytes = 0
 
     def _init_state(self, tx: Any, params: Any) -> Any:
         """Builds the initial optimizer state this wrapper owns. The ZeRO
@@ -549,12 +577,45 @@ class Optimizer:
         return True
 
     # ------------------------------------------------------------------
-    # pipelined commit (depth 1): resolution machinery
+    # pipelined commit (depth N): resolution machinery
     # ------------------------------------------------------------------
 
     def pending_commits(self) -> int:
-        """Uncommitted pipelined steps currently in flight (0 or 1)."""
+        """Uncommitted pipelined steps currently in flight (0 up to the
+        window depth)."""
         return len(self._pipeline) if self._pipeline is not None else 0
+
+    def _snapshot_nbytes(self, snapshot: Any) -> int:
+        """Approximate resident bytes of one rollback snapshot (device
+        array leaves by ``nbytes``; opaque states that expose
+        ``owned_bytes`` — the ZeRO shard state — by that). Feeds the
+        ``tpuft_pipeline_snapshot_bytes`` gauge: the window holds one
+        (params, opt_state) copy per slot, which is THE memory cost of
+        deepening it (the doctor's depth probe states the formula)."""
+        total = 0
+        try:
+            for leaf in jax.tree_util.tree_leaves(
+                snapshot, is_leaf=lambda x: hasattr(x, "owned_bytes")
+            ):
+                owned = getattr(leaf, "owned_bytes", None)
+                if owned is not None:
+                    total += int(owned)
+                else:
+                    total += int(getattr(leaf, "nbytes", 0) or 0)
+        except Exception:  # noqa: BLE001 — a gauge must never wound a step
+            return 0
+        return total
+
+    def _note_snapshot(self, rec: "_PendingStep", admitted: bool) -> None:
+        if admitted:
+            self._snapshot_ring_bytes += rec.snapshot_bytes
+        else:
+            self._snapshot_ring_bytes = max(
+                0, self._snapshot_ring_bytes - rec.snapshot_bytes
+            )
+        metrics.set_gauge(
+            "tpuft_pipeline_snapshot_bytes", self._snapshot_ring_bytes
+        )
 
     def next_pipelined_step(self) -> int:
         """The step index the next pipelined ``step_fn`` call will compute.
@@ -563,28 +624,53 @@ class Optimizer:
         flight (it advances on the manager's executor the moment the
         barrier resolves), so DDP loops that key their data stream on the
         step must use this caller-thread-maintained prediction instead. It
-        assumes the in-flight step commits; a failed commit or a heal makes
-        exactly one prediction stale, and the next call re-anchors — every
-        replica observes the same quorum-wide verdicts, so the streams stay
-        in lockstep."""
+        assumes every in-flight step commits; a failed commit or a heal
+        makes up to window-depth predictions stale, and the next call
+        re-anchors — every replica observes the same quorum-wide verdicts,
+        so the streams stay in lockstep."""
         return self._next_pipelined_step
 
     def _resolve_pipelined_record(self, rec: _PendingStep) -> bool:
         """Vote phase: reads the barrier verdict and reconciles the already
         adopted speculation — confirm (no-op), roll back to the pre-step
-        snapshot on a failed commit, or (same semantics as
+        snapshot on a failed commit (discarding every younger slot of the
+        window: the refusal is quorum-wide, so all survivors unwind the
+        same suffix identically), or (same semantics as
         :meth:`_commit_and_adopt`) re-derive the update against a state the
-        barrier healed. Idempotent: the quorum-change drain and the train
-        loop may both reach it."""
+        barrier healed — younger slots re-derive in turn when they become
+        oldest, replaying the whole window's grads onto the healed state.
+        Idempotent: the quorum-change drain and the train loop may both
+        reach it."""
         with rec._lock:
             if rec.committed is not None:
                 return rec.committed
+            if rec.gen != self._speculation_gen:
+                # A rollback unwound the window past this slot: the step
+                # never happened (quorum-wide). Consume the in-flight
+                # verdict WITHOUT accounting and skip the device bound —
+                # the work was discarded along with the state it computed.
+                rec.discarded = True
+                rec._bound = True
+                discard = getattr(rec.commit_future, "discard", None)
+                if discard is not None:
+                    discard()
+                else:  # pragma: no cover — depth-1 windows have no youngers
+                    try:
+                        rec.commit_future.result()
+                    except Exception:  # noqa: BLE001
+                        pass
+                _trace_of(self.manager).record(
+                    "speculation_discarded", step=rec.claimed_step
+                )
+                rec.committed = False
+                return False
             with trace_span(
                 "tpuft::optim::resolve_pipelined_commit",
                 step=self.manager.current_step(),
             ):
                 committed = rec.commit_future.result()
                 rolled_back = False
+                discarded = 0
                 self.manager.disallow_state_dict_read()
                 try:
                     if self._heal_count != rec.heal_count:
@@ -597,14 +683,31 @@ class Optimizer:
                             self.params, self.opt_state = rec.recompute()
                     elif not committed:
                         # Refuse to adopt: restore the pre-step state the
-                        # speculation was dispatched from.
+                        # speculation was dispatched from, and turn every
+                        # younger in-flight slot into a discard — their
+                        # speculations chain from this refused one.
                         self.params, self.opt_state = rec.snapshot
                         self.rollback_count += 1
                         rolled_back = True
+                        pending = (
+                            self._pipeline.pending()
+                            if self._pipeline is not None
+                            else ()
+                        )
+                        discarded = sum(
+                            1
+                            for r in pending
+                            if r is not rec and r.gen == rec.gen
+                        )
+                        self._speculation_gen += 1
                         metrics.inc(
                             "tpuft_rollbacks_total",
                             **_replica_labels(self.manager),
                         )
+                        metrics.histogram(
+                            "tpuft_rollback_unwind_depth",
+                            buckets=_UNWIND_DEPTH_BUCKETS,
+                        ).observe(1 + discarded)
                 finally:
                     self.manager.allow_state_dict_read()
                 if rolled_back:
@@ -618,7 +721,11 @@ class Optimizer:
                     rolled_step = self.manager.current_step()
                     rolled_quorum = getattr(self.manager, "_quorum_id", -1)
                     journal.record(
-                        "rollback", step=rolled_step, quorum_id=rolled_quorum
+                        "rollback",
+                        step=rolled_step,
+                        quorum_id=rolled_quorum,
+                        unwound_to=rolled_step,
+                        discarded=discarded,
                     )
                     tracing.open_incident(
                         "rollback", rolled_step, rolled_quorum,
@@ -630,27 +737,38 @@ class Optimizer:
 
     def flush_pipeline(self, raise_on_error: bool = True) -> Optional[bool]:
         """Resolves every pending pipelined step (vote + rollback + device
-        bound); returns the last step's commit verdict, or None when the
+        bound), oldest first; returns the last resolved verdict (False when
+        the tail of the window was unwound by a refusal), or None when the
         pipeline was idle. Call at train-loop boundaries — end of run,
         before a checkpoint restore, before switching step protocols."""
         if self._pipeline is None:
             return None
         last: Optional[bool] = None
-        for rec in self._pipeline.drain():
+        while True:
+            rec = self._pipeline.oldest()
+            if rec is None:
+                break
+            # Records stay in the pipeline until resolved so a refusal's
+            # unwind can see (and discard) the younger slots.
             last = self._resolve_pipelined_record(rec)
+            self._pipeline.remove(rec)
+            self._note_snapshot(rec, admitted=False)
             rec.bound_device(raise_on_error=raise_on_error)
         return last
 
     def _drain_pipeline_for_quorum_change(self) -> None:
         """Quorum-change hook (runs on the manager's quorum thread): fully
-        resolve the pipeline before the PG reconfigures or a donor send
-        samples this replica's state — a joiner must never heal from an
-        uncommitted speculative step. Safe here: the pending vote ran
-        earlier on the same single-thread executor (FIFO), so its result()
-        cannot deadlock, and the train-loop thread is parked in
-        wait_quorum while this runs. Records stay in the pipeline (resolved
-        in place, both phases idempotent) so the train loop still observes
-        each step's verdict on its own thread."""
+        resolve the WHOLE speculative window before the PG reconfigures or
+        a donor send samples this replica's state — a joiner must never
+        heal from an uncommitted speculative step (tpuft_check rule R7
+        pins the call ordering in the manager). Safe here at every depth:
+        depth-1 votes ran earlier on the same single-thread executor
+        (FIFO), so their result() cannot deadlock, and depth>=2 votes ride
+        the manager's dedicated commit pool — never this thread; the
+        train-loop thread is parked in wait_quorum while this runs.
+        Records stay in the pipeline (resolved in place, both phases
+        idempotent) so the train loop still observes each step's verdict
+        on its own thread."""
         if self._pipeline is None:
             return
         for rec in self._pipeline.pending():
@@ -687,15 +805,18 @@ class Optimizer:
         ``loss_fn(params, *batch) -> scalar``; ``on_quorum(seconds)``, when
         given, receives each step's measured quorum wait (telemetry hook).
 
-        With ``Manager(commit_pipeline_depth=1)`` (or
-        ``TPUFT_COMMIT_PIPELINE=1``) the returned step_fn runs the
-        **pipelined-commit** schedule instead: step N's device sync and
-        commit vote resolve while step N+1 is already dispatched, so the
-        loop pays zero serialized readiness round trips per step. The
-        returned ``committed`` flag then reports the PREVIOUS step's
-        verdict (None on the first call); call :meth:`flush_pipeline` at
-        the loop boundary for the final step's. ``TPUFT_STRICT_COMMIT=1``
-        overrides the pipeline back to the strict per-step ordering.
+        With ``Manager(commit_pipeline_depth=N)`` for N >= 1 (or
+        ``TPUFT_COMMIT_PIPELINE_DEPTH=N|auto``) the returned step_fn runs
+        the **pipelined-commit** schedule instead: up to N steps' device
+        syncs and commit votes resolve while younger steps are already
+        dispatched — a bounded speculative window that hides up to N
+        control-plane round trips per step (``auto`` sizes N per quorum
+        era from the measured RTT/step ratio). The returned ``committed``
+        flag then reports the verdict of the OLDEST in-flight step
+        resolved during the call — lagging dispatch by up to N steps, None
+        while the window still has room; call :meth:`flush_pipeline` at
+        the loop boundary for the rest. ``TPUFT_STRICT_COMMIT=1``
+        overrides any pipeline depth back to the strict per-step ordering.
         """
         fused = make_jit_fused_step(self.tx, loss_fn)
         grad_fn = jax.jit(jax.value_and_grad(loss_fn))
@@ -853,29 +974,45 @@ class Optimizer:
         self, fused: Any, grad_fn: Any, should_quantize: bool,
         on_quorum: Any, depth: int,
     ):
-        """The pipelined-commit schedule (commit depth 1): per call —
+        """The pipelined-commit schedule (window depth N >= 1): per call —
 
         1. (wire path) speculatively dispatch this step's forward/backward
-           and start staging the gradients to host, BEFORE the previous
-           vote resolves;
-        2. resolve the previous step's commit verdict — confirm, roll the
-           live state back to its pre-step snapshot, or heal-recompute;
-        3. quorum (a membership change drains the pipeline on the quorum
-           thread before the PG reconfigures — see
-           Manager.register_quorum_change_hook);
+           and start staging the gradients to host, BEFORE any older vote
+           resolves;
+        2. resolve just enough of the OLDEST window slots to open one:
+           with the window full, exactly one verdict per call — confirm,
+           roll the live state back to that slot's pre-step snapshot
+           (discarding every younger slot: their speculations chain from
+           the refused one), or heal-recompute (younger slots replay their
+           grads onto the healed state as they resolve in turn);
+        3. quorum (a membership change drains the FULL window on the
+           quorum thread before the PG reconfigures or any donor send —
+           see Manager.register_quorum_change_hook);
         4. dispatch this step and tentatively adopt its speculative
-           (params, opt_state) — the one-step-deep uncommitted window;
-        5. observe the PREVIOUS step's device completion: the readiness
-           round trip rides under THIS step's device execution instead of
+           (params, opt_state) — the window grows to at most depth
+           uncommitted steps;
+        5. observe the resolved slots' device completion: the readiness
+           round trips ride under THIS step's device execution instead of
            serializing after it (the per-step RTT this mode kills);
-        6. vote with this step's device work still in flight.
+        6. vote with this step's device work still in flight — but only
+           AFTER step (N - depth)'s completion was observed in 5, so the
+           phantom-commit envelope is bounded at exactly the window depth.
 
-        The widened envelope vs the overlapped ordering: a post-vote
-        device failure can phantom-commit ONE step (the vote at N observed
-        completion only through N-1). The blast radius is bounded
-        accounting, not divergence — a failure discovered at vote N makes
-        commit N fail quorum-wide, every survivor rolls back N's
-        speculative update identically, and recovery for hard device
+        Depth 1 keeps the single-executor vote path whose FIFO ordering
+        the depth-1 tests pin; depth >= 2 (and adaptive mode at any depth)
+        votes through Manager.speculative_commit_async so the whole
+        window's barrier RPCs overlap on the wire — that overlap is what
+        hides MULTIPLE control-plane round trips per step. In adaptive
+        mode the target depth is re-read from the manager every call, so
+        the controller's per-era re-evaluation (and mid-era deepening)
+        takes effect between steps without rebuilding the step_fn.
+
+        The widened envelope vs the overlapped ordering: post-vote device
+        failures can phantom-commit up to DEPTH steps (vote N observed
+        completion only through N - depth). The blast radius is bounded
+        accounting, not divergence — a failure discovered at a vote makes
+        that commit fail quorum-wide, every survivor unwinds the same
+        suffix of the window identically, and recovery for hard device
         failures is the same supervisor-restart + heal path the
         non-pipelined orderings document.
         """
@@ -886,23 +1023,28 @@ class Optimizer:
 
         if self._pipeline is not None and len(self._pipeline):
             self.flush_pipeline()
-        pipeline = CommitPipeline(depth)
+        manager = self.manager
+        pipeline = CommitPipeline(max(1, depth))
         self._pipeline = pipeline
         if not self._pipeline_hooked:
-            self.manager.register_quorum_change_hook(
+            manager.register_quorum_change_hook(
                 self._drain_pipeline_for_quorum_change
             )
-            self.manager.register_shutdown_hook(
+            manager.register_shutdown_hook(
                 lambda: self.flush_pipeline(raise_on_error=False)
             )
             self._pipeline_hooked = True
-        self._next_pipelined_step = self.manager.current_step()
+        self._next_pipelined_step = manager.current_step()
         was_wire = [False]
+        # Depth 1 static keeps the legacy single-executor vote (its FIFO
+        # ordering is pinned); deeper/adaptive windows vote concurrently.
+        speculative_votes = manager.commit_pipeline_adaptive or depth >= 2
 
         def step_fn(*batch):
-            manager = self.manager
-            # Next-step dispatch before prior-step vote resolution: the
-            # wire path's forward/backward depends only on the (already
+            target_depth = max(1, manager.commit_pipeline_depth)
+            pipeline.set_depth(target_depth)
+            # Next-step dispatch before any vote resolution: the wire
+            # path's forward/backward depends only on the (already
             # adopted, speculative) params, so its device work and d2h
             # staging start under the vote wait + quorum RPC. A rollback
             # or heal below invalidates it — detected by identity on the
@@ -914,10 +1056,28 @@ class Optimizer:
                 early = grad_fn(early_params, *batch)
                 prefetch_gradients(early[1])
 
-            prev = pipeline.oldest()
-            prev_committed = None
-            if prev is not None:
-                prev_committed = self._resolve_pipelined_record(prev)
+            # Resolve the oldest slots until the window has room (plus any
+            # slot a rollback already unwound — zombies consume instantly).
+            stall_t0 = _time.monotonic()
+            first_verdict: Optional[bool] = None
+            to_bound = []
+            while True:
+                rec = pipeline.oldest()
+                if rec is None:
+                    break
+                zombie = (
+                    rec.committed is not None
+                    or rec.gen != self._speculation_gen
+                )
+                if not zombie and len(pipeline) < target_depth:
+                    break
+                verdict = self._resolve_pipelined_record(rec)
+                pipeline.remove(rec)
+                self._note_snapshot(rec, admitted=False)
+                to_bound.append(rec)
+                if first_verdict is None:
+                    first_verdict = verdict
+            vote_stall = _time.monotonic() - stall_t0
 
             self.begin_step()
             if on_quorum is not None:
@@ -948,29 +1108,59 @@ class Optimizer:
                     grads, pre_opt, pre_params, should_quantize
                 )
 
-            # Tentative adoption — the uncommitted one-step window. Write-
-            # locked so a concurrent donor capture never reads a torn pair.
+            # Tentative adoption — one more slot of the uncommitted
+            # window. Write-locked so a concurrent donor capture never
+            # reads a torn pair.
             manager.disallow_state_dict_read()
             try:
                 self.params, self.opt_state = spec
             finally:
                 manager.allow_state_dict_read()
-            self._next_pipelined_step = manager.current_step() + 1
+            # Claim the step this slot speculates: committed + in-flight.
+            # Count only UNRESOLVED slots — the quorum-thread drain
+            # resolves records in place without removing them (the train
+            # loop still observes each verdict), so raw occupancy can
+            # overcount right after a membership change.
+            claimed_step = manager.current_step() + sum(
+                1 for r in pipeline.pending() if r.committed is None
+            )
+            self._next_pipelined_step = claimed_step + 1
 
-            if prev is not None:
-                pipeline.remove(prev)
-                prev.bound_device(raise_on_error=True)
+            # Observe the resolved slots' device completion BEFORE this
+            # step's vote leaves: the envelope invariant — vote N is sent
+            # only after step (N - depth)'s completion was observed. The
+            # sync rides under this step's (already dispatched) execution.
+            stall_t0 = _time.monotonic()
+            for done_rec in to_bound:
+                done_rec.bound_device(raise_on_error=True)
+            manager.observe_pipeline_step(
+                vote_stall + (_time.monotonic() - stall_t0)
+            )
 
+            if speculative_votes:
+                commit_future = manager.speculative_commit_async(claimed_step)
+            else:
+                commit_future = manager.should_commit_async(None)
             rec = _PendingStep(
                 manager=manager,
                 heal_count=heal_count,
                 loss=loss,
                 snapshot=(pre_params, pre_opt),
                 recompute=recompute,
-                commit_future=manager.should_commit_async(None),
+                commit_future=commit_future,
+                gen=self._speculation_gen,
+                claimed_step=claimed_step,
+                snapshot_bytes=self._snapshot_nbytes((pre_params, pre_opt)),
             )
             pipeline.push(rec)
-            return loss, prev_committed
+            self._note_snapshot(rec, admitted=True)
+            _trace_of(manager).record(
+                "speculate",
+                step=claimed_step,
+                window=len(pipeline),
+                depth=target_depth,
+            )
+            return loss, first_verdict
 
         return step_fn
 
