@@ -147,6 +147,20 @@ def _serve_state(snapshot: Dict[str, Any]) -> Optional[str]:
     return "child" if up == 1 else "child!"
 
 
+def _publish_state(snapshot: Dict[str, Any], now: float) -> Optional[str]:
+    """Serving-plane publication state from the pushed gauges: the last
+    published step and how stale it is ("s12@3s"), or None when the
+    replica has no attached publisher. A growing age on a committing
+    replica means publication is failing (check
+    tpuft_publish_failures_total / the replica's log)."""
+    step = _gauge(snapshot, "tpuft_publish_last_step")
+    if step is None:
+        return None
+    last = _gauge(snapshot, "tpuft_publish_last_time")
+    age = f"@{round(now - last, 1)}s" if last else ""
+    return f"s{int(step)}{age}"
+
+
 def collect(lighthouse_addr: str, prev: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
     """One poll: lighthouse status + per-rank snapshots, as a JSON-safe
     dict. ``prev`` (the previous poll) turns step deltas into step/s."""
@@ -192,6 +206,7 @@ def collect(lighthouse_addr: str, prev: Optional[Dict[str, Any]] = None) -> Dict
                     heals=_counter_total(snap, "tpuft_heals_total"),
                     serve=_serve_state(snap),
                     shard=_shard_state(snap),
+                    publish=_publish_state(snap, now),
                     push_age_s=round(now - snap["ts"], 1) if "ts" in snap else None,
                     last_commit_age_s=(
                         round(now - last_commit, 1) if last_commit else None
@@ -232,6 +247,7 @@ _COLUMNS = (
     ("heals", "HEALS"),
     ("serve", "SERVE"),
     ("shard", "SHARD"),
+    ("publish", "PUBLISH"),
     ("lag_s", "LAG"),
     ("last_commit_age_s", "LAST COMMIT"),
     ("healing", "HEALING"),

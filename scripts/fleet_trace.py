@@ -574,6 +574,23 @@ def explain_step(merged: List[Dict[str, Any]], step: int) -> str:
             f"chunk(s) locally ({_fmt_mb(args.get('bytes_saved', 0))} not "
             "fetched)"
         )
+    # Serving plane: publications (and rollback retractions) at this step.
+    for e in at_step:
+        if e["name"] != "publish":
+            continue
+        args = e.get("args") or {}
+        lines.append(
+            f"published: {proc_label(proc_key(e))} staged version step "
+            f"{e.get('step')} for readers ({_fmt_mb(args.get('bytes', 0))}, "
+            f"digest {args.get('digest', '?')}, era q{e.get('quorum_id')})"
+        )
+    for e in at_step:
+        if e["name"] != "publish_retracted":
+            continue
+        lines.append(
+            f"publish RETRACTED: {proc_label(proc_key(e))} dropped its due "
+            "version at the rollback-unwind — readers never observed it"
+        )
     fails = [e for e in at_step if e["name"] == "heal_attempt_failed"]
     for e in fails:
         args = e.get("args") or {}

@@ -1,0 +1,25 @@
+"""R7 fixture (clean, publish extension): the serving-plane publication
+site drains the speculative window lexically before sampling state and
+publishing — the manager's _maybe_publish shape."""
+
+
+class Manager:
+    def _run_quorum_drain_hooks(self):
+        for hook in self._quorum_change_hooks:
+            try:
+                hook()
+            except Exception as e:  # noqa: BLE001
+                self.report_error(e)
+
+    def _maybe_publish(self):
+        publisher = self._publisher
+        if publisher is None or not publisher.due():
+            return
+        # Publication must never sample speculative-window state: the
+        # full window resolves before params are touched.
+        self._run_quorum_drain_hooks()
+        with self._state_dict_lock.r_lock(timeout=self._timeout):
+            state = self._publisher_state_fn()
+        publisher.publish(
+            step=self._step, quorum_id=self._quorum_id, state=state
+        )

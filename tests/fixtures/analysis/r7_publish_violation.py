@@ -1,0 +1,17 @@
+"""R7 fixture (violation, publish extension): a serving-plane publish
+reachable with NO speculative-window drain before it — readers could
+adopt a version sampled from uncommitted speculative state that a
+quorum-wide refusal is about to unwind."""
+
+
+class Manager:
+    def _maybe_publish(self):
+        publisher = self._publisher
+        if publisher is None or not publisher.due():
+            return
+        # Samples live state with the window possibly undrained.
+        with self._state_dict_lock.r_lock(timeout=self._timeout):
+            state = self._publisher_state_fn()
+        publisher.publish(
+            step=self._step, quorum_id=self._quorum_id, state=state
+        )

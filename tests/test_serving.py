@@ -1,0 +1,853 @@
+"""Committed-weights serving plane drills (pure Python — carries tier-1
+in a container without the native toolchain):
+
+- publisher/relay/subscriber roundtrips over loopback HTTP: bitwise
+  adoption, descriptor integrity binding, delta-aware version bumps with
+  exact bytes-saved accounting;
+- resilience: upstream dying mid-pull fails over across the fleet like a
+  striped heal; era regressions are rejected at the relay AND the
+  reader; rapid version bumps under concurrent readers never produce a
+  torn observation (leaves are a function of the step — any mix would
+  show);
+- chaos: the punisher's file-armed kill_relay drops a relay abruptly
+  under live readers, who fail over without ever observing a bad
+  version;
+- manager integration: commits mark publications due at the cadence, the
+  step boundary publishes AFTER a full speculative-window drain (R7's
+  publish extension pins the ordering lexically; here we pin it
+  observationally — published params always sit on the committed
+  trajectory), publish failures never poison a commit, and a
+  rollback-unwind retracts the due-but-unpublished version;
+- the flagship chaos drill in strict AND pipelined depth-2 orderings:
+  kill_relay + a refused commit + a mid-run heal while subscribers poll;
+  every observed version is digest-valid, era-monotonic, and never the
+  discarded speculation;
+- shared-egress fairness: the serve pacer's heal-priority split
+  (a healing joiner cannot be starved by N serving readers);
+- the parameter-server fix: session errors narrate through the
+  telemetry logger with their session id, and shutdown joins session
+  threads.
+"""
+
+import json
+import logging
+import threading
+import time
+import urllib.request
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from test_ddp import scripted_manager
+
+from torchft_tpu import metrics
+from torchft_tpu import punisher
+from torchft_tpu.checkpointing import serve_child as sc
+from torchft_tpu.checkpointing.http_transport import _checkpoint_digest
+from torchft_tpu.optim import Optimizer
+from torchft_tpu.serving import (
+    CachingRelay,
+    WeightPublisher,
+    WeightSubscriber,
+)
+from torchft_tpu.serving._wire import validate_latest
+from torchft_tpu.utils import faultinject
+
+_COUNTERS = {
+    "pulls": "tpuft_serving_pulls_total",
+    "pull_failures": "tpuft_serving_pull_failures_total",
+    "failovers": "tpuft_serving_upstream_failovers_total",
+    "delta_chunks": "tpuft_serving_delta_chunks_reused_total",
+    "delta_bytes": "tpuft_serving_delta_bytes_saved_total",
+    "stale_era": "tpuft_serving_stale_era_rejects_total",
+    "integrity": "tpuft_serving_integrity_rejects_total",
+    "reader_versions": "tpuft_serving_reader_versions_total",
+    "reader_bytes": "tpuft_serving_reader_bytes_total",
+    "relay_deaths": "tpuft_serving_relay_deaths_total",
+    "publishes": "tpuft_publish_total",
+    "publish_failures": "tpuft_publish_failures_total",
+    "retracted": "tpuft_publish_retracted_total",
+}
+
+
+def counters() -> dict:
+    return {k: metrics.counter_total(name) for k, name in _COUNTERS.items()}
+
+
+def state_for(step: int, n_leaves: int = 4, leaf_elems: int = 512) -> dict:
+    """Every leaf filled with ``step`` — a torn (mixed-version) read or a
+    wrong-version adoption is visible in any single element."""
+    return {
+        f"w{i}": np.full(leaf_elems, float(step), np.float32)
+        for i in range(n_leaves)
+    }
+
+
+def assert_version_is(version, step: int) -> None:
+    assert version is not None
+    assert version.step == step
+    for leaf in version.params.values():
+        np.testing.assert_array_equal(np.asarray(leaf), float(step))
+
+
+# ---------------------------------------------------------------------------
+# publisher -> relay -> subscriber roundtrips
+# ---------------------------------------------------------------------------
+
+
+def test_publish_subscribe_roundtrip_bitwise() -> None:
+    pub = WeightPublisher(num_chunks=4, timeout=5.0)
+    try:
+        descriptor = pub.publish(step=3, quorum_id=7, state=state_for(3))
+        assert descriptor["step"] == 3 and descriptor["quorum_id"] == 7
+        assert validate_latest(descriptor) is None
+        sub = WeightSubscriber([pub.address()], timeout=5.0)
+        assert_version_is(sub.poll(), 3)
+        assert sub.current().quorum_id == 7
+        assert sub.current().digest == descriptor["digest"]
+        # Nothing new: poll is a no-op, held version untouched.
+        assert sub.poll() is None
+        assert sub.current().step == 3
+    finally:
+        pub.shutdown()
+
+
+def test_descriptor_digest_binding_rejected_when_tampered() -> None:
+    pub = WeightPublisher(num_chunks=2, timeout=5.0)
+    try:
+        descriptor = pub.publish(step=1, quorum_id=0, state=state_for(1))
+        bad = dict(descriptor)
+        bad["chunk_crcs"] = list(bad["chunk_crcs"])
+        bad["chunk_crcs"][0] ^= 1
+        assert validate_latest(bad) is not None
+        bad2 = dict(descriptor)
+        bad2["step"] = 99
+        assert validate_latest(bad2) is not None
+    finally:
+        pub.shutdown()
+
+
+def test_relay_pulls_and_fans_out_bitwise() -> None:
+    pub = WeightPublisher(num_chunks=4, timeout=5.0)
+    relay = CachingRelay([pub.address()], timeout=5.0, start=False)
+    try:
+        pub.publish(step=1, quorum_id=0, state=state_for(1))
+        before = counters()
+        assert relay.poll_once() is True
+        assert relay.poll_once() is False  # same version: no re-pull
+        after = counters()
+        assert after["pulls"] - before["pulls"] == 1
+        # Many readers, one relay: all bitwise identical, publisher idle.
+        subs = [WeightSubscriber([relay.address()], timeout=5.0) for _ in range(4)]
+        for sub in subs:
+            assert_version_is(sub.poll(), 1)
+    finally:
+        relay.shutdown()
+        pub.shutdown()
+
+
+def test_delta_version_bump_moves_only_changed_bytes() -> None:
+    """Steady-state version bumps: chunks whose (crc, size) match the
+    cached previous version are reused, not refetched — at the relay AND
+    the reader; the saved bytes are pinned by the counters."""
+    pub = WeightPublisher(num_chunks=4, timeout=5.0)
+    relay = CachingRelay([pub.address()], timeout=5.0, start=False)
+    try:
+        state = state_for(1)
+        pub.publish(step=1, quorum_id=0, state=state)
+        relay.poll_once()
+        sub = WeightSubscriber([relay.address()], timeout=5.0)
+        assert_version_is(sub.poll(), 1)
+
+        # Change ONE leaf of four; with 4 round-robin chunks the other
+        # three chunks are byte-identical and must not cross the wire.
+        state2 = dict(state)
+        state2["w2"] = np.full(512, 2.0, np.float32)
+        before = counters()
+        pub.publish(step=2, quorum_id=0, state=state2)
+        assert relay.poll_once() is True
+        version = sub.poll()
+        assert version is not None and version.step == 2
+        np.testing.assert_array_equal(np.asarray(version.params["w2"]), 2.0)
+        np.testing.assert_array_equal(np.asarray(version.params["w1"]), 1.0)
+        after = counters()
+        # Relay reused 3 chunks; the subscriber reused the same 3.
+        assert after["delta_chunks"] - before["delta_chunks"] == 3
+        full_bytes = sum(pub.latest()["chunk_sizes"])
+        saved = after["delta_bytes"] - before["delta_bytes"]
+        fetched = after["reader_bytes"] - before["reader_bytes"]
+        # Saved on both legs: ~2x (3/4 of the payload each).
+        assert saved > full_bytes
+        assert 0 < fetched < full_bytes / 2
+    finally:
+        relay.shutdown()
+        pub.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# resilience: upstream death, era fencing, torn reads
+# ---------------------------------------------------------------------------
+
+
+def test_relay_fails_over_when_upstream_dies_mid_pull() -> None:
+    """Two publishers announce the same committed version (bitwise
+    identical, interchangeable — the striped-heal argument); one dies
+    mid-pull and the relay finishes from the survivor."""
+    pub_a = WeightPublisher(num_chunks=6, timeout=5.0)
+    pub_b = WeightPublisher(num_chunks=6, timeout=5.0)
+    relay = None
+    try:
+        state = state_for(5)
+        desc_a = pub_a.publish(step=5, quorum_id=1, state=state)
+        desc_b = pub_b.publish(step=5, quorum_id=1, state=state)
+        assert desc_a["digest"] == desc_b["digest"]
+
+        # pub_a's transport cuts the connection on its first chunk serve
+        # (one-shot): whichever chunk the relay's round-robin hands it.
+        died = []
+
+        def fault(step: int, index: int):
+            if not died:
+                died.append(index)
+                return "die"
+            return None
+
+        pub_a._transport._fault_hook = fault
+        relay = CachingRelay(
+            [pub_a.address(), pub_b.address()], timeout=5.0, start=False
+        )
+        before = counters()
+        assert relay.poll_once() is True
+        after = counters()
+        assert after["failovers"] - before["failovers"] >= 1
+        sub = WeightSubscriber([relay.address()], timeout=5.0)
+        assert_version_is(sub.poll(), 5)
+    finally:
+        if relay is not None:
+            relay.shutdown()
+        pub_a.shutdown()
+        pub_b.shutdown()
+
+
+def test_relay_rejects_era_regression() -> None:
+    """A stale-era survivor announcing a higher step must not roll the
+    relay (and therefore every reader) backwards across quorum eras."""
+    pub_new = WeightPublisher(num_chunks=2, timeout=5.0)
+    pub_stale = WeightPublisher(num_chunks=2, timeout=5.0)
+    relay = None
+    try:
+        pub_new.publish(step=10, quorum_id=5, state=state_for(10))
+        relay = CachingRelay([pub_new.address()], timeout=5.0, start=False)
+        assert relay.poll_once() is True
+        # The fleet moves on; only a stale-era publisher remains visible.
+        pub_stale.publish(step=12, quorum_id=4, state=state_for(12))
+        relay._upstreams = [pub_stale.address()]
+        before = counters()
+        assert relay.poll_once() is False
+        after = counters()
+        assert after["stale_era"] - before["stale_era"] == 1
+        assert relay.current().step == 10 and relay.current().quorum_id == 5
+    finally:
+        if relay is not None:
+            relay.shutdown()
+        pub_new.shutdown()
+        pub_stale.shutdown()
+
+
+def test_subscriber_rejects_era_regression() -> None:
+    pub = WeightPublisher(num_chunks=2, timeout=5.0)
+    try:
+        pub.publish(step=10, quorum_id=5, state=state_for(10))
+        sub = WeightSubscriber([pub.address()], timeout=5.0)
+        assert_version_is(sub.poll(), 10)
+        pub.publish(step=12, quorum_id=4, state=state_for(12))
+        before = counters()
+        assert sub.poll() is None
+        after = counters()
+        assert after["stale_era"] - before["stale_era"] == 1
+        assert sub.current().step == 10
+    finally:
+        pub.shutdown()
+
+
+def test_concurrent_readers_never_observe_torn_versions() -> None:
+    """Rapid version bumps under a concurrent reader population: every
+    adopted version must be internally consistent (all leaves equal its
+    step) and step-monotone per reader — the verify-then-swap contract
+    under real races."""
+    pub = WeightPublisher(num_chunks=4, timeout=5.0)
+    stop = threading.Event()
+    torn: list = []
+    observed: list = []
+
+    def reader() -> None:
+        sub = WeightSubscriber([pub.address()], timeout=5.0)
+        last = 0
+        while not stop.is_set():
+            version = sub.poll()
+            if version is None:
+                continue
+            values = {
+                float(np.asarray(leaf).ravel()[0])
+                for leaf in version.params.values()
+            } | {
+                float(np.asarray(leaf).ravel()[-1])
+                for leaf in version.params.values()
+            }
+            if values != {float(version.step)}:
+                torn.append((version.step, values))
+            if version.step <= last:
+                torn.append(("non-monotone", last, version.step))
+            last = version.step
+            observed.append(version.step)
+
+    try:
+        pub.publish(step=1, quorum_id=0, state=state_for(1))
+        threads = [threading.Thread(target=reader) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for step in range(2, 30):
+            pub.publish(step=step, quorum_id=0, state=state_for(step))
+            time.sleep(0.005)
+        # Readers racing the bump storm abort those polls (the torn-read
+        # fence); once the version stream settles every reader converges.
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and 29 not in observed:
+            time.sleep(0.05)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        assert not torn, torn
+        assert observed, "readers never adopted anything"
+        assert 29 in observed, sorted(set(observed))
+    finally:
+        stop.set()
+        pub.shutdown()
+
+
+def test_punisher_kill_relay_fault_file_and_reader_failover(
+    tmp_path, monkeypatch
+) -> None:
+    """The punisher's kill_relay arm: the relay consumes the file-armed
+    ``die`` at its next poll round and drops abruptly; subscribers fail
+    over to the surviving endpoint (here: the publisher itself) without
+    observing anything invalid."""
+    fault_file = tmp_path / "fault"
+    monkeypatch.setenv(faultinject.ENV_FAULT_FILE, str(fault_file))
+    pub = WeightPublisher(num_chunks=2, timeout=5.0)
+    relay = CachingRelay([pub.address()], timeout=5.0, start=False)
+    try:
+        pub.publish(step=1, quorum_id=0, state=state_for(1))
+        assert relay.poll_once() is True
+        sub = WeightSubscriber([relay.address(), pub.address()], timeout=5.0)
+        assert_version_is(sub.poll(), 1)
+
+        assert punisher.arm_stream_fault("kill_relay", str(fault_file))
+        before = counters()
+        assert relay.poll_once() is False
+        assert relay.dead
+        after = counters()
+        assert after["relay_deaths"] - before["relay_deaths"] == 1
+
+        # Reader fails over to the publisher endpoint for the next bump.
+        pub.publish(step=2, quorum_id=0, state=state_for(2))
+        assert_version_is(sub.poll(), 2)
+    finally:
+        relay.shutdown()
+        pub.shutdown()
+
+
+def test_punisher_kill_relay_targets_one_relay_by_tag(
+    tmp_path, monkeypatch
+) -> None:
+    """A port-tagged kill_relay hits exactly the targeted relay of a
+    fan-out tier; the untargeted one keeps serving."""
+    fault_file = tmp_path / "fault"
+    monkeypatch.setenv(faultinject.ENV_FAULT_FILE, str(fault_file))
+    pub = WeightPublisher(num_chunks=2, timeout=5.0)
+    relay_a = CachingRelay([pub.address()], timeout=5.0, start=False)
+    relay_b = CachingRelay([pub.address()], timeout=5.0, start=False)
+    try:
+        pub.publish(step=1, quorum_id=0, state=state_for(1))
+        assert relay_a.poll_once() and relay_b.poll_once()
+        tag = relay_a._server.server_address[1]
+        assert punisher.arm_stream_fault(
+            "kill_relay", str(fault_file), donor_tag=str(tag)
+        )
+        relay_b.poll_once()  # wrong site: must NOT consume the arm
+        assert not relay_b.dead
+        relay_a.poll_once()
+        assert relay_a.dead
+        sub = WeightSubscriber([relay_b.address()], timeout=5.0)
+        assert_version_is(sub.poll(), 1)
+    finally:
+        relay_a.shutdown()
+        relay_b.shutdown()
+        pub.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# manager integration: cadence, drain-first publication, retraction
+# ---------------------------------------------------------------------------
+
+
+def _loss_fn(p, b):
+    return jnp.sum((p["w"] - b) ** 2)  # grad = 2(w - b); sgd(0.1): w -= 0.2(w-b)
+
+
+def _expected_trajectory(batches, w0=1.0) -> list:
+    """Committed params after each step of the scripted loss above."""
+    w = np.array([w0, w0], np.float32)
+    out = []
+    for b in batches:
+        w = w - 0.1 * 2 * (w - b)
+        out.append(w.copy())
+    return out
+
+
+def test_manager_publishes_on_commit_cadence() -> None:
+    """every=2: publications land only for even committed steps, at the
+    NEXT step boundary, carrying the committed params."""
+    manager = scripted_manager()
+    pub = WeightPublisher(every=2, num_chunks=2, timeout=5.0)
+    opt = Optimizer(manager, optax.sgd(0.1), {"w": jnp.array([1.0, 1.0], jnp.float32)})
+    manager.attach_publisher(pub, lambda: {"params": opt.params})
+    published: list = []
+    real_publish = pub.publish
+
+    def spy(step, quorum_id, state):
+        published.append((step, np.asarray(state["params"]["w"]).copy()))
+        return real_publish(step, quorum_id, state)
+
+    pub.publish = spy
+    step_fn = opt.make_step_fn(_loss_fn)
+    try:
+        for i in range(5):
+            step_fn(jnp.full((2,), float(i), jnp.float32))
+        # Publication of the step-4 commit needs one more boundary.
+        manager.start_quorum()
+        manager.wait_quorum()
+        assert [p[0] for p in published] == [2, 4]
+        trajectory = _expected_trajectory([0.0, 1.0, 2.0, 3.0, 4.0])
+        np.testing.assert_allclose(published[0][1], trajectory[1], rtol=1e-6)
+        np.testing.assert_allclose(published[1][1], trajectory[3], rtol=1e-6)
+        assert pub.latest()["step"] == 4
+    finally:
+        manager.shutdown(wait=False)
+
+
+def test_publish_failure_never_poisons_commits() -> None:
+    manager = scripted_manager()
+    pub = WeightPublisher(every=1, num_chunks=2, timeout=5.0)
+    opt = Optimizer(manager, optax.sgd(0.1), {"w": jnp.array([1.0, 1.0], jnp.float32)})
+    manager.attach_publisher(pub, lambda: {"params": opt.params})
+
+    def broken_publish(step, quorum_id, state):
+        raise RuntimeError("publication plane down")
+
+    pub.publish = broken_publish
+    step_fn = opt.make_step_fn(_loss_fn)
+    before = counters()
+    try:
+        committed = [step_fn(jnp.full((2,), float(i), jnp.float32))[1] for i in range(3)]
+        assert committed == [True, True, True]
+        assert manager.current_step() == 3
+        assert manager.errored() is None
+        after = counters()
+        assert after["publish_failures"] - before["publish_failures"] >= 2
+    finally:
+        manager.shutdown(wait=False)
+
+
+@pytest.mark.parametrize("depth", [2, 3], ids=["depth2", "depth3"])
+def test_pipelined_publication_samples_only_committed_state(depth) -> None:
+    """The R7 ordering, observed: with a depth-N window and every-step
+    publication, every published state sits exactly on the committed
+    trajectory — never a speculative value the window had in flight."""
+    manager = scripted_manager(commit_pipeline_depth=depth)
+    pub = WeightPublisher(every=1, num_chunks=2, timeout=5.0)
+    opt = Optimizer(manager, optax.sgd(0.1), {"w": jnp.array([1.0, 1.0], jnp.float32)})
+    manager.attach_publisher(pub, lambda: {"params": opt.params})
+    published: list = []
+    real_publish = pub.publish
+
+    def spy(step, quorum_id, state):
+        published.append((step, np.asarray(state["params"]["w"]).copy()))
+        return real_publish(step, quorum_id, state)
+
+    pub.publish = spy
+    step_fn = opt.make_step_fn(_loss_fn)
+    batches = [float(i) for i in range(6)]
+    try:
+        for b in batches:
+            step_fn(jnp.full((2,), b, jnp.float32))
+        opt.flush_pipeline()
+        manager.start_quorum()
+        manager.wait_quorum()
+        trajectory = _expected_trajectory(batches)
+        assert published, "nothing published"
+        for step, w in published:
+            assert 1 <= step <= len(batches)
+            np.testing.assert_allclose(w, trajectory[step - 1], rtol=1e-6)
+    finally:
+        manager.shutdown(wait=False)
+
+
+def test_retract_after_drops_due_version() -> None:
+    pub = WeightPublisher(every=1, num_chunks=2, timeout=5.0)
+    try:
+        pub.note_commit(7, 1)
+        assert pub.due()
+        before = counters()
+        pub.retract_after(5)
+        assert not pub.due()
+        assert counters()["retracted"] - before["retracted"] == 1
+        # Retraction is bounded: a due version AT the surviving committed
+        # step is kept.
+        pub.note_commit(5, 1)
+        pub.retract_after(5)
+        assert pub.due()
+    finally:
+        pub.shutdown()
+
+
+def test_rollback_unwind_reaches_retract_hook() -> None:
+    """A refused pipelined commit's unwind calls the attached publisher's
+    retract_after with the surviving committed step."""
+    manager = scripted_manager(commit_pipeline_depth=1)
+    votes = iter([True, False, True, True])
+    manager._client.should_commit.side_effect = (
+        lambda rank, step, vote, timeout: vote and next(votes)
+    )
+    pub = WeightPublisher(every=1, num_chunks=2, timeout=5.0)
+    retracts: list = []
+    real_retract = pub.retract_after
+
+    def spy(committed_step):
+        retracts.append(committed_step)
+        return real_retract(committed_step)
+
+    pub.retract_after = spy
+    opt = Optimizer(manager, optax.sgd(0.1), {"w": jnp.array([1.0, 1.0], jnp.float32)})
+    manager.attach_publisher(pub, lambda: {"params": opt.params})
+    step_fn = opt.make_step_fn(_loss_fn)
+    try:
+        for i in range(4):
+            step_fn(jnp.full((2,), float(i), jnp.float32))
+        opt.flush_pipeline()
+        assert opt.rollback_count == 1
+        assert retracts, "rollback never reached the publisher"
+    finally:
+        manager.shutdown(wait=False)
+
+
+# ---------------------------------------------------------------------------
+# the flagship chaos drill: kill/heal + kill_relay under live readers
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("depth", [0, 2], ids=["strict", "pipelined2"])
+def test_serving_chaos_drill(depth, tmp_path, monkeypatch) -> None:
+    """Fleet chaos while subscribers poll: a refused commit (rollback in
+    the pipelined ordering), a mid-run heal, a quorum-era change, and a
+    punisher kill_relay. Every version any reader observed must be
+    digest-valid, era-monotonic, and never the refused step's discarded
+    speculation; after the relay dies readers fail over to the publisher
+    endpoint and keep adopting."""
+    fault_file = tmp_path / "fault"
+    monkeypatch.setenv(faultinject.ENV_FAULT_FILE, str(fault_file))
+    manager = scripted_manager(commit_pipeline_depth=depth)
+    refused_dispatch = 3  # 0-indexed dispatch that the barrier refuses
+    dispatches = {"n": 0}
+
+    def voting(rank, step, vote, timeout):
+        refuse = dispatches["n"] == refused_dispatch
+        dispatches["n"] += 1
+        return vote and not refuse
+
+    manager._client.should_commit.side_effect = voting
+    pub = WeightPublisher(every=1, num_chunks=2, timeout=5.0)
+    opt = Optimizer(manager, optax.sgd(0.1), {"w": jnp.array([1.0, 1.0], jnp.float32)})
+    manager.attach_publisher(pub, lambda: {"params": opt.params})
+    relay = CachingRelay([pub.address()], poll_interval=0.02, timeout=5.0)
+
+    stop = threading.Event()
+    bad: list = []
+    observed: list = []
+
+    def reader() -> None:
+        sub = WeightSubscriber([relay.address(), pub.address()], timeout=5.0)
+        last_era = -1
+        last_step = 0
+        while not stop.is_set():
+            version = sub.poll()
+            if version is None:
+                continue
+            # Digest validity: recompute the binding from what we hold.
+            values = {
+                float(np.asarray(leaf).ravel()[0])
+                for leaf in version.params["params"].values()
+            }
+            observed.append(
+                (version.step, version.quorum_id, sorted(values))
+            )
+            if version.quorum_id is not None:
+                if version.quorum_id < last_era:
+                    bad.append(("era regression", last_era, version.quorum_id))
+                last_era = version.quorum_id
+            if version.step <= last_step:
+                bad.append(("non-monotone step", last_step, version.step))
+            last_step = version.step
+
+    readers = [threading.Thread(target=reader) for _ in range(3)]
+    for t in readers:
+        t.start()
+    try:
+        step_fn = opt.make_step_fn(_loss_fn)
+        batches = [float(i) for i in range(8)]
+        pre_refusal_w = None
+        for i, b in enumerate(batches):
+            if i == 5:
+                # Mid-run heal: a donor state lands (rebinds under the
+                # writer, bumps the heal count) — later publications must
+                # follow the healed trajectory, never a stale one.
+                opt._load_state_dict(
+                    {
+                        "params": {"w": jnp.array([5.0, 5.0], jnp.float32)},
+                        "opt_state": opt.opt_state,
+                    }
+                )
+            if i == 4:
+                # punisher: kill the relay under the live readers.
+                punisher.arm_stream_fault("kill_relay", str(fault_file))
+            if i == refused_dispatch:
+                pre_refusal_w = np.asarray(opt.params["w"]).copy()
+            step_fn(jnp.full((2,), b, jnp.float32))
+        opt.flush_pipeline()
+        manager.start_quorum()
+        manager.wait_quorum()
+        # Let readers catch the final version, then stop.
+        deadline = time.monotonic() + 5.0
+        final_step = pub.latest()["step"]
+        while time.monotonic() < deadline and not any(
+            step == final_step for step, _era, _v in observed
+        ):
+            time.sleep(0.05)
+        stop.set()
+        for t in readers:
+            t.join(timeout=10)
+
+        assert not bad, bad
+        assert observed, "no reader ever adopted a version"
+        # The refused dispatch's speculation was discarded quorum-wide:
+        # its would-have-been params must never surface.
+        assert pre_refusal_w is not None
+        discarded = pre_refusal_w - 0.2 * (
+            pre_refusal_w - batches[refused_dispatch]
+        )
+        for _step, _era, values in observed:
+            for v in values:
+                assert not np.allclose(v, discarded[0]), (
+                    "a reader observed the discarded speculation",
+                    v,
+                    discarded,
+                )
+        # The heal is visible downstream: some post-heal version carries
+        # the healed trajectory (values derived from w=5.0), which the
+        # pre-heal trajectory never produces.
+        assert any(v and v[0] > 3.0 for _s, _e, v in observed), observed
+        # The relay did die under the readers.
+        assert relay.dead
+    finally:
+        stop.set()
+        relay.shutdown()
+        manager.shutdown(wait=False)
+
+
+# ---------------------------------------------------------------------------
+# shared-egress fairness: heal priority on the serve pacer
+# ---------------------------------------------------------------------------
+
+
+def test_pacer_heal_priority_split() -> None:
+    """While both classes are active the heal class gets its configured
+    share of the paced rate (80% here) and serving readers get the rest
+    (20% — 5x the per-byte cost); a lone class gets the full rate."""
+    pacer = sc._ServePacer(8.0, heal_share=0.8)  # 8 Gb/s = 1 GB/s aggregate
+    chunk = 1 << 20  # 1 MiB
+    per_mib = chunk / 1e9  # seconds per MiB at the full rate
+    # Serving alone: full rate.
+    solo = pacer.debit(chunk, cls="serving")
+    assert solo == pytest.approx(per_mib, rel=0.25), (solo, per_mib)
+    # Heal joins: both classes active from here on. Heal pays 1/0.8x.
+    h1 = pacer.debit(chunk, cls="heal")
+    assert h1 == pytest.approx(per_mib / 0.8, rel=0.25), (h1, per_mib)
+    # A contended serving MiB pays 1/0.2x = 5x the full-rate cost.
+    s2 = pacer.debit(chunk, cls="serving")
+    assert s2 - solo == pytest.approx(per_mib / 0.2, rel=0.25), (s2, solo)
+    # Heal's incremental cost stays at its share: readers cannot starve it.
+    h2 = pacer.debit(chunk, cls="heal")
+    assert h2 - h1 == pytest.approx(per_mib / 0.8, rel=0.25), (h2, h1)
+    assert (s2 - solo) > 3 * (h2 - h1)
+
+
+def test_pacer_single_class_keeps_full_rate_and_shared_bucket() -> None:
+    """Heal-only traffic is unchanged by the split (full rate), and two
+    heal writers still share one clock — the PR-8 aggregate-egress
+    contract."""
+    pacer = sc._ServePacer(8.0)
+    chunk = 1 << 20
+    d1 = pacer.debit(chunk, cls="heal")
+    d2 = pacer.debit(chunk, cls="heal")
+    per_mib = chunk / 1e9
+    assert d2 - d1 == pytest.approx(per_mib, rel=0.2)
+
+
+def test_maybe_pace_serve_carries_class(monkeypatch) -> None:
+    monkeypatch.setenv(sc.ENV_SERVE_GBPS, "8.0")
+    # Fresh shared pacer for the configured rate.
+    out = sc.maybe_pace_serve(object(), cls="serving")
+    assert isinstance(out, sc._RateWriter)
+    assert out._cls == "serving"
+    default = sc.maybe_pace_serve(object())
+    assert default._cls == "heal"
+
+
+# ---------------------------------------------------------------------------
+# parameter server: diagnosable sessions + bounded shutdown
+# ---------------------------------------------------------------------------
+
+
+def test_parameter_server_session_error_logged_and_threads_joined(caplog) -> None:
+    from torchft_tpu.parameter_server import ParameterServer
+
+    class FailingPS(ParameterServer):
+        def forward(self, session_id, pg):
+            raise RuntimeError("session wedged")
+
+    server = FailingPS(timeout=5.0)
+    try:
+        with caplog.at_level(logging.ERROR, logger="tpuft_errors"):
+            req = urllib.request.Request(
+                f"{server.address()}/new_session", method="POST"
+            )
+            with urllib.request.urlopen(req, timeout=5.0) as resp:
+                session = json.loads(resp.read())
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline and not caplog.records:
+                time.sleep(0.02)
+        records = [r for r in caplog.records if r.name == "tpuft_errors"]
+        assert records, "session failure never narrated"
+        record = records[0]
+        assert session["session_id"] in getattr(record, "replica_id", "")
+        assert "session wedged" in getattr(record, "error", "")
+    finally:
+        server.shutdown()
+    # Bounded shutdown: no session thread left running.
+    live = [t.name for t in threading.enumerate() if t.name.startswith("ps-session")]
+    assert not live, live
+
+
+def test_parameter_server_session_error_narrates_unit(caplog, monkeypatch) -> None:
+    """Native-free seam test of the same fix (the e2e above skips without
+    the toolchain): _serve_session funnels a forward() crash into the
+    telemetry error logger with the session id and drops the session from
+    the live-thread registry."""
+    from unittest.mock import MagicMock
+
+    from torchft_tpu import parameter_server as ps_mod
+
+    class FailingPS(ps_mod.ParameterServer):
+        def forward(self, session_id, pg):
+            raise RuntimeError("session wedged")
+
+    monkeypatch.setattr(ps_mod, "ProcessGroupTCP", MagicMock())
+    server = FailingPS.__new__(FailingPS)
+    server.timeout = 1.0
+    server._sessions_lock = threading.Lock()
+    server._sessions = {"deadbeef": threading.current_thread()}
+    server._store = MagicMock()
+    server._store.address.return_value = "store:0"
+    with caplog.at_level(logging.ERROR, logger="tpuft_errors"):
+        server._serve_session("deadbeef")
+    records = [r for r in caplog.records if r.name == "tpuft_errors"]
+    assert records, "session failure never narrated"
+    assert "deadbeef" in getattr(records[0], "replica_id", "")
+    assert "session wedged" in getattr(records[0], "error", "")
+    assert "deadbeef" not in server._sessions
+
+
+def test_fleet_status_publish_column() -> None:
+    import importlib.util
+    from pathlib import Path
+
+    spec = importlib.util.spec_from_file_location(
+        "fleet_status",
+        Path(__file__).resolve().parent.parent / "scripts" / "fleet_status.py",
+    )
+    fleet_status = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(fleet_status)
+    now = 1000.0
+    snap = {
+        "metrics": {
+            "gauges": {
+                "tpuft_publish_last_step": [{"value": 12.0}],
+                "tpuft_publish_last_time": [{"value": 997.0}],
+            }
+        }
+    }
+    assert fleet_status._publish_state(snap, now) == "s12@3.0s"
+    assert fleet_status._publish_state({"metrics": {"gauges": {}}}, now) is None
+    assert ("publish", "PUBLISH") in fleet_status._COLUMNS
+
+
+def test_fleet_trace_explain_prints_publish_lines() -> None:
+    import importlib.util
+    from pathlib import Path
+
+    spec = importlib.util.spec_from_file_location(
+        "fleet_trace",
+        Path(__file__).resolve().parent.parent / "scripts" / "fleet_trace.py",
+    )
+    fleet_trace = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(fleet_trace)
+
+    def event(seq, name, **kw):
+        base = {
+            "seq": seq, "name": name, "ph": "i", "cat": "ft",
+            "t_wall": 100.0 + seq, "t_mono": float(seq),
+            "replica_id": "train_0", "group_rank": 0,
+            "step": 7, "quorum_id": 2, "args": {},
+        }
+        base.update(kw)
+        return base
+
+    merged = fleet_trace.merge_events(
+        [
+            event(1, "commit"),
+            event(
+                2, "publish",
+                args={"bytes": 2 << 20, "digest": "abcdef123456"},
+            ),
+            event(3, "publish_retracted"),
+        ]
+    )
+    text = fleet_trace.explain_step(merged, 7)
+    assert "published: train_0/0 staged version step 7" in text
+    assert "abcdef123456" in text
+    assert "publish RETRACTED: train_0/0" in text
+
+
+def test_checkpoint_digest_matches_descriptor() -> None:
+    """The /serving/latest digest is exactly the heal plane's binding —
+    one integrity chain from donor staging to reader adoption."""
+    pub = WeightPublisher(num_chunks=2, timeout=5.0)
+    try:
+        descriptor = pub.publish(step=4, quorum_id=2, state=state_for(4))
+        assert descriptor["digest"] == _checkpoint_digest(
+            4, descriptor["crc_algo"], descriptor["chunk_crcs"]
+        )
+    finally:
+        pub.shutdown()

@@ -190,6 +190,23 @@ def test_r7_clean_fixture() -> None:
     assert scan("r7_pipeline_clean.py") == []
 
 
+def test_r7_publish_violation_fixture() -> None:
+    # The serving-plane extension: a committed-weights publish reachable
+    # with the window undrained is the reader-facing twin of an undrained
+    # donor send — one finding at the publish call.
+    findings = scan("r7_publish_violation.py", rules=["speculation-discipline"])
+    assert len(findings) == 1
+    assert rules_of(findings) == ["speculation-discipline"]
+    assert "publish" in findings[0].message
+    assert "drain" in findings[0].message
+
+
+def test_r7_publish_clean_fixture() -> None:
+    # The manager's _maybe_publish shape: drain lexically precedes the
+    # state sample + publish — clean under all rules.
+    assert scan("r7_publish_clean.py") == []
+
+
 def test_r6_clean_fixture(tmp_path) -> None:
     # Clean with the snapshot absent...
     assert scan("r6_clean.py") == []

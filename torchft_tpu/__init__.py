@@ -16,7 +16,9 @@ Public surface (parity with the reference's ``torchft/__init__.py``)::
 
 Heavier pieces import from their modules: ``torchft_tpu.local_sgd`` (LocalSGD,
 DiLoCo), ``torchft_tpu.zero`` (ZeroOptimizer — cross-replica optimizer-state
-sharding, docs/zero.md), ``torchft_tpu.tracing`` (the fleet trace plane —
+sharding, docs/zero.md), ``torchft_tpu.serving`` (the committed-weights
+serving plane — WeightPublisher/CachingRelay/WeightSubscriber,
+docs/serving.md), ``torchft_tpu.tracing`` (the fleet trace plane —
 per-process step-event journals merged by scripts/fleet_trace.py,
 docs/observability.md), ``torchft_tpu.parallel.mesh`` (FTMesh/HSDP),
 ``torchft_tpu.models``, ``torchft_tpu.checkpointing``, ``torchft_tpu.ops``.

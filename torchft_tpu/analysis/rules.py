@@ -23,7 +23,8 @@ how the per-rule fixture tests drive them.
 | citation-lint       | docstring ``file.py:line`` citations parse and      |
 |                     | resolve (reference tree when present)               |
 | speculation-        | no pg.configure / send_checkpoint / sidecar staging |
-| discipline          | reachable inside an undrained speculative window    |
+| discipline          | / serving publish reachable inside an undrained     |
+|                     | speculative window                                  |
 """
 
 from __future__ import annotations
@@ -710,7 +711,11 @@ _R7_DRAIN_CALLS = {
 }
 _R7_HOOK_ITER_MARK = "quorum_change_hook"
 _R7_PG_RECEIVERS = {"pg", "_pg"}
-_R7_UNSAFE_CALLS = {"send_checkpoint", "stage"}  # stage = sidecar heal-part staging
+# stage = sidecar heal-part staging; publish = the serving plane's
+# committed-weights publication (Manager._maybe_publish) — a publish
+# sampling an undrained window would hand READERS speculative state,
+# the serving twin of a donor send doing the same to a joiner.
+_R7_UNSAFE_CALLS = {"send_checkpoint", "stage", "publish"}
 
 
 def _check_r7(module: Module, reference_root: Optional[Path] = None) -> List[Finding]:
@@ -745,7 +750,12 @@ def _check_r7(module: Module, reference_root: Optional[Path] = None) -> List[Fin
             ):
                 unsafe.append((node.lineno, "pg.configure (wire reconfigure)"))
             elif cname in _R7_UNSAFE_CALLS:
-                unsafe.append((node.lineno, f"{cname} (donor/heal staging)"))
+                label = (
+                    "publish (serving-plane publication)"
+                    if cname == "publish"
+                    else f"{cname} (donor/heal staging)"
+                )
+                unsafe.append((node.lineno, label))
         for lineno, what in unsafe:
             if any(drain_line < lineno for drain_line in drains):
                 continue
@@ -809,7 +819,7 @@ ALL_RULES: Sequence[Rule] = (
     ),
     Rule(
         id="speculation-discipline",
-        summary="no pg.configure / donor send / heal staging inside an undrained speculative window",
+        summary="no pg.configure / donor send / heal staging / serving publish inside an undrained speculative window",
         anchor="CLAUDE.md 'quorum membership changes drain the FULL window ... BEFORE pg.configure / any donor send'",
         checker=_check_r7,
     ),

@@ -428,6 +428,29 @@ def _meta_bytes(
     )
 
 
+def _stage_manifest(
+    step: int,
+    quorum_id: Optional[int],
+    crc_algo: str,
+    chunk_crcs: List[int],
+    chunk_sizes: List[int],
+    digest: str,
+) -> Dict[str, Any]:
+    """JSON-safe summary of one staged checkpoint (no treedef — readers
+    that need it fetch the pickled ``/meta``). ``send_checkpoint`` returns
+    it so the serving plane's publisher can announce the staged version
+    without a second pass over the payload."""
+    return {
+        "step": int(step),
+        "quorum_id": quorum_id,
+        "crc_algo": crc_algo,
+        "chunk_crcs": [int(c) for c in chunk_crcs],
+        "chunk_sizes": [int(s) for s in chunk_sizes],
+        "num_chunks": len(chunk_crcs),
+        "digest": digest,
+    }
+
+
 def _plan_chunks(
     state_dict: Any, num_chunks: int
 ) -> Tuple[Any, List[Dict[int, Any]], Dict[str, int]]:
@@ -607,9 +630,16 @@ class HTTPTransport(CheckpointTransport[Any]):
                     return
                 stall_t0 = time.perf_counter()
                 with transport._cond:
+                    # Park only for a step that may still arrive: staged
+                    # steps are monotone, so a request for an OLDER step
+                    # than the current stage can never be satisfied —
+                    # answer immediately instead of holding the reader
+                    # (or a stale joiner) for the full timeout. A reader
+                    # racing a serving-plane version bump refetches the
+                    # new descriptor on its next poll.
                     transport._cond.wait_for(
                         lambda: transport._staged is not None
-                        and transport._staged.step == step,
+                        and transport._staged.step >= step,
                         timeout=transport._timeout,
                     )
                     staged = transport._staged
@@ -806,7 +836,7 @@ class HTTPTransport(CheckpointTransport[Any]):
 
     def _stage_to_child(
         self, step: int, state_dict: Any, quorum_id: Optional[int]
-    ) -> None:
+    ) -> Dict[str, Any]:
         """Child-mode staging: serialize each chunk ONCE into a fresh
         epoch directory on the shared-memory filesystem (tmpfs pages, so
         this is a memcpy + C-speed CRC, not disk I/O), computing the
@@ -863,6 +893,9 @@ class HTTPTransport(CheckpointTransport[Any]):
             digest=digest,
         )
         self._child_staged = True
+        return _stage_manifest(
+            step, quorum_id, _CRC_ALGO, crcs, sizes, digest
+        )
 
     # -- CheckpointTransport -----------------------------------------------
 
@@ -884,22 +917,27 @@ class HTTPTransport(CheckpointTransport[Any]):
         state_dict: Any,
         timeout: float,
         quorum_id: Optional[int] = None,
-    ) -> None:
+    ) -> Optional[Dict[str, Any]]:
         """Stages host copies of the state and starts serving them for
         ``step`` (tagged with ``quorum_id`` when the manager provides the
         era). Serving continues until :meth:`disallow_checkpoint`. In
         child mode the snapshot is handed to the serving child; any
         failure on that path degrades THIS stage (and the advertised
-        address, from the next quorum round) to inline serving."""
+        address, from the next quorum round) to inline serving.
+
+        Returns the staged integrity manifest (step, quorum_id, digest,
+        per-chunk CRCs + sizes) — the serving plane's publisher announces
+        it as the version descriptor; heal callers ignore it (the ABC
+        return contract stays ``None``-compatible)."""
         if self._serve_child is not None:
             try:
                 with metrics.timer(
                     "tpuft_heal_serve_stage_seconds", mode="child"
                 ):
-                    self._stage_to_child(step, state_dict, quorum_id)
+                    manifest = self._stage_to_child(step, state_dict, quorum_id)
                 self._child_degraded = False
                 metrics.inc("tpuft_heal_serve_stages_total", mode="child")
-                return
+                return manifest
             except Exception as e:  # noqa: BLE001 — degrade to inline serving
                 logger.warning(
                     "child-mode stage failed (%s); staging inline instead", e
@@ -921,6 +959,14 @@ class HTTPTransport(CheckpointTransport[Any]):
         with self._cond:
             self._staged = staged
             self._cond.notify_all()
+        return _stage_manifest(
+            step,
+            quorum_id,
+            staged.crc_algo,
+            staged.chunk_crcs,
+            staged.chunk_sizes,
+            staged.digest,
+        )
 
     def disallow_checkpoint(self) -> None:
         with self._cond:

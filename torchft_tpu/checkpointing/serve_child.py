@@ -81,6 +81,7 @@ __all__ = [
     "ENV_SERVE_MAX_RESTARTS",
     "serve_dir_root",
     "serve_rate_gbps",
+    "heal_priority_share",
     "maybe_pace_serve",
 ]
 
@@ -88,6 +89,7 @@ ENV_SERVE_MODE = "TPUFT_HEAL_SERVE_MODE"
 ENV_SERVE_DIR = "TPUFT_HEAL_SERVE_DIR"
 ENV_SERVE_NICE = "TPUFT_HEAL_SERVE_NICE"
 ENV_SERVE_GBPS = "TPUFT_HEAL_SERVE_GBPS"
+ENV_SERVE_PRIORITY_SHARE = "TPUFT_HEAL_SERVE_PRIORITY_SHARE"
 ENV_SERVE_MAX_RESTARTS = "TPUFT_HEAL_SERVE_MAX_RESTARTS"
 
 logger = logging.getLogger(__name__)
@@ -157,27 +159,66 @@ def serve_rate_gbps(default: float = 0.0) -> float:
         return default
 
 
+def heal_priority_share(default: float = 0.8) -> float:
+    """Fraction of the paced egress reserved for HEAL streams while both
+    traffic classes are active (``$TPUFT_HEAL_SERVE_PRIORITY_SHARE``,
+    clamped to (0, 1)). Serving readers are throughput traffic; a healing
+    joiner is the fleet's recovery path — it must never be starved by a
+    reader fan-out that got to the bucket first."""
+    try:
+        share = float(os.environ.get(ENV_SERVE_PRIORITY_SHARE, str(default)))
+    except ValueError:
+        return default
+    return min(max(share, 0.01), 0.99)
+
+
 class _ServePacer:
     """Process-wide token bucket for the serve-egress bound: every paced
     stream debits the SAME clock, so N parallel chunk streams (a striped
     or pooled joiner) share the configured rate instead of each getting
     it — ``TPUFT_HEAL_SERVE_GBPS`` bounds the donor's aggregate egress,
-    like the NIC share it stands for."""
+    like the NIC share it stands for.
 
-    def __init__(self, gbps: float) -> None:
+    Two traffic classes share the bucket with a priority split instead of
+    first-come-first-served: ``heal`` (joiner recovery streams) and
+    ``serving`` (committed-weights readers, torchft_tpu/serving). While
+    both classes are active — a class counts as active while it debited
+    within the last :data:`_ACTIVE_WINDOW_SEC` — heal streams get
+    :func:`heal_priority_share` of the rate and serving readers split the
+    remainder, so N concurrent readers structurally cannot starve a
+    healing joiner; a lone class gets the full rate. Each class keeps its
+    own virtual-finish-time clock, so the split holds regardless of which
+    class's writes arrive first."""
+
+    _ACTIVE_WINDOW_SEC = 0.5
+
+    def __init__(self, gbps: float, heal_share: Optional[float] = None) -> None:
         self.gbps = gbps
-        self._spb = 8.0 / (gbps * 1e9)
+        self._share = heal_share if heal_share is not None else heal_priority_share()
         self._lock = threading.Lock()
-        self._ready = time.monotonic()
+        now = time.monotonic()
+        self._ready = {"heal": now, "serving": now}
+        self._last_debit = {"heal": float("-inf"), "serving": float("-inf")}
 
-    def debit(self, nbytes: int) -> float:
-        """Charges ``nbytes`` against the bucket; returns how long the
-        caller must sleep so the aggregate rate holds."""
+    def debit(self, nbytes: int, cls: str = "heal") -> float:
+        """Charges ``nbytes`` against ``cls``'s share of the bucket;
+        returns how long the caller must sleep so the aggregate rate (and
+        the heal-priority split, when both classes are active) holds."""
+        other = "serving" if cls == "heal" else "heal"
         with self._lock:
             now = time.monotonic()
-            start = self._ready if self._ready > now else now
-            self._ready = start + nbytes * self._spb
-            return max(self._ready - now, 0.0)
+            self._last_debit[cls] = now
+            contended = now - self._last_debit[other] < self._ACTIVE_WINDOW_SEC
+            if contended:
+                rate = self.gbps * (
+                    self._share if cls == "heal" else 1.0 - self._share
+                )
+            else:
+                rate = self.gbps
+            spb = 8.0 / (rate * 1e9)
+            start = self._ready[cls] if self._ready[cls] > now else now
+            self._ready[cls] = start + nbytes * spb
+            return max(self._ready[cls] - now, 0.0)
 
 
 _pacer: Optional[_ServePacer] = None
@@ -197,10 +238,17 @@ class _RateWriter:
     (sleep released between slices, so a paced serve is IO-bound, not a
     CPU hog)."""
 
-    def __init__(self, raw: Any, pacer: _ServePacer, slice_bytes: int = 1 << 18) -> None:
+    def __init__(
+        self,
+        raw: Any,
+        pacer: _ServePacer,
+        slice_bytes: int = 1 << 18,
+        cls: str = "heal",
+    ) -> None:
         self._raw = raw
         self._pacer = pacer
         self._slice = slice_bytes
+        self._cls = cls
 
     def write(self, data: Any) -> None:
         mv = memoryview(data)
@@ -209,17 +257,19 @@ class _RateWriter:
         for off in range(0, len(mv), self._slice):
             part = mv[off : off + self._slice]
             self._raw.write(part)
-            delay = self._pacer.debit(len(part))
+            delay = self._pacer.debit(len(part), cls=self._cls)
             if delay > 0:
                 time.sleep(delay)
 
 
-def maybe_pace_serve(out: Any) -> Any:
+def maybe_pace_serve(out: Any, cls: str = "heal") -> Any:
     """Wraps ``out`` with the (process-aggregate) serve-rate bound when
-    configured."""
+    configured. ``cls`` is the traffic class the bytes charge against:
+    ``heal`` (default — every existing heal-serve seam) or ``serving``
+    (committed-weights readers), see :class:`_ServePacer`."""
     gbps = serve_rate_gbps()
     if gbps > 0:
-        return _RateWriter(out, _shared_pacer(gbps))
+        return _RateWriter(out, _shared_pacer(gbps), cls=cls)
     return out
 
 

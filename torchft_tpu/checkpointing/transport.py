@@ -46,8 +46,13 @@ class CheckpointTransport(ABC, Generic[T]):
         state_dict: T,
         timeout: float,
         quorum_id: Optional[int] = None,
-    ) -> None:
-        """Stages/sends ``state_dict`` for ``dst_ranks`` at ``step``."""
+    ) -> Optional[dict]:
+        """Stages/sends ``state_dict`` for ``dst_ranks`` at ``step``.
+
+        May return a JSON-safe staging manifest (step/era/digest/per-chunk
+        CRCs; HTTPTransport does) for the serving plane's publisher; heal
+        callers ignore the return value and ``None`` is always a valid
+        answer."""
 
     @abstractmethod
     def recv_checkpoint(

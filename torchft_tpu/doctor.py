@@ -48,6 +48,12 @@ KNOWN_ENV = {
     # child niceness, egress bound, respawn budget.
     "TPUFT_HEAL_SERVE_MODE", "TPUFT_HEAL_SERVE_DIR", "TPUFT_HEAL_SERVE_NICE",
     "TPUFT_HEAL_SERVE_GBPS", "TPUFT_HEAL_SERVE_MAX_RESTARTS",
+    # Paced-egress fairness: heal streams' guaranteed share of the
+    # serve-rate bucket while serving readers are also active.
+    "TPUFT_HEAL_SERVE_PRIORITY_SHARE",
+    # Committed-weights serving plane (torchft_tpu/serving): publication
+    # cadence + chunking, relay poll cadence.
+    "TPUFT_PUBLISH_EVERY", "TPUFT_PUBLISH_CHUNKS", "TPUFT_SERVING_POLL_SEC",
     "TPUFT_METRICS_PORT", "TPUFT_METRICS_PUSH_SEC",
     # ZeRO plane (torchft_tpu/zero.py): enable flag for the harness/bench
     # loops, fleet-wide shard count, assignment policy, joiner heal
@@ -405,6 +411,47 @@ def _check_heal_stripe(lighthouse: str) -> Tuple[str, str]:
     )
 
 
+def _check_serving() -> Tuple[str, str]:
+    """Committed-weights serving-plane preflight: one in-process
+    publisher -> relay -> subscriber roundtrip over loopback HTTP (tiny
+    payload). WARN, never FAIL — serving is a read path; a broken relay
+    means readers lag, not that training is wrong."""
+    import numpy as np
+
+    from torchft_tpu.serving import (
+        CachingRelay,
+        WeightPublisher,
+        WeightSubscriber,
+        publish_every,
+    )
+
+    pub = None
+    relay = None
+    try:
+        pub = WeightPublisher(num_chunks=2, timeout=5.0)
+        pub.publish(
+            step=1, quorum_id=0, state={"doctor": np.arange(8, dtype=np.float32)}
+        )
+        relay = CachingRelay([pub.address()], timeout=5.0, start=False)
+        if not relay.poll_once():
+            return "WARN", "relay failed to pull the probe version"
+        version = WeightSubscriber([relay.address()], timeout=5.0).poll()
+        if version is None or version.step != 1:
+            return "WARN", "subscriber failed to adopt the probe version"
+        return (
+            "PASS",
+            "publisher->relay->subscriber probe ok (publish cadence: every "
+            f"{publish_every()} committed step(s))",
+        )
+    except Exception as e:  # noqa: BLE001 — WARN, never FAIL
+        return "WARN", f"serving probe failed: {type(e).__name__}: {e}"
+    finally:
+        if relay is not None:
+            relay.shutdown(wait=False)
+        if pub is not None:
+            pub.shutdown(wait=False)
+
+
 def _check_commit_pipeline() -> Tuple[str, str]:
     """Commit-pipeline window preflight. WARN, never FAIL: any depth
     trains correctly — but the snapshot ring holds one full
@@ -496,6 +543,7 @@ def run_checks(lighthouse: str, skip_device: bool = False) -> int:
         ("metrics", _check_metrics),
         ("trace plane", _check_trace),
         ("heal serving", _check_heal_serve),
+        ("weights serving", _check_serving),
         ("heal striping", lambda: _check_heal_stripe(lighthouse)),
         ("zero plane", lambda: _check_zero(lighthouse)),
         ("lighthouse", lambda: _check_lighthouse(lighthouse)),

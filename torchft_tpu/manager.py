@@ -458,6 +458,12 @@ class Manager:
         self._shutdown_hooks: List[Callable[[], None]] = []
         self._quorum_change_hooks: List[Callable[[], None]] = []
         self._heal_parts_filters: List[Callable[[], Any]] = []
+        # Serving plane (torchft_tpu/serving): commit-tail publish hooks
+        # (cheap due-marks) + the attached publisher the step boundary
+        # publishes through — see register_publish_hook/_maybe_publish.
+        self._publish_hooks: List[Callable[[int, int], None]] = []
+        self._publisher: Optional[Any] = None
+        self._publisher_state_fn: Optional[Callable[[], Any]] = None
         self._healing = False
         self._pending_state_dict: Optional[Dict[str, Any]] = None
         self._pending_commit_future: Optional[_TrackedCommitFuture] = None
@@ -733,6 +739,78 @@ class Manager:
             except Exception as e:  # noqa: BLE001
                 self._logger.exception(f"quorum-change drain hook failed: {e}")
                 self.report_error(e)
+
+    def register_publish_hook(self, hook: Callable[[int, int], None]) -> None:
+        """Runs ``hook(committed_step, quorum_id)`` after every committed
+        step's accounting (both the inline ``should_commit`` tail and the
+        speculative window's deferred resolution). Hooks must be CHEAP —
+        they run on the commit-resolution path — and must not sample
+        state: a depth-N pipeline's live state contains younger
+        speculative steps at resolution time. The serving plane's
+        publisher registers a due-mark here; the actual state capture
+        happens at the next step boundary (:meth:`_maybe_publish`), after
+        a full window drain. Hook errors are logged and dropped — the
+        serving plane must never poison a commit."""
+        self._publish_hooks.append(hook)
+
+    def attach_publisher(
+        self, publisher: Any, state_fn: Optional[Callable[[], Any]] = None
+    ) -> None:
+        """Attaches a ``serving.WeightPublisher``: commits mark it due via
+        :meth:`register_publish_hook`, and the step boundary publishes
+        through :meth:`_maybe_publish`. ``state_fn`` samples the state to
+        publish (e.g. ``lambda: opt.params``); default is the registered
+        user state dicts. The publisher's serving-sidecar failures funnel
+        into :meth:`report_error` like the heal transport's."""
+        self._publisher = publisher
+        self._publisher_state_fn = state_fn
+        publisher.register_error_callback(self.report_error)
+        self.register_publish_hook(publisher.note_commit)
+        self.register_shutdown_hook(lambda: publisher.shutdown(wait=False))
+
+    def _run_publish_hooks(self, step: int, quorum_id: int) -> None:
+        for hook in self._publish_hooks:
+            try:
+                hook(step, quorum_id)
+            except Exception:  # noqa: BLE001 — serving must never wound a commit
+                self._logger.exception("publish hook failed (ignored)")
+
+    def _maybe_publish(self) -> None:
+        """The publication site, run on the train thread at the step
+        boundary (:meth:`start_quorum`) when the attached publisher has a
+        version due. The speculative window is drained FIRST — identical
+        discipline to donor sends, pinned lexically by analyzer rule R7 —
+        so published bytes are always committed-only; the state sample
+        rides the state-dict read lock like a checkpoint serve. Failures
+        are counted and logged (serving lags; training is unaffected)."""
+        publisher = self._publisher
+        if publisher is None or not publisher.due():
+            return
+        try:
+            # Publication must never sample speculative-window state:
+            # resolve the full window before touching params (R7).
+            self._run_quorum_drain_hooks()
+            with self._state_dict_lock.r_lock(timeout=self._timeout):
+                if self._publisher_state_fn is not None:
+                    state = self._publisher_state_fn()
+                else:
+                    state = {
+                        key: fn() for key, fn in self._user_state_dicts.items()
+                    }
+            with metrics.timer(
+                "tpuft_publish_seconds", **self._metric_labels
+            ), self._trace.span(
+                "publish", step=self._step, quorum_id=self._quorum_id
+            ):
+                publisher.publish(
+                    step=self._step, quorum_id=self._quorum_id, state=state
+                )
+        except Exception as e:  # noqa: BLE001 — publication is best-effort
+            metrics.inc("tpuft_publish_failures_total", **self._metric_labels)
+            self._logger.exception(
+                f"publish failed (readers lag one cadence; training "
+                f"unaffected): {e}"
+            )
 
     def register_heal_parts_filter(self, fn: Callable[[], Any]) -> None:
         """Registers a callable returning the set of heal-part names
@@ -1049,6 +1127,12 @@ class Manager:
 
         self._errored = None
         self._healing = False
+
+        # Serving plane: a due publication runs here, on the train thread,
+        # with no quorum task in flight — the drain inside can resolve the
+        # window's votes without racing the quorum executor, and any error
+        # it reports sticks to THIS step's freshly wiped flags.
+        self._maybe_publish()
 
         self._quorum_future = self._executor.submit(
             self._async_quorum,
@@ -1655,6 +1739,7 @@ class Manager:
             metrics.set_gauge(
                 "tpuft_last_commit_time", time.time(), **self._metric_labels
             )
+            self._run_publish_hooks(self._step, self._quorum_id)
             # A committed step closes any open incident window: later dumps
             # get fresh ids instead of riding a resolved incident.
             tracing.clear_incident(self._trace)
@@ -1814,6 +1899,7 @@ class Manager:
             metrics.set_gauge(
                 "tpuft_last_commit_time", time.time(), **self._metric_labels
             )
+            self._run_publish_hooks(self._step, self._quorum_id)
             tracing.clear_incident(self._trace)
         else:
             self._commit_failures += 1

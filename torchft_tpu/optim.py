@@ -732,6 +732,14 @@ class Optimizer:
                         journal=journal,
                         reason="speculative step refused by the commit barrier",
                     )
+                    # Serving plane: an unwind retracts any due-but-
+                    # unpublished version newer than the surviving
+                    # committed step — a discarded speculation must never
+                    # surface to readers (published versions are post-
+                    # barrier and final, so this is the only window).
+                    publisher = getattr(self.manager, "_publisher", None)
+                    if publisher is not None:
+                        publisher.retract_after(rolled_step)
                 rec.committed = committed
                 return committed
 

@@ -12,16 +12,18 @@ wedged client only costs its own session.
 from __future__ import annotations
 
 import json
+import os
 import socket
 import threading
 import urllib.request
 import uuid
 from abc import ABC, abstractmethod
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any
+from typing import Any, Dict
 
 from torchft_tpu.parallel.process_group import ProcessGroup, ProcessGroupTCP
 from torchft_tpu.parallel.store import StoreServer
+from torchft_tpu.telemetry import errors_logger
 
 __all__ = ["ParameterServer"]
 
@@ -40,6 +42,10 @@ class ParameterServer(ABC):
     def __init__(self, bind_port: int = 0, timeout: float = 60.0) -> None:
         self.timeout = timeout
         self._store = StoreServer()
+        # Live session service threads, so shutdown can bound-join them
+        # instead of abandoning daemon threads mid-RPC.
+        self._sessions_lock = threading.Lock()
+        self._sessions: Dict[str, threading.Thread] = {}
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -60,12 +66,15 @@ class ParameterServer(ABC):
                     }
                 ).encode()
                 # Service thread joins the session PG as rank 0.
-                threading.Thread(
+                thread = threading.Thread(
                     target=server._serve_session,
                     args=(session_id,),
                     daemon=True,
                     name=f"ps-session-{session_id[:8]}",
-                ).start()
+                )
+                with server._sessions_lock:
+                    server._sessions[session_id] = thread
+                thread.start()
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
@@ -95,10 +104,24 @@ class ParameterServer(ABC):
                 world_size=2,
             )
             self.forward(session_id, pg)
-        except Exception:  # noqa: BLE001  — a broken session only kills itself
-            pass
+        except Exception as e:  # noqa: BLE001  — a broken session only kills itself
+            # Containment is the contract, silence is not: a wedged or
+            # crashed session must be diagnosable by its id from the
+            # telemetry stream (the reference pattern — errors narrate,
+            # they never escape the session boundary).
+            errors_logger.error(
+                "parameter-server session failed",
+                extra={
+                    "job_id": os.environ.get("JOB_ID", "unknown"),
+                    "replica_id": f"ps-session-{session_id}",
+                    "error": f"{type(e).__name__}: {e}",
+                },
+                exc_info=True,
+            )
         finally:
             pg.shutdown()
+            with self._sessions_lock:
+                self._sessions.pop(session_id, None)
 
     @abstractmethod
     def forward(self, session_id: str, pg: ProcessGroup) -> None:
@@ -123,3 +146,11 @@ class ParameterServer(ABC):
         self._http.shutdown()
         self._http.server_close()
         self._store.shutdown()
+        # Bound-join live session threads: the store shutdown above
+        # unblocks their PG waits, so each join is short — a session that
+        # outlives its slice is left to its daemon flag, not waited on
+        # forever.
+        with self._sessions_lock:
+            threads = list(self._sessions.values())
+        for thread in threads:
+            thread.join(timeout=self.timeout)

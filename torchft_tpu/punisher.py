@@ -33,6 +33,12 @@ Heal-path modes target the recovery plane itself:
   donor of a stripe set (``heal_stream:<donor tag>``, usually the serve
   port) so the drill proves a corrupting donor is fenced out of the
   stripe while its peers keep serving.
+- ``kill_relay``: armed at the ``serving_relay`` site (optionally
+  ``--relay-tag <port>`` to target one relay of a tier); the next relay
+  poll round or reader GET consumes it and the relay dies abruptly
+  mid-service — subscribers must fail over to another endpoint without
+  ever observing a torn or stale-era version (the serving plane's
+  chaos drill, tests/test_serving.py).
 
     python -m torchft_tpu.punisher --lighthouse host:29510 kill_one
     python -m torchft_tpu.punisher --lighthouse host:29510 fault_one --mode deadlock
@@ -64,6 +70,7 @@ __all__ = [
     "main",
     "FAULT_MODES",
     "HEAL_FAULT_MODES",
+    "SERVING_FAULT_MODES",
     "ALL_FAULT_MODES",
 ]
 
@@ -86,7 +93,9 @@ HEAL_FAULT_MODES = (
     "kill_donor_mid_stripe",
     "corrupt_stripe",
 )
-ALL_FAULT_MODES = FAULT_MODES + HEAL_FAULT_MODES
+# Serving-plane modes (the committed-weights fan-out tier).
+SERVING_FAULT_MODES = ("kill_relay",)
+ALL_FAULT_MODES = FAULT_MODES + HEAL_FAULT_MODES + SERVING_FAULT_MODES
 
 
 def kill_one(
@@ -175,12 +184,21 @@ def arm_stream_fault(
     ``corrupt_stripe`` is the same bit-flip as ``corrupt_stream`` but
     site-tagged to one donor of a stripe set (``--donor-tag``, usually
     the victim's serve port — untagged it behaves like corrupt_stream,
-    hitting whichever stripe serves next)."""
+    hitting whichever stripe serves next); ``kill_relay`` arms a ``die``
+    at the ``serving_relay`` site (``--donor-tag`` = the relay's serve
+    port to target one relay of a tier) — the victim relay drops
+    abruptly at its next poll round or reader GET."""
     if mode == "kill_serve_child":
         site, armed_mode = "serve_child", mode
     elif mode == "corrupt_stripe":
         site = f"heal_stream:{donor_tag}" if donor_tag else "heal_stream"
         armed_mode = "corrupt_stream"  # the serve seam knows one bit-flip
+    elif mode == "kill_relay":
+        # The relay consumes "die" at its poll loop and serve handler;
+        # the tag (its serve port) narrows the kill to one relay of a
+        # fan-out tier.
+        site = f"serving_relay:{donor_tag}" if donor_tag else "serving_relay"
+        armed_mode = "die"
     else:
         site, armed_mode = "heal_stream", mode
     try:
@@ -211,6 +229,7 @@ def inject_fault(
         "stall_donor",
         "kill_serve_child",
         "corrupt_stripe",
+        "kill_relay",
     ):
         return arm_stream_fault(mode, fault_file)
     raise ValueError(f"unknown fault mode {mode!r}")
@@ -264,8 +283,9 @@ def main() -> None:
     fault.add_argument(
         "--donor-tag",
         default=None,
-        help="corrupt_stripe only: target one donor of a stripe set by its "
-        "serve-site tag (usually the serve port)",
+        help="corrupt_stripe / kill_relay: target one donor of a stripe "
+        "set (or one relay of a tier) by its serve-site tag (usually the "
+        "serve port)",
     )
     loop = sub.add_parser("kill_loop")
     loop.add_argument("--mtbf", type=float, default=60.0, help="mean seconds between faults")
@@ -283,7 +303,7 @@ def main() -> None:
     elif args.cmd == "kill_all":
         kill_all(client, rng)
     elif args.cmd == "fault_one":
-        if args.mode == "corrupt_stripe" and args.donor_tag:
+        if args.mode in ("corrupt_stripe", "kill_relay") and args.donor_tag:
             arm_stream_fault(
                 args.mode, args.fault_file, donor_tag=args.donor_tag
             )

@@ -1,0 +1,47 @@
+"""Committed-weights serving plane.
+
+The training fleet's answer to "serve heavy traffic from millions of
+users": every committed step's params become an immutable, quorum-era-
+tagged, integrity-digested snapshot in the heal plane's exact chunk
+format, published without stalling the step loop and fanned out through
+a caching relay tier to arbitrarily many readers.
+
+Roles:
+
+- :class:`WeightPublisher` (publisher.py) — publication, driven by the
+  manager's commit hooks; speculative-window state is structurally never
+  published (analyzer rule R7 pins the drain-before-publish ordering).
+- :class:`CachingRelay` (relay.py) — delta-aware pulls, in-memory chunk
+  cache, upstream failover mid-pull, stackable.
+- :class:`WeightSubscriber` (subscriber.py) — verify-then-swap reader;
+  torn, stale-era, or rolled-back versions are structurally unobservable.
+
+docs/serving.md has the architecture, version lifecycle, and failure
+rows; benchmarks/serving_bench.py measures reader throughput under
+fleet chaos.
+"""
+
+from torchft_tpu.serving.publisher import (
+    ENV_PUBLISH_CHUNKS,
+    ENV_PUBLISH_EVERY,
+    WeightPublisher,
+    publish_every,
+)
+from torchft_tpu.serving.relay import (
+    ENV_SERVING_POLL_SEC,
+    CachingRelay,
+    serving_poll_sec,
+)
+from torchft_tpu.serving.subscriber import ServingVersion, WeightSubscriber
+
+__all__ = [
+    "WeightPublisher",
+    "CachingRelay",
+    "WeightSubscriber",
+    "ServingVersion",
+    "ENV_PUBLISH_EVERY",
+    "ENV_PUBLISH_CHUNKS",
+    "ENV_SERVING_POLL_SEC",
+    "publish_every",
+    "serving_poll_sec",
+]

@@ -1,0 +1,91 @@
+"""Shared wire helpers for the committed-weights serving plane.
+
+The serving plane speaks the heal plane's exact chunk protocol
+(checkpointing/http_transport.py: pickled ``/checkpoint/{step}/meta``,
+raw ``/checkpoint/{step}/{i}`` chunk bodies, per-chunk CRCs bound into a
+whole-checkpoint sha256 digest) plus one JSON announcement route,
+``/serving/latest`` — the version descriptor a publisher or relay serves
+so readers can discover the newest fully staged version without
+unpickling anything. These helpers keep the three roles (publisher /
+relay / subscriber) byte-compatible.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+from typing import Any, Callable, Dict, List, Optional
+
+from torchft_tpu.checkpointing.http_transport import (
+    _CRC_UPDATERS,
+    _checkpoint_digest,
+)
+
+__all__ = [
+    "LATEST_ROUTE",
+    "fetch_json",
+    "fetch_bytes",
+    "latest_descriptor",
+    "validate_latest",
+    "chunk_crc",
+]
+
+LATEST_ROUTE = "/serving/latest"
+
+
+def fetch_json(url: str, timeout: float) -> Dict[str, Any]:
+    """One JSON GET (no retry — serving readers fail over across
+    endpoints instead of betting a retry window on one)."""
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        body = resp.read()
+    data = json.loads(body)
+    if not isinstance(data, dict):
+        raise ValueError(f"expected a JSON object from {url}")
+    return data
+
+
+def fetch_bytes(url: str, timeout: float) -> bytes:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read()
+
+
+def latest_descriptor(
+    manifest: Dict[str, Any], base: str, published_ts: float
+) -> Dict[str, Any]:
+    """The ``/serving/latest`` body: the staging manifest
+    (http_transport._stage_manifest) plus where to fetch the chunks from
+    (``base`` — the publisher's transport/sidecar or a relay) and when
+    the version went live."""
+    descriptor = dict(manifest)
+    descriptor["format"] = 1
+    descriptor["base"] = base
+    descriptor["published_ts"] = published_ts
+    return descriptor
+
+
+def validate_latest(latest: Dict[str, Any]) -> Optional[str]:
+    """Structural + integrity-binding validation of a ``/serving/latest``
+    descriptor; returns a rejection reason or None when acceptable. The
+    digest MUST be exactly the binding of (step, algo, chunk_crcs) —
+    checked before any chunk transfer, so a torn or tampered descriptor
+    never costs a payload fetch and can never be adopted."""
+    if latest.get("format") != 1:
+        return f"unrecognized /serving/latest format: {latest.get('format')!r}"
+    for key in ("step", "digest", "crc_algo", "chunk_crcs", "chunk_sizes", "base"):
+        if latest.get(key) is None:
+            return f"/serving/latest missing {key!r}"
+    algo = latest["crc_algo"]
+    if algo not in _CRC_UPDATERS:
+        return f"descriptor checksums use {algo!r}, unavailable on this host"
+    crcs: List[int] = latest["chunk_crcs"]
+    sizes: List[int] = latest["chunk_sizes"]
+    if len(crcs) != len(sizes) or len(crcs) != int(latest.get("num_chunks", len(crcs))):
+        return "descriptor chunk_crcs/chunk_sizes/num_chunks disagree"
+    if _checkpoint_digest(int(latest["step"]), algo, crcs) != latest["digest"]:
+        return "descriptor digest does not bind its per-chunk checksums"
+    return None
+
+
+def chunk_crc(data: bytes, algo: str) -> int:
+    update: Callable[[int, Any], int] = _CRC_UPDATERS[algo]
+    return update(0, data)

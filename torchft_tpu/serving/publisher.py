@@ -1,0 +1,260 @@
+"""Committed-weights publisher: the training fleet's publication plane.
+
+A :class:`WeightPublisher` turns every committed step's params into an
+immutable, quorum-era-tagged, sha256-digested, per-chunk-CRC'd snapshot —
+the exact heal-plane format (checkpointing/http_transport.py format-2
+``/meta`` + chunk routes) staged through the existing serve paths, so in
+``TPUFT_HEAL_SERVE_MODE=child`` the snapshot is served by the
+deprioritized sidecar and publication structurally cannot stall the
+donor's step loop (the PR-5 isolation envelope applies unchanged).
+
+Integration contract (see ``Manager.attach_publisher``):
+
+- the manager's commit tails call :meth:`note_commit` — a cheap due-mark,
+  never a state sample, so the commit path cannot stall on publication;
+- the actual publication runs at the next step boundary on the train
+  thread (``Manager._maybe_publish``), lexically AFTER the speculative-
+  window drain — analyzer rule R7 pins the ordering exactly like donor
+  sends, so speculative-window state is structurally never published;
+- a rollback-unwind retracts any due-but-unpublished version through
+  :meth:`retract_after` (published versions are post-commit-barrier and
+  therefore final — the retraction is the invariant's belt-and-braces,
+  counted in ``tpuft_publish_retracted_total``).
+
+Readers discover versions via ``GET /serving/latest`` on
+:meth:`address` — a JSON descriptor carrying the staged manifest (step,
+era, digest, per-chunk CRCs/sizes) plus the chunk base URL (the
+transport's inline server or its serving sidecar). Chunk traffic never
+touches the announcement server.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import socket
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, Optional
+
+from torchft_tpu import metrics, tracing
+from torchft_tpu.checkpointing.http_transport import HTTPTransport
+from torchft_tpu.serving._wire import LATEST_ROUTE, latest_descriptor
+
+__all__ = [
+    "WeightPublisher",
+    "ENV_PUBLISH_EVERY",
+    "ENV_PUBLISH_CHUNKS",
+    "publish_every",
+]
+
+ENV_PUBLISH_EVERY = "TPUFT_PUBLISH_EVERY"
+ENV_PUBLISH_CHUNKS = "TPUFT_PUBLISH_CHUNKS"
+
+logger = logging.getLogger(__name__)
+
+
+def publish_every(default: int = 1) -> int:
+    """Publication cadence in committed steps (``$TPUFT_PUBLISH_EVERY``,
+    default every commit). With a depth-N commit pipeline each publication
+    drains the window first, so cadences >= the window depth keep the
+    pipeline's RTT hiding between publications."""
+    try:
+        return max(1, int(os.environ.get(ENV_PUBLISH_EVERY, str(default))))
+    except ValueError:
+        return default
+
+
+def _publish_chunks(default: int = 8) -> int:
+    try:
+        return max(1, int(os.environ.get(ENV_PUBLISH_CHUNKS, str(default))))
+    except ValueError:
+        return default
+
+
+class WeightPublisher:
+    """Publishes committed params as versioned, integrity-bound snapshots.
+
+    Standalone use (benchmarks, serving-only hosts)::
+
+        pub = WeightPublisher()
+        pub.publish(step=1, quorum_id=0, state={"params": params})
+        # readers: WeightSubscriber([pub.address()]).poll()
+
+    Training use: ``manager.attach_publisher(pub, lambda: opt.params)`` —
+    the manager drives the commit-note -> drain -> publish cycle.
+    """
+
+    def __init__(
+        self,
+        every: Optional[int] = None,
+        num_chunks: Optional[int] = None,
+        timeout: float = 10.0,
+        transport: Optional[HTTPTransport] = None,
+        bind_port: int = 0,
+    ) -> None:
+        self._every = every if every is not None else publish_every()
+        self._timeout = timeout
+        self._owns_transport = transport is None
+        self._transport = (
+            transport
+            if transport is not None
+            else HTTPTransport(
+                timeout=timeout,
+                num_chunks=num_chunks if num_chunks is not None else _publish_chunks(),
+            )
+        )
+        self._lock = threading.Lock()
+        self._latest: Optional[Dict[str, Any]] = None
+        self._due: Optional[int] = None
+        self._shutdown = False
+
+        publisher = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt: str, *args: Any) -> None:
+                pass
+
+            def do_GET(self) -> None:
+                if self.path.split("?", 1)[0] != LATEST_ROUTE:
+                    self.send_error(404, "unknown route")
+                    return
+                with publisher._lock:
+                    latest = publisher._latest
+                if latest is None:
+                    self.send_error(404, "nothing published yet")
+                    return
+                body = json.dumps(latest).encode()
+                metrics.inc("tpuft_serving_requests_total", route="latest")
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        class DualStack(ThreadingHTTPServer):
+            address_family = socket.AF_INET6
+            daemon_threads = True
+
+        self._server = DualStack(("::", bind_port), Handler)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            daemon=True,
+            name="tpuft-publish-announce",
+        )
+        self._thread.start()
+
+    # -- discovery ---------------------------------------------------------
+
+    def address(self) -> str:
+        """The announcement endpoint readers poll for ``/serving/latest``."""
+        host = socket.gethostname()
+        return f"http://{host}:{self._server.server_address[1]}"
+
+    def latest(self) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            return self._latest
+
+    # -- manager-facing seams ----------------------------------------------
+
+    @property
+    def every(self) -> int:
+        return self._every
+
+    def note_commit(self, step: int, quorum_id: int) -> None:
+        """Commit-tail hook (runs on whichever thread resolved the vote):
+        marks a publication due at the configured cadence. Deliberately
+        samples NOTHING — the commit path must never wait on the serving
+        plane."""
+        if step % self._every == 0:
+            with self._lock:
+                self._due = step
+
+    def due(self) -> bool:
+        with self._lock:
+            return self._due is not None
+
+    def retract_after(self, committed_step: int) -> None:
+        """Rollback-unwind retraction: drops any due-but-unpublished
+        version for a step newer than the unwound-to committed step, so a
+        quorum-wide refusal can never surface a version the fleet
+        discarded. Versions already published are post-barrier (final by
+        quorum agreement) and are never retracted."""
+        with self._lock:
+            if self._due is not None and self._due > committed_step:
+                self._due = None
+                metrics.inc("tpuft_publish_retracted_total")
+                tracing.record("publish_retracted", step=committed_step)
+
+    # -- publication -------------------------------------------------------
+
+    def publish(
+        self, step: int, quorum_id: Optional[int], state: Any
+    ) -> Dict[str, Any]:
+        """Stages ``state`` as version ``step`` and flips ``/serving/latest``
+        to it. ``state`` must be a committed-only view — when manager-
+        attached the call site (``Manager._maybe_publish``) drains the
+        speculative window first; standalone callers own that contract.
+        jax/numpy leaves are immutable, so holding references is a true
+        snapshot; the staging pass makes the one host copy the heal plane
+        already budgets for."""
+        t0 = time.perf_counter()
+        with self._lock:
+            self._due = None
+        manifest = self._transport.send_checkpoint(
+            dst_ranks=[],
+            step=step,
+            state_dict=state,
+            timeout=self._timeout,
+            quorum_id=quorum_id,
+        )
+        if manifest is None:
+            raise RuntimeError(
+                "WeightPublisher needs a manifest-returning transport "
+                "(HTTPTransport); got None from send_checkpoint"
+            )
+        latest = latest_descriptor(
+            manifest, base=self._transport.metadata(), published_ts=time.time()
+        )
+        with self._lock:
+            self._latest = latest
+        elapsed = time.perf_counter() - t0
+        nbytes = sum(manifest["chunk_sizes"])
+        metrics.inc("tpuft_publish_total")
+        metrics.inc("tpuft_publish_bytes_total", nbytes)
+        metrics.observe("tpuft_publish_stage_seconds", elapsed)
+        metrics.set_gauge("tpuft_publish_last_step", step)
+        metrics.set_gauge("tpuft_publish_last_time", time.time())
+        tracing.record(
+            "publish",
+            step=step,
+            quorum_id=quorum_id,
+            bytes=nbytes,
+            digest=str(manifest["digest"])[:12],
+        )
+        return latest
+
+    def register_error_callback(self, cb: Callable[[Exception], None]) -> None:
+        """Serving-sidecar crash funnel, forwarded to the publication
+        transport (mirrors the heal transport's contract — the manager
+        wires report_error here so a crashed publish sidecar poisons a
+        step instead of raising past the boundary)."""
+        self._transport.register_error_callback(cb)
+
+    def shutdown(self, wait: bool = True) -> None:
+        # Idempotent: the manager's shutdown hook and a direct call may
+        # both reach here.
+        with self._lock:
+            if self._shutdown:
+                return
+            self._shutdown = True
+        self._server.shutdown()
+        self._server.server_close()
+        if self._owns_transport:
+            self._transport.shutdown(wait=wait)
+        if wait:
+            self._thread.join(timeout=5)

@@ -1,0 +1,480 @@
+"""Caching fan-out relay for the committed-weights serving plane.
+
+A :class:`CachingRelay` sits between the training fleet's publishers and
+a reader population: it polls the upstreams' ``/serving/latest``, pulls
+each new version into an in-memory chunk cache, and serves the same
+protocol back out — so relays stack (a relay's upstream can be another
+relay) and readers hammer relay RAM instead of the training fleet.
+
+Resilience properties (the heal plane's, applied to serving):
+
+- **Atomic versions**: a pulled version becomes visible only after EVERY
+  chunk verified against the descriptor's CRCs and the descriptor's
+  digest verified as the binding of those CRCs — readers can never
+  observe a torn or half-pulled version.
+- **Delta-aware pulls**: chunks whose ``(crc, size)`` matches the cached
+  previous version are reused without fetching (the delta-rejoin match,
+  PR-8), so steady-state version bumps move only changed bytes
+  (``tpuft_serving_delta_bytes_saved_total``).
+- **Upstream failover mid-pull**: the chunk fetch walks every upstream
+  currently announcing the same digest (committed state is bitwise
+  identical across the fleet — the striped-heal argument); an upstream
+  that dies mid-pull is fenced and its chunks re-fetched from survivors.
+  All upstreams dead aborts the pull and keeps serving the last good
+  version — degradation is staleness, never unavailability or
+  corruption.
+- **Era fencing**: a descriptor whose quorum era regresses below the
+  held version's is rejected (a stale survivor cannot roll readers
+  back); chunk GETs accept the same ``?quorum_id`` tag the heal plane
+  uses and answer 409 on a mismatch.
+- **Chaos seam**: the punisher's ``kill_relay`` fault (site
+  ``serving_relay[:port]``) is consumed at the poll loop and the serve
+  handler; ``die()`` drops the process abruptly mid-service, the drill
+  asserting readers fail over without ever observing a bad version.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import socket
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional
+
+from torchft_tpu import metrics, tracing
+from torchft_tpu.checkpointing.serve_child import maybe_pace_serve
+from torchft_tpu.serving._wire import (
+    LATEST_ROUTE,
+    chunk_crc,
+    fetch_bytes,
+    fetch_json,
+    latest_descriptor,
+    validate_latest,
+)
+from torchft_tpu.utils import faultinject
+
+__all__ = ["CachingRelay", "ENV_SERVING_POLL_SEC", "serving_poll_sec"]
+
+ENV_SERVING_POLL_SEC = "TPUFT_SERVING_POLL_SEC"
+
+logger = logging.getLogger(__name__)
+
+
+def serving_poll_sec(default: float = 0.5) -> float:
+    """Upstream poll cadence (``$TPUFT_SERVING_POLL_SEC``)."""
+    try:
+        return max(0.01, float(os.environ.get(ENV_SERVING_POLL_SEC, str(default))))
+    except ValueError:
+        return default
+
+
+class _RelayVersion:
+    """One fully verified, immutable cached version."""
+
+    __slots__ = (
+        "step",
+        "quorum_id",
+        "digest",
+        "crc_algo",
+        "chunk_crcs",
+        "chunk_sizes",
+        "meta_bytes",
+        "chunks",
+        "ts",
+    )
+
+    def __init__(
+        self,
+        step: int,
+        quorum_id: Optional[int],
+        digest: str,
+        crc_algo: str,
+        chunk_crcs: List[int],
+        chunk_sizes: List[int],
+        meta_bytes: bytes,
+        chunks: List[bytes],
+        ts: float,
+    ) -> None:
+        self.step = step
+        self.quorum_id = quorum_id
+        self.digest = digest
+        self.crc_algo = crc_algo
+        self.chunk_crcs = chunk_crcs
+        self.chunk_sizes = chunk_sizes
+        self.meta_bytes = meta_bytes
+        self.chunks = chunks
+        self.ts = ts
+
+    def manifest(self) -> Dict[str, Any]:
+        return {
+            "step": self.step,
+            "quorum_id": self.quorum_id,
+            "crc_algo": self.crc_algo,
+            "chunk_crcs": self.chunk_crcs,
+            "chunk_sizes": self.chunk_sizes,
+            "num_chunks": len(self.chunk_crcs),
+            "digest": self.digest,
+        }
+
+
+class _PullFailed(RuntimeError):
+    """This pull attempt failed (every source fenced); the relay keeps
+    serving its current version and retries next poll round."""
+
+
+class CachingRelay:
+    """Pulls committed-weight versions from upstream publishers/relays and
+    fans them out to readers from an in-memory chunk cache."""
+
+    def __init__(
+        self,
+        upstreams: List[str],
+        poll_interval: Optional[float] = None,
+        timeout: float = 10.0,
+        bind_port: int = 0,
+        start: bool = True,
+    ) -> None:
+        if not upstreams:
+            raise ValueError("CachingRelay needs at least one upstream")
+        self._upstreams = list(upstreams)
+        self._timeout = timeout
+        self._poll_interval = (
+            poll_interval if poll_interval is not None else serving_poll_sec()
+        )
+        self._lock = threading.Lock()
+        self._current: Optional[_RelayVersion] = None
+        self._stop = threading.Event()
+        self.dead = False
+
+        relay = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt: str, *args: Any) -> None:
+                pass
+
+            def do_GET(self) -> None:
+                if relay._consume_fault():
+                    # kill_relay armed: die mid-service, connection cut.
+                    self.close_connection = True
+                    relay.die()
+                    return
+                if metrics._serve_metrics_http(self, metrics.REGISTRY, self.path):
+                    return
+                split = urllib.parse.urlsplit(self.path)
+                version = relay.current()
+                if split.path == LATEST_ROUTE:
+                    if version is None:
+                        self.send_error(404, "no version cached yet")
+                        return
+                    body = json.dumps(
+                        latest_descriptor(
+                            version.manifest(),
+                            base=relay.address(),
+                            published_ts=version.ts,
+                        )
+                    ).encode()
+                    metrics.inc("tpuft_serving_requests_total", route="latest")
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                parts = split.path.strip("/").split("/")
+                if len(parts) != 3 or parts[0] != "checkpoint":
+                    self.send_error(404, "unknown route")
+                    return
+                try:
+                    step = int(parts[1])
+                except ValueError:
+                    self.send_error(400, "bad step")
+                    return
+                if version is None or version.step != step:
+                    # No waiting: a reader racing a version bump retries
+                    # its poll against the new descriptor instead of
+                    # parking a relay thread.
+                    self.send_error(404, f"step {step} not cached")
+                    return
+                want_era = urllib.parse.parse_qs(split.query).get("quorum_id")
+                if (
+                    want_era
+                    and version.quorum_id is not None
+                    and str(version.quorum_id) != want_era[0]
+                ):
+                    self.send_error(
+                        409,
+                        f"stale quorum era: cached {version.quorum_id}, "
+                        f"reader wants {want_era[0]}",
+                    )
+                    return
+                if parts[2] == "meta":
+                    body = version.meta_bytes
+                    route = "meta"
+                else:
+                    try:
+                        body = version.chunks[int(parts[2])]
+                    except (ValueError, IndexError):
+                        self.send_error(400, "bad chunk index")
+                        return
+                    route = "chunk"
+                metrics.inc("tpuft_serving_requests_total", route=route)
+                metrics.inc("tpuft_serving_bytes_total", len(body))
+                self.send_response(200)
+                self.send_header("Content-Type", "application/octet-stream")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                out = maybe_pace_serve(self.wfile, cls="serving")
+                try:
+                    out.write(body)
+                except (ConnectionError, TimeoutError, OSError):
+                    self.close_connection = True
+
+        class DualStack(ThreadingHTTPServer):
+            address_family = socket.AF_INET6
+            daemon_threads = True
+
+        self._server = DualStack(("::", bind_port), Handler)
+        self._serve_thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True, name="tpuft-relay-http"
+        )
+        self._serve_thread.start()
+        self._poll_thread: Optional[threading.Thread] = None
+        if start:
+            self._poll_thread = threading.Thread(
+                target=self._poll_loop, daemon=True, name="tpuft-relay-poll"
+            )
+            self._poll_thread.start()
+
+    # -- surface -----------------------------------------------------------
+
+    def address(self) -> str:
+        host = socket.gethostname()
+        return f"http://{host}:{self._server.server_address[1]}"
+
+    def current(self) -> Optional[_RelayVersion]:
+        with self._lock:
+            return self._current
+
+    def _consume_fault(self) -> bool:
+        return (
+            faultinject.consume(
+                f"serving_relay:{self._server.server_address[1]}"
+            )
+            == "die"
+        )
+
+    def die(self) -> None:
+        """Chaos seam (punisher ``kill_relay``): drop abruptly — server
+        closed under live readers, poll loop stopped, cache gone. Readers
+        observe connection errors and fail over; they can never observe a
+        bad version (there is nothing to serve torn)."""
+        if self.dead:
+            return
+        self.dead = True
+        self._stop.set()
+        metrics.inc("tpuft_serving_relay_deaths_total")
+        tracing.record("relay_died", step=self._current.step if self._current else -1)
+        logger.warning("relay %s dying (kill_relay)", self.address())
+        threading.Thread(
+            target=self._server.shutdown, daemon=True, name="tpuft-relay-die"
+        ).start()
+        self._server.server_close()
+
+    # -- pulling -----------------------------------------------------------
+
+    def _poll_loop(self) -> None:
+        while not self._stop.wait(self._poll_interval):
+            try:
+                self.poll_once()
+            except Exception as e:  # noqa: BLE001 — keep serving, retry next round
+                metrics.inc("tpuft_serving_pull_failures_total")
+                logger.warning("relay pull failed (%s); retrying next round", e)
+
+    def poll_once(self) -> bool:
+        """One poll round: discover the newest acceptable upstream version
+        and pull it if it is new. Returns True when a new version was
+        adopted."""
+        if self._consume_fault():
+            self.die()
+            return False
+        if self.dead:
+            return False
+        best: Optional[Dict[str, Any]] = None
+        sources: List[str] = []
+        for upstream in self._upstreams:
+            try:
+                latest = fetch_json(f"{upstream}{LATEST_ROUTE}", self._timeout)
+            except Exception:  # noqa: BLE001 — a dead upstream is routine
+                metrics.inc("tpuft_serving_upstream_failovers_total")
+                continue
+            reason = validate_latest(latest)
+            if reason is not None:
+                metrics.inc("tpuft_serving_integrity_rejects_total")
+                logger.warning("upstream %s rejected: %s", upstream, reason)
+                continue
+            if best is None or _newer(latest, best):
+                best = latest
+        if best is None:
+            return False
+        # Every upstream announcing the SAME digest serves interchangeable
+        # bytes (committed state is bitwise identical) — they form this
+        # pull's failover set.
+        for upstream in self._upstreams:
+            try:
+                latest = fetch_json(f"{upstream}{LATEST_ROUTE}", self._timeout)
+            except Exception:  # noqa: BLE001
+                continue
+            if latest.get("digest") == best["digest"] and latest.get("base"):
+                sources.append(latest["base"])
+        current = self.current()
+        if current is not None:
+            if best["step"] < current.step or (
+                best["step"] == current.step and best["digest"] == current.digest
+            ):
+                return False
+            if (
+                best.get("quorum_id") is not None
+                and current.quorum_id is not None
+                and best["quorum_id"] < current.quorum_id
+            ):
+                # A stale-era survivor must never roll readers back.
+                metrics.inc("tpuft_serving_stale_era_rejects_total")
+                return False
+        self._pull(best, sources or [best["base"]], previous=current)
+        return True
+
+    def _pull(
+        self,
+        latest: Dict[str, Any],
+        sources: List[str],
+        previous: Optional[_RelayVersion],
+    ) -> None:
+        step = int(latest["step"])
+        algo: str = latest["crc_algo"]
+        crcs: List[int] = [int(c) for c in latest["chunk_crcs"]]
+        sizes: List[int] = [int(s) for s in latest["chunk_sizes"]]
+        t0 = time.perf_counter()
+        live = list(dict.fromkeys(sources))
+        meta_bytes = self._fetch_failover(
+            live, f"/checkpoint/{step}/meta", expect_crc=None, algo=algo
+        )
+        chunks: List[Optional[bytes]] = [None] * len(crcs)
+        reused = 0
+        saved = 0
+        fetched = 0
+        delta_ok = (
+            previous is not None
+            and previous.crc_algo == algo
+            and len(previous.chunk_crcs) == len(crcs)
+        )
+        for i in range(len(crcs)):
+            if (
+                delta_ok
+                and previous.chunk_crcs[i] == crcs[i]  # type: ignore[union-attr]
+                and previous.chunk_sizes[i] == sizes[i]  # type: ignore[union-attr]
+            ):
+                # Serialized (crc, size) equality implies byte-equal
+                # chunks — the PR-8 delta-rejoin argument; reuse the
+                # cached bytes instead of refetching.
+                chunks[i] = previous.chunks[i]  # type: ignore[union-attr]
+                reused += 1
+                saved += sizes[i]
+                continue
+            data = self._fetch_failover(
+                live, f"/checkpoint/{step}/{i}", expect_crc=crcs[i], algo=algo,
+                expect_size=sizes[i],
+            )
+            chunks[i] = data
+            fetched += len(data)
+        version = _RelayVersion(
+            step=step,
+            quorum_id=latest.get("quorum_id"),
+            digest=latest["digest"],
+            crc_algo=algo,
+            chunk_crcs=crcs,
+            chunk_sizes=sizes,
+            meta_bytes=meta_bytes,
+            chunks=chunks,  # type: ignore[arg-type]
+            ts=time.time(),
+        )
+        with self._lock:
+            self._current = version
+        metrics.inc("tpuft_serving_pulls_total")
+        if reused:
+            metrics.inc("tpuft_serving_delta_chunks_reused_total", reused)
+            metrics.inc("tpuft_serving_delta_bytes_saved_total", saved)
+        metrics.set_gauge("tpuft_serving_version_step", step)
+        tracing.record(
+            "serving_pull",
+            step=step,
+            quorum_id=latest.get("quorum_id"),
+            fetched_bytes=fetched,
+            reused_chunks=reused,
+            bytes_saved=saved,
+            duration_s=round(time.perf_counter() - t0, 6),
+        )
+
+    def _fetch_failover(
+        self,
+        live: List[str],
+        route: str,
+        expect_crc: Optional[int],
+        algo: str,
+        expect_size: Optional[int] = None,
+    ) -> bytes:
+        """Fetches ``route`` from the first live source that serves valid
+        bytes; a source that fails (dead, corrupt, truncated) is fenced
+        from THIS pull and the fetch fails over — the striped-heal
+        reassignment shape, sized for a relay (fences mutate ``live`` in
+        place so later chunks skip the dead source up front)."""
+        while live:
+            base = live[0]
+            try:
+                data = fetch_bytes(f"{base}{route}", self._timeout)
+                if expect_size is not None and len(data) != expect_size:
+                    raise ValueError(
+                        f"short read: {len(data)} != {expect_size} bytes"
+                    )
+                if expect_crc is not None and chunk_crc(data, algo) != expect_crc:
+                    raise ValueError("chunk checksum mismatch")
+                # Round-robin across the survivors so a multi-upstream
+                # pull spreads load like a striped heal.
+                live.append(live.pop(0))
+                return data
+            except Exception as e:  # noqa: BLE001 — fence and fail over
+                live.pop(0)
+                metrics.inc("tpuft_serving_upstream_failovers_total")
+                logger.warning(
+                    "relay fetch %s from %s failed (%s); %d source(s) left",
+                    route,
+                    base,
+                    e,
+                    len(live),
+                )
+        raise _PullFailed(f"every source failed for {route}")
+
+    def shutdown(self, wait: bool = True) -> None:
+        self._stop.set()
+        if not self.dead:
+            self._server.shutdown()
+            self._server.server_close()
+        if wait:
+            self._serve_thread.join(timeout=5)
+            if self._poll_thread is not None:
+                self._poll_thread.join(timeout=5)
+
+
+def _newer(a: Dict[str, Any], b: Dict[str, Any]) -> bool:
+    """Version ordering across candidate descriptors: quorum era first
+    (never prefer a stale-era survivor), then step."""
+    era_a = a.get("quorum_id")
+    era_b = b.get("quorum_id")
+    if era_a is not None and era_b is not None and era_a != era_b:
+        return era_a > era_b
+    return int(a["step"]) > int(b["step"])

@@ -1,0 +1,180 @@
+"""Reader client for the committed-weights serving plane.
+
+A :class:`WeightSubscriber` polls one or more serving endpoints (relays
+or publishers — they speak the same protocol) and atomically swaps to
+the newest *fully verified* version:
+
+- the ``/serving/latest`` descriptor must bind its digest to its
+  per-chunk CRCs (checked before any transfer);
+- the pickled ``/meta`` must carry the SAME digest (the torn-read fence:
+  a version bump between the descriptor fetch and the meta fetch changes
+  the digest, aborting this poll instead of mixing versions);
+- every chunk verifies against its CRC and size before decode;
+- only then does :meth:`current` flip to the new
+  :class:`ServingVersion` — a reader can never observe a torn, partially
+  adopted, or corrupt version, and a failed poll leaves the held version
+  untouched.
+
+Era discipline: a descriptor whose quorum era regresses below the held
+version's is a stale-era read and is rejected
+(``tpuft_serving_stale_era_rejects_total``); steps are monotone.
+
+Delta-aware: decoded chunks are cached per index with their ``(crc,
+size)``; a version bump re-decodes (and re-fetches) only chunks that
+actually changed — the reader-side twin of the relay's delta pull.
+"""
+
+from __future__ import annotations
+
+import io
+import logging
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+
+from torchft_tpu import metrics
+from torchft_tpu._safe_pickle import safe_loads
+from torchft_tpu.checkpointing import _serialization
+from torchft_tpu.serving._wire import (
+    LATEST_ROUTE,
+    chunk_crc,
+    fetch_bytes,
+    fetch_json,
+    validate_latest,
+)
+
+__all__ = ["WeightSubscriber", "ServingVersion"]
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class ServingVersion:
+    """One adopted version: the unflattened params plus its identity."""
+
+    step: int
+    quorum_id: Optional[int]
+    digest: str
+    params: Any
+    ts: float
+
+
+class WeightSubscriber:
+    """Polls serving endpoints and holds the newest verified version."""
+
+    def __init__(self, endpoints: List[str], timeout: float = 10.0) -> None:
+        if not endpoints:
+            raise ValueError("WeightSubscriber needs at least one endpoint")
+        self._endpoints = list(endpoints)
+        self._timeout = timeout
+        self._version: Optional[ServingVersion] = None
+        # chunk index -> (crc, size, decoded chunk dict): the delta cache.
+        self._chunk_cache: Dict[int, Tuple[int, int, Any]] = {}
+
+    def current(self) -> Optional[ServingVersion]:
+        return self._version
+
+    def poll(self) -> Optional[ServingVersion]:
+        """One poll round; returns the newly adopted version, or None when
+        there is nothing new (or this round failed — the held version is
+        untouched either way)."""
+        try:
+            return self._poll()
+        except Exception as e:  # noqa: BLE001 — a failed poll is staleness
+            metrics.inc("tpuft_serving_reader_poll_failures_total")
+            logger.warning("subscriber poll failed (%s); keeping held version", e)
+            return None
+
+    def _fetch_latest(self) -> Optional[Dict[str, Any]]:
+        for _ in range(len(self._endpoints)):
+            endpoint = self._endpoints[0]
+            try:
+                return fetch_json(f"{endpoint}{LATEST_ROUTE}", self._timeout)
+            except Exception:  # noqa: BLE001 — fail over to the next endpoint
+                # Rotate so a dead endpoint stops being everyone's first
+                # try; it heals back in naturally once others fail.
+                self._endpoints.append(self._endpoints.pop(0))
+                metrics.inc("tpuft_serving_reader_failovers_total")
+        return None
+
+    def _poll(self) -> Optional[ServingVersion]:
+        latest = self._fetch_latest()
+        if latest is None:
+            metrics.inc("tpuft_serving_reader_poll_failures_total")
+            return None
+        reason = validate_latest(latest)
+        if reason is not None:
+            metrics.inc("tpuft_serving_integrity_rejects_total")
+            logger.warning("serving descriptor rejected: %s", reason)
+            return None
+        held = self._version
+        step = int(latest["step"])
+        if held is not None:
+            if step <= held.step:
+                return None
+            if (
+                latest.get("quorum_id") is not None
+                and held.quorum_id is not None
+                and latest["quorum_id"] < held.quorum_id
+            ):
+                metrics.inc("tpuft_serving_stale_era_rejects_total")
+                return None
+        base: str = latest["base"]
+        algo: str = latest["crc_algo"]
+        crcs: List[int] = [int(c) for c in latest["chunk_crcs"]]
+        sizes: List[int] = [int(s) for s in latest["chunk_sizes"]]
+        meta = safe_loads(
+            fetch_bytes(f"{base}/checkpoint/{step}/meta", self._timeout)
+        )
+        if (
+            not isinstance(meta, dict)
+            or meta.get("step") != step
+            or meta.get("digest") != latest["digest"]
+        ):
+            # The serving side moved on between our descriptor and meta
+            # fetches — abort THIS poll; the next one sees a consistent
+            # pair. This is the fence that makes torn reads structurally
+            # impossible.
+            return None
+        treedef = meta["treedef"]
+        new_cache: Dict[int, Tuple[int, int, Any]] = {}
+        fetched_bytes = 0
+        saved = 0
+        for i in range(len(crcs)):
+            cached = self._chunk_cache.get(i)
+            if cached is not None and cached[0] == crcs[i] and cached[1] == sizes[i]:
+                new_cache[i] = cached
+                saved += sizes[i]
+                continue
+            data = fetch_bytes(f"{base}/checkpoint/{step}/{i}", self._timeout)
+            if len(data) != sizes[i] or chunk_crc(data, algo) != crcs[i]:
+                metrics.inc("tpuft_serving_integrity_rejects_total")
+                raise ValueError(
+                    f"chunk {i} of version {step} failed verification; "
+                    "discarding this poll"
+                )
+            chunk = _serialization.load_state_dict(io.BytesIO(data))
+            new_cache[i] = (crcs[i], sizes[i], chunk)
+            fetched_bytes += len(data)
+        merged: Dict[int, Any] = {}
+        for _crc, _size, chunk in new_cache.values():
+            merged.update(chunk)
+        leaves = [merged[i] for i in range(treedef.num_leaves)]
+        params = jax.tree_util.tree_unflatten(treedef, leaves)
+        version = ServingVersion(
+            step=step,
+            quorum_id=latest.get("quorum_id"),
+            digest=latest["digest"],
+            params=params,
+            ts=time.time(),
+        )
+        # The swap is the adoption point: everything above verified.
+        self._version = version
+        self._chunk_cache = new_cache
+        metrics.inc("tpuft_serving_reader_versions_total")
+        metrics.inc("tpuft_serving_reader_bytes_total", fetched_bytes)
+        if saved:
+            metrics.inc("tpuft_serving_delta_bytes_saved_total", saved)
+        return version
