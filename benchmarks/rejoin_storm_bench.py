@@ -1,0 +1,381 @@
+#!/usr/bin/env python
+"""Mass-rejoin storm benchmark: time-to-full-strength vs joiner count.
+
+The production scenario ROADMAP item 3 names: a preemption wave returns
+N replicas AT ONCE and they all stripe the same donor set. This bench
+pins the storm plane's acceptance number — **time-to-full-strength
+(TTFS): kill-wave → last joiner back at max_step — must scale
+SUB-LINEARLY in joiner count** against a fixed donor set, because donors
+serve joiners in parallel (per-joiner fair shares of each donor's paced
+egress) while each joiner is bounded by its own ingress cap.
+
+Topology (wire-level, like transport_bench's striped legs):
+
+- **4 donor PROCESSES**, each staging the same seeded state (bitwise
+  identical, like committed replicas) and serving with a per-donor
+  egress bound (``TPUFT_HEAL_SERVE_GBPS``, default 0.08 ≈ 10 MB/s — a
+  per-NIC share sized under this 1-core box's verify-path ceiling, so
+  the measured scaling is the wire story, not the CPU scheduler's).
+- **One joiner-leg PROCESS per leg** running N joiner THREADS (each with
+  its own ``HTTPTransport`` — its own fairness peer tag, its own
+  ``stripe_rotation`` seed j, and a per-attempt ingress bucket from
+  ``TPUFT_HEAL_INGRESS_GBPS``, default 0.16 ≈ 20 MB/s). Legs: N = 1, 2,
+  4, 8 against the SAME 4 donors.
+- A final **chaos leg** (N = 4) SIGKILLs one donor mid-storm: every
+  joiner must still land bitwise identical in the same attempt via
+  stripe reassignment.
+
+Expected physics with the defaults (payload P, donor egress D_agg,
+joiner ingress I): TTFS(N) ≈ N·P / min(D_agg, N·I) — flat while the
+joiners' aggregate ingress is the binding constraint, then growing with
+N/D_agg once donor egress binds: sub-linear everywhere. The committed
+artifact also pins the counter-exact hygiene line: zero checksum
+failures, zero era rejects, zero heal exhaustions, and per-leg digest
+identity (zero wrong adoptions) across every leg including the chaos
+one.
+
+Usage: ``python benchmarks/rejoin_storm_bench.py`` → one JSON line on
+stdout + REJOIN_STORM_BENCH.json in the repo root.
+Env: TPUFT_STORM_BENCH_MB (payload, default 24), TPUFT_STORM_BENCH_GBPS
+(per-donor egress), TPUFT_STORM_BENCH_INGRESS_GBPS (per-joiner ingress),
+TPUFT_STORM_BENCH_DEADLINE (seconds, default 600).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import zlib
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+NUM_DONORS = 4
+NUM_CHUNKS = 24
+JOINER_LEGS = (1, 2, 4, 8)
+STEP = 7
+ERA = 7
+
+
+def _force_cpu() -> None:
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        pass
+
+
+def _emit(obj: dict) -> None:
+    sys.stdout.write(json.dumps(obj) + "\n")
+    sys.stdout.flush()
+
+
+def synth_state(total_bytes: int) -> dict:
+    """Seeded leaves, bitwise identical across processes."""
+    import numpy as np
+
+    rng = np.random.default_rng(1234)
+    n_leaves = NUM_CHUNKS  # one leaf per chunk: full stripe granularity
+    per = total_bytes // n_leaves // 4
+    return {
+        f"w{i}": rng.standard_normal(per).astype(np.float32)
+        for i in range(n_leaves)
+    }
+
+
+def state_digest(state: dict) -> str:
+    import numpy as np
+
+    crc = 0
+    for key in sorted(state):
+        crc = zlib.crc32(np.ascontiguousarray(state[key]).tobytes(), crc)
+    return f"{crc:#010x}"
+
+
+# ---------------------------------------------------------------------------
+# roles (subprocesses)
+# ---------------------------------------------------------------------------
+
+
+def role_donor(total_bytes: int) -> None:
+    """One donor of the fixed set: stages once, serves (egress-paced via
+    TPUFT_HEAL_SERVE_GBPS set by the parent) until stdin closes."""
+    _force_cpu()
+    from torchft_tpu.checkpointing.http_transport import HTTPTransport
+
+    state = synth_state(total_bytes)
+    donor = HTTPTransport(timeout=600.0, num_chunks=NUM_CHUNKS)
+    donor.send_checkpoint(
+        [1], step=STEP, state_dict=state, timeout=600.0, quorum_id=ERA
+    )
+    _emit({"addr": donor.metadata(), "digest": state_digest(state)})
+    sys.stdin.readline()
+    donor.shutdown()
+
+
+def role_leg(num_joiners: int, addrs_csv: str) -> None:
+    """One storm leg: N joiner threads, each its own transport (own peer
+    tag + ingress bucket), each seeding its stripe plan at rotation j —
+    exactly what N healing managers would derive. Emits per-joiner walls
+    + the leg's counter deltas + digests."""
+    _force_cpu()
+    from torchft_tpu import metrics
+    from torchft_tpu.checkpointing.http_transport import HTTPTransport
+
+    addrs = addrs_csv.split(",")
+    results: list = [None] * num_joiners
+    errors: list = []
+    barrier = threading.Barrier(num_joiners)
+
+    def joiner(j: int) -> None:
+        transport = HTTPTransport(timeout=600.0)
+        try:
+            barrier.wait(timeout=60)
+            t0 = time.monotonic()
+            state = transport.recv_checkpoint(
+                0,
+                addrs[j % len(addrs)],  # anchor donors round-robin too
+                STEP,
+                timeout=600.0,
+                quorum_id=ERA,
+                donors=[a for a in addrs if a != addrs[j % len(addrs)]],
+                stripe_rotation=j,
+            )
+            results[j] = {
+                "wall_s": round(time.monotonic() - t0, 3),
+                "digest": state_digest(state),
+            }
+        except Exception as e:  # noqa: BLE001 — reported, not swallowed
+            errors.append(f"joiner {j}: {type(e).__name__}: {e}")
+        finally:
+            transport.shutdown()
+
+    def counters() -> dict:
+        return {
+            "checksum_failures": metrics.counter_total(
+                "tpuft_heal_checksum_failures_total"
+            ),
+            "era_rejects": metrics.counter_total("tpuft_heal_era_rejects_total"),
+            "stalled_fetches": metrics.counter_total(
+                "tpuft_heal_stalled_fetches_total"
+            ),
+            "heal_exhausted_incidents": metrics.counter_total(
+                "tpuft_trace_incidents_total", kind="heal_exhausted"
+            ),
+            "stripe_chunks": metrics.counter_total(
+                "tpuft_heal_stripe_chunks_total"
+            ),
+            "donor_failures": metrics.counter_total(
+                "tpuft_heal_stripe_donor_failures_total"
+            ),
+            "refetched_bytes": metrics.counter_total(
+                "tpuft_heal_stripe_refetched_bytes_total"
+            ),
+            "ingress_paced_s": metrics.counter_total(
+                "tpuft_heal_ingress_paced_seconds_total"
+            ),
+        }
+
+    _emit({"event": "leg_start", "t_wall": time.time()})
+    before = counters()
+    t0 = time.monotonic()
+    threads = [
+        threading.Thread(target=joiner, args=(j,), name=f"joiner-{j}")
+        for j in range(num_joiners)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    ttfs = time.monotonic() - t0
+    after = counters()
+    _emit(
+        {
+            "ttfs_s": round(ttfs, 3),
+            "joiners": results,
+            "errors": errors,
+            "counters": {k: after[k] - before[k] for k in after},
+        }
+    )
+
+
+# ---------------------------------------------------------------------------
+# orchestration
+# ---------------------------------------------------------------------------
+
+
+def _spawn(role: str, *args: str, env: dict | None = None) -> subprocess.Popen:
+    child_env = dict(os.environ)
+    child_env["JAX_PLATFORMS"] = "cpu"
+    child_env.update(env or {})
+    return subprocess.Popen(
+        [sys.executable, str(Path(__file__).resolve()), "--role", role, *args],
+        stdin=subprocess.PIPE,
+        stdout=subprocess.PIPE,
+        text=True,
+        env=child_env,
+    )
+
+
+def _read_json(proc: subprocess.Popen, deadline: float) -> dict:
+    line = [None]
+
+    def read() -> None:
+        assert proc.stdout is not None
+        line[0] = proc.stdout.readline()
+
+    t = threading.Thread(target=read, daemon=True)
+    t.start()
+    t.join(timeout=deadline)
+    if line[0] is None or not line[0].strip():
+        raise TimeoutError(f"child produced no JSON within {deadline}s")
+    return json.loads(line[0])
+
+
+def main() -> None:
+    if "--role" in sys.argv:
+        i = sys.argv.index("--role")
+        role = sys.argv[i + 1]
+        if role == "donor":
+            role_donor(int(sys.argv[i + 2]))
+        elif role == "leg":
+            role_leg(int(sys.argv[i + 2]), sys.argv[i + 3])
+        else:
+            raise SystemExit(f"unknown role {role}")
+        return
+
+    payload_mb = float(os.environ.get("TPUFT_STORM_BENCH_MB", "24"))
+    gbps = float(os.environ.get("TPUFT_STORM_BENCH_GBPS", "0.08"))
+    ingress = float(os.environ.get("TPUFT_STORM_BENCH_INGRESS_GBPS", "0.16"))
+    deadline = float(os.environ.get("TPUFT_STORM_BENCH_DEADLINE", "600"))
+    total_bytes = int(payload_mb * (1 << 20))
+
+    donor_env = {"TPUFT_HEAL_SERVE_GBPS": str(gbps)}
+    leg_env = {"TPUFT_HEAL_INGRESS_GBPS": str(ingress)}
+    donors = [
+        _spawn("donor", str(total_bytes), env=donor_env)
+        for _ in range(NUM_DONORS)
+    ]
+    out: dict = {
+        "payload_mb": payload_mb,
+        "num_donors": NUM_DONORS,
+        "num_chunks": NUM_CHUNKS,
+        "per_donor_gbps": gbps,
+        "per_joiner_ingress_gbps": ingress,
+        "legs": {},
+    }
+    try:
+        staged = [_read_json(d, deadline) for d in donors]
+        digest = staged[0]["digest"]
+        assert all(s["digest"] == digest for s in staged), "donors disagree"
+        addrs = ",".join(s["addr"] for s in staged)
+
+        for n in JOINER_LEGS:
+            leg = _spawn("leg", str(n), addrs, env=leg_env)
+            started = _read_json(leg, deadline)
+            assert started.get("event") == "leg_start", started
+            result = _read_json(leg, deadline)
+            leg.wait(timeout=60)
+            assert not result["errors"], result["errors"]
+            joiners = result["joiners"]
+            assert all(j and j["digest"] == digest for j in joiners), (
+                "wrong adoption"
+            )
+            walls = [j["wall_s"] for j in joiners]
+            out["legs"][f"joiners_{n}"] = {
+                "num_joiners": n,
+                "ttfs_s": result["ttfs_s"],
+                "joiner_walls_s": walls,
+                # Fairness: how unevenly the N joiners finished.
+                "fairness_spread": round(
+                    (max(walls) - min(walls)) / max(walls), 3
+                ),
+                "counters": result["counters"],
+            }
+            print(
+                f"[storm] {n} joiner(s): ttfs {result['ttfs_s']}s "
+                f"(walls {walls})",
+                file=sys.stderr,
+            )
+
+        t1 = out["legs"]["joiners_1"]["ttfs_s"]
+        for n in JOINER_LEGS:
+            leg = out["legs"][f"joiners_{n}"]
+            leg["scaling_vs_1"] = round(leg["ttfs_s"] / t1, 2)
+            leg["sublinear"] = n == 1 or leg["scaling_vs_1"] < n
+        out["sublinear"] = all(
+            out["legs"][f"joiners_{n}"]["sublinear"] for n in JOINER_LEGS
+        )
+
+        # Chaos leg: 4 joiners, one donor SIGKILLed mid-storm — the storm
+        # must finish in the SAME attempt via stripe reassignment.
+        leg = _spawn("leg", "4", addrs, env=leg_env)
+        started = _read_json(leg, deadline)
+        assert started.get("event") == "leg_start", started
+        expected_s = max(out["legs"]["joiners_4"]["ttfs_s"], 1.0)
+        time.sleep(expected_s * 0.4)
+        victim = donors[-1]
+        victim.kill()
+        result = _read_json(leg, deadline)
+        leg.wait(timeout=60)
+        assert not result["errors"], result["errors"]
+        assert all(
+            j and j["digest"] == digest for j in result["joiners"]
+        ), "wrong adoption in the chaos leg"
+        out["storm_with_donor_kill"] = {
+            "num_joiners": 4,
+            "ttfs_s": result["ttfs_s"],
+            "joiner_walls_s": [j["wall_s"] for j in result["joiners"]],
+            "counters": result["counters"],
+            "donor_failures_observed": result["counters"]["donor_failures"],
+            # A SIGKILLed donor can tear a stream AT a chunk boundary;
+            # the CRC catches it, the chunk re-fetches from a survivor —
+            # caught corruption, the opposite of a wrong adoption.
+            "torn_streams_caught_by_crc": result["counters"][
+                "checksum_failures"
+            ],
+        }
+
+        # Counter-exact hygiene (PR-8 methodology): clean legs see ZERO
+        # checksum failures / era rejects / heal exhaustions; the chaos
+        # leg may catch torn streams by CRC (counted above) but every
+        # joiner's final digest equals the committed one (asserted per
+        # leg), nothing heals backwards, nothing exhausts.
+        out["zero_wrong_adoption"] = all(
+            leg["counters"]["checksum_failures"] == 0
+            and leg["counters"]["era_rejects"] == 0
+            and leg["counters"]["heal_exhausted_incidents"] == 0
+            for leg in out["legs"].values()
+        ) and (
+            out["storm_with_donor_kill"]["counters"]["era_rejects"] == 0
+            and out["storm_with_donor_kill"]["counters"][
+                "heal_exhausted_incidents"
+            ]
+            == 0
+        )
+    finally:
+        for d in donors:
+            if d.poll() is None:
+                try:
+                    assert d.stdin is not None
+                    d.stdin.write("done\n")
+                    d.stdin.flush()
+                except OSError:
+                    pass
+        time.sleep(0.2)
+        for d in donors:
+            if d.poll() is None:
+                d.kill()
+
+    artifact = Path(__file__).resolve().parents[1] / "REJOIN_STORM_BENCH.json"
+    artifact.write_text(json.dumps(out, indent=2) + "\n")
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -8,7 +8,9 @@ Every Manager publishes its process metrics into its group store under
 lighthouse status reports each member's ``replica_id`` + store address —
 so one status RPC plus one store get per rank renders the whole fleet
 without touching any training process: step, step rate, commits, last
-commit age, heal-in-progress, heartbeat age. The LAG column derives
+commit age, heal-in-progress, the joiner count each replica observed in
+its last quorum (the JOINERS column — the mass-rejoin storm gauge),
+heartbeat age. The LAG column derives
 straggler attribution from the trace plane's pushed per-step phase
 durations (``trace/<replica_id>/<rank>``): at the latest shared step, the
 rank that waited least in the commit barrier entered it last — its lag is
@@ -194,11 +196,17 @@ def collect(lighthouse_addr: str, prev: Optional[Dict[str, Any]] = None) -> Dict
             }
             if snap is not None:
                 last_commit = _gauge(snap, "tpuft_last_commit_time")
+                joiners = _gauge(snap, "tpuft_heal_storm_joiners")
                 row.update(
                     step=snap.get("step"),
                     batches_committed=snap.get("batches_committed"),
                     healing=bool(snap.get("healing"))
                     or _gauge(snap, "tpuft_healing") == 1,
+                    # Storm visibility: how many joiners THIS replica saw
+                    # in its last quorum (pushed gauge). Disagreement
+                    # across rows is itself a signal — someone is acting
+                    # on a stale quorum view.
+                    joiners=int(joiners) if joiners is not None else None,
                     commits=_counter_total(snap, "tpuft_commits_total"),
                     commit_failures=_counter_total(
                         snap, "tpuft_commit_failures_total"
@@ -251,6 +259,7 @@ _COLUMNS = (
     ("lag_s", "LAG"),
     ("last_commit_age_s", "LAST COMMIT"),
     ("healing", "HEALING"),
+    ("joiners", "JOINERS"),
     ("heartbeat_age_ms", "HB AGE MS"),
     ("push_age_s", "PUSH AGE"),
 )

@@ -540,6 +540,50 @@ def explain_step(merged: List[Dict[str, Any]], step: int) -> str:
             f"heal progress: {len(chunks)} chunk(s) verified, last chunk "
             f"{args.get('chunk')} of {args.get('total_chunks')}"
         )
+    # Mass-rejoin storm table: when more than one joiner healed in this
+    # era, print one row per joiner — chunks verified, bytes, and which
+    # donor each stripe came from — plus the coordinated plan offsets,
+    # so "half the fleet just rejoined" reads as a table, not a blur of
+    # interleaved chunk lines.
+    chunks_by_joiner: Dict[ProcKey, List[Dict[str, Any]]] = {}
+    for e in chunks:
+        chunks_by_joiner.setdefault(proc_key(e), []).append(e)
+    if len(chunks_by_joiner) > 1:
+        plans = {
+            proc_key(e): e.get("args") or {}
+            for e in at_step
+            if e["name"] == "heal_stripe_plan"
+        }
+        lines.append(
+            f"rejoin storm: {len(chunks_by_joiner)} joiner(s) healing "
+            "concurrently in this era"
+        )
+        for joiner in sorted(chunks_by_joiner):
+            evs = chunks_by_joiner[joiner]
+            total = (evs[-1].get("args") or {}).get("total_chunks", "?")
+            nbytes = sum(
+                float((e.get("args") or {}).get("bytes", 0)) for e in evs
+            )
+            donors: Dict[str, int] = {}
+            for e in evs:
+                donor = (e.get("args") or {}).get("donor")
+                if donor:
+                    donors[donor] = donors.get(donor, 0) + 1
+            plan = plans.get(joiner)
+            plan_txt = (
+                f", plan rotation {plan.get('rotation')} over "
+                f"{plan.get('donors')} donor(s)"
+                if plan
+                else ""
+            )
+            donor_txt = (
+                " ".join(f"{d}({n})" for d, n in sorted(donors.items()))
+                or "?"
+            )
+            lines.append(
+                f"  {proc_label(joiner)}: {len(evs)}/{total} chunk(s) "
+                f"({_fmt_mb(nbytes)}) from {donor_txt}{plan_txt}"
+            )
     # Striped-heal breakdown: one line per donor stripe (who served how
     # much), one per reassignment (which donor's stripe moved and why),
     # one for the delta-rejoin savings.

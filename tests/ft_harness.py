@@ -70,6 +70,9 @@ FT_COUNTERS = (
     "zero_shards_moved",
     "zero_shard_reinits",
     "zero_heal_bytes_saved",
+    "ingress_paced_seconds",
+    "ingress_bytes",
+    "heal_exhausted_incidents",
 )
 
 
@@ -148,6 +151,15 @@ def ft_counter_snapshot(replica_id: str = "") -> Dict[str, float]:
         ),
         "delta_bytes_saved": metrics.counter_total(
             "tpuft_heal_delta_bytes_saved_total"
+        ),
+        # Storm-plane accounting: the joiner ingress bound's injected
+        # pacing, and heal exhaustions (a storm drill's hard zero).
+        "ingress_paced_seconds": metrics.counter_total(
+            "tpuft_heal_ingress_paced_seconds_total"
+        ),
+        "ingress_bytes": metrics.counter_total("tpuft_heal_ingress_bytes_total"),
+        "heal_exhausted_incidents": metrics.counter_total(
+            "tpuft_trace_incidents_total", kind="heal_exhausted"
         ),
     }
 

@@ -246,3 +246,93 @@ def test_chaos_soak_full_fault_menu(tmp_path) -> None:
     for dump in (out_dir / "fr").glob("tpuft_fr_*.jsonl"):
         entries = [json.loads(l) for l in dump.read_text().splitlines()]
         assert entries and "flight_recorder_dump_reason" in entries[0]
+
+
+@pytest.mark.slow
+def test_rejoin_storm_soak(tmp_path) -> None:
+    """The mass-rejoin storm soak (slow — the soak-menu leg of ISSUE 11's
+    storm plane; the tier-1 storm coverage is the threads-as-replicas
+    drill in tests/test_rejoin_storm.py): a real 4-group multi-process
+    job where the punisher fires ``kill_half_fleet`` TWICE, so two of
+    the four groups die and relaunch together each time and re-enter as
+    simultaneous joiners striping the same donor set. The storm is
+    triggered on OBSERVED lighthouse membership (never timed sleeps);
+    the master invariant stays bitwise identity across all four groups,
+    with zero heal exhaustions."""
+    import socket
+
+    from tests.test_lighthouse_failure import _spawn_lighthouse
+    from torchft_tpu.coordination import LighthouseClient
+    from torchft_tpu.launch import supervise
+    from torchft_tpu.punisher import kill_half_fleet
+
+    num_groups = 4
+    storms = int(os.environ.get("TPUFT_STORM_SOAK_ROUNDS", "2"))
+    soak_seconds = float(os.environ.get("TPUFT_SOAK_SECONDS", "40"))
+    soak_seed = int(os.environ.get("TPUFT_SOAK_SEED", "1234"))
+    repo = pathlib.Path(__file__).resolve().parents[1]
+    script = tmp_path / "storm_job.py"
+    script.write_text(_TRAIN_SCRIPT.replace("@REPO@", str(repo)))
+    out_dir = tmp_path / "out"
+    out_dir.mkdir()
+
+    with socket.create_server(("127.0.0.1", 0)) as s:
+        lh_port = s.getsockname()[1]
+    lh = _spawn_lighthouse(
+        lh_port, min_replicas=1, join_timeout_ms=2000, heartbeat_timeout_ms=2000
+    )
+    lh_addr = f"127.0.0.1:{lh_port}"
+    stop = threading.Event()
+    storms_fired = {"count": 0}
+
+    def punish() -> None:
+        client = LighthouseClient(lh_addr)
+        rng = random.Random(soak_seed)
+        deadline = time.monotonic() + soak_seconds
+        while (
+            storms_fired["count"] < storms
+            and time.monotonic() < deadline
+            and not stop.is_set()
+        ):
+            # Gate each storm on OBSERVED membership: fire only when the
+            # full fleet is heartbeating and nobody is still joining —
+            # i.e. the previous storm's joiners have fully rejoined.
+            try:
+                status = client.status()
+                full = [m for m in status.members if not m.joining]
+                if len(full) >= num_groups and kill_half_fleet(client, rng):
+                    storms_fired["count"] += 1
+            except Exception as e:  # noqa: BLE001
+                print(f"[storm-soak] status/kill ended with: {e}")
+            if stop.wait(0.5):  # poll cadence, not a correctness gate
+                return
+
+    punisher = threading.Thread(target=punish, daemon=True)
+    punisher.start()
+    try:
+        code = supervise(
+            [sys.executable, str(script)],
+            num_replica_groups=num_groups,
+            lighthouse_addr=lh_addr,
+            relaunch_interval=0.5,
+            max_restarts=100,
+            extra_env={
+                "SOAK_OUT": str(out_dir),
+                "SOAK_STEPS": str(int(soak_seconds * 10)),
+                "TPUFT_LOG": "warn",
+            },
+        )
+    finally:
+        stop.set()
+        punisher.join(timeout=30)
+        lh.kill()
+    assert code == 0
+    assert storms_fired["count"] >= 1, "no storm was ever deliverable"
+
+    digests = {}
+    for group in range(num_groups):
+        data = json.loads((out_dir / f"group{group}.json").read_text())
+        digests[group] = data["digest"]
+    # Master invariant: every group — including the storm's rejoiners —
+    # ends bitwise identical.
+    assert len(set(digests.values())) == 1, digests

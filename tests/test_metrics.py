@@ -515,6 +515,7 @@ def test_fleet_status_render_and_extractors() -> None:
                 "tpuft_last_commit_time": [{"labels": {}, "value": 99.0}],
                 "tpuft_zero_num_shards": [{"labels": {}, "value": 8.0}],
                 "tpuft_zero_owned_shards": [{"labels": {}, "value": 2.0}],
+                "tpuft_heal_storm_joiners": [{"labels": {}, "value": 2.0}],
             },
             "histograms": {},
         },
@@ -525,6 +526,8 @@ def test_fleet_status_render_and_extractors() -> None:
     # ZeRO ownership column: "owned/num_shards"; None without the plane.
     assert fleet_status._shard_state(snap) == "2/8"
     assert fleet_status._shard_state({"metrics": {"gauges": {}}}) is None
+    # Storm gauge feeding the JOINERS column.
+    assert fleet_status._gauge(snap, "tpuft_heal_storm_joiners") == 2.0
 
     table = {
         "ts": 100.0,
@@ -554,7 +557,7 @@ def test_fleet_status_render_and_extractors() -> None:
     assert lines[1].split() == [
         "REPLICA", "RANK", "STEP", "STEP/S", "COMMITS", "FAILED", "HEALS",
         "SERVE", "SHARD", "PUBLISH", "LAG", "LAST", "COMMIT", "HEALING",
-        "HB", "AGE", "MS", "PUSH", "AGE",
+        "JOINERS", "HB", "AGE", "MS", "PUSH", "AGE",
     ]
     assert "train_0:uuid" in text and "1.25" in text and "1.0s" in text
     # The dead replica renders dashes, not a crash.

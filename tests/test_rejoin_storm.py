@@ -1,0 +1,524 @@
+"""Mass-rejoin storm drills (pure Python — tier-1 in a toolchain-less
+container):
+
+- coordinated stripe plan: the storm rotation is a pure function of
+  (joiner ordinal, group rank, quorum id); rotated plans stay complete,
+  deterministic, and byte-balanced while seeding at different donors;
+- ZeRO shard parts stripe like any other dedicated CRC'd chunk when the
+  heal policy is ``fetch`` (byte-balanced assignment pinned);
+- joiner ingress bound (``TPUFT_HEAL_INGRESS_GBPS``): a token bucket
+  shared by every stripe worker of one heal attempt, whose injected
+  sleep is credited back to the minimum-progress watchdog — self-pacing
+  never fences a healthy donor;
+- manager plumbing: concurrent joiners derive DISTINCT rotations from
+  the same quorum view and hand them to ``recv_checkpoint``;
+- punisher ``kill_half_fleet``: status-targeted, floor(n/2) victims,
+  always >= 1 survivor;
+- the flagship storm drill, threads-as-replicas over loopback HTTP in
+  strict AND pipelined commit orderings: three stale rejoiners heal
+  SIMULTANEOUSLY from the same two-donor set — every joiner lands
+  bitwise identical, zero heal exhaustions, zero checksum failures,
+  zero era rejects, and the whole default-run drill finishes inside the
+  tier-1 budget (< 60 s wall, gated on observed state, never sleeps);
+- ``--explain-step`` prints the per-joiner storm table when more than
+  one joiner healed in the same era.
+"""
+
+import importlib.util
+import random
+import threading
+import time
+from pathlib import Path
+from unittest.mock import MagicMock
+
+import numpy as np
+import pytest
+
+from test_checkpointing import assert_state_equal
+from test_heal_striping import (
+    committed_state_dict,
+    member,
+    patched_manager_client,
+    stripe_counters,
+    wide_state,
+)
+from test_manager import make_manager, make_quorum
+from torchft_tpu import metrics
+from torchft_tpu.checkpointing import HTTPTransport
+from torchft_tpu.checkpointing import http_transport as ht
+from torchft_tpu.checkpointing.transport import HEAL_PART_PREFIX
+from torchft_tpu.coordination import Quorum
+from torchft_tpu.manager import storm_stripe_rotation
+from torchft_tpu.parallel.process_group import ProcessGroupDummy
+from torchft_tpu.punisher import kill_half_fleet
+
+
+def storm_counters() -> dict:
+    base = stripe_counters()
+    base.update(
+        {
+            "ingress_paced_s": metrics.counter_total(
+                "tpuft_heal_ingress_paced_seconds_total"
+            ),
+            "ingress_bytes": metrics.counter_total(
+                "tpuft_heal_ingress_bytes_total"
+            ),
+            "heal_exhausted": metrics.counter_total(
+                "tpuft_trace_incidents_total", kind="heal_exhausted"
+            ),
+        }
+    )
+    return base
+
+
+# ---------------------------------------------------------------------------
+# coordinated stripe plan (pure functions)
+# ---------------------------------------------------------------------------
+
+
+def test_storm_rotation_is_pure_and_distinct_per_joiner() -> None:
+    joiners = ["grp2:u2", "grp0:u0", "grp5:u5"]
+    rotations = {
+        rid: storm_stripe_rotation(rid, joiners, group_rank=1, quorum_id=4)
+        for rid in joiners
+    }
+    # Ordinals follow the SORTED id list, so every observer agrees.
+    assert rotations == {"grp0:u0": 5, "grp2:u2": 6, "grp5:u5": 7}
+    # Deterministic: same inputs, same answer — no negotiation anywhere.
+    assert rotations["grp0:u0"] == storm_stripe_rotation(
+        "grp0:u0", joiners, 1, 4
+    )
+    # A non-joiner (or lone joiner) degrades to (group rank + quorum id).
+    assert storm_stripe_rotation("other:u", joiners, 1, 4) == 5
+    assert storm_stripe_rotation("solo:u", ["solo:u"], 0, 7) == 7
+
+
+def test_plan_stripes_rotation_seeds_different_donors() -> None:
+    chunks = list(range(8))
+    sizes = [100] * 8  # equal sizes: ties expose the rotation directly
+    plan0 = ht._plan_stripes(chunks, sizes, 2, rotation=0)
+    plan1 = ht._plan_stripes(chunks, sizes, 2, rotation=1)
+    assert plan0 == [[0, 2, 4, 6], [1, 3, 5, 7]]
+    assert plan1 == [[1, 3, 5, 7], [0, 2, 4, 6]]  # seeded at donor 1
+    # Rotation wraps: a full cycle is the identity plan.
+    assert ht._plan_stripes(chunks, sizes, 2, rotation=2) == plan0
+    # Rotated plans keep every PR-8 property: complete, deterministic,
+    # byte-balanced (LPT bound).
+    uneven = [10, 80, 20, 70, 30, 60, 40, 50, 90]
+    for rotation in range(4):
+        a = ht._plan_stripes(list(range(9)), uneven, 3, rotation=rotation)
+        assert a == ht._plan_stripes(list(range(9)), uneven, 3, rotation=rotation)
+        assert sorted(i for s in a for i in s) == list(range(9))
+        loads = [sum(uneven[i] for i in s) for s in a]
+        assert max(loads) - min(loads) <= max(uneven)
+
+
+def test_plan_stripes_rotation_round_robin_without_sizes() -> None:
+    assert ht._plan_stripes([0, 1, 2, 3, 4, 5], None, 3, rotation=1) == [
+        [2, 5],
+        [0, 3],
+        [1, 4],
+    ]
+
+
+# ---------------------------------------------------------------------------
+# ZeRO shard parts inside the stripe plan (fetch mode)
+# ---------------------------------------------------------------------------
+
+
+def test_zero_shard_parts_stripe_byte_balanced() -> None:
+    """``heal_part:zero_shard_*`` chunks are dedicated CRC'd chunks; in
+    ``TPUFT_ZERO_HEAL_SHARDS=fetch`` mode (no skip_parts) they enter
+    ``_plan_stripes`` like any other chunk — pinned here byte-balanced
+    across the donor set, not lumped onto one donor."""
+    state = wide_state(n_leaves=4, leaf_kb=64)
+    for shard in range(4):
+        state[f"{HEAL_PART_PREFIX}zero_shard_{shard}"] = {
+            "m": np.full(64 * 256, float(shard), dtype=np.float32)
+        }
+    treedef, chunk_dicts, parts = ht._plan_chunks(state, 4)
+    assert len(parts) == 4 and len(chunk_dicts) == 8
+    prepared = [ht._serialization.prepare(c) for c in chunk_dicts]
+    sizes = [int(p.total_size) for p in prepared]
+    plan = ht._plan_stripes(list(range(8)), sizes, 2)
+    part_chunks = set(parts.values())
+    # Part chunks appear in the plan (complete) and are split across the
+    # donors, byte-balanced within the LPT bound.
+    assert sorted(i for s in plan for i in s) == list(range(8))
+    per_donor_parts = [len(part_chunks & set(s)) for s in plan]
+    assert all(n >= 1 for n in per_donor_parts)
+    loads = [sum(sizes[i] for i in s) for s in plan]
+    assert max(loads) - min(loads) <= max(sizes)
+
+
+def test_zero_shard_parts_fetched_striped_across_donors() -> None:
+    """Transport-level fetch-mode drill: with no skip_parts, shard parts
+    ride the striped fetch and land bitwise identical."""
+    state = wide_state(n_leaves=4, leaf_kb=64)
+    state[f"{HEAL_PART_PREFIX}zero_shard_0"] = {
+        "m": np.full(4096, 3.0, dtype=np.float32)
+    }
+    state[f"{HEAL_PART_PREFIX}zero_shard_1"] = {
+        "m": np.full(4096, 4.0, dtype=np.float32)
+    }
+    donors = [HTTPTransport(num_chunks=4) for _ in range(2)]
+    joiner = HTTPTransport()
+    try:
+        for d in donors:
+            d.send_checkpoint([1], step=5, state_dict=state, timeout=10,
+                              quorum_id=7)
+        before = storm_counters()
+        out = joiner.recv_checkpoint(
+            0,
+            donors[0].metadata(),
+            5,
+            timeout=10,
+            quorum_id=7,
+            donors=[donors[1].metadata()],
+        )
+        after = storm_counters()
+        assert_state_equal(state, out)  # parts included, bitwise
+        # All 6 chunks (4 base + 2 parts) rode the stripe path.
+        assert after["stripe_chunks"] - before["stripe_chunks"] == 6
+        assert after["checksum"] - before["checksum"] == 0
+    finally:
+        for d in donors:
+            d.shutdown()
+        joiner.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# joiner ingress bound
+# ---------------------------------------------------------------------------
+
+
+def test_ingress_pacer_is_shared_across_streams() -> None:
+    pacer = ht._IngressPacer(8.0)  # 1 GB/s
+    d1 = pacer.debit(1 << 20)
+    d2 = pacer.debit(1 << 20)
+    # The second debit queues behind the first — one bucket, not one per
+    # stream (striping across N donors must not multiply the bound).
+    assert d2 > d1 >= 0.0
+    assert 0.0015 <= d2 <= 0.01, d2
+
+
+def test_ingress_bound_paces_without_tripping_watchdog(monkeypatch) -> None:
+    """A joiner bounded BELOW the watchdog floor must still heal: the
+    pacer's injected sleep is credited back to the progress window, so
+    the floor judges donor throughput, not our own throttle. Without the
+    credit, 6 parallel chunk streams sharing 1 MB/s against a 2 MB/s
+    floor would fence every (healthy) donor."""
+    monkeypatch.setenv(ht.ENV_HEAL_INGRESS, "0.008")  # 1 MB/s aggregate
+    monkeypatch.setenv(ht.ENV_HEAL_MIN_BPS, "2000000")  # 2 MB/s floor
+    state = wide_state(n_leaves=6, leaf_kb=512)  # ~3 MB payload
+    payload = sum(v.nbytes for v in state.values())
+    donor = HTTPTransport(num_chunks=6)
+    joiner = HTTPTransport()
+    try:
+        donor.send_checkpoint([1], step=5, state_dict=state, timeout=30,
+                              quorum_id=7)
+        before = storm_counters()
+        t0 = time.monotonic()
+        out = joiner.recv_checkpoint(
+            0, donor.metadata(), 5, timeout=30, quorum_id=7
+        )
+        wall = time.monotonic() - t0
+        after = storm_counters()
+        assert_state_equal(state, out)
+        # The bound actually paced (~3 s for 3 MB at 1 MB/s)...
+        assert wall >= 0.8 * payload / 1e6, wall
+        assert after["ingress_paced_s"] - before["ingress_paced_s"] > 0.5
+        assert after["ingress_bytes"] - before["ingress_bytes"] >= payload
+        # ...and the watchdog never fenced the healthy donor.
+        assert after["stalled"] - before["stalled"] == 0
+        assert after["checksum"] - before["checksum"] == 0
+    finally:
+        donor.shutdown()
+        joiner.shutdown()
+
+
+def test_ingress_unset_is_zero_cost(monkeypatch) -> None:
+    monkeypatch.delenv(ht.ENV_HEAL_INGRESS, raising=False)
+    state = wide_state(n_leaves=2, leaf_kb=64)
+    donor = HTTPTransport(num_chunks=2)
+    joiner = HTTPTransport()
+    try:
+        donor.send_checkpoint([1], step=5, state_dict=state, timeout=10,
+                              quorum_id=7)
+        before = storm_counters()
+        out = joiner.recv_checkpoint(
+            0, donor.metadata(), 5, timeout=10, quorum_id=7
+        )
+        after = storm_counters()
+        assert_state_equal(state, out)
+        assert after["ingress_bytes"] - before["ingress_bytes"] == 0
+    finally:
+        donor.shutdown()
+        joiner.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# punisher kill_half_fleet
+# ---------------------------------------------------------------------------
+
+
+def _lh_status(members) -> MagicMock:
+    status = MagicMock()
+    status.members = []
+    for replica_id, joining in members:
+        ms = MagicMock()
+        ms.joining = joining
+        ms.member.replica_id = replica_id
+        status.members.append(ms)
+    return status
+
+
+def test_kill_half_fleet_kills_floor_half_with_survivors() -> None:
+    client = MagicMock()
+    client.status.return_value = _lh_status(
+        [("r0", False), ("r1", False), ("r2", False), ("r3", False),
+         ("j0", True)]
+    )
+    assert kill_half_fleet(client, random.Random(0)) is True
+    victims = [call.args[0] for call in client.kill.call_args_list]
+    assert len(victims) == 2 and len(set(victims)) == 2
+    # Only non-joining members are targeted; >= half the fleet survives.
+    assert set(victims) <= {"r0", "r1", "r2", "r3"}
+    for call in client.kill.call_args_list:
+        assert call.kwargs.get("mode") == "exit"
+
+
+def test_kill_half_fleet_noops_below_two_members() -> None:
+    client = MagicMock()
+    client.status.return_value = _lh_status([("r0", False), ("j0", True)])
+    assert kill_half_fleet(client, random.Random(0)) is False
+    client.kill.assert_not_called()
+
+
+# ---------------------------------------------------------------------------
+# manager plumbing: distinct rotations from one quorum view
+# ---------------------------------------------------------------------------
+
+
+def storm_quorum(joiner_ids, quorum_id=2, max_step=7):
+    participants = [
+        member("ra", "donor_a:1", max_step),
+        member("rb", "donor_b:1", max_step),
+    ] + [member(rid, f"{rid}:addr", 3) for rid in joiner_ids]
+    return make_quorum(
+        quorum_id=quorum_id,
+        replica_rank=1,
+        replica_world_size=2,
+        heal=True,
+        max_step=max_step,
+        recover_src_manager_address="donor_a:1",
+        recover_src_replica_rank=0,
+        quorum=Quorum(quorum_id=quorum_id, participants=participants),
+    )
+
+
+def test_concurrent_joiners_derive_distinct_rotations() -> None:
+    """Two joiners observing the SAME quorum hand distinct, deterministic
+    stripe rotations to their transports — the no-negotiation storm
+    plan."""
+    recv_result = {
+        "user": {"model": {"w": np.zeros(2)}},
+        "tpuft": {"step": 7, "batches_committed": 14},
+    }
+    rotations = {}
+    for rid in ("stormA:u", "stormB:u"):
+        manager, client, _, transport = make_manager(
+            pg=ProcessGroupDummy(), min_replica_size=1
+        )
+        manager._replica_id = rid
+        manager._metric_labels = {
+            "replica_id": rid.split(":", 1)[0],
+            "group_rank": "1",
+        }
+        transport.recv_checkpoint.return_value = recv_result
+        with patched_manager_client(
+            {"donor_a:1": "http://a:0", "donor_b:1": "http://b:0"}
+        ):
+            client._quorum.return_value = storm_quorum(
+                ["stormA:u", "stormB:u"]
+            )
+            manager.start_quorum()
+        assert manager.errored() is None
+        kwargs = transport.recv_checkpoint.call_args[1]
+        rotations[rid] = kwargs["stripe_rotation"]
+        assert metrics.gauge_value(
+            "tpuft_heal_storm_rotation", **manager._metric_labels
+        ) == float(kwargs["stripe_rotation"])
+        # Every member's view of the storm size rides the pushed gauges.
+        assert metrics.gauge_value(
+            "tpuft_heal_storm_joiners", **manager._metric_labels
+        ) == 2.0
+        manager.shutdown(wait=False)
+    # stormA ordinal 0, stormB ordinal 1 (+ group_rank 1 + quorum_id 2).
+    assert rotations == {"stormA:u": 3, "stormB:u": 4}
+
+
+# ---------------------------------------------------------------------------
+# the flagship storm drill (threads-as-replicas, both commit orderings)
+# ---------------------------------------------------------------------------
+
+
+def make_storm_rejoiner(tag: str, depth: int, stale_params: dict,
+                        stale_step: int):
+    """A rejoining replica with a REAL heal transport, a distinct storm
+    identity, and registered stale state, in the requested ordering."""
+    transport = HTTPTransport()
+    manager, client, _, _ = make_manager(
+        pg=ProcessGroupDummy(),
+        min_replica_size=1,
+        commit_pipeline_depth=depth,
+        checkpoint_transport=transport,
+    )
+    manager._replica_id = f"{tag}:u"
+    manager._metric_labels = {"replica_id": tag, "group_rank": "1"}
+    holder = {"params": stale_params}
+    healed: list = []
+
+    def load(state):
+        holder["params"] = state
+        healed.append(state)
+
+    manager.register_state_dict_fn(
+        "params", load_state_dict=load, state_dict=lambda: holder["params"]
+    )
+    manager._step = stale_step
+    return manager, client, transport, holder, healed
+
+
+@pytest.mark.parametrize("depth", [0, 1], ids=["strict", "pipelined"])
+def test_mass_rejoin_storm_drill(depth, monkeypatch) -> None:
+    """THREE stale rejoiners heal SIMULTANEOUSLY from the same two-donor
+    set (threads-as-replicas over loopback HTTP): every joiner reaches
+    bitwise identity with the committed state, rotations are pairwise
+    distinct, and the storm produces zero heal exhaustions, zero
+    checksum failures, and zero era rejects — in strict AND pipelined
+    commit orderings, inside the tier-1 wall budget."""
+    monkeypatch.delenv("TPUFT_COMMIT_PIPELINE", raising=False)
+    t_start = time.monotonic()
+    committed = wide_state(n_leaves=6)
+    donors = [HTTPTransport(num_chunks=12) for _ in range(2)]
+    joiners = []
+    try:
+        for d in donors:
+            d.send_checkpoint(
+                [1], step=7, state_dict=committed_state_dict(committed, 7),
+                timeout=10, quorum_id=2,
+            )
+        tags = ["stormA", "stormB", "stormC"]
+        for j, tag in enumerate(tags):
+            stale = {k: v.copy() for k, v in committed.items()}
+            stale[f"w{j}"] = stale[f"w{j}"] + float(j + 1)  # per-joiner drift
+            joiners.append(make_storm_rejoiner(tag, depth, stale, 3))
+        joiner_ids = [m._replica_id for m, *_ in joiners]
+        before = storm_counters()
+        with patched_manager_client(
+            {"donor_a:1": donors[0].metadata(),
+             "donor_b:1": donors[1].metadata()}
+        ):
+            for manager, client, *_ in joiners:
+                client._quorum.return_value = storm_quorum(joiner_ids)
+            threads = [
+                threading.Thread(target=m.start_quorum, name=f"storm-{i}")
+                for i, (m, *_rest) in enumerate(joiners)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+                assert not t.is_alive(), "storm joiner wedged"
+        after = storm_counters()
+
+        rotations = set()
+        for manager, client, transport, holder, healed in joiners:
+            assert manager.errored() is None, manager.errored()
+            assert manager.current_step() == 7
+            assert len(healed) == 1
+            assert_state_equal(committed, holder["params"])
+            rotations.add(
+                metrics.gauge_value(
+                    "tpuft_heal_storm_rotation", **manager._metric_labels
+                )
+            )
+        # Coordinated plan: three joiners, three distinct offsets.
+        assert len(rotations) == 3, rotations
+        # Storm hygiene: nothing exhausted, nothing corrupt, nothing
+        # healed backwards, no cross-round retries needed.
+        assert after["heal_exhausted"] - before["heal_exhausted"] == 0
+        assert after["checksum"] - before["checksum"] == 0
+        assert after["era"] - before["era"] == 0
+        # Every donor served some stripe of the storm.
+        for d in donors:
+            assert d._served_event.is_set()
+        # Tier-1 budget: the default-run storm drill must stay fast on
+        # the 1-core box (gated on observed state above — no sleeps).
+        assert time.monotonic() - t_start < 60.0
+    finally:
+        for d in donors:
+            d.shutdown()
+        for manager, *_rest in joiners:
+            manager.shutdown(wait=False)
+
+
+# ---------------------------------------------------------------------------
+# --explain-step storm lines
+# ---------------------------------------------------------------------------
+
+
+def _load_fleet_trace():
+    repo = Path(__file__).resolve().parent.parent
+    spec = importlib.util.spec_from_file_location(
+        "fleet_trace_storm", repo / "scripts" / "fleet_trace.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_explain_step_prints_per_joiner_storm_table() -> None:
+    """With >1 joiner healing in the same era, the postmortem prints one
+    row per joiner — chunks verified, bytes, and which donors served its
+    stripes — plus each joiner's derived plan rotation."""
+    fleet_trace = _load_fleet_trace()
+    events = []
+    seq = {"j1": 0, "j2": 0}
+
+    def ev(proc, name, **args):
+        seq[proc] += 1
+        return {
+            "name": name,
+            "seq": seq[proc],
+            "t_wall": 1000.0 + seq[proc],
+            "replica_id": proc,
+            "group_rank": 0,
+            "step": 7,
+            "quorum_id": 2,
+            "args": args,
+        }
+
+    for proc, rotation in (("j1", 3), ("j2", 4)):
+        events.append(
+            ev(proc, "heal_stripe_plan", donors=2, rotation=rotation, chunks=4)
+        )
+        for chunk, donor in ((0, "http://a:1"), (1, "http://b:1")):
+            events.append(
+                ev(
+                    proc,
+                    "heal_chunk_recv",
+                    chunk=chunk + (2 if proc == "j2" else 0),
+                    bytes=1 << 20,
+                    total_chunks=4,
+                    donor=donor,
+                )
+            )
+    merged = fleet_trace.merge_events(events, offsets={})
+    out = fleet_trace.explain_step(merged, 7)
+    assert "rejoin storm: 2 joiner(s)" in out
+    assert "j1/0" in out and "j2/0" in out
+    assert "rotation 3" in out and "rotation 4" in out
+    # Donor attribution per joiner.
+    assert out.count("http://a:1") >= 2 and out.count("http://b:1") >= 2

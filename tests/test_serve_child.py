@@ -416,3 +416,40 @@ def test_serve_rate_bound_is_process_aggregate() -> None:
     # Per-connection pacing would finish both in ~1 ms wall; the shared
     # bucket needs ~2 ms for 2 MB.
     assert elapsed >= 0.0016
+
+
+def test_two_heal_peers_each_get_half_the_heal_share() -> None:
+    """Intra-class fairness (not just the class split): two concurrent
+    heal streams from DISTINCT peers through one pacer each run at ~half
+    the heal rate — asserted on the pacer's returned virtual delays, so
+    the 1-core box's scheduler cannot flake it."""
+    pacer = sc._ServePacer(8.0, heal_share=0.8)  # 8 Gb/s = 1 GB/s aggregate
+    mb = 1 << 20
+    # Interleave debits so both peers stay inside the activity window.
+    for _ in range(4):
+        delay_a = pacer.debit(mb, cls="heal", peer="joiner-a")
+        delay_b = pacer.debit(mb, cls="heal", peer="joiner-b")
+    # Each peer pushed 4 MB; at half of 1 GB/s each needs ~8 ms of
+    # virtual delay (first debit of each ran uncontended at full rate,
+    # so allow that 1 MB at 1 GB/s = ~1 ms of slack under the ideal).
+    for delay in (delay_a, delay_b):
+        assert 0.005 <= delay <= 0.010, (delay_a, delay_b)
+    # ...and the split is fair: neither peer is ahead of the other by
+    # more than one debit's worth.
+    assert abs(delay_a - delay_b) <= 0.003, (delay_a, delay_b)
+
+
+def test_fast_heal_peer_cannot_starve_a_late_one() -> None:
+    """A joiner that got to the bucket first with a big backlog must not
+    queue a second joiner behind its whole virtual backlog: the late
+    peer's first debit pays only its own sub-bucket share."""
+    pacer = sc._ServePacer(8.0, heal_share=0.8)
+    # Peer A rams 16 MB through while alone (full heal rate).
+    delay_a = 0.0
+    for _ in range(16):
+        delay_a = pacer.debit(1 << 20, cls="heal", peer="joiner-a")
+    assert delay_a >= 0.014  # ~16 ms of backlog on A's own clock
+    # Peer B arrives: its 1 MB debit must NOT inherit A's backlog (the
+    # single-class-clock design would charge it ~17 ms).
+    delay_b = pacer.debit(1 << 20, cls="heal", peer="joiner-b")
+    assert delay_b <= 0.006, delay_b

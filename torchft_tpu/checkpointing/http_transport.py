@@ -82,6 +82,7 @@ import threading
 import urllib.error
 import urllib.parse
 import urllib.request
+import uuid
 import zlib
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
@@ -127,6 +128,13 @@ ENV_HEAL_STRIPE_MAX_DONORS = "TPUFT_HEAL_STRIPE_MAX_DONORS"
 # Delta rejoin: adopt local chunks whose (crc, size) matches the donor's
 # manifest instead of fetching them.
 ENV_HEAL_DELTA = "TPUFT_HEAL_DELTA"
+# Joiner-side ingress bound (Gbps; <= 0 = unbounded): a joiner striping
+# across many donors must not swamp its own link — N uncapped donor
+# streams contending for one NIC collapse per-stream throughput until
+# the minimum-progress watchdog fences HEALTHY donors. One token bucket
+# per heal attempt bounds the aggregate; pacer-injected sleep is credited
+# back to the watchdog so self-pacing can never read as a gray donor.
+ENV_HEAL_INGRESS = "TPUFT_HEAL_INGRESS_GBPS"
 
 
 def _env_flag(env: str, default: bool = True) -> bool:
@@ -153,6 +161,15 @@ def heal_stripe_max_donors(default: int = 8) -> int:
 def heal_delta_enabled() -> bool:
     """Delta rejoin (``$TPUFT_HEAL_DELTA``, default on)."""
     return _env_flag(ENV_HEAL_DELTA, True)
+
+
+def heal_ingress_gbps(default: float = 0.0) -> float:
+    """Joiner-side heal ingress bound (``$TPUFT_HEAL_INGRESS_GBPS``;
+    <= 0 = unbounded; malformed values fall back)."""
+    try:
+        return float(os.environ.get(ENV_HEAL_INGRESS, str(default)))
+    except ValueError:
+        return default
 
 logger = logging.getLogger(__name__)
 
@@ -251,11 +268,42 @@ def _heal_min_bps(default: float = 1024.0) -> float:
         return default
 
 
+class _IngressPacer:
+    """Per-heal-attempt token bucket for the joiner's ingress bound
+    (``$TPUFT_HEAL_INGRESS_GBPS``): every stripe worker of one
+    ``recv_checkpoint`` debits the SAME clock, so striping across N
+    donors shares the configured rate instead of multiplying it by the
+    donor count — the bound stands for the joiner's NIC, which all the
+    stripes arrive through. One instance per heal attempt (not process-
+    global): a joiner process runs one heal at a time, and tests run
+    many joiners in one process."""
+
+    __slots__ = ("gbps", "_lock", "_ready")
+
+    def __init__(self, gbps: float) -> None:
+        self.gbps = gbps
+        self._lock = threading.Lock()
+        self._ready = time.monotonic()
+
+    def debit(self, nbytes: int) -> float:
+        with self._lock:
+            now = time.monotonic()
+            start = self._ready if self._ready > now else now
+            self._ready = start + nbytes * 8.0 / (self.gbps * 1e9)
+            return max(self._ready - now, 0.0)
+
+
 class _GuardedReader:
     """Wraps an HTTP response stream: checksums bytes on the fly and fences
     the fetch when progress falls below the bytes/s floor for a full
     watchdog window (the gray-failure case a per-recv socket timeout
-    cannot see — a dripping donor resets that timeout with every byte)."""
+    cannot see — a dripping donor resets that timeout with every byte).
+
+    ``ingress`` (an :class:`_IngressPacer`) bounds the joiner's own read
+    rate; the pacer's injected sleep is subtracted from the watchdog
+    window before the floor check, so a self-paced stream is judged by
+    what the DONOR delivered in the time we were actually willing to
+    read — self-pacing can never fence a healthy donor as gray."""
 
     def __init__(
         self,
@@ -263,6 +311,7 @@ class _GuardedReader:
         crc_update: Optional[Callable[[int, Any], int]] = None,
         min_bps: float = 0.0,
         window: float = _WATCHDOG_WINDOW_SEC,
+        ingress: Optional[_IngressPacer] = None,
     ) -> None:
         self._raw = raw
         self._update = crc_update
@@ -270,8 +319,10 @@ class _GuardedReader:
         self.total = 0
         self._min_bps = float(min_bps)
         self._window = float(window)
+        self._ingress = ingress
         self._start = time.monotonic()
         self._events: deque = deque()  # (t, nbytes) inside the window
+        self._paced: deque = deque()  # (t, sleep_s) inside the window
 
     def _read1(self, n: int) -> bytes:
         # read1 returns whatever ONE underlying read yields; plain read(n)
@@ -307,6 +358,13 @@ class _GuardedReader:
 
     def _account(self, n: int) -> None:
         self.total += n
+        if self._ingress is not None and n > 0:
+            delay = self._ingress.debit(n)
+            metrics.inc("tpuft_heal_ingress_bytes_total", n)
+            if delay > 0:
+                metrics.inc("tpuft_heal_ingress_paced_seconds_total", delay)
+                time.sleep(delay)
+                self._paced.append((time.monotonic(), delay))
         if self._min_bps <= 0:
             return
         now = time.monotonic()
@@ -314,8 +372,15 @@ class _GuardedReader:
         cutoff = now - self._window
         while self._events and self._events[0][0] < cutoff:
             self._events.popleft()
+        while self._paced and self._paced[0][0] < cutoff:
+            self._paced.popleft()
         if now - self._start >= self._window:
-            rate = sum(nb for _, nb in self._events) / self._window
+            # Credit ingress-pacer sleep back: the donor only had
+            # (window - paced) seconds of our attention.
+            paced = min(
+                sum(s for _, s in self._paced), self._window - 1e-3
+            )
+            rate = sum(nb for _, nb in self._events) / (self._window - paced)
             if rate < self._min_bps:
                 metrics.inc("tpuft_heal_stalled_fetches_total")
                 raise HealStalledError(
@@ -495,25 +560,39 @@ def _plan_chunks(
 
 
 def _plan_stripes(
-    chunks: List[int], sizes: Optional[List[int]], num_donors: int
+    chunks: List[int],
+    sizes: Optional[List[int]],
+    num_donors: int,
+    rotation: int = 0,
 ) -> List[List[int]]:
     """Partitions chunk indices across ``num_donors`` stripes, byte-balanced
     when ``sizes`` is known (greedy longest-processing-time: biggest chunk
-    to the currently lightest stripe, ties to the lowest donor slot) and
-    count-balanced round-robin otherwise. Pure and deterministic — the
-    same inputs always yield the same plan, so drills can pin exactly
-    which donor owned which chunks. Within a stripe, chunks fetch in
-    ascending index order."""
+    to the currently lightest stripe, ties broken by ``rotation``-offset
+    donor slot) and count-balanced round-robin otherwise. Pure and
+    deterministic — the same inputs always yield the same plan, so drills
+    can pin exactly which donor owned which chunks. Within a stripe,
+    chunks fetch in ascending index order.
+
+    ``rotation`` is the coordinated-storm offset: with it zero this is
+    exactly the PR-8 plan; N concurrent joiners pass N distinct offsets
+    (the manager derives each from its joiner ordinal / group rank /
+    quorum id — a pure function, never negotiated) so they seed their
+    plans at DIFFERENT donors instead of all hammering donor 0's first
+    stripe at the same instant."""
     num_donors = max(1, num_donors)
+    rotation = rotation % num_donors
     stripes: List[List[int]] = [[] for _ in range(num_donors)]
     if sizes is None:
         for slot, index in enumerate(chunks):
-            stripes[slot % num_donors].append(index)
+            stripes[(slot + rotation) % num_donors].append(index)
         return stripes
     loads = [0] * num_donors
     by_weight = sorted(chunks, key=lambda i: (-sizes[i], i))
     for index in by_weight:
-        slot = min(range(num_donors), key=lambda d: (loads[d], d))
+        slot = min(
+            range(num_donors),
+            key=lambda d: (loads[d], (d - rotation) % num_donors),
+        )
         stripes[slot].append(index)
         loads[slot] += sizes[index]
     for stripe in stripes:
@@ -549,6 +628,12 @@ class HTTPTransport(CheckpointTransport[Any]):
     ) -> None:
         self._timeout = timeout
         self._num_chunks = num_chunks
+        # Fairness identity this JOINER sends on its fetch URLs (?peer=):
+        # per transport instance, so every joiner of a storm — one per
+        # process in production, many per process in threads-as-replicas
+        # drills — owns exactly one sub-bucket of a donor's paced egress
+        # no matter how many parallel chunk streams it opens.
+        self._peer_tag = uuid.uuid4().hex[:12]
         serve_mode = serve_mode or os.environ.get(ENV_SERVE_MODE, "inline")
         if serve_mode not in ("inline", "child"):
             raise ValueError(
@@ -707,7 +792,7 @@ class HTTPTransport(CheckpointTransport[Any]):
                     if netem.enabled():  # emulated-DCN heal path
                         netem.pace_latency()
                         out = netem.PacingWriter(out)
-                    out = maybe_pace_serve(out)
+                    out = maybe_pace_serve(out, peer=transport._peer_of(self, split))
                     try:
                         for chunk in staged.chunks:
                             out.write(chunk.total_size.to_bytes(8, "big"))
@@ -740,7 +825,7 @@ class HTTPTransport(CheckpointTransport[Any]):
                         # one up-front sleep would hold the wire silent
                         # past the joiner's per-recv inactivity timeout.
                         out = netem.PacingWriter(out)
-                    out = maybe_pace_serve(out)
+                    out = maybe_pace_serve(out, peer=transport._peer_of(self, split))
                     if fault == "corrupt_stream":
                         # Flip a payload bit (the LAST byte is raw array
                         # data whenever the chunk carries arrays): the
@@ -776,6 +861,15 @@ class HTTPTransport(CheckpointTransport[Any]):
             target=self._server.serve_forever, daemon=True, name="tpuft-http-ckpt"
         )
         self._thread.start()
+
+    @staticmethod
+    def _peer_of(handler: Any, split: Any) -> str:
+        """Fairness identity of the requesting joiner: the ``?peer=`` tag
+        its transport sent, falling back to the client address (so an
+        untagged fetcher — curl, an old joiner — still gets exactly one
+        sub-bucket per host instead of bypassing the fairness split)."""
+        tags = urllib.parse.parse_qs(split.query).get("peer")
+        return tags[0] if tags else str(handler.client_address[0])
 
     def _chunk_fault(self, step: int, index: int) -> Optional[str]:
         hook = self._fault_hook
@@ -985,6 +1079,7 @@ class HTTPTransport(CheckpointTransport[Any]):
         skip_parts: Optional[Set[str]] = None,
         donors: Optional[List[str]] = None,
         local_state: Optional[Any] = None,
+        stripe_rotation: int = 0,
     ) -> Any:
         # Donor set: the assigned donor first (it is the one the quorum
         # proved holds max_step state), then every other advertised donor,
@@ -1078,8 +1173,15 @@ class HTTPTransport(CheckpointTransport[Any]):
             if i not in entry.chunks and i not in skipped_chunks
         ]
 
-        era_tag = f"?quorum_id={quorum_id}" if quorum_id is not None else ""
+        # Chunk-URL query: the era fence plus this joiner's fairness tag
+        # (the donor's pacer keys its per-joiner sub-bucket on it).
+        query: Dict[str, Any] = {"peer": self._peer_tag}
+        if quorum_id is not None:
+            query["quorum_id"] = quorum_id
+        era_tag = "?" + urllib.parse.urlencode(query)
         min_bps = _heal_min_bps()
+        ingress_gbps = heal_ingress_gbps()
+        ingress = _IngressPacer(ingress_gbps) if ingress_gbps > 0 else None
 
         def fetch_chunk(
             i: int, base: str, stripe_retry: bool = False
@@ -1106,6 +1208,7 @@ class HTTPTransport(CheckpointTransport[Any]):
                     resp,
                     crc_update=crc_update if expected is not None else None,
                     min_bps=min_bps,
+                    ingress=ingress,
                 )
                 t0 = time.perf_counter()
                 try:
@@ -1182,13 +1285,16 @@ class HTTPTransport(CheckpointTransport[Any]):
         if len(donor_urls) > 1 and len(missing) > 1:
             # Striped heal: one worker per donor over a byte-balanced
             # partition of the missing chunks; a failed donor's unfetched
-            # ranges reassign to the survivors.
+            # ranges reassign to the survivors. ``stripe_rotation`` seeds
+            # the plan so concurrent storm joiners spread across the
+            # donor set instead of colliding on the same first stripe.
             self._striped_fetch(
                 donor_urls=donor_urls,
                 missing=missing,
                 chunk_sizes=chunk_sizes,
                 fetch_chunk=fetch_chunk,
                 step=step,
+                rotation=stripe_rotation,
             )
         elif len(missing) <= 1:
             for i in missing:
@@ -1378,6 +1484,7 @@ class HTTPTransport(CheckpointTransport[Any]):
         chunk_sizes: Optional[List[int]],
         fetch_chunk: Callable[..., int],
         step: int,
+        rotation: int = 0,
     ) -> None:
         """Fetches ``missing`` striped across ``donor_urls``: one worker per
         donor walks its byte-balanced stripe; each chunk verifies through
@@ -1388,7 +1495,19 @@ class HTTPTransport(CheckpointTransport[Any]):
         raises to the caller (the resume cache keeps everything already
         verified)."""
         cond = threading.Condition()
-        stripes = _plan_stripes(missing, chunk_sizes, len(donor_urls))
+        stripes = _plan_stripes(
+            missing, chunk_sizes, len(donor_urls), rotation=rotation
+        )
+        # The plan in the fleet timeline: which rotation this joiner
+        # derived and how wide its donor set is — --explain-step pairs
+        # concurrent joiners' plans to show a storm's donor spread.
+        tracing.record(
+            "heal_stripe_plan",
+            step=step,
+            donors=len(donor_urls),
+            rotation=rotation % max(len(donor_urls), 1),
+            chunks=len(missing),
+        )
         queues: Dict[str, deque] = {
             url: deque(stripe) for url, stripe in zip(donor_urls, stripes)
         }

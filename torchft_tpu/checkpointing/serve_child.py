@@ -188,7 +188,18 @@ class _ServePacer:
     remainder, so N concurrent readers structurally cannot starve a
     healing joiner; a lone class gets the full rate. Each class keeps its
     own virtual-finish-time clock, so the split holds regardless of which
-    class's writes arrive first."""
+    class's writes arrive first.
+
+    Inside the heal class the rate splits AGAIN into per-peer sub-buckets
+    (the mass-rejoin storm case): each healing peer — identified by the
+    ``peer`` tag its joiner sends on chunk URLs, falling back to the
+    client address — gets an equal share of the heal rate while it is
+    active, so one fast joiner (or one joiner with more parallel chunk
+    streams) structurally cannot starve the other N-1 joiners of a storm.
+    A peer idle past the activity window stops counting against the
+    split, so a lone joiner still gets the full heal share. Sub-bucket
+    state is pruned on the same window, bounding memory by the number of
+    CONCURRENTLY active peers, not by fleet history."""
 
     _ACTIVE_WINDOW_SEC = 0.5
 
@@ -199,11 +210,14 @@ class _ServePacer:
         now = time.monotonic()
         self._ready = {"heal": now, "serving": now}
         self._last_debit = {"heal": float("-inf"), "serving": float("-inf")}
+        # Heal-class sub-buckets: peer -> [virtual-ready clock, last debit].
+        self._peers: Dict[str, List[float]] = {}
 
-    def debit(self, nbytes: int, cls: str = "heal") -> float:
-        """Charges ``nbytes`` against ``cls``'s share of the bucket;
-        returns how long the caller must sleep so the aggregate rate (and
-        the heal-priority split, when both classes are active) holds."""
+    def debit(self, nbytes: int, cls: str = "heal", peer: Optional[str] = None) -> float:
+        """Charges ``nbytes`` against ``cls``'s share of the bucket (and,
+        for heal traffic, against ``peer``'s sub-bucket of that share);
+        returns how long the caller must sleep so the aggregate rate, the
+        heal-priority split, and the per-joiner fairness split all hold."""
         other = "serving" if cls == "heal" else "heal"
         with self._lock:
             now = time.monotonic()
@@ -215,6 +229,23 @@ class _ServePacer:
                 )
             else:
                 rate = self.gbps
+            if cls == "heal":
+                key = peer if peer is not None else "_anon"
+                entry = self._peers.setdefault(key, [now, float("-inf")])
+                entry[1] = now
+                stale = [
+                    k
+                    for k, v in self._peers.items()
+                    if now - v[1] >= self._ACTIVE_WINDOW_SEC
+                ]
+                for k in stale:
+                    del self._peers[k]
+                active = len(self._peers)
+                metrics.set_gauge("tpuft_heal_serve_active_peers", active)
+                spb = 8.0 / (rate * 1e9) * max(active, 1)
+                start = entry[0] if entry[0] > now else now
+                entry[0] = start + nbytes * spb
+                return max(entry[0] - now, 0.0)
             spb = 8.0 / (rate * 1e9)
             start = self._ready[cls] if self._ready[cls] > now else now
             self._ready[cls] = start + nbytes * spb
@@ -244,11 +275,13 @@ class _RateWriter:
         pacer: _ServePacer,
         slice_bytes: int = 1 << 18,
         cls: str = "heal",
+        peer: Optional[str] = None,
     ) -> None:
         self._raw = raw
         self._pacer = pacer
         self._slice = slice_bytes
         self._cls = cls
+        self._peer = peer
 
     def write(self, data: Any) -> None:
         mv = memoryview(data)
@@ -257,19 +290,21 @@ class _RateWriter:
         for off in range(0, len(mv), self._slice):
             part = mv[off : off + self._slice]
             self._raw.write(part)
-            delay = self._pacer.debit(len(part), cls=self._cls)
+            delay = self._pacer.debit(len(part), cls=self._cls, peer=self._peer)
             if delay > 0:
                 time.sleep(delay)
 
 
-def maybe_pace_serve(out: Any, cls: str = "heal") -> Any:
+def maybe_pace_serve(out: Any, cls: str = "heal", peer: Optional[str] = None) -> Any:
     """Wraps ``out`` with the (process-aggregate) serve-rate bound when
     configured. ``cls`` is the traffic class the bytes charge against:
     ``heal`` (default — every existing heal-serve seam) or ``serving``
-    (committed-weights readers), see :class:`_ServePacer`."""
+    (committed-weights readers); ``peer`` identifies the healing joiner
+    for the per-peer fairness split inside the heal class (see
+    :class:`_ServePacer`)."""
     gbps = serve_rate_gbps()
     if gbps > 0:
-        return _RateWriter(out, _shared_pacer(gbps), cls=cls)
+        return _RateWriter(out, _shared_pacer(gbps), cls=cls, peer=peer)
     return out
 
 
@@ -514,6 +549,13 @@ def _child_main(argv: Optional[List[str]] = None) -> int:
                 return
             route = parts[2] if parts[2] in ("meta", "full", "delta") else "chunk"
             metrics.inc("tpuft_heal_serve_requests_total", route=route)
+            # Per-joiner fairness identity: the joiner tags its fetches
+            # (?peer=...), falling back to the client address — either
+            # way, one joiner's parallel chunk streams share ONE
+            # sub-bucket of the paced heal share.
+            peer = urllib.parse.parse_qs(split.query).get(
+                "peer", [str(self.client_address[0])]
+            )[0]
             if route == "meta":
                 body = staged.meta_bytes
                 self.send_response(200)
@@ -548,7 +590,7 @@ def _child_main(argv: Optional[List[str]] = None) -> int:
                 if netem.enabled():
                     netem.pace_latency()
                     out = netem.PacingWriter(out)
-                out = maybe_pace_serve(out)
+                out = maybe_pace_serve(out, peer=peer)
                 try:
                     for name, size in zip(staged.files, staged.sizes):
                         out.write(size.to_bytes(8, "big"))
@@ -585,7 +627,7 @@ def _child_main(argv: Optional[List[str]] = None) -> int:
             if netem.enabled():
                 netem.pace_latency()
                 out = netem.PacingWriter(out)
-            out = maybe_pace_serve(out)
+            out = maybe_pace_serve(out, peer=peer)
             if fault == "corrupt_stream":
                 out = _CorruptingWriter(out, size - 1)
             elif fault == "stall_donor":

@@ -65,6 +65,7 @@ class CheckpointTransport(ABC, Generic[T]):
         skip_parts: Optional[Set[str]] = None,
         donors: Optional[List[str]] = None,
         local_state: Optional[T] = None,
+        stripe_rotation: int = 0,
     ) -> T:
         """Fetches the state for ``step`` from ``src_rank``.
 
@@ -85,7 +86,14 @@ class CheckpointTransport(ABC, Generic[T]):
         rejoin; a delta-capable transport adopts provably identical
         pieces locally instead of fetching them, others MUST ignore it.
         Both are optimizations with the same contract as ``skip_parts``:
-        degrading means a full single-donor fetch, never a wrong one."""
+        degrading means a full single-donor fetch, never a wrong one.
+
+        ``stripe_rotation``: the coordinated mass-rejoin-storm offset — a
+        pure function the manager derives from (joiner ordinal, group
+        rank, quorum id) so N simultaneous joiners seed their stripe
+        plans at different donors. Stripe-capable transports fold it
+        into their chunk partition; others MUST ignore it (it never
+        changes WHAT is fetched, only the donor ordering)."""
 
     def disallow_checkpoint(self) -> None:
         """Stops serving the staged checkpoint (called at commit)."""

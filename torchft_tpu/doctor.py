@@ -43,6 +43,10 @@ KNOWN_ENV = {
     # Multi-donor striped heal + delta rejoin (checkpointing/
     # http_transport.py): stripe switch, donor-set cap, delta switch.
     "TPUFT_HEAL_STRIPE", "TPUFT_HEAL_STRIPE_MAX_DONORS", "TPUFT_HEAL_DELTA",
+    # Mass-rejoin storm plane: joiner-side aggregate ingress bound (the
+    # stripe workers of one heal share one token bucket) and the storm
+    # soak's round count (tests/test_chaos_soak.py).
+    "TPUFT_HEAL_INGRESS_GBPS", "TPUFT_STORM_SOAK_ROUNDS",
     # Donor sidecar (out-of-process heal serving, checkpointing/
     # serve_child.py): mode switch, snapshot dir (shared-memory tmpfs),
     # child niceness, egress bound, respawn budget.
@@ -411,6 +415,62 @@ def _check_heal_stripe(lighthouse: str) -> Tuple[str, str]:
     )
 
 
+def _check_rejoin_storm(lighthouse: str) -> Tuple[str, str]:
+    """Mass-rejoin storm preflight. WARN, never FAIL: a degenerate storm
+    (more joiners than donor-capable members) still converges — the
+    per-joiner fairness split keeps every joiner progressing and
+    ``TPUFT_HEAL_MAX_ATTEMPTS`` still bounds each heal — but the
+    operator should hear that time-to-full-strength is donor-egress
+    bound, not joiner-count bound, in that regime."""
+    from torchft_tpu.checkpointing import http_transport as ht
+
+    raw = os.environ.get(ht.ENV_HEAL_INGRESS)
+    if raw is not None:
+        try:
+            gbps = float(raw)
+        except ValueError:
+            return (
+                "WARN",
+                f"{ht.ENV_HEAL_INGRESS}={raw!r} is not a number (the "
+                "joiner ingress bound will silently fall back to "
+                "unbounded)",
+            )
+        ingress = f"ingress={gbps} Gbps" if gbps > 0 else "ingress=unbounded"
+    else:
+        ingress = "ingress=unbounded"
+    if not lighthouse:
+        return (
+            "PASS",
+            f"{ingress} (no lighthouse to probe the joiner/donor balance)",
+        )
+    try:
+        from torchft_tpu.coordination import LighthouseClient
+
+        client = LighthouseClient(lighthouse, connect_timeout=5.0)
+        try:
+            members = client.status(timeout=5.0).members
+        finally:
+            client.close()
+    except Exception as e:  # noqa: BLE001 — WARN-never-FAIL probe
+        return "WARN", f"{ingress} but lighthouse probe failed ({e})"
+    joiners = sum(1 for m in members if m.joining)
+    donors = len(members) - joiners
+    if joiners > max(donors, 0):
+        return (
+            "WARN",
+            f"{ingress}: degenerate storm in flight — {joiners} joiner(s) "
+            f"vs {donors} donor-capable member(s); every joiner still "
+            "progresses (per-joiner share of the paced donor egress), but "
+            "time-to-full-strength is bound by aggregate donor egress "
+            "(TPUFT_HEAL_SERVE_GBPS x donors), not by joiner parallelism",
+        )
+    return (
+        "PASS",
+        f"{ingress}: {joiners} joiner(s) / {donors} donor-capable "
+        "member(s) — storm headroom ok",
+    )
+
+
 def _check_serving() -> Tuple[str, str]:
     """Committed-weights serving-plane preflight: one in-process
     publisher -> relay -> subscriber roundtrip over loopback HTTP (tiny
@@ -545,6 +605,7 @@ def run_checks(lighthouse: str, skip_device: bool = False) -> int:
         ("heal serving", _check_heal_serve),
         ("weights serving", _check_serving),
         ("heal striping", lambda: _check_heal_stripe(lighthouse)),
+        ("rejoin storm", lambda: _check_rejoin_storm(lighthouse)),
         ("zero plane", lambda: _check_zero(lighthouse)),
         ("lighthouse", lambda: _check_lighthouse(lighthouse)),
     ]

@@ -129,6 +129,28 @@ class _DonorRecentlyFailed(Exception):
     consecutive reassignment of the same donor is attempted for real."""
 
 
+def storm_stripe_rotation(
+    replica_id: str,
+    joining_replica_ids: List[str],
+    group_rank: int,
+    quorum_id: int,
+) -> int:
+    """The coordinated mass-rejoin-storm stripe offset: a pure function of
+    the joiner's identity inside the quorum view — its ordinal among the
+    joining members (sorted replica ids, so every observer derives the
+    same ordering from the same quorum), its group rank, and the quorum
+    id. No negotiation, no randomness, same spirit as the ZeRO
+    ``shard_assignment``: N joiners healing in the same era derive N
+    distinct offsets and seed their stripe plans at different donors
+    instead of all hammering donor 0's first stripe simultaneously. A
+    replica not in the joining list (or a lone joiner) degrades to the
+    pre-storm rotation — a function of (group rank, quorum id) alone."""
+    ordinal = 0
+    if replica_id in joining_replica_ids:
+        ordinal = sorted(joining_replica_ids).index(replica_id)
+    return ordinal + max(group_rank, 0) + max(int(quorum_id), 0)
+
+
 class ExceptionWithTraceback(Exception):
     """Carries a worker-thread exception across the report_error funnel with
     its formatted stack attached, so the thread hop cannot strand the
@@ -1235,12 +1257,28 @@ class Manager:
             self._participating_replica_world_size,
             **self._metric_labels,
         )
+        # Storm visibility: how many members of this quorum are behind
+        # max_step (i.e. joining/healing) as THIS replica observed it.
+        # Pushed with the metrics snapshot, so fleet_status's JOINERS
+        # column shows every replica's view — drift between views is
+        # itself a debugging signal (a member seeing stale quorums).
+        joining = 0
+        if quorum.quorum is not None and quorum.max_step > 0:
+            joining = sum(
+                1
+                for member in quorum.quorum.participants
+                if member.step < quorum.max_step
+            )
+        metrics.set_gauge(
+            "tpuft_heal_storm_joiners", joining, **self._metric_labels
+        )
         self._trace.record(
             "quorum_ready",
             step=self._step,
             quorum_id=quorum.quorum_id,
             participants=self._participating_replica_world_size,
             heal=bool(quorum.heal),
+            joining=joining,
         )
 
         if quorum.quorum_id != self._quorum_id:
@@ -1459,7 +1497,11 @@ class Manager:
             assert (
                 quorum.recover_src_replica_rank is not None
             ), "must have a recover rank when healing"
-            donor_urls = self._resolve_stripe_donors(quorum)
+            rotation = self._storm_rotation(quorum)
+            metrics.set_gauge(
+                "tpuft_heal_storm_rotation", rotation, **self._metric_labels
+            )
+            donor_urls = self._resolve_stripe_donors(quorum, rotation=rotation)
             local_state = self._delta_local_state(quorum)
             with trace_span(
                 "tpuft::manager::_checkpoint_transport::recv_checkpoint",
@@ -1475,6 +1517,7 @@ class Manager:
                 donors=len(donor_urls) + 1,
                 delta=local_state is not None,
                 attempt=self._heal_attempts,
+                rotation=rotation,
             ):
                 self._pending_state_dict = self._checkpoint_transport.recv_checkpoint(
                     src_rank=quorum.recover_src_replica_rank,
@@ -1485,6 +1528,7 @@ class Manager:
                     skip_parts=self._heal_skip_parts(),
                     donors=donor_urls,
                     local_state=local_state,
+                    stripe_rotation=rotation,
                 )
             # Restore manager accounting immediately; user state is
             # applied from the main thread when safe.
@@ -1521,7 +1565,26 @@ class Manager:
                     f"(bound from ${HEAL_MAX_ATTEMPTS_ENV})"
                 ) from e
 
-    def _resolve_stripe_donors(self, quorum: Any) -> List[str]:
+    def _storm_rotation(self, quorum: Any) -> int:
+        """This joiner's coordinated-storm offset (see
+        :func:`storm_stripe_rotation`): derived purely from the quorum
+        view every member already holds, so N joiners agree on who is
+        joiner 0..N-1 without a single extra RPC."""
+        joining: List[str] = []
+        q = quorum.quorum
+        if q is not None and quorum.max_step > 0:
+            joining = [
+                member.replica_id
+                for member in q.participants
+                if member.step < quorum.max_step
+            ]
+        return storm_stripe_rotation(
+            self._replica_id, joining, self._group_rank, quorum.quorum_id
+        )
+
+    def _resolve_stripe_donors(
+        self, quorum: Any, rotation: Optional[int] = None
+    ) -> List[str]:
         """Extra donor addresses for a striped heal: every quorum
         participant standing at ``max_step`` holds bitwise-identical
         committed state (and co-stages it when it sees a joiner — see
@@ -1529,9 +1592,11 @@ class Manager:
         fetch. Each candidate's manager resolves to its checkpoint
         transport address; resolution is best-effort per donor — a peer
         that cannot be resolved is simply left out of the stripe set,
-        never a reason to fail the heal. The extras rotate by group rank
-        so concurrent joiners spread their stripe order across the donor
-        set instead of all hammering it in the same sequence.
+        never a reason to fail the heal. The extras rotate by the storm
+        offset (:meth:`_storm_rotation` — joiner ordinal + group rank +
+        quorum id) so N concurrent joiners spread their donor ORDER and,
+        past the stripe cap, their donor SUBSETS across the fleet
+        instead of all hammering it in the same sequence.
 
         Striping is skipped entirely at ``max_step == 0``: the init_sync
         heal is a per-LOCAL-rank mosaic (state is intentionally NOT
@@ -1550,13 +1615,17 @@ class Manager:
             and member.replica_id != self._replica_id
             and member.step >= quorum.max_step
         ]
+        if not candidates:
+            return []
+        if rotation is None:
+            rotation = self._storm_rotation(quorum)
+        # Rotate BEFORE capping: joiners beyond the cap then resolve
+        # different donor subsets, not just different orderings.
+        rotate = rotation % len(candidates)
+        candidates = candidates[rotate:] + candidates[:rotate]
         # The cap minus the assigned donor; the transport re-applies it
         # after deduping, this just avoids pointless resolution RPCs.
         candidates = candidates[: max(0, heal_stripe_max_donors() - 1)]
-        if not candidates:
-            return []
-        rotate = self._group_rank % len(candidates)
-        candidates = candidates[rotate:] + candidates[:rotate]
         urls: List[str] = []
         for addr in candidates:
             try:

@@ -33,6 +33,11 @@ Heal-path modes target the recovery plane itself:
   donor of a stripe set (``heal_stream:<donor tag>``, usually the serve
   port) so the drill proves a corrupting donor is fenced out of the
   stripe while its peers keep serving.
+- ``kill_half_fleet``: the mass-rejoin storm — half the non-joining
+  members (floor(n/2), >= 1 survivor kept as donor) are killed at once;
+  their supervised relaunches re-enter as SIMULTANEOUS joiners striping
+  the same donor set, exercising the coordinated stripe plan, per-joiner
+  serve fairness, and the joiner ingress bound.
 - ``kill_relay``: armed at the ``serving_relay`` site (optionally
   ``--relay-tag <port>`` to target one relay of a tier); the next relay
   poll round or reader GET consumes it and the relay dies abruptly
@@ -65,6 +70,7 @@ __all__ = [
     "kill_loop",
     "kill_donor_mid_heal",
     "kill_donor_mid_stripe",
+    "kill_half_fleet",
     "arm_stream_fault",
     "inject_fault",
     "main",
@@ -92,6 +98,7 @@ HEAL_FAULT_MODES = (
     "kill_serve_child",
     "kill_donor_mid_stripe",
     "corrupt_stripe",
+    "kill_half_fleet",
 )
 # Serving-plane modes (the committed-weights fan-out tier).
 SERVING_FAULT_MODES = ("kill_relay",)
@@ -137,6 +144,40 @@ def kill_donor_mid_heal(client: LighthouseClient, rng: random.Random) -> bool:
         client.kill(victim, mode="exit")
     except Exception as e:  # noqa: BLE001
         print(f"[punisher] kill rpc ended with: {e}")
+    return True
+
+
+def kill_half_fleet(client: LighthouseClient, rng: random.Random) -> bool:
+    """The mass-rejoin storm fault: kills HALF the non-joining members at
+    once (floor(n/2), always leaving at least one survivor to donor the
+    storm), status-targeted like kill_donor_mid_heal. The supervised
+    victims all relaunch together and re-enter the next quorums as
+    simultaneous joiners striping the same donor set — the scenario the
+    coordinated stripe plan, per-joiner serve fairness, and joiner
+    ingress bound exist for. Needs >= 2 killable members (one kill is
+    just kill_one)."""
+    try:
+        status = client.status()
+    except Exception as e:  # noqa: BLE001
+        print(f"[punisher] status rpc ended with: {e}")
+        return False
+    donors = [m.member.replica_id for m in status.members if not m.joining]
+    if len(donors) < 2:
+        print(
+            f"[punisher] only {len(donors)} killable member(s); "
+            "skipping kill_half_fleet"
+        )
+        return False
+    victims = rng.sample(donors, len(donors) // 2)
+    print(
+        f"[punisher] storm: killing {len(victims)} of {len(donors)} "
+        f"members at once: {victims}"
+    )
+    for victim in victims:
+        try:
+            client.kill(victim, mode="exit")
+        except Exception as e:  # noqa: BLE001
+            print(f"[punisher] kill rpc ended with: {e}")
     return True
 
 
@@ -224,6 +265,8 @@ def inject_fault(
         return kill_donor_mid_heal(client, rng)
     if mode == "kill_donor_mid_stripe":
         return kill_donor_mid_stripe(client, rng)
+    if mode == "kill_half_fleet":
+        return kill_half_fleet(client, rng)
     if mode in (
         "corrupt_stream",
         "stall_donor",
