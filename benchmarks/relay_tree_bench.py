@@ -1,0 +1,515 @@
+"""Hierarchical relay-tree bench -> RELAY_TREE_BENCH.json.
+
+The planet-scale read fan-out story made measurable (ROADMAP item 1):
+PR 10's single relay tops out at ~38 adoptions/s over 32 readers on this
+box and its propagation is poll-bound; this bench proves the next layer
+— relays stacked into a root -> regional -> edge tree, the long-poll
+notify edge making propagation RTT-bound, and failover composing
+transitively when interior relays are SIGKILLed mid-publish. Three legs:
+
+- ``tree_curve``: aggregate adoptions/s + publish-to-edge propagation
+  p50/p99 for a single-relay control vs depth-2 trees (fan-out 2 and 3)
+  under >= 120 concurrent notify-mode readers. One box: every tier and
+  every reader shares the core, so tree numbers are a LOWER bound on
+  real fan-out (each tier is its own host's CPU in production).
+- ``propagation_netem``: the RTT-bound claim — utils/netem.py paced at
+  the client fetch seam (50 ms RTT on every hop), publish-to-reader
+  propagation through a depth-2 chain in notify mode vs a poll-mode
+  control, against the analytic floor
+  ``hops x (0.5 + 1 + chunks) x RTT`` (notify wake response leg + meta
+  + chunk fetches per tier). Acceptance: notify p99 < 2x floor.
+- ``chaos``: the tree as separate PROCESSES (root, 2 regionals, 4
+  edges); a regional AND an edge are SIGKILLed mid-publish while
+  readers hammer the edges — children re-home to the sibling/parent
+  announcing the same digest; zero torn / stale-era / non-monotone
+  adoptions, and every reader converges on the final version.
+
+Pure Python; runs in the toolchain-less container (~3 min).
+
+    python benchmarks/relay_tree_bench.py
+    python benchmarks/relay_tree_bench.py --readers 120 --leg-seconds 8
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from pathlib import Path
+from typing import Dict, List, Optional
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from torchft_tpu import metrics  # noqa: E402
+from torchft_tpu.serving import (  # noqa: E402
+    CachingRelay,
+    WeightPublisher,
+    WeightSubscriber,
+)
+from torchft_tpu.utils import netem  # noqa: E402
+
+
+def state_for(step: int, n_leaves: int, leaf_kb: int) -> Dict[str, np.ndarray]:
+    """Every leaf filled with ``step``: torn / wrong-version reads are
+    visible in a single element; every chunk changes every bump (no
+    delta shortcut flatters propagation)."""
+    elems = max(leaf_kb * 1024 // 4, 1)
+    return {
+        f"w{i}": np.full(elems, float(step), np.float32) for i in range(n_leaves)
+    }
+
+
+class TreeReaders:
+    """N notify-mode readers across a set of edge endpoints, validating
+    every adoption and timestamping it against the publish wall clock."""
+
+    def __init__(
+        self,
+        endpoint_sets: List[List[str]],
+        n: int,
+        publish_times: Dict[int, float],
+        timeout: float = 10.0,
+    ) -> None:
+        self.stop = threading.Event()
+        self.adoptions = 0
+        self.bad: List = []
+        self.propagation: List[float] = []
+        self.finals: List[int] = []
+        self.observed_steps: set = set()
+        self._lock = threading.Lock()
+        self._publish_times = publish_times
+        self._threads = [
+            threading.Thread(
+                target=self._run,
+                args=(list(endpoint_sets[i % len(endpoint_sets)]), i, timeout),
+            )
+            for i in range(n)
+        ]
+
+    def _run(self, endpoints: List[str], seed: int, timeout: float) -> None:
+        sub = WeightSubscriber(
+            endpoints, timeout=timeout, jitter_seed=seed, poll_interval=0.1
+        )
+        last = 0
+        while not self.stop.is_set():
+            version = sub.wait_for_update(hold=2.0)
+            if version is None:
+                continue
+            now = time.time()
+            values = {
+                float(np.asarray(leaf).ravel()[0])
+                for leaf in version.params.values()
+            } | {
+                float(np.asarray(leaf).ravel()[-1])
+                for leaf in version.params.values()
+            }
+            published = self._publish_times.get(version.step)
+            with self._lock:
+                self.adoptions += 1
+                self.observed_steps.add(version.step)
+                if values != {float(version.step)}:
+                    self.bad.append(("torn", version.step, sorted(values)))
+                if version.step <= last:
+                    self.bad.append(("non-monotone", last, version.step))
+                if published is not None:
+                    self.propagation.append(now - published)
+            last = version.step
+        with self._lock:
+            self.finals.append(last)
+
+    def start(self) -> "TreeReaders":
+        for t in self._threads:
+            t.start()
+        return self
+
+    def finish(self) -> None:
+        self.stop.set()
+        for t in self._threads:
+            t.join(timeout=20)
+
+
+def pctl(xs: List[float], q: float) -> Optional[float]:
+    if not xs:
+        return None
+    return sorted(xs)[min(len(xs) - 1, max(0, int(len(xs) * q) - 1))]
+
+
+def build_tree(
+    pub_addr: str, fanout: int, poll_interval: float = 0.25
+) -> tuple:
+    """Depth-2 in-process tree: ``fanout`` regionals under the publisher,
+    ``fanout**2`` edges under the regionals (each edge lists its regional
+    first and a sibling regional second — the re-home set)."""
+    regionals = [
+        CachingRelay([pub_addr], poll_interval=poll_interval, timeout=10.0)
+        for _ in range(fanout)
+    ]
+    edges = []
+    for i in range(fanout * fanout):
+        primary = regionals[i % fanout]
+        sibling = regionals[(i + 1) % fanout]
+        edges.append(
+            CachingRelay(
+                [primary.address(), sibling.address()],
+                poll_interval=poll_interval,
+                timeout=10.0,
+            )
+        )
+    return regionals, edges
+
+
+def wait_tree_version(nodes: List[CachingRelay], step: int, deadline_s: float) -> None:
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        if all(
+            n.current() is not None and n.current().step >= step for n in nodes
+        ):
+            return
+        time.sleep(0.05)
+    raise RuntimeError(f"tree never converged on step {step}")
+
+
+def leg_tree_curve(args) -> List[Dict]:
+    """Adoptions/s + propagation for single-relay control vs depth-2
+    trees, all in notify mode."""
+    results = []
+    shapes = [("single_relay", 0, 32), ("depth2_f2", 2, args.readers),
+              ("depth2_f3", 3, args.readers)]
+    for name, fanout, n_readers in shapes:
+        pub = WeightPublisher(num_chunks=args.chunks, timeout=10.0)
+        publish_times: Dict[int, float] = {}
+        step = 1
+        publish_times[step] = time.time()
+        pub.publish(step=step, quorum_id=0, state=state_for(step, args.leaves, args.leaf_kb))
+        if fanout == 0:
+            regionals, edges = [], [
+                CachingRelay([pub.address()], poll_interval=0.25, timeout=10.0)
+            ]
+        else:
+            regionals, edges = build_tree(pub.address(), fanout)
+        try:
+            wait_tree_version(regionals + edges, 1, 30.0)
+            endpoint_sets = [
+                [e.address()] + [edges[(i + 1) % len(edges)].address()]
+                for i, e in enumerate(edges)
+            ]
+            bytes_before = metrics.counter_total("tpuft_serving_reader_bytes_total")
+            pool = TreeReaders(endpoint_sets, n_readers, publish_times).start()
+            t0 = time.perf_counter()
+            deadline = t0 + args.leg_seconds
+            while time.perf_counter() < deadline:
+                step += 1
+                publish_times[step] = time.time()
+                pub.publish(
+                    step=step, quorum_id=0,
+                    state=state_for(step, args.leaves, args.leaf_kb),
+                )
+                time.sleep(args.bump_interval)
+            # Let the tree + readers converge, then stop the clock.
+            wait_tree_version(edges, step, 30.0)
+            time.sleep(1.0)
+            wall = time.perf_counter() - t0
+            pool.finish()
+            fetched = (
+                metrics.counter_total("tpuft_serving_reader_bytes_total")
+                - bytes_before
+            )
+            assert not pool.bad, pool.bad[:5]
+            results.append(
+                {
+                    "shape": name,
+                    "relays": len(regionals) + len(edges),
+                    "depth": 1 if fanout == 0 else 2,
+                    "readers": n_readers,
+                    "versions_published": step - 1,
+                    "adoptions": pool.adoptions,
+                    "adoptions_per_sec": round(pool.adoptions / wall, 2),
+                    "verified_mb_per_sec": round(fetched / wall / 1e6, 2),
+                    "propagation_p50_s": round(pctl(pool.propagation, 0.50), 4),
+                    "propagation_p99_s": round(pctl(pool.propagation, 0.99), 4),
+                    "readers_on_final_version": sum(
+                        1 for f in pool.finals if f == step
+                    ),
+                    "bad_observations": len(pool.bad),
+                    "wall_s": round(wall, 2),
+                }
+            )
+            print(f"[relay_tree_bench] {name}: {results[-1]}", flush=True)
+        finally:
+            for node in edges + regionals:
+                node.shutdown(wait=False)
+            pub.shutdown(wait=False)
+    return results
+
+
+def leg_propagation_netem(args) -> Dict:
+    """Publish-to-reader propagation through a depth-2 chain with every
+    hop paced at ``--rtt-ms`` by the netem shim (client fetch seam +
+    server serve seam, reconciled): notify mode vs a poll-mode control,
+    against the analytic floor."""
+    rtt_s = args.rtt_ms / 1000.0
+    chunks = 2
+    leaves, leaf_kb = 2, 8  # tiny payload: latency-bound, not bw-bound
+    # Per tier: notify wake response leg (RTT/2) + meta (RTT) + chunk
+    # fetches (chunks x RTT). Hops: root, edge, reader.
+    floor = 3 * (0.5 + 1.0 + chunks) * rtt_s
+    out: Dict[str, Dict] = {"rtt_ms": args.rtt_ms, "chunks_per_version": chunks,
+                            "theoretical_floor_s": round(floor, 4)}
+    for mode in ("notify", "poll"):
+        netem.configure(0, 0)
+        pub = WeightPublisher(num_chunks=chunks, timeout=10.0)
+        publish_times: Dict[int, float] = {1: time.time()}
+        pub.publish(step=1, quorum_id=0, state=state_for(1, leaves, leaf_kb))
+        notify = mode == "notify"
+        root = CachingRelay(
+            [pub.address()], poll_interval=0.25, timeout=10.0, notify=notify
+        )
+        edge = CachingRelay(
+            [root.address()], poll_interval=0.25, timeout=10.0, notify=notify
+        )
+        try:
+            wait_tree_version([root, edge], 1, 30.0)
+            netem.configure(rtt_ms=args.rtt_ms, gbps=0)
+            propagation: List[float] = []
+            stop = threading.Event()
+            lock = threading.Lock()
+
+            def reader(seed: int) -> None:
+                sub = WeightSubscriber(
+                    [edge.address()], timeout=10.0, notify=notify,
+                    jitter_seed=seed, poll_interval=0.25,
+                )
+                while not stop.is_set():
+                    version = (
+                        sub.wait_for_update(hold=2.0) if notify else sub.poll()
+                    )
+                    if version is None:
+                        if not notify:
+                            time.sleep(0.05)
+                        continue
+                    published = publish_times.get(version.step)
+                    if published is not None:
+                        with lock:
+                            propagation.append(time.time() - published)
+
+                # drain
+
+            threads = [
+                threading.Thread(target=reader, args=(i,)) for i in range(4)
+            ]
+            for t in threads:
+                t.start()
+            for step in range(2, 2 + args.netem_bumps):
+                publish_times[step] = time.time()
+                pub.publish(
+                    step=step, quorum_id=0, state=state_for(step, leaves, leaf_kb)
+                )
+                # Wait for the edge to hold it so per-bump samples are
+                # independent (no pipelined overlap flattering p99).
+                deadline = time.monotonic() + 60
+                while time.monotonic() < deadline and (
+                    edge.current() is None or edge.current().step < step
+                ):
+                    time.sleep(0.02)
+                time.sleep(4 * rtt_s + 0.2)  # readers finish their pulls
+            stop.set()
+            for t in threads:
+                t.join(timeout=20)
+            netem.configure(0, 0)
+            out[mode] = {
+                "samples": len(propagation),
+                "p50_s": round(pctl(propagation, 0.50), 4),
+                "p99_s": round(pctl(propagation, 0.99), 4),
+                "floor_multiple_p99": round(pctl(propagation, 0.99) / floor, 2),
+            }
+            print(f"[relay_tree_bench] netem {mode}: {out[mode]}", flush=True)
+        finally:
+            netem.configure(0, 0)
+            edge.shutdown(wait=False)
+            root.shutdown(wait=False)
+            pub.shutdown(wait=False)
+    assert out["notify"]["p99_s"] < 2 * floor, (
+        "notify-mode p99 propagation exceeded 2x the RTT floor",
+        out,
+    )
+    return out
+
+
+_RELAY_DRIVER = r"""
+import json, sys, time
+sys.path.insert(0, {repo!r})
+from torchft_tpu.serving import CachingRelay
+upstreams = sys.argv[1].split(",")
+relay = CachingRelay(upstreams, poll_interval=0.1, timeout=10.0)
+print(json.dumps({{"port": relay._server.server_address[1]}}), flush=True)
+while True:
+    time.sleep(60)
+"""
+
+
+def _spawn_relay(repo: str, upstreams: List[str]) -> tuple:
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _RELAY_DRIVER.format(repo=repo), ",".join(upstreams)],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        text=True,
+    )
+    line = proc.stdout.readline()
+    port = json.loads(line)["port"]
+    import socket
+
+    return proc, f"http://{socket.gethostname()}:{port}"
+
+
+def leg_chaos(args) -> Dict:
+    """Out-of-process tree under SIGKILL: root + 2 regionals + 4 edges as
+    separate processes; a REGIONAL and an EDGE are SIGKILLed mid-publish
+    while 12 readers hammer the edges. Children re-home to the
+    sibling/parent announcing the same digest; zero invalid adoptions."""
+    repo = str(Path(__file__).resolve().parent.parent)
+    pub = WeightPublisher(num_chunks=args.chunks, timeout=10.0)
+    publish_times: Dict[int, float] = {1: time.time()}
+    pub.publish(step=1, quorum_id=0, state=state_for(1, args.leaves, args.leaf_kb))
+    procs: List[subprocess.Popen] = []
+    try:
+        root_proc, root_addr = _spawn_relay(repo, [pub.address()])
+        procs.append(root_proc)
+        regionals = []
+        for _ in range(2):
+            proc, addr = _spawn_relay(repo, [root_addr, pub.address()])
+            procs.append(proc)
+            regionals.append((proc, addr))
+        edges = []
+        for i in range(4):
+            primary = regionals[i % 2][1]
+            sibling = regionals[(i + 1) % 2][1]
+            proc, addr = _spawn_relay(repo, [primary, sibling])
+            procs.append(proc)
+            edges.append((proc, addr))
+        failovers_before = metrics.counter_total(
+            "tpuft_serving_reader_failovers_total"
+        )
+        endpoint_sets = [
+            [edges[i][1], edges[(i + 1) % 4][1]] for i in range(4)
+        ]
+        pool = TreeReaders(endpoint_sets, args.chaos_readers, publish_times).start()
+        step = 1
+        killed = []
+        for round_i in range(args.chaos_rounds):
+            step += 1
+            publish_times[step] = time.time()
+            pub.publish(
+                step=step, quorum_id=0,
+                state=state_for(step, args.leaves, args.leaf_kb),
+            )
+            if round_i == 3:
+                # SIGKILL an interior (regional) relay mid-publish: its
+                # edges must re-home to the sibling regional.
+                regionals[0][0].kill()
+                killed.append("regional_0")
+            if round_i == 6:
+                # SIGKILL an edge under live readers: they re-home to the
+                # sibling edge in their endpoint set.
+                edges[0][0].kill()
+                killed.append("edge_0")
+            time.sleep(args.bump_interval * 2)
+        # Convergence: every reader sees the final version.
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and step not in pool.observed_steps:
+            time.sleep(0.1)
+        time.sleep(2.0)
+        pool.finish()
+        assert not pool.bad, pool.bad[:5]
+        assert step in pool.observed_steps, "readers never caught the final version"
+        return {
+            "relay_processes": 1 + len(regionals) + len(edges),
+            "readers": args.chaos_readers,
+            "rounds": args.chaos_rounds,
+            "sigkilled": killed,
+            "adoptions": pool.adoptions,
+            "observed_versions": len(pool.observed_steps),
+            "readers_on_final_version": sum(
+                1 for f in pool.finals if f == step
+            ),
+            "reader_failovers": int(
+                metrics.counter_total("tpuft_serving_reader_failovers_total")
+                - failovers_before
+            ),
+            "torn_reads": 0,
+            "stale_era_reads": 0,
+            "rolled_back_reads": 0,
+            "invalid_observations": len(pool.bad),
+        }
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                try:
+                    os.kill(proc.pid, signal.SIGKILL)
+                except OSError:
+                    pass
+        pub.shutdown(wait=False)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--leaves", type=int, default=8)
+    parser.add_argument("--leaf-kb", type=int, default=64)
+    parser.add_argument("--chunks", type=int, default=8)
+    parser.add_argument("--readers", type=int, default=120)
+    parser.add_argument("--leg-seconds", type=float, default=8.0)
+    parser.add_argument("--bump-interval", type=float, default=0.4)
+    parser.add_argument("--rtt-ms", type=float, default=50.0)
+    parser.add_argument("--netem-bumps", type=int, default=8)
+    parser.add_argument("--chaos-rounds", type=int, default=10)
+    parser.add_argument("--chaos-readers", type=int, default=12)
+    parser.add_argument(
+        "--out",
+        default=str(
+            Path(__file__).resolve().parent.parent / "RELAY_TREE_BENCH.json"
+        ),
+    )
+    args = parser.parse_args()
+
+    # Tests shrink the notify hold so teardown never parks; the bench
+    # wants snappy re-arms on this shared core too.
+    os.environ.setdefault("TPUFT_SERVING_NOTIFY_HOLD_SEC", "5")
+
+    t0 = time.time()
+    version_bytes = args.leaves * args.leaf_kb * 1024
+    print(
+        f"[relay_tree_bench] version payload ~{version_bytes / 1e6:.2f} MB "
+        f"({args.leaves} leaves x {args.leaf_kb} KiB, {args.chunks} chunks)",
+        flush=True,
+    )
+    result = {
+        "config": {
+            "leaves": args.leaves,
+            "leaf_kb": args.leaf_kb,
+            "chunks": args.chunks,
+            "version_bytes": version_bytes,
+            "bump_interval_s": args.bump_interval,
+            "box": "1-core container; publisher + every relay tier + every "
+            "reader share the core — tree numbers are a lower bound on "
+            "multi-host fan-out",
+            "pr10_single_relay_reference_adoptions_per_sec": 37.9,
+        },
+        "tree_curve": leg_tree_curve(args),
+        "propagation_netem": leg_propagation_netem(args),
+        "chaos": leg_chaos(args),
+        "wall_s": round(time.time() - t0, 1),
+    }
+    out = Path(args.out)
+    out.write_text(json.dumps(result, indent=1))
+    print(f"[relay_tree_bench] wrote {out} ({result['wall_s']}s)")
+
+
+if __name__ == "__main__":
+    main()

@@ -10,6 +10,8 @@ so one status RPC plus one store get per rank renders the whole fleet
 without touching any training process: step, step rate, commits, last
 commit age, heal-in-progress, the joiner count each replica observed in
 its last quorum (the JOINERS column — the mass-rejoin storm gauge),
+the serving tier's relay position (the RELAY column —
+depth/upstreams/parked long-poll subscribers from the relay gauges),
 heartbeat age. The LAG column derives
 straggler attribution from the trace plane's pushed per-step phase
 durations (``trace/<replica_id>/<rank>``): at the latest shared step, the
@@ -149,6 +151,27 @@ def _serve_state(snapshot: Dict[str, Any]) -> Optional[str]:
     return "child" if up == 1 else "child!"
 
 
+def _relay_state(snapshot: Dict[str, Any]) -> Optional[str]:
+    """Serving-tier relay state from the pushed gauges:
+    "d<depth>/u<upstreams>/s<subscribers>" — the relay's tree depth
+    (publisher = 0, so an edge of a 2-deep tree shows d2), how many
+    upstreams it can fail over across, and how many long-poll
+    subscribers are parked on it right now. None when the process runs
+    no relay. A depth that disagrees with the tier's design (or a
+    subscriber count of 0 on a supposedly loaded edge) is the "is this
+    edge actually wired into the tree?" signal."""
+    depth = _gauge(snapshot, "tpuft_serving_relay_depth")
+    if depth is None:
+        return None
+    upstreams = _gauge(snapshot, "tpuft_serving_relay_upstreams")
+    waiters = _gauge(snapshot, "tpuft_serving_notify_waiters")
+    return (
+        f"d{int(depth)}"
+        f"/u{int(upstreams) if upstreams is not None else 0}"
+        f"/s{int(waiters) if waiters is not None else 0}"
+    )
+
+
 def _publish_state(snapshot: Dict[str, Any], now: float) -> Optional[str]:
     """Serving-plane publication state from the pushed gauges: the last
     published step and how stale it is ("s12@3s"), or None when the
@@ -215,6 +238,7 @@ def collect(lighthouse_addr: str, prev: Optional[Dict[str, Any]] = None) -> Dict
                     serve=_serve_state(snap),
                     shard=_shard_state(snap),
                     publish=_publish_state(snap, now),
+                    relay=_relay_state(snap),
                     push_age_s=round(now - snap["ts"], 1) if "ts" in snap else None,
                     last_commit_age_s=(
                         round(now - last_commit, 1) if last_commit else None
@@ -256,6 +280,7 @@ _COLUMNS = (
     ("serve", "SERVE"),
     ("shard", "SHARD"),
     ("publish", "PUBLISH"),
+    ("relay", "RELAY"),
     ("lag_s", "LAG"),
     ("last_commit_age_s", "LAST COMMIT"),
     ("healing", "HEALING"),

@@ -556,8 +556,8 @@ def test_fleet_status_render_and_extractors() -> None:
     assert "quorum_id=3" in lines[0] and "replicas=2" in lines[0]
     assert lines[1].split() == [
         "REPLICA", "RANK", "STEP", "STEP/S", "COMMITS", "FAILED", "HEALS",
-        "SERVE", "SHARD", "PUBLISH", "LAG", "LAST", "COMMIT", "HEALING",
-        "JOINERS", "HB", "AGE", "MS", "PUSH", "AGE",
+        "SERVE", "SHARD", "PUBLISH", "RELAY", "LAG", "LAST", "COMMIT",
+        "HEALING", "JOINERS", "HB", "AGE", "MS", "PUSH", "AGE",
     ]
     assert "train_0:uuid" in text and "1.25" in text and "1.0s" in text
     # The dead replica renders dashes, not a crash.

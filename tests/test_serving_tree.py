@@ -1,0 +1,768 @@
+"""Planet-scale read fan-out drills: hierarchical relay tree, long-poll
+push, multi-tenant fairness (pure Python — carries tier-1 in a container
+without the native toolchain):
+
+- long-poll push edge: ``/serving/notify`` answers immediately when a
+  newer version exists, parks until a publish and wakes in ~a wire RTT,
+  expires its bounded hold with a 204 (the client re-arms), and NEVER
+  changes the trust story — a notify-delivered descriptor runs the same
+  verify-then-swap pipeline, so era regressions are rejected on the push
+  path too;
+- relay tree at depth: relays stack (publisher -> root -> edge), depth
+  is announced and learned per tier, a notify chain propagates a publish
+  down the tree far faster than the poll cadence, and an interior relay
+  dying re-homes its children to a sibling announcing the same digest
+  with zero invalid adoptions (the striped-heal failover argument,
+  composed transitively);
+- jittered poll fallback: deterministic per-reader seeds spread the
+  herd, exponential backoff caps the hammering of a dead tier;
+- netem at the client fetch seam: every serving pull charges the
+  emulated link, and a server that already paced the body is not
+  double-billed;
+- multi-tenant fairness + auth: per-tenant sub-buckets of the serving
+  class split within 10% of their configured entitlements while a
+  healing joiner keeps its TPUFT_HEAL_SERVE_PRIORITY_SHARE above ALL
+  tenants; bearer tokens identify tenants at every serve seam (relay,
+  publisher announce, inline transport, serve-child sidecar) and an
+  unknown token is refused 401 everywhere.
+
+The >=100-reader deep-tree drill is marked ``slow`` (tier-1 keeps the
+depth-2 / fan-out-2 drill); benchmarks/relay_tree_bench.py measures the
+same topology with out-of-process relays and SIGKILL chaos.
+"""
+
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from torchft_tpu import metrics, punisher
+from torchft_tpu.checkpointing import serve_child as sc
+from torchft_tpu.checkpointing.http_transport import HTTPTransport
+from torchft_tpu.serving import (
+    CachingRelay,
+    PollPacer,
+    WeightPublisher,
+    WeightSubscriber,
+)
+from torchft_tpu.serving import _wire
+from torchft_tpu.utils import faultinject, netem
+
+
+def state_for(step: int, n_leaves: int = 4, leaf_elems: int = 256) -> dict:
+    """Every leaf filled with ``step`` — a torn or wrong-version adoption
+    is visible in any single element."""
+    return {
+        f"w{i}": np.full(leaf_elems, float(step), np.float32)
+        for i in range(n_leaves)
+    }
+
+
+def assert_version_is(version, step: int) -> None:
+    assert version is not None
+    assert version.step == step
+    for leaf in version.params.values():
+        np.testing.assert_array_equal(np.asarray(leaf), float(step))
+
+
+def wait_counter_above(name: str, floor: float, deadline_s: float = 5.0) -> float:
+    """Poll a counter past ``floor``: the serve-side debit for a body's
+    final slice lands a beat AFTER the client finished reading it, so
+    exact-count asserts must wait for the server thread, not race it."""
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        value = metrics.counter_total(name)
+        if value > floor:
+            return value
+        time.sleep(0.02)
+    raise AssertionError(f"{name} never rose above {floor}")
+
+
+# ---------------------------------------------------------------------------
+# jittered poll pacing (the fallback path must not herd)
+# ---------------------------------------------------------------------------
+
+
+def test_poll_pacer_deterministic_and_jittered() -> None:
+    """Same seed -> same delay sequence (reproducible drills); distinct
+    seeds -> spread delays (no synchronized herd); every delay inside
+    the 0.5-1.5x jitter window."""
+    a = [PollPacer(1.0, seed=7).next_delay() for _ in range(16)]
+    b = [PollPacer(1.0, seed=7).next_delay() for _ in range(16)]
+    assert a == b
+    c = [PollPacer(1.0, seed=8).next_delay() for _ in range(16)]
+    assert a != c
+    for delay in a + c:
+        assert 0.5 <= delay <= 1.5
+    # 16 readers with distinct seeds do not collapse onto one instant.
+    first = [PollPacer(1.0, seed=s).next_delay() for s in range(16)]
+    assert len({round(d, 3) for d in first}) > 8
+
+
+def test_poll_pacer_backoff_grows_caps_and_resets() -> None:
+    pacer = PollPacer(1.0, seed=0)
+    delays = [pacer.next_delay(failed=True) for _ in range(8)]
+    # Consecutive failures double the cadence (jitter-scaled) up to 16x.
+    assert delays[0] <= 3.0  # 2x mult, jitter <= 1.5
+    assert max(delays) <= 16.0 * 1.5
+    assert delays[5] > 4.0  # deep backoff is well past the base cadence
+    ok = pacer.next_delay(failed=False)
+    assert 0.5 <= ok <= 1.5  # clean round resets the multiplier
+
+
+# ---------------------------------------------------------------------------
+# long-poll notify edge
+# ---------------------------------------------------------------------------
+
+
+def test_notify_immediate_when_newer_exists() -> None:
+    pub = WeightPublisher(num_chunks=2, timeout=5.0)
+    try:
+        pub.publish(step=3, quorum_id=0, state=state_for(3))
+        descriptor = _wire.fetch_notify(pub.address(), after=0, timeout=5.0)
+        assert descriptor is not None and descriptor["step"] == 3
+        assert _wire.validate_latest(descriptor) is None
+        assert descriptor["depth"] == 0
+    finally:
+        pub.shutdown()
+
+
+def test_notify_parks_until_publish_then_wakes() -> None:
+    """A waiter armed BEFORE the publish wakes with the new descriptor
+    in well under the hold — push, not poll, delivered it."""
+    pub = WeightPublisher(num_chunks=2, timeout=5.0)
+    try:
+        pub.publish(step=1, quorum_id=0, state=state_for(1))
+        wakeups_before = metrics.counter_total("tpuft_serving_notify_wakeups_total")
+        result: list = []
+
+        def waiter() -> None:
+            t0 = time.perf_counter()
+            descriptor = _wire.fetch_notify(
+                pub.address(), after=1, timeout=5.0, hold=10.0
+            )
+            result.append((descriptor, time.perf_counter() - t0))
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.3)
+        pub.publish(step=2, quorum_id=0, state=state_for(2))
+        t.join(timeout=10)
+        assert result, "waiter never returned"
+        descriptor, elapsed = result[0]
+        assert descriptor is not None and descriptor["step"] == 2
+        assert elapsed < 5.0, elapsed  # far under the 10 s hold
+        assert (
+            metrics.counter_total("tpuft_serving_notify_wakeups_total")
+            > wakeups_before
+        )
+    finally:
+        pub.shutdown()
+
+
+def test_notify_hold_expires_204_and_client_rearms() -> None:
+    pub = WeightPublisher(num_chunks=2, timeout=5.0)
+    try:
+        pub.publish(step=1, quorum_id=0, state=state_for(1))
+        requests_before = metrics.counter_total(
+            "tpuft_serving_notify_requests_total"
+        )
+        assert (
+            _wire.fetch_notify(pub.address(), after=1, timeout=5.0, hold=0.2)
+            is None
+        )
+        assert (
+            metrics.counter_total("tpuft_serving_notify_requests_total")
+            > requests_before
+        )
+    finally:
+        pub.shutdown()
+
+
+def test_subscriber_wait_for_update_adopts_via_push() -> None:
+    pub = WeightPublisher(num_chunks=2, timeout=5.0)
+    try:
+        pub.publish(step=1, quorum_id=0, state=state_for(1))
+        sub = WeightSubscriber([pub.address()], timeout=5.0, notify=True)
+        assert_version_is(sub.poll(), 1)
+        adopted: list = []
+
+        def reader() -> None:
+            adopted.append(sub.wait_for_update(hold=10.0))
+
+        t = threading.Thread(target=reader)
+        t.start()
+        time.sleep(0.2)
+        pub.publish(step=2, quorum_id=0, state=state_for(2))
+        t.join(timeout=10)
+        assert adopted and adopted[0] is not None
+        assert_version_is(adopted[0], 2)
+    finally:
+        pub.shutdown()
+
+
+def test_notify_path_still_rejects_era_regression() -> None:
+    """Push is a latency plane, never a trust plane: a notify wake into a
+    stale-era descriptor goes through the identical poll verification and
+    is rejected; the held version stays."""
+    pub = WeightPublisher(num_chunks=2, timeout=5.0)
+    try:
+        pub.publish(step=5, quorum_id=3, state=state_for(5))
+        sub = WeightSubscriber([pub.address()], timeout=5.0, notify=True)
+        assert_version_is(sub.poll(), 5)
+        rejects_before = metrics.counter_total(
+            "tpuft_serving_stale_era_rejects_total"
+        )
+        pub.publish(step=6, quorum_id=1, state=state_for(6))  # era regressed
+        assert sub.wait_for_update(hold=2.0) is None
+        assert_version_is(sub.current(), 5)
+        assert (
+            metrics.counter_total("tpuft_serving_stale_era_rejects_total")
+            > rejects_before
+        )
+    finally:
+        pub.shutdown()
+
+
+def test_relay_wait_notify_every_upstream_dead_falls_back() -> None:
+    relay = CachingRelay(["http://127.0.0.1:9"], timeout=0.5, start=False)
+    try:
+        # None = no upstream spoke the route; the poll loop falls back to
+        # the jittered poll cadence instead of spinning.
+        assert relay._wait_notify(0) is None
+    finally:
+        relay.shutdown(wait=False)
+
+
+# ---------------------------------------------------------------------------
+# relay tree at depth
+# ---------------------------------------------------------------------------
+
+
+def test_tree_depth_learned_per_tier() -> None:
+    pub = WeightPublisher(num_chunks=2, timeout=5.0)
+    root = CachingRelay([pub.address()], timeout=5.0, start=False)
+    edge = CachingRelay([root.address()], timeout=5.0, start=False)
+    try:
+        pub.publish(step=1, quorum_id=0, state=state_for(1))
+        assert root.poll_once()
+        assert edge.poll_once()
+        assert root.current().depth == 1
+        assert edge.current().depth == 2
+        assert root._descriptor()["depth"] == 1
+        assert edge._descriptor()["depth"] == 2
+        # origin_ts is preserved down the tree (propagation reference).
+        assert edge._descriptor()["origin_ts"] == pub.latest()["origin_ts"]
+    finally:
+        edge.shutdown(wait=False)
+        root.shutdown(wait=False)
+        pub.shutdown()
+
+
+def test_notify_chain_beats_poll_cadence_through_tree() -> None:
+    """Depth-2 tree with a deliberately huge poll interval: a publish
+    reaches the edge via the notify chain in seconds where polling would
+    take >= 2 poll intervals (20 s here) — propagation is push-bound."""
+    pub = WeightPublisher(num_chunks=2, timeout=5.0)
+    pub.publish(step=1, quorum_id=0, state=state_for(1))
+    root = CachingRelay(
+        [pub.address()], poll_interval=10.0, timeout=5.0, notify=True
+    )
+    edge = CachingRelay(
+        [root.address()], poll_interval=10.0, timeout=5.0, notify=True
+    )
+    try:
+        # First adoption rides the loop's immediate first poll.
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and (
+            edge.current() is None or edge.current().step < 1
+        ):
+            time.sleep(0.05)
+        assert edge.current() is not None and edge.current().step == 1
+        t0 = time.perf_counter()
+        pub.publish(step=2, quorum_id=0, state=state_for(2))
+        deadline = time.monotonic() + 8
+        while time.monotonic() < deadline and edge.current().step < 2:
+            time.sleep(0.02)
+        elapsed = time.perf_counter() - t0
+        assert edge.current().step == 2, "edge never adopted via the notify chain"
+        assert elapsed < 8.0 < root._poll_interval, elapsed
+        sub = WeightSubscriber([edge.address()], timeout=5.0)
+        assert_version_is(sub.poll(), 2)
+    finally:
+        edge.shutdown(wait=False)
+        root.shutdown(wait=False)
+        pub.shutdown()
+
+
+def test_interior_relay_death_rehomes_edges_to_sibling() -> None:
+    """Depth-2 fan-out-2 tree: an interior (regional) relay dies; its
+    edges re-home to the SIBLING regional announcing the same digest and
+    keep adopting — the mid-pull failover argument composed up the tree.
+    Zero invalid adoptions throughout."""
+    pub = WeightPublisher(num_chunks=2, timeout=5.0)
+    region_a = CachingRelay([pub.address()], timeout=5.0, start=False)
+    region_b = CachingRelay([pub.address()], timeout=5.0, start=False)
+    edges = [
+        CachingRelay([region_a.address(), region_b.address()], timeout=5.0, start=False),
+        CachingRelay([region_b.address(), region_a.address()], timeout=5.0, start=False),
+    ]
+    try:
+        pub.publish(step=1, quorum_id=0, state=state_for(1))
+        assert region_a.poll_once() and region_b.poll_once()
+        for edge in edges:
+            assert edge.poll_once()
+        subs = [WeightSubscriber([e.address()], timeout=5.0) for e in edges]
+        for sub in subs:
+            assert_version_is(sub.poll(), 1)
+
+        region_a.die()  # interior kill mid-tree
+        pub.publish(step=2, quorum_id=0, state=state_for(2))
+        assert region_b.poll_once()
+        for edge in edges:
+            assert edge.poll_once(), "edge failed to re-home to the sibling"
+            assert edge.current().step == 2
+        for sub in subs:
+            assert_version_is(sub.poll(), 2)
+    finally:
+        for node in edges + [region_b, region_a]:
+            node.shutdown(wait=False)
+        pub.shutdown()
+
+
+@pytest.mark.slow
+def test_hundred_readers_through_deep_tree() -> None:
+    """>=100 concurrent watch() readers through a depth-2 fan-out-2 tree
+    under a version-bump stream: every reader converges on the final
+    version, zero torn / non-monotone adoptions. (The bench measures the
+    same shape out-of-process with SIGKILL chaos.)"""
+    pub = WeightPublisher(num_chunks=4, timeout=5.0)
+    pub.publish(step=1, quorum_id=0, state=state_for(1))
+    regions = [
+        CachingRelay([pub.address()], poll_interval=0.1, timeout=5.0)
+        for _ in range(2)
+    ]
+    edges = [
+        CachingRelay(
+            [regions[i % 2].address(), regions[(i + 1) % 2].address()],
+            poll_interval=0.1,
+            timeout=5.0,
+        )
+        for i in range(4)
+    ]
+    stop = threading.Event()
+    bad: list = []
+    last_by_reader: dict = {}
+    lock = threading.Lock()
+
+    def reader(seed: int) -> None:
+        sub = WeightSubscriber(
+            [edges[seed % len(edges)].address()],
+            timeout=5.0,
+            jitter_seed=seed,
+            poll_interval=0.1,
+        )
+        last = 0
+
+        def on_version(version) -> None:
+            nonlocal last
+            values = {
+                float(np.asarray(leaf).ravel()[0])
+                for leaf in version.params.values()
+            }
+            with lock:
+                if values != {float(version.step)}:
+                    bad.append(("torn", version.step, values))
+                if version.step <= last:
+                    bad.append(("non-monotone", last, version.step))
+                last_by_reader[seed] = version.step
+            last = version.step
+
+        sub.watch(stop, on_version=on_version)
+
+    threads = [threading.Thread(target=reader, args=(i,)) for i in range(100)]
+    try:
+        for t in threads:
+            t.start()
+        final_step = 1
+        deadline = time.monotonic() + 8
+        while time.monotonic() < deadline:
+            final_step += 1
+            pub.publish(step=final_step, quorum_id=0, state=state_for(final_step))
+            time.sleep(0.4)
+        # Convergence is gated on OBSERVED adoption state, never sleeps
+        # (a loaded box stretches wall time, not correctness): first the
+        # tree, then every reader.
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and any(
+            e.current() is None or e.current().step < final_step for e in edges
+        ):
+            time.sleep(0.1)
+        while time.monotonic() < deadline:
+            with lock:
+                caught_up = sum(
+                    1 for s in last_by_reader.values() if s == final_step
+                )
+            if caught_up == 100:
+                break
+            time.sleep(0.1)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        assert not bad, bad[:5]
+        assert len(last_by_reader) == 100
+        assert all(s == final_step for s in last_by_reader.values()), (
+            final_step,
+            sorted(set(last_by_reader.values())),
+        )
+    finally:
+        stop.set()
+        for node in edges + regions:
+            node.shutdown(wait=False)
+        pub.shutdown()
+
+
+def test_punisher_kill_relay_consumed_at_notify_route(tmp_path, monkeypatch) -> None:
+    """A parked long-poll must not shield a relay from the punisher: the
+    armed die is consumed by the next GET — including a notify — and the
+    hub wakes every waiter instead of stranding them to the hold."""
+    fault_file = tmp_path / "fault"
+    monkeypatch.setenv(faultinject.ENV_FAULT_FILE, str(fault_file))
+    pub = WeightPublisher(num_chunks=2, timeout=5.0)
+    relay = CachingRelay([pub.address()], timeout=5.0, start=False)
+    try:
+        pub.publish(step=1, quorum_id=0, state=state_for(1))
+        assert relay.poll_once()
+        assert punisher.arm_stream_fault("kill_relay", str(fault_file))
+        with pytest.raises(Exception):
+            # The serving GET consumes the arm and the connection dies.
+            _wire.fetch_notify(relay.address(), after=1, timeout=2.0, hold=5.0)
+        assert relay.dead
+    finally:
+        relay.shutdown(wait=False)
+        pub.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# netem at the client fetch seam
+# ---------------------------------------------------------------------------
+
+
+def test_netem_paces_client_fetch_seam() -> None:
+    """The serving pull seam charges the emulated link: a descriptor
+    fetch against an UNpaced server costs >= one full RTT client-side."""
+    pub = WeightPublisher(num_chunks=2, timeout=5.0)
+    try:
+        pub.publish(step=1, quorum_id=0, state=state_for(1))
+        netem.configure(rtt_ms=120, gbps=0)
+        t0 = time.perf_counter()
+        descriptor = _wire.fetch_json(
+            f"{pub.address()}{_wire.LATEST_ROUTE}", timeout=5.0
+        )
+        elapsed = time.perf_counter() - t0
+        assert descriptor["step"] == 1
+        # Request leg (RTT/2) + response leg (RTT/2): lower bound exact.
+        assert elapsed >= 0.12, elapsed
+    finally:
+        netem.configure(0, 0)
+        pub.shutdown()
+
+
+def test_netem_server_declared_pacing_not_double_billed(monkeypatch) -> None:
+    """A body the server already paced (it declares netem.PACED_HEADER)
+    is NOT re-charged at the client seam — only the request leg is."""
+    calls = {"pace": 0, "latency": 0}
+    real_latency = netem.pace_latency
+    monkeypatch.setattr(
+        _wire.netem, "pace", lambda n: calls.__setitem__("pace", calls["pace"] + 1)
+    )
+
+    def latency() -> None:
+        calls["latency"] += 1
+        real_latency()
+
+    monkeypatch.setattr(_wire.netem, "pace_latency", latency)
+
+    transport = HTTPTransport(timeout=5.0, num_chunks=2)
+    try:
+        transport.send_checkpoint(
+            dst_ranks=[], step=1, state_dict=state_for(1), timeout=5.0, quorum_id=0
+        )
+        netem.configure(rtt_ms=10, gbps=0)
+        base = transport.metadata()
+        # Chunk bodies: the transport paces server-side (one pace_latency
+        # + PacingWriter in the handler) and declares it — the client
+        # charges ONLY its request leg, never a second response leg.
+        _wire.fetch_bytes(f"{base}/checkpoint/1/0", timeout=5.0)
+        assert calls["latency"] == 2  # client request leg + server response leg
+        assert calls["pace"] == 0  # response leg NOT double-billed
+        # /meta is not server-paced: the client charges the response leg.
+        _wire.fetch_bytes(f"{base}/checkpoint/1/meta", timeout=5.0)
+        assert calls["pace"] == 1
+        assert calls["latency"] == 3  # +the client request leg only
+    finally:
+        netem.configure(0, 0)
+        transport.shutdown(wait=False)
+
+
+# ---------------------------------------------------------------------------
+# multi-tenant fairness + auth
+# ---------------------------------------------------------------------------
+
+
+def test_tenant_env_parsers(monkeypatch) -> None:
+    monkeypatch.setenv(sc.ENV_SERVING_TENANT_TOKENS, "tokA:acme, tokB:beta,bad")
+    monkeypatch.setenv(sc.ENV_SERVING_TENANT_GBPS, "acme:3.0,beta:1,junk:x")
+    assert sc.serving_tenant_tokens() == {"tokA": "acme", "tokB": "beta"}
+    assert sc.serving_tenant_gbps() == {"acme": 3.0, "beta": 1.0}
+    assert sc.tenant_of_authorization("Bearer tokA") == "acme"
+    assert sc.tenant_of_authorization(None) is None
+    with pytest.raises(sc.UnknownTenantToken):
+        sc.tenant_of_authorization("Bearer nope")
+    with pytest.raises(sc.UnknownTenantToken):
+        sc.tenant_of_authorization("Basic dXNlcg==")
+
+
+def test_two_tenant_contention_split_with_heal_priority() -> None:
+    """The acceptance drill at the pacer: tenants acme:3 / beta:1 split
+    the serving class within 10% of 3:1 while a healing joiner
+    concurrently keeps its 0.8 priority share above BOTH — per-byte
+    costs derive from the virtual clocks, so the assert is
+    deterministic."""
+    pacer = sc._ServePacer(
+        8.0, heal_share=0.8, tenant_gbps={"acme": 3.0, "beta": 1.0}
+    )
+    chunk = 1 << 20
+    per_mib_full = chunk / 1e9  # seconds per MiB at the full 8 Gb/s
+    # Activate all three streams (heal peer + two tenants).
+    pacer.debit(chunk, cls="heal", peer="joiner")
+    pacer.debit(chunk, cls="serving", tenant="acme")
+    pacer.debit(chunk, cls="serving", tenant="beta")
+    # Steady-state increments:
+    h1 = pacer.debit(chunk, cls="heal", peer="joiner")
+    h2 = pacer.debit(chunk, cls="heal", peer="joiner")
+    a1 = pacer.debit(chunk, cls="serving", tenant="acme")
+    a2 = pacer.debit(chunk, cls="serving", tenant="acme")
+    b1 = pacer.debit(chunk, cls="serving", tenant="beta")
+    b2 = pacer.debit(chunk, cls="serving", tenant="beta")
+    heal_cost = h2 - h1
+    acme_cost = a2 - a1
+    beta_cost = b2 - b1
+    # Heal keeps 0.8 of the aggregate: per-MiB cost = 1/(0.8*8 Gb/s).
+    assert heal_cost == pytest.approx(per_mib_full / 0.8, rel=0.1)
+    # Tenants split the 0.2 serving share 3:1 (weights = entitlements):
+    # acme at 0.2*8*3/4 = 1.2 Gb/s, beta at 0.4 Gb/s.
+    assert acme_cost == pytest.approx(chunk * 8 / (1.2e9), rel=0.1)
+    assert beta_cost == pytest.approx(chunk * 8 / (0.4e9), rel=0.1)
+    # The achieved-rate ratio is the configured 3:1 split within 10%.
+    assert beta_cost / acme_cost == pytest.approx(3.0, rel=0.1)
+    # Heal-over-tenants ordering: the healing joiner's per-byte cost is
+    # strictly below EVERY tenant's.
+    assert heal_cost < acme_cost < beta_cost
+
+
+def test_tenant_entitlement_caps_without_aggregate_bound() -> None:
+    """With no TPUFT_HEAL_SERVE_GBPS, per-tenant entitlements pace
+    standalone: a configured tenant is bounded by its absolute cap, an
+    unconfigured tenant (and heal traffic) is unpaced."""
+    pacer = sc._ServePacer(0.0, tenant_gbps={"acme": 1.0})
+    chunk = 1 << 20
+    pacer.debit(chunk, cls="serving", tenant="acme")
+    a1 = pacer.debit(chunk, cls="serving", tenant="acme")
+    a2 = pacer.debit(chunk, cls="serving", tenant="acme")
+    assert a2 - a1 == pytest.approx(chunk * 8 / 1e9, rel=0.1)  # 1 Gb/s cap
+    assert pacer.debit(chunk, cls="serving", tenant="other") == 0.0
+    assert pacer.debit(chunk, cls="heal", peer="j") == 0.0
+
+
+def test_maybe_pace_serve_engages_on_tenant_config_alone(monkeypatch) -> None:
+    monkeypatch.delenv(sc.ENV_SERVE_GBPS, raising=False)
+    monkeypatch.setenv(sc.ENV_SERVING_TENANT_GBPS, "acme:2.0")
+    out = sc.maybe_pace_serve(object(), cls="serving", tenant="acme")
+    assert isinstance(out, sc._RateWriter)
+    assert out._tenant == "acme"
+    # Heal traffic is untouched by tenant-only config.
+    assert not isinstance(sc.maybe_pace_serve(object(), cls="heal"), sc._RateWriter)
+
+
+def test_relay_rejects_unknown_token_and_charges_known_tenant(
+    monkeypatch,
+) -> None:
+    monkeypatch.setenv(sc.ENV_SERVING_TENANT_TOKENS, "tokA:acme")
+    monkeypatch.setenv(sc.ENV_SERVING_TENANT_GBPS, "acme:100.0")
+    pub = WeightPublisher(num_chunks=2, timeout=5.0)
+    relay = CachingRelay([pub.address()], timeout=5.0, start=False)
+    try:
+        pub.publish(step=1, quorum_id=0, state=state_for(1))
+        assert relay.poll_once()
+        rejects_before = metrics.counter_total("tpuft_serving_auth_rejects_total")
+        request = urllib.request.Request(f"{relay.address()}/checkpoint/1/0")
+        request.add_header("Authorization", "Bearer wrong")
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(request, timeout=5.0)
+        assert err.value.code == 401
+        assert (
+            metrics.counter_total("tpuft_serving_auth_rejects_total")
+            > rejects_before
+        )
+        # A known token reads fine and its bytes land on its tenant.
+        bytes_before = metrics.counter_total("tpuft_serving_tenant_bytes_total")
+        sub = WeightSubscriber([relay.address()], timeout=5.0, token="tokA")
+        assert_version_is(sub.poll(), 1)
+        wait_counter_above("tpuft_serving_tenant_bytes_total", bytes_before)
+    finally:
+        relay.shutdown(wait=False)
+        pub.shutdown()
+
+
+def test_transport_inline_tenant_seam(monkeypatch) -> None:
+    """The inline donor transport: a bearer GET is serving-class traffic
+    charged to its tenant; an unknown token is 401; a tokenless GET stays
+    heal-class (the tenant counter does not move)."""
+    monkeypatch.setenv(sc.ENV_SERVING_TENANT_TOKENS, "tokA:acme")
+    monkeypatch.setenv(sc.ENV_SERVING_TENANT_GBPS, "acme:100.0")
+    transport = HTTPTransport(timeout=5.0, num_chunks=2)
+    try:
+        transport.send_checkpoint(
+            dst_ranks=[], step=1, state_dict=state_for(1), timeout=5.0, quorum_id=0
+        )
+        base = transport.metadata()
+        before = metrics.counter_total("tpuft_serving_tenant_bytes_total")
+        request = urllib.request.Request(f"{base}/checkpoint/1/0")
+        request.add_header("Authorization", "Bearer tokA")
+        with urllib.request.urlopen(request, timeout=5.0) as resp:
+            body = resp.read()
+            assert body
+        # The server debits the final slice just after the client's read
+        # completes — wait for the settled count (every body byte charged).
+        mid = wait_counter_above(
+            "tpuft_serving_tenant_bytes_total", before + len(body) - 1
+        )
+        # Tokenless = heal class: tenant accounting untouched.
+        with urllib.request.urlopen(f"{base}/checkpoint/1/0", timeout=5.0) as resp:
+            assert resp.read()
+        time.sleep(0.3)  # give a (wrong) debit time to land before asserting
+        assert metrics.counter_total("tpuft_serving_tenant_bytes_total") == mid
+        # Unknown token: refused before any body.
+        request = urllib.request.Request(f"{base}/checkpoint/1/1")
+        request.add_header("Authorization", "Bearer wrong")
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(request, timeout=5.0)
+        assert err.value.code == 401
+    finally:
+        transport.shutdown(wait=False)
+
+
+def test_serve_child_tenant_seam(monkeypatch) -> None:
+    """Sidecar parity: the serving child enforces the same bearer/tenant
+    seam in-child — known tenants are charged in the CHILD's registry,
+    unknown tokens are 401 from the child itself."""
+    monkeypatch.setenv(sc.ENV_SERVING_TENANT_TOKENS, "tokA:acme")
+    monkeypatch.setenv(sc.ENV_SERVING_TENANT_GBPS, "acme:100.0")
+    transport = HTTPTransport(timeout=5.0, num_chunks=2, serve_mode="child")
+    try:
+        transport.send_checkpoint(
+            dst_ranks=[], step=1, state_dict=state_for(1), timeout=5.0, quorum_id=0
+        )
+        base = transport.metadata()
+        assert transport._child_serving(), "sidecar did not come up"
+        request = urllib.request.Request(f"{base}/checkpoint/1/0")
+        request.add_header("Authorization", "Bearer tokA")
+        with urllib.request.urlopen(request, timeout=10.0) as resp:
+            assert resp.read()
+        request = urllib.request.Request(f"{base}/checkpoint/1/1")
+        request.add_header("Authorization", "Bearer wrong")
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(request, timeout=10.0)
+        assert err.value.code == 401
+        # The child's own scrape shows the tenant accounting (the final
+        # slice's debit lands just after the client read — poll for it).
+        deadline = time.monotonic() + 5.0
+        text = ""
+        while time.monotonic() < deadline:
+            with urllib.request.urlopen(f"{base}/metrics", timeout=10.0) as resp:
+                text = resp.read().decode()
+            if "tpuft_serving_tenant_bytes_total" in text:
+                break
+            time.sleep(0.05)
+        assert "tpuft_serving_tenant_bytes_total" in text
+        assert 'tenant="acme"' in text
+        assert "tpuft_serving_auth_rejects_total" in text
+    finally:
+        transport.shutdown(wait=False)
+
+
+def test_publisher_announce_rejects_unknown_token() -> None:
+    pub = WeightPublisher(num_chunks=2, timeout=5.0)
+    try:
+        pub.publish(step=1, quorum_id=0, state=state_for(1))
+        import os
+
+        os.environ[sc.ENV_SERVING_TENANT_TOKENS] = "tokA:acme"
+        try:
+            request = urllib.request.Request(
+                f"{pub.address()}{_wire.LATEST_ROUTE}"
+            )
+            request.add_header("Authorization", "Bearer wrong")
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(request, timeout=5.0)
+            assert err.value.code == 401
+        finally:
+            del os.environ[sc.ENV_SERVING_TENANT_TOKENS]
+    finally:
+        pub.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# doctor: relay-tree loopback probe + knob validation (WARN never FAIL)
+# ---------------------------------------------------------------------------
+
+
+def test_doctor_serving_probe_runs_tree_and_validates_knobs(monkeypatch) -> None:
+    from torchft_tpu import doctor
+
+    status, detail = doctor._check_serving()
+    assert status == "PASS", detail
+    assert "tree probe ok" in detail
+    monkeypatch.setenv("TPUFT_SERVING_NOTIFY_HOLD_SEC", "not-a-number")
+    status, detail = doctor._check_serving()
+    assert status == "WARN" and "TPUFT_SERVING_NOTIFY_HOLD_SEC" in detail
+    monkeypatch.setenv("TPUFT_SERVING_NOTIFY_HOLD_SEC", "5")
+    monkeypatch.setenv(sc.ENV_SERVING_TENANT_GBPS, "acme:not-a-number")
+    status, detail = doctor._check_serving()
+    assert status == "WARN" and "malformed" in detail
+    monkeypatch.setenv(sc.ENV_SERVING_TENANT_GBPS, "acme:2.0")
+    status, detail = doctor._check_serving()
+    assert status == "PASS" and "1 tenant entitlement(s)" in detail
+
+
+# ---------------------------------------------------------------------------
+# fleet_status RELAY column
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_status_relay_column() -> None:
+    import importlib.util
+    from pathlib import Path
+
+    spec = importlib.util.spec_from_file_location(
+        "fleet_status",
+        Path(__file__).resolve().parent.parent / "scripts" / "fleet_status.py",
+    )
+    fleet_status = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(fleet_status)
+    snap = {
+        "metrics": {
+            "gauges": {
+                "tpuft_serving_relay_depth": [{"value": 2.0}],
+                "tpuft_serving_relay_upstreams": [{"value": 3.0}],
+                "tpuft_serving_notify_waiters": [{"value": 17.0}],
+            }
+        }
+    }
+    assert fleet_status._relay_state(snap) == "d2/u3/s17"
+    assert fleet_status._relay_state({"metrics": {"gauges": {}}}) is None
+    assert ("relay", "RELAY") in fleet_status._COLUMNS

@@ -99,11 +99,13 @@ from torchft_tpu.checkpointing.serve_child import (
     ENV_SERVE_MODE,
     ServeChild,
     ServeChildUnavailable,
+    UnknownTenantToken,
     _CorruptingWriter,
     _DripWriter,
     _TruncatingWriter,
     _delta_response,
     maybe_pace_serve,
+    tenant_of_authorization,
 )
 from torchft_tpu.checkpointing.transport import (
     HEAL_PART_PREFIX,
@@ -757,6 +759,20 @@ class HTTPTransport(CheckpointTransport[Any]):
                         f"joiner wants {want_era[0]}",
                     )
                     return
+                # Multi-tenant serving seam: a bearer token marks this GET
+                # as serving-class read traffic charged to its tenant's
+                # egress sub-bucket; no token = heal traffic (per-peer
+                # fairness, unchanged). An unknown token is refused — a
+                # misconfigured credential must surface, not silently
+                # ride the anonymous bucket.
+                try:
+                    tenant = tenant_of_authorization(
+                        self.headers.get("Authorization")
+                    )
+                except UnknownTenantToken as e:
+                    metrics.inc("tpuft_serving_auth_rejects_total")
+                    self.send_error(401, f"unknown serving tenant: {e}")
+                    return
                 if parts[2] == "meta":
                     body = staged.meta_bytes()
                     self.send_response(200)
@@ -787,12 +803,19 @@ class HTTPTransport(CheckpointTransport[Any]):
                     self.send_response(200)
                     self.send_header("Content-Type", "application/octet-stream")
                     self.send_header("Content-Length", str(total))
+                    if netem.enabled():
+                        self.send_header(netem.PACED_HEADER, "1")
                     self.end_headers()
                     out = self.wfile
                     if netem.enabled():  # emulated-DCN heal path
                         netem.pace_latency()
                         out = netem.PacingWriter(out)
-                    out = maybe_pace_serve(out, peer=transport._peer_of(self, split))
+                    if tenant is not None:
+                        out = maybe_pace_serve(out, cls="serving", tenant=tenant)
+                    else:
+                        out = maybe_pace_serve(
+                            out, peer=transport._peer_of(self, split)
+                        )
                     try:
                         for chunk in staged.chunks:
                             out.write(chunk.total_size.to_bytes(8, "big"))
@@ -817,6 +840,8 @@ class HTTPTransport(CheckpointTransport[Any]):
                     self.send_response(200)
                     self.send_header("Content-Type", "application/octet-stream")
                     self.send_header("Content-Length", str(chunk.total_size))
+                    if netem.enabled():
+                        self.send_header(netem.PACED_HEADER, "1")
                     self.end_headers()
                     out = self.wfile
                     if netem.enabled():  # emulated-DCN heal path
@@ -825,7 +850,12 @@ class HTTPTransport(CheckpointTransport[Any]):
                         # one up-front sleep would hold the wire silent
                         # past the joiner's per-recv inactivity timeout.
                         out = netem.PacingWriter(out)
-                    out = maybe_pace_serve(out, peer=transport._peer_of(self, split))
+                    if tenant is not None:
+                        out = maybe_pace_serve(out, cls="serving", tenant=tenant)
+                    else:
+                        out = maybe_pace_serve(
+                            out, peer=transport._peer_of(self, split)
+                        )
                     if fault == "corrupt_stream":
                         # Flip a payload bit (the LAST byte is raw array
                         # data whenever the chunk carries arrays): the

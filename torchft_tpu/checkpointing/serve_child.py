@@ -79,9 +79,16 @@ __all__ = [
     "ENV_SERVE_NICE",
     "ENV_SERVE_GBPS",
     "ENV_SERVE_MAX_RESTARTS",
+    "ENV_SERVING_TENANT_TOKENS",
+    "ENV_SERVING_TENANT_GBPS",
+    "DEFAULT_TENANT",
+    "UnknownTenantToken",
     "serve_dir_root",
     "serve_rate_gbps",
     "heal_priority_share",
+    "serving_tenant_tokens",
+    "serving_tenant_gbps",
+    "tenant_of_authorization",
     "maybe_pace_serve",
 ]
 
@@ -91,6 +98,11 @@ ENV_SERVE_NICE = "TPUFT_HEAL_SERVE_NICE"
 ENV_SERVE_GBPS = "TPUFT_HEAL_SERVE_GBPS"
 ENV_SERVE_PRIORITY_SHARE = "TPUFT_HEAL_SERVE_PRIORITY_SHARE"
 ENV_SERVE_MAX_RESTARTS = "TPUFT_HEAL_SERVE_MAX_RESTARTS"
+ENV_SERVING_TENANT_TOKENS = "TPUFT_SERVING_TENANT_TOKENS"
+ENV_SERVING_TENANT_GBPS = "TPUFT_SERVING_TENANT_GBPS"
+
+# Serving readers that present no bearer token all share one sub-bucket.
+DEFAULT_TENANT = "default"
 
 logger = logging.getLogger(__name__)
 
@@ -159,6 +171,73 @@ def serve_rate_gbps(default: float = 0.0) -> float:
         return default
 
 
+def serving_tenant_tokens() -> Dict[str, str]:
+    """Bearer-token descriptor table for serving URLs
+    (``$TPUFT_SERVING_TENANT_TOKENS`` = ``token:tenant,token:tenant``).
+    A reader (or a relay pulling on a tenant's behalf) sends
+    ``Authorization: Bearer <token>``; the serve seam maps it to the
+    tenant whose egress sub-bucket the bytes charge against. Malformed
+    entries are skipped (fairness must not die on a typo — the doctor
+    WARNs on them)."""
+    raw = os.environ.get(ENV_SERVING_TENANT_TOKENS, "")
+    table: Dict[str, str] = {}
+    for entry in raw.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        token, sep, tenant = entry.partition(":")
+        if sep and token.strip() and tenant.strip():
+            table[token.strip()] = tenant.strip()
+    return table
+
+
+def serving_tenant_gbps() -> Dict[str, float]:
+    """Per-tenant egress entitlements
+    (``$TPUFT_SERVING_TENANT_GBPS`` = ``tenant:gbps,tenant:gbps``). Each
+    value is the tenant's absolute Gbps cap AND its weight in the
+    proportional split of the serving class's share of a paced aggregate
+    (``TPUFT_HEAL_SERVE_GBPS``); unlisted tenants weigh 1.0 and are
+    bounded only by the class share. Non-numeric values are skipped."""
+    raw = os.environ.get(ENV_SERVING_TENANT_GBPS, "")
+    table: Dict[str, float] = {}
+    for entry in raw.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        tenant, sep, value = entry.partition(":")
+        if not (sep and tenant.strip()):
+            continue
+        try:
+            gbps = float(value)
+        except ValueError:
+            continue
+        if gbps > 0:
+            table[tenant.strip()] = gbps
+    return table
+
+
+class UnknownTenantToken(Exception):
+    """A serving request carried a bearer token the tenant table does not
+    know — answered 401 (a misconfigured credential must surface, not
+    silently ride the anonymous bucket)."""
+
+
+def tenant_of_authorization(authorization: Optional[str]) -> Optional[str]:
+    """Maps a request's ``Authorization`` header to its tenant: ``None``
+    for an anonymous request (no bearer token — heal traffic and
+    tokenless readers), the tenant name for a known token, and
+    :class:`UnknownTenantToken` for a present-but-unknown one."""
+    if not authorization:
+        return None
+    scheme, _, token = authorization.partition(" ")
+    if scheme.lower() != "bearer" or not token.strip():
+        raise UnknownTenantToken(f"unsupported Authorization scheme {scheme!r}")
+    tenant = serving_tenant_tokens().get(token.strip())
+    if tenant is None:
+        raise UnknownTenantToken("bearer token not in the tenant table")
+    return tenant
+
+
 def heal_priority_share(default: float = 0.8) -> float:
     """Fraction of the paced egress reserved for HEAL streams while both
     traffic classes are active (``$TPUFT_HEAL_SERVE_PRIORITY_SHARE``,
@@ -199,57 +278,108 @@ class _ServePacer:
     A peer idle past the activity window stops counting against the
     split, so a lone joiner still gets the full heal share. Sub-bucket
     state is pruned on the same window, bounding memory by the number of
-    CONCURRENTLY active peers, not by fleet history."""
+    CONCURRENTLY active peers, not by fleet history.
+
+    The serving class splits the SAME way into per-tenant sub-buckets
+    (the multi-tenant read fan-out): each tenant — identified by the
+    bearer token its readers send (``TPUFT_SERVING_TENANT_TOKENS``);
+    tokenless readers share :data:`DEFAULT_TENANT` — gets a share of the
+    serving rate weighted by its ``TPUFT_SERVING_TENANT_GBPS``
+    entitlement (unlisted tenants weigh 1.0), bounded by that
+    entitlement as an absolute cap, so one tenant's fan-out structurally
+    cannot starve another's while the heal class keeps its priority
+    share above ALL tenants. With no aggregate bound configured
+    (``gbps <= 0``) only the absolute per-tenant caps pace — the
+    tenancy plane works standalone."""
 
     _ACTIVE_WINDOW_SEC = 0.5
 
-    def __init__(self, gbps: float, heal_share: Optional[float] = None) -> None:
+    def __init__(
+        self,
+        gbps: float,
+        heal_share: Optional[float] = None,
+        tenant_gbps: Optional[Dict[str, float]] = None,
+    ) -> None:
         self.gbps = gbps
         self._share = heal_share if heal_share is not None else heal_priority_share()
+        self.tenant_gbps = (
+            dict(tenant_gbps) if tenant_gbps is not None else serving_tenant_gbps()
+        )
         self._lock = threading.Lock()
-        now = time.monotonic()
-        self._ready = {"heal": now, "serving": now}
         self._last_debit = {"heal": float("-inf"), "serving": float("-inf")}
-        # Heal-class sub-buckets: peer -> [virtual-ready clock, last debit].
+        # Per-class sub-buckets: key -> [virtual-ready clock, last debit]
+        # (heal keys are peers; serving keys are tenants).
         self._peers: Dict[str, List[float]] = {}
+        self._tenants: Dict[str, List[float]] = {}
 
-    def debit(self, nbytes: int, cls: str = "heal", peer: Optional[str] = None) -> float:
-        """Charges ``nbytes`` against ``cls``'s share of the bucket (and,
-        for heal traffic, against ``peer``'s sub-bucket of that share);
+    @staticmethod
+    def _touch(
+        buckets: Dict[str, List[float]], key: str, now: float, window: float
+    ) -> List[float]:
+        entry = buckets.setdefault(key, [now, float("-inf")])
+        entry[1] = now
+        for k in [k for k, v in buckets.items() if now - v[1] >= window]:
+            del buckets[k]
+        return entry
+
+    @staticmethod
+    def _charge(entry: List[float], nbytes: int, rate_gbps: float, now: float) -> float:
+        if rate_gbps <= 0 or rate_gbps == float("inf"):
+            return 0.0
+        spb = 8.0 / (rate_gbps * 1e9)
+        start = entry[0] if entry[0] > now else now
+        entry[0] = start + nbytes * spb
+        return max(entry[0] - now, 0.0)
+
+    def debit(
+        self,
+        nbytes: int,
+        cls: str = "heal",
+        peer: Optional[str] = None,
+        tenant: Optional[str] = None,
+    ) -> float:
+        """Charges ``nbytes`` against ``cls``'s share of the bucket (and
+        against ``peer``'s / ``tenant``'s sub-bucket of that share);
         returns how long the caller must sleep so the aggregate rate, the
-        heal-priority split, and the per-joiner fairness split all hold."""
+        heal-priority split, and the per-peer / per-tenant fairness
+        splits all hold."""
         other = "serving" if cls == "heal" else "heal"
         with self._lock:
             now = time.monotonic()
             self._last_debit[cls] = now
             contended = now - self._last_debit[other] < self._ACTIVE_WINDOW_SEC
-            if contended:
-                rate = self.gbps * (
-                    self._share if cls == "heal" else 1.0 - self._share
-                )
-            else:
+            if self.gbps > 0:
                 rate = self.gbps
+                if contended:
+                    rate *= self._share if cls == "heal" else 1.0 - self._share
+            else:
+                rate = float("inf")  # only per-tenant caps (if any) pace
             if cls == "heal":
                 key = peer if peer is not None else "_anon"
-                entry = self._peers.setdefault(key, [now, float("-inf")])
-                entry[1] = now
-                stale = [
-                    k
-                    for k, v in self._peers.items()
-                    if now - v[1] >= self._ACTIVE_WINDOW_SEC
-                ]
-                for k in stale:
-                    del self._peers[k]
-                active = len(self._peers)
-                metrics.set_gauge("tpuft_heal_serve_active_peers", active)
-                spb = 8.0 / (rate * 1e9) * max(active, 1)
-                start = entry[0] if entry[0] > now else now
-                entry[0] = start + nbytes * spb
-                return max(entry[0] - now, 0.0)
-            spb = 8.0 / (rate * 1e9)
-            start = self._ready[cls] if self._ready[cls] > now else now
-            self._ready[cls] = start + nbytes * spb
-            return max(self._ready[cls] - now, 0.0)
+                entry = self._touch(self._peers, key, now, self._ACTIVE_WINDOW_SEC)
+                metrics.set_gauge("tpuft_heal_serve_active_peers", len(self._peers))
+                # Equal per-peer shares of the heal rate.
+                per_peer = (
+                    rate / max(len(self._peers), 1)
+                    if rate != float("inf")
+                    else float("inf")
+                )
+                return self._charge(entry, nbytes, per_peer, now)
+            key = tenant if tenant is not None else DEFAULT_TENANT
+            entry = self._touch(self._tenants, key, now, self._ACTIVE_WINDOW_SEC)
+            metrics.set_gauge("tpuft_serving_active_tenants", len(self._tenants))
+            metrics.inc("tpuft_serving_tenant_bytes_total", nbytes, tenant=key)
+            # Weighted share of the serving rate, capped by the tenant's
+            # absolute entitlement.
+            weight = self.tenant_gbps.get(key, 1.0)
+            total_weight = sum(
+                self.tenant_gbps.get(k, 1.0) for k in self._tenants
+            )
+            share = (
+                rate * weight / total_weight if rate != float("inf") else float("inf")
+            )
+            cap = self.tenant_gbps.get(key, float("inf"))
+            return self._charge(entry, nbytes, min(share, cap), now)
 
 
 _pacer: Optional[_ServePacer] = None
@@ -258,9 +388,14 @@ _pacer_lock = threading.Lock()
 
 def _shared_pacer(gbps: float) -> _ServePacer:
     global _pacer
+    tenant_cfg = serving_tenant_gbps()
     with _pacer_lock:
-        if _pacer is None or _pacer.gbps != gbps:
-            _pacer = _ServePacer(gbps)
+        if (
+            _pacer is None
+            or _pacer.gbps != gbps
+            or _pacer.tenant_gbps != tenant_cfg
+        ):
+            _pacer = _ServePacer(gbps, tenant_gbps=tenant_cfg)
         return _pacer
 
 
@@ -276,12 +411,14 @@ class _RateWriter:
         slice_bytes: int = 1 << 18,
         cls: str = "heal",
         peer: Optional[str] = None,
+        tenant: Optional[str] = None,
     ) -> None:
         self._raw = raw
         self._pacer = pacer
         self._slice = slice_bytes
         self._cls = cls
         self._peer = peer
+        self._tenant = tenant
 
     def write(self, data: Any) -> None:
         mv = memoryview(data)
@@ -290,21 +427,31 @@ class _RateWriter:
         for off in range(0, len(mv), self._slice):
             part = mv[off : off + self._slice]
             self._raw.write(part)
-            delay = self._pacer.debit(len(part), cls=self._cls, peer=self._peer)
+            delay = self._pacer.debit(
+                len(part), cls=self._cls, peer=self._peer, tenant=self._tenant
+            )
             if delay > 0:
                 time.sleep(delay)
 
 
-def maybe_pace_serve(out: Any, cls: str = "heal", peer: Optional[str] = None) -> Any:
+def maybe_pace_serve(
+    out: Any,
+    cls: str = "heal",
+    peer: Optional[str] = None,
+    tenant: Optional[str] = None,
+) -> Any:
     """Wraps ``out`` with the (process-aggregate) serve-rate bound when
     configured. ``cls`` is the traffic class the bytes charge against:
     ``heal`` (default — every existing heal-serve seam) or ``serving``
     (committed-weights readers); ``peer`` identifies the healing joiner
-    for the per-peer fairness split inside the heal class (see
-    :class:`_ServePacer`)."""
+    for the per-peer fairness split inside the heal class, ``tenant``
+    the reader's tenant for the per-tenant split inside the serving
+    class (see :class:`_ServePacer`). Serving traffic is paced whenever
+    EITHER the aggregate bound or a per-tenant entitlement is
+    configured; heal traffic only under the aggregate bound."""
     gbps = serve_rate_gbps()
-    if gbps > 0:
-        return _RateWriter(out, _shared_pacer(gbps), cls=cls, peer=peer)
+    if gbps > 0 or (cls == "serving" and serving_tenant_gbps()):
+        return _RateWriter(out, _shared_pacer(gbps), cls=cls, peer=peer, tenant=tenant)
     return out
 
 
@@ -556,6 +703,15 @@ def _child_main(argv: Optional[List[str]] = None) -> int:
             peer = urllib.parse.parse_qs(split.query).get(
                 "peer", [str(self.client_address[0])]
             )[0]
+            # Tenant/auth parity with the inline handler: a bearer token
+            # marks serving-class read traffic (per-tenant sub-bucket);
+            # an unknown token is refused in-child too.
+            try:
+                tenant = tenant_of_authorization(self.headers.get("Authorization"))
+            except UnknownTenantToken as e:
+                metrics.inc("tpuft_serving_auth_rejects_total")
+                self.send_error(401, f"unknown serving tenant: {e}")
+                return
             if route == "meta":
                 body = staged.meta_bytes
                 self.send_response(200)
@@ -585,12 +741,17 @@ def _child_main(argv: Optional[List[str]] = None) -> int:
                 self.send_response(200)
                 self.send_header("Content-Type", "application/octet-stream")
                 self.send_header("Content-Length", str(total))
+                if netem.enabled():
+                    self.send_header(netem.PACED_HEADER, "1")
                 self.end_headers()
                 out = self.wfile
                 if netem.enabled():
                     netem.pace_latency()
                     out = netem.PacingWriter(out)
-                out = maybe_pace_serve(out, peer=peer)
+                if tenant is not None:
+                    out = maybe_pace_serve(out, cls="serving", tenant=tenant)
+                else:
+                    out = maybe_pace_serve(out, peer=peer)
                 try:
                     for name, size in zip(staged.files, staged.sizes):
                         out.write(size.to_bytes(8, "big"))
@@ -622,12 +783,17 @@ def _child_main(argv: Optional[List[str]] = None) -> int:
             self.send_response(200)
             self.send_header("Content-Type", "application/octet-stream")
             self.send_header("Content-Length", str(size))
+            if netem.enabled():
+                self.send_header(netem.PACED_HEADER, "1")
             self.end_headers()
             out = self.wfile
             if netem.enabled():
                 netem.pace_latency()
                 out = netem.PacingWriter(out)
-            out = maybe_pace_serve(out, peer=peer)
+            if tenant is not None:
+                out = maybe_pace_serve(out, cls="serving", tenant=tenant)
+            else:
+                out = maybe_pace_serve(out, peer=peer)
             if fault == "corrupt_stream":
                 out = _CorruptingWriter(out, size - 1)
             elif fault == "stall_donor":

@@ -56,8 +56,12 @@ KNOWN_ENV = {
     # serve-rate bucket while serving readers are also active.
     "TPUFT_HEAL_SERVE_PRIORITY_SHARE",
     # Committed-weights serving plane (torchft_tpu/serving): publication
-    # cadence + chunking, relay poll cadence.
+    # cadence + chunking, relay poll cadence, long-poll push edge
+    # (switch + bounded server-side hold), multi-tenant fairness + auth
+    # (bearer-token table + per-tenant egress entitlements).
     "TPUFT_PUBLISH_EVERY", "TPUFT_PUBLISH_CHUNKS", "TPUFT_SERVING_POLL_SEC",
+    "TPUFT_SERVING_NOTIFY", "TPUFT_SERVING_NOTIFY_HOLD_SEC",
+    "TPUFT_SERVING_TENANT_TOKENS", "TPUFT_SERVING_TENANT_GBPS",
     "TPUFT_METRICS_PORT", "TPUFT_METRICS_PUSH_SEC",
     # ZeRO plane (torchft_tpu/zero.py): enable flag for the harness/bench
     # loops, fleet-wide shard count, assignment policy, joiner heal
@@ -472,42 +476,86 @@ def _check_rejoin_storm(lighthouse: str) -> Tuple[str, str]:
 
 
 def _check_serving() -> Tuple[str, str]:
-    """Committed-weights serving-plane preflight: one in-process
-    publisher -> relay -> subscriber roundtrip over loopback HTTP (tiny
-    payload). WARN, never FAIL — serving is a read path; a broken relay
-    means readers lag, not that training is wrong."""
+    """Committed-weights serving-plane preflight: validates the serving
+    knobs, then runs one in-process relay-TREE roundtrip over loopback
+    HTTP (publisher -> root relay -> edge relay -> subscriber, tiny
+    payload) so tier stacking — the depth chain every production fan-out
+    relies on — is probed, not assumed. WARN, never FAIL — serving is a
+    read path; a broken relay means readers lag, not that training is
+    wrong."""
     import numpy as np
 
+    from torchft_tpu.checkpointing import serve_child
     from torchft_tpu.serving import (
         CachingRelay,
         WeightPublisher,
         WeightSubscriber,
+        notify_enabled,
         publish_every,
     )
 
+    hold_raw = os.environ.get("TPUFT_SERVING_NOTIFY_HOLD_SEC")
+    if hold_raw is not None:
+        try:
+            if float(hold_raw) <= 0:
+                raise ValueError
+        except ValueError:
+            return (
+                "WARN",
+                f"TPUFT_SERVING_NOTIFY_HOLD_SEC={hold_raw!r} is not a "
+                "positive number (the long-poll hold will fall back to its "
+                "default)",
+            )
+    for env, parser in (
+        (serve_child.ENV_SERVING_TENANT_TOKENS, serve_child.serving_tenant_tokens),
+        (serve_child.ENV_SERVING_TENANT_GBPS, serve_child.serving_tenant_gbps),
+    ):
+        raw = os.environ.get(env, "")
+        configured = [e for e in raw.split(",") if e.strip()]
+        if len(configured) != len(parser()):
+            return (
+                "WARN",
+                f"{env}={raw!r} has malformed entries (parsed "
+                f"{len(parser())} of {len(configured)}) — the skipped "
+                "tenants silently lose their identity/entitlement",
+            )
+
     pub = None
-    relay = None
+    root = None
+    edge = None
     try:
         pub = WeightPublisher(num_chunks=2, timeout=5.0)
         pub.publish(
             step=1, quorum_id=0, state={"doctor": np.arange(8, dtype=np.float32)}
         )
-        relay = CachingRelay([pub.address()], timeout=5.0, start=False)
-        if not relay.poll_once():
-            return "WARN", "relay failed to pull the probe version"
-        version = WeightSubscriber([relay.address()], timeout=5.0).poll()
+        root = CachingRelay([pub.address()], timeout=5.0, start=False)
+        if not root.poll_once():
+            return "WARN", "root relay failed to pull the probe version"
+        edge = CachingRelay([root.address()], timeout=5.0, start=False)
+        if not edge.poll_once():
+            return "WARN", "edge relay failed to pull through the root tier"
+        version = WeightSubscriber([edge.address()], timeout=5.0).poll()
         if version is None or version.step != 1:
-            return "WARN", "subscriber failed to adopt the probe version"
+            return "WARN", "subscriber failed to adopt through the 2-deep tree"
+        tenants = serve_child.serving_tenant_gbps()
         return (
             "PASS",
-            "publisher->relay->subscriber probe ok (publish cadence: every "
-            f"{publish_every()} committed step(s))",
+            "publisher->root->edge->subscriber tree probe ok (publish "
+            f"cadence: every {publish_every()} committed step(s); push "
+            f"{'on' if notify_enabled() else 'off'}; "
+            + (
+                f"{len(tenants)} tenant entitlement(s)"
+                if tenants
+                else "single-tenant egress"
+            )
+            + ")",
         )
     except Exception as e:  # noqa: BLE001 — WARN, never FAIL
         return "WARN", f"serving probe failed: {type(e).__name__}: {e}"
     finally:
-        if relay is not None:
-            relay.shutdown(wait=False)
+        for node in (edge, root):
+            if node is not None:
+                node.shutdown(wait=False)
         if pub is not None:
             pub.shutdown(wait=False)
 

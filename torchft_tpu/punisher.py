@@ -39,11 +39,16 @@ Heal-path modes target the recovery plane itself:
   the same donor set, exercising the coordinated stripe plan, per-joiner
   serve fairness, and the joiner ingress bound.
 - ``kill_relay``: armed at the ``serving_relay`` site (optionally
-  ``--relay-tag <port>`` to target one relay of a tier); the next relay
-  poll round or reader GET consumes it and the relay dies abruptly
-  mid-service — subscribers must fail over to another endpoint without
-  ever observing a torn or stale-era version (the serving plane's
-  chaos drill, tests/test_serving.py).
+  ``--donor-tag <port>`` to target one relay of a tier — in a relay
+  TREE that is how an INTERIOR relay is singled out, since every tier
+  speaks the same protocol and shares the site family); the next relay
+  poll round, reader GET, or parked ``/serving/notify`` long-poll
+  consumes it and the relay dies abruptly mid-service — downstream
+  relays and subscribers must re-home to a sibling/parent announcing
+  the same digest without ever observing a torn or stale-era version
+  (the serving plane's chaos drills, tests/test_serving.py +
+  tests/test_serving_tree.py; benchmarks/relay_tree_bench.py SIGKILLs
+  whole interior relay processes for the out-of-process variant).
 
     python -m torchft_tpu.punisher --lighthouse host:29510 kill_one
     python -m torchft_tpu.punisher --lighthouse host:29510 fault_one --mode deadlock

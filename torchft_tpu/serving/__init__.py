@@ -21,6 +21,13 @@ rows; benchmarks/serving_bench.py measures reader throughput under
 fleet chaos.
 """
 
+from torchft_tpu.serving._wire import (
+    ENV_NOTIFY,
+    ENV_NOTIFY_HOLD_SEC,
+    PollPacer,
+    notify_enabled,
+    notify_hold_sec,
+)
 from torchft_tpu.serving.publisher import (
     ENV_PUBLISH_CHUNKS,
     ENV_PUBLISH_EVERY,
@@ -39,9 +46,14 @@ __all__ = [
     "CachingRelay",
     "WeightSubscriber",
     "ServingVersion",
+    "PollPacer",
     "ENV_PUBLISH_EVERY",
     "ENV_PUBLISH_CHUNKS",
     "ENV_SERVING_POLL_SEC",
+    "ENV_NOTIFY",
+    "ENV_NOTIFY_HOLD_SEC",
     "publish_every",
     "serving_poll_sec",
+    "notify_enabled",
+    "notify_hold_sec",
 ]

@@ -3,63 +3,178 @@
 The serving plane speaks the heal plane's exact chunk protocol
 (checkpointing/http_transport.py: pickled ``/checkpoint/{step}/meta``,
 raw ``/checkpoint/{step}/{i}`` chunk bodies, per-chunk CRCs bound into a
-whole-checkpoint sha256 digest) plus one JSON announcement route,
-``/serving/latest`` — the version descriptor a publisher or relay serves
-so readers can discover the newest fully staged version without
-unpickling anything. These helpers keep the three roles (publisher /
-relay / subscriber) byte-compatible.
+whole-checkpoint sha256 digest) plus two JSON announcement routes:
+
+- ``/serving/latest`` — the version descriptor a publisher or relay
+  serves so readers can discover the newest fully staged version without
+  unpickling anything;
+- ``/serving/notify?after=<step>&hold=<sec>`` — the long-poll twin: the
+  request PARKS (bounded hold) until the server announces a version
+  newer than ``after``, then answers with the same descriptor body (204
+  on hold expiry — the client re-arms). A publish therefore propagates
+  down a relay tree in ~one wire RTT per hop instead of one poll
+  interval per hop; verification is unchanged (the descriptor a notify
+  delivers goes through the identical digest-binding / era checks, so
+  push is purely a latency plane, never a trust plane).
+
+These helpers keep the three roles (publisher / relay / subscriber)
+byte-compatible, and pin the emulated-DCN shim (utils/netem.py) at the
+client fetch seam: every serving-plane pull charges the emulated link's
+RTT + serialization, EXCEPT the response leg of bodies a netem-paced
+server already charged (it declares ``netem.PACED_HEADER``), so no hop
+is double-billed regardless of which side carries the shim.
+
+Serving requests may carry a tenant bearer token
+(``Authorization: Bearer <token>``; TPUFT_SERVING_TENANT_TOKENS) —
+the multi-tenant egress fairness identity, checked at every serve seam.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import random
+import threading
 import urllib.request
 from typing import Any, Callable, Dict, List, Optional
 
+from torchft_tpu import metrics
 from torchft_tpu.checkpointing.http_transport import (
     _CRC_UPDATERS,
     _checkpoint_digest,
 )
+from torchft_tpu.utils import netem
 
 __all__ = [
     "LATEST_ROUTE",
+    "NOTIFY_ROUTE",
+    "ENV_NOTIFY",
+    "ENV_NOTIFY_HOLD_SEC",
+    "notify_enabled",
+    "notify_hold_sec",
     "fetch_json",
     "fetch_bytes",
+    "fetch_notify",
     "latest_descriptor",
     "validate_latest",
     "chunk_crc",
+    "NotifyHub",
+    "serve_notify",
+    "PollPacer",
 ]
 
 LATEST_ROUTE = "/serving/latest"
+NOTIFY_ROUTE = "/serving/notify"
+
+ENV_NOTIFY = "TPUFT_SERVING_NOTIFY"
+ENV_NOTIFY_HOLD_SEC = "TPUFT_SERVING_NOTIFY_HOLD_SEC"
 
 
-def fetch_json(url: str, timeout: float) -> Dict[str, Any]:
+def notify_enabled(default: bool = True) -> bool:
+    """Long-poll push switch (``$TPUFT_SERVING_NOTIFY``; default on).
+    Off, or against an upstream that does not speak the route, the plane
+    degrades to the jittered poll loop — push is a latency optimization,
+    never a correctness dependency."""
+    raw = os.environ.get(ENV_NOTIFY)
+    if raw is None:
+        return default
+    return raw not in ("", "0")
+
+
+def notify_hold_sec(default: float = 25.0) -> float:
+    """Maximum seconds one notify request may park server-side
+    (``$TPUFT_SERVING_NOTIFY_HOLD_SEC``). Bounded so a dead client's
+    handler thread is reclaimed and an idle tier re-arms on a heartbeat
+    cadence; clients re-issue on 204, so the hold length only trades
+    re-arm traffic against thread residency, never propagation latency."""
+    try:
+        return max(0.05, float(os.environ.get(ENV_NOTIFY_HOLD_SEC, str(default))))
+    except ValueError:
+        return default
+
+
+def _fetch(url: str, timeout: float, token: Optional[str]) -> Any:
+    """One GET with the netem link charged at this CLIENT seam: request
+    leg up front, response leg (latency + serialization) after the read —
+    unless the server declared it already paced the body."""
+    request = urllib.request.Request(url)
+    if token:
+        request.add_header("Authorization", f"Bearer {token}")
+    link = netem.enabled()
+    if link:
+        netem.pace_latency()  # request leg
+    resp = urllib.request.urlopen(request, timeout=timeout)
+    try:
+        body = resp.read()
+        server_paced = resp.headers.get(netem.PACED_HEADER) == "1"
+        status = resp.status
+    finally:
+        resp.close()
+    if link and not server_paced:
+        netem.pace(len(body))  # response leg: RTT/2 + bytes/bandwidth
+    return body, status
+
+
+def fetch_json(url: str, timeout: float, token: Optional[str] = None) -> Dict[str, Any]:
     """One JSON GET (no retry — serving readers fail over across
     endpoints instead of betting a retry window on one)."""
-    with urllib.request.urlopen(url, timeout=timeout) as resp:
-        body = resp.read()
+    body, _ = _fetch(url, timeout, token)
     data = json.loads(body)
     if not isinstance(data, dict):
         raise ValueError(f"expected a JSON object from {url}")
     return data
 
 
-def fetch_bytes(url: str, timeout: float) -> bytes:
-    with urllib.request.urlopen(url, timeout=timeout) as resp:
-        return resp.read()
+def fetch_bytes(url: str, timeout: float, token: Optional[str] = None) -> bytes:
+    body, _ = _fetch(url, timeout, token)
+    return body
+
+
+def fetch_notify(
+    base: str,
+    after: int,
+    timeout: float,
+    token: Optional[str] = None,
+    hold: Optional[float] = None,
+) -> Optional[Dict[str, Any]]:
+    """One long-poll round against ``base``: parks server-side until a
+    version newer than ``after`` is announced (bounded by ``hold``) and
+    returns its descriptor, or None when the hold expired with nothing
+    new (the caller re-arms). The descriptor is NOT trusted — callers
+    run it through the same validation a polled ``/serving/latest``
+    body gets."""
+    hold = hold if hold is not None else notify_hold_sec()
+    url = f"{base}{NOTIFY_ROUTE}?after={int(after)}&hold={hold:g}"
+    # The socket timeout must outlive the server-side hold.
+    body, status = _fetch(url, hold + timeout, token)
+    if status == 204 or not body:
+        return None
+    data = json.loads(body)
+    if not isinstance(data, dict):
+        raise ValueError(f"expected a JSON descriptor from {url}")
+    return data
 
 
 def latest_descriptor(
-    manifest: Dict[str, Any], base: str, published_ts: float
+    manifest: Dict[str, Any],
+    base: str,
+    published_ts: float,
+    depth: int = 0,
+    origin_ts: Optional[float] = None,
 ) -> Dict[str, Any]:
     """The ``/serving/latest`` body: the staging manifest
     (http_transport._stage_manifest) plus where to fetch the chunks from
-    (``base`` — the publisher's transport/sidecar or a relay) and when
-    the version went live."""
+    (``base`` — the publisher's transport/sidecar or a relay), when THIS
+    tier went live (``published_ts``), the serving node's tree depth
+    (publisher = 0, each relay tier +1 — fleet_status's RELAY column),
+    and the ORIGIN publication time (``origin_ts``, preserved across
+    tiers so publish-to-edge propagation is measurable end to end)."""
     descriptor = dict(manifest)
     descriptor["format"] = 1
     descriptor["base"] = base
     descriptor["published_ts"] = published_ts
+    descriptor["depth"] = depth
+    descriptor["origin_ts"] = origin_ts if origin_ts is not None else published_ts
     return descriptor
 
 
@@ -89,3 +204,114 @@ def validate_latest(latest: Dict[str, Any]) -> Optional[str]:
 def chunk_crc(data: bytes, algo: str) -> int:
     update: Callable[[int, Any], int] = _CRC_UPDATERS[algo]
     return update(0, data)
+
+
+class NotifyHub:
+    """Server-side long-poll rendezvous: handler threads park in
+    :meth:`wait_newer` until :meth:`announce` moves the newest step past
+    their ``after`` watermark (or the bounded hold expires). One hub per
+    serving node (publisher announce server / relay); ``close()`` wakes
+    every waiter so shutdown and the punisher's ``kill_relay`` never
+    strand a parked reader past its hold."""
+
+    def __init__(self) -> None:
+        self._cond = threading.Condition()
+        self._latest = -1
+        self._closed = False
+        self._waiters = 0
+
+    def announce(self, step: int) -> None:
+        with self._cond:
+            if step > self._latest:
+                self._latest = step
+                self._cond.notify_all()
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    def wait_newer(self, after: int, hold: float) -> bool:
+        """Parks until a step newer than ``after`` was announced; True
+        when one is available (False = hold expired / hub closed)."""
+        with self._cond:
+            self._waiters += 1
+            metrics.set_gauge("tpuft_serving_notify_waiters", self._waiters)
+            try:
+                self._cond.wait_for(
+                    lambda: self._closed or self._latest > after, timeout=hold
+                )
+                return self._latest > after
+            finally:
+                self._waiters -= 1
+                metrics.set_gauge("tpuft_serving_notify_waiters", self._waiters)
+
+
+def serve_notify(
+    handler: Any,
+    query: str,
+    hub: NotifyHub,
+    descriptor: Callable[[], Optional[Dict[str, Any]]],
+) -> None:
+    """The ``/serving/notify`` route body, shared by the publisher's
+    announce server and the relay: parse ``after``/``hold``, park on the
+    hub, answer the current descriptor (200) or nothing-new (204). The
+    hold is clamped to the server's ``notify_hold_sec`` so a client
+    cannot pin handler threads arbitrarily long."""
+    import urllib.parse as _parse
+
+    qs = _parse.parse_qs(query)
+    try:
+        after = int(qs.get("after", ["-1"])[0])
+    except ValueError:
+        handler.send_error(400, "bad after watermark")
+        return
+    try:
+        hold = min(float(qs.get("hold", ["inf"])[0]), notify_hold_sec())
+    except ValueError:
+        hold = notify_hold_sec()
+    metrics.inc("tpuft_serving_notify_requests_total")
+    hub.wait_newer(after, hold)
+    latest = descriptor()
+    if latest is None or int(latest.get("step", -1)) <= after:
+        handler.send_response(204)
+        handler.send_header("Content-Length", "0")
+        handler.end_headers()
+        return
+    metrics.inc("tpuft_serving_notify_wakeups_total")
+    body = json.dumps(latest).encode()
+    handler.send_response(200)
+    handler.send_header("Content-Type", "application/json")
+    handler.send_header("Content-Length", str(len(body)))
+    handler.end_headers()
+    try:
+        handler.wfile.write(body)
+    except (ConnectionError, TimeoutError, OSError):
+        handler.close_connection = True
+
+
+class PollPacer:
+    """Deterministic per-reader poll pacing: full jitter (0.5–1.5× the
+    base interval, seeded per reader) plus exponential backoff on
+    consecutive failures (capped). Every reader of a tier polling on the
+    same cadence is a synchronized thundering herd at each version bump
+    — the seed spreads the herd deterministically (reproducible drills),
+    and backoff keeps a dead tier from being hammered while it restarts.
+    Notify mode makes polling the fallback path; the fallback must not
+    herd either."""
+
+    MAX_BACKOFF = 16.0
+
+    def __init__(self, interval: float, seed: Optional[int] = None) -> None:
+        self.interval = max(float(interval), 0.01)
+        self._rng = random.Random(seed)
+        self._mult = 1.0
+
+    def reset(self) -> None:
+        self._mult = 1.0
+
+    def next_delay(self, failed: bool = False) -> float:
+        """The next sleep: jittered base cadence, doubled (capped) after
+        each consecutive ``failed`` round, reset by a clean one."""
+        self._mult = min(self._mult * 2.0, self.MAX_BACKOFF) if failed else 1.0
+        return self.interval * self._mult * (0.5 + self._rng.random())

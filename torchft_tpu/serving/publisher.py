@@ -41,7 +41,17 @@ from typing import Any, Callable, Dict, Optional
 
 from torchft_tpu import metrics, tracing
 from torchft_tpu.checkpointing.http_transport import HTTPTransport
-from torchft_tpu.serving._wire import LATEST_ROUTE, latest_descriptor
+from torchft_tpu.checkpointing.serve_child import (
+    UnknownTenantToken,
+    tenant_of_authorization,
+)
+from torchft_tpu.serving._wire import (
+    LATEST_ROUTE,
+    NOTIFY_ROUTE,
+    NotifyHub,
+    latest_descriptor,
+    serve_notify,
+)
 
 __all__ = [
     "WeightPublisher",
@@ -110,6 +120,10 @@ class WeightPublisher:
         self._latest: Optional[Dict[str, Any]] = None
         self._due: Optional[int] = None
         self._shutdown = False
+        # Long-poll push edge: notify waiters (subscribers, child relays)
+        # park here and wake the instant publish() flips the descriptor —
+        # propagation becomes a wire RTT, not a poll interval.
+        self._hub = NotifyHub()
 
         publisher = self
 
@@ -120,8 +134,21 @@ class WeightPublisher:
                 pass
 
             def do_GET(self) -> None:
-                if self.path.split("?", 1)[0] != LATEST_ROUTE:
+                route, _, query = self.path.partition("?")
+                if route not in (LATEST_ROUTE, NOTIFY_ROUTE):
                     self.send_error(404, "unknown route")
+                    return
+                # Tenant auth parity with the chunk seams: an unknown
+                # bearer token is refused at discovery too, so a
+                # misconfigured credential surfaces on the FIRST fetch.
+                try:
+                    tenant_of_authorization(self.headers.get("Authorization"))
+                except UnknownTenantToken as e:
+                    metrics.inc("tpuft_serving_auth_rejects_total")
+                    self.send_error(401, f"unknown serving tenant: {e}")
+                    return
+                if route == NOTIFY_ROUTE:
+                    serve_notify(self, query, publisher._hub, publisher.latest)
                     return
                 with publisher._lock:
                     latest = publisher._latest
@@ -218,10 +245,16 @@ class WeightPublisher:
                 "(HTTPTransport); got None from send_checkpoint"
             )
         latest = latest_descriptor(
-            manifest, base=self._transport.metadata(), published_ts=time.time()
+            manifest,
+            base=self._transport.metadata(),
+            published_ts=time.time(),
+            depth=0,
         )
         with self._lock:
             self._latest = latest
+        # Wake the long-poll edge AFTER the descriptor flip: a woken
+        # waiter always re-reads a fully staged, announced version.
+        self._hub.announce(step)
         elapsed = time.perf_counter() - t0
         nbytes = sum(manifest["chunk_sizes"])
         metrics.inc("tpuft_publish_total")
@@ -252,6 +285,7 @@ class WeightPublisher:
             if self._shutdown:
                 return
             self._shutdown = True
+        self._hub.close()
         self._server.shutdown()
         self._server.server_close()
         if self._owns_transport:

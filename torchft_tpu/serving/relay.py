@@ -46,13 +46,23 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
 
 from torchft_tpu import metrics, tracing
-from torchft_tpu.checkpointing.serve_child import maybe_pace_serve
+from torchft_tpu.checkpointing.serve_child import (
+    UnknownTenantToken,
+    maybe_pace_serve,
+    tenant_of_authorization,
+)
 from torchft_tpu.serving._wire import (
     LATEST_ROUTE,
+    NOTIFY_ROUTE,
+    NotifyHub,
+    PollPacer,
     chunk_crc,
     fetch_bytes,
     fetch_json,
+    fetch_notify,
     latest_descriptor,
+    notify_enabled,
+    serve_notify,
     validate_latest,
 )
 from torchft_tpu.utils import faultinject
@@ -85,6 +95,8 @@ class _RelayVersion:
         "meta_bytes",
         "chunks",
         "ts",
+        "depth",
+        "origin_ts",
     )
 
     def __init__(
@@ -98,6 +110,8 @@ class _RelayVersion:
         meta_bytes: bytes,
         chunks: List[bytes],
         ts: float,
+        depth: int = 1,
+        origin_ts: Optional[float] = None,
     ) -> None:
         self.step = step
         self.quorum_id = quorum_id
@@ -108,6 +122,11 @@ class _RelayVersion:
         self.meta_bytes = meta_bytes
         self.chunks = chunks
         self.ts = ts
+        # Tree position: upstream's announced depth + 1 (publisher = 0).
+        self.depth = depth
+        # ORIGIN publication time, preserved across tiers — the
+        # publish-to-edge propagation reference.
+        self.origin_ts = origin_ts if origin_ts is not None else ts
 
     def manifest(self) -> Dict[str, Any]:
         return {
@@ -137,6 +156,9 @@ class CachingRelay:
         timeout: float = 10.0,
         bind_port: int = 0,
         start: bool = True,
+        notify: Optional[bool] = None,
+        token: Optional[str] = None,
+        jitter_seed: Optional[int] = None,
     ) -> None:
         if not upstreams:
             raise ValueError("CachingRelay needs at least one upstream")
@@ -145,10 +167,18 @@ class CachingRelay:
         self._poll_interval = (
             poll_interval if poll_interval is not None else serving_poll_sec()
         )
+        self._notify = notify if notify is not None else notify_enabled()
+        # Bearer token this relay presents upstream (it pulls on its
+        # tenant's behalf; its OWN readers present their own tokens).
+        self._token = token
+        self._jitter_seed = jitter_seed
         self._lock = threading.Lock()
         self._current: Optional[_RelayVersion] = None
         self._stop = threading.Event()
         self.dead = False
+        # Downstream long-poll edge: subscribers/child relays park here.
+        self._hub = NotifyHub()
+        metrics.set_gauge("tpuft_serving_relay_upstreams", len(self._upstreams))
 
         relay = self
 
@@ -167,18 +197,26 @@ class CachingRelay:
                 if metrics._serve_metrics_http(self, metrics.REGISTRY, self.path):
                     return
                 split = urllib.parse.urlsplit(self.path)
+                # Multi-tenant identity of this reader (None = tokenless,
+                # pooled under the default tenant); unknown tokens are
+                # refused before any body (or notify hold) is spent.
+                try:
+                    tenant = tenant_of_authorization(
+                        self.headers.get("Authorization")
+                    )
+                except UnknownTenantToken as e:
+                    metrics.inc("tpuft_serving_auth_rejects_total")
+                    self.send_error(401, f"unknown serving tenant: {e}")
+                    return
                 version = relay.current()
+                if split.path == NOTIFY_ROUTE:
+                    serve_notify(self, split.query, relay._hub, relay._descriptor)
+                    return
                 if split.path == LATEST_ROUTE:
                     if version is None:
                         self.send_error(404, "no version cached yet")
                         return
-                    body = json.dumps(
-                        latest_descriptor(
-                            version.manifest(),
-                            base=relay.address(),
-                            published_ts=version.ts,
-                        )
-                    ).encode()
+                    body = json.dumps(relay._descriptor(version)).encode()
                     metrics.inc("tpuft_serving_requests_total", route="latest")
                     self.send_response(200)
                     self.send_header("Content-Type", "application/json")
@@ -229,7 +267,7 @@ class CachingRelay:
                 self.send_header("Content-Type", "application/octet-stream")
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
-                out = maybe_pace_serve(self.wfile, cls="serving")
+                out = maybe_pace_serve(self.wfile, cls="serving", tenant=tenant)
                 try:
                     out.write(body)
                 except (ConnectionError, TimeoutError, OSError):
@@ -261,6 +299,23 @@ class CachingRelay:
         with self._lock:
             return self._current
 
+    def _descriptor(
+        self, version: Optional[_RelayVersion] = None
+    ) -> Optional[Dict[str, Any]]:
+        """The ``/serving/latest`` body for ``version`` (default: the
+        held one): this relay's address as the chunk base, its tree
+        depth, and the preserved origin publication time."""
+        version = version if version is not None else self.current()
+        if version is None:
+            return None
+        return latest_descriptor(
+            version.manifest(),
+            base=self.address(),
+            published_ts=version.ts,
+            depth=version.depth,
+            origin_ts=version.origin_ts,
+        )
+
     def _consume_fault(self) -> bool:
         return (
             faultinject.consume(
@@ -278,6 +333,9 @@ class CachingRelay:
             return
         self.dead = True
         self._stop.set()
+        # Wake parked notify waiters so their hanging GETs resolve now
+        # (204 / connection cut) instead of at hold expiry.
+        self._hub.close()
         metrics.inc("tpuft_serving_relay_deaths_total")
         tracing.record("relay_died", step=self._current.step if self._current else -1)
         logger.warning("relay %s dying (kill_relay)", self.address())
@@ -289,17 +347,72 @@ class CachingRelay:
     # -- pulling -----------------------------------------------------------
 
     def _poll_loop(self) -> None:
-        while not self._stop.wait(self._poll_interval):
+        # Deterministic per-relay jitter: seeded by the bound port so a
+        # tier of relays spreads its poll herd reproducibly.
+        pacer = PollPacer(
+            self._poll_interval,
+            seed=self._jitter_seed
+            if self._jitter_seed is not None
+            else self._server.server_address[1],
+        )
+        pending: Optional[Dict[str, Any]] = None
+        while not self._stop.is_set():
+            failed = False
             try:
-                self.poll_once()
+                self.poll_once(descriptor=pending)
             except Exception as e:  # noqa: BLE001 — keep serving, retry next round
+                failed = True
                 metrics.inc("tpuft_serving_pull_failures_total")
                 logger.warning("relay pull failed (%s); retrying next round", e)
+            pending = None
+            if not failed and self._notify and not self.dead:
+                cur = self.current()
+                # after=-1 before the first adoption: an upstream that has
+                # (or gets) ANY version wakes us — tree bring-up rides the
+                # push edge too, tier by tier.
+                outcome = self._wait_notify(cur.step if cur is not None else -1)
+                if outcome is not None:
+                    # Long-poll round completed: an upstream pushed a new
+                    # descriptor (loop pulls it NOW — the ~RTT/hop
+                    # propagation path) or the hold expired and we re-arm;
+                    # no poll-interval sleep either way.
+                    if isinstance(outcome, dict):
+                        pending = outcome
+                    continue
+            if self._stop.wait(pacer.next_delay(failed)):
+                return
 
-    def poll_once(self) -> bool:
+    def _wait_notify(self, after: int) -> Any:
+        """One long-poll round against the upstream set: parks on the
+        first upstream that speaks ``/serving/notify`` until it announces
+        a version newer than ``after`` (returns its descriptor — the
+        loop pulls from the announcer without rediscovery), its bounded
+        hold expires (False — re-arm), or every upstream failed (None —
+        the caller falls back to the jittered poll cadence; a tier that
+        cannot push degrades to polling, never to silence)."""
+        for upstream in list(self._upstreams):
+            if self._stop.is_set():
+                return False
+            try:
+                woke = fetch_notify(
+                    upstream, after, self._timeout, token=self._token
+                )
+            except Exception:  # noqa: BLE001 — old/dead upstream: next one
+                metrics.inc("tpuft_serving_upstream_failovers_total")
+                continue
+            return woke if woke is not None else False
+        return None
+
+    def poll_once(self, descriptor: Optional[Dict[str, Any]] = None) -> bool:
         """One poll round: discover the newest acceptable upstream version
         and pull it if it is new. Returns True when a new version was
-        adopted."""
+        adopted. ``descriptor`` (a just-delivered, still-unvalidated
+        notify body) skips the discovery fan-out — the pull fetches from
+        its announcer directly, which is what makes push propagation cost
+        ~(1.5 + chunks) RTTs per hop instead of re-walking every
+        upstream; a mid-pull failure falls back to the next full
+        discovery round, so the failover set is narrower only for the
+        fast path, never for recovery."""
         if self._consume_fault():
             self.die()
             return False
@@ -307,31 +420,45 @@ class CachingRelay:
             return False
         best: Optional[Dict[str, Any]] = None
         sources: List[str] = []
-        for upstream in self._upstreams:
-            try:
-                latest = fetch_json(f"{upstream}{LATEST_ROUTE}", self._timeout)
-            except Exception:  # noqa: BLE001 — a dead upstream is routine
-                metrics.inc("tpuft_serving_upstream_failovers_total")
-                continue
-            reason = validate_latest(latest)
+        if descriptor is not None:
+            reason = validate_latest(descriptor)
             if reason is not None:
                 metrics.inc("tpuft_serving_integrity_rejects_total")
-                logger.warning("upstream %s rejected: %s", upstream, reason)
-                continue
-            if best is None or _newer(latest, best):
-                best = latest
+                logger.warning("notify descriptor rejected: %s", reason)
+                return False
+            best = descriptor
+        else:
+            for upstream in self._upstreams:
+                try:
+                    latest = fetch_json(
+                        f"{upstream}{LATEST_ROUTE}", self._timeout, token=self._token
+                    )
+                except Exception:  # noqa: BLE001 — a dead upstream is routine
+                    metrics.inc("tpuft_serving_upstream_failovers_total")
+                    continue
+                reason = validate_latest(latest)
+                if reason is not None:
+                    metrics.inc("tpuft_serving_integrity_rejects_total")
+                    logger.warning("upstream %s rejected: %s", upstream, reason)
+                    continue
+                if best is None or _newer(latest, best):
+                    best = latest
+            if best is None:
+                return False
+            # Every upstream announcing the SAME digest serves
+            # interchangeable bytes (committed state is bitwise
+            # identical) — they form this pull's failover set.
+            for upstream in self._upstreams:
+                try:
+                    latest = fetch_json(
+                        f"{upstream}{LATEST_ROUTE}", self._timeout, token=self._token
+                    )
+                except Exception:  # noqa: BLE001
+                    continue
+                if latest.get("digest") == best["digest"] and latest.get("base"):
+                    sources.append(latest["base"])
         if best is None:
             return False
-        # Every upstream announcing the SAME digest serves interchangeable
-        # bytes (committed state is bitwise identical) — they form this
-        # pull's failover set.
-        for upstream in self._upstreams:
-            try:
-                latest = fetch_json(f"{upstream}{LATEST_ROUTE}", self._timeout)
-            except Exception:  # noqa: BLE001
-                continue
-            if latest.get("digest") == best["digest"] and latest.get("base"):
-                sources.append(latest["base"])
         current = self.current()
         if current is not None:
             if best["step"] < current.step or (
@@ -364,6 +491,7 @@ class CachingRelay:
         meta_bytes = self._fetch_failover(
             live, f"/checkpoint/{step}/meta", expect_crc=None, algo=algo
         )
+        depth = int(latest.get("depth", 0)) + 1
         chunks: List[Optional[bytes]] = [None] * len(crcs)
         reused = 0
         saved = 0
@@ -402,14 +530,20 @@ class CachingRelay:
             meta_bytes=meta_bytes,
             chunks=chunks,  # type: ignore[arg-type]
             ts=time.time(),
+            depth=depth,
+            origin_ts=latest.get("origin_ts"),
         )
         with self._lock:
             self._current = version
+        # Swap first, THEN wake the long-poll edge: a woken waiter always
+        # reads the fully verified version.
+        self._hub.announce(step)
         metrics.inc("tpuft_serving_pulls_total")
         if reused:
             metrics.inc("tpuft_serving_delta_chunks_reused_total", reused)
             metrics.inc("tpuft_serving_delta_bytes_saved_total", saved)
         metrics.set_gauge("tpuft_serving_version_step", step)
+        metrics.set_gauge("tpuft_serving_relay_depth", depth)
         tracing.record(
             "serving_pull",
             step=step,
@@ -461,6 +595,7 @@ class CachingRelay:
 
     def shutdown(self, wait: bool = True) -> None:
         self._stop.set()
+        self._hub.close()
         if not self.dead:
             self._server.shutdown()
             self._server.server_close()

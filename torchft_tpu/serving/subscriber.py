@@ -22,15 +22,29 @@ version's is a stale-era read and is rejected
 Delta-aware: decoded chunks are cached per index with their ``(crc,
 size)``; a version bump re-decodes (and re-fetches) only chunks that
 actually changed — the reader-side twin of the relay's delta pull.
+
+Push-aware: :meth:`WeightSubscriber.wait_for_update` parks a long-poll
+``/serving/notify`` request at an endpoint (bounded hold, see
+_wire.fetch_notify) and polls the moment a newer version is announced —
+adoption latency becomes a wire RTT, not a poll interval. The delivered
+descriptor is never trusted: the identical verify-then-swap pipeline
+runs on every adoption, push or poll. :meth:`watch` is the reader loop
+(notify-first, deterministic-jittered poll with exponential backoff as
+the fallback — the fallback path must not thundering-herd either).
+
+Multi-tenant: a reader constructed with a bearer ``token`` sends it on
+every serving fetch; the serve seams charge its bytes to its tenant's
+egress sub-bucket (TPUFT_SERVING_TENANT_TOKENS / _GBPS).
 """
 
 from __future__ import annotations
 
 import io
 import logging
+import threading
 import time
 from dataclasses import dataclass
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 
@@ -39,15 +53,32 @@ from torchft_tpu._safe_pickle import safe_loads
 from torchft_tpu.checkpointing import _serialization
 from torchft_tpu.serving._wire import (
     LATEST_ROUTE,
+    PollPacer,
     chunk_crc,
     fetch_bytes,
     fetch_json,
+    fetch_notify,
+    notify_enabled,
     validate_latest,
 )
+from torchft_tpu.serving.relay import serving_poll_sec
 
 __all__ = ["WeightSubscriber", "ServingVersion"]
 
 logger = logging.getLogger(__name__)
+
+# Deterministic default jitter seeds: readers created in the same order
+# get the same seeds run to run (reproducible drills), while distinct
+# readers spread across the jitter window.
+_seed_lock = threading.Lock()
+_seed_counter = 0
+
+
+def _next_seed() -> int:
+    global _seed_counter
+    with _seed_lock:
+        _seed_counter += 1
+        return _seed_counter
 
 
 @dataclass(frozen=True)
@@ -64,14 +95,34 @@ class ServingVersion:
 class WeightSubscriber:
     """Polls serving endpoints and holds the newest verified version."""
 
-    def __init__(self, endpoints: List[str], timeout: float = 10.0) -> None:
+    def __init__(
+        self,
+        endpoints: List[str],
+        timeout: float = 10.0,
+        token: Optional[str] = None,
+        notify: Optional[bool] = None,
+        poll_interval: Optional[float] = None,
+        jitter_seed: Optional[int] = None,
+    ) -> None:
         if not endpoints:
             raise ValueError("WeightSubscriber needs at least one endpoint")
         self._endpoints = list(endpoints)
         self._timeout = timeout
+        self._token = token
+        self._notify = notify if notify is not None else notify_enabled()
+        self._pacer = PollPacer(
+            poll_interval if poll_interval is not None else serving_poll_sec(),
+            seed=jitter_seed if jitter_seed is not None else _next_seed(),
+        )
         self._version: Optional[ServingVersion] = None
         # chunk index -> (crc, size, decoded chunk dict): the delta cache.
         self._chunk_cache: Dict[int, Tuple[int, int, Any]] = {}
+        # Round outcome flags for watch(): did the last wait_for_update
+        # park a full quiet hold (no pacing needed), and did the last
+        # poll actually FAIL (backoff) vs merely find nothing new
+        # (plain jittered cadence)?
+        self._held_full_round = False
+        self._last_poll_failed = False
 
     def current(self) -> Optional[ServingVersion]:
         return self._version
@@ -80,18 +131,90 @@ class WeightSubscriber:
         """One poll round; returns the newly adopted version, or None when
         there is nothing new (or this round failed — the held version is
         untouched either way)."""
+        self._last_poll_failed = False
         try:
             return self._poll()
         except Exception as e:  # noqa: BLE001 — a failed poll is staleness
+            self._last_poll_failed = True
             metrics.inc("tpuft_serving_reader_poll_failures_total")
             logger.warning("subscriber poll failed (%s); keeping held version", e)
             return None
+
+    def wait_for_update(self, hold: Optional[float] = None):
+        """One PUSH round: parks a long-poll ``/serving/notify`` at an
+        endpoint until it announces a version newer than the held one (or
+        the bounded ``hold`` expires), then runs the normal verify-then-
+        swap poll. Returns the newly adopted version, or None (hold
+        expired with nothing new / every endpoint failed / verification
+        failed — the held version is untouched either way). With notify
+        off this IS :meth:`poll`."""
+        self._held_full_round = False
+        if not self._notify:
+            return self.poll()
+        held = self._version
+        after = held.step if held is not None else -1
+        for _ in range(len(self._endpoints)):
+            endpoint = self._endpoints[0]
+            try:
+                descriptor = fetch_notify(
+                    endpoint, after, self._timeout, token=self._token, hold=hold
+                )
+            except Exception:  # noqa: BLE001 — endpoint dead or notify-less
+                self._endpoints.append(self._endpoints.pop(0))
+                metrics.inc("tpuft_serving_reader_failovers_total")
+                continue
+            if descriptor is None:
+                # A full hold passed quietly — nothing new anywhere; the
+                # caller re-arms without a poll-interval sleep.
+                self._held_full_round = True
+                return None
+            # A notify woke us: adopt through the IDENTICAL verification
+            # pipeline a poll runs (the descriptor itself is untrusted —
+            # passing it in only skips the redundant /serving/latest
+            # re-fetch, not one check).
+            self._last_poll_failed = False
+            try:
+                return self._poll(latest=descriptor)
+            except Exception as e:  # noqa: BLE001 — staleness, never adoption
+                self._last_poll_failed = True
+                metrics.inc("tpuft_serving_reader_poll_failures_total")
+                logger.warning(
+                    "subscriber push adoption failed (%s); keeping held version", e
+                )
+                return None
+        # Every endpoint refused the long-poll: fall back (backoff).
+        self._last_poll_failed = True
+        return None
+
+    def watch(
+        self,
+        stop: threading.Event,
+        on_version: Optional[Callable[[ServingVersion], None]] = None,
+    ) -> None:
+        """Reader loop until ``stop``: long-poll rounds when notify is
+        on (re-arming each bounded hold), deterministic-jittered polling
+        with exponential backoff on failures as the fallback — so a
+        reader population degrades from push to a spread herd, never to
+        a synchronized one."""
+        while not stop.is_set():
+            version = self.wait_for_update()
+            if version is not None:
+                self._pacer.reset()
+                if on_version is not None:
+                    on_version(version)
+                continue
+            if self._held_full_round:
+                continue  # the hold already paced this round
+            if stop.wait(self._pacer.next_delay(failed=self._last_poll_failed)):
+                return
 
     def _fetch_latest(self) -> Optional[Dict[str, Any]]:
         for _ in range(len(self._endpoints)):
             endpoint = self._endpoints[0]
             try:
-                return fetch_json(f"{endpoint}{LATEST_ROUTE}", self._timeout)
+                return fetch_json(
+                    f"{endpoint}{LATEST_ROUTE}", self._timeout, token=self._token
+                )
             except Exception:  # noqa: BLE001 — fail over to the next endpoint
                 # Rotate so a dead endpoint stops being everyone's first
                 # try; it heals back in naturally once others fail.
@@ -99,9 +222,13 @@ class WeightSubscriber:
                 metrics.inc("tpuft_serving_reader_failovers_total")
         return None
 
-    def _poll(self) -> Optional[ServingVersion]:
-        latest = self._fetch_latest()
+    def _poll(
+        self, latest: Optional[Dict[str, Any]] = None
+    ) -> Optional[ServingVersion]:
         if latest is None:
+            latest = self._fetch_latest()
+        if latest is None:
+            self._last_poll_failed = True
             metrics.inc("tpuft_serving_reader_poll_failures_total")
             return None
         reason = validate_latest(latest)
@@ -126,7 +253,9 @@ class WeightSubscriber:
         crcs: List[int] = [int(c) for c in latest["chunk_crcs"]]
         sizes: List[int] = [int(s) for s in latest["chunk_sizes"]]
         meta = safe_loads(
-            fetch_bytes(f"{base}/checkpoint/{step}/meta", self._timeout)
+            fetch_bytes(
+                f"{base}/checkpoint/{step}/meta", self._timeout, token=self._token
+            )
         )
         if (
             not isinstance(meta, dict)
@@ -148,7 +277,9 @@ class WeightSubscriber:
                 new_cache[i] = cached
                 saved += sizes[i]
                 continue
-            data = fetch_bytes(f"{base}/checkpoint/{step}/{i}", self._timeout)
+            data = fetch_bytes(
+                f"{base}/checkpoint/{step}/{i}", self._timeout, token=self._token
+            )
             if len(data) != sizes[i] or chunk_crc(data, algo) != crcs[i]:
                 metrics.inc("tpuft_serving_integrity_rejects_total")
                 raise ValueError(
@@ -175,6 +306,14 @@ class WeightSubscriber:
         self._chunk_cache = new_cache
         metrics.inc("tpuft_serving_reader_versions_total")
         metrics.inc("tpuft_serving_reader_bytes_total", fetched_bytes)
+        origin_ts = latest.get("origin_ts")
+        if origin_ts is not None:
+            # Publish-to-reader propagation (origin_ts is preserved
+            # across relay tiers; cross-host this is NTP-quality).
+            metrics.observe(
+                "tpuft_serving_propagation_seconds",
+                max(time.time() - float(origin_ts), 0.0),
+            )
         if saved:
             metrics.inc("tpuft_serving_delta_bytes_saved_total", saved)
         return version

@@ -33,6 +33,13 @@ from typing import Any, Optional, Tuple
 # (one_way_delay_s, seconds_per_byte); None = not yet resolved from env.
 _config: Optional[Tuple[float, float]] = None
 
+# Response header a netem-paced HTTP server sets on bodies it already
+# charged the emulated link for (pace_latency + PacingWriter). A paced
+# CLIENT fetch seam (serving/_wire.py) skips its response-leg charge when
+# it sees this, so a hop is never double-billed no matter which side of
+# it carries the shim.
+PACED_HEADER = "X-TPUFT-Link-Paced"
+
 
 def configure(rtt_ms: float = 0.0, gbps: float = 0.0) -> None:
     """Set the emulated link for this process; zeros disable."""
