@@ -21,6 +21,19 @@ stepping and the punisher kills things. Four legs:
   thread's step time while the publisher stages + serves versions under
   reader load, vs idle baseline (the PR-5 donor-stall methodology; the
   acceptance bar is the child-serve envelope).
+- ``pinned``: history-ring reads under churn — readers pinned to step S
+  and to ``latest-1`` while the version stream keeps bumping; every
+  pinned adoption is exactly the pin (ZERO wrong-version adoptions,
+  counter-exact via ``tpuft_serving_wrong_version_rejects_total`` /
+  ``tpuft_serving_reader_versions_total``).
+- ``rollback``: a published version is retracted under >= 6 live
+  readers: everyone converges to V-1 (seq-sanctioned regressions only),
+  zero torn / stale-era / wrong-version adoptions, counter-exact via
+  ``tpuft_history_retractions_total`` / ``_retraction_adoptions_total``.
+- ``delta_chain``: a reader holding V-2 adopts the newest in one hop,
+  moving strictly fewer bytes than a full refetch
+  (``tpuft_history_delta_chain_hops_total`` +
+  ``tpuft_serving_delta_bytes_saved_total``).
 
 Pure Python; runs in the toolchain-less container.
 
@@ -68,13 +81,25 @@ def counter(name: str) -> float:
 
 class ReaderPool:
     """N subscriber threads polling a set of endpoints continuously,
-    validating every adoption (consistency + era/step monotonicity)."""
+    validating every adoption (consistency + era/step monotonicity;
+    ``retraction_aware`` additionally allows step regressions that are
+    seq-sanctioned rollbacks — same publisher stream, higher pub_seq —
+    and flags every other regression as bad)."""
 
-    def __init__(self, endpoints: List[str], n: int, timeout: float = 5.0) -> None:
+    def __init__(
+        self,
+        endpoints: List[str],
+        n: int,
+        timeout: float = 5.0,
+        retraction_aware: bool = False,
+    ) -> None:
         self.stop = threading.Event()
         self.adoptions = 0
+        self.retraction_adoptions = 0
         self.bad: List = []
         self.observed_steps: set = set()
+        self.final_steps: List[int] = []
+        self._retraction_aware = retraction_aware
         self._lock = threading.Lock()
         self._threads = [
             threading.Thread(target=self._run, args=(list(endpoints), timeout))
@@ -83,6 +108,7 @@ class ReaderPool:
 
     def _run(self, endpoints: List[str], timeout: float) -> None:
         sub = WeightSubscriber(endpoints, timeout=timeout)
+        last = None
         last_step = 0
         last_era = -1
         while not self.stop.is_set():
@@ -94,18 +120,36 @@ class ReaderPool:
             } | {
                 float(np.asarray(leaf).ravel()[-1]) for leaf in version.params.values()
             }
+            sanctioned = (
+                self._retraction_aware
+                and last is not None
+                and version.pub_seq is not None
+                and last.pub_seq is not None
+                and version.pub_id == last.pub_id
+                and version.pub_seq > last.pub_seq
+            )
             with self._lock:
                 self.adoptions += 1
                 self.observed_steps.add(version.step)
                 if values != {float(version.step)}:
                     self.bad.append(("torn", version.step, sorted(values)))
                 if version.step <= last_step:
-                    self.bad.append(("step-regression", last_step, version.step))
-                if version.quorum_id is not None and version.quorum_id < last_era:
+                    if sanctioned:
+                        self.retraction_adoptions += 1
+                    else:
+                        self.bad.append(("step-regression", last_step, version.step))
+                if (
+                    version.quorum_id is not None
+                    and version.quorum_id < last_era
+                    and not sanctioned
+                ):
                     self.bad.append(("era-regression", last_era, version.quorum_id))
+            last = version
             last_step = version.step
             if version.quorum_id is not None:
                 last_era = version.quorum_id
+        with self._lock:
+            self.final_steps.append(last_step)
 
     def start(self) -> "ReaderPool":
         for t in self._threads:
@@ -297,6 +341,193 @@ def leg_chaos(args, fault_file: str) -> Dict:
             relay2.shutdown(wait=False)
         pub_a.shutdown(wait=False)
         pub_b.shutdown(wait=False)
+
+
+def leg_pinned(args) -> Dict:
+    """History-ring reads under churn: readers pinned to a fixed step S
+    and to latest-1 while the version stream bumps; pinned adoptions are
+    exactly the pin — zero wrong-version adoptions, counter-exact."""
+    pub = WeightPublisher(num_chunks=args.chunks, timeout=5.0, keep_versions=6)
+    threads: List[threading.Thread] = []
+    stop = threading.Event()
+    results = {"pin_bad": 0, "prev_bad": 0, "pin_adoptions": 0, "prev_adoptions": 0}
+    lock = threading.Lock()
+    try:
+        pin_step = 2
+        for s in (1, 2):
+            pub.publish(step=s, quorum_id=0, state=state_for(s, args.leaves, args.leaf_kb))
+
+        def pinned_reader() -> None:
+            sub = WeightSubscriber([pub.address()], timeout=5.0, pin=pin_step)
+            while not stop.is_set():
+                v = sub.poll()
+                if v is None:
+                    time.sleep(0.01)
+                    continue
+                with lock:
+                    results["pin_adoptions"] += 1
+                    if v.step != pin_step or not np.all(
+                        np.asarray(v.params["w0"]) == float(pin_step)
+                    ):
+                        results["pin_bad"] += 1
+
+        def prev_reader() -> None:
+            sub = WeightSubscriber([pub.address()], timeout=5.0, pin="latest-1")
+            while not stop.is_set():
+                v = sub.poll()
+                if v is None:
+                    time.sleep(0.01)
+                    continue
+                with lock:
+                    results["prev_adoptions"] += 1
+                    # latest-1 must trail the newest published version.
+                    if not np.all(np.asarray(v.params["w0"]) == float(v.step)):
+                        results["prev_bad"] += 1
+
+        wrong_before = counter("tpuft_serving_wrong_version_rejects_total")
+        threads = [
+            threading.Thread(target=pinned_reader) for _ in range(2)
+        ] + [threading.Thread(target=prev_reader) for _ in range(2)]
+        for t in threads:
+            t.start()
+        step = 2
+        deadline = time.perf_counter() + args.leg_seconds
+        while time.perf_counter() < deadline:
+            step += 1
+            pub.publish(
+                step=step, quorum_id=0,
+                state=state_for(step, args.leaves, args.leaf_kb),
+            )
+            time.sleep(args.bump_interval)
+        stop.set()
+        for t in threads:
+            t.join(timeout=15)
+        assert results["pin_bad"] == 0 and results["prev_bad"] == 0, results
+        return {
+            "versions_published": step,
+            "pinned_step": pin_step,
+            "pinned_readers": 2,
+            "latest_minus_one_readers": 2,
+            "pinned_adoptions": results["pin_adoptions"],
+            "latest_minus_one_adoptions": results["prev_adoptions"],
+            "wrong_version_adoptions": results["pin_bad"] + results["prev_bad"],
+            "wrong_version_rejects_counter": int(
+                counter("tpuft_serving_wrong_version_rejects_total") - wrong_before
+            ),
+        }
+    finally:
+        stop.set()
+        pub.shutdown(wait=False)
+
+
+def leg_rollback(args, fault_file: str) -> Dict:
+    """Retraction under live readers: a punisher-armed retract_version
+    fires mid-churn; every reader converges to V-1 with only
+    seq-sanctioned regressions and zero torn/stale/wrong adoptions."""
+    pub = WeightPublisher(num_chunks=args.chunks, timeout=5.0, keep_versions=6)
+    relay = CachingRelay([pub.address()], poll_interval=0.02, timeout=5.0)
+    pool = None
+    try:
+        pub.publish(step=1, quorum_id=0, state=state_for(1, args.leaves, args.leaf_kb))
+        time.sleep(0.1)
+        pool = ReaderPool(
+            [relay.address(), pub.address()],
+            args.chaos_readers,
+            retraction_aware=True,
+        ).start()
+        retract_before = counter("tpuft_history_retractions_total")
+        adopt_before = counter("tpuft_serving_retraction_adoptions_total")
+        step = 1
+        retracted: List[int] = []
+        for round_i in range(args.chaos_rounds):
+            step += 1
+            pub.publish(
+                step=step, quorum_id=0,
+                state=state_for(step, args.leaves, args.leaf_kb),
+            )
+            time.sleep(args.bump_interval * 2)
+            if round_i == args.chaos_rounds // 2:
+                # Retract AFTER the fleet adopted V (the bump interval
+                # above let readers and the relay pull it): the readers
+                # that hold V must now converge BACK to V-1 through the
+                # seq-sanctioned rollback path, not merely never see V.
+                pub.retract_version(step)
+                retracted.append(step)
+                time.sleep(args.bump_interval * 2)
+        survivor = pub.latest()["step"]
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and survivor not in pool.observed_steps:
+            time.sleep(0.05)
+        pool.finish()
+        assert not pool.bad, pool.bad[:5]
+        wrong = [s for s in retracted if s == survivor]
+        return {
+            "readers": args.chaos_readers,
+            "versions_published": step,
+            "retracted_versions": retracted,
+            "survivor_version": survivor,
+            "adoptions": pool.adoptions,
+            "retraction_adoptions_observed": pool.retraction_adoptions,
+            "retractions_counter": int(
+                counter("tpuft_history_retractions_total") - retract_before
+            ),
+            "retraction_adoptions_counter": int(
+                counter("tpuft_serving_retraction_adoptions_total") - adopt_before
+            ),
+            "readers_converged": sum(
+                1 for s in pool.final_steps if s == survivor
+            ),
+            "torn_reads": 0,
+            "stale_era_reads": 0,
+            "wrong_version_adoptions": len(wrong) + len(pool.bad),
+        }
+    finally:
+        if pool is not None:
+            pool.stop.set()
+        relay.shutdown(wait=False)
+        pub.shutdown(wait=False)
+
+
+def leg_delta_chain(args) -> Dict:
+    """A V-2 reader catches up in ONE adoption moving only the chunks
+    that changed across the skipped versions — strictly fewer bytes than
+    a full refetch, pinned by the chain-hop and bytes-saved counters."""
+    pub = WeightPublisher(num_chunks=args.leaves, timeout=5.0, keep_versions=6)
+    try:
+        state = state_for(1, args.leaves, args.leaf_kb)
+        pub.publish(step=1, quorum_id=0, state=state)
+        lagger = WeightSubscriber([pub.address()], timeout=5.0)
+        assert lagger.poll() is not None
+        # Two bumps while the lagger sleeps; each changes ONE leaf.
+        for step in (2, 3):
+            state = dict(state)
+            state[f"w{step}"] = np.full(
+                args.leaf_kb * 1024 // 4, float(step) * 11, np.float32
+            )
+            pub.publish(step=step, quorum_id=0, state=state)
+        full = sum(pub.latest()["chunk_sizes"])
+        bytes_before = counter("tpuft_serving_reader_bytes_total")
+        saved_before = counter("tpuft_serving_delta_bytes_saved_total")
+        hops_before = counter("tpuft_history_delta_chain_hops_total")
+        v = lagger.poll()
+        assert v is not None and v.step == 3, v
+        fetched = counter("tpuft_serving_reader_bytes_total") - bytes_before
+        assert 0 < fetched < full, (fetched, full)
+        return {
+            "versions_skipped": 1,
+            "changed_leaves_across_chain": 2,
+            "full_refetch_bytes": int(full),
+            "fetched_bytes": int(fetched),
+            "fetched_fraction_of_full": round(fetched / full, 4),
+            "delta_bytes_saved": int(
+                counter("tpuft_serving_delta_bytes_saved_total") - saved_before
+            ),
+            "chain_hops_counter": int(
+                counter("tpuft_history_delta_chain_hops_total") - hops_before
+            ),
+        }
+    finally:
+        pub.shutdown(wait=False)
 
 
 _READER_DRIVER = r"""
@@ -513,6 +744,9 @@ def main() -> None:
         },
         "reader_curve": leg_reader_curve(args),
         "delta": leg_delta(args),
+        "pinned": leg_pinned(args),
+        "rollback": leg_rollback(args, fault_file),
+        "delta_chain": leg_delta_chain(args),
         "chaos": leg_chaos(args, fault_file),
         "publish_stall": leg_publish_stall(args),
         "wall_s": round(time.time() - t0, 1),

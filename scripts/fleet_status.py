@@ -172,6 +172,31 @@ def _relay_state(snapshot: Dict[str, Any]) -> Optional[str]:
     )
 
 
+def _history_state(snapshot: Dict[str, Any]) -> Optional[str]:
+    """Versioned weight-history residency from the pushed gauges:
+    "<versions>v/<MB>MB" summed across the process's rings (manager
+    state ring + serving staged ring + relay ring), or None when no ring
+    ever promoted. A replica stuck at 1v under a deep commit pipeline is
+    the "deep-window donors will fail-clean-retry instead of serving
+    exactly" signal; a ballooning MB figure is the eviction budget's
+    (TPUFT_HISTORY_BYTES) to answer."""
+    entries = (
+        (snapshot.get("metrics") or {})
+        .get("gauges", {})
+        .get("tpuft_history_versions")
+    )
+    if not entries:
+        return None
+    versions = sum(int(e.get("value", 0)) for e in entries)
+    byte_entries = (
+        (snapshot.get("metrics") or {})
+        .get("gauges", {})
+        .get("tpuft_history_bytes")
+    ) or []
+    nbytes = sum(e.get("value", 0.0) for e in byte_entries)
+    return f"{versions}v/{nbytes / 1e6:.1f}MB"
+
+
 def _publish_state(snapshot: Dict[str, Any], now: float) -> Optional[str]:
     """Serving-plane publication state from the pushed gauges: the last
     published step and how stale it is ("s12@3s"), or None when the
@@ -238,6 +263,7 @@ def collect(lighthouse_addr: str, prev: Optional[Dict[str, Any]] = None) -> Dict
                     serve=_serve_state(snap),
                     shard=_shard_state(snap),
                     publish=_publish_state(snap, now),
+                    hist=_history_state(snap),
                     relay=_relay_state(snap),
                     push_age_s=round(now - snap["ts"], 1) if "ts" in snap else None,
                     last_commit_age_s=(
@@ -280,6 +306,7 @@ _COLUMNS = (
     ("serve", "SERVE"),
     ("shard", "SHARD"),
     ("publish", "PUBLISH"),
+    ("hist", "HIST"),
     ("relay", "RELAY"),
     ("lag_s", "LAG"),
     ("last_commit_age_s", "LAST COMMIT"),

@@ -635,6 +635,32 @@ def explain_step(merged: List[Dict[str, Any]], step: int) -> str:
             f"publish RETRACTED: {proc_label(proc_key(e))} dropped its due "
             "version at the rollback-unwind — readers never observed it"
         )
+    # Versioned weight history: exact deep-window donor serves and
+    # published-version retractions (fleet rollback) at this step.
+    for e in at_step:
+        if e["name"] != "history_exact_serve":
+            continue
+        args = e.get("args") or {}
+        lines.append(
+            f"history: {proc_label(proc_key(e))} served step {e.get('step')} "
+            f"EXACTLY from its committed ring (live window had drained to "
+            f"step {args.get('drained_step', '?')}) — the joiner healed this "
+            "round instead of retrying"
+        )
+    for e in at_step:
+        if e["name"] != "version_retracted":
+            continue
+        args = e.get("args") or {}
+        survivor = args.get("survivor")
+        tail = (
+            f"; readers converge to step {survivor}"
+            if survivor is not None
+            else ""
+        )
+        lines.append(
+            f"version RETRACTED: {proc_label(proc_key(e))} withdrew published "
+            f"step {e.get('step')} from the history ring{tail}"
+        )
     fails = [e for e in at_step if e["name"] == "heal_attempt_failed"]
     for e in fails:
         args = e.get("args") or {}

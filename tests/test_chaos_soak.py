@@ -1,8 +1,11 @@
 """Chaos soak: a real multi-process 2-group job under the full fault menu
 (exit / segfault / deadlock / partition + the heal-plane modes
-kill_donor_mid_heal / corrupt_stream / stall_donor), driven by the
-punisher against a live lighthouse — the CI promotion of the reference's
-slurm/monarch chaos drives (punisher.py + failure.py:25-100).
+kill_donor_mid_heal / corrupt_stream / stall_donor + the serving-plane
+rollback storm retract_version — each group publishes every commit, so
+the arm is consumed by a real publication and the retraction/history
+path runs under the same chaos), driven by the punisher against a live
+lighthouse — the CI promotion of the reference's slurm/monarch chaos
+drives (punisher.py + failure.py:25-100).
 
 ON by default (a soak that never runs automatically is a soak that rots —
 round-2 verdict weak #5): every full-suite run pays the ~2 minutes.
@@ -71,6 +74,17 @@ def init_params():
     }
 
 opt = Optimizer(manager, optax.sgd(0.05, momentum=0.9), init_params())
+
+# Serving plane under chaos: every commit publishes, so the punisher's
+# rollback-storm arm (retract_version at site publisher_retract) is
+# actually consumable mid-soak — publication staging, retraction, and
+# history eviction all run under the full fault menu. Serving must
+# never wound training: the master bitwise-identity invariant below is
+# also the proof that a mid-soak retraction never touched committed
+# state.
+from torchft_tpu.serving import WeightPublisher
+publisher = WeightPublisher(every=1, num_chunks=2, timeout=5.0)
+manager.attach_publisher(publisher, lambda: {"params": opt.params})
 
 def grad_for(step):
     key = jax.random.PRNGKey(1000 + step)

@@ -631,11 +631,86 @@ def test_pipelined_depth2_quorum_change_drains_full_window():
     assert opt.flush_pipeline() is True
 
 
-def test_pipelined_depth2_donor_send_drains_and_stages_drained_step():
+def test_pipelined_depth2_donor_send_drains_and_serves_exact_max_step():
     """A donor send with no quorum-id change (a repeated heal round) must
-    still drain the window first and stage the DRAINED committed step —
-    never speculative state, never committed bytes mislabeled with the
-    quorum's stale max_step."""
+    still drain the window first and — now that resolved window slots
+    promote into the manager's history ring — serve the joiner EXACTLY
+    the step it asked for (``quorum.max_step``), even though the drain
+    advanced this donor's live committed step past it. The pre-history
+    behavior (stage the drained step; the joiner fails cleanly and
+    retries next round) remains only as the ring-miss fallback, covered
+    by the test below."""
+    import numpy as np
+
+    from torchft_tpu import metrics as ft_metrics
+
+    manager = scripted_manager(commit_pipeline_depth=2)
+    transport = manager._checkpoint_transport
+    # The exact-serve path requires EVERY registered state key to be
+    # promoted by its owner at commit resolution; the test fixture's
+    # static "model" key has no owner, so drop it (a real training job
+    # registers owner-promoted state — the Optimizer here). The ring
+    # refusing to serve when an unpromoted key is registered is itself
+    # the conservative contract (covered by the miss test below).
+    manager._user_state_dicts.pop("model", None)
+    manager._load_state_dict_fns.pop("model", None)
+    tx = optax.sgd(0.1)
+    opt = Optimizer(manager, tx, {"w": jnp.array([1.0, 1.0], jnp.float32)})
+    seen = []
+
+    def spy_send(dst_ranks, step, state_dict, timeout, quorum_id=None):
+        seen.append(
+            (step, opt.pending_commits(), manager.current_step(), state_dict)
+        )
+
+    transport.send_checkpoint.side_effect = spy_send
+    step_fn = opt.make_step_fn(lambda p, b: jnp.sum(p["w"] * b))
+    step_fn(jnp.array([1.0, 2.0], jnp.float32))
+    step_fn(jnp.array([1.0, 2.0], jnp.float32))
+    assert opt.pending_commits() == 2
+    exact_before = ft_metrics.counter_total("tpuft_history_exact_serves_total")
+    # Same quorum id, but a joiner was assigned to heal from us; the
+    # lighthouse computed max_step=1 from pre-drain reports — the drain
+    # below resolves the full window, advancing this donor to step 2.
+    manager._client._quorum.return_value = make_quorum(
+        quorum_id=1, replica_world_size=1, max_world_size=1,
+        recover_dst_replica_ranks=[1], max_step=1,
+    )
+    step_fn(jnp.array([1.0, 2.0], jnp.float32))
+    assert len(seen) == 1
+    staged_step, pending, committed, state_dict = seen[0]
+    assert pending - sum(
+        1 for r in (opt._pipeline.pending() if opt._pipeline else ())
+        if r.committed is not None
+    ) == 0  # window fully resolved before the send
+    # The immediate-serve path: the joiner's requested step, exactly,
+    # while the donor's live state had drained past it.
+    assert staged_step == 1
+    assert committed >= 2
+    # The staged bytes ARE committed step 1: w0 - 0.1 * [1, 2].
+    np.testing.assert_allclose(
+        np.asarray(state_dict["user"]["optimizer"]["params"]["w"]),
+        np.array([0.9, 0.8], np.float32),
+        rtol=1e-6,
+    )
+    assert state_dict["tpuft"]["step"] == 1
+    assert (
+        ft_metrics.counter_total("tpuft_history_exact_serves_total")
+        - exact_before
+        == 1
+    )
+    assert opt.flush_pipeline() is True
+
+
+def test_pipelined_donor_send_history_miss_falls_back_to_drained_step(
+    monkeypatch,
+):
+    """The ring-miss fallback (history evicted down to the live step):
+    the donor stages its DRAINED committed step honestly labeled — never
+    speculative state, never committed bytes mislabeled with the
+    quorum's stale max_step — and the joiner fails that round cleanly,
+    exactly the pre-history envelope."""
+    monkeypatch.setenv("TPUFT_HISTORY_MAX_VERSIONS", "1")
     manager = scripted_manager(commit_pipeline_depth=2)
     transport = manager._checkpoint_transport
     tx = optax.sgd(0.1)
@@ -643,27 +718,22 @@ def test_pipelined_depth2_donor_send_drains_and_stages_drained_step():
     seen = []
 
     def spy_send(dst_ranks, step, state_dict, timeout, quorum_id=None):
-        seen.append((step, opt.pending_commits(), manager.current_step()))
+        seen.append((step, manager.current_step()))
 
     transport.send_checkpoint.side_effect = spy_send
     step_fn = opt.make_step_fn(lambda p, b: jnp.sum(p["w"] * b))
     step_fn(jnp.array([1.0, 2.0], jnp.float32))
     step_fn(jnp.array([1.0, 2.0], jnp.float32))
-    assert opt.pending_commits() == 2
-    # Same quorum id, but a joiner was assigned to heal from us; the
-    # lighthouse computed max_step from pre-drain reports (0 here).
     manager._client._quorum.return_value = make_quorum(
         quorum_id=1, replica_world_size=1, max_world_size=1,
-        recover_dst_replica_ranks=[1], max_step=0,
+        recover_dst_replica_ranks=[1], max_step=1,
     )
     step_fn(jnp.array([1.0, 2.0], jnp.float32))
     assert len(seen) == 1
-    staged_step, pending, committed = seen[0]
-    assert pending - sum(
-        1 for r in (opt._pipeline.pending() if opt._pipeline else ())
-        if r.committed is not None
-    ) == 0  # window fully resolved before the send
-    assert staged_step == committed == 2  # the drained step, honestly labeled
+    staged_step, committed = seen[0]
+    # K=1 keeps only the newest committed version: max_step=1 is gone,
+    # so the drained step is staged under its true label.
+    assert staged_step == committed == 2
     assert opt.flush_pipeline() is True
 
 

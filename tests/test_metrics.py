@@ -528,6 +528,24 @@ def test_fleet_status_render_and_extractors() -> None:
     assert fleet_status._shard_state({"metrics": {"gauges": {}}}) is None
     # Storm gauge feeding the JOINERS column.
     assert fleet_status._gauge(snap, "tpuft_heal_storm_joiners") == 2.0
+    # History rings feeding the HIST column: versions + bytes summed
+    # across this process's rings (state + staged + relay).
+    hist_snap = {
+        "metrics": {
+            "gauges": {
+                "tpuft_history_versions": [
+                    {"labels": {"ring": "state"}, "value": 3.0},
+                    {"labels": {"ring": "staged"}, "value": 2.0},
+                ],
+                "tpuft_history_bytes": [
+                    {"labels": {"ring": "state"}, "value": 8_000_000.0},
+                    {"labels": {"ring": "staged"}, "value": 4_500_000.0},
+                ],
+            }
+        }
+    }
+    assert fleet_status._history_state(hist_snap) == "5v/12.5MB"
+    assert fleet_status._history_state({"metrics": {"gauges": {}}}) is None
 
     table = {
         "ts": 100.0,
@@ -556,7 +574,7 @@ def test_fleet_status_render_and_extractors() -> None:
     assert "quorum_id=3" in lines[0] and "replicas=2" in lines[0]
     assert lines[1].split() == [
         "REPLICA", "RANK", "STEP", "STEP/S", "COMMITS", "FAILED", "HEALS",
-        "SERVE", "SHARD", "PUBLISH", "RELAY", "LAG", "LAST", "COMMIT",
+        "SERVE", "SHARD", "PUBLISH", "HIST", "RELAY", "LAG", "LAST", "COMMIT",
         "HEALING", "JOINERS", "HB", "AGE", "MS", "PUSH", "AGE",
     ]
     assert "train_0:uuid" in text and "1.25" in text and "1.0s" in text

@@ -851,3 +851,392 @@ def test_checkpoint_digest_matches_descriptor() -> None:
         )
     finally:
         pub.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# versioned history: pinned reads, latest-1, retraction, delta chains
+# ---------------------------------------------------------------------------
+
+
+def test_pinned_version_reader_exact_and_wrong_version_refused() -> None:
+    """pin=<step> follows exactly that resident version; any other step
+    offered under the route is refused (wrong-version counter), so a
+    canary reader structurally cannot drift."""
+    pub = WeightPublisher(num_chunks=4, timeout=5.0)
+    try:
+        for s in (1, 2, 3):
+            pub.publish(step=s, quorum_id=0, state=state_for(s))
+        sub = WeightSubscriber([pub.address()], timeout=5.0, pin=2)
+        assert_version_is(sub.poll(), 2)
+        # Later bumps do not move a pinned reader.
+        pub.publish(step=4, quorum_id=0, state=state_for(4))
+        assert sub.poll() is None
+        assert sub.current().step == 2
+        # A descriptor for another step is refused outright.
+        before = counters_history()
+        other = pub.latest()
+        assert sub._poll(latest=other) is None
+        after = counters_history()
+        assert (
+            after["wrong_version"] - before["wrong_version"] == 1
+        )
+    finally:
+        pub.shutdown()
+
+
+def test_latest_minus_one_reader_trails_by_one() -> None:
+    pub = WeightPublisher(num_chunks=4, timeout=5.0)
+    try:
+        pub.publish(step=1, quorum_id=0, state=state_for(1))
+        sub = WeightSubscriber([pub.address()], timeout=5.0, pin="latest-1")
+        assert sub.poll() is None  # only one resident version: no latest-1
+        pub.publish(step=2, quorum_id=0, state=state_for(2))
+        assert_version_is(sub.poll(), 1)
+        pub.publish(step=3, quorum_id=0, state=state_for(3))
+        assert_version_is(sub.poll(), 2)
+    finally:
+        pub.shutdown()
+
+
+def counters_history() -> dict:
+    names = {
+        "retractions": "tpuft_history_retractions_total",
+        "retracted_reads": "tpuft_history_retracted_reads_total",
+        "retraction_adoptions": "tpuft_serving_retraction_adoptions_total",
+        "wrong_version": "tpuft_serving_wrong_version_rejects_total",
+        "meta_skips": "tpuft_serving_meta_fetches_skipped_total",
+        "chain_hops": "tpuft_history_delta_chain_hops_total",
+        "delta_bytes": "tpuft_serving_delta_bytes_saved_total",
+    }
+    return {k: metrics.counter_total(n) for k, n in names.items()}
+
+
+def test_retract_version_converges_readers_and_relay_to_previous() -> None:
+    """retract_version(V): the publisher drops V everywhere (descriptors,
+    chunks), re-announces V-1 seq-newer, and BOTH a direct reader and a
+    relay-backed reader converge to V-1; pinned-V readers get the 410
+    tombstone, never retracted bytes."""
+    pub = WeightPublisher(num_chunks=4, timeout=5.0)
+    relay = CachingRelay([pub.address()], timeout=5.0, start=False)
+    try:
+        for s in (1, 2, 3):
+            pub.publish(step=s, quorum_id=0, state=state_for(s))
+        relay.poll_once()
+        direct = WeightSubscriber([pub.address()], timeout=5.0)
+        via_relay = WeightSubscriber([relay.address()], timeout=5.0)
+        assert_version_is(direct.poll(), 3)
+        assert_version_is(via_relay.poll(), 3)
+        pinned = WeightSubscriber([pub.address()], timeout=5.0, pin=3)
+        assert_version_is(pinned.poll(), 3)
+
+        before = counters_history()
+        assert pub.retract_version(3)
+        # Direct reader converges immediately (seq-newer V-1).
+        v = direct.poll()
+        assert_version_is(v, 2)
+        # The relay adopts the retraction and fans V-1 out.
+        assert relay.poll_once() is True
+        assert relay.current().step == 2
+        assert_version_is(via_relay.poll(), 2)
+        # The pinned-3 reader is told the version is GONE (410), never
+        # served stale bytes and never silently failed over.
+        assert pinned.poll() is None
+        assert pinned.pin_retracted
+        after = counters_history()
+        assert after["retractions"] - before["retractions"] == 1
+        assert after["retraction_adoptions"] - before["retraction_adoptions"] >= 2
+        assert after["retracted_reads"] - before["retracted_reads"] >= 1
+        # Forward recovery: the next publish moves everyone ahead again.
+        pub.publish(step=4, quorum_id=0, state=state_for(4))
+        assert_version_is(direct.poll(), 4)
+        assert relay.poll_once() is True
+        assert_version_is(via_relay.poll(), 4)
+    finally:
+        relay.shutdown()
+        pub.shutdown()
+
+
+def test_punisher_retract_version_armed_via_fault_file(
+    tmp_path, monkeypatch
+) -> None:
+    """The punisher's retract_version arm: the NEXT publish consumes it
+    and immediately retracts the just-published version — readers only
+    ever converge to V-1 ("canary shipped and was found bad")."""
+    fault_file = tmp_path / "fault"
+    monkeypatch.setenv(faultinject.ENV_FAULT_FILE, str(fault_file))
+    pub = WeightPublisher(num_chunks=2, timeout=5.0)
+    try:
+        pub.publish(step=1, quorum_id=0, state=state_for(1))
+        sub = WeightSubscriber([pub.address()], timeout=5.0)
+        assert_version_is(sub.poll(), 1)
+        assert punisher.arm_stream_fault("retract_version", str(fault_file))
+        before = counters_history()
+        pub.publish(step=2, quorum_id=0, state=state_for(2))
+        after = counters_history()
+        assert after["retractions"] - before["retractions"] == 1
+        assert pub.latest()["step"] == 1
+        assert pub.is_retracted(2)
+        # The reader never adopts the retracted canary.
+        v = sub.poll()
+        assert v is None or v.step == 1
+        assert sub.current().step == 1
+    finally:
+        pub.shutdown()
+
+
+@pytest.mark.parametrize("depth", [0, 2], ids=["strict", "pipelined2"])
+def test_rollback_storm_drill(depth, tmp_path, monkeypatch) -> None:
+    """The rollback-storm chaos drill in strict AND pipelined orderings:
+    a training manager publishes every commit while >= 2 readers poll; a
+    punisher-armed retract_version fires mid-run. Every reader must end
+    on the surviving version with zero torn / stale-era / wrong-version
+    adoptions, and the only step regressions any reader observes are
+    seq-sanctioned retractions."""
+    fault_file = tmp_path / "fault"
+    monkeypatch.setenv(faultinject.ENV_FAULT_FILE, str(fault_file))
+    manager = scripted_manager(commit_pipeline_depth=depth)
+    pub = WeightPublisher(every=1, num_chunks=2, timeout=5.0)
+    opt = Optimizer(manager, optax.sgd(0.1), {"w": jnp.array([1.0, 1.0], jnp.float32)})
+    manager.attach_publisher(pub, lambda: {"params": opt.params})
+
+    stop = threading.Event()
+    bad: list = []
+    readers_state: list = []
+
+    def reader(slot: int) -> None:
+        sub = WeightSubscriber([pub.address()], timeout=5.0)
+        last = None
+        while not stop.is_set():
+            version = sub.poll()
+            if version is None:
+                time.sleep(0.005)
+                continue
+            values = {
+                float(np.asarray(leaf).ravel()[0])
+                for leaf in version.params["params"].values()
+            }
+            if last is not None:
+                if version.step <= last.step:
+                    # Only a seq-sanctioned retraction may regress.
+                    sanctioned = (
+                        version.pub_seq is not None
+                        and last.pub_seq is not None
+                        and version.pub_id == last.pub_id
+                        and version.pub_seq > last.pub_seq
+                    )
+                    if not sanctioned:
+                        bad.append(("unsanctioned regression", last.step, version.step))
+                if (
+                    version.quorum_id is not None
+                    and last.quorum_id is not None
+                    and version.quorum_id < last.quorum_id
+                    and version.step > last.step
+                ):
+                    bad.append(("era regression", last.quorum_id, version.quorum_id))
+            last = version
+            readers_state.append((slot, version.step))
+
+    threads = [threading.Thread(target=reader, args=(i,)) for i in range(3)]
+    for t in threads:
+        t.start()
+    try:
+        step_fn = opt.make_step_fn(_loss_fn)
+        retract_before = counters_history()["retractions"]
+        for i in range(6):
+            if i == 3:
+                punisher.arm_stream_fault("retract_version", str(fault_file))
+            step_fn(jnp.full((2,), float(i), jnp.float32))
+        opt.flush_pipeline()
+        manager.start_quorum()
+        manager.wait_quorum()
+        assert counters_history()["retractions"] - retract_before >= 1
+        survivor = pub.latest()["step"]
+        retracted = [s for s in range(1, 7) if pub.is_retracted(s)]
+        assert retracted, "the armed retraction never fired"
+        # Every reader converges to the surviving latest version.
+        deadline = time.monotonic() + 10.0
+        converged = set()
+        while time.monotonic() < deadline and len(converged) < 3:
+            converged = {
+                slot for slot, step in readers_state if step == survivor
+            }
+            time.sleep(0.05)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        assert not bad, bad[:5]
+        assert len(converged) == 3, (converged, survivor, readers_state[-10:])
+        # Zero wrong-version adoptions: nothing retracted is held.
+        assert survivor not in retracted
+    finally:
+        stop.set()
+        manager.shutdown(wait=False)
+        pub.shutdown(wait=False)
+
+
+def test_lying_notify_body_cannot_cause_bad_adoption() -> None:
+    """The delta-aware notify body is ADVISORY: a forged descriptor with
+    tampered CRCs fails digest binding; a forged changed-chunk set on a
+    valid descriptor cannot corrupt the adoption — the reader's own
+    (crc, size) comparison decides what to fetch and every chunk still
+    verifies."""
+    pub = WeightPublisher(num_chunks=4, timeout=5.0)
+    try:
+        pub.publish(step=1, quorum_id=0, state=state_for(1))
+        sub = WeightSubscriber([pub.address()], timeout=5.0)
+        assert_version_is(sub.poll(), 1)
+        state2 = state_for(1)
+        state2["w2"] = np.full(512, 2.0, np.float32)
+        descriptor = pub.publish(step=2, quorum_id=0, state=state2)
+        # Forged body 1: tampered CRC — rejected before any transfer.
+        forged = dict(descriptor)
+        forged["chunk_crcs"] = list(forged["chunk_crcs"])
+        forged["chunk_crcs"][0] ^= 1
+        before = counters()
+        assert sub._poll(latest=forged) is None
+        assert counters()["integrity"] - before["integrity"] == 1
+        # Forged body 2: a lying changed-chunk hint on a VALID descriptor
+        # (claims nothing changed). Adoption still lands the correct
+        # bytes: the hint cannot override the reader's own crc diff.
+        lying = dict(descriptor)
+        lying["delta_base_step"] = 1
+        lying["changed_chunks"] = []
+        v = sub._poll(latest=lying)
+        assert v is not None and v.step == 2
+        np.testing.assert_array_equal(np.asarray(v.params["w2"]), 2.0)
+        np.testing.assert_array_equal(np.asarray(v.params["w1"]), 1.0)
+    finally:
+        pub.shutdown()
+
+
+def test_meta_skip_on_sparse_bumps_and_notify_delta_hint() -> None:
+    """Sparse version bumps skip the /meta RTT (tree_token cache) and a
+    long-poll wake carries the changed-chunk set computed from the
+    server's history ring."""
+    pub = WeightPublisher(num_chunks=4, timeout=5.0)
+    try:
+        state = state_for(1)
+        pub.publish(step=1, quorum_id=0, state=state)
+        sub = WeightSubscriber([pub.address()], timeout=5.0)
+        assert_version_is(sub.poll(), 1)
+        before = counters_history()
+        state2 = dict(state)
+        state2["w1"] = np.full(512, 2.0, np.float32)
+        pub.publish(step=2, quorum_id=0, state=state2)
+        v = sub.wait_for_update(hold=5.0)
+        assert v is not None and v.step == 2
+        after = counters_history()
+        assert after["meta_skips"] - before["meta_skips"] == 1
+        # The notify body itself carries the changed-chunk set vs the
+        # reader's watermark (advisory; verified by the lying-body test).
+        from torchft_tpu.serving._wire import fetch_notify
+
+        body = fetch_notify(pub.address(), 1, 5.0, hold=0.2)
+        assert body is not None and body["step"] == 2
+        assert body.get("delta_base_step") == 1
+        assert body.get("changed_chunks") == [1]
+    finally:
+        pub.shutdown()
+
+
+def test_delta_chain_lagging_reader_moves_only_changed_bytes() -> None:
+    """A reader that SKIPPED a published version (held V-2) adopts the
+    newest moving strictly fewer bytes than a full refetch — the
+    chunk-level (crc, size) match composes across the ring, counted by
+    the delta-chain hop counter."""
+    pub = WeightPublisher(num_chunks=8, timeout=5.0)
+    try:
+        state = {f"w{i}": np.full(512, 1.0, np.float32) for i in range(8)}
+        pub.publish(step=1, quorum_id=0, state=state)
+        lagger = WeightSubscriber([pub.address()], timeout=5.0)
+        assert lagger.poll().step == 1
+        # Two bumps while the lagger sleeps; each changes ONE leaf.
+        state2 = dict(state)
+        state2["w2"] = np.full(512, 22.0, np.float32)
+        pub.publish(step=2, quorum_id=0, state=state2)
+        state3 = dict(state2)
+        state3["w5"] = np.full(512, 35.0, np.float32)
+        pub.publish(step=3, quorum_id=0, state=state3)
+        before = counters_history()
+        reader_before = counters()["reader_bytes"]
+        v = lagger.poll()  # V-2 -> V in ONE adoption
+        assert v is not None and v.step == 3
+        np.testing.assert_array_equal(np.asarray(v.params["w2"]), 22.0)
+        np.testing.assert_array_equal(np.asarray(v.params["w5"]), 35.0)
+        after = counters_history()
+        fetched = counters()["reader_bytes"] - reader_before
+        full = sum(pub.latest()["chunk_sizes"])
+        # Only the two changed chunks moved: strictly fewer bytes than a
+        # full refetch, pinned by the counters.
+        assert 0 < fetched < full / 2
+        assert after["delta_bytes"] - before["delta_bytes"] > 0
+        assert after["chain_hops"] - before["chain_hops"] == 2
+    finally:
+        pub.shutdown()
+
+
+def test_child_mode_staged_history_serves_pinned_versions() -> None:
+    """Child serve mode: the resident history versions live as the serve
+    child's /dev/shm epoch dirs — a pinned reader fetches an OLDER
+    version's chunks from the sidecar, and retraction removes the epoch
+    (the version 410s instead of serving deleted bytes)."""
+    from torchft_tpu.checkpointing.http_transport import HTTPTransport
+
+    transport = HTTPTransport(
+        timeout=5.0, num_chunks=2, serve_mode="child", keep_versions=4
+    )
+    if not transport._child_serving():
+        transport.shutdown(wait=False)
+        pytest.skip("serve child unavailable on this box")
+    pub = WeightPublisher(timeout=5.0, transport=transport)
+    try:
+        for s in (1, 2, 3):
+            pub.publish(step=s, quorum_id=0, state=state_for(s))
+        pinned = WeightSubscriber([pub.address()], timeout=5.0, pin=1)
+        assert_version_is(pinned.poll(), 1)
+        latest = WeightSubscriber([pub.address()], timeout=5.0)
+        assert_version_is(latest.poll(), 3)
+        # Retract the newest: readers converge to 2, the pinned-3 route
+        # answers 410 and the child's epoch for 3 is gone.
+        pub.retract_version(3)
+        assert_version_is(latest.poll(), 2)
+        pinned3 = WeightSubscriber([pub.address()], timeout=5.0, pin=3)
+        assert pinned3.poll() is None
+        assert pinned3.pin_retracted
+    finally:
+        pub.shutdown(wait=False)
+        transport.shutdown(wait=False)
+
+
+def test_fleet_trace_explain_prints_history_and_retraction_lines() -> None:
+    import importlib.util
+    from pathlib import Path
+
+    spec = importlib.util.spec_from_file_location(
+        "fleet_trace",
+        Path(__file__).resolve().parent.parent / "scripts" / "fleet_trace.py",
+    )
+    fleet_trace = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(fleet_trace)
+
+    def event(seq, name, **kw):
+        base = {
+            "seq": seq, "name": name, "ph": "i", "cat": "ft",
+            "t_wall": 100.0 + seq, "t_mono": float(seq),
+            "replica_id": "train_0", "group_rank": 0,
+            "step": 7, "quorum_id": 2, "args": {},
+        }
+        base.update(kw)
+        return base
+
+    merged = fleet_trace.merge_events(
+        [
+            event(1, "history_exact_serve", args={"drained_step": 9}),
+            event(2, "version_retracted", args={"survivor": 6}),
+        ]
+    )
+    text = fleet_trace.explain_step(merged, 7)
+    assert "served step 7 EXACTLY from its committed ring" in text
+    assert "drained to step 9" in text
+    assert "version RETRACTED" in text
+    assert "readers converge to step 6" in text

@@ -111,6 +111,7 @@ from torchft_tpu.checkpointing.transport import (
     HEAL_PART_PREFIX,
     CheckpointTransport,
 )
+from torchft_tpu.history import StagedVersionStore
 
 __all__ = [
     "HTTPTransport",
@@ -446,6 +447,7 @@ class _Staged:
             _serialization.write_prepared(chunk, w)
             self.chunk_crcs.append(w.crc)
         self.digest = _checkpoint_digest(step, self.crc_algo, self.chunk_crcs)
+        self.tree_token = _tree_token(treedef)
 
     def meta_bytes(self) -> bytes:
         return _meta_bytes(
@@ -495,6 +497,19 @@ def _meta_bytes(
     )
 
 
+def _tree_token(treedef: Any) -> Optional[str]:
+    """Content token of a pytree STRUCTURE (sha256 of the pickled
+    treedef): two stages with equal tokens flatten identically, so a
+    serving reader that cached the treedef under this token can skip the
+    ``/meta`` fetch on version bumps that only changed leaf bytes — one
+    less RTT per hop. Purely an optimization key: a reader that cannot
+    (or will not) match tokens fetches ``/meta`` exactly as before."""
+    try:
+        return hashlib.sha256(pickle.dumps(treedef)).hexdigest()
+    except Exception:  # noqa: BLE001 — token absence just costs the /meta RTT
+        return None
+
+
 def _stage_manifest(
     step: int,
     quorum_id: Optional[int],
@@ -502,6 +517,7 @@ def _stage_manifest(
     chunk_crcs: List[int],
     chunk_sizes: List[int],
     digest: str,
+    tree_token: Optional[str] = None,
 ) -> Dict[str, Any]:
     """JSON-safe summary of one staged checkpoint (no treedef — readers
     that need it fetch the pickled ``/meta``). ``send_checkpoint`` returns
@@ -515,6 +531,7 @@ def _stage_manifest(
         "chunk_sizes": [int(s) for s in chunk_sizes],
         "num_chunks": len(chunk_crcs),
         "digest": digest,
+        "tree_token": tree_token,
     }
 
 
@@ -627,9 +644,23 @@ class HTTPTransport(CheckpointTransport[Any]):
         timeout: float = 60.0,
         num_chunks: int = 0,
         serve_mode: Optional[str] = None,
+        keep_versions: int = 1,
     ) -> None:
         self._timeout = timeout
         self._num_chunks = num_chunks
+        # Versioned staged history (torchft_tpu/history.py): with
+        # keep_versions > 1 the last K staged checkpoints stay servable
+        # (the serving plane's pinned-version / rollback reads), budgeted
+        # by TPUFT_HISTORY_BYTES / TPUFT_HISTORY_MAX_VERSIONS. The
+        # default (1) is the pre-history single-stage donor behavior. In
+        # child serve mode the resident versions are the child's /dev/shm
+        # epoch dirs; this store then mirrors manifests for bookkeeping.
+        self._keep_versions = max(1, int(keep_versions))
+        self._staged_store: Optional[StagedVersionStore] = (
+            StagedVersionStore(max_versions=self._keep_versions)
+            if self._keep_versions > 1
+            else None
+        )
         # Fairness identity this JOINER sends on its fetch URLs (?peer=):
         # per transport instance, so every joiner of a storm — one per
         # process in production, many per process in threads-as-replicas
@@ -720,13 +751,18 @@ class HTTPTransport(CheckpointTransport[Any]):
                     # Park only for a step that may still arrive: staged
                     # steps are monotone, so a request for an OLDER step
                     # than the current stage can never be satisfied —
-                    # answer immediately instead of holding the reader
-                    # (or a stale joiner) for the full timeout. A reader
-                    # racing a serving-plane version bump refetches the
-                    # new descriptor on its next poll.
+                    # it either lives in the staged-version history ring
+                    # (answered below) or 404s immediately instead of
+                    # holding the reader (or a stale joiner) for the
+                    # full timeout. A reader racing a serving-plane
+                    # version bump refetches the new descriptor on its
+                    # next poll.
                     transport._cond.wait_for(
-                        lambda: transport._staged is not None
-                        and transport._staged.step >= step,
+                        lambda: (
+                            transport._staged is not None
+                            and transport._staged.step >= step
+                        )
+                        or transport._staged_version(step) is not None,
                         timeout=transport._timeout,
                     )
                     staged = transport._staged
@@ -737,12 +773,22 @@ class HTTPTransport(CheckpointTransport[Any]):
                     time.perf_counter() - stall_t0,
                 )
                 if staged is None or staged.step != step:
-                    self.send_error(
-                        404,
-                        f"no checkpoint staged for step {step}"
-                        + (f" (have {staged.step})" if staged else ""),
-                    )
-                    return
+                    historical = transport._staged_version(step)
+                    if historical is not None:
+                        staged = historical
+                    elif transport._staged_retracted(step):
+                        metrics.inc("tpuft_history_retracted_reads_total")
+                        self.send_error(
+                            410, f"version {step} was retracted"
+                        )
+                        return
+                    else:
+                        self.send_error(
+                            404,
+                            f"no checkpoint staged for step {step}"
+                            + (f" (have {staged.step})" if staged else ""),
+                        )
+                        return
                 # Era fence: a joiner tags its chunk fetches with the quorum
                 # era it is healing in; serving a different staged era would
                 # hand it bytes its /meta checksums do not describe (the
@@ -912,6 +958,44 @@ class HTTPTransport(CheckpointTransport[Any]):
             f"heal_stream:{self._server.server_address[1]}"
         )
 
+    # -- staged-version history (torchft_tpu/history.py) -------------------
+
+    def _staged_version(self, step: int) -> Optional[_Staged]:
+        """A resident HISTORICAL staged checkpoint for ``step`` (inline
+        payloads only — in child mode the chunk bytes live in the child's
+        /dev/shm ring and this process's store holds manifests)."""
+        store = self._staged_store
+        if store is None:
+            return None
+        payload = store.get(step)
+        return payload if isinstance(payload, _Staged) else None
+
+    def _staged_retracted(self, step: int) -> bool:
+        store = self._staged_store
+        return store is not None and store.is_retracted(step)
+
+    def staged_steps(self) -> List[int]:
+        """Resident staged versions, oldest first (the serving plane's
+        pinned-version inventory)."""
+        store = self._staged_store
+        if store is not None:
+            return store.steps()
+        with self._cond:
+            return [self._staged.step] if self._staged is not None else []
+
+    def drop_staged(self, step: int, retracted: bool = True) -> None:
+        """Retraction: removes one resident staged version (inline ring
+        AND the child's /dev/shm ring) so it can never be served again;
+        later reads answer 410 instead of 404."""
+        store = self._staged_store
+        if store is not None:
+            store.drop(step, retracted=retracted)
+        if self._serve_child is not None:
+            self._serve_child.drop_staged(step)
+        with self._cond:
+            if self._staged is not None and self._staged.step == step:
+                self._staged = None
+
     # -- serve-child plumbing ----------------------------------------------
 
     def register_error_callback(self, cb: Callable[[Exception], None]) -> None:
@@ -1015,11 +1099,19 @@ class HTTPTransport(CheckpointTransport[Any]):
             crc_algo=_CRC_ALGO,
             crcs=crcs,
             digest=digest,
+            keep=self._keep_versions,
         )
         self._child_staged = True
-        return _stage_manifest(
-            step, quorum_id, _CRC_ALGO, crcs, sizes, digest
+        manifest = _stage_manifest(
+            step, quorum_id, _CRC_ALGO, crcs, sizes, digest,
+            tree_token=_tree_token(treedef),
         )
+        if self._staged_store is not None:
+            # Child mode: payload bytes live in the child's /dev/shm
+            # ring; mirror the manifest here (same budget, same order)
+            # for the serving plane's pinned-version inventory.
+            self._staged_store.put(step, manifest, sum(sizes))
+        return manifest
 
     # -- CheckpointTransport -----------------------------------------------
 
@@ -1083,6 +1175,8 @@ class HTTPTransport(CheckpointTransport[Any]):
         with self._cond:
             self._staged = staged
             self._cond.notify_all()
+        if self._staged_store is not None:
+            self._staged_store.put(step, staged, sum(staged.chunk_sizes))
         return _stage_manifest(
             step,
             quorum_id,
@@ -1090,6 +1184,7 @@ class HTTPTransport(CheckpointTransport[Any]):
             staged.chunk_crcs,
             staged.chunk_sizes,
             staged.digest,
+            tree_token=staged.tree_token,
         )
 
     def disallow_checkpoint(self) -> None:

@@ -632,19 +632,20 @@ def _child_main(argv: Optional[List[str]] = None) -> int:
         pass
 
     cond = threading.Condition()
-    state: Dict[str, Any] = {"staged": None, "closing": False}
+    # "staged" = the current (newest) snapshot — the heal-gating target;
+    # "history" = the step-labeled ring of resident snapshots (epoch
+    # dirs stay on the shared-memory filesystem until the budget evicts
+    # them), so pinned-version serving reads old versions from /dev/shm.
+    state: Dict[str, Any] = {"staged": None, "history": {}, "closing": False}
 
     def wait_for_staged(step: int) -> Optional[_FileStaged]:
         t0 = time.perf_counter()
         with cond:
             cond.wait_for(
-                lambda: (
-                    state["staged"] is not None and state["staged"].step == step
-                )
-                or state["closing"],
+                lambda: step in state["history"] or state["closing"],
                 timeout=args.timeout,
             )
-            staged = state["staged"]
+            staged = state["history"].get(step, state["staged"])
         metrics.observe(
             "tpuft_ckpt_donor_stall_seconds", time.perf_counter() - t0
         )
@@ -858,18 +859,46 @@ def _child_main(argv: Optional[List[str]] = None) -> int:
             op = cmd.get("cmd")
             if op == "stage":
                 staged = _FileStaged(cmd)
+                keep = max(1, int(cmd.get("keep", 1)))
+                doomed: List[_FileStaged] = []
                 with cond:
-                    old, state["staged"] = state["staged"], staged
+                    # Restage at the same step swaps its epoch; the ring
+                    # keeps the newest `keep` steps resident (keep=1 is
+                    # exactly the pre-history donor behavior).
+                    old = state["history"].pop(staged.step, None)
+                    if old is not None:
+                        doomed.append(old)
+                    state["history"][staged.step] = staged
+                    for s in sorted(state["history"])[:-keep]:
+                        doomed.append(state["history"].pop(s))
+                    state["staged"] = staged
                     cond.notify_all()
-                if old is not None:
-                    old.delete()
+                for d in doomed:
+                    d.delete()
                 _emit({"event": "staged", "step": staged.step, "epoch": staged.epoch})
+            elif op == "drop":
+                # Retraction: one resident version leaves the ring (and
+                # /dev/shm) — later reads of it fail instead of serving
+                # retracted bytes.
+                with cond:
+                    dropped = state["history"].pop(int(cmd.get("step", -1)), None)
+                    if state["staged"] is dropped and dropped is not None:
+                        remaining = sorted(state["history"])
+                        state["staged"] = (
+                            state["history"][remaining[-1]] if remaining else None
+                        )
+                    cond.notify_all()
+                if dropped is not None:
+                    dropped.delete()
+                _emit({"event": "dropped", "step": cmd.get("step")})
             elif op == "disallow":
                 with cond:
-                    old, state["staged"] = state["staged"], None
+                    doomed = list(state["history"].values())
+                    state["history"].clear()
+                    state["staged"] = None
                     cond.notify_all()
-                if old is not None:
-                    old.delete()
+                for d in doomed:
+                    d.delete()
                 _emit({"event": "disallowed"})
             elif op == "shutdown":
                 break
@@ -877,11 +906,13 @@ def _child_main(argv: Optional[List[str]] = None) -> int:
                 logging.warning("unknown control cmd: %r", op)
     finally:
         with cond:
-            old, state["staged"] = state["staged"], None
+            doomed = list(state["history"].values())
+            state["history"].clear()
+            state["staged"] = None
             state["closing"] = True
             cond.notify_all()
-        if old is not None:
-            old.delete()
+        for d in doomed:
+            d.delete()
         server.shutdown()
         server.server_close()
     return 0
@@ -1087,11 +1118,15 @@ class ServeChild:
         crc_algo: str = "crc32",
         crcs: Optional[List[int]] = None,
         digest: Optional[str] = None,
+        keep: int = 1,
     ) -> None:
         """Hands the snapshot to the child (which owns — and eventually
         deletes — the epoch directory from here on). ``crcs``/``digest``
         ride along in the clear (not only inside the pickled meta) so the
-        jax-free child can answer ``/delta`` manifest diffs."""
+        jax-free child can answer ``/delta`` manifest diffs. ``keep`` is
+        the child-side history-ring width: the newest ``keep`` staged
+        steps stay resident as /dev/shm epoch dirs (pinned-version
+        serving); 1 = the pre-history single-snapshot behavior."""
         if not self.alive():
             raise ServeChildUnavailable("serving child is not alive")
         try:
@@ -1108,11 +1143,21 @@ class ServeChild:
                     "crc_algo": crc_algo,
                     "crcs": crcs,
                     "digest": digest,
+                    "keep": max(1, int(keep)),
                 }
             )
         except OSError as e:
             raise ServeChildUnavailable(f"serving child pipe broken: {e}") from e
         self._staged_epoch = epoch
+
+    def drop_staged(self, step: int) -> None:
+        """Retraction: removes one resident version from the child's ring
+        (its /dev/shm epoch dir is deleted) so a retracted published
+        version can never be served again."""
+        try:
+            self._send({"cmd": "drop", "step": int(step)})
+        except (OSError, ServeChildUnavailable):
+            pass  # child death is the watcher's to report
 
     def disallow(self) -> None:
         if self._staged_epoch is None:

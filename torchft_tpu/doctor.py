@@ -62,6 +62,10 @@ KNOWN_ENV = {
     "TPUFT_PUBLISH_EVERY", "TPUFT_PUBLISH_CHUNKS", "TPUFT_SERVING_POLL_SEC",
     "TPUFT_SERVING_NOTIFY", "TPUFT_SERVING_NOTIFY_HOLD_SEC",
     "TPUFT_SERVING_TENANT_TOKENS", "TPUFT_SERVING_TENANT_GBPS",
+    # Versioned weight history (torchft_tpu/history.py): resident-bytes
+    # budget + version-count cap for the committed-snapshot rings
+    # (manager state ring, serving staged ring, relay ring).
+    "TPUFT_HISTORY_BYTES", "TPUFT_HISTORY_MAX_VERSIONS",
     "TPUFT_METRICS_PORT", "TPUFT_METRICS_PUSH_SEC",
     # ZeRO plane (torchft_tpu/zero.py): enable flag for the harness/bench
     # loops, fleet-wide shard count, assignment policy, joiner heal
@@ -625,6 +629,73 @@ def _check_commit_pipeline() -> Tuple[str, str]:
     )
 
 
+def _check_history() -> Tuple[str, str]:
+    """Versioned weight-history preflight (torchft_tpu/history.py).
+    WARN, never FAIL: any budget trains and serves correctly — but every
+    resident ring version is one full ``(params, opt_state)`` copy, the
+    same K x (params + opt_state) formula as the commit-pipeline snapshot
+    ring (watch ``tpuft_history_bytes``), so an operator who pinned a
+    deep history should hear the memory bill before HBM does."""
+    from torchft_tpu import history as hist
+    from torchft_tpu import manager as mgr
+
+    raw_versions = os.environ.get(hist.ENV_HISTORY_MAX_VERSIONS)
+    raw_bytes = os.environ.get(hist.ENV_HISTORY_BYTES)
+    if raw_versions is not None:
+        try:
+            if int(raw_versions) < 1:
+                raise ValueError
+        except ValueError:
+            return (
+                "WARN",
+                f"{hist.ENV_HISTORY_MAX_VERSIONS}={raw_versions!r} is not a "
+                "positive int (rings will fall back to their defaults)",
+            )
+    if raw_bytes is not None:
+        try:
+            float(raw_bytes)
+        except ValueError:
+            return (
+                "WARN",
+                f"{hist.ENV_HISTORY_BYTES}={raw_bytes!r} is not a number "
+                "(rings will fall back to count-bounded budgets)",
+            )
+    # Effective manager-ring width: env override, else window depth + 1.
+    depth_raw = os.environ.get(mgr.COMMIT_PIPELINE_DEPTH_ENV) or os.environ.get(
+        mgr.COMMIT_PIPELINE_ENV
+    )
+    if depth_raw and depth_raw.strip().lower() == "auto":
+        depth = mgr.DEFAULT_ADAPTIVE_MAX_DEPTH
+    else:
+        try:
+            depth = int(depth_raw) if depth_raw else 0
+        except ValueError:
+            depth = 0
+    k = hist.history_max_versions(max(1, depth) + 1)
+    serving_k = hist.history_max_versions(hist.DEFAULT_SERVING_VERSIONS)
+    budget = hist.history_bytes_budget()
+    budget_note = (
+        f"; byte budget {budget} ({hist.ENV_HISTORY_BYTES})"
+        if budget is not None
+        else "; count-bounded (set TPUFT_HISTORY_BYTES for a byte budget)"
+    )
+    if k > 8 and budget is None:
+        # Same threshold as the commit-pipeline snapshot probe: past ~8
+        # resident copies the memory bill dwarfs what the history buys.
+        return (
+            "WARN",
+            f"history ring keeps {k} committed versions with no byte "
+            f"budget — resident bytes ~= {k} x (params + opt_state); "
+            "watch tpuft_history_bytes, or set TPUFT_HISTORY_BYTES",
+        )
+    return (
+        "PASS",
+        f"history ring: manager keeps {k} committed version(s) (exact "
+        f"deep-window donor serves), serving keeps {serving_k} staged "
+        f"version(s) (pinned/latest-1/rollback reads){budget_note}",
+    )
+
+
 def _check_env() -> Tuple[str, str]:
     # Value validation first — a fatal misconfig must FAIL even when a
     # typo'd var would also WARN.
@@ -648,6 +719,7 @@ def run_checks(lighthouse: str, skip_device: bool = False) -> int:
         ("wire codecs", _check_kernels),
         ("env vars", _check_env),
         ("commit pipeline", _check_commit_pipeline),
+        ("weight history", _check_history),
         ("metrics", _check_metrics),
         ("trace plane", _check_trace),
         ("heal serving", _check_heal_serve),

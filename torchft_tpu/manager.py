@@ -57,6 +57,7 @@ from torchft_tpu.checkpointing import (
 )
 from torchft_tpu.checkpointing._rwlock import RWLock
 from torchft_tpu.coordination import ManagerClient, ManagerServer
+from torchft_tpu.history import WeightHistory
 from torchft_tpu.parallel.process_group import ProcessGroup, ReduceOp
 from torchft_tpu.parallel.store import StoreClient
 from torchft_tpu.telemetry import commits_logger, errors_logger, quorums_logger
@@ -475,6 +476,21 @@ class Manager:
         self._batches_committed = 0
         self._commit_failures = 0
 
+        # Versioned weight history (torchft_tpu/history.py): the ring of
+        # committed state refs the optimizer promotes into at commit
+        # resolution. Sized off the commit-pipeline window by default —
+        # depth+1 versions are exactly what the rollback ring already
+        # held, so a deep-window donor can serve quorum.max_step EXACTLY
+        # after a drain advanced its live step past it (the PR-9
+        # "fail cleanly and retry" round becomes an immediate serve).
+        # TPUFT_HISTORY_MAX_VERSIONS / TPUFT_HISTORY_BYTES override.
+        window = (
+            self._adaptive_max_depth
+            if self._commit_pipeline_adaptive
+            else self._commit_pipeline_depth
+        )
+        self._history = WeightHistory(max_versions=max(1, int(window)) + 1)
+
         # Per-step error/heal state.
         self._errored: Optional[ExceptionWithTraceback] = None
         self._shutdown_hooks: List[Callable[[], None]] = []
@@ -732,6 +748,27 @@ class Manager:
             "pipeline_depth", step=self._step, quorum_id=self._quorum_id,
             depth=depth,
         )
+
+    @property
+    def history(self) -> WeightHistory:
+        """The step-labeled ring of committed state refs (history.py):
+        state owners (the optimizer) promote each committed step here at
+        commit RESOLUTION — never from a live speculative window — and
+        the donor staging path consults it so a joiner asking for
+        ``quorum.max_step`` is served that exact committed step even
+        when this donor's window drained past it."""
+        return self._history
+
+    def _history_state_dict(self, step: int) -> Optional[Dict[str, Any]]:
+        """The exact manager-shaped state dict for committed ``step``
+        from the history ring, or None when it cannot be served exactly
+        (evicted, a registered key never promoted — e.g. DiLoCo's
+        fragments, which don't promote yet — or accounting missing).
+        None means the caller stages its drained step instead: the
+        fallback fetches more, it never mislabels."""
+        if not self._user_state_dicts:
+            return None
+        return self._history.state_dict_at(step, set(self._user_state_dicts))
 
     def register_quorum_change_hook(self, hook: Callable[[], None]) -> None:
         """Runs ``hook`` on the quorum thread whenever the quorum id
@@ -1310,11 +1347,13 @@ class Manager:
             # still has in flight BEFORE reconfiguring the wire or serving
             # a donor checkpoint — the new quorum era (and any joiner
             # healing from this replica) must observe committed state only.
-            # With a depth-N window this resolves the FULL window (the
-            # committed step may advance past quorum.max_step here — the
-            # donor send below stages the drained committed step honestly,
-            # so a first heal round against a deep window can fail cleanly
-            # and succeed next round, never serving mislabeled bytes).
+            # With a depth-N window this resolves the FULL window; the
+            # committed step may advance past quorum.max_step here, and
+            # the donor send below then serves max_step EXACTLY from the
+            # history ring (resolved slots promote instead of dropping —
+            # torchft_tpu/history.py). Only a ring miss falls back to
+            # staging the drained step honestly labeled, which the joiner
+            # rejects cleanly and retries — never mislabeled bytes.
             self._run_quorum_drain_hooks()
             # Era boundary: the adaptive controller re-derives its depth
             # from the measured barrier RTT vs step time (the only point
@@ -1374,20 +1413,48 @@ class Manager:
                 # uncommitted state either.
                 self._run_quorum_drain_hooks()
                 serve_step = quorum.max_step
+                serve_state_dict: Optional[Dict[str, Any]] = None
                 if self._step > serve_step:
                     # Draining a depth-N window advanced our committed
                     # step past the quorum's (pre-drain-reported)
-                    # max_step. Stage what we actually hold — a joiner
-                    # that asked for max_step fails this round cleanly
-                    # and re-heals next round once the fleet's reported
-                    # steps catch up; mislabeling committed bytes with an
-                    # older step would break the (step, digest) chain.
-                    self._logger.info(
-                        f"donor staging drained step {self._step} "
-                        f"(quorum max_step={serve_step}): a deep window "
-                        "resolved during the drain"
-                    )
-                    serve_step = self._step
+                    # max_step. The history ring holds the last K
+                    # committed steps exactly (optim promotes each slot
+                    # at resolution), so serve the joiner the step it
+                    # asked for — the committed bytes AT max_step,
+                    # honestly labeled. Only a ring miss (evicted /
+                    # never promoted) falls back to staging the drained
+                    # step, which the joiner rejects cleanly and retries
+                    # next round — never mislabeled bytes either way.
+                    # Step 0 is the init_sync mosaic (per-rank state,
+                    # never history-served).
+                    if serve_step > 0:
+                        serve_state_dict = self._history_state_dict(serve_step)
+                    if serve_state_dict is not None:
+                        metrics.inc(
+                            "tpuft_history_exact_serves_total",
+                            **self._metric_labels,
+                        )
+                        self._trace.record(
+                            "history_exact_serve",
+                            step=serve_step,
+                            quorum_id=quorum.quorum_id,
+                            drained_step=self._step,
+                        )
+                        self._logger.info(
+                            f"donor serving step {serve_step} exactly from "
+                            f"the history ring (drained step {self._step})"
+                        )
+                    else:
+                        metrics.inc(
+                            "tpuft_history_misses_total",
+                            **self._metric_labels,
+                        )
+                        self._logger.info(
+                            f"donor staging drained step {self._step} "
+                            f"(quorum max_step={serve_step}): history ring "
+                            "cannot serve the exact step"
+                        )
+                        serve_step = self._step
                 try:
                     if stripe_costage:
                         self._logger.info(
@@ -1422,7 +1489,11 @@ class Manager:
                         self._checkpoint_transport.send_checkpoint(
                             dst_ranks=quorum.recover_dst_replica_ranks,
                             step=serve_step,
-                            state_dict=self._manager_state_dict(),
+                            state_dict=(
+                                serve_state_dict
+                                if serve_state_dict is not None
+                                else self._manager_state_dict()
+                            ),
                             timeout=self._timeout,
                             quorum_id=quorum.quorum_id,
                         )
@@ -1804,6 +1875,10 @@ class Manager:
             self._step += 1
             self._batches_committed += self.num_participants()
             self._commit_failures = 0
+            # History-ring accounting for this committed step (cheap
+            # ints, never a state sample): the state half arrives from
+            # the optimizer's promotion at adoption.
+            self._history.note_accounting(self._step, self._batches_committed)
             metrics.inc("tpuft_commits_total", **self._metric_labels)
             metrics.set_gauge(
                 "tpuft_last_commit_time", time.time(), **self._metric_labels
@@ -1964,6 +2039,7 @@ class Manager:
             self._step = max(self._step, step + 1)
             self._batches_committed += participants
             self._commit_failures = 0
+            self._history.note_accounting(self._step, self._batches_committed)
             metrics.inc("tpuft_commits_total", **self._metric_labels)
             metrics.set_gauge(
                 "tpuft_last_commit_time", time.time(), **self._metric_labels
@@ -2070,6 +2146,9 @@ class Manager:
     def load_state_dict(self, state_dict: Dict[str, int]) -> None:
         self._step = state_dict["step"]
         self._batches_committed = state_dict["batches_committed"]
+        # A checkpoint restore rewrote the step counter: resident history
+        # entries' step labels no longer describe this trajectory.
+        self._history.clear()
 
     def _manager_state_dict(self) -> Dict[str, Any]:
         with self._state_dict_lock.r_lock(timeout=self._timeout):

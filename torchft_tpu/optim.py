@@ -425,6 +425,7 @@ class Optimizer:
         self.tx = tx
         self.params = params
         self._heal_count = 0
+        self._register_key = register_key
         self.opt_state = self._init_state(tx, params)
         manager.register_state_dict_fn(
             register_key, self._load_state_dict, self._state_dict
@@ -574,7 +575,66 @@ class Optimizer:
                 self.params, self.opt_state = speculation
         finally:
             self.manager.allow_state_dict_read()
+        # Promote the just-committed state into the manager's history
+        # ring (refs only — immutable trees make holding a reference a
+        # true snapshot). The barrier already advanced the step counter.
+        self._promote_committed(
+            self._int_or_none(self.manager.current_step()),
+            self.params,
+            self.opt_state,
+        )
         return True
+
+    # ------------------------------------------------------------------
+    # versioned weight history (torchft_tpu/history.py)
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _int_or_none(value: Any) -> Optional[int]:
+        return value if isinstance(value, int) else None
+
+    def _promote_committed(
+        self, step: Optional[int], params: Any, opt_state: Any
+    ) -> None:
+        """Hands one committed step's ``(params, opt_state)`` refs to the
+        manager's history ring — the slot promotion that replaces simply
+        dropping resolved window snapshots. Best-effort: history is an
+        availability plane (exact deep-window heals, pinned serving);
+        its bookkeeping must never wound a commit."""
+        if step is None:
+            return
+        hist = getattr(self.manager, "history", None)
+        try:
+            from torchft_tpu.history import WeightHistory
+
+            if not isinstance(hist, WeightHistory):
+                return  # scripted/mocked managers without a real ring
+            state = {"params": params, "opt_state": opt_state}
+            hist.note_state(
+                self._register_key,
+                step,
+                state,
+                nbytes=self._snapshot_nbytes((params, opt_state)),
+                quorum_id=getattr(self.manager, "_quorum_id", None),
+            )
+        except Exception:  # noqa: BLE001 — bookkeeping must not wound a step
+            logger.exception("history promotion failed (ignored)")
+
+    def _post_commit_state(self, rec: "_PendingStep") -> Any:
+        """The committed state AFTER ``rec``'s step: the next younger
+        same-generation window slot's pre-step snapshot (speculations
+        chain — slot k+1's snapshot IS post-k state), or the live state
+        when ``rec`` is the window's newest resolved slot."""
+        if self._pipeline is not None:
+            seen = False
+            for r in self._pipeline.pending():
+                if r is rec:
+                    seen = True
+                    continue
+                if not seen or r.gen != rec.gen or r.committed is not None:
+                    continue
+                return r.snapshot
+        return (self.params, self.opt_state)
 
     # ------------------------------------------------------------------
     # pipelined commit (depth N): resolution machinery
@@ -710,6 +770,22 @@ class Optimizer:
                         ).observe(1 + discarded)
                 finally:
                     self.manager.allow_state_dict_read()
+                if committed:
+                    # Ring-slot promotion: the resolved slot's committed
+                    # state enters the step-labeled history instead of
+                    # being dropped — after a heal it is the live
+                    # (just-recomputed) state; otherwise the next younger
+                    # slot's snapshot (speculations chain).
+                    self._promote_committed(
+                        rec.claimed_step + 1
+                        if rec.claimed_step >= 0
+                        else self._int_or_none(self.manager.current_step()),
+                        *(
+                            (self.params, self.opt_state)
+                            if self._heal_count != rec.heal_count
+                            else self._post_commit_state(rec)
+                        ),
+                    )
                 if rolled_back:
                     # Incident capture runs OUTSIDE the writer: dumping
                     # journals is file I/O a concurrent checkpoint serve
@@ -740,6 +816,13 @@ class Optimizer:
                     publisher = getattr(self.manager, "_publisher", None)
                     if publisher is not None:
                         publisher.retract_after(rolled_step)
+                    # History ring: drop anything newer than the surviving
+                    # committed step (belt-and-braces — refused steps were
+                    # never promoted, but the ring must stay provably on
+                    # the committed trajectory).
+                    hist = getattr(self.manager, "_history", None)
+                    if hist is not None and hasattr(hist, "retract_newer"):
+                        hist.retract_newer(rolled_step)
                 rec.committed = committed
                 return committed
 

@@ -38,6 +38,15 @@ Heal-path modes target the recovery plane itself:
   their supervised relaunches re-enter as SIMULTANEOUS joiners striping
   the same donor set, exercising the coordinated stripe plan, per-joiner
   serve fairness, and the joiner ingress bound.
+- ``retract_version``: armed at the ``publisher_retract`` site; the
+  targeted publisher's NEXT publish consumes it and immediately
+  retracts the just-published version — the rollback-storm drill's
+  deterministic trigger ("canary V shipped and was found bad"): every
+  resident version >= V is dropped (descriptors, inline chunks, the
+  serve child's /dev/shm epochs) and V-1 is re-announced seq-newer, so
+  relays and subscribers converge to V-1 with zero torn / stale-era /
+  wrong-version adoptions (tests/test_serving.py rollback-storm drill,
+  strict AND pipelined orderings; SERVING_BENCH.json rollback leg).
 - ``kill_relay``: armed at the ``serving_relay`` site (optionally
   ``--donor-tag <port>`` to target one relay of a tier — in a relay
   TREE that is how an INTERIOR relay is singled out, since every tier
@@ -106,7 +115,7 @@ HEAL_FAULT_MODES = (
     "kill_half_fleet",
 )
 # Serving-plane modes (the committed-weights fan-out tier).
-SERVING_FAULT_MODES = ("kill_relay",)
+SERVING_FAULT_MODES = ("kill_relay", "retract_version")
 ALL_FAULT_MODES = FAULT_MODES + HEAL_FAULT_MODES + SERVING_FAULT_MODES
 
 
@@ -245,6 +254,11 @@ def arm_stream_fault(
         # fan-out tier.
         site = f"serving_relay:{donor_tag}" if donor_tag else "serving_relay"
         armed_mode = "die"
+    elif mode == "retract_version":
+        # The publisher consumes "retract" right after its next publish
+        # and retracts that version fleet-wide (readers converge to V-1).
+        site = "publisher_retract"
+        armed_mode = "retract"
     else:
         site, armed_mode = "heal_stream", mode
     try:
@@ -278,6 +292,7 @@ def inject_fault(
         "kill_serve_child",
         "corrupt_stripe",
         "kill_relay",
+        "retract_version",
     ):
         return arm_stream_fault(mode, fault_file)
     raise ValueError(f"unknown fault mode {mode!r}")

@@ -35,6 +35,7 @@ import json
 import os
 import random
 import threading
+import urllib.parse
 import urllib.request
 from typing import Any, Callable, Dict, List, Optional
 
@@ -48,6 +49,8 @@ from torchft_tpu.utils import netem
 __all__ = [
     "LATEST_ROUTE",
     "NOTIFY_ROUTE",
+    "VERSION_ROUTE_PREFIX",
+    "LATEST_PREV_ROUTE",
     "ENV_NOTIFY",
     "ENV_NOTIFY_HOLD_SEC",
     "notify_enabled",
@@ -57,6 +60,9 @@ __all__ = [
     "fetch_notify",
     "latest_descriptor",
     "validate_latest",
+    "newer_than_held",
+    "same_stream",
+    "changed_chunks_between",
     "chunk_crc",
     "NotifyHub",
     "serve_notify",
@@ -65,6 +71,12 @@ __all__ = [
 
 LATEST_ROUTE = "/serving/latest"
 NOTIFY_ROUTE = "/serving/notify"
+# Pinned-version discovery (the history ring's read surface):
+# ``/serving/version/{step}`` answers that exact resident version's
+# descriptor (410 once retracted), ``/serving/latest-1`` the previous
+# resident version — canary/A-B reads and the rollback fallback.
+VERSION_ROUTE_PREFIX = "/serving/version/"
+LATEST_PREV_ROUTE = "/serving/latest-1"
 
 ENV_NOTIFY = "TPUFT_SERVING_NOTIFY"
 ENV_NOTIFY_HOLD_SEC = "TPUFT_SERVING_NOTIFY_HOLD_SEC"
@@ -136,15 +148,24 @@ def fetch_notify(
     timeout: float,
     token: Optional[str] = None,
     hold: Optional[float] = None,
+    after_seq: Optional[int] = None,
+    after_pub: Optional[str] = None,
 ) -> Optional[Dict[str, Any]]:
     """One long-poll round against ``base``: parks server-side until a
     version newer than ``after`` is announced (bounded by ``hold``) and
     returns its descriptor, or None when the hold expired with nothing
-    new (the caller re-arms). The descriptor is NOT trusted — callers
-    run it through the same validation a polled ``/serving/latest``
-    body gets."""
+    new (the caller re-arms). ``after_seq`` is the held version's
+    publication sequence — against a seq-aware server it makes a
+    RETRACTION (lower step, higher pub_seq) wake the waiter too, which
+    step watermarks alone cannot express. The descriptor is NOT trusted
+    — callers run it through the same validation a polled
+    ``/serving/latest`` body gets."""
     hold = hold if hold is not None else notify_hold_sec()
     url = f"{base}{NOTIFY_ROUTE}?after={int(after)}&hold={hold:g}"
+    if after_seq is not None:
+        url += f"&after_seq={int(after_seq)}"
+    if after_pub:
+        url += f"&after_pub={urllib.parse.quote(str(after_pub))}"
     # The socket timeout must outlive the server-side hold.
     body, status = _fetch(url, hold + timeout, token)
     if status == 204 or not body:
@@ -161,6 +182,8 @@ def latest_descriptor(
     published_ts: float,
     depth: int = 0,
     origin_ts: Optional[float] = None,
+    pub_seq: Optional[int] = None,
+    pub_id: Optional[str] = None,
 ) -> Dict[str, Any]:
     """The ``/serving/latest`` body: the staging manifest
     (http_transport._stage_manifest) plus where to fetch the chunks from
@@ -175,7 +198,77 @@ def latest_descriptor(
     descriptor["published_ts"] = published_ts
     descriptor["depth"] = depth
     descriptor["origin_ts"] = origin_ts if origin_ts is not None else published_ts
+    if pub_seq is not None:
+        # Publication sequence: monotone over publishes AND retractions,
+        # preserved across relay tiers. It is what lets a deliberate
+        # rollback (step DECREASES, seq increases) outrank the retracted
+        # version while a stale endpoint (old seq) still cannot roll a
+        # reader back. Scoped by "pub_id" (the originating publisher's
+        # stream identity): sequences from DIFFERENT publishers are
+        # incomparable counters, so cross-publisher failover falls back
+        # to era/step ordering.
+        descriptor["pub_seq"] = int(pub_seq)
+    if pub_id is not None:
+        descriptor["pub_id"] = str(pub_id)
     return descriptor
+
+
+def same_stream(
+    latest: Dict[str, Any], held_seq: Optional[int], held_src: Optional[str]
+) -> bool:
+    """True when ``latest`` continues the publication stream the held
+    version came from — both carry a sequence and the originating
+    publisher identity matches — i.e. pub_seq ordering is meaningful."""
+    return (
+        latest.get("pub_seq") is not None
+        and held_seq is not None
+        and latest.get("pub_id") == held_src
+    )
+
+
+def newer_than_held(
+    latest: Dict[str, Any],
+    held_step: int,
+    held_seq: Optional[int] = None,
+    held_src: Optional[str] = None,
+) -> bool:
+    """Version ordering against a held version: publication sequence
+    within one publisher stream (a retraction is seq-newer at a LOWER
+    step), step order otherwise (cross-publisher failover and the
+    pre-history wire contract). Era fencing stays the caller's separate
+    check — suspended only under same-stream seq ordering, where an era
+    regression is a sanctioned rollback, not a stale survivor."""
+    if same_stream(latest, held_seq, held_src):
+        return int(latest["pub_seq"]) > int(held_seq)  # type: ignore[arg-type]
+    return int(latest["step"]) > held_step
+
+
+def changed_chunks_between(
+    base: Optional[Dict[str, Any]], latest: Dict[str, Any]
+) -> Optional[List[int]]:
+    """Chunk indices whose ``(crc, size)`` differ between two manifests
+    of the SAME chunk layout; None when the layouts are incomparable.
+    Serves the delta-aware notify body — advisory only: readers verify
+    every adopted chunk against the descriptor CRCs regardless, so a
+    lying set can waste a fetch, never corrupt an adoption."""
+    if base is None:
+        return None
+    try:
+        base_crcs, base_sizes = base["chunk_crcs"], base["chunk_sizes"]
+        crcs, sizes = latest["chunk_crcs"], latest["chunk_sizes"]
+    except KeyError:
+        return None
+    if (
+        base.get("crc_algo") != latest.get("crc_algo")
+        or len(base_crcs) != len(crcs)
+        or len(base_sizes) != len(sizes)
+    ):
+        return None
+    return [
+        i
+        for i in range(len(crcs))
+        if base_crcs[i] != crcs[i] or base_sizes[i] != sizes[i]
+    ]
 
 
 def validate_latest(latest: Dict[str, Any]) -> Optional[str]:
@@ -217,13 +310,24 @@ class NotifyHub:
     def __init__(self) -> None:
         self._cond = threading.Condition()
         self._latest = -1
+        self._latest_seq = -1
         self._closed = False
         self._waiters = 0
 
-    def announce(self, step: int) -> None:
+    def announce(self, step: int, seq: Optional[int] = None) -> None:
+        """A new version went live. ``seq`` (the publication sequence)
+        moves independently of ``step`` so a RETRACTION — lower step,
+        higher seq — still wakes seq-aware waiters; step-only waiters
+        (the pre-history wire) keep their step watermark semantics."""
         with self._cond:
+            woke = False
             if step > self._latest:
                 self._latest = step
+                woke = True
+            if seq is not None and seq > self._latest_seq:
+                self._latest_seq = seq
+                woke = True
+            if woke:
                 self._cond.notify_all()
 
     def close(self) -> None:
@@ -231,17 +335,27 @@ class NotifyHub:
             self._closed = True
             self._cond.notify_all()
 
-    def wait_newer(self, after: int, hold: float) -> bool:
-        """Parks until a step newer than ``after`` was announced; True
-        when one is available (False = hold expired / hub closed)."""
+    def wait_newer(
+        self, after: int, hold: float, after_seq: Optional[int] = None
+    ) -> bool:
+        """Parks until a version newer than the watermark was announced;
+        True when one is available (False = hold expired / hub closed).
+        The watermark is ``after_seq`` (publication sequence) when the
+        client sent one, the step otherwise."""
+
+        def newer() -> bool:
+            if after_seq is not None and self._latest_seq >= 0:
+                return self._latest_seq > after_seq
+            return self._latest > after
+
         with self._cond:
             self._waiters += 1
             metrics.set_gauge("tpuft_serving_notify_waiters", self._waiters)
             try:
                 self._cond.wait_for(
-                    lambda: self._closed or self._latest > after, timeout=hold
+                    lambda: self._closed or newer(), timeout=hold
                 )
-                return self._latest > after
+                return newer()
             finally:
                 self._waiters -= 1
                 metrics.set_gauge("tpuft_serving_notify_waiters", self._waiters)
@@ -252,12 +366,22 @@ def serve_notify(
     query: str,
     hub: NotifyHub,
     descriptor: Callable[[], Optional[Dict[str, Any]]],
+    manifest_at: Optional[Callable[[int], Optional[Dict[str, Any]]]] = None,
 ) -> None:
     """The ``/serving/notify`` route body, shared by the publisher's
-    announce server and the relay: parse ``after``/``hold``, park on the
-    hub, answer the current descriptor (200) or nothing-new (204). The
-    hold is clamped to the server's ``notify_hold_sec`` so a client
-    cannot pin handler threads arbitrarily long."""
+    announce server and the relay: parse ``after``/``hold`` (and the
+    retraction-aware ``after_seq`` watermark), park on the hub, answer
+    the current descriptor (200) or nothing-new (204). The hold is
+    clamped to the server's ``notify_hold_sec`` so a client cannot pin
+    handler threads arbitrarily long.
+
+    Delta-aware push bodies: when the server can look up the CLIENT's
+    held version (``manifest_at`` over the history ring), the response
+    carries ``changed_chunks`` — the chunk indices that differ from the
+    client's watermark version — so a reader with a matching treedef
+    token skips the ``/meta`` RTT on sparse bumps. Advisory only: the
+    verify-then-swap pipeline runs unchanged on the descriptor itself,
+    so a lying hint cannot survive CRC/digest validation."""
     import urllib.parse as _parse
 
     qs = _parse.parse_qs(query)
@@ -266,19 +390,35 @@ def serve_notify(
     except ValueError:
         handler.send_error(400, "bad after watermark")
         return
+    after_seq: Optional[int] = None
+    if "after_seq" in qs:
+        try:
+            after_seq = int(qs["after_seq"][0])
+        except ValueError:
+            after_seq = None
+    after_pub = qs.get("after_pub", [None])[0]
     try:
         hold = min(float(qs.get("hold", ["inf"])[0]), notify_hold_sec())
     except ValueError:
         hold = notify_hold_sec()
     metrics.inc("tpuft_serving_notify_requests_total")
-    hub.wait_newer(after, hold)
+    hub.wait_newer(after, hold, after_seq=after_seq)
     latest = descriptor()
-    if latest is None or int(latest.get("step", -1)) <= after:
+    if latest is None or not newer_than_held(latest, after, after_seq, after_pub):
         handler.send_response(204)
         handler.send_header("Content-Length", "0")
         handler.end_headers()
         return
     metrics.inc("tpuft_serving_notify_wakeups_total")
+    if manifest_at is not None and after >= 0:
+        try:
+            changed = changed_chunks_between(manifest_at(after), latest)
+        except Exception:  # noqa: BLE001 — the hint must never wound a push
+            changed = None
+        if changed is not None:
+            latest = dict(latest)
+            latest["delta_base_step"] = after
+            latest["changed_chunks"] = changed
     body = json.dumps(latest).encode()
     handler.send_response(200)
     handler.send_header("Content-Type", "application/json")

@@ -8,6 +8,21 @@ the exact heal-plane format (checkpointing/http_transport.py format-2
 deprioritized sidecar and publication structurally cannot stall the
 donor's step loop (the PR-5 isolation envelope applies unchanged).
 
+Versioned history (torchft_tpu/history.py): the publication transport
+keeps the last K staged versions resident (``TPUFT_HISTORY_BYTES`` /
+``TPUFT_HISTORY_MAX_VERSIONS``), so besides ``GET /serving/latest``
+readers get:
+
+- ``GET /serving/version/{step}`` — a PINNED version's descriptor
+  (canary/A-B reads; 410 once retracted, 404 once evicted);
+- ``GET /serving/latest-1`` — the previous resident version (the
+  standing rollback/canary-baseline alias);
+- :meth:`retract_version` — instant fleet-wide model rollback: every
+  resident version >= V is dropped (transport chunks AND descriptors)
+  and V-1 is re-announced under a HIGHER publication sequence
+  (``pub_seq``), so relays and subscribers converge to V-1 while a
+  merely-stale endpoint (old pub_seq) still cannot roll anyone back.
+
 Integration contract (see ``Manager.attach_publisher``):
 
 - the manager's commit tails call :meth:`note_commit` — a cheap due-mark,
@@ -18,14 +33,16 @@ Integration contract (see ``Manager.attach_publisher``):
   sends, so speculative-window state is structurally never published;
 - a rollback-unwind retracts any due-but-unpublished version through
   :meth:`retract_after` (published versions are post-commit-barrier and
-  therefore final — the retraction is the invariant's belt-and-braces,
-  counted in ``tpuft_publish_retracted_total``).
+  quorum-final — the belt-and-braces published-history retraction there
+  exists for the bounded phantom-commit envelope only, counted in
+  ``tpuft_history_retractions_total`` like the operator path).
 
-Readers discover versions via ``GET /serving/latest`` on
-:meth:`address` — a JSON descriptor carrying the staged manifest (step,
-era, digest, per-chunk CRCs/sizes) plus the chunk base URL (the
-transport's inline server or its serving sidecar). Chunk traffic never
-touches the announcement server.
+Readers discover versions via the JSON descriptor routes on
+:meth:`address`; chunk traffic never touches the announcement server.
+The punisher's ``retract_version`` chaos action arms a file fault at
+site ``publisher_retract``: the next :meth:`publish` consumes it and
+immediately retracts the just-published version — the rollback-storm
+drill's deterministic trigger.
 """
 
 from __future__ import annotations
@@ -36,8 +53,10 @@ import os
 import socket
 import threading
 import time
+import uuid
+from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 from torchft_tpu import metrics, tracing
 from torchft_tpu.checkpointing.http_transport import HTTPTransport
@@ -45,13 +64,17 @@ from torchft_tpu.checkpointing.serve_child import (
     UnknownTenantToken,
     tenant_of_authorization,
 )
+from torchft_tpu.history import DEFAULT_SERVING_VERSIONS, history_max_versions
 from torchft_tpu.serving._wire import (
+    LATEST_PREV_ROUTE,
     LATEST_ROUTE,
     NOTIFY_ROUTE,
+    VERSION_ROUTE_PREFIX,
     NotifyHub,
     latest_descriptor,
     serve_notify,
 )
+from torchft_tpu.utils import faultinject
 
 __all__ = [
     "WeightPublisher",
@@ -104,20 +127,39 @@ class WeightPublisher:
         timeout: float = 10.0,
         transport: Optional[HTTPTransport] = None,
         bind_port: int = 0,
+        keep_versions: Optional[int] = None,
     ) -> None:
         self._every = every if every is not None else publish_every()
         self._timeout = timeout
         self._owns_transport = transport is None
+        keep = history_max_versions(
+            keep_versions
+            if keep_versions is not None
+            else DEFAULT_SERVING_VERSIONS
+        )
         self._transport = (
             transport
             if transport is not None
             else HTTPTransport(
                 timeout=timeout,
                 num_chunks=num_chunks if num_chunks is not None else _publish_chunks(),
+                keep_versions=keep,
             )
         )
         self._lock = threading.Lock()
         self._latest: Optional[Dict[str, Any]] = None
+        # Descriptor history, mirroring the transport's resident staged
+        # versions: step -> the descriptor announced for it. Pruned to
+        # the transport's inventory after every publish, so a descriptor
+        # never outlives its chunks.
+        self._versions: "OrderedDict[int, Dict[str, Any]]" = OrderedDict()
+        self._retracted: set = set()
+        # Publication stream identity + sequence: the sequence is
+        # monotone over publishes AND retractions; the id scopes it (two
+        # publishers' counters are incomparable — readers fall back to
+        # step ordering across streams).
+        self._pub_id = uuid.uuid4().hex[:12]
+        self._pub_seq = 0
         self._due: Optional[int] = None
         self._shutdown = False
         # Long-poll push edge: notify waiters (subscribers, child relays)
@@ -135,7 +177,12 @@ class WeightPublisher:
 
             def do_GET(self) -> None:
                 route, _, query = self.path.partition("?")
-                if route not in (LATEST_ROUTE, NOTIFY_ROUTE):
+                pinned = route.startswith(VERSION_ROUTE_PREFIX)
+                if route not in (
+                    LATEST_ROUTE,
+                    NOTIFY_ROUTE,
+                    LATEST_PREV_ROUTE,
+                ) and not pinned:
                     self.send_error(404, "unknown route")
                     return
                 # Tenant auth parity with the chunk seams: an unknown
@@ -148,15 +195,34 @@ class WeightPublisher:
                     self.send_error(401, f"unknown serving tenant: {e}")
                     return
                 if route == NOTIFY_ROUTE:
-                    serve_notify(self, query, publisher._hub, publisher.latest)
+                    serve_notify(
+                        self,
+                        query,
+                        publisher._hub,
+                        publisher.latest,
+                        manifest_at=publisher.version_descriptor,
+                    )
                     return
-                with publisher._lock:
-                    latest = publisher._latest
+                if route == LATEST_ROUTE:
+                    latest, label = publisher.latest(), "latest"
+                elif route == LATEST_PREV_ROUTE:
+                    latest, label = publisher.latest_prev(), "latest-1"
+                else:
+                    try:
+                        step = int(route[len(VERSION_ROUTE_PREFIX):])
+                    except ValueError:
+                        self.send_error(400, "bad version step")
+                        return
+                    if publisher.is_retracted(step):
+                        metrics.inc("tpuft_history_retracted_reads_total")
+                        self.send_error(410, f"version {step} was retracted")
+                        return
+                    latest, label = publisher.version_descriptor(step), "version"
                 if latest is None:
-                    self.send_error(404, "nothing published yet")
+                    self.send_error(404, "no such version published")
                     return
                 body = json.dumps(latest).encode()
-                metrics.inc("tpuft_serving_requests_total", route="latest")
+                metrics.inc("tpuft_serving_requests_total", route=label)
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
@@ -186,6 +252,28 @@ class WeightPublisher:
         with self._lock:
             return self._latest
 
+    def latest_prev(self) -> Optional[Dict[str, Any]]:
+        """The previous resident version's descriptor (``latest-1``) —
+        the standing canary-baseline / rollback-fallback alias."""
+        with self._lock:
+            if len(self._versions) < 2:
+                return None
+            return self._versions[list(self._versions)[-2]]
+
+    def version_descriptor(self, step: int) -> Optional[Dict[str, Any]]:
+        """The resident descriptor for pinned ``step`` (None = evicted or
+        never published; retraction answers 410 at the route)."""
+        with self._lock:
+            return self._versions.get(step)
+
+    def resident_versions(self) -> List[int]:
+        with self._lock:
+            return list(self._versions)
+
+    def is_retracted(self, step: int) -> bool:
+        with self._lock:
+            return step in self._retracted
+
     # -- manager-facing seams ----------------------------------------------
 
     @property
@@ -209,13 +297,63 @@ class WeightPublisher:
         """Rollback-unwind retraction: drops any due-but-unpublished
         version for a step newer than the unwound-to committed step, so a
         quorum-wide refusal can never surface a version the fleet
-        discarded. Versions already published are post-barrier (final by
-        quorum agreement) and are never retracted."""
+        discarded. Published versions are post-barrier (final by quorum
+        agreement); the published-history sweep below is belt-and-braces
+        for the bounded phantom-commit envelope only — under normal
+        operation there is nothing published past the surviving step."""
         with self._lock:
             if self._due is not None and self._due > committed_step:
                 self._due = None
                 metrics.inc("tpuft_publish_retracted_total")
                 tracing.record("publish_retracted", step=committed_step)
+            published_newer = [s for s in self._versions if s > committed_step]
+        if published_newer:
+            self.retract_version(min(published_newer))
+
+    # -- retraction (published history) ------------------------------------
+
+    def retract_version(self, step: int) -> bool:
+        """Instant fleet-wide model rollback: retracts published version
+        ``step`` AND everything newer (a rollback never leaves a torn
+        mix of retracted and post-retracted versions resident), then
+        re-announces the newest surviving version (V-1) under a higher
+        publication sequence so relays/subscribers converge to it.
+        Returns whether anything was actually retracted."""
+        with self._lock:
+            doomed = sorted(s for s in self._versions if s >= step)
+            if not doomed:
+                return False
+            for s in doomed:
+                del self._versions[s]
+                self._retracted.add(s)
+                metrics.inc("tpuft_history_retractions_total")
+            self._pub_seq += 1
+            survivor: Optional[Dict[str, Any]] = None
+            if self._versions:
+                prev_step = list(self._versions)[-1]
+                survivor = dict(self._versions[prev_step])
+                # Same bytes, same digest — only the publication identity
+                # moves: seq-newer so readers adopt it over retracted V,
+                # while stale endpoints (old seq) still cannot win.
+                survivor["pub_seq"] = self._pub_seq
+                survivor["published_ts"] = time.time()
+                self._versions[prev_step] = survivor
+            self._latest = survivor
+            seq = self._pub_seq
+        for s in doomed:
+            # Chunk bytes leave the serve path too (inline ring and the
+            # child's /dev/shm ring): a retracted version 410s at every
+            # seam instead of lingering as fetchable bytes.
+            self._transport.drop_staged(s, retracted=True)
+            tracing.record("version_retracted", step=s)
+        logger.warning(
+            "retracted published version(s) %s; readers converge to %s",
+            doomed,
+            survivor["step"] if survivor is not None else "none",
+        )
+        if survivor is not None:
+            self._hub.announce(int(survivor["step"]), seq=seq)
+        return True
 
     # -- publication -------------------------------------------------------
 
@@ -244,17 +382,30 @@ class WeightPublisher:
                 "WeightPublisher needs a manifest-returning transport "
                 "(HTTPTransport); got None from send_checkpoint"
             )
-        latest = latest_descriptor(
-            manifest,
-            base=self._transport.metadata(),
-            published_ts=time.time(),
-            depth=0,
-        )
         with self._lock:
+            self._pub_seq += 1
+            latest = latest_descriptor(
+                manifest,
+                base=self._transport.metadata(),
+                published_ts=time.time(),
+                depth=0,
+                pub_seq=self._pub_seq,
+                pub_id=self._pub_id,
+            )
             self._latest = latest
+            self._retracted.discard(step)
+            self._versions[step] = latest
+            if list(self._versions) != sorted(self._versions):
+                self._versions = OrderedDict(sorted(self._versions.items()))
+            # Descriptors never outlive their chunks: prune to the
+            # transport's resident staged inventory.
+            resident = set(self._transport.staged_steps()) | {step}
+            for s in [s for s in self._versions if s not in resident]:
+                del self._versions[s]
+            seq = self._pub_seq
         # Wake the long-poll edge AFTER the descriptor flip: a woken
         # waiter always re-reads a fully staged, announced version.
-        self._hub.announce(step)
+        self._hub.announce(step, seq=seq)
         elapsed = time.perf_counter() - t0
         nbytes = sum(manifest["chunk_sizes"])
         metrics.inc("tpuft_publish_total")
@@ -269,6 +420,15 @@ class WeightPublisher:
             bytes=nbytes,
             digest=str(manifest["digest"])[:12],
         )
+        # Chaos seam (punisher ``retract_version``): a file-armed
+        # retraction is consumed by the publish that follows it — the
+        # just-published version is immediately retracted, modeling
+        # "canary V shipped and was found bad" deterministically.
+        if faultinject.consume("publisher_retract") == "retract":
+            logger.warning(
+                "punisher retract_version armed: retracting version %d", step
+            )
+            self.retract_version(step)
         return latest
 
     def register_error_callback(self, cb: Callable[[Exception], None]) -> None:

@@ -51,9 +51,12 @@ from torchft_tpu.checkpointing.serve_child import (
     maybe_pace_serve,
     tenant_of_authorization,
 )
+from torchft_tpu.history import DEFAULT_SERVING_VERSIONS, StagedVersionStore
 from torchft_tpu.serving._wire import (
+    LATEST_PREV_ROUTE,
     LATEST_ROUTE,
     NOTIFY_ROUTE,
+    VERSION_ROUTE_PREFIX,
     NotifyHub,
     PollPacer,
     chunk_crc,
@@ -61,7 +64,9 @@ from torchft_tpu.serving._wire import (
     fetch_json,
     fetch_notify,
     latest_descriptor,
+    newer_than_held,
     notify_enabled,
+    same_stream,
     serve_notify,
     validate_latest,
 )
@@ -97,6 +102,9 @@ class _RelayVersion:
         "ts",
         "depth",
         "origin_ts",
+        "pub_seq",
+        "pub_id",
+        "tree_token",
     )
 
     def __init__(
@@ -112,6 +120,9 @@ class _RelayVersion:
         ts: float,
         depth: int = 1,
         origin_ts: Optional[float] = None,
+        pub_seq: Optional[int] = None,
+        pub_id: Optional[str] = None,
+        tree_token: Optional[str] = None,
     ) -> None:
         self.step = step
         self.quorum_id = quorum_id
@@ -127,6 +138,12 @@ class _RelayVersion:
         # ORIGIN publication time, preserved across tiers — the
         # publish-to-edge propagation reference.
         self.origin_ts = origin_ts if origin_ts is not None else ts
+        # Origin publication stream identity + sequence (retraction
+        # ordering) and the treedef token (readers' /meta-skip key) —
+        # all preserved verbatim across tiers.
+        self.pub_seq = pub_seq
+        self.pub_id = pub_id
+        self.tree_token = tree_token
 
     def manifest(self) -> Dict[str, Any]:
         return {
@@ -137,6 +154,7 @@ class _RelayVersion:
             "chunk_sizes": self.chunk_sizes,
             "num_chunks": len(self.chunk_crcs),
             "digest": self.digest,
+            "tree_token": self.tree_token,
         }
 
 
@@ -174,6 +192,13 @@ class CachingRelay:
         self._jitter_seed = jitter_seed
         self._lock = threading.Lock()
         self._current: Optional[_RelayVersion] = None
+        # Resident version ring (torchft_tpu/history.py): the last K
+        # adopted versions stay servable from relay RAM — pinned
+        # (/serving/version/{step}) and latest-1 reads at the edge, and
+        # the retraction path's V-1 fallback without a re-pull.
+        self._versions = StagedVersionStore(
+            max_versions=DEFAULT_SERVING_VERSIONS, ring="relay"
+        )
         self._stop = threading.Event()
         self.dead = False
         # Downstream long-poll edge: subscribers/child relays park here.
@@ -210,14 +235,41 @@ class CachingRelay:
                     return
                 version = relay.current()
                 if split.path == NOTIFY_ROUTE:
-                    serve_notify(self, split.query, relay._hub, relay._descriptor)
+                    serve_notify(
+                        self,
+                        split.query,
+                        relay._hub,
+                        relay._descriptor,
+                        manifest_at=relay._manifest_at,
+                    )
                     return
-                if split.path == LATEST_ROUTE:
+                if split.path in (LATEST_ROUTE, LATEST_PREV_ROUTE) or (
+                    split.path.startswith(VERSION_ROUTE_PREFIX)
+                ):
+                    if split.path == LATEST_ROUTE:
+                        label = "latest"
+                    elif split.path == LATEST_PREV_ROUTE:
+                        label = "latest-1"
+                        version = relay.latest_prev()
+                    else:
+                        label = "version"
+                        try:
+                            want = int(split.path[len(VERSION_ROUTE_PREFIX):])
+                        except ValueError:
+                            self.send_error(400, "bad version step")
+                            return
+                        if relay._versions.is_retracted(want):
+                            metrics.inc("tpuft_history_retracted_reads_total")
+                            self.send_error(
+                                410, f"version {want} was retracted"
+                            )
+                            return
+                        version = relay._version_for(want)
                     if version is None:
-                        self.send_error(404, "no version cached yet")
+                        self.send_error(404, "no such version cached")
                         return
                     body = json.dumps(relay._descriptor(version)).encode()
-                    metrics.inc("tpuft_serving_requests_total", route="latest")
+                    metrics.inc("tpuft_serving_requests_total", route=label)
                     self.send_response(200)
                     self.send_header("Content-Type", "application/json")
                     self.send_header("Content-Length", str(len(body)))
@@ -234,6 +286,14 @@ class CachingRelay:
                     self.send_error(400, "bad step")
                     return
                 if version is None or version.step != step:
+                    # Pinned/lagging readers: chunk bytes for any RESIDENT
+                    # ring version are servable, not just the newest.
+                    version = relay._version_for(step)
+                if version is None:
+                    if relay._versions.is_retracted(step):
+                        metrics.inc("tpuft_history_retracted_reads_total")
+                        self.send_error(410, f"version {step} was retracted")
+                        return
                     # No waiting: a reader racing a version bump retries
                     # its poll against the new descriptor instead of
                     # parking a relay thread.
@@ -299,12 +359,37 @@ class CachingRelay:
         with self._lock:
             return self._current
 
+    def latest_prev(self) -> Optional[_RelayVersion]:
+        """The previous resident ring version (``latest-1``)."""
+        steps = self._versions.latest_steps(2)
+        if len(steps) < 2:
+            return None
+        payload = self._versions.get(steps[1])
+        return payload if isinstance(payload, _RelayVersion) else None
+
+    def _version_for(self, step: int) -> Optional[_RelayVersion]:
+        """A resident ring version for exactly ``step`` (pinned reads and
+        lagging chunk fetches), or None."""
+        current = self.current()
+        if current is not None and current.step == step:
+            return current
+        payload = self._versions.get(step)
+        return payload if isinstance(payload, _RelayVersion) else None
+
+    def _manifest_at(self, step: int) -> Optional[Dict[str, Any]]:
+        """Manifest lookup for the delta-aware notify body (the changed-
+        chunk set vs a parked client's held version)."""
+        version = self._version_for(step)
+        return version.manifest() if version is not None else None
+
     def _descriptor(
         self, version: Optional[_RelayVersion] = None
     ) -> Optional[Dict[str, Any]]:
         """The ``/serving/latest`` body for ``version`` (default: the
         held one): this relay's address as the chunk base, its tree
-        depth, and the preserved origin publication time."""
+        depth, the preserved origin publication time, and the origin
+        publication stream identity/sequence (retraction ordering rides
+        the tree unchanged)."""
         version = version if version is not None else self.current()
         if version is None:
             return None
@@ -314,6 +399,8 @@ class CachingRelay:
             published_ts=version.ts,
             depth=version.depth,
             origin_ts=version.origin_ts,
+            pub_seq=version.pub_seq,
+            pub_id=version.pub_id,
         )
 
     def _consume_fault(self) -> bool:
@@ -370,7 +457,11 @@ class CachingRelay:
                 # after=-1 before the first adoption: an upstream that has
                 # (or gets) ANY version wakes us — tree bring-up rides the
                 # push edge too, tier by tier.
-                outcome = self._wait_notify(cur.step if cur is not None else -1)
+                outcome = self._wait_notify(
+                    cur.step if cur is not None else -1,
+                    after_seq=cur.pub_seq if cur is not None else None,
+                    after_pub=cur.pub_id if cur is not None else None,
+                )
                 if outcome is not None:
                     # Long-poll round completed: an upstream pushed a new
                     # descriptor (loop pulls it NOW — the ~RTT/hop
@@ -382,7 +473,12 @@ class CachingRelay:
             if self._stop.wait(pacer.next_delay(failed)):
                 return
 
-    def _wait_notify(self, after: int) -> Any:
+    def _wait_notify(
+        self,
+        after: int,
+        after_seq: Optional[int] = None,
+        after_pub: Optional[str] = None,
+    ) -> Any:
         """One long-poll round against the upstream set: parks on the
         first upstream that speaks ``/serving/notify`` until it announces
         a version newer than ``after`` (returns its descriptor — the
@@ -395,7 +491,8 @@ class CachingRelay:
                 return False
             try:
                 woke = fetch_notify(
-                    upstream, after, self._timeout, token=self._token
+                    upstream, after, self._timeout, token=self._token,
+                    after_seq=after_seq, after_pub=after_pub,
                 )
             except Exception:  # noqa: BLE001 — old/dead upstream: next one
                 metrics.inc("tpuft_serving_upstream_failovers_total")
@@ -461,18 +558,36 @@ class CachingRelay:
             return False
         current = self.current()
         if current is not None:
-            if best["step"] < current.step or (
-                best["step"] == current.step and best["digest"] == current.digest
-            ):
-                return False
             if (
-                best.get("quorum_id") is not None
-                and current.quorum_id is not None
-                and best["quorum_id"] < current.quorum_id
+                best["step"] == current.step
+                and best["digest"] == current.digest
+                and best.get("pub_seq") in (None, current.pub_seq)
             ):
-                # A stale-era survivor must never roll readers back.
-                metrics.inc("tpuft_serving_stale_era_rejects_total")
                 return False
+            stream = same_stream(best, current.pub_seq, current.pub_id)
+            retraction = False
+            if stream:
+                # Same publication stream: seq ordering governs, and a
+                # seq-newer descriptor at a LOWER step is a sanctioned
+                # retraction (adopted below, converging this tier — and
+                # everything downstream — to V-1). Its era is V-1's own,
+                # exempt from the forward-motion fence.
+                if not newer_than_held(
+                    best, current.step, current.pub_seq, current.pub_id
+                ):
+                    return False
+                retraction = int(best["step"]) < current.step
+            if not retraction:
+                if (
+                    best.get("quorum_id") is not None
+                    and current.quorum_id is not None
+                    and best["quorum_id"] < current.quorum_id
+                ):
+                    # A stale-era survivor must never roll readers back.
+                    metrics.inc("tpuft_serving_stale_era_rejects_total")
+                    return False
+                if not stream and best["step"] <= current.step:
+                    return False
         self._pull(best, sources or [best["base"]], previous=current)
         return True
 
@@ -532,12 +647,26 @@ class CachingRelay:
             ts=time.time(),
             depth=depth,
             origin_ts=latest.get("origin_ts"),
+            pub_seq=latest.get("pub_seq"),
+            pub_id=latest.get("pub_id"),
+            tree_token=latest.get("tree_token"),
         )
+        retraction = previous is not None and step <= previous.step
         with self._lock:
             self._current = version
+        self._versions.put(step, version, sum(sizes))
+        if retraction:
+            # A sanctioned rollback (seq-newer at a lower step — the
+            # ordering gate upstream already proved it): resident ring
+            # versions past the survivor are dropped, so this tier serves
+            # no retracted version to pinned readers either — converged,
+            # never a torn mix.
+            self._versions.drop_newer(step, retracted=True)
+            metrics.inc("tpuft_serving_retraction_adoptions_total")
+            tracing.record("version_retracted", step=previous.step, survivor=step)
         # Swap first, THEN wake the long-poll edge: a woken waiter always
         # reads the fully verified version.
-        self._hub.announce(step)
+        self._hub.announce(step, seq=latest.get("pub_seq"))
         metrics.inc("tpuft_serving_pulls_total")
         if reused:
             metrics.inc("tpuft_serving_delta_chunks_reused_total", reused)
@@ -606,8 +735,17 @@ class CachingRelay:
 
 
 def _newer(a: Dict[str, Any], b: Dict[str, Any]) -> bool:
-    """Version ordering across candidate descriptors: quorum era first
-    (never prefer a stale-era survivor), then step."""
+    """Version ordering across candidate descriptors: same publication
+    stream orders by sequence (a retraction outranks the retracted step),
+    else quorum era first (never prefer a stale-era survivor), then
+    step."""
+    if (
+        a.get("pub_id") is not None
+        and a.get("pub_id") == b.get("pub_id")
+        and a.get("pub_seq") is not None
+        and b.get("pub_seq") is not None
+    ):
+        return int(a["pub_seq"]) > int(b["pub_seq"])
     era_a = a.get("quorum_id")
     era_b = b.get("quorum_id")
     if era_a is not None and era_b is not None and era_a != era_b:

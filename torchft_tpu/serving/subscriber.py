@@ -8,7 +8,12 @@ the newest *fully verified* version:
   per-chunk CRCs (checked before any transfer);
 - the pickled ``/meta`` must carry the SAME digest (the torn-read fence:
   a version bump between the descriptor fetch and the meta fetch changes
-  the digest, aborting this poll instead of mixing versions);
+  the digest, aborting this poll instead of mixing versions) — UNLESS
+  the descriptor's ``tree_token`` matches the reader's cached treedef,
+  in which case the ``/meta`` RTT is skipped entirely on sparse bumps
+  (``tpuft_serving_meta_fetches_skipped_total``): every adopted chunk
+  still verifies against the descriptor's digest-bound CRCs, so the
+  fence moves, it never weakens;
 - every chunk verifies against its CRC and size before decode;
 - only then does :meth:`current` flip to the new
   :class:`ServingVersion` — a reader can never observe a torn, partially
@@ -17,20 +22,35 @@ the newest *fully verified* version:
 
 Era discipline: a descriptor whose quorum era regresses below the held
 version's is a stale-era read and is rejected
-(``tpuft_serving_stale_era_rejects_total``); steps are monotone.
+(``tpuft_serving_stale_era_rejects_total``). Version ordering is the
+publication sequence (``pub_seq``) when both sides carry one — which is
+how a deliberate RETRACTION (step decreases, seq increases) converges
+readers to V-1 (``tpuft_serving_retraction_adoptions_total``) while a
+stale endpoint still cannot roll anyone back — and step order against
+pre-history servers.
+
+Pinned reads (the history ring's read surface): construct with
+``pin=<step>`` to follow exactly one version via
+``/serving/version/{step}`` (adoption REFUSES any other step —
+``tpuft_serving_wrong_version_rejects_total``; a 410 marks the pin
+retracted, see :attr:`pin_retracted`), or ``pin="latest-1"`` to trail
+the newest version by one (canary baseline).
 
 Delta-aware: decoded chunks are cached per index with their ``(crc,
 size)``; a version bump re-decodes (and re-fetches) only chunks that
-actually changed — the reader-side twin of the relay's delta pull.
+actually changed — including across SKIPPED versions (a reader that
+held V-2 moves only the chunks that changed since V-2;
+``tpuft_history_delta_chain_hops_total`` counts the crossed versions).
 
 Push-aware: :meth:`WeightSubscriber.wait_for_update` parks a long-poll
 ``/serving/notify`` request at an endpoint (bounded hold, see
 _wire.fetch_notify) and polls the moment a newer version is announced —
 adoption latency becomes a wire RTT, not a poll interval. The delivered
 descriptor is never trusted: the identical verify-then-swap pipeline
-runs on every adoption, push or poll. :meth:`watch` is the reader loop
-(notify-first, deterministic-jittered poll with exponential backoff as
-the fallback — the fallback path must not thundering-herd either).
+runs on every adoption, push or poll (its advisory ``changed_chunks``
+body can save a fetch, never corrupt one). :meth:`watch` is the reader
+loop (notify-first, deterministic-jittered poll with exponential backoff
+as the fallback — the fallback path must not thundering-herd either).
 
 Multi-tenant: a reader constructed with a bearer ``token`` sends it on
 every serving fetch; the serve seams charge its bytes to its tenant's
@@ -43,8 +63,9 @@ import io
 import logging
 import threading
 import time
+import urllib.error
 from dataclasses import dataclass
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 
 import jax
 
@@ -52,13 +73,17 @@ from torchft_tpu import metrics
 from torchft_tpu._safe_pickle import safe_loads
 from torchft_tpu.checkpointing import _serialization
 from torchft_tpu.serving._wire import (
+    LATEST_PREV_ROUTE,
     LATEST_ROUTE,
+    VERSION_ROUTE_PREFIX,
     PollPacer,
     chunk_crc,
     fetch_bytes,
     fetch_json,
     fetch_notify,
+    newer_than_held,
     notify_enabled,
+    same_stream,
     validate_latest,
 )
 from torchft_tpu.serving.relay import serving_poll_sec
@@ -90,6 +115,8 @@ class ServingVersion:
     digest: str
     params: Any
     ts: float
+    pub_seq: Optional[int] = None
+    pub_id: Optional[str] = None
 
 
 class WeightSubscriber:
@@ -103,13 +130,26 @@ class WeightSubscriber:
         notify: Optional[bool] = None,
         poll_interval: Optional[float] = None,
         jitter_seed: Optional[int] = None,
+        pin: Optional[Union[int, str]] = None,
     ) -> None:
         if not endpoints:
             raise ValueError("WeightSubscriber needs at least one endpoint")
+        if pin is not None and not (
+            isinstance(pin, int) or pin == "latest-1"
+        ):
+            raise ValueError(
+                f"pin must be a step (int) or 'latest-1', got {pin!r}"
+            )
         self._endpoints = list(endpoints)
         self._timeout = timeout
         self._token = token
-        self._notify = notify if notify is not None else notify_enabled()
+        self._pin = pin
+        # Pinned-step readers have a FIXED target: push notifications
+        # announce newer versions, which is exactly what a pin ignores.
+        self._notify = (
+            (notify if notify is not None else notify_enabled())
+            and not isinstance(pin, int)
+        )
         self._pacer = PollPacer(
             poll_interval if poll_interval is not None else serving_poll_sec(),
             seed=jitter_seed if jitter_seed is not None else _next_seed(),
@@ -117,6 +157,13 @@ class WeightSubscriber:
         self._version: Optional[ServingVersion] = None
         # chunk index -> (crc, size, decoded chunk dict): the delta cache.
         self._chunk_cache: Dict[int, Tuple[int, int, Any]] = {}
+        # tree_token -> treedef: the /meta-skip cache (sparse bumps reuse
+        # the verified structure instead of paying the meta RTT).
+        self._treedef_cache: Optional[Tuple[str, Any]] = None
+        # A pinned step answered 410: the version was deliberately
+        # retracted — the caller re-pins (e.g. to latest-1) instead of
+        # polling a tombstone forever.
+        self.pin_retracted = False
         # Round outcome flags for watch(): did the last wait_for_update
         # park a full quiet hold (no pacing needed), and did the last
         # poll actually FAIL (backoff) vs merely find nothing new
@@ -153,11 +200,14 @@ class WeightSubscriber:
             return self.poll()
         held = self._version
         after = held.step if held is not None else -1
+        after_seq = held.pub_seq if held is not None else None
+        after_pub = held.pub_id if held is not None else None
         for _ in range(len(self._endpoints)):
             endpoint = self._endpoints[0]
             try:
                 descriptor = fetch_notify(
-                    endpoint, after, self._timeout, token=self._token, hold=hold
+                    endpoint, after, self._timeout, token=self._token,
+                    hold=hold, after_seq=after_seq, after_pub=after_pub,
                 )
             except Exception:  # noqa: BLE001 — endpoint dead or notify-less
                 self._endpoints.append(self._endpoints.pop(0))
@@ -208,13 +258,31 @@ class WeightSubscriber:
             if stop.wait(self._pacer.next_delay(failed=self._last_poll_failed)):
                 return
 
+    def _discovery_route(self) -> str:
+        if isinstance(self._pin, int):
+            return f"{VERSION_ROUTE_PREFIX}{self._pin}"
+        if self._pin == "latest-1":
+            return LATEST_PREV_ROUTE
+        return LATEST_ROUTE
+
     def _fetch_latest(self) -> Optional[Dict[str, Any]]:
+        route = self._discovery_route()
         for _ in range(len(self._endpoints)):
             endpoint = self._endpoints[0]
             try:
                 return fetch_json(
-                    f"{endpoint}{LATEST_ROUTE}", self._timeout, token=self._token
+                    f"{endpoint}{route}", self._timeout, token=self._token
                 )
+            except urllib.error.HTTPError as e:
+                if e.code == 410 and isinstance(self._pin, int):
+                    # The pinned version was deliberately retracted: this
+                    # is an ANSWER, not an endpoint failure — surface it
+                    # instead of rotating through the fleet forever.
+                    self.pin_retracted = True
+                    metrics.inc("tpuft_serving_wrong_version_rejects_total")
+                    return None
+                self._endpoints.append(self._endpoints.pop(0))
+                metrics.inc("tpuft_serving_reader_failovers_total")
             except Exception:  # noqa: BLE001 — fail over to the next endpoint
                 # Rotate so a dead endpoint stops being everyone's first
                 # try; it heals back in naturally once others fail.
@@ -238,36 +306,73 @@ class WeightSubscriber:
             return None
         held = self._version
         step = int(latest["step"])
+        if isinstance(self._pin, int) and step != self._pin:
+            # Pinned readers adopt EXACTLY their pin — any other version
+            # offered under the pinned route is refused outright.
+            metrics.inc("tpuft_serving_wrong_version_rejects_total")
+            return None
+        retraction = False
         if held is not None:
-            if step <= held.step:
-                return None
-            if (
-                latest.get("quorum_id") is not None
-                and held.quorum_id is not None
-                and latest["quorum_id"] < held.quorum_id
-            ):
-                metrics.inc("tpuft_serving_stale_era_rejects_total")
-                return None
+            if step == held.step and latest["digest"] == held.digest:
+                return None  # identical version (possibly re-announced)
+            stream = same_stream(latest, held.pub_seq, held.pub_id)
+            if stream:
+                # Same publication stream: seq ordering governs, and a
+                # seq-newer descriptor at a LOWER step is a sanctioned
+                # rollback (retraction re-announced V-1) — its era is
+                # V-1's own, exempt from the regression fence below.
+                if not newer_than_held(latest, held.step, held.pub_seq, held.pub_id):
+                    return None
+                retraction = step < held.step
+            if not retraction:
+                # Era fence (all forward motion, same stream or not): a
+                # stale-era survivor announcing a higher step must never
+                # roll readers back across quorum eras.
+                if (
+                    latest.get("quorum_id") is not None
+                    and held.quorum_id is not None
+                    and latest["quorum_id"] < held.quorum_id
+                ):
+                    metrics.inc("tpuft_serving_stale_era_rejects_total")
+                    return None
+                if not stream and step <= held.step:
+                    return None
         base: str = latest["base"]
         algo: str = latest["crc_algo"]
         crcs: List[int] = [int(c) for c in latest["chunk_crcs"]]
         sizes: List[int] = [int(s) for s in latest["chunk_sizes"]]
-        meta = safe_loads(
-            fetch_bytes(
-                f"{base}/checkpoint/{step}/meta", self._timeout, token=self._token
-            )
-        )
+        token = latest.get("tree_token")
+        treedef = None
         if (
-            not isinstance(meta, dict)
-            or meta.get("step") != step
-            or meta.get("digest") != latest["digest"]
+            token
+            and self._treedef_cache is not None
+            and self._treedef_cache[0] == token
         ):
-            # The serving side moved on between our descriptor and meta
-            # fetches — abort THIS poll; the next one sees a consistent
-            # pair. This is the fence that makes torn reads structurally
-            # impossible.
-            return None
-        treedef = meta["treedef"]
+            # Sparse bump, unchanged structure: skip the /meta RTT. The
+            # adopted bytes still verify chunk-by-chunk against the
+            # descriptor's digest-bound CRCs, so the torn-read fence
+            # holds — it just no longer costs a round trip.
+            treedef = self._treedef_cache[1]
+            metrics.inc("tpuft_serving_meta_fetches_skipped_total")
+        else:
+            meta = safe_loads(
+                fetch_bytes(
+                    f"{base}/checkpoint/{step}/meta", self._timeout, token=self._token
+                )
+            )
+            if (
+                not isinstance(meta, dict)
+                or meta.get("step") != step
+                or meta.get("digest") != latest["digest"]
+            ):
+                # The serving side moved on between our descriptor and meta
+                # fetches — abort THIS poll; the next one sees a consistent
+                # pair. This is the fence that makes torn reads structurally
+                # impossible.
+                return None
+            treedef = meta["treedef"]
+            if token:
+                self._treedef_cache = (token, treedef)
         new_cache: Dict[int, Tuple[int, int, Any]] = {}
         fetched_bytes = 0
         saved = 0
@@ -300,12 +405,32 @@ class WeightSubscriber:
             digest=latest["digest"],
             params=params,
             ts=time.time(),
+            pub_seq=latest.get("pub_seq"),
+            pub_id=latest.get("pub_id"),
         )
         # The swap is the adoption point: everything above verified.
         self._version = version
         self._chunk_cache = new_cache
         metrics.inc("tpuft_serving_reader_versions_total")
         metrics.inc("tpuft_serving_reader_bytes_total", fetched_bytes)
+        if retraction:
+            metrics.inc("tpuft_serving_retraction_adoptions_total")
+        if (
+            saved
+            and held is not None
+            and held.pub_seq is not None
+            and version.pub_seq is not None
+            and version.pub_id == held.pub_id
+            and version.pub_seq - held.pub_seq > 1
+        ):
+            # Delta CHAIN: this adoption crossed several published
+            # versions (the reader lagged / was pinned / slept) yet still
+            # moved only the chunks that changed since its held version —
+            # strictly fewer bytes than a full refetch.
+            metrics.inc(
+                "tpuft_history_delta_chain_hops_total",
+                version.pub_seq - held.pub_seq,
+            )
         origin_ts = latest.get("origin_ts")
         if origin_ts is not None:
             # Publish-to-reader propagation (origin_ts is preserved
