@@ -92,7 +92,14 @@ class ReaderPool:
         n: int,
         timeout: float = 5.0,
         retraction_aware: bool = False,
+        value_rtol: float = 0.0,
     ) -> None:
+        # value_rtol > 0: the quantized-reader leg — adopted values must
+        # be codec-close to the published step, not bit-equal (constant
+        # leaves quantize near-exactly; the tolerance covers f32 scale
+        # rounding). Torn reads still show as a WRONG step's value, far
+        # outside any codec tolerance.
+        self._value_rtol = value_rtol
         self.stop = threading.Event()
         self.adoptions = 0
         self.retraction_adoptions = 0
@@ -128,10 +135,18 @@ class ReaderPool:
                 and version.pub_id == last.pub_id
                 and version.pub_seq > last.pub_seq
             )
+            if self._value_rtol:
+                clean = all(
+                    abs(v - float(version.step))
+                    <= self._value_rtol * max(1.0, float(version.step))
+                    for v in values
+                )
+            else:
+                clean = values == {float(version.step)}
             with self._lock:
                 self.adoptions += 1
                 self.observed_steps.add(version.step)
-                if values != {float(version.step)}:
+                if not clean:
                     self.bad.append(("torn", version.step, sorted(values)))
                 if version.step <= last_step:
                     if sanctioned:
@@ -204,6 +219,94 @@ def leg_reader_curve(args) -> List[Dict]:
             relay.shutdown(wait=False)
             pub.shutdown(wait=False)
     return results
+
+
+def leg_quantized(args) -> Dict:
+    """Quantized-reader leg (ISSUE-14): the reader-chase harness with
+    TPUFT_SERVING_CODEC=int8 — publisher stages encoded chunks, the
+    relay fans the encoded bytes out verbatim, readers verify-then-
+    decode. Reports adoptions/s and verified MB/s at int8 plus the
+    counter-exact encoded-byte reduction (tpuft_codec_*)."""
+    import os
+
+    os.environ["TPUFT_SERVING_CODEC"] = "int8"
+    n_readers = 8
+    try:
+        pub = WeightPublisher(num_chunks=args.chunks, timeout=5.0)
+        relay = CachingRelay([pub.address()], poll_interval=0.02, timeout=5.0)
+        try:
+            pre0 = counter_labeled(
+                "tpuft_codec_bytes_pre_total", wire="serving", codec="int8"
+            )
+            post0 = counter_labeled(
+                "tpuft_codec_bytes_post_total", wire="serving", codec="int8"
+            )
+            bytes0 = counter("tpuft_serving_reader_bytes_total")
+            step = 1
+            pub.publish(
+                step=step, quorum_id=0,
+                state=state_for(step, args.leaves, args.leaf_kb),
+            )
+            assert pub.latest().get("chunk_codecs") == ["int8"] * args.chunks
+            time.sleep(0.1)
+            pool = ReaderPool(
+                [relay.address()], n_readers, value_rtol=1e-3
+            ).start()
+            t0 = time.perf_counter()
+            deadline = t0 + args.leg_seconds
+            while time.perf_counter() < deadline:
+                step += 1
+                pub.publish(
+                    step=step, quorum_id=0,
+                    state=state_for(step, args.leaves, args.leaf_kb),
+                )
+                time.sleep(args.bump_interval)
+            wall = time.perf_counter() - t0
+            pool.finish()
+            fetched = counter("tpuft_serving_reader_bytes_total") - bytes0
+            pre = (
+                counter_labeled(
+                    "tpuft_codec_bytes_pre_total", wire="serving", codec="int8"
+                )
+                - pre0
+            )
+            post = (
+                counter_labeled(
+                    "tpuft_codec_bytes_post_total", wire="serving", codec="int8"
+                )
+                - post0
+            )
+            assert not pool.bad, pool.bad[:5]
+            raw_version = args.leaves * args.leaf_kb * 1024
+            result = {
+                "codec": "int8",
+                "readers": n_readers,
+                "versions_published": step - 1,
+                "adoptions": pool.adoptions,
+                "adoptions_per_sec": round(pool.adoptions / wall, 2),
+                "verified_mb_per_sec": round(fetched / wall / 1e6, 2),
+                "raw_version_bytes": raw_version,
+                "encoded_bytes_pre": int(pre),
+                "encoded_bytes_post": int(post),
+                "encoded_reduction_x": round(pre / post, 2) if post else None,
+                "bad_observations": len(pool.bad),
+                "bitwise_note": (
+                    "readers adopt decode(encode(state)) — per-reader "
+                    "determinism pinned by tests/test_wire_codec.py "
+                    "(quantized publisher->relay->subscriber drill)"
+                ),
+            }
+            print(f"[serving_bench] quantized: {result}", flush=True)
+            return result
+        finally:
+            relay.shutdown(wait=False)
+            pub.shutdown(wait=False)
+    finally:
+        del os.environ["TPUFT_SERVING_CODEC"]
+
+
+def counter_labeled(name: str, **labels) -> float:
+    return metrics.counter_total(name, **labels)
 
 
 def leg_delta(args) -> Dict:
@@ -743,6 +846,7 @@ def main() -> None:
             "box": "1-core container; relay+readers+publisher share the core",
         },
         "reader_curve": leg_reader_curve(args),
+        "quantized": leg_quantized(args),
         "delta": leg_delta(args),
         "pinned": leg_pinned(args),
         "rollback": leg_rollback(args, fault_file),

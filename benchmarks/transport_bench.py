@@ -537,6 +537,174 @@ def role_pg_receiver(total_bytes: int, store_addr: str) -> None:
 # ---------------------------------------------------------------------------
 
 
+def role_quant_donor(total_bytes: int, num_chunks: int) -> None:
+    """Donor of the quantized-heal leg: stages the synth state with
+    TPUFT_HEAL_CODEC (set by the parent) — the staged chunks are the
+    ENCODED bytes, CRC'd as such — and serves until signaled. Emits the
+    raw payload size and the encoded staged size (the wire-bytes story)."""
+    _force_cpu()
+    from torchft_tpu.checkpointing.http_transport import HTTPTransport
+
+    state = synth_state(total_bytes)
+    raw = total_payload_bytes(state)
+    donor = HTTPTransport(timeout=600.0, num_chunks=num_chunks)
+    t0 = time.monotonic()
+    donor.send_checkpoint([1], step=7, state_dict=state, timeout=600.0, quorum_id=7)
+    _emit(
+        {
+            "addr": donor.metadata(),
+            "stage_s": round(time.monotonic() - t0, 3),
+            "raw_bytes": int(raw),
+            "encoded_bytes": int(sum(donor._staged.chunk_sizes)),
+            "codec": (donor._staged.chunk_codecs or ["fp32"])[0],
+        }
+    )
+    sys.stdin.readline()
+    donor.shutdown()
+    _emit({"peak_rss": _rss_bytes()})
+
+
+def role_quant_receiver(addrs_csv: str, delta: str) -> None:
+    """Joiner of the quantized-heal leg: striped fetch of ENCODED chunks
+    across every donor, decode after CRC verification. ``delta=stale``
+    passes a stale local state (every 4th leaf changed) so the delta
+    rejoin matches unchanged chunks on the encoded layout and fetches
+    only the rest — striping, delta, and the codec composed in one heal."""
+    _force_cpu()
+    from torchft_tpu import metrics
+    from torchft_tpu.checkpointing.http_transport import HTTPTransport
+
+    addrs = addrs_csv.split(",")
+    total_bytes = int(os.environ["TPUFT_QUANT_BENCH_BYTES"])
+    local_state = None
+    if delta == "stale":
+        # Sparse staleness: ONE layer's weights differ. Round-robin
+        # chunking interleaves leaves across chunks, so a changed leaf
+        # dirties the (few) chunks holding its payload/scales arrays and
+        # every other chunk (crc,size)-matches on the ENCODED layout —
+        # the rejoiner fetches only the dirty chunks' encoded bytes.
+        local_state = synth_state(total_bytes)
+        local_state["layer0"]["w"][:64] = -1.0
+    receiver = HTTPTransport(timeout=600.0)
+    _emit({"event": "recv_start", "t_wall": time.time()})
+    t0 = time.monotonic()
+    received = receiver.recv_checkpoint(
+        0, addrs[0], step=7, timeout=600.0, quorum_id=7, donors=addrs[1:],
+        local_state=local_state,
+    )
+    fetch_s = time.monotonic() - t0
+    receiver.shutdown()
+    # Decode sanity without re-encoding 12 GB: every leaf's unique head
+    # value must survive within the codec's per-block resolution — a
+    # wrong/missing decode would be off by whole leaves, not quanta.
+    # RELATIVE error: the head block's scale grows with the head value
+    # (maxabs/127 for int8), so the absolute quantum does too.
+    max_head_err = 0.0
+    for key, leaves in sorted(received.items()):
+        if key == "step":
+            continue
+        want = float(int(key[5:]) + 1)
+        head = float(np.asarray(leaves["w"]).ravel()[0])
+        max_head_err = max(max_head_err, abs(head - want) / max(want, 1.0))
+    _emit(
+        {
+            "fetch_s": round(fetch_s, 3),
+            "peak_rss": _rss_bytes(),
+            "max_head_err": round(max_head_err, 6),
+            "stripe_chunks": metrics.counter_total("tpuft_heal_stripe_chunks_total"),
+            "stripe_bytes": metrics.counter_total("tpuft_heal_stripe_bytes_total"),
+            "delta_matched_chunks": metrics.counter_total(
+                "tpuft_heal_delta_chunks_matched_total"
+            ),
+            "delta_saved_bytes": metrics.counter_total(
+                "tpuft_heal_delta_bytes_saved_total"
+            ),
+            "checksum_failures": metrics.counter_total(
+                "tpuft_heal_checksum_failures_total"
+            ),
+            "decode_failures": metrics.counter_total(
+                "tpuft_codec_decode_failures_total"
+            ),
+        }
+    )
+
+
+def bench_http_quantized(
+    total_bytes: int,
+    deadline: float,
+    codec: str,
+    num_donors: int = 2,
+    num_chunks: int = 64,
+) -> dict:
+    """Quantized-heal leg: the reference-scale payload staged with
+    ``TPUFT_HEAL_CODEC=codec`` and fetched striped across ``num_donors``
+    donors, twice — a fresh joiner (full encoded fetch) and a stale
+    rejoiner (delta match on the encoded layout). Unpaced: the leg's
+    headline is BYTES moved (counter-exact), with wall time as the
+    1-core box's lower bound."""
+    env = {
+        "TPUFT_HEAL_CODEC": codec,
+        "TPUFT_QUANT_BENCH_BYTES": str(total_bytes),
+    }
+    donors = [
+        _spawn("quant-donor", str(total_bytes), str(num_chunks), env=env)
+        for _ in range(num_donors)
+    ]
+    out: dict = {"codec": codec, "num_donors": num_donors, "num_chunks": num_chunks}
+    try:
+        staged = [_read_json(d, deadline) for d in donors]
+        assert all(s["encoded_bytes"] == staged[0]["encoded_bytes"] for s in staged)
+        out.update(
+            {
+                "raw_bytes": staged[0]["raw_bytes"],
+                "encoded_bytes": staged[0]["encoded_bytes"],
+                "encoded_reduction_x": round(
+                    staged[0]["raw_bytes"] / staged[0]["encoded_bytes"], 2
+                ),
+                "stage_s_max": max(s["stage_s"] for s in staged),
+                "staged_codec": staged[0]["codec"],
+            }
+        )
+        addrs = ",".join(s["addr"] for s in staged)
+        for label, delta in (("fresh_joiner", "none"), ("stale_rejoiner", "stale")):
+            receiver = _spawn("quant-receiver", addrs, delta, env=env)
+            started = _read_json(receiver, deadline)
+            assert started.get("event") == "recv_start", started
+            fetched = _read_json(receiver, deadline)
+            receiver.wait(timeout=30)
+            assert fetched["max_head_err"] < 0.02, fetched  # relative
+            assert fetched["checksum_failures"] == 0
+            assert fetched["decode_failures"] == 0
+            leg = {
+                "heal_s": fetched["fetch_s"],
+                "encoded_bytes_fetched": fetched["stripe_bytes"],
+                "goodput_encoded_gbps": round(
+                    8 * fetched["stripe_bytes"] / 1e9 / fetched["fetch_s"], 2
+                )
+                if fetched["fetch_s"]
+                else None,
+                "receiver_rss_multiple": round(
+                    fetched["peak_rss"] / staged[0]["raw_bytes"], 2
+                ),
+                "max_head_err": fetched["max_head_err"],
+            }
+            if delta == "stale":
+                leg["delta_matched_chunks"] = fetched["delta_matched_chunks"]
+                leg["delta_saved_encoded_bytes"] = fetched["delta_saved_bytes"]
+            out[label] = leg
+        for d in donors:
+            d.stdin.write("done\n")
+            d.stdin.flush()
+        for d in donors:
+            _read_json(d, 60.0)
+            d.wait(timeout=30)
+    finally:
+        for p in donors:
+            if p.poll() is None:
+                p.kill()
+    return out
+
+
 def _spawn(
     role: str, *args: str, env: dict | None = None, nice: int = 0
 ) -> subprocess.Popen:
@@ -902,6 +1070,16 @@ def main() -> None:
         )
         print(json.dumps(quick))
         return
+    if mode == "quantized":
+        # Quantized-heal legs only (ISSUE-14): the 12 GB payload staged
+        # encoded (TPUFT_HEAL_CODEC) and fetched striped, fresh + delta.
+        codec = os.environ.get("TPUFT_HEAL_CODEC") or "int8"
+        quickq: dict = {"payload_gb": gb, "mode": "quantized"}
+        quickq["quantized_heal"] = bench_http_quantized(
+            total, deadline, codec=codec
+        )
+        print(json.dumps(quickq))
+        return
     rss_bound = float(os.environ.get("TPUFT_TRANSPORT_RSS_BOUND", "1.35"))
     # payload == n_big leaves of 32 MiB + small biases; compute exactly.
     n_big = max(total // LEAF_BYTES, 1)
@@ -1087,6 +1265,10 @@ if __name__ == "__main__":
             role_stripe_donor(int(args[0]), int(args[1]))
         elif role == "stripe-receiver":
             role_stripe_receiver(args[0])
+        elif role == "quant-donor":
+            role_quant_donor(int(args[0]), int(args[1]))
+        elif role == "quant-receiver":
+            role_quant_receiver(args[0], args[1])
         elif role == "pg-sender":
             role_pg_sender(int(args[0]), args[1])
         elif role == "pg-receiver":

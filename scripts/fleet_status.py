@@ -197,6 +197,29 @@ def _history_state(snapshot: Dict[str, Any]) -> Optional[str]:
     return f"{versions}v/{nbytes / 1e6:.1f}MB"
 
 
+def _wire_state(snapshot: Dict[str, Any]) -> Optional[str]:
+    """Quantized-wire-plane state from the pushed ``tpuft_codec_wire``
+    gauges: one ``<wire>:<codec>`` cell per wire class that ever staged
+    or decoded encoded bytes (e.g. "heal:int8 zero:fp8"), or None when
+    every wire runs the fp32 default. A fleet whose rows disagree here
+    is running MIXED codecs — exactly the misconfiguration the format-3
+    refusal (and the doctor's codec-negotiation WARN) exists to catch."""
+    entries = (
+        (snapshot.get("metrics") or {}).get("gauges", {}).get("tpuft_codec_wire")
+    )
+    if not entries:
+        return None
+    from torchft_tpu import wire_codec
+
+    cells = []
+    for entry in entries:
+        codec = wire_codec.GAUGE_CODE_CODECS.get(int(entry.get("value", 0)))
+        if codec and codec != "fp32":
+            label = (entry.get("labels") or {}).get("wire", "?")
+            cells.append(f"{label}:{codec}")
+    return " ".join(sorted(cells)) or None
+
+
 def _publish_state(snapshot: Dict[str, Any], now: float) -> Optional[str]:
     """Serving-plane publication state from the pushed gauges: the last
     published step and how stale it is ("s12@3s"), or None when the
@@ -262,6 +285,7 @@ def collect(lighthouse_addr: str, prev: Optional[Dict[str, Any]] = None) -> Dict
                     heals=_counter_total(snap, "tpuft_heals_total"),
                     serve=_serve_state(snap),
                     shard=_shard_state(snap),
+                    wire=_wire_state(snap),
                     publish=_publish_state(snap, now),
                     hist=_history_state(snap),
                     relay=_relay_state(snap),
@@ -305,6 +329,7 @@ _COLUMNS = (
     ("heals", "HEALS"),
     ("serve", "SERVE"),
     ("shard", "SHARD"),
+    ("wire", "WIRE"),
     ("publish", "PUBLISH"),
     ("hist", "HIST"),
     ("relay", "RELAY"),

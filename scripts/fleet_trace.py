@@ -618,6 +618,31 @@ def explain_step(merged: List[Dict[str, Any]], step: int) -> str:
             f"chunk(s) locally ({_fmt_mb(args.get('bytes_saved', 0))} not "
             "fetched)"
         )
+    # Quantized-wire savings: which bulk wires rode a codec this step and
+    # what the encoded bytes were (codec_wire carries the exact pre/post
+    # pair; codec_stage/codec_decode mark the heal/serving seams).
+    for e in at_step:
+        if e["name"] != "codec_wire":
+            continue
+        args = e.get("args") or {}
+        pre = float(args.get("pre_bytes", 0.0))
+        post = float(args.get("post_bytes", 0.0)) or 1.0
+        lines.append(
+            f"codec: {proc_label(proc_key(e))} {args.get('wire', '?')} wire "
+            f"rode {args.get('codec', '?')} — {_fmt_mb(pre)} -> "
+            f"{_fmt_mb(post)} ({pre / post:.1f}x fewer bytes)"
+        )
+    for e in at_step:
+        if e["name"] not in ("codec_stage", "codec_decode"):
+            continue
+        args = e.get("args") or {}
+        verb = "staged" if e["name"] == "codec_stage" else "decoded"
+        lines.append(
+            f"codec: {proc_label(proc_key(e))} {verb} "
+            f"{_fmt_mb(args.get('encoded_bytes', 0))} of "
+            f"{args.get('codec', '?')}-encoded {args.get('wire', '?')} "
+            "chunks"
+        )
     # Serving plane: publications (and rollback retractions) at this step.
     for e in at_step:
         if e["name"] != "publish":

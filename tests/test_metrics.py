@@ -528,6 +528,21 @@ def test_fleet_status_render_and_extractors() -> None:
     assert fleet_status._shard_state({"metrics": {"gauges": {}}}) is None
     # Storm gauge feeding the JOINERS column.
     assert fleet_status._gauge(snap, "tpuft_heal_storm_joiners") == 2.0
+    # Quantized-wire column: per-wire-class codec cells from the
+    # tpuft_codec_wire gauges; None when every wire is fp32/absent.
+    wire_snap = {
+        "metrics": {
+            "gauges": {
+                "tpuft_codec_wire": [
+                    {"labels": {"wire": "heal"}, "value": 2.0},   # int8
+                    {"labels": {"wire": "zero"}, "value": 1.0},   # fp8
+                    {"labels": {"wire": "serving"}, "value": 0.0},  # fp32
+                ]
+            }
+        }
+    }
+    assert fleet_status._wire_state(wire_snap) == "heal:int8 zero:fp8"
+    assert fleet_status._wire_state({"metrics": {"gauges": {}}}) is None
     # History rings feeding the HIST column: versions + bytes summed
     # across this process's rings (state + staged + relay).
     hist_snap = {
@@ -574,8 +589,8 @@ def test_fleet_status_render_and_extractors() -> None:
     assert "quorum_id=3" in lines[0] and "replicas=2" in lines[0]
     assert lines[1].split() == [
         "REPLICA", "RANK", "STEP", "STEP/S", "COMMITS", "FAILED", "HEALS",
-        "SERVE", "SHARD", "PUBLISH", "HIST", "RELAY", "LAG", "LAST", "COMMIT",
-        "HEALING", "JOINERS", "HB", "AGE", "MS", "PUSH", "AGE",
+        "SERVE", "SHARD", "WIRE", "PUBLISH", "HIST", "RELAY", "LAG", "LAST",
+        "COMMIT", "HEALING", "JOINERS", "HB", "AGE", "MS", "PUSH", "AGE",
     ]
     assert "train_0:uuid" in text and "1.25" in text and "1.0s" in text
     # The dead replica renders dashes, not a crash.

@@ -18,7 +18,10 @@ Heavier pieces import from their modules: ``torchft_tpu.local_sgd`` (LocalSGD,
 DiLoCo), ``torchft_tpu.zero`` (ZeroOptimizer — cross-replica optimizer-state
 sharding, docs/zero.md), ``torchft_tpu.serving`` (the committed-weights
 serving plane — WeightPublisher/CachingRelay/WeightSubscriber,
-docs/serving.md), ``torchft_tpu.tracing`` (the fleet trace plane —
+docs/serving.md), ``torchft_tpu.wire_codec`` (the quantized wire plane —
+codec-tagged heal/serving chunks and the fp8/int8/int4 ZeRO wire,
+``TPUFT_HEAL_CODEC``/``TPUFT_SERVING_CODEC``/``TPUFT_ZERO_CODEC``,
+default fp32 bit-for-bit), ``torchft_tpu.tracing`` (the fleet trace plane —
 per-process step-event journals merged by scripts/fleet_trace.py,
 docs/observability.md), ``torchft_tpu.parallel.mesh`` (FTMesh/HSDP),
 ``torchft_tpu.models``, ``torchft_tpu.checkpointing``, ``torchft_tpu.ops``.

@@ -91,7 +91,7 @@ from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 import jax
 
-from torchft_tpu import metrics, tracing
+from torchft_tpu import metrics, tracing, wire_codec
 from torchft_tpu._safe_pickle import safe_loads
 from torchft_tpu.utils import faultinject, netem
 from torchft_tpu.checkpointing import _serialization
@@ -250,15 +250,25 @@ class _CRCWriter:
         self.crc = self._update(self.crc, data)
 
 
-def _checkpoint_digest(step: int, algo: str, chunk_crcs: List[int]) -> str:
+def _checkpoint_digest(
+    step: int,
+    algo: str,
+    chunk_crcs: List[int],
+    chunk_codecs: Optional[List[str]] = None,
+) -> str:
     """Whole-checkpoint digest binding the per-chunk checksums to (step,
-    algo). Deliberately quorum-era independent: committed state at a step
-    is bitwise identical across donors and eras, which is exactly what
-    makes cross-donor resume valid."""
+    algo) — and, when the stage is codec-encoded, the per-chunk codec
+    tags, so a tampered/lying tag in ``/meta`` breaks the digest binding
+    before any payload transfer. Deliberately quorum-era independent:
+    committed state at a step is bitwise identical across donors and
+    eras, which is exactly what makes cross-donor resume valid. With
+    ``chunk_codecs`` None/all-fp32 the binding is byte-identical to the
+    pre-codec format (old metas verify unchanged)."""
     h = hashlib.sha256()
-    h.update(
-        f"{step}:{algo}:{','.join(str(c) for c in chunk_crcs)}".encode()
-    )
+    binding = f"{step}:{algo}:{','.join(str(c) for c in chunk_crcs)}"
+    if chunk_codecs and any(c != "fp32" for c in chunk_codecs):
+        binding += f":codecs={','.join(chunk_codecs)}"
+    h.update(binding.encode())
     return h.hexdigest()
 
 
@@ -430,6 +440,7 @@ class _Staged:
         treedef: Any,
         quorum_id: Optional[int] = None,
         parts: Optional[Dict[str, int]] = None,
+        codec: Optional[str] = None,
     ) -> None:
         self.step = step
         self.chunks = chunks  # List[_serialization.Prepared]
@@ -441,12 +452,20 @@ class _Staged:
             for name, index in (parts or {}).items()
         }
         self.chunk_sizes = [int(chunk.total_size) for chunk in chunks]
+        # CRCs (and the digest below) are computed over the ENCODED bytes
+        # when a wire codec staged this checkpoint: integrity, delta
+        # matching, and stripe reassignment all operate on what actually
+        # crosses the wire. None = fp32 passthrough, bit-for-bit the
+        # pre-codec format.
+        self.chunk_codecs = wire_codec.chunk_codecs_for(len(chunks), codec)
         self.chunk_crcs: List[int] = []
         for chunk in chunks:
             w = _CRCWriter(_CRC_UPDATERS[_CRC_ALGO])
             _serialization.write_prepared(chunk, w)
             self.chunk_crcs.append(w.crc)
-        self.digest = _checkpoint_digest(step, self.crc_algo, self.chunk_crcs)
+        self.digest = _checkpoint_digest(
+            step, self.crc_algo, self.chunk_crcs, self.chunk_codecs
+        )
         self.tree_token = _tree_token(treedef)
 
     def meta_bytes(self) -> bytes:
@@ -460,6 +479,7 @@ class _Staged:
             digest=self.digest,
             parts=self.parts,
             chunk_sizes=self.chunk_sizes,
+            chunk_codecs=self.chunk_codecs,
         )
 
 
@@ -473,6 +493,7 @@ def _meta_bytes(
     digest: str,
     parts: Optional[Dict[str, Dict[str, int]]] = None,
     chunk_sizes: Optional[List[int]] = None,
+    chunk_codecs: Optional[List[str]] = None,
 ) -> bytes:
     """The exact ``/meta`` response body. Built once per stage in BOTH
     serve modes (the serving child receives these bytes pre-pickled over
@@ -480,21 +501,31 @@ def _meta_bytes(
     unpickle a treedef, so it never needs jax). ``parts`` maps heal-part
     name -> {"chunk", "nbytes"} so a joiner can address (or skip) exactly
     one part's payload; ``chunk_sizes`` lets the stripe planner balance
-    donors by bytes and pins the reassigned-remainder accounting exactly."""
-    return pickle.dumps(
-        {
-            "format": 2,
-            "num_chunks": num_chunks,
-            "treedef": treedef,
-            "step": step,
-            "quorum_id": quorum_id,
-            "crc_algo": crc_algo,
-            "chunk_crcs": chunk_crcs,
-            "digest": digest,
-            "parts": parts or {},
-            "chunk_sizes": chunk_sizes,
-        }
-    )
+    donors by bytes and pins the reassigned-remainder accounting exactly.
+
+    ``chunk_codecs`` (the quantized wire plane) bumps the format to 3:
+    every chunk's bytes are codec-encoded (fp8/int8/int4) and the tags
+    are digest-bound. A codec-less peer refuses format 3 outright — it
+    can never misdecode encoded bytes as raw arrays — and negotiates
+    fp32 by healing from a donor staged without a codec (the default).
+    With ``chunk_codecs`` None these bytes are bit-for-bit the format-2
+    body (pinned by tests)."""
+    meta: Dict[str, Any] = {
+        "format": 3 if chunk_codecs else 2,
+        "num_chunks": num_chunks,
+        "treedef": treedef,
+        "step": step,
+        "quorum_id": quorum_id,
+        "crc_algo": crc_algo,
+        "chunk_crcs": chunk_crcs,
+        "digest": digest,
+        "parts": parts or {},
+        "chunk_sizes": chunk_sizes,
+    }
+    if chunk_codecs:
+        meta["chunk_codecs"] = list(chunk_codecs)
+        meta["codec"] = chunk_codecs[0]
+    return pickle.dumps(meta)
 
 
 def _tree_token(treedef: Any) -> Optional[str]:
@@ -518,12 +549,15 @@ def _stage_manifest(
     chunk_sizes: List[int],
     digest: str,
     tree_token: Optional[str] = None,
+    chunk_codecs: Optional[List[str]] = None,
 ) -> Dict[str, Any]:
     """JSON-safe summary of one staged checkpoint (no treedef — readers
     that need it fetch the pickled ``/meta``). ``send_checkpoint`` returns
     it so the serving plane's publisher can announce the staged version
-    without a second pass over the payload."""
-    return {
+    without a second pass over the payload. ``chunk_codecs`` rides into
+    the serving descriptor only when the stage was codec-encoded (the
+    default descriptor stays field-identical to the pre-codec wire)."""
+    manifest: Dict[str, Any] = {
         "step": int(step),
         "quorum_id": quorum_id,
         "crc_algo": crc_algo,
@@ -533,10 +567,14 @@ def _stage_manifest(
         "digest": digest,
         "tree_token": tree_token,
     }
+    if chunk_codecs:
+        manifest["chunk_codecs"] = list(chunk_codecs)
+        manifest["codec"] = chunk_codecs[0]
+    return manifest
 
 
 def _plan_chunks(
-    state_dict: Any, num_chunks: int
+    state_dict: Any, num_chunks: int, codec: Optional[str] = None, wire: str = "heal"
 ) -> Tuple[Any, List[Dict[int, Any]], Dict[str, int]]:
     """Splits a state dict's leaves into servable chunks, part-aware.
 
@@ -547,7 +585,16 @@ def _plan_chunks(
     exactly as before (with no part keys the layout is bit-identical to
     the pre-part format). Returns ``(treedef, chunk_dicts, parts)`` where
     ``parts`` maps part name -> chunk index.
+
+    ``codec`` (the quantized wire plane, torchft_tpu/wire_codec.py)
+    encodes every eligible float leaf BEFORE planning, so the chunk
+    layout, CRCs, sizes, and the delta/stripe machinery all operate on
+    the encoded bytes. Both sides plan through this one function — a
+    delta-rejoining peer encodes its local state with the donor's codec
+    and lands on the identical layout. None/"fp32" is the bit-for-bit
+    passthrough.
     """
+    state_dict, _stats = wire_codec.encode_state(state_dict, codec, wire=wire)
     leaves_with_paths, treedef = jax.tree_util.tree_flatten_with_path(state_dict)
 
     def part_of(path: Any) -> Optional[str]:
@@ -645,9 +692,21 @@ class HTTPTransport(CheckpointTransport[Any]):
         num_chunks: int = 0,
         serve_mode: Optional[str] = None,
         keep_versions: int = 1,
+        codec: Optional[str] = None,
+        wire: str = "heal",
     ) -> None:
         self._timeout = timeout
         self._num_chunks = num_chunks
+        # Quantized wire plane: the codec this transport stages with. An
+        # explicit ctor codec pins it; otherwise the env knob for this
+        # transport's wire class ($TPUFT_HEAL_CODEC / $TPUFT_SERVING_CODEC,
+        # via `wire`) is read at STAGE time, so tests and operators can
+        # flip it without rebuilding transports. Default fp32 =
+        # bit-for-bit the pre-codec wire.
+        if codec is not None:
+            wire_codec.resolve_codec(codec)  # validate eagerly
+        self._codec_arg = codec
+        self._wire = wire
         # Versioned staged history (torchft_tpu/history.py): with
         # keep_versions > 1 the last K staged checkpoints stay servable
         # (the serving plane's pinned-version / rollback reads), budgeted
@@ -838,6 +897,7 @@ class HTTPTransport(CheckpointTransport[Any]):
                         chunk_crcs=staged.chunk_crcs,
                         chunk_sizes=staged.chunk_sizes,
                         digest=staged.digest,
+                        chunk_codecs=staged.chunk_codecs,
                     )
                     self.send_response(200)
                     self.send_header("Content-Type", "application/json")
@@ -946,6 +1006,15 @@ class HTTPTransport(CheckpointTransport[Any]):
         sub-bucket per host instead of bypassing the fairness split)."""
         tags = urllib.parse.parse_qs(split.query).get("peer")
         return tags[0] if tags else str(handler.client_address[0])
+
+    def _stage_codec(self) -> Optional[str]:
+        """The codec for the NEXT stage: the pinned ctor codec, else this
+        wire class's env knob (heal vs serving), read fresh per stage."""
+        if self._codec_arg is not None:
+            return self._codec_arg
+        if self._wire == "serving":
+            return wire_codec.serving_codec()
+        return wire_codec.heal_codec()
 
     def _chunk_fault(self, step: int, index: int) -> Optional[str]:
         hook = self._fault_hook
@@ -1056,7 +1125,10 @@ class HTTPTransport(CheckpointTransport[Any]):
         child = self._serve_child
         if child is None or not child.alive():
             raise ServeChildUnavailable("no live serving child")
-        treedef, chunk_dicts, parts = _plan_chunks(state_dict, self._num_chunks)
+        codec = self._stage_codec()
+        treedef, chunk_dicts, parts = _plan_chunks(
+            state_dict, self._num_chunks, codec=codec, wire=self._wire
+        )
         epoch, epoch_dir = child.new_epoch_dir()
         update = _CRC_UPDATERS[_CRC_ALGO]
         files: List[str] = []
@@ -1073,7 +1145,8 @@ class HTTPTransport(CheckpointTransport[Any]):
             crcs.append(w.crc)
             chunk_dicts[i] = None  # type: ignore[call-overload]
             del prepared
-        digest = _checkpoint_digest(step, _CRC_ALGO, crcs)
+        chunk_codecs = wire_codec.chunk_codecs_for(len(files), codec)
+        digest = _checkpoint_digest(step, _CRC_ALGO, crcs, chunk_codecs)
         meta = _meta_bytes(
             step=step,
             quorum_id=quorum_id,
@@ -1087,6 +1160,7 @@ class HTTPTransport(CheckpointTransport[Any]):
                 for name, index in parts.items()
             },
             chunk_sizes=sizes,
+            chunk_codecs=chunk_codecs,
         )
         child.stage(
             step=step,
@@ -1100,11 +1174,13 @@ class HTTPTransport(CheckpointTransport[Any]):
             crcs=crcs,
             digest=digest,
             keep=self._keep_versions,
+            chunk_codecs=chunk_codecs,
         )
         self._child_staged = True
         manifest = _stage_manifest(
             step, quorum_id, _CRC_ALGO, crcs, sizes, digest,
             tree_token=_tree_token(treedef),
+            chunk_codecs=chunk_codecs,
         )
         if self._staged_store is not None:
             # Child mode: payload bytes live in the child's /dev/shm
@@ -1160,16 +1236,26 @@ class HTTPTransport(CheckpointTransport[Any]):
                 )
                 metrics.inc("tpuft_heal_serve_fallbacks_total")
                 self._child_degraded = True
+        codec = self._stage_codec()
         with metrics.timer("tpuft_heal_serve_stage_seconds", mode="inline"):
             treedef, chunk_dicts, parts = _plan_chunks(
-                state_dict, self._num_chunks
+                state_dict, self._num_chunks, codec=codec, wire=self._wire
             )
             # prepare() keeps the host leaves + a small header per chunk;
             # the serialized bytes never exist as a second whole-payload
             # copy.
             chunks = [_serialization.prepare(chunk) for chunk in chunk_dicts]
             staged = _Staged(
-                step, chunks, treedef, quorum_id=quorum_id, parts=parts
+                step, chunks, treedef, quorum_id=quorum_id, parts=parts,
+                codec=codec,
+            )
+        if staged.chunk_codecs:
+            tracing.record(
+                "codec_stage",
+                step=step,
+                wire=self._wire,
+                codec=staged.chunk_codecs[0],
+                encoded_bytes=sum(staged.chunk_sizes),
             )
         metrics.inc("tpuft_heal_serve_stages_total", mode="inline")
         with self._cond:
@@ -1185,6 +1271,7 @@ class HTTPTransport(CheckpointTransport[Any]):
             staged.chunk_sizes,
             staged.digest,
             tree_token=staged.tree_token,
+            chunk_codecs=staged.chunk_codecs,
         )
 
     def disallow_checkpoint(self) -> None:
@@ -1450,6 +1537,25 @@ class HTTPTransport(CheckpointTransport[Any]):
         else:
             leaves = [merged[i] for i in range(len(merged))]
         result = jax.tree_util.tree_unflatten(treedef, leaves)
+        # Quantized wire plane: decode AFTER every chunk verified its CRC
+        # (and the digest bound the codec tags). Decode is structure-
+        # driven and self-verifying — a lying tag raises here and the
+        # state is never adopted (the caller funnels HealIntegrityError
+        # into Manager.report_error like any other corrupt donor).
+        if meta.get("chunk_codecs"):
+            try:
+                result = wire_codec.decode_state(result, wire=self._wire)
+            except wire_codec.WireCodecError as e:
+                raise HealIntegrityError(
+                    f"encoded checkpoint failed codec validation: {e}"
+                ) from e
+            tracing.record(
+                "codec_decode",
+                step=step,
+                wire=self._wire,
+                codec=meta.get("codec"),
+                encoded_bytes=sum(chunk_sizes or []),
+            )
         if key is not None:
             self._heal_cache.pop(key, None)
         return result
@@ -1473,11 +1579,27 @@ class HTTPTransport(CheckpointTransport[Any]):
                 meta = safe_loads(
                     _fetch_retry(f"{url}/checkpoint/{step}/meta", timeout)
                 )
-                if not isinstance(meta, dict) or meta.get("format") != 2:
+                # Format 2 = the pre-codec wire (raw-array chunks); 3 =
+                # codec-encoded chunks with digest-bound tags. Anything
+                # else is refused — this check is what makes a codec-less
+                # peer fail CLEANLY against an encoded donor instead of
+                # misdecoding encoded bytes as raw arrays.
+                if not isinstance(meta, dict) or meta.get("format") not in (2, 3):
                     raise HealIntegrityError(
                         f"unrecognized checkpoint /meta format from {url}: "
                         f"{type(meta).__name__}"
                     )
+                meta_codecs = meta.get("chunk_codecs")
+                if meta.get("format") == 3:
+                    if (
+                        not isinstance(meta_codecs, list)
+                        or len(meta_codecs) != meta.get("num_chunks")
+                        or any(c not in wire_codec.CODECS for c in meta_codecs)
+                    ):
+                        raise HealIntegrityError(
+                            f"format-3 /meta from {url} carries an invalid "
+                            f"chunk_codecs list: {meta_codecs!r}"
+                        )
                 donor_era = meta.get("quorum_id")
                 # Era fence: never heal backwards from a survivor still
                 # staged for an older quorum (its state may predate
@@ -1501,10 +1623,14 @@ class HTTPTransport(CheckpointTransport[Any]):
                 # never adopted.
                 if digest is not None and chunk_crcs is not None:
                     algo = meta.get("crc_algo", "crc32")
-                    if _checkpoint_digest(step, algo, chunk_crcs) != digest:
+                    if (
+                        _checkpoint_digest(step, algo, chunk_crcs, meta_codecs)
+                        != digest
+                    ):
                         raise HealIntegrityError(
                             "whole-checkpoint digest does not match the "
-                            "per-chunk checksums in /meta: refusing the heal"
+                            "per-chunk checksums (and codec tags) in /meta: "
+                            "refusing the heal"
                         )
                 return meta, url
             except Exception as e:  # noqa: BLE001 — re-raised when last
@@ -1552,8 +1678,14 @@ class HTTPTransport(CheckpointTransport[Any]):
 
         try:
             base_n = num_chunks - len(parts_meta)
+            # Plan with the DONOR's codec: both sides encode through the
+            # same deterministic host codec, so a committed-equal chunk
+            # serializes to identical encoded bytes and the (crc, size)
+            # match works unchanged on the compressed payload. An
+            # unknown donor codec falls back to the full fetch below.
             treedef, chunk_dicts, local_parts = _plan_chunks(
-                local_state, base_n
+                local_state, base_n,
+                codec=meta.get("codec"), wire=self._wire,
             )
         except Exception as e:  # noqa: BLE001 — never fail the heal here
             fall_back(f"local chunk plan failed: {e}")

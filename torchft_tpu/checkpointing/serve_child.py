@@ -461,6 +461,7 @@ def _delta_response(
     chunk_crcs: Optional[List[int]],
     chunk_sizes: Optional[List[int]],
     digest: Optional[str],
+    chunk_codecs: Optional[List[str]] = None,
 ) -> bytes:
     """The ``/checkpoint/{step}/delta`` manifest-diff body, shared by the
     inline handler and the serving child (stdlib-only by construction):
@@ -481,6 +482,11 @@ def _delta_response(
         "num_chunks": len(chunk_crcs) if chunk_crcs is not None else 0,
         "digest": digest,
     }
+    if chunk_codecs:
+        # Quantized stage: a caller diffing raw-f32 CRCs against encoded
+        # chunks would see everything differ — name the codec so the
+        # operator knows which format the staged manifest speaks.
+        body["chunk_codecs"] = list(chunk_codecs)
     if (
         crcs is None
         or chunk_crcs is None
@@ -590,6 +596,7 @@ class _FileStaged:
         self.crc_algo: str = cmd.get("crc_algo", "crc32")
         self.chunk_crcs: Optional[List[int]] = cmd.get("crcs")
         self.digest: Optional[str] = cmd.get("digest")
+        self.chunk_codecs: Optional[List[str]] = cmd.get("chunk_codecs")
 
     def delete(self) -> None:
         shutil.rmtree(self.dir, ignore_errors=True)
@@ -730,6 +737,7 @@ def _child_main(argv: Optional[List[str]] = None) -> int:
                     chunk_crcs=staged.chunk_crcs,
                     chunk_sizes=staged.sizes,
                     digest=staged.digest,
+                    chunk_codecs=staged.chunk_codecs,
                 )
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
@@ -1119,6 +1127,7 @@ class ServeChild:
         crcs: Optional[List[int]] = None,
         digest: Optional[str] = None,
         keep: int = 1,
+        chunk_codecs: Optional[List[str]] = None,
     ) -> None:
         """Hands the snapshot to the child (which owns — and eventually
         deletes — the epoch directory from here on). ``crcs``/``digest``
@@ -1144,6 +1153,7 @@ class ServeChild:
                     "crcs": crcs,
                     "digest": digest,
                     "keep": max(1, int(keep)),
+                    "chunk_codecs": chunk_codecs,
                 }
             )
         except OSError as e:

@@ -72,6 +72,10 @@ KNOWN_ENV = {
     # policy for shard parts, bench sizing.
     "TPUFT_ZERO", "TPUFT_ZERO_SHARDS", "TPUFT_ZERO_REBALANCE",
     "TPUFT_ZERO_HEAL_SHARDS", "TPUFT_ZERO_BENCH_ELEMS",
+    # Quantized wire plane (torchft_tpu/wire_codec.py): per-wire-class
+    # codecs for heal chunks, serving fan-out, and the ZeRO shard legs
+    # (fp32 default = bit-for-bit the pre-codec wire).
+    "TPUFT_HEAL_CODEC", "TPUFT_SERVING_CODEC", "TPUFT_ZERO_CODEC",
     "TPUFT_BENCH_CHILD",
     "TPUFT_BENCH_MODEL", "TPUFT_BENCH_STEPS", "TPUFT_BENCH_BATCH",
     "TPUFT_BENCH_SEQ", "TPUFT_BENCH_SYNC_EVERY", "TPUFT_BENCH_SYNC_DELAY",
@@ -192,6 +196,65 @@ def _check_kernels() -> Tuple[str, str]:
         if not np.allclose(back, x, atol=0.5):
             return "FAIL", f"{wire} codec roundtrip error"
     return "PASS", "host wire codecs (fp8/int8/int4) roundtrip ok"
+
+
+def _check_wire_codec_negotiation() -> Tuple[str, str]:
+    """Quantized-wire-plane preflight. WARN, never FAIL: the codec knobs
+    change the wire FORMAT, so the thing that breaks real deployments is
+    a mixed fleet — a codec-less (format-2) peer refuses an encoded
+    donor's format-3 /meta cleanly and the heal retries elsewhere, which
+    in a fully mixed fleet means "falls back to operators setting fp32",
+    never a silent misdecode. This check names that, probes an
+    encode/decode roundtrip per configured codec, and flags the
+    bitwise-heal envelope."""
+    from torchft_tpu import wire_codec
+
+    knobs = []
+    for env in (
+        wire_codec.ENV_HEAL_CODEC,
+        wire_codec.ENV_SERVING_CODEC,
+        wire_codec.ENV_ZERO_CODEC,
+    ):
+        raw = os.environ.get(env)
+        if raw is None or raw.strip() == "":
+            continue
+        try:
+            codec = wire_codec._env_codec(env)
+        except ValueError:
+            return (
+                "WARN",
+                f"{env}={raw!r} is not one of {sorted(wire_codec.CODECS)}; "
+                "the plane would refuse to stage — unset it or pick a "
+                "valid codec",
+            )
+        if codec != "fp32":
+            knobs.append(f"{env}={codec}")
+    if not knobs:
+        return (
+            "PASS",
+            "all bulk wires fp32 (bit-for-bit pre-codec format; "
+            "TPUFT_HEAL_CODEC/TPUFT_SERVING_CODEC/TPUFT_ZERO_CODEC unset)",
+        )
+    try:
+        import numpy as np
+
+        probe = {"w": np.linspace(-2, 2, 4096, dtype=np.float32)}
+        for knob in knobs:
+            codec = knob.split("=", 1)[1]
+            enc, stats = wire_codec.encode_state(probe, codec)
+            wire_codec.decode_state(enc)
+            if stats["encoded_leaves"] != 1:
+                return "WARN", f"{codec} probe encoded nothing"
+    except Exception as e:  # noqa: BLE001 — WARN-never-FAIL probe
+        return "WARN", f"codec roundtrip probe failed: {e}"
+    return (
+        "WARN",
+        f"{', '.join(knobs)}: encoded stages are /meta format 3 — "
+        "codec-less peers refuse them cleanly and a MIXED fleet must fall "
+        "back to fp32 (unset the knob) until every peer is codec-aware; "
+        "quantized HEALS are lossy per adoption (pair with ZeRO, whose "
+        "next allgather re-syncs params bitwise, or DiLoCo outer syncs)",
+    )
 
 
 def _check_metrics() -> Tuple[str, str]:
@@ -717,6 +780,7 @@ def run_checks(lighthouse: str, skip_device: bool = False) -> int:
         ("native plane", _check_native),
         ("kv store", _check_store),
         ("wire codecs", _check_kernels),
+        ("codec negotiation", _check_wire_codec_negotiation),
         ("env vars", _check_env),
         ("commit pipeline", _check_commit_pipeline),
         ("weight history", _check_history),

@@ -451,10 +451,15 @@ class Manager:
         )
 
         self._store = store
+        # The default heal transport speaks the heal wire class: its
+        # stages encode with $TPUFT_HEAL_CODEC (default fp32 = bit-for-bit
+        # the pre-codec format) and a joiner decodes after CRC/digest
+        # verification — decode failures funnel into report_error through
+        # the same HealIntegrityError path as any corrupt donor.
         self._checkpoint_transport: CheckpointTransport = (
             checkpoint_transport
             if checkpoint_transport is not None
-            else HTTPTransport(timeout=self._timeout)
+            else HTTPTransport(timeout=self._timeout, wire="heal")
         )
         # Serving-plane failures (e.g. a heal-serve sidecar crash,
         # TPUFT_HEAL_SERVE_MODE=child) funnel into report_error: the step

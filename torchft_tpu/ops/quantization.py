@@ -259,13 +259,19 @@ _ROWS_PER_TILE = 1024
 
 
 def quantize_blocks_pallas(
-    x, block: int = BLOCK, interpret: bool = False, wire: Optional[str] = None
+    x,
+    block: int = BLOCK,
+    interpret: bool = False,
+    wire: Optional[str] = None,
+    rows_per_tile: Optional[int] = None,
 ):
     """Device-side blockwise 8-bit quantization (fp8 or int8).
 
     ``x``: float array, flattened/padded by the caller to (n_blocks, block).
     Returns (payload, scales f32). One grid row per block tile keeps the
-    VPU busy while scales stay in SMEM-sized slices.
+    VPU busy while scales stay in SMEM-sized slices. ``rows_per_tile``
+    overrides the tuned grid tile height (:data:`_ROWS_PER_TILE`) — the
+    free parameter ``scripts/codec_block_sweep.py`` sweeps on-chip.
     """
     import jax
     import jax.numpy as jnp
@@ -279,7 +285,9 @@ def quantize_blocks_pallas(
     qmax = _WIRE_QMAX[wire]
     out_dtype = jnp.int8 if wire == "int8" else jnp.float8_e4m3fn
     n_blocks = x.shape[0]
-    rows_per_tile = min(n_blocks, _ROWS_PER_TILE)
+    rows_per_tile = min(
+        n_blocks, rows_per_tile if rows_per_tile else _ROWS_PER_TILE
+    )
 
     def kernel(x_ref, payload_ref, scales_ref):
         block_data = x_ref[:].astype(jnp.float32)
@@ -311,8 +319,12 @@ def quantize_blocks_pallas(
     return payload, scales.reshape(n_blocks)
 
 
-def dequantize_blocks_pallas(payload, scales, interpret: bool = False):
-    """Device-side blockwise fp8/int8 dequantization to float32."""
+def dequantize_blocks_pallas(
+    payload, scales, interpret: bool = False, rows_per_tile: Optional[int] = None
+):
+    """Device-side blockwise fp8/int8 dequantization to float32.
+    ``rows_per_tile`` as in :func:`quantize_blocks_pallas` (the paired
+    kernels need not share a height — the wire format is tile-agnostic)."""
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -322,7 +334,9 @@ def dequantize_blocks_pallas(payload, scales, interpret: bool = False):
             "packed int4 has no Pallas kernel — use dequantize_blocks_device"
         )
     n_blocks, block = payload.shape
-    rows_per_tile = min(n_blocks, _ROWS_PER_TILE)
+    rows_per_tile = min(
+        n_blocks, rows_per_tile if rows_per_tile else _ROWS_PER_TILE
+    )
 
     def kernel(payload_ref, scales_ref, out_ref):
         out_ref[:] = payload_ref[:].astype(jnp.float32) * scales_ref[:]

@@ -112,6 +112,7 @@ HEAL_FAULT_MODES = (
     "kill_serve_child",
     "kill_donor_mid_stripe",
     "corrupt_stripe",
+    "corrupt_quantized_chunk",
     "kill_half_fleet",
 )
 # Serving-plane modes (the committed-weights fan-out tier).
@@ -245,7 +246,13 @@ def arm_stream_fault(
     abruptly at its next poll round or reader GET."""
     if mode == "kill_serve_child":
         site, armed_mode = "serve_child", mode
-    elif mode == "corrupt_stripe":
+    elif mode in ("corrupt_stripe", "corrupt_quantized_chunk"):
+        # corrupt_quantized_chunk: the same bit-flip, aimed at a donor
+        # staged with TPUFT_HEAL_CODEC — the drill that proves the CRC
+        # (computed over ENCODED bytes) catches corruption in the
+        # compressed payload exactly like in raw f32, and a decode of
+        # tampered-but-CRC-clean bytes can still never be adopted
+        # (tests/test_wire_codec.py).
         site = f"heal_stream:{donor_tag}" if donor_tag else "heal_stream"
         armed_mode = "corrupt_stream"  # the serve seam knows one bit-flip
     elif mode == "kill_relay":
@@ -291,6 +298,7 @@ def inject_fault(
         "stall_donor",
         "kill_serve_child",
         "corrupt_stripe",
+        "corrupt_quantized_chunk",
         "kill_relay",
         "retract_version",
     ):

@@ -274,9 +274,11 @@ def changed_chunks_between(
 def validate_latest(latest: Dict[str, Any]) -> Optional[str]:
     """Structural + integrity-binding validation of a ``/serving/latest``
     descriptor; returns a rejection reason or None when acceptable. The
-    digest MUST be exactly the binding of (step, algo, chunk_crcs) —
+    digest MUST be exactly the binding of (step, algo, chunk_crcs) — and
+    of the per-chunk codec tags when the version is codec-encoded —
     checked before any chunk transfer, so a torn or tampered descriptor
-    never costs a payload fetch and can never be adopted."""
+    (including a tampered codec tag) never costs a payload fetch and can
+    never be adopted."""
     if latest.get("format") != 1:
         return f"unrecognized /serving/latest format: {latest.get('format')!r}"
     for key in ("step", "digest", "crc_algo", "chunk_crcs", "chunk_sizes", "base"):
@@ -289,8 +291,21 @@ def validate_latest(latest: Dict[str, Any]) -> Optional[str]:
     sizes: List[int] = latest["chunk_sizes"]
     if len(crcs) != len(sizes) or len(crcs) != int(latest.get("num_chunks", len(crcs))):
         return "descriptor chunk_crcs/chunk_sizes/num_chunks disagree"
-    if _checkpoint_digest(int(latest["step"]), algo, crcs) != latest["digest"]:
-        return "descriptor digest does not bind its per-chunk checksums"
+    codecs = latest.get("chunk_codecs")
+    if codecs is not None:
+        from torchft_tpu import wire_codec
+
+        if (
+            not isinstance(codecs, list)
+            or len(codecs) != len(crcs)
+            or any(c not in wire_codec.CODECS for c in codecs)
+        ):
+            return f"descriptor carries an invalid chunk_codecs list: {codecs!r}"
+    if (
+        _checkpoint_digest(int(latest["step"]), algo, crcs, codecs)
+        != latest["digest"]
+    ):
+        return "descriptor digest does not bind its per-chunk checksums/codecs"
     return None
 
 

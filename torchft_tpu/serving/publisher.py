@@ -144,6 +144,11 @@ class WeightPublisher:
                 timeout=timeout,
                 num_chunks=num_chunks if num_chunks is not None else _publish_chunks(),
                 keep_versions=keep,
+                # Publication stages speak the serving wire class: encoded
+                # with $TPUFT_SERVING_CODEC (default fp32), decoded
+                # reader-side after verify-then-swap. Relays are
+                # byte-level and fan the encoded chunks out verbatim.
+                wire="serving",
             )
         )
         self._lock = threading.Lock()

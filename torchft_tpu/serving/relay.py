@@ -105,6 +105,7 @@ class _RelayVersion:
         "pub_seq",
         "pub_id",
         "tree_token",
+        "chunk_codecs",
     )
 
     def __init__(
@@ -123,6 +124,7 @@ class _RelayVersion:
         pub_seq: Optional[int] = None,
         pub_id: Optional[str] = None,
         tree_token: Optional[str] = None,
+        chunk_codecs: Optional[List[str]] = None,
     ) -> None:
         self.step = step
         self.quorum_id = quorum_id
@@ -144,9 +146,14 @@ class _RelayVersion:
         self.pub_seq = pub_seq
         self.pub_id = pub_id
         self.tree_token = tree_token
+        # Quantized wire plane: the chunk bytes this relay caches are
+        # whatever the publisher staged — possibly codec-encoded. The
+        # tags ride the tree verbatim (they are digest-bound; the relay
+        # itself never decodes).
+        self.chunk_codecs = chunk_codecs
 
     def manifest(self) -> Dict[str, Any]:
-        return {
+        manifest: Dict[str, Any] = {
             "step": self.step,
             "quorum_id": self.quorum_id,
             "crc_algo": self.crc_algo,
@@ -156,6 +163,10 @@ class _RelayVersion:
             "digest": self.digest,
             "tree_token": self.tree_token,
         }
+        if self.chunk_codecs:
+            manifest["chunk_codecs"] = list(self.chunk_codecs)
+            manifest["codec"] = self.chunk_codecs[0]
+        return manifest
 
 
 class _PullFailed(RuntimeError):
@@ -650,6 +661,7 @@ class CachingRelay:
             pub_seq=latest.get("pub_seq"),
             pub_id=latest.get("pub_id"),
             tree_token=latest.get("tree_token"),
+            chunk_codecs=latest.get("chunk_codecs"),
         )
         retraction = previous is not None and step <= previous.step
         with self._lock:

@@ -399,6 +399,21 @@ class WeightSubscriber:
             merged.update(chunk)
         leaves = [merged[i] for i in range(treedef.num_leaves)]
         params = jax.tree_util.tree_unflatten(treedef, leaves)
+        if latest.get("chunk_codecs"):
+            # Quantized serving wire: decode AFTER every chunk verified
+            # its digest-bound CRC. A lying/corrupt codec tag raises —
+            # counted as an integrity reject, the poll fails, and the
+            # held version stays; a bad tag can never become an adopted
+            # version.
+            from torchft_tpu import wire_codec
+
+            try:
+                params = wire_codec.decode_state(params, wire="serving")
+            except wire_codec.WireCodecError as e:
+                metrics.inc("tpuft_serving_integrity_rejects_total")
+                raise ValueError(
+                    f"version {step} failed codec validation: {e}"
+                ) from e
         version = ServingVersion(
             step=step,
             quorum_id=latest.get("quorum_id"),

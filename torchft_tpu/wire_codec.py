@@ -1,0 +1,358 @@
+"""Quantized wire plane: one codec registry for every bulk byte path.
+
+WIRE_CONVERGENCE.json retired the quality risk of the 8/4-bit formats
+(fp8/int4 outer syncs are quality-neutral vs fp32, same seed), but until
+this module only the DDP/DiLoCo gradient wires spent that win. Here the
+same host codecs (:mod:`torchft_tpu.ops.quantization` — the reference's
+Triton-kernel lineage, torchft/quantization.py) become a *wire format*
+every bulk path consults:
+
+- **heal streams** (``$TPUFT_HEAL_CODEC``): the donor stages encoded
+  chunks; CRC + digest are computed over the ENCODED bytes, so tamper
+  detection, delta rejoin's (crc, size) matching, striped-heal
+  reassignment, and serve-child isolation all work unchanged on the
+  compressed payload. Decode runs joiner-side AFTER CRC verification.
+- **serving fan-out** (``$TPUFT_SERVING_CODEC``): the publisher stages
+  encoded versions; relays cache and fan out the encoded bytes verbatim
+  (they are byte-level), readers decode after verify-then-swap.
+- **ZeRO shard plane** (``$TPUFT_ZERO_CODEC``): the flat f32 plane
+  encodes on the reduce-scatter and allgather wires
+  (:class:`torchft_tpu.zero.ZeroOptimizer`); masters stay f32 and
+  bitwise replica identity survives BY CONSTRUCTION because every
+  replica dequantizes the same encoded allgather payload with one shared
+  dispatch.
+
+All three default to ``fp32`` — a passthrough that keeps every byte,
+/meta field, and wire payload bit-for-bit identical to the pre-codec
+format (pinned by tests). A codec-less (format-2) peer therefore
+interoperates by default; with a codec enabled the staged ``/meta``
+bumps to format 3, so an old joiner REFUSES the stage cleanly instead of
+ever misdecoding (see ``docs/resilience.md``).
+
+Wire format
+-----------
+
+Encoding is a *leaf transform*: each eligible float array leaf is
+replaced by a marker dict ::
+
+    {CODEC_KEY: "int8", "shape": (..), "dtype": "float32",
+     "payload": uint8/int8/fp8 (n_blocks, cols), "scales": f32 (n_blocks,)}
+
+The marker rides INSIDE the chunk bytes (covered by the per-chunk CRC
+and the digest binding), so decode is structure-driven and
+self-verifying: a wrong or lying codec tag — payload dtype, block
+geometry, or scale shape that does not match the claimed codec — raises
+:class:`WireCodecError` and the state is never adopted (heal callers
+funnel it into ``Manager.report_error``; serving readers count it as a
+failed poll and keep their held version). Integer leaves, tiny leaves
+(< :data:`MIN_ENCODE_ELEMS` elements), and non-fully-addressable
+multi-host arrays pass through unencoded.
+
+The per-chunk ``codec`` field in ``/meta`` (``chunk_codecs``) and the
+serving descriptor is bound into the checkpoint digest, so a tampered
+tag fails the digest check before any payload transfer.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from torchft_tpu import metrics
+from torchft_tpu.ops import quantization as q
+
+__all__ = [
+    "CODECS",
+    "CODEC_KEY",
+    "ENV_HEAL_CODEC",
+    "ENV_SERVING_CODEC",
+    "ENV_ZERO_CODEC",
+    "MIN_ENCODE_ELEMS",
+    "WireCodecError",
+    "heal_codec",
+    "serving_codec",
+    "zero_codec",
+    "resolve_codec",
+    "is_encoded_leaf",
+    "encode_state",
+    "decode_state",
+    "encoded_ratio",
+]
+
+ENV_HEAL_CODEC = "TPUFT_HEAL_CODEC"
+ENV_SERVING_CODEC = "TPUFT_SERVING_CODEC"
+ENV_ZERO_CODEC = "TPUFT_ZERO_CODEC"
+
+# "fp32" is the identity codec: no transform, no /meta field, format 2 —
+# bit-for-bit the pre-codec wire. The others reuse the block codecs in
+# ops/quantization.py (BLOCK-element blocks, one f32 scale per block).
+CODECS = ("fp32", "fp8", "int8", "int4")
+
+# Sentinel key marking an encoded leaf's marker dict. Rides the chunk
+# header (pickled non-array leaf), so it is covered by the chunk CRC.
+CODEC_KEY = "__tpuft_wire_codec__"
+
+# Leaves below this element count pass through unencoded: the per-block
+# scale + padding overhead wipes out the byte win on tiny leaves, and
+# scalars (step counters) must stay exact.
+MIN_ENCODE_ELEMS = 1024
+
+# Numeric code per codec for the `tpuft_codec_wire` gauge (fleet_status's
+# WIRE column decodes it back).
+CODEC_GAUGE_CODES = {"fp32": 0, "fp8": 1, "int8": 2, "int4": 3}
+GAUGE_CODE_CODECS = {v: k for k, v in CODEC_GAUGE_CODES.items()}
+
+
+class WireCodecError(RuntimeError):
+    """An encoded leaf failed validation (wrong/lying codec tag, payload
+    geometry, or dtype): the bytes verified their CRC but do not decode
+    as the codec they claim — corrupt-by-construction, never adopted."""
+
+
+def _env_codec(env: str) -> str:
+    raw = os.environ.get(env)
+    if raw is None or raw.strip() == "":
+        return "fp32"
+    name = raw.strip().lower()
+    if name not in CODECS:
+        raise ValueError(
+            f"{env}={raw!r} is not one of {sorted(CODECS)}"
+        )
+    return name
+
+
+def heal_codec() -> str:
+    """Heal-stream wire codec (``$TPUFT_HEAL_CODEC``, default fp32)."""
+    return _env_codec(ENV_HEAL_CODEC)
+
+
+def serving_codec() -> str:
+    """Serving fan-out wire codec (``$TPUFT_SERVING_CODEC``, default fp32)."""
+    return _env_codec(ENV_SERVING_CODEC)
+
+
+def zero_codec() -> str:
+    """ZeRO shard-plane wire codec (``$TPUFT_ZERO_CODEC``, default fp32)."""
+    return _env_codec(ENV_ZERO_CODEC)
+
+
+def resolve_codec(codec: Optional[str]) -> str:
+    """Validates an explicit codec name; None means fp32 passthrough."""
+    if codec is None:
+        return "fp32"
+    if codec not in CODECS:
+        raise ValueError(f"codec={codec!r} is not one of {sorted(CODECS)}")
+    return codec
+
+
+def _resolve_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _is_float_dtype(dtype: np.dtype) -> bool:
+    if dtype.kind == "f":
+        return True
+    # ml_dtypes customs (bfloat16 & friends) register as void-kind; the
+    # quantizer upcasts them through float32 exactly like the DDP wire.
+    try:
+        import ml_dtypes
+
+        # Deliberately NOT the fp8 wire dtype itself: an fp8 array is
+        # either already a wire payload (never double-encode) or exotic
+        # enough that passthrough is the safe default.
+        return dtype == np.dtype(ml_dtypes.bfloat16)
+    except ImportError:  # pragma: no cover
+        return False
+
+
+def _eligible(leaf: Any) -> bool:
+    """Encodable: a host/jax float array of at least MIN_ENCODE_ELEMS
+    elements, fully addressable (multi-host shard captures pass through —
+    they serialize per-shard and re-assemble receiver-side)."""
+    if isinstance(leaf, dict) and CODEC_KEY in leaf:
+        return False  # already encoded — never double-encode
+    if not (hasattr(leaf, "dtype") and hasattr(leaf, "shape")):
+        return False
+    if hasattr(leaf, "is_fully_addressable") and not leaf.is_fully_addressable:
+        return False
+    try:
+        dtype = np.dtype(leaf.dtype)
+    except TypeError:
+        return False
+    if not _is_float_dtype(dtype):
+        return False
+    size = 1
+    for dim in leaf.shape:
+        size *= int(dim)
+    return size >= MIN_ENCODE_ELEMS
+
+
+def is_encoded_leaf(node: Any) -> bool:
+    """True for a marker dict produced by :func:`encode_state` (the key
+    survives the wire even when a skipped part nulled the values)."""
+    return isinstance(node, dict) and CODEC_KEY in node
+
+
+def _encode_leaf(leaf: Any, codec: str) -> Dict[str, Any]:
+    arr = np.asarray(leaf)
+    payload, scales = q.quantize_blocks(arr, wire=codec)
+    return {
+        CODEC_KEY: codec,
+        "shape": tuple(int(d) for d in arr.shape),
+        "dtype": np.dtype(arr.dtype).name,
+        "payload": payload,
+        "scales": scales,
+    }
+
+
+def _decode_leaf(marker: Dict[str, Any]) -> Any:
+    codec = marker.get(CODEC_KEY)
+    payload = marker.get("payload")
+    scales = marker.get("scales")
+    if codec is None or payload is None or scales is None:
+        # A skipped heal part substituted None for this chunk's leaves;
+        # the part owner reconstructs the state through its own plane.
+        return None
+    if codec not in CODECS or codec == "fp32":
+        raise WireCodecError(f"unknown wire codec tag {codec!r} in payload")
+    shape = marker.get("shape")
+    dtype_name = marker.get("dtype")
+    if shape is None or dtype_name is None:
+        raise WireCodecError(f"encoded {codec} leaf is missing shape/dtype")
+    payload = np.asarray(payload)
+    scales = np.asarray(scales)
+    expect_dtype = q._WIRE_NP_DTYPES[codec]
+    cols = q.payload_cols(codec)
+    size = 1
+    for dim in shape:
+        size *= int(dim)
+    n_blocks = -(-max(size, 1) // q.BLOCK)
+    # The tag is self-verifying: payload dtype AND block geometry must
+    # match the claimed codec exactly, or these bytes were produced by a
+    # different codec than the tag says (a lying tag / cross-codec mixup)
+    # and decoding them would fabricate state.
+    if np.dtype(payload.dtype) != expect_dtype:
+        raise WireCodecError(
+            f"lying codec tag: payload dtype {payload.dtype} does not match "
+            f"claimed codec {codec!r} (expected {expect_dtype})"
+        )
+    if payload.shape != (n_blocks, cols):
+        raise WireCodecError(
+            f"lying codec tag: {codec} payload shape {payload.shape} does "
+            f"not match the leaf geometry (expected {(n_blocks, cols)})"
+        )
+    if scales.shape != (n_blocks,) or np.dtype(scales.dtype) != np.float32:
+        raise WireCodecError(
+            f"corrupt {codec} scales: shape {scales.shape} dtype "
+            f"{scales.dtype} (expected ({n_blocks},) float32)"
+        )
+    return q.dequantize_blocks(
+        payload, scales, tuple(shape), _resolve_dtype(dtype_name)
+    )
+
+
+def encode_state(
+    state: Any, codec: Optional[str], wire: str = "heal"
+) -> Tuple[Any, Dict[str, int]]:
+    """Encodes every eligible float leaf of ``state`` with ``codec``;
+    returns ``(encoded_state, stats)`` where stats carries the exact
+    pre/post byte accounting (also emitted as ``tpuft_codec_*``
+    counters labeled ``wire=``/``codec=``). ``codec`` None/"fp32" is the
+    identity: the INPUT object is returned untouched, so the default
+    path stays bit-for-bit (and allocation-free)."""
+    import jax
+
+    codec = resolve_codec(codec)
+    stats = {"encoded_leaves": 0, "pre_bytes": 0, "post_bytes": 0}
+    if codec == "fp32":
+        return state, stats
+    t0 = time.perf_counter()
+
+    def enc(leaf: Any) -> Any:
+        if not _eligible(leaf):
+            return leaf
+        marker = _encode_leaf(leaf, codec)
+        stats["encoded_leaves"] += 1
+        stats["pre_bytes"] += int(np.dtype(leaf.dtype).itemsize) * int(
+            np.prod(marker["shape"], dtype=np.int64)
+        )
+        stats["post_bytes"] += int(
+            marker["payload"].nbytes + marker["scales"].nbytes
+        )
+        return marker
+
+    encoded = jax.tree_util.tree_map(enc, state)
+    dt = time.perf_counter() - t0
+    metrics.observe("tpuft_codec_encode_seconds", dt, wire=wire)
+    if stats["encoded_leaves"]:
+        metrics.inc(
+            "tpuft_codec_bytes_pre_total", stats["pre_bytes"],
+            wire=wire, codec=codec,
+        )
+        metrics.inc(
+            "tpuft_codec_bytes_post_total", stats["post_bytes"],
+            wire=wire, codec=codec,
+        )
+    metrics.set_gauge(
+        "tpuft_codec_wire", CODEC_GAUGE_CODES[codec], wire=wire
+    )
+    return encoded, stats
+
+
+def decode_state(state: Any, wire: str = "heal") -> Any:
+    """Inverse of :func:`encode_state`: replaces every marker dict with
+    its dequantized array (or None when a skipped part nulled it).
+    Structure-driven — an unencoded tree passes through untouched — and
+    self-verifying: any marker whose payload does not match its claimed
+    codec raises :class:`WireCodecError` (counted in
+    ``tpuft_codec_decode_failures_total``), so a lying tag can never
+    become adopted state."""
+    import jax
+
+    t0 = time.perf_counter()
+    found = [0]
+
+    def dec(node: Any) -> Any:
+        if is_encoded_leaf(node):
+            found[0] += 1
+            return _decode_leaf(node)
+        return node
+
+    try:
+        decoded = jax.tree_util.tree_map(
+            dec, state, is_leaf=lambda x: is_encoded_leaf(x)
+        )
+    except WireCodecError:
+        metrics.inc("tpuft_codec_decode_failures_total", wire=wire)
+        raise
+    if found[0]:
+        metrics.observe(
+            "tpuft_codec_decode_seconds", time.perf_counter() - t0, wire=wire
+        )
+    return decoded
+
+
+def encoded_ratio(stats: Dict[str, int]) -> Optional[float]:
+    """post/pre byte ratio of one encode pass (None when nothing encoded)."""
+    if not stats.get("pre_bytes"):
+        return None
+    return stats["post_bytes"] / stats["pre_bytes"]
+
+
+def chunk_codecs_for(num_chunks: int, codec: Optional[str]) -> Optional[List[str]]:
+    """The per-chunk codec tag list for a stage: None for the fp32
+    default (the /meta stays format 2, bit-for-bit), else one tag per
+    chunk (decode is structure-driven; the tag is the negotiation +
+    digest-binding surface)."""
+    codec = resolve_codec(codec)
+    if codec == "fp32":
+        return None
+    return [codec] * num_chunks

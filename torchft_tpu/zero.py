@@ -45,6 +45,17 @@ transport stages each part as its own CRC'd chunk, and the joiner skips
 the parts it can re-balance from survivors (``TPUFT_ZERO_HEAL_SHARDS``;
 the skipped bytes land in ``tpuft_zero_heal_bytes_saved_total``).
 
+**Quantized shard wire** (``$TPUFT_ZERO_CODEC``, default fp32): the flat
+f32 plane encodes to fp8/int8/int4 on both bulk legs — the grad reduce
+rides the fused dequant-reduce-requant allreduce
+(:func:`torchft_tpu.parallel.collectives.allreduce_quantized`) and the
+master allgather ships packed ``[tag||scales||payload]`` ranges that
+EVERY replica (owners included) dequantizes identically, so bitwise
+replica identity survives by construction while the replica-axis bytes
+drop ~4x (8-bit) / ~8x (int4). Masters stay f32 on their owners; the
+env must agree fleet-wide (the wire tag turns disagreement into a hard
+error). See docs/zero.md.
+
 Composes with all three commit orderings (strict / overlapped /
 pipelined — rollback snapshots are whole :class:`ZeroState` objects,
 rebound never mutated), with DiLoCo/LocalSGD manager registration
@@ -62,8 +73,10 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
-from torchft_tpu import metrics
+from torchft_tpu import metrics, tracing, wire_codec
 from torchft_tpu.checkpointing.transport import HEAL_PART_PREFIX
+from torchft_tpu.ops import quantization as q
+from torchft_tpu.parallel.collectives import allreduce_quantized
 from torchft_tpu.manager import Manager
 from torchft_tpu.optim import (
     Optimizer,
@@ -330,8 +343,12 @@ class ZeroOptimizer(Optimizer):
     across replicas — it keys the shard-addressable heal format); choose
     a value divisible by the cohort sizes you expect so the
     ``pg.reduce_scatter`` fast path engages (``$TPUFT_ZERO_SHARDS``,
-    default 8, covers 1/2/4/8). ``should_quantize`` is not yet supported
-    on the sharded wire (the flat f32 plane is the v1 format).
+    default 8, covers 1/2/4/8). The sharded wire quantizes through
+    ``$TPUFT_ZERO_CODEC`` (fp8/int8/int4; fleet-wide agreement like
+    ``TPUFT_WIRE_DTYPE``) — NOT through the per-call ``should_quantize``
+    flag, which remains a no-op warning here: the codec is a wire
+    format, not a step flag, because every replica must decode the same
+    allgather bytes for bitwise identity to survive.
     """
 
     def __init__(
@@ -699,10 +716,59 @@ class ZeroOptimizer(Optimizer):
             "tpuft_zero_reduce_scatter_bytes_total", flat.nbytes,
             **_replica_labels(manager),
         )
+        # Quantized shard wire ($TPUFT_ZERO_CODEC, fleet-wide like
+        # TPUFT_WIRE_DTYPE): the flat f32 grad plane rides the fused
+        # dequant-reduce-requant allreduce at ~1/4 (fp8/int8) or ~1/8
+        # (int4) of the f32 bytes. Reduced values feed only the OWNED
+        # shards' updates, so cross-replica bitwise identity of the
+        # reduction is not required here — it is re-established by the
+        # allgather leg, where every replica dequantizes the same
+        # encoded master payload.
+        codec = wire_codec.zero_codec()
+        if codec != "fp32":
+            n_blocks = -(-flat.size // q.BLOCK)
+            pad_blocks = (-n_blocks) % max(pg.size(), 1)
+            post = (n_blocks + pad_blocks) * (4 + q.payload_cols(codec)) + (
+                q.WIRE_HEADER_BYTES * pg.size()
+            )
+            metrics.inc(
+                "tpuft_codec_bytes_pre_total", flat.nbytes,
+                wire="zero", codec=codec,
+            )
+            metrics.inc(
+                "tpuft_codec_bytes_post_total", int(post),
+                wire="zero", codec=codec,
+            )
+            metrics.set_gauge(
+                "tpuft_codec_wire", wire_codec.CODEC_GAUGE_CODES[codec],
+                wire="zero",
+            )
+            tracing.record(
+                "codec_wire",
+                step=manager.current_step(),
+                wire="zero",
+                codec=codec,
+                pre_bytes=int(flat.nbytes),
+                post_bytes=int(post),
+            )
+            try:
+                reduced = np.asarray(
+                    allreduce_quantized([flat], ReduceOp.SUM, pg, wire_dtype=codec)
+                    .wait()[0]
+                )
+                reduced = (reduced / nparts).astype(np.float32)
+                return {s: spec.shard_view(reduced, s) for s in ids}
+            except Exception as e:  # noqa: BLE001 — poison, never raise
+                logger.exception("ZeRO quantized grad reduce failed: %s", e)
+                manager.report_error(
+                    e if isinstance(e, Exception) else RuntimeError(str(e))
+                )
+                return None
         # Every rank derives the branch from globally-agreed facts (PG
         # size vs participant count, shard divisibility, the proven rank
-        # identity from the shared manifest round) so no rank can enter
-        # reduce_scatter while a peer enters allreduce.
+        # identity from the shared manifest round, the shared codec env)
+        # so no rank can enter reduce_scatter while a peer enters
+        # allreduce.
         fast = (
             pre_state.ranks_identical
             and pg.size() == nparts
@@ -751,9 +817,33 @@ class ZeroOptimizer(Optimizer):
         pg = manager._pg
         spec = self._spec
         ids = sorted(updated)
-        payload = [np.array(ids, dtype=np.int64)] + [
-            np.asarray(updated[s], dtype=np.float32) for s in ids
-        ]
+        # Quantized shard wire: owners encode their updated master ranges
+        # and EVERY replica — owners included — dequantizes the same
+        # encoded allgather payload through the same deterministic host
+        # codec, so params stay bitwise identical across replicas BY
+        # CONSTRUCTION (the wire bytes, not each owner's f32 local copy,
+        # are the source of truth for params). Masters themselves stay
+        # f32 on their owners; only the wire narrows.
+        codec = wire_codec.zero_codec()
+        shard_blocks = -(-spec.shard_len // q.BLOCK)
+        if codec == "fp32":
+            payload = [np.array(ids, dtype=np.int64)] + [
+                np.asarray(updated[s], dtype=np.float32) for s in ids
+            ]
+        else:
+            payload = [np.array(ids, dtype=np.int64)]
+            pre = 0
+            for s in ids:
+                rng = np.asarray(updated[s], dtype=np.float32)
+                pre += rng.nbytes
+                payload.append(q.pack_arrays(*q.quantize_blocks(rng, wire=codec)))
+            post = sum(int(a.nbytes) for a in payload[1:])
+            metrics.inc(
+                "tpuft_codec_bytes_pre_total", pre, wire="zero", codec=codec
+            )
+            metrics.inc(
+                "tpuft_codec_bytes_post_total", post, wire="zero", codec=codec
+            )
         sent = sum(int(a.nbytes) for a in payload[1:])
         metrics.inc(
             "tpuft_zero_allgather_bytes_total", sent,
@@ -776,7 +866,22 @@ class ZeroOptimizer(Optimizer):
             row_ids = np.asarray(arrays[0], dtype=np.int64)
             for slot, shard in enumerate(row_ids):
                 start, stop = spec.shard_range(int(shard))
-                flat[start:stop] = np.asarray(arrays[1 + slot], np.float32)
+                if codec == "fp32":
+                    rng = np.asarray(arrays[1 + slot], np.float32)
+                else:
+                    # unpack_arrays' embedded format tag asserts the
+                    # sender used OUR codec — a cross-rank
+                    # TPUFT_ZERO_CODEC disagreement is a hard error,
+                    # never a silent misdecode.
+                    p, sc = q.unpack_arrays(
+                        np.asarray(arrays[1 + slot], np.uint8).reshape(-1),
+                        shard_blocks,
+                        wire=codec,
+                    )
+                    rng = q.dequantize_blocks(
+                        p, sc, (spec.shard_len,), np.float32
+                    )
+                flat[start:stop] = rng
                 covered[int(shard)] = True
         if not covered.all():
             fallback = np.asarray(spec.pack(self.params), dtype=np.float32)
@@ -895,7 +1000,8 @@ def _warn_quantize_once() -> None:
     if not _WARNED_QUANTIZE[0]:
         _WARNED_QUANTIZE[0] = True
         logger.warning(
-            "should_quantize is not yet supported on the ZeRO sharded wire; "
-            "running the flat f32 plane (quantized shard ranges are a "
-            "format, not a flag — see docs/zero.md)"
+            "should_quantize is a no-op on the ZeRO sharded wire; set "
+            "TPUFT_ZERO_CODEC=fp8|int8|int4 instead (the codec is a wire "
+            "format every replica must agree on, not a per-step flag — "
+            "see docs/zero.md)"
         )
