@@ -1,0 +1,500 @@
+#!/usr/bin/env python
+"""Gray-failure straggler benchmark: slow-is-the-new-dead, with numbers.
+
+The ejection plane's acceptance evidence (ISSUE 15): a fleet whose
+commit cadence is dragged down by one gray replica must (1) reach a
+verdict and self-eject the straggler within a bounded window, (2)
+recover its healthy commit cadence — **post-ejection steady-state step
+time within 15% of the healthy baseline** — and (3) re-admit the
+replica after the fault clears; while hysteresis guarantees (4) a
+transient blip NEVER ejects and (5) a flapping replica's ejections are
+BOUNDED by the crash-loop park. All counter-exact against the
+``tpuft_health_*`` metrics.
+
+Topology: pure Python, no native plane — N simulated replicas (threads,
+each with its own trace-journal identity) run the REAL health machinery
+(``HealthMonitor`` / ``HealthScorer`` / ``QuarantineGate`` and the real
+``health.injected_stall`` chaos seam) against a dict health board and a
+membership-aware step barrier that models the commit barrier's defining
+property: the fleet steps at the pace of its slowest live member.
+
+Legs:
+
+- **baseline**: healthy fleet, median step time.
+- **persistent_straggler**: one replica gets a punisher-grade
+  ``slow_replica`` stall mid-run; measures time-to-verdict,
+  time-to-eject, degraded vs post-ejection cadence, and rejoin time
+  through the quarantine gate.
+- **transient_blip**: a one-window stall — hysteresis must hold
+  (0 verdicts, 0 ejections).
+- **flapping**: the replica re-grays itself after every rejoin —
+  ejections are bounded at ``max_ejects`` by the crash-loop park.
+- **wedge**: the replica's device sync never completes — the
+  step-progress watchdog must trip within its deadline and release the
+  fleet.
+
+Usage: ``python benchmarks/straggler_bench.py`` → one JSON line on
+stdout + STRAGGLER_BENCH.json in the repo root (~40 s wall).
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import sys
+import threading
+import time
+from pathlib import Path
+from typing import Any, Callable, Dict, List, Optional
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from torchft_tpu import health, metrics, tracing  # noqa: E402
+from torchft_tpu.health import (  # noqa: E402
+    HealthMonitor,
+    HealthScorer,
+    QuarantineGate,
+    StepWatchdog,
+)
+
+NUM_REPLICAS = 4
+BASE_STEP_S = 0.04
+STALL_S = 0.35
+THRESHOLD = 2.0
+CONSECUTIVE = 3  # K windows of hysteresis
+MIN_GAP_S = 0.05
+
+
+class Board:
+    """The quorum store's get/set surface, dict-backed."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.data: Dict[str, bytes] = {}
+
+    def set(self, key: str, value: bytes) -> None:
+        with self._lock:
+            self.data[key] = value
+
+    def get(self, key: str, timeout: float = 0.0, wait: bool = True):
+        with self._lock:
+            return self.data.get(key)
+
+
+class StepBarrier:
+    """Membership-aware step barrier: a step completes when every LIVE
+    replica has arrived — the commit barrier's pacing model (the fleet
+    moves at its slowest member). Arrivals record per-step release
+    times so cadence is measurable per phase window."""
+
+    def __init__(self, live: List[int]) -> None:
+        self._cond = threading.Condition()
+        self.live = set(live)
+        self._arrived: set = set()
+        self.gen = 0
+        self.closed = False
+        self.release_times: List[float] = []
+
+    def close(self) -> None:
+        """Releases every waiter immediately (leg teardown) — a parked
+        waiter must not outlive its leg and starve a later leg's
+        watchdog of beats."""
+        with self._cond:
+            self.closed = True
+            self._cond.notify_all()
+
+    def _maybe_release(self) -> None:
+        if self._arrived and self.live <= self._arrived:
+            self._arrived = set()
+            self.gen += 1
+            self.release_times.append(time.monotonic())
+            self._cond.notify_all()
+
+    def arrive(self, i: int, deadline_s: float = 120.0) -> Optional[float]:
+        """Blocks until the step releases; returns this replica's wait
+        (the commit-barrier wait — the straggler waits least)."""
+        t0 = time.monotonic()
+        with self._cond:
+            if i not in self.live or self.closed:
+                return None
+            self._arrived.add(i)
+            gen = self.gen
+            self._maybe_release()
+            while self.gen == gen and i in self.live and not self.closed:
+                if not self._cond.wait(timeout=0.5):
+                    if time.monotonic() - t0 > deadline_s:
+                        return None
+            if self.closed:
+                return None
+            return time.monotonic() - t0
+
+    def leave(self, i: int) -> None:
+        with self._cond:
+            self.live.discard(i)
+            self._arrived.discard(i)
+            self._maybe_release()
+
+    def join(self, i: int) -> None:
+        with self._cond:
+            self.live.add(i)
+
+
+class SimReplica(threading.Thread):
+    """One replica: real monitor + real chaos seam, simulated work."""
+
+    def __init__(
+        self,
+        index: int,
+        barrier: StepBarrier,
+        board: Board,
+        stop: threading.Event,
+        fault_plan: Callable[["SimReplica", int], None],
+        max_ejects: int = 10,
+        park_s: float = 1.5,
+        wedge_floor_s: float = 30.0,
+        probe: Optional[Callable[[], bool]] = None,
+    ) -> None:
+        super().__init__(daemon=True, name=f"sim-{index}")
+        self.index = index
+        self.replica_id = f"sim_{index}"
+        self.barrier = barrier
+        self.stop_event = stop
+        self.fault_plan = fault_plan
+        self.step = 0
+        self.events: List[Dict[str, Any]] = []
+        self.journal = tracing.TraceJournal()
+        self.journal.configure(replica_id=self.replica_id)
+        peers = [f"sim_{j}" for j in range(NUM_REPLICAS) if j != index]
+        self.monitor = HealthMonitor(
+            replica_id=self.replica_id,
+            min_replica_size=1,
+            scorer=HealthScorer(
+                self.replica_id, threshold=THRESHOLD, consecutive=CONSECUTIVE,
+                min_peers=2, alpha=0.5, min_gap_s=MIN_GAP_S, peer_ttl_s=120.0,
+            ),
+            gate=QuarantineGate(
+                self.replica_id, base_s=0.05, cap_s=0.4,
+                max_ejects=max_ejects, window_s=60.0, park_s=park_s,
+                state_dir="", probe=probe or (lambda: True),
+            ),
+            watchdog=StepWatchdog(lambda *a: None, scale=4.0,
+                                  floor_s=wedge_floor_s),
+            board=board,
+            trace=self.journal,
+            push_interval_s=0.0,
+            wedge_action=lambda: None,
+        )
+        self.monitor.set_peers(peers, board)
+
+    def note(self, kind: str) -> None:
+        self.events.append({"kind": kind, "t": time.monotonic(),
+                            "step": self.step})
+
+    def run(self) -> None:
+        with tracing.use_journal(self.journal):
+            while not self.stop_event.is_set():
+                self.fault_plan(self, self.step)
+                t0 = time.monotonic()
+                health.injected_stall("device_sync")  # the REAL chaos seam
+                time.sleep(BASE_STEP_S)
+                work = time.monotonic() - t0
+                self.monitor.scorer.observe("device_sync", work)
+                wait = self.barrier.arrive(self.index)
+                if wait is None:
+                    if self.stop_event.is_set():
+                        return
+                    continue
+                self.monitor.scorer.observe("commit_barrier", wait)
+                self.step += 1
+                self.monitor.on_step(
+                    self.step, participants=len(self.barrier.live)
+                )
+                reason = self.monitor.should_eject()
+                if reason is not None:
+                    self.note("eject")
+                    self.monitor.note_ejected(reason)
+                    self.barrier.leave(self.index)
+                    self.monitor.serve_quarantine_if_pending()  # blocks
+                    self.note("rejoin")
+                    self.barrier.join(self.index)
+
+
+def counters_snapshot() -> Dict[str, float]:
+    return {
+        "verdicts": metrics.counter_total("tpuft_health_verdicts_total"),
+        "ejections": metrics.counter_total("tpuft_health_ejections_total"),
+        "refused": metrics.counter_total("tpuft_health_ejections_refused_total"),
+        "wedge_trips": metrics.counter_total("tpuft_health_wedge_trips_total"),
+        "parked": metrics.counter_total("tpuft_health_parked_total"),
+        "probes_pass": metrics.counter_total(
+            "tpuft_health_probes_total", result="pass"
+        ),
+        "accusations": metrics.counter_total("tpuft_health_accusations_total"),
+        "injected": metrics.counter_total("tpuft_health_injected_faults_total"),
+    }
+
+
+def counters_delta(before: Dict[str, float]) -> Dict[str, float]:
+    after = counters_snapshot()
+    return {k: round(after[k] - before[k], 1) for k in after}
+
+
+def step_cadence(times: List[float]) -> Dict[str, float]:
+    if len(times) < 3:
+        return {"median_s": float("nan"), "p90_s": float("nan"), "steps": len(times)}
+    deltas = [b - a for a, b in zip(times, times[1:])]
+    deltas.sort()
+    return {
+        "median_s": round(statistics.median(deltas), 4),
+        "p90_s": round(deltas[int(0.9 * (len(deltas) - 1))], 4),
+        "steps": len(times),
+    }
+
+
+def run_leg(
+    fault_plan: Callable[[SimReplica, int], None],
+    duration_s: float,
+    max_ejects: int = 10,
+    park_s: float = 1.5,
+    wedge_floor_s: float = 30.0,
+    probe_for: Optional[Callable[[int], Optional[Callable[[], bool]]]] = None,
+    wedge_floor_for: Optional[Callable[[int], float]] = None,
+) -> Dict[str, Any]:
+    health.clear_injected()
+    board = Board()
+    barrier = StepBarrier(list(range(NUM_REPLICAS)))
+    stop = threading.Event()
+    replicas = [
+        SimReplica(i, barrier, board, stop, fault_plan,
+                   max_ejects=max_ejects, park_s=park_s,
+                   wedge_floor_s=(
+                       wedge_floor_for(i) if wedge_floor_for else wedge_floor_s
+                   ),
+                   probe=probe_for(i) if probe_for else None)
+        for i in range(NUM_REPLICAS)
+    ]
+    before = counters_snapshot()
+    t_start = time.monotonic()
+    for r in replicas:
+        r.start()
+    time.sleep(duration_s)
+    stop.set()
+    # Teardown order matters for counter exactness: watchdogs stop FIRST
+    # (a beatless watchdog during teardown must not fake a wedge trip),
+    # then the barrier and any wedge waiters release so threads exit.
+    for r in replicas:
+        r.monitor.stop()
+    barrier.close()
+    for r in replicas:
+        health.clear_injected(r.replica_id)  # release any wedge waiter
+    for r in replicas:
+        r.join(timeout=30.0)
+    return {
+        "t_start": t_start,
+        "release_times": list(barrier.release_times),
+        "replicas": replicas,
+        "counters": counters_delta(before),
+    }
+
+
+def no_fault(replica: SimReplica, step: int) -> None:
+    pass
+
+
+def main() -> None:
+    out: Dict[str, Any] = {
+        "fleet": {
+            "replicas": NUM_REPLICAS,
+            "base_step_s": BASE_STEP_S,
+            "stall_s": STALL_S,
+            "threshold": THRESHOLD,
+            "consecutive_windows": CONSECUTIVE,
+            "min_gap_s": MIN_GAP_S,
+        },
+    }
+    counter_exact = True
+
+    # ---- baseline -------------------------------------------------------
+    leg = run_leg(no_fault, duration_s=4.0)
+    baseline = step_cadence(leg["release_times"])
+    out["baseline"] = baseline
+    assert leg["counters"]["ejections"] == 0, leg["counters"]
+    print(f"[bench] baseline: {baseline}", file=sys.stderr)
+
+    # ---- persistent straggler ------------------------------------------
+    state: Dict[str, Any] = {}
+
+    def straggler_plan(replica: SimReplica, step: int) -> None:
+        if replica.index == 0 and step == 20 and "t_stall" not in state:
+            state["t_stall"] = time.monotonic()
+            # The gray condition persists ~6 s: the quarantine probe
+            # keeps failing (exponential backoff) until the host
+            # recovers, so the post-ejection cadence window is real.
+            state["fault_clears_at"] = state["t_stall"] + 6.0
+            health.install_injected(
+                "slow_replica", replica_id=replica.replica_id, stall_s=STALL_S
+            )
+            replica.note("stall_installed")
+
+    def straggler_probe(index: int):
+        if index != 0:
+            return None
+        return lambda: time.monotonic() >= state.get("fault_clears_at", 0.0)
+
+    leg = run_leg(straggler_plan, duration_s=14.0, probe_for=straggler_probe)
+    victim = leg["replicas"][0]
+    ejects = [e for e in victim.events if e["kind"] == "eject"]
+    rejoins = [e for e in victim.events if e["kind"] == "rejoin"]
+    assert ejects, "straggler never self-ejected"
+    t_stall = state["t_stall"]
+    t_eject = ejects[0]["t"]
+    t_rejoin = rejoins[0]["t"] if rejoins else None
+    # Cadence windows: degraded = stall..eject; post-ejection = eject..rejoin.
+    degraded = step_cadence(
+        [t for t in leg["release_times"] if t_stall <= t <= t_eject]
+    )
+    post_eject_end = t_rejoin if t_rejoin else leg["release_times"][-1]
+    post = step_cadence(
+        [t for t in leg["release_times"] if t_eject < t <= post_eject_end]
+    )
+    ratio = post["median_s"] / baseline["median_s"]
+    straggler_counters = leg["counters"]
+    out["persistent_straggler"] = {
+        "time_to_eject_s": round(t_eject - t_stall, 3),
+        "eject_bound_s": round((CONSECUTIVE + 2) * (BASE_STEP_S + STALL_S), 3),
+        "rejoin_s": round(t_rejoin - t_eject, 3) if t_rejoin else None,
+        "degraded_step": degraded,
+        "post_ejection_step": post,
+        "post_vs_baseline": round(ratio, 3),
+        "within_15pct": bool(ratio <= 1.15),
+        "advisory_accusations_from_peers": straggler_counters["accusations"],
+        "counters": straggler_counters,
+    }
+    counter_exact &= (
+        straggler_counters["verdicts"] == 1
+        and straggler_counters["ejections"] == 1
+        and straggler_counters["injected"] == 1
+        and straggler_counters["wedge_trips"] == 0
+    )
+    print(f"[bench] straggler: {out['persistent_straggler']}", file=sys.stderr)
+
+    # ---- transient blip (hysteresis must hold) -------------------------
+    blip: Dict[str, Any] = {}
+
+    def blip_plan(replica: SimReplica, step: int) -> None:
+        if replica.index == 1 and step == 15 and "on" not in blip:
+            blip["on"] = True
+            health.install_injected(
+                "slow_replica", replica_id=replica.replica_id, stall_s=STALL_S
+            )
+        # Cleared after ONE slow window — fewer than K consecutive.
+        if replica.index == 1 and step == 16 and "off" not in blip:
+            blip["off"] = True
+            health.clear_injected(replica.replica_id)
+
+    leg = run_leg(blip_plan, duration_s=6.0)
+    blip_counters = leg["counters"]
+    out["transient_blip"] = {
+        "ejections": blip_counters["ejections"],
+        "verdicts": blip_counters["verdicts"],
+        "hysteresis_holds": bool(
+            blip_counters["ejections"] == 0 and blip_counters["verdicts"] == 0
+        ),
+        "counters": blip_counters,
+    }
+    counter_exact &= blip_counters["ejections"] == 0
+    print(f"[bench] blip: {out['transient_blip']}", file=sys.stderr)
+
+    # ---- flapping (bounded by the crash-loop park) ---------------------
+    MAX_EJECTS = 2
+
+    def flap_plan(replica: SimReplica, step: int) -> None:
+        # Re-grays itself 3 steps after every rejoin, until parked once.
+        if replica.index == 2 and replica.monitor.gate.parked_until() == 0:
+            rejoin_steps = [
+                e["step"] for e in replica.events if e["kind"] == "rejoin"
+            ]
+            last_rejoin = rejoin_steps[-1] if rejoin_steps else 10
+            flapping = replica.replica_id not in health._INJECTED
+            if flapping and step >= last_rejoin + 3 and len(
+                [e for e in replica.events if e["kind"] == "eject"]
+            ) < MAX_EJECTS + 2:
+                health.install_injected(
+                    "slow_replica", replica_id=replica.replica_id,
+                    stall_s=STALL_S,
+                )
+
+    leg = run_leg(flap_plan, duration_s=16.0, max_ejects=MAX_EJECTS,
+                  park_s=2.0)
+    flap_counters = leg["counters"]
+    out["flapping"] = {
+        "max_ejects": MAX_EJECTS,
+        "ejections": flap_counters["ejections"],
+        "parked": flap_counters["parked"],
+        "bounded": bool(
+            flap_counters["parked"] >= 1
+            and flap_counters["ejections"] <= MAX_EJECTS + 1
+        ),
+        "counters": flap_counters,
+    }
+    counter_exact &= flap_counters["parked"] >= 1
+    print(f"[bench] flapping: {out['flapping']}", file=sys.stderr)
+
+    # ---- wedge (the step-progress watchdog) ----------------------------
+    wedge: Dict[str, Any] = {}
+
+    def wedge_plan(replica: SimReplica, step: int) -> None:
+        if replica.index == 3 and step == 15 and "t_wedge" not in wedge:
+            wedge["t_wedge"] = time.monotonic()
+            health.install_injected("wedge_device",
+                                    replica_id=replica.replica_id)
+
+    # Only the victim runs the tight 1 s floor: a fleet stalled behind a
+    # wedged PEER stops everyone's step progress, so survivors' floors
+    # must exceed the exclusion time or they false-positive en masse —
+    # exactly why the production default floor (30 s) sits above quorum
+    # heartbeat expiry + join timeout.
+    leg = run_leg(
+        wedge_plan, duration_s=8.0,
+        wedge_floor_for=lambda i: 1.0 if i == 3 else 30.0,
+    )
+    wedged = leg["replicas"][3]
+    wedge_counters = leg["counters"]
+    trip = [e for e in wedged.events if e["kind"] == "eject"]
+    out["wedge"] = {
+        "watchdog_floor_s": 1.0,
+        "time_to_eject_s": (
+            round(trip[0]["t"] - wedge["t_wedge"], 3) if trip else None
+        ),
+        "wedge_trips": wedge_counters["wedge_trips"],
+        "counters": wedge_counters,
+    }
+    counter_exact &= (
+        wedge_counters["wedge_trips"] == 1
+        and wedge_counters["ejections"] == 1
+    )
+    print(f"[bench] wedge: {out['wedge']}", file=sys.stderr)
+
+    out["counter_exact"] = bool(counter_exact)
+    out["acceptance"] = {
+        "post_ejection_within_15pct_of_baseline": out["persistent_straggler"][
+            "within_15pct"
+        ],
+        "time_to_eject_bounded": bool(
+            out["persistent_straggler"]["time_to_eject_s"]
+            <= out["persistent_straggler"]["eject_bound_s"]
+        ),
+        "transient_blip_zero_ejections": out["transient_blip"][
+            "hysteresis_holds"
+        ],
+        "flapping_bounded": out["flapping"]["bounded"],
+        "counter_exact": out["counter_exact"],
+    }
+
+    artifact = Path(__file__).resolve().parents[1] / "STRAGGLER_BENCH.json"
+    artifact.write_text(json.dumps(out, indent=2) + "\n")
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -12,6 +12,8 @@ commit age, heal-in-progress, the joiner count each replica observed in
 its last quorum (the JOINERS column — the mass-rejoin storm gauge),
 the serving tier's relay position (the RELAY column —
 depth/upstreams/parked long-poll subscribers from the relay gauges),
+the gray-failure verdict/quarantine state plus any advisory straggler
+accusation (the HEALTH column — ``tpuft_health_*`` gauges),
 heartbeat age. The LAG column derives
 straggler attribution from the trace plane's pushed per-step phase
 durations (``trace/<replica_id>/<rank>``): at the latest shared step, the
@@ -220,6 +222,35 @@ def _wire_state(snapshot: Dict[str, Any]) -> Optional[str]:
     return " ".join(sorted(cells)) or None
 
 
+def _health_state(snapshot: Dict[str, Any]) -> Optional[str]:
+    """Gray-failure verdict state from the pushed ``tpuft_health_*``
+    gauges: the state name (ok / suspect / degraded / quar / parked),
+    ``/e<n>`` when the replica has self-ejected n times, and
+    ``>accused`` when it is currently publishing an ADVISORY barrier-
+    asymmetry accusation (never an actuation — only self-verdicts
+    eject). None when the replica runs no health monitor. A row stuck
+    at "degraded" is the min_replica-refusal regime: the verdict
+    latched but ejecting would drop the quorum below min_replica_size
+    (tpuft_health_ejections_refused_total counts it)."""
+    state = _gauge(snapshot, "tpuft_health_state")
+    if state is None:
+        return None
+    names = {0: "ok", 1: "suspect", 2: "degraded", 3: "quar", 4: "parked"}
+    cell = names.get(int(state), "?")
+    ejections = _counter_total(snapshot, "tpuft_health_ejections_total")
+    if ejections:
+        cell += f"/e{int(ejections)}"
+    accuse_entries = (
+        (snapshot.get("metrics") or {}).get("gauges", {}).get("tpuft_health_accuse")
+    ) or []
+    for entry in accuse_entries:
+        if entry.get("value") == 1:
+            accused = (entry.get("labels") or {}).get("accused", "?")
+            cell += f">{accused}"
+            break
+    return cell
+
+
 def _publish_state(snapshot: Dict[str, Any], now: float) -> Optional[str]:
     """Serving-plane publication state from the pushed gauges: the last
     published step and how stale it is ("s12@3s"), or None when the
@@ -284,6 +315,7 @@ def collect(lighthouse_addr: str, prev: Optional[Dict[str, Any]] = None) -> Dict
                     ),
                     heals=_counter_total(snap, "tpuft_heals_total"),
                     serve=_serve_state(snap),
+                    health=_health_state(snap),
                     shard=_shard_state(snap),
                     wire=_wire_state(snap),
                     publish=_publish_state(snap, now),
@@ -328,6 +360,7 @@ _COLUMNS = (
     ("commit_failures", "FAILED"),
     ("heals", "HEALS"),
     ("serve", "SERVE"),
+    ("health", "HEALTH"),
     ("shard", "SHARD"),
     ("wire", "WIRE"),
     ("publish", "PUBLISH"),

@@ -30,8 +30,9 @@ Clock alignment (wall clocks across hosts are NOT trusted):
 
 ``--explain-step N`` prints a causal narrative for one step: straggler
 attribution per phase (who entered the commit barrier last and by how
-much), who voted abort and the linked ``report_error``, heal progress at
-that instant, and the surrounding quorum transitions.
+much), who voted abort and the linked ``report_error``, health-plane
+verdict/ejection/quarantine lines (incl. advisory accusations), heal
+progress at that instant, and the surrounding quorum transitions.
 
 Usage::
 
@@ -516,6 +517,56 @@ def explain_step(merged: List[Dict[str, Any]], step: int) -> str:
             f"adaptive: {proc_label(proc_key(e))} moved the window depth "
             f"to {args.get('depth', '?')}"
         )
+
+    # Gray-failure health plane: verdicts, ejections (and refusals),
+    # wedge-watchdog trips, quarantine service, and ADVISORY accusations
+    # touching this step (torchft_tpu/health.py events).
+    for e in at_step:
+        name = e["name"]
+        args = e.get("args") or {}
+        who = proc_label(proc_key(e))
+        if name == "health_verdict":
+            lines.append(
+                f"health: {who} judged ITSELF degraded after "
+                f"{args.get('streak', '?')} consecutive slow windows "
+                f"(phase ratios vs fleet median: {args.get('ratios', '?')}, "
+                f"{args.get('peers', '?')} peer snapshot(s))"
+            )
+        elif name == "health_ejection":
+            lines.append(
+                f"health: {who} SELF-EJECTED at the step boundary — "
+                f"{args.get('reason', '?')}"
+            )
+        elif name == "health_ejection_refused":
+            lines.append(
+                f"health: {who} degraded verdict REFUSED ejection — "
+                f"{args.get('participants', '?')} participant(s) would drop "
+                f"below min_replica {args.get('min_replica', '?')}; training "
+                "continues degraded"
+            )
+        elif name == "health_wedge":
+            lines.append(
+                f"health: {who} step-progress watchdog tripped — no step in "
+                f"{args.get('elapsed_s', '?')}s (deadline "
+                f"{args.get('deadline_s', '?')}s from its own cadence)"
+            )
+        elif name == "health_quarantine" and args.get("phase") == "served":
+            lines.append(
+                f"health: {who} served quarantine — {args.get('attempts', '?')}"
+                f" probe attempt(s), {args.get('waited_s', '?')}s waited"
+                + (", crash-loop PARKED first" if args.get("parked") else "")
+            )
+        elif name == "health_quarantine" and args.get("phase") == "parked":
+            lines.append(
+                f"health: {who} crash-loop parked for {args.get('wait_s', '?')}s "
+                f"({args.get('ejections', '?')} ejection(s) in the window)"
+            )
+        elif name == "health_accuse":
+            lines.append(
+                f"health: {who} ADVISORY accusation -> {args.get('accused', '?')} "
+                f"(barrier-wait asymmetry {_fmt_ms(float(args.get('gap_s', 0.0)))}; "
+                "advisory only — peers never eject peers)"
+            )
 
     # Heal activity touching this step.
     heal_spans = [e for e in at_step if e["name"] in ("heal_recv", "heal_send")]

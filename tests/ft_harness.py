@@ -32,6 +32,7 @@ lockcheck.maybe_enable_from_env(default="1")
 
 from torchft_tpu.coordination import LighthouseServer  # noqa: E402
 from torchft_tpu.ddp import ft_allreduce_gradients
+from torchft_tpu.health import DegradedReplicaError
 from torchft_tpu.manager import Manager
 from torchft_tpu.optim import Optimizer
 from torchft_tpu.parallel.process_group import (
@@ -236,11 +237,15 @@ class Runner:
                     for fut in futures:
                         results.append(fut.result())
                     return results
-            except InjectedFailure:
+            except (InjectedFailure, DegradedReplicaError) as e:
+                # Both are "supervisor restarts the group" in production:
+                # an injected process death, or the health plane's
+                # self-ejection escalating out of start_quorum.
                 logger.info(
-                    "replica %d attempt %d died (injected); restarting",
+                    "replica %d attempt %d died (%s); restarting",
                     self.replica_group,
                     attempt,
+                    type(e).__name__,
                 )
                 time.sleep(0.2)
                 continue

@@ -3,9 +3,15 @@
 kill_donor_mid_heal / corrupt_stream / stall_donor + the serving-plane
 rollback storm retract_version — each group publishes every commit, so
 the arm is consumed by a real publication and the retraction/history
-path runs under the same chaos), driven by the punisher against a live
-lighthouse — the CI promotion of the reference's slurm/monarch chaos
-drives (punisher.py + failure.py:25-100).
+path runs under the same chaos — + the GRAY-failure arms slow_replica /
+wedge_device / drip_wire: the job runs with the health plane armed
+(TPUFT_HEALTH=1, fast verdict knobs), so a grayed group must self-eject
+at a step boundary, relaunch through the quarantine gate, and rejoin —
+the injected stall/wedge clears with the process, and recovery is gated
+on observed quorum status like every other fault, never on sleeps),
+driven by the punisher against a live lighthouse — the CI promotion of
+the reference's slurm/monarch chaos drives (punisher.py +
+failure.py:25-100).
 
 ON by default (a soak that never runs automatically is a soak that rots —
 round-2 verdict weak #5): every full-suite run pays the ~2 minutes.
@@ -234,6 +240,29 @@ def test_chaos_soak_full_fault_menu(tmp_path) -> None:
                 # Donor transports consume punisher-armed stream faults
                 # (corrupt_stream / stall_donor) from this file.
                 faultinject.ENV_FAULT_FILE: fault_file,
+                # Gray-failure plane armed with soak-scale knobs: a
+                # slow_replica/drip_wire arm (persistent ~300 ms stall)
+                # must verdict in ~2 windows against the 1 healthy peer
+                # and self-eject; a wedge_device arm must trip the
+                # step-progress watchdog and SIGTERM out. The watchdog
+                # floor sits ABOVE the pg/heal op timeout (8 s): a group
+                # blocked in a collective against a dying peer must not
+                # false-trip its own wedge deadline.
+                # Quarantine is fast (probe skipped — no accelerator in
+                # this job) and parking is bounded so a repeatedly
+                # punished group cannot stall the soak.
+                "TPUFT_HEALTH": "1",
+                "TPUFT_HEALTH_MIN_PEERS": "1",
+                "TPUFT_HEALTH_CONSECUTIVE": "2",
+                "TPUFT_HEALTH_THRESHOLD": "2.5",
+                "TPUFT_HEALTH_PUSH_SEC": "0.5",
+                "TPUFT_HEALTH_SLOW_MS": "300",
+                "TPUFT_HEALTH_WEDGE_FLOOR_SEC": "10",
+                "TPUFT_HEALTH_PROBE": "0",
+                "TPUFT_QUARANTINE_BASE_SEC": "0.2",
+                "TPUFT_QUARANTINE_CAP_SEC": "1",
+                "TPUFT_QUARANTINE_WINDOW_SEC": "30",
+                "TPUFT_QUARANTINE_PARK_SEC": "2",
             },
         )
     finally:

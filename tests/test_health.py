@@ -1,0 +1,934 @@
+"""Gray-failure ejection plane tests (torchft_tpu/health.py).
+
+Coverage tiers:
+
+1. pure logic (always runs): scorer EWMAs + fleet-relative hysteresis
+   (a transient slow step NEVER ejects — unit-pinned), barrier-asymmetry
+   accusations (advisory only), quarantine backoff schedule + crash-loop
+   parking + persistence, step-progress watchdog deadlines;
+2. chaos seams (always runs): punisher-armed slow_replica / wedge_device
+   / drip_wire consume-once semantics and per-replica scoping;
+3. monitor + mock manager (always runs): the step-boundary loop against
+   a dict board, the min_replica ejection refusal, and the
+   DegradedReplicaError escalation out of ``start_quorum``;
+4. threads-as-replicas drills (native-gated; skip cleanly without the
+   toolchain): a persistent straggler self-ejects and rejoins via the
+   normal heal path in strict AND pipelined depth-2 orderings, bitwise
+   identity throughout, zero wrong adoptions.
+"""
+
+import json
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+import pytest
+
+from torchft_tpu import health, metrics, tracing
+from torchft_tpu.health import (
+    DegradedReplicaError,
+    HealthMonitor,
+    HealthScorer,
+    QuarantineGate,
+    StepWatchdog,
+)
+from torchft_tpu.utils import faultinject
+
+
+@pytest.fixture(autouse=True)
+def _clean_injected():
+    health.clear_injected()
+    yield
+    health.clear_injected()
+
+
+class FakeBoard:
+    """Dict-backed health board (the quorum store's get/set surface)."""
+
+    def __init__(self) -> None:
+        self.data: Dict[str, bytes] = {}
+
+    def set(self, key: str, value: bytes) -> None:
+        self.data[key] = value
+
+    def get(self, key: str, timeout: float = 0.0, wait: bool = True):
+        return self.data.get(key)
+
+
+def _quiet_watchdog() -> StepWatchdog:
+    return StepWatchdog(lambda *a: None, floor_s=300.0)
+
+
+# ---------------------------------------------------------------------------
+# scorer
+# ---------------------------------------------------------------------------
+
+
+def test_scorer_ewma_and_fleet_median() -> None:
+    s = HealthScorer("r0", threshold=2.0, consecutive=2, min_peers=2,
+                     alpha=0.5, min_gap_s=0.01)
+    s.observe("device_sync", 0.1)
+    s.observe("device_sync", 0.3)
+    assert s.ewma["device_sync"] == pytest.approx(0.2)
+    s.note_peer("r1", {"device_sync": 0.05})
+    s.note_peer("r2", {"device_sync": 0.07})
+    v = s.evaluate()
+    assert v["judgeable"] and v["slow"]
+    assert v["ratios"]["device_sync"] == pytest.approx(0.2 / 0.06, rel=0.05)
+
+
+def test_transient_blip_never_ejects_hysteresis_pinned() -> None:
+    """THE hysteresis contract: one (or K-1) slow windows followed by a
+    healthy one reset the streak — a transient blip cannot reach a
+    degraded verdict."""
+    s = HealthScorer("r0", threshold=2.0, consecutive=3, min_peers=2,
+                     alpha=1.0, min_gap_s=0.01)
+    s.note_peer("r1", {"device_sync": 0.05})
+    s.note_peer("r2", {"device_sync": 0.05})
+    s.observe("device_sync", 0.05)
+    s.observe("device_sync", 0.5)  # the blip (alpha=1: EWMA = last value)
+    v1 = s.evaluate()
+    assert v1["slow"] and not v1["degraded"] and v1["streak"] == 1
+    v2 = s.evaluate()
+    assert v2["streak"] == 2 and not v2["degraded"]
+    s.observe("device_sync", 0.05)  # recovered before the K-th window
+    v3 = s.evaluate()
+    assert not v3["slow"] and v3["streak"] == 0 and not v3["degraded"]
+    # A persistent straggler DOES latch after K consecutive windows.
+    s.observe("device_sync", 0.5)
+    for expect in (1, 2):
+        assert s.evaluate()["streak"] == expect
+    assert s.evaluate()["degraded"]
+
+
+def test_scorer_absolute_gap_floor_filters_microsecond_noise() -> None:
+    s = HealthScorer("r0", threshold=2.0, consecutive=1, min_peers=2,
+                     alpha=1.0, min_gap_s=0.05)
+    s.note_peer("r1", {"device_sync": 0.0001})
+    s.note_peer("r2", {"device_sync": 0.0001})
+    s.observe("device_sync", 0.001)  # 10x the median but only +0.9 ms
+    s.observe("device_sync", 0.001)
+    v = s.evaluate()
+    assert v["judgeable"] and not v["slow"]
+
+
+def test_scorer_uniformly_slow_fleet_is_healthy() -> None:
+    """Fleet-relative by construction: when everyone is equally slow
+    (e.g. a big model), nobody is a straggler."""
+    s = HealthScorer("r0", threshold=2.0, consecutive=1, min_peers=2,
+                     alpha=1.0, min_gap_s=0.01)
+    s.note_peer("r1", {"device_sync": 2.0})
+    s.note_peer("r2", {"device_sync": 2.1})
+    s.observe("device_sync", 2.05)
+    s.observe("device_sync", 2.05)
+    v = s.evaluate()
+    assert v["judgeable"] and not v["slow"]
+
+
+def test_scorer_needs_min_fresh_peers_and_expires_stale() -> None:
+    clock = {"t": 1000.0}
+    s = HealthScorer("r0", threshold=2.0, consecutive=1, min_peers=2,
+                     alpha=1.0, peer_ttl_s=10.0, min_gap_s=0.01,
+                     wall=lambda: clock["t"])
+    s.observe("device_sync", 1.0)
+    s.observe("device_sync", 1.0)
+    s.note_peer("r1", {"device_sync": 0.05})
+    assert not s.evaluate()["judgeable"]  # one peer < min_peers
+    s.note_peer("r2", {"device_sync": 0.05})
+    assert s.evaluate()["judgeable"]
+    clock["t"] += 60.0  # both snapshots now stale
+    v = s.evaluate()
+    assert not v["judgeable"] and len(s.fresh_peers()) == 0
+
+
+def test_scorer_ingest_rollup_each_step_once() -> None:
+    s = HealthScorer("r0", alpha=1.0)
+    rollup = [
+        {"step": 1, "phases": {"device_sync": 0.1, "commit_barrier": 0.2}},
+        {"step": 2, "phases": {"device_sync": 0.3}},
+    ]
+    s.ingest_rollup(rollup)
+    assert s.counts["device_sync"] == 2
+    s.ingest_rollup(rollup)  # same steps: ignored
+    assert s.counts["device_sync"] == 2
+    s.ingest_rollup([{"step": 3, "phases": {"device_sync": 0.4}}])
+    assert s.counts["device_sync"] == 3
+
+
+def test_accusation_from_barrier_asymmetry_is_advisory() -> None:
+    """The member with the SMALLEST barrier wait entered last — it held
+    the fleet up. accuse() only returns a name; nothing in the module
+    can act on another replica (no kill RPC exists here at all)."""
+    s = HealthScorer("r0", threshold=2.0, min_peers=2, alpha=1.0,
+                     min_gap_s=0.05)
+    s.observe("commit_barrier", 0.5)
+    s.observe("commit_barrier", 0.5)
+    s.note_peer("r1", {"commit_barrier": 0.45})
+    s.note_peer("r2", {"commit_barrier": 0.02})  # entered last = straggler
+    accused, gap = s.accuse()
+    assert accused == "r2" and gap == pytest.approx(0.48)
+    # Symmetric waits: no accusation.
+    s.note_peer("r2", {"commit_barrier": 0.48})
+    assert s.accuse() is None
+
+
+# ---------------------------------------------------------------------------
+# quarantine gate
+# ---------------------------------------------------------------------------
+
+
+def test_quarantine_backoff_schedule_exact() -> None:
+    sleeps: List[float] = []
+    outcomes = iter([False, False, False, True])
+    gate = QuarantineGate(
+        "r0", base_s=1.0, cap_s=4.0, max_ejects=10, window_s=100.0,
+        park_s=50.0, state_dir="", probe=lambda: next(outcomes),
+        sleep=sleeps.append, wall=lambda: 1000.0,
+    )
+    before_pass = metrics.counter_total("tpuft_health_probes_total", result="pass")
+    before_fail = metrics.counter_total("tpuft_health_probes_total", result="fail")
+    record = gate.serve(trace=tracing.TraceJournal())
+    # base * 2^n capped at 4: 1, 2, 4, 4.
+    assert sleeps == [1.0, 2.0, 4.0, 4.0]
+    assert record["attempts"] == 4 and not record["parked"]
+    assert record["waited_s"] == pytest.approx(11.0)
+    assert metrics.counter_total("tpuft_health_probes_total", result="pass") - before_pass == 1
+    assert metrics.counter_total("tpuft_health_probes_total", result="fail") - before_fail == 3
+
+
+def test_quarantine_crash_loop_parks_until_cooldown() -> None:
+    clock = {"t": 1000.0}
+    sleeps: List[float] = []
+
+    def sleep(s: float) -> None:
+        sleeps.append(s)
+        clock["t"] += s
+
+    gate = QuarantineGate(
+        "r0", base_s=0.5, cap_s=0.5, max_ejects=3, window_s=100.0,
+        park_s=50.0, state_dir="", probe=lambda: True, sleep=sleep,
+        wall=lambda: clock["t"],
+    )
+    for i in range(3):
+        gate.record_ejection(f"eject {i}")
+        clock["t"] += 1.0
+    assert gate.pending()
+    park_until = gate.parked_until()
+    assert park_until == pytest.approx(1002.0 + 50.0)
+    before_park = metrics.counter_total("tpuft_health_parked_total")
+    record = gate.serve(trace=tracing.TraceJournal())
+    assert record["parked"]
+    # Park remainder first (50 - 1s since last ejection), then one probe
+    # backoff.
+    assert sleeps[0] == pytest.approx(49.0)
+    assert sleeps[1] == pytest.approx(0.5)
+    assert metrics.counter_total("tpuft_health_parked_total") - before_park == 1
+
+
+def test_quarantine_window_prunes_old_ejections() -> None:
+    clock = {"t": 1000.0}
+    gate = QuarantineGate(
+        "r0", base_s=0.1, cap_s=0.1, max_ejects=2, window_s=10.0,
+        park_s=50.0, state_dir="", probe=lambda: True,
+        sleep=lambda s: None, wall=lambda: clock["t"],
+    )
+    gate.record_ejection("old")
+    clock["t"] += 100.0  # far outside the window
+    assert not gate.pending() and gate.parked_until() == 0.0
+    gate.record_ejection("fresh")
+    assert gate.pending() and gate.parked_until() == 0.0  # 1 < max_ejects
+
+
+def test_quarantine_state_persists_across_restarts(tmp_path) -> None:
+    gate = QuarantineGate(
+        "replica_7", base_s=0.1, cap_s=0.1, max_ejects=5, window_s=1000.0,
+        park_s=5.0, state_dir=str(tmp_path), probe=lambda: True,
+        sleep=lambda s: None,
+    )
+    gate.record_ejection("wedged device")
+    # A fresh gate (the restarted process) sees the persisted record.
+    reborn = QuarantineGate(
+        "replica_7", base_s=0.1, cap_s=0.1, max_ejects=5, window_s=1000.0,
+        park_s=5.0, state_dir=str(tmp_path), probe=lambda: True,
+        sleep=lambda s: None,
+    )
+    assert reborn.pending() and reborn.last_reason == "wedged device"
+    files = list(tmp_path.glob("quarantine_*.json"))
+    assert len(files) == 1
+    data = json.loads(files[0].read_text())
+    assert len(data["ejections"]) == 1
+
+
+def test_quarantine_probe_never_passing_is_bounded() -> None:
+    sleeps: List[float] = []
+    gate = QuarantineGate(
+        "r0", base_s=0.1, cap_s=0.2, max_ejects=10, window_s=100.0,
+        park_s=5.0, state_dir="", probe=lambda: False,
+        sleep=sleeps.append, wall=lambda: 0.0,
+    )
+    record = gate.serve(trace=tracing.TraceJournal(), max_attempts=5)
+    assert record["attempts"] == 5 and len(sleeps) == 5
+
+
+# ---------------------------------------------------------------------------
+# step-progress watchdog
+# ---------------------------------------------------------------------------
+
+
+def test_watchdog_deadline_scales_from_own_cadence() -> None:
+    wd = StepWatchdog(lambda *a: None, scale=5.0, floor_s=0.1)
+    try:
+        assert wd.deadline_s() == pytest.approx(0.1)  # floor before evidence
+        clock = [0.0]
+        wd._mono = lambda: clock[0]
+        for t in (0.0, 0.5, 1.0):  # interval EWMA -> 0.5
+            clock[0] = t
+            wd.beat()
+        assert wd.deadline_s() == pytest.approx(2.5)  # scale * interval
+    finally:
+        wd.stop()
+
+
+def test_watchdog_fires_once_on_missing_beat_and_rearms() -> None:
+    fired = []
+    done = threading.Event()
+
+    def on_wedge(elapsed: float, deadline: float) -> None:
+        fired.append((elapsed, deadline))
+        done.set()
+
+    wd = StepWatchdog(on_wedge, scale=2.0, floor_s=0.2)
+    try:
+        wd.beat()
+        time.sleep(0.05)
+        wd.beat()  # beating: must not fire yet
+        assert not fired
+        assert done.wait(5.0), "watchdog never fired after beats stopped"
+        time.sleep(0.3)
+        assert len(fired) == 1, "watchdog must fire once per missed beat"
+        elapsed, deadline = fired[0]
+        assert elapsed > deadline
+        # A new beat re-arms it.
+        done.clear()
+        wd.beat()
+        assert done.wait(5.0)
+        assert len(fired) == 2
+    finally:
+        wd.stop()
+
+
+# ---------------------------------------------------------------------------
+# chaos seams (slow_replica / wedge_device / drip_wire)
+# ---------------------------------------------------------------------------
+
+
+def test_injected_slow_replica_scopes_to_consuming_replica(tmp_path, monkeypatch) -> None:
+    """One arm = one straggler: the consuming thread's journal identity
+    keys the persistent stall; other replicas' device syncs are
+    untouched (the threads-as-replicas scoping the drills rely on)."""
+    from torchft_tpu import optim
+
+    fault_file = str(tmp_path / "fault")
+    monkeypatch.setenv(faultinject.ENV_FAULT_FILE, fault_file)
+    monkeypatch.setenv(health.ENV_SLOW_MS, "80")
+    faultinject.arm("slow_replica", path=fault_file, site="device_sync")
+
+    def sync_in(replica: str) -> float:
+        journal = tracing.TraceJournal()
+        journal.configure(replica_id=replica)
+        with tracing.use_journal(journal):
+            t0 = time.perf_counter()
+            optim._sync_device(np.zeros(2))
+            return time.perf_counter() - t0
+
+    before = metrics.counter_total(
+        "tpuft_health_injected_faults_total", mode="slow_replica"
+    )
+    slow = sync_in("victim")  # consumes the arm, installs the stall
+    assert slow >= 0.08
+    assert (
+        metrics.counter_total(
+            "tpuft_health_injected_faults_total", mode="slow_replica"
+        )
+        - before
+        == 1
+    )
+    # Persistent for the victim; absent for a peer.
+    assert sync_in("victim") >= 0.08
+    assert sync_in("peer") < 0.05
+    # Consume-once: nothing left armed.
+    assert faultinject.consume("device_sync") is None
+    health.clear_injected("victim")
+    assert sync_in("victim") < 0.05
+
+
+def test_injected_wedge_blocks_until_cleared() -> None:
+    health.install_injected("wedge_device", replica_id="wedged")
+    journal = tracing.TraceJournal()
+    journal.configure(replica_id="wedged")
+    released = threading.Event()
+
+    def run() -> None:
+        with tracing.use_journal(journal):
+            health.injected_stall("device_sync")
+        released.set()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert not released.wait(0.2), "wedge must block the device sync"
+    health.clear_injected("wedged")
+    assert released.wait(5.0), "clear_injected must release the wedge"
+    t.join(timeout=5.0)
+
+
+def test_injected_drip_wire_hits_wire_site_only(tmp_path, monkeypatch) -> None:
+    fault_file = str(tmp_path / "fault")
+    monkeypatch.setenv(faultinject.ENV_FAULT_FILE, fault_file)
+    faultinject.arm("drip_wire", path=fault_file, site="wire")
+    journal = tracing.TraceJournal()
+    journal.configure(replica_id="nic_victim")
+    with tracing.use_journal(journal):
+        t0 = time.perf_counter()
+        health.injected_stall("device_sync")  # wrong site: no consume
+        assert time.perf_counter() - t0 < 0.05
+        t0 = time.perf_counter()
+        health.injected_stall("wire")
+        wire_dt = time.perf_counter() - t0
+        assert wire_dt >= 0.2  # default TPUFT_HEALTH_SLOW_MS=250
+        # ... and the installed stall does not leak to the device seam.
+        t0 = time.perf_counter()
+        health.injected_stall("device_sync")
+        assert time.perf_counter() - t0 < 0.05
+
+
+def test_punisher_arms_health_modes(tmp_path) -> None:
+    from torchft_tpu import punisher
+
+    for mode, site in (
+        ("slow_replica", "device_sync"),
+        ("wedge_device", "device_sync"),
+        ("drip_wire", "wire"),
+    ):
+        fault_file = str(tmp_path / f"fault_{mode}")
+        assert punisher.arm_stream_fault(mode, fault_file)
+        assert faultinject.consume.__doc__  # sanity: API unchanged
+        content = (tmp_path / f"fault_{mode}").read_text()
+        assert content == f"{mode}:{site}"
+        assert mode in punisher.HEALTH_FAULT_MODES
+        assert mode in punisher.ALL_FAULT_MODES
+
+
+# ---------------------------------------------------------------------------
+# monitor: board exchange, verdict latch, min_replica refusal
+# ---------------------------------------------------------------------------
+
+
+def _monitor(
+    replica: str,
+    board: FakeBoard,
+    peers: List[str],
+    min_replica: int = 1,
+    consecutive: int = 2,
+    min_peers: int = 1,
+) -> HealthMonitor:
+    mon = HealthMonitor(
+        replica_id=replica,
+        min_replica_size=min_replica,
+        scorer=HealthScorer(
+            replica, threshold=2.0, consecutive=consecutive,
+            min_peers=min_peers, alpha=1.0, min_gap_s=0.02, peer_ttl_s=300.0,
+        ),
+        gate=QuarantineGate(
+            replica, base_s=0.01, cap_s=0.02, max_ejects=3, window_s=300.0,
+            park_s=0.05, state_dir="", probe=lambda: True,
+            sleep=lambda s: None,
+        ),
+        watchdog=_quiet_watchdog(),
+        board=board,
+        trace=tracing.TraceJournal(),
+        push_interval_s=0.0,
+        wedge_action=lambda: None,
+    )
+    mon.set_peers(peers, board)
+    return mon
+
+
+def test_monitor_board_exchange_and_self_verdict() -> None:
+    board = FakeBoard()
+    healthy = _monitor("h0", board, ["slowpoke"])
+    slow = _monitor("slowpoke", board, ["h0"])
+    before = metrics.counter_total(
+        "tpuft_health_verdicts_total", replica_id="slowpoke"
+    )
+    for step in range(1, 5):
+        healthy.scorer.observe("device_sync", 0.01)
+        slow.scorer.observe("device_sync", 0.5)
+        healthy.on_step(step, participants=3)
+        slow.on_step(step, participants=3)
+    assert healthy.should_eject() is None
+    reason = slow.should_eject()
+    assert reason is not None and "fleet median" in reason
+    assert slow.state == health.STATE_DEGRADED
+    assert (
+        metrics.counter_total("tpuft_health_verdicts_total", replica_id="slowpoke")
+        - before
+        == 1
+    )
+    # The healthy peer read the slowpoke's snapshot off the board.
+    assert "health/slowpoke" in board.data
+    snap = json.loads(board.data["health/slowpoke"].decode())
+    assert snap["phases"]["device_sync"] == pytest.approx(0.5)
+
+
+def test_monitor_refuses_ejection_below_min_replica() -> None:
+    board = FakeBoard()
+    slow = _monitor("lonely", board, ["h0"], min_replica=2)
+    h0 = _monitor("h0", board, ["lonely"])
+    before = metrics.counter_total(
+        "tpuft_health_ejections_refused_total", replica_id="lonely"
+    )
+    for step in range(1, 6):
+        h0.scorer.observe("device_sync", 0.01)
+        slow.scorer.observe("device_sync", 0.5)
+        h0.on_step(step, participants=2)
+        slow.on_step(step, participants=2)  # 2 - 1 < min_replica_size=2
+    assert slow.should_eject() is None, "ejection must be refused, not latched"
+    assert slow.state == health.STATE_DEGRADED
+    delta = (
+        metrics.counter_total(
+            "tpuft_health_ejections_refused_total", replica_id="lonely"
+        )
+        - before
+    )
+    assert delta == 1, "refusal is counted once per latch, not per window"
+    # Head-room appears (a third replica joined): the ejection unlocks.
+    slow.on_step(6, participants=3)
+    assert slow.should_eject() is not None
+
+
+def test_monitor_note_ejected_records_gate_and_clears_faults() -> None:
+    board = FakeBoard()
+    mon = _monitor("victim_m", board, ["h0"])
+    health.install_injected("slow_replica", replica_id="victim_m", stall_s=0.5)
+    before = metrics.counter_total(
+        "tpuft_health_ejections_total", replica_id="victim_m"
+    )
+    mon.note_ejected("test ejection")
+    assert mon.gate.pending() and mon.gate.last_reason == "test ejection"
+    assert (
+        metrics.counter_total("tpuft_health_ejections_total", replica_id="victim_m")
+        - before
+        == 1
+    )
+    assert "victim_m" not in health._INJECTED
+    # The rejoin gate serves (injected probe passes instantly) and resets.
+    record = mon.serve_quarantine_if_pending()
+    assert record is not None and record["attempts"] >= 1
+    assert mon.should_eject() is None and mon.state == health.STATE_HEALTHY
+
+
+def test_monitor_wedge_path_flag_action() -> None:
+    board = FakeBoard()
+    mon = _monitor("wedgy", board, ["h0"])
+    errors: List[Exception] = []
+    mon.bind(report_error=errors.append)
+    before = metrics.counter_total(
+        "tpuft_health_wedge_trips_total", replica_id="wedgy"
+    )
+    mon._on_wedge(12.0, 4.0)
+    assert mon.should_eject() is not None and "watchdog" in mon.should_eject()
+    assert errors and isinstance(errors[0], DegradedReplicaError)
+    assert mon.gate.pending()
+    assert (
+        metrics.counter_total("tpuft_health_wedge_trips_total", replica_id="wedgy")
+        - before
+        == 1
+    )
+
+
+def test_monitor_advisory_accusation_published() -> None:
+    board = FakeBoard()
+    mon = _monitor("acc0", board, ["lagger", "acc2"], min_peers=1)
+    mon.scorer.observe("commit_barrier", 0.5)
+    mon.scorer.observe("commit_barrier", 0.5)
+    mon.scorer.note_peer("acc2", {"commit_barrier": 0.45})
+    mon.scorer.note_peer("lagger", {"commit_barrier": 0.01})
+    before = metrics.counter_total(
+        "tpuft_health_accusations_total", replica_id="acc0"
+    )
+    mon.on_step(1, participants=3)
+    assert (
+        metrics.counter_total("tpuft_health_accusations_total", replica_id="acc0")
+        - before
+        == 1
+    )
+    assert (
+        metrics.gauge_value(
+            "tpuft_health_accuse", accused="lagger",
+            replica_id="acc0", group_rank="0",
+        )
+        == 1
+    )
+    # Advisory only: the accuser itself never latches an ejection.
+    assert mon.should_eject() is None
+    # Snapshot carries the accusation for fleet_status / peers.
+    snap = json.loads(board.data["health/acc0"].decode())
+    assert snap["accused"] == "lagger"
+
+
+# ---------------------------------------------------------------------------
+# mock-manager integration (no native plane needed)
+# ---------------------------------------------------------------------------
+
+
+def _mock_manager_with_monitor(monitor: Optional[HealthMonitor] = None, **kw):
+    from test_manager import make_manager
+
+    return make_manager(health_monitor=monitor, **kw)
+
+
+def test_manager_start_quorum_raises_degraded_and_funnels_error() -> None:
+    from test_manager import make_quorum
+
+    board = FakeBoard()
+    mon = _monitor("eject_me", board, ["h0"])
+    manager, client, pg, transport = _mock_manager_with_monitor(mon)
+    try:
+        client._quorum.return_value = make_quorum()
+        pg.errored.return_value = None
+        with mon._lock:
+            mon._eject_reason = "scripted degraded verdict"
+        with pytest.raises(DegradedReplicaError, match="scripted degraded"):
+            manager.start_quorum()
+        assert manager.errored() is not None
+        assert mon.gate.pending(), "ejection must be persisted for the gate"
+    finally:
+        manager.shutdown(wait=False)
+
+
+def test_manager_commit_tail_drives_health_window() -> None:
+    from test_manager import make_quorum
+
+    board = FakeBoard()
+    mon = _monitor("stepper", board, ["h0"])
+    manager, client, pg, transport = _mock_manager_with_monitor(mon)
+    try:
+        client._quorum.return_value = make_quorum(
+            replica_rank=0, replica_world_size=2, max_rank=0, max_world_size=2
+        )
+        client.should_commit.return_value = True
+        pg.errored.return_value = None
+        manager.start_quorum()
+        assert manager.should_commit()
+        # The commit tail ran one scoring window: watchdog armed + board
+        # pushed (push interval 0 -> every window).
+        assert "health/stepper" in board.data
+        assert mon._watchdog.interval_ewma is None  # single beat so far
+        assert manager.should_commit()
+        assert mon._watchdog.interval_ewma is not None
+    finally:
+        manager.shutdown(wait=False)
+
+
+def test_manager_env_auto_attach_and_quarantine_gate(tmp_path, monkeypatch) -> None:
+    monkeypatch.setenv(health.ENV_HEALTH, "1")
+    monkeypatch.setenv(health.ENV_PROBE, "0")
+    monkeypatch.setenv(health.ENV_QUARANTINE_BASE, "0.01")
+    monkeypatch.setenv(health.ENV_QUARANTINE_CAP, "0.01")
+    monkeypatch.setenv(health.ENV_QUARANTINE_DIR, str(tmp_path))
+    manager, client, pg, transport = _mock_manager_with_monitor(
+        None, replica_id="auto_health"
+    )
+    try:
+        assert manager._health is not None
+        assert manager._health.replica_id == "auto_health"
+    finally:
+        manager.shutdown(wait=False)
+    # A prior ejection on file makes the NEXT construction serve the gate.
+    gate = QuarantineGate(
+        "auto_health", state_dir=str(tmp_path), probe=lambda: True,
+        sleep=lambda s: None,
+    )
+    gate.record_ejection("previous life ejected")
+    t0 = time.monotonic()
+    manager2, *_ = _mock_manager_with_monitor(None, replica_id="auto_health")
+    try:
+        served = time.monotonic() - t0
+        assert served < 5.0  # fast knobs: the gate must not hang
+        assert manager2._health.state == health.STATE_HEALTHY
+    finally:
+        manager2.shutdown(wait=False)
+
+
+def test_health_disabled_by_default_no_monitor() -> None:
+    manager, *_ = _mock_manager_with_monitor(None)
+    try:
+        assert manager._health is None
+    finally:
+        manager.shutdown(wait=False)
+
+
+# ---------------------------------------------------------------------------
+# launch.py crash-loop hardening (pure functions)
+# ---------------------------------------------------------------------------
+
+
+def test_relaunch_backoff_schedule() -> None:
+    from torchft_tpu.launch import relaunch_delay
+
+    assert relaunch_delay(1.0, 0, 8.0) == 1.0
+    assert relaunch_delay(1.0, 1, 8.0) == 2.0
+    assert relaunch_delay(1.0, 2, 8.0) == 4.0
+    assert relaunch_delay(1.0, 3, 8.0) == 8.0
+    assert relaunch_delay(1.0, 10, 8.0) == 8.0  # capped
+    assert relaunch_delay(0.5, 0, 4.0) == 0.5
+    assert relaunch_delay(2.0, 5, 1.0) == 2.0  # cap below base: base wins
+
+
+def test_restart_window_pruning() -> None:
+    from torchft_tpu.launch import prune_restart_window
+
+    stamps = [0.0, 50.0, 99.0, 100.0]
+    assert prune_restart_window(stamps, 100.0, 10.0) == [99.0, 100.0]
+    assert prune_restart_window(stamps, 100.0, 0.0) == stamps  # lifetime
+    assert prune_restart_window([], 100.0, 10.0) == []
+
+
+# ---------------------------------------------------------------------------
+# explain-step health lines (golden-style, synthetic journal)
+# ---------------------------------------------------------------------------
+
+
+def test_explain_step_prints_health_verdict_ejection_quarantine() -> None:
+    from test_fleet_trace import _Journal, fleet_trace
+
+    j = _Journal("gray_1", 0.0, 900.0)
+    j.ev("health_accuse", 0.05, step=9, q=4, accused="gray_1", gap_s=0.31)
+    j.ev("health_verdict", 0.1, step=9, q=4, streak=3,
+         ratios='{"device_sync": 6.1}', peers=2)
+    j.ev("health_ejection_refused", 0.15, step=9, q=4, participants=2,
+         min_replica=2)
+    j.ev("health_ejection", 0.2, step=9, q=4,
+         reason="self-verdict: phases {'device_sync': 6.1} beyond 3.0x")
+    j.ev("health_wedge", 0.25, step=9, q=4, elapsed_s=42.0, deadline_s=12.0)
+    j.ev("health_quarantine", 0.3, step=9, q=4, phase="parked",
+         wait_s=30.0, ejections=3)
+    j.ev("health_quarantine", 0.35, step=9, q=4, phase="served",
+         attempts=2, waited_s=3.1, parked=True)
+    merged = fleet_trace.merge_events(j.events)
+    text = fleet_trace.explain_step(merged, 9)
+    assert "health: gray_1/0 judged ITSELF degraded after 3 consecutive" in text
+    assert "health: gray_1/0 SELF-EJECTED at the step boundary" in text
+    assert "REFUSED ejection" in text and "below min_replica 2" in text
+    assert "step-progress watchdog tripped" in text
+    assert "crash-loop parked for 30.0s" in text
+    assert "served quarantine — 2 probe attempt(s)" in text
+    assert "crash-loop PARKED first" in text
+    assert "ADVISORY accusation -> gray_1" in text
+    assert "peers never eject peers" in text
+
+
+# ---------------------------------------------------------------------------
+# threads-as-replicas drills (native-gated: skip without the toolchain)
+# ---------------------------------------------------------------------------
+
+
+def _health_train_loop(
+    runner,
+    rank: int,
+    store_client,
+    store_addr: str,
+    depth: int = 0,
+    straggler_group: int = 2,
+    stall_at: int = 2,
+    stall_s: float = 0.3,
+    state_dir: str = "",
+    stall_once: Optional[Dict[str, bool]] = None,
+):
+    """DDP loop with a per-replica health monitor: the straggler group
+    installs a persistent device-sync stall mid-run (the slow_replica
+    arm's install path), must self-eject at a step boundary, serve its
+    quarantine gate on the supervised restart, and rejoin via the
+    normal heal path."""
+    import optax
+
+    from ft_harness import _batch_for, _grad_fn, _init_model_params, _loss_fn
+    from torchft_tpu.ddp import ft_allreduce_gradients
+    from torchft_tpu.manager import Manager
+    from torchft_tpu.optim import Optimizer
+    from torchft_tpu.parallel.process_group import (
+        FakeProcessGroupWrapper,
+        ProcessGroupTCP,
+    )
+
+    replica = f"hddp_{runner.replica_group}"
+    journal = tracing.TraceJournal()
+    with tracing.use_journal(journal):
+        pg = FakeProcessGroupWrapper(ProcessGroupTCP(timeout=10.0))
+        monitor = HealthMonitor(
+            replica_id=replica,
+            group_rank=rank,
+            min_replica_size=1,
+            scorer=HealthScorer(
+                replica, threshold=2.0, consecutive=2, min_peers=1,
+                alpha=0.5, min_gap_s=0.05, peer_ttl_s=120.0,
+            ),
+            gate=QuarantineGate(
+                replica, base_s=0.05, cap_s=0.1, max_ejects=10,
+                window_s=300.0, park_s=0.2, state_dir=state_dir,
+                probe=lambda: True,
+            ),
+            watchdog=_quiet_watchdog(),
+            push_interval_s=0.0,
+            wedge_action=lambda: None,
+        )
+        manager = Manager(
+            pg=pg,
+            min_replica_size=1,
+            store=store_client,
+            store_addr=store_addr,
+            use_async_quorum=runner.use_async_quorum,
+            group_rank=rank,
+            group_world_size=runner.world_size,
+            lighthouse_addr=runner.lighthouse_addr,
+            replica_id=replica,
+            heartbeat_interval=0.05,
+            timeout=10.0,
+            quorum_timeout=20.0,
+            commit_pipeline_depth=depth,
+            health_monitor=monitor,
+        )
+        opt = Optimizer(manager, optax.sgd(0.05), _init_model_params())
+        failed_commits = 0
+        try:
+            if depth:
+                step_fn = opt.make_step_fn(_loss_fn)
+                while manager.current_step() < runner.num_steps:
+                    while opt.next_pipelined_step() < runner.num_steps:
+                        step = opt.next_pipelined_step()
+                        _maybe_install_stall(
+                            runner, step, straggler_group, stall_at,
+                            stall_s, stall_once, replica,
+                        )
+                        x, y = _batch_for(step, runner.replica_group)
+                        _, prev = step_fn(x, y)
+                        if prev is False:
+                            failed_commits += 1
+                    if opt.flush_pipeline() is False:
+                        failed_commits += 1
+            else:
+                while manager.current_step() < runner.num_steps:
+                    step = manager.current_step()
+                    _maybe_install_stall(
+                        runner, step, straggler_group, stall_at,
+                        stall_s, stall_once, replica,
+                    )
+                    opt.begin_step()
+                    manager.wait_quorum()
+                    x, y = _batch_for(step, runner.replica_group)
+                    grads = _grad_fn(opt.params, x, y)
+                    if not opt.step(ft_allreduce_gradients(manager, grads)):
+                        failed_commits += 1
+            import jax
+
+            return {
+                "state_dict": {"params": opt.params},
+                "manager_state": manager.state_dict(),
+                "failed_commits": failed_commits,
+                "health_state": monitor.state,
+            }
+        finally:
+            try:
+                opt.flush_pipeline(raise_on_error=False)
+            except Exception:
+                pass
+            manager.shutdown(wait=False)
+            pg.shutdown()
+
+
+def _maybe_install_stall(
+    runner, step, straggler_group, stall_at, stall_s, stall_once, replica
+) -> None:
+    if (
+        runner.replica_group == straggler_group
+        and step >= stall_at
+        and stall_once is not None
+        and not stall_once.get("installed")
+    ):
+        stall_once["installed"] = True
+        health.install_injected("slow_replica", replica_id=replica,
+                                stall_s=stall_s)
+
+
+@pytest.fixture()
+def lighthouse():
+    from torchft_tpu.coordination import LighthouseServer
+
+    server = LighthouseServer(
+        min_replicas=1,
+        join_timeout_ms=10000,
+        heartbeat_timeout_ms=1000,
+        quorum_tick_ms=20,
+    )
+    yield server
+    server.shutdown()
+
+
+def _run_ejection_drill(lighthouse, tmp_path, depth: int) -> None:
+    import jax
+
+    from ft_harness import Runner, ft_counter_delta, ft_counter_snapshot, run_replica_groups
+    from test_manager_integ import assert_pytree_equal
+
+    num_steps = 8
+    stall_once: Dict[str, bool] = {}
+    before = ft_counter_snapshot()
+    before_ejections = metrics.counter_total(
+        "tpuft_health_ejections_total", replica_id="hddp_2"
+    )
+    runners = [
+        Runner(
+            replica_group=i,
+            lighthouse_addr=lighthouse.address(),
+            train_loop=_health_train_loop,
+            num_steps=num_steps,
+            attempts=4,
+            train_loop_args={
+                "depth": depth,
+                "state_dir": str(tmp_path),
+                "stall_once": stall_once,
+            },
+        )
+        for i in range(3)
+    ]
+    results = run_replica_groups(runners, timeout=240)
+    after = ft_counter_snapshot()
+    delta = ft_counter_delta(before, after)
+
+    # The straggler self-ejected exactly once and rejoined.
+    ejections = (
+        metrics.counter_total("tpuft_health_ejections_total", replica_id="hddp_2")
+        - before_ejections
+    )
+    assert ejections == 1, f"expected exactly one self-ejection, got {ejections}"
+    assert stall_once.get("installed")
+    # Rejoin rode the normal heal path with zero wrong adoptions.
+    assert delta["heals_joiner"] >= 1
+    assert delta["checksum_failures"] == 0
+    assert delta["era_rejects"] == 0
+    # Bitwise identity across all groups, straggler included.
+    reference = results[0][0]["state_dict"]["params"]
+    for group_result in results:
+        assert group_result[0]["manager_state"]["step"] == num_steps
+        assert_pytree_equal(group_result[0]["state_dict"]["params"], reference)
+
+
+def test_straggler_self_ejects_and_rejoins_strict(lighthouse, tmp_path) -> None:
+    _run_ejection_drill(lighthouse, tmp_path, depth=0)
+
+
+def test_straggler_self_ejects_and_rejoins_pipelined_depth2(
+    lighthouse, tmp_path
+) -> None:
+    _run_ejection_drill(lighthouse, tmp_path, depth=2)

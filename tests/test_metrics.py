@@ -561,6 +561,30 @@ def test_fleet_status_render_and_extractors() -> None:
     }
     assert fleet_status._history_state(hist_snap) == "5v/12.5MB"
     assert fleet_status._history_state({"metrics": {"gauges": {}}}) is None
+    # HEALTH column: verdict state + ejection count + advisory accusation
+    # from the tpuft_health_* gauges; None without the health plane.
+    health_snap = {
+        "metrics": {
+            "gauges": {
+                "tpuft_health_state": [{"labels": {}, "value": 2.0}],
+                "tpuft_health_accuse": [
+                    {"labels": {"accused": "train_9"}, "value": 0.0},
+                    {"labels": {"accused": "train_7"}, "value": 1.0},
+                ],
+            },
+            "counters": {
+                "tpuft_health_ejections_total": [{"labels": {}, "value": 2.0}]
+            },
+        }
+    }
+    assert fleet_status._health_state(health_snap) == "degraded/e2>train_7"
+    assert (
+        fleet_status._health_state(
+            {"metrics": {"gauges": {"tpuft_health_state": [{"labels": {}, "value": 0.0}]}}}
+        )
+        == "ok"
+    )
+    assert fleet_status._health_state({"metrics": {"gauges": {}}}) is None
 
     table = {
         "ts": 100.0,
@@ -589,8 +613,9 @@ def test_fleet_status_render_and_extractors() -> None:
     assert "quorum_id=3" in lines[0] and "replicas=2" in lines[0]
     assert lines[1].split() == [
         "REPLICA", "RANK", "STEP", "STEP/S", "COMMITS", "FAILED", "HEALS",
-        "SERVE", "SHARD", "WIRE", "PUBLISH", "HIST", "RELAY", "LAG", "LAST",
-        "COMMIT", "HEALING", "JOINERS", "HB", "AGE", "MS", "PUSH", "AGE",
+        "SERVE", "HEALTH", "SHARD", "WIRE", "PUBLISH", "HIST", "RELAY",
+        "LAG", "LAST", "COMMIT", "HEALING", "JOINERS", "HB", "AGE", "MS",
+        "PUSH", "AGE",
     ]
     assert "train_0:uuid" in text and "1.25" in text and "1.0s" in text
     # The dead replica renders dashes, not a crash.

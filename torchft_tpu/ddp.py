@@ -26,7 +26,7 @@ from typing import Any, List
 import jax
 import numpy as np
 
-from torchft_tpu import metrics, tracing
+from torchft_tpu import health, metrics, tracing
 from torchft_tpu.manager import Manager
 from torchft_tpu.utils.transfer import prefetch_to_host
 from torchft_tpu.work import Work
@@ -174,6 +174,10 @@ def ft_allreduce_gradients(
     journal = getattr(manager, "_trace", None) or tracing.current()
     for bucket_index, (members, work) in enumerate(zip(buckets, works)):
         wire_t0 = time.perf_counter()
+        # Gray-failure chaos seam: a punisher-armed drip_wire installs a
+        # persistent per-replica per-bucket stall here — a dripping NIC,
+        # visible in the wire_bucket histogram and the health scorer.
+        health.injected_stall("wire")
         flat = np.asarray(work.wait())
         wire_dt = time.perf_counter() - wire_t0
         metrics.observe("tpuft_wire_bucket_seconds", wire_dt, path="bucket")
@@ -299,6 +303,7 @@ def _ft_allreduce_gradients_fp8(manager: Manager, grads: Any) -> Any:
             zip(quantized, futures)
         ):
             wire_t0 = time.perf_counter()
+            health.injected_stall("wire")
             result = future.result()
             wire_dt = time.perf_counter() - wire_t0
             metrics.observe("tpuft_wire_bucket_seconds", wire_dt, path="fp8")

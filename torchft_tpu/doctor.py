@@ -89,6 +89,22 @@ KNOWN_ENV = {
     # Fleet trace plane (torchft_tpu/tracing.py): recording switch, journal
     # ring size, store clock-beacon sampling switch.
     "TPUFT_TRACE", "TPUFT_TRACE_SIZE", "TPUFT_TRACE_CLOCK",
+    # Gray-failure ejection plane (torchft_tpu/health.py): master switch,
+    # verdict knobs (fleet-relative threshold / hysteresis windows / peer
+    # freshness / absolute gap floor), board push cadence, wedge watchdog
+    # (deadline scale + floor + escalation action), injected-stall size,
+    # self-probe toggles, and the quarantine gate (backoff base/cap,
+    # crash-loop sliding window + park cooldown, state dir).
+    "TPUFT_HEALTH", "TPUFT_HEALTH_THRESHOLD", "TPUFT_HEALTH_CONSECUTIVE",
+    "TPUFT_HEALTH_MIN_PEERS", "TPUFT_HEALTH_EWMA_ALPHA",
+    "TPUFT_HEALTH_PEER_TTL_SEC", "TPUFT_HEALTH_PUSH_SEC",
+    "TPUFT_HEALTH_MIN_GAP_SEC", "TPUFT_HEALTH_WEDGE_SCALE",
+    "TPUFT_HEALTH_WEDGE_FLOOR_SEC", "TPUFT_HEALTH_WEDGE_ACTION",
+    "TPUFT_HEALTH_SLOW_MS", "TPUFT_HEALTH_PROBE",
+    "TPUFT_HEALTH_PROBE_TIMEOUT_SEC", "TPUFT_QUARANTINE_BASE_SEC",
+    "TPUFT_QUARANTINE_CAP_SEC", "TPUFT_QUARANTINE_MAX_EJECTS",
+    "TPUFT_QUARANTINE_WINDOW_SEC", "TPUFT_QUARANTINE_PARK_SEC",
+    "TPUFT_QUARANTINE_DIR",
     # Repo tooling outside the package (tests/benchmarks/sentinel) — real
     # knobs a user may have exported; not typos.
     "TPUFT_SOAK_SECONDS", "TPUFT_SOAK_SEED",
@@ -759,6 +775,94 @@ def _check_history() -> Tuple[str, str]:
     )
 
 
+def _check_health(lighthouse: str) -> Tuple[str, str]:
+    """Gray-failure ejection plane preflight. WARN, never FAIL: the
+    plane only ever REMOVES a replica that judged itself degraded, and
+    every refusal path keeps training — but an operator who armed it
+    should hear about knob typos, a probe that cannot run, and the N=2
+    degenerate regime where a verdict can never actuate: with two
+    participants and ``min_replica_size=2``, ejecting would drop the
+    quorum below min_replica, so the verdict latches and is REFUSED
+    (counted in ``tpuft_health_ejections_refused_total``) while
+    training continues degraded."""
+    from torchft_tpu import health
+
+    if not health.enabled():
+        return (
+            "PASS",
+            f"health plane off (set {health.ENV_HEALTH}=1 for "
+            "slow-is-the-new-dead straggler verdicts + self-ejection)",
+        )
+    threshold = os.environ.get(health.ENV_THRESHOLD)
+    if threshold is not None:
+        try:
+            if float(threshold) <= 1.0:
+                raise ValueError
+        except ValueError:
+            return (
+                "WARN",
+                f"{health.ENV_THRESHOLD}={threshold!r} must be a number > 1 "
+                "(a multiplicative bound vs the fleet median)",
+            )
+    for env, floor in (
+        (health.ENV_CONSECUTIVE, 1),
+        (health.ENV_MIN_PEERS, 1),
+        (health.ENV_QUARANTINE_MAX_EJECTS, 1),
+    ):
+        raw = os.environ.get(env)
+        if raw is not None:
+            try:
+                if int(raw) < floor:
+                    raise ValueError
+            except ValueError:
+                return "WARN", f"{env}={raw!r} is not an int >= {floor}"
+    for env in (
+        health.ENV_QUARANTINE_BASE,
+        health.ENV_QUARANTINE_CAP,
+        health.ENV_QUARANTINE_WINDOW,
+        health.ENV_QUARANTINE_PARK,
+        health.ENV_WEDGE_FLOOR,
+    ):
+        raw = os.environ.get(env)
+        if raw is not None:
+            try:
+                if float(raw) <= 0:
+                    raise ValueError
+            except ValueError:
+                return "WARN", f"{env}={raw!r} is not a positive number"
+    knobs = (
+        f"threshold {os.environ.get(health.ENV_THRESHOLD, '3.0')}x, "
+        f"K={os.environ.get(health.ENV_CONSECUTIVE, '3')} windows, "
+        f"wedge floor {os.environ.get(health.ENV_WEDGE_FLOOR, '30')}s, "
+        f"probe {'off' if os.environ.get(health.ENV_PROBE, '1') == '0' else 'on'}"
+    )
+    if not lighthouse:
+        return "PASS", f"health plane on ({knobs}; no lighthouse to probe fleet size)"
+    try:
+        from torchft_tpu.coordination import LighthouseClient
+
+        client = LighthouseClient(lighthouse, connect_timeout=5.0)
+        try:
+            members = len(client.status(timeout=5.0).members)
+        finally:
+            client.close()
+    except Exception as e:  # noqa: BLE001 — WARN-never-FAIL probe
+        return "WARN", f"health plane on but lighthouse probe failed ({e})"
+    if members <= 2:
+        return (
+            "WARN",
+            f"health plane on with only {members} member(s): the N=2 "
+            "degenerate regime — under min_replica_size=2 an ejection "
+            "would drop the quorum below min_replica, so degraded "
+            "verdicts are REFUSED (counted, training continues slow); "
+            "self-ejection needs ejectable headroom (N-1 >= min_replica)",
+        )
+    return (
+        "PASS",
+        f"health plane on ({knobs}; {members} members — ejectable headroom ok)",
+    )
+
+
 def _check_env() -> Tuple[str, str]:
     # Value validation first — a fatal misconfig must FAIL even when a
     # typo'd var would also WARN.
@@ -789,6 +893,7 @@ def run_checks(lighthouse: str, skip_device: bool = False) -> int:
         ("heal serving", _check_heal_serve),
         ("weights serving", _check_serving),
         ("heal striping", lambda: _check_heal_stripe(lighthouse)),
+        ("health plane", lambda: _check_health(lighthouse)),
         ("rejoin storm", lambda: _check_rejoin_storm(lighthouse)),
         ("zero plane", lambda: _check_zero(lighthouse)),
         ("lighthouse", lambda: _check_lighthouse(lighthouse)),

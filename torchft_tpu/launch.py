@@ -10,9 +10,16 @@ that relaunches dead replica groups).
 
 Each replica group becomes a supervised subprocess with:
   REPLICA_GROUP_ID, NUM_REPLICA_GROUPS, TPUFT_LIGHTHOUSE
-plus any TPUFT_* timeouts passed through. Dead groups are relaunched every
-``--relaunch-interval`` seconds up to ``--max-restarts``, mirroring the
-torchelastic max_restarts contract the reference delegates to.
+plus any TPUFT_* timeouts passed through. Dead groups are relaunched with
+**exponential backoff**: the delay doubles per recent rapid death (deaths
+within ``_backoff_window`` seconds of each other — a genuinely
+crash-looping group, not chaos kills minutes apart), capped at
+``--relaunch-backoff-max``, so a hot-looping group cannot spin the host.
+Restart exhaustion is **windowed**, not lifetime: ``--max-restarts``
+restarts inside the sliding ``--restart-window`` seconds gives up on the
+group (the torchelastic max_restarts contract, hardened for long-running
+jobs where a lifetime counter eventually strands a healthy fleet over
+unrelated faults spread across days).
 """
 
 from __future__ import annotations
@@ -27,7 +34,30 @@ from typing import Dict, List, Optional
 
 from torchft_tpu.coordination import LighthouseServer
 
-__all__ = ["supervise", "main"]
+__all__ = ["supervise", "main", "relaunch_delay", "prune_restart_window"]
+
+
+def relaunch_delay(
+    base: float, recent_rapid_deaths: int, cap: float
+) -> float:
+    """The relaunch backoff schedule (pure function, unit-pinned):
+    ``min(base * 2^n, cap)`` where ``n`` counts RECENT rapid deaths —
+    deaths inside the short backoff window, i.e. evidence of a hot
+    crash loop. Chaos kills minutes apart keep ``n`` at 0 and relaunch
+    at the base interval; an instant-exit loop escalates geometrically
+    to the cap."""
+    return min(base * (2.0 ** max(recent_rapid_deaths, 0)), max(cap, base))
+
+
+def prune_restart_window(
+    restarts: List[float], now: float, window: float
+) -> List[float]:
+    """Sliding-window restart accounting (pure function, unit-pinned):
+    keeps only restart timestamps within ``window`` seconds of ``now``.
+    ``window <= 0`` disables pruning (lifetime semantics)."""
+    if window <= 0:
+        return list(restarts)
+    return [t for t in restarts if now - t <= window]
 
 
 def supervise(
@@ -40,13 +70,22 @@ def supervise(
     group_world_size: int = 1,
     store_port_base: int = 29600,
     jax_coordinator_port_base: int = 0,
+    restart_window: float = 600.0,
+    relaunch_backoff_max: Optional[float] = None,
 ) -> int:
     """Runs ``command`` for each (group, rank) cell, relaunching dead
     groups. With ``group_world_size > 1`` every rank of a group shares
     GROUP_WORLD_SIZE/TPUFT_STORE_ADDR (group rank 0 binds the store on
     ``store_port_base + group``); a death of any rank restarts the whole
     group, matching the per-group restart unit of the reference's
-    torchelastic deployment. Returns 0 when every group exits cleanly."""
+    torchelastic deployment. Returns 0 when every group exits cleanly.
+
+    Crash-loop hardening: the relaunch delay doubles per rapid death
+    (:func:`relaunch_delay`, capped at ``relaunch_backoff_max``, default
+    ``max(8 x relaunch_interval, relaunch_interval)``), and a group is
+    given up only after ``max_restarts`` restarts inside the sliding
+    ``restart_window`` seconds (:func:`prune_restart_window`;
+    ``restart_window <= 0`` restores the legacy lifetime count)."""
     if group_world_size < 1:
         raise ValueError(f"group_world_size must be >= 1, got {group_world_size}")
     if jax_coordinator_port_base and group_world_size == 1:
@@ -93,7 +132,16 @@ def supervise(
         return procs
 
     groups = {g: spawn_group(g) for g in range(num_replica_groups)}
-    restarts = {g: 0 for g in range(num_replica_groups)}
+    # Restart timestamps per group (sliding-window exhaustion); the
+    # short backoff window detects HOT loops (instant re-deaths) for the
+    # exponential delay without punishing chaos kills minutes apart.
+    restarts: Dict[int, List[float]] = {g: [] for g in range(num_replica_groups)}
+    backoff_cap = (
+        relaunch_backoff_max
+        if relaunch_backoff_max is not None
+        else max(8.0 * relaunch_interval, relaunch_interval)
+    )
+    backoff_window = max(4.0 * relaunch_interval + 5.0, 10.0)
     done: Dict[int, int] = {}
     try:
         while len(done) < num_replica_groups:
@@ -123,15 +171,23 @@ def supervise(
                     except subprocess.TimeoutExpired:
                         p.kill()
                         p.wait()
-                if restarts[group] < max_restarts:
-                    restarts[group] += 1
+                now = time.monotonic()
+                restarts[group] = prune_restart_window(
+                    restarts[group], now, restart_window
+                )
+                if len(restarts[group]) < max_restarts:
+                    rapid = len(
+                        prune_restart_window(restarts[group], now, backoff_window)
+                    )
+                    delay = relaunch_delay(relaunch_interval, rapid, backoff_cap)
+                    restarts[group].append(now)
                     print(
                         f"[launch] group {group} died (exit {failed[0]}); "
-                        f"relaunch {restarts[group]}/{max_restarts} "
-                        f"in {relaunch_interval}s",
+                        f"relaunch {len(restarts[group])}/{max_restarts} "
+                        f"(window {restart_window:g}s) in {delay:.1f}s",
                         flush=True,
                     )
-                    time.sleep(relaunch_interval)
+                    time.sleep(delay)
                     groups[group] = spawn_group(group)
                 else:
                     print(
@@ -161,6 +217,20 @@ def main() -> None:
     parser.add_argument("--lighthouse", default=os.environ.get("TPUFT_LIGHTHOUSE"))
     parser.add_argument("--relaunch-interval", type=float, default=10.0)
     parser.add_argument("--max-restarts", type=int, default=100)
+    parser.add_argument(
+        "--restart-window",
+        type=float,
+        default=600.0,
+        help="sliding window (seconds) for --max-restarts exhaustion; "
+        "<= 0 restores the legacy lifetime count",
+    )
+    parser.add_argument(
+        "--relaunch-backoff-max",
+        type=float,
+        default=None,
+        help="cap on the exponential relaunch backoff (default "
+        "8 x relaunch-interval)",
+    )
     parser.add_argument("--group-world-size", type=int, default=1)
     parser.add_argument("--store-port-base", type=int, default=29600)
     parser.add_argument(
@@ -187,6 +257,8 @@ def main() -> None:
             group_world_size=args.group_world_size,
             store_port_base=args.store_port_base,
             jax_coordinator_port_base=args.jax_coordinator_port_base,
+            restart_window=args.restart_window,
+            relaunch_backoff_max=args.relaunch_backoff_max,
         )
     )
 

@@ -47,6 +47,7 @@ from typing import Any, Callable, Dict, List, Optional, TypeVar, cast
 
 import numpy as np
 
+from torchft_tpu import health as health_plane
 from torchft_tpu import metrics, tracing
 from torchft_tpu.checkpointing import (
     CheckpointTransport,
@@ -75,7 +76,13 @@ __all__ = [
     "WorldSizeMode",
     "ExceptionWithTraceback",
     "HealExhaustedError",
+    "DegradedReplicaError",
 ]
+
+# Re-exported for train loops/supervisors that catch the escalation
+# family in one place (quorum timeout / HealExhaustedError /
+# DegradedReplicaError all mean "supervisor territory").
+DegradedReplicaError = health_plane.DegradedReplicaError
 
 # Env overrides (reference: manager.py:82-89).
 TIMEOUT_SEC_ENV = "TPUFT_TIMEOUT_SEC"
@@ -365,6 +372,7 @@ class Manager:
         quorum_retries: int = 0,
         commit_pipeline_depth: Any = 0,
         heal_max_attempts: int = 5,
+        health_monitor: Optional[Any] = None,
     ) -> None:
         self._pg = pg
         self._min_replica_size = min_replica_size
@@ -449,6 +457,25 @@ class Manager:
             if group_world_size is not None
             else int(os.environ.get("GROUP_WORLD_SIZE", "1"))
         )
+
+        # Gray-failure health plane (torchft_tpu/health.py): explicit
+        # monitor injection (drills/bench) or env-gated auto-attach
+        # ($TPUFT_HEALTH=1). The quarantine gate runs NOW — before the
+        # ManagerServer below starts heartbeating — so a replica whose
+        # previous incarnation self-ejected must pass its accelerator
+        # self-probe (with exponential backoff; crash-loop parking)
+        # before it re-enters anyone's quorum view. Rejoin then rides
+        # the normal heal path (delta rejoin makes the comeback cheap).
+        self._health: Optional[health_plane.HealthMonitor] = health_monitor
+        if self._health is None and health_plane.enabled():
+            self._health = health_plane.HealthMonitor(
+                replica_id=(replica_id or "replica"),
+                group_rank=self._group_rank,
+                min_replica_size=min_replica_size,
+            )
+        if self._health is not None:
+            self._health.bind(min_replica_size=min_replica_size)
+            self._health.serve_quarantine_if_pending()
 
         self._store = store
         # The default heal transport speaks the heal wire class: its
@@ -605,6 +632,13 @@ class Manager:
             owner_key=f"{self._metric_labels['replica_id']}/{self._group_rank}",
             claim=self._group_rank == 0,
         )
+
+        # Health plane wiring that needs the full identity: the monitor
+        # journals into this replica's timeline and funnels wedge-path
+        # errors through report_error like every other comm-layer error.
+        if self._health is not None:
+            self._health.bind(trace=self._trace, report_error=self.report_error)
+            self.register_shutdown_hook(self._health.stop)
 
     # ------------------------------------------------------------------
     # state dict registry
@@ -1189,6 +1223,21 @@ class Manager:
         # the misordering is impossible rather than merely documented.
         self._drain_pending_commit("start_quorum")
 
+        # Gray-failure self-ejection: a latched degraded verdict (or a
+        # wedge-watchdog trip) leaves the fleet HERE, at the step
+        # boundary, with the previous commit fully resolved — the same
+        # supervisor-escalation family as a quorum timeout or
+        # HealExhaustedError. Survivors observe an ordinary membership
+        # change (window drain -> pg.configure -> proceed) and this
+        # replica rejoins through the quarantine gate + normal heal path.
+        if self._health is not None:
+            eject_reason = self._health.should_eject()
+            if eject_reason is not None:
+                err = DegradedReplicaError(eject_reason)
+                self.report_error(err)
+                self._health.note_ejected(eject_reason)
+                raise err
+
         self._errored = None
         self._healing = False
 
@@ -1314,6 +1363,10 @@ class Manager:
         metrics.set_gauge(
             "tpuft_heal_storm_joiners", joining, **self._metric_labels
         )
+        if self._health is not None:
+            # Peer discovery for the health board: participant ids + the
+            # quorum's shared rendezvous store. Best-effort inside.
+            self._health.on_quorum(quorum)
         self._trace.record(
             "quorum_ready",
             step=self._step,
@@ -1907,6 +1960,16 @@ class Manager:
             "tpuft_batches_committed", self._batches_committed, **self._metric_labels
         )
         self._push_metrics()
+        if self._health is not None:
+            # One health-scoring window per commit resolution (cheap,
+            # never raises): watchdog beat, rollup ingest, board
+            # push/pull, verdict latching. Actuation waits for the next
+            # start_quorum — the step boundary.
+            self._health.on_step(
+                self._step,
+                committed=should_commit,
+                participants=self._participating_replica_world_size,
+            )
         if not should_commit:
             if self._max_retries is not None and self._commit_failures > self._max_retries:
                 msg = (
@@ -2066,6 +2129,13 @@ class Manager:
             "tpuft_batches_committed", self._batches_committed, **self._metric_labels
         )
         self._push_metrics()
+        if self._health is not None:
+            # Same per-resolution health window as the inline tail;
+            # participants were captured at vote launch (re-reading here
+            # could block on the current quorum future).
+            self._health.on_step(
+                self._step, committed=should_commit, participants=participants
+            )
         if not should_commit:
             if self._max_retries is not None and self._commit_failures > self._max_retries:
                 msg = (

@@ -35,7 +35,7 @@ from typing import Any, Optional
 import jax
 import numpy as np
 
-from torchft_tpu import metrics, tracing
+from torchft_tpu import health, metrics, tracing
 from torchft_tpu.manager import Manager
 from torchft_tpu.utils.profiling import trace_span
 
@@ -86,6 +86,12 @@ def _sync_device(x: Any) -> Any:
     start = time.perf_counter()
     try:
         with tracing.span("device_sync"):
+            # Gray-failure chaos seam: a punisher-armed slow_replica/
+            # wedge_device installs a persistent per-replica stall/wedge
+            # here (one env lookup when unarmed) — the injected latency
+            # lands in the phase histogram and the health scorer's EWMA
+            # exactly like a real gray device.
+            health.injected_stall("device_sync")
             return _bound_device(x)
     finally:
         metrics.observe("tpuft_device_sync_seconds", time.perf_counter() - start)

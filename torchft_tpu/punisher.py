@@ -47,6 +47,16 @@ Heal-path modes target the recovery plane itself:
   relays and subscribers converge to V-1 with zero torn / stale-era /
   wrong-version adoptions (tests/test_serving.py rollback-storm drill,
   strict AND pipelined orderings; SERVING_BENCH.json rollback leg).
+- ``slow_replica`` / ``wedge_device`` / ``drip_wire``: the GRAY-failure
+  arms (torchft_tpu/health.py seams). One arm is consumed by the next
+  matching phase — ``slow_replica``/``wedge_device`` at the device-sync
+  seam (``device_sync``), ``drip_wire`` at the wire-bucket seam
+  (``wire``) — and installs a PERSISTENT per-replica fault in the
+  consuming process: a per-step stall, a device sync that never
+  completes (heartbeats continue — the fully-wedged mode), or a
+  dripping per-bucket wire stall. The health plane must verdict and
+  self-eject the victim (``TPUFT_HEALTH=1``); ejection/restart clears
+  the fault, so the victim's comeback is clean.
 - ``kill_relay``: armed at the ``serving_relay`` site (optionally
   ``--donor-tag <port>`` to target one relay of a tier — in a relay
   TREE that is how an INTERIOR relay is singled out, since every tier
@@ -91,6 +101,7 @@ __all__ = [
     "FAULT_MODES",
     "HEAL_FAULT_MODES",
     "SERVING_FAULT_MODES",
+    "HEALTH_FAULT_MODES",
     "ALL_FAULT_MODES",
 ]
 
@@ -117,7 +128,12 @@ HEAL_FAULT_MODES = (
 )
 # Serving-plane modes (the committed-weights fan-out tier).
 SERVING_FAULT_MODES = ("kill_relay", "retract_version")
-ALL_FAULT_MODES = FAULT_MODES + HEAL_FAULT_MODES + SERVING_FAULT_MODES
+# Gray-failure modes (the health plane's slow-is-the-new-dead drills):
+# file-armed persistent stalls/wedges at the device-sync and wire seams.
+HEALTH_FAULT_MODES = ("slow_replica", "wedge_device", "drip_wire")
+ALL_FAULT_MODES = (
+    FAULT_MODES + HEAL_FAULT_MODES + SERVING_FAULT_MODES + HEALTH_FAULT_MODES
+)
 
 
 def kill_one(
@@ -266,6 +282,16 @@ def arm_stream_fault(
         # and retracts that version fleet-wide (readers converge to V-1).
         site = "publisher_retract"
         armed_mode = "retract"
+    elif mode in ("slow_replica", "wedge_device"):
+        # Consumed by the next device sync anywhere in the fleet; the
+        # consumer installs a persistent per-replica gray fault
+        # (health.injected_stall) — the health plane's verdict/ejection
+        # machinery is what recovers the fleet, not this arm.
+        site = "device_sync"
+        armed_mode = mode
+    elif mode == "drip_wire":
+        site = "wire"
+        armed_mode = mode
     else:
         site, armed_mode = "heal_stream", mode
     try:
@@ -301,7 +327,7 @@ def inject_fault(
         "corrupt_quantized_chunk",
         "kill_relay",
         "retract_version",
-    ):
+    ) or mode in HEALTH_FAULT_MODES:
         return arm_stream_fault(mode, fault_file)
     raise ValueError(f"unknown fault mode {mode!r}")
 
