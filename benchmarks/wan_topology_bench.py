@@ -1,0 +1,590 @@
+#!/usr/bin/env python
+"""WAN topology benchmark: region-aware vs region-blind heal striping,
+plus the region-partition drill.
+
+The ISSUE-16 acceptance artifact. Two legs per region matrix, one drill:
+
+**Striping legs** — 4 donor PROCESSES split across regions serve one
+joiner, every donor pacing its egress per the (donor, joiner) link of an
+emulated WAN matrix (``TPUFT_EMULATED_LINK_*`` envs; the joiner's
+``?region=`` tag tells each donor which directed link to charge).
+
+- *blind*: the pre-topology plan — equal LPT stripes over all donors,
+  cold bandwidth EWMA, no donor metadata. Wall clock is bounded by the
+  slowest (cross-region) donors serving a full 1/N share.
+- *aware*: same donors, same links, but the joiner passes ``donor_info``
+  (stable replica id + region per donor, what the manager derives from
+  the quorum) and keeps the per-donor bandwidth EWMA learned by a prior
+  warmup attempt — the weighted-LPT plan shifts bytes onto same-region
+  donors in proportion to measured bandwidth.
+
+Both modes run the SAME warmup attempt first (the aware leg's learning
+pass, the blind leg's fairness control — blind then resets the EWMA), so
+the timed fetches differ ONLY in the plan. Attribution is counter-exact:
+per-donor chunks/bytes from the ``heal_stripe`` trace spans, same- vs
+cross-region bytes from ``tpuft_wan_heal_bytes_total{link=}``, and the
+learned per-donor rates from ``tpuft_heal_donor_bw_bytes_per_sec``.
+
+Ideal weighted-LPT speedup over blind is sum(bw)/(N*min(bw)) — about
+half the raw link-bandwidth ratio with donors split evenly across two
+regions, approaching the full ratio as per-chunk RTT dominates; the
+artifact records measured speedup next to both reference numbers.
+
+**Partition drill** — on the 2-region fleet: the minority region's
+replicas are ejected (the gray-failure plane's verdict on a partitioned
+replica), serve quarantine through ``QuarantineGate`` (injected clock —
+the backoff schedule is recorded, not slept), then storm-rejoin via
+region-aware striping from the majority donors, which kept serving the
+whole time (majority keeps training). Acceptance: every rejoiner lands
+bitwise identical (digest equality), zero checksum failures / era
+rejects / heal exhaustions — a partition never produces a wrong
+adoption.
+
+Usage: ``python benchmarks/wan_topology_bench.py`` → one JSON line on
+stdout + WAN_TOPOLOGY_BENCH.json in the repo root. Env:
+TPUFT_WAN_BENCH_MB (payload, default 8), TPUFT_WAN_BENCH_DEADLINE
+(seconds, default 300).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import zlib
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+NUM_CHUNKS = 24
+STEP = 7
+ERA = 7
+
+# Region matrices under test. Donor i lives in donor_regions[i]; the
+# joiner always sits in joiner_region. Links are (rtt_ms, gbps) env
+# strings — directed pairs resolve donor->joiner on the donor side.
+MATRICES = {
+    "regions_2": {
+        "joiner_region": "us",
+        "donor_regions": ["us", "us", "eu", "eu"],
+        "links": {
+            "TPUFT_EMULATED_LINK_LOCAL": "2,0.16",
+            "TPUFT_EMULATED_LINK_CROSS": "100,0.01",
+        },
+        "intra_gbps": 0.16,
+        "cross_gbps": 0.01,
+    },
+    "regions_3": {
+        "joiner_region": "us",
+        "donor_regions": ["us", "eu", "eu", "ap"],
+        "links": {
+            "TPUFT_EMULATED_LINK_LOCAL": "2,0.16",
+            "TPUFT_EMULATED_LINK_EU_US": "80,0.02",
+            "TPUFT_EMULATED_LINK_AP_US": "150,0.01",
+            "TPUFT_EMULATED_LINK_CROSS": "100,0.02",
+        },
+        "intra_gbps": 0.16,
+        "cross_gbps": 0.01,
+    },
+}
+
+
+def _force_cpu() -> None:
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        pass
+
+
+def _emit(obj: dict) -> None:
+    sys.stdout.write(json.dumps(obj) + "\n")
+    sys.stdout.flush()
+
+
+def synth_state(total_bytes: int) -> dict:
+    import numpy as np
+
+    rng = np.random.default_rng(4321)
+    per = total_bytes // NUM_CHUNKS // 4
+    return {
+        f"w{i}": rng.standard_normal(per).astype(np.float32)
+        for i in range(NUM_CHUNKS)
+    }
+
+
+def state_digest(state: dict) -> str:
+    import numpy as np
+
+    crc = 0
+    for key in sorted(state):
+        crc = zlib.crc32(np.ascontiguousarray(state[key]).tobytes(), crc)
+    return f"{crc:#010x}"
+
+
+def _hygiene_counters() -> dict:
+    from torchft_tpu import metrics
+
+    return {
+        "checksum_failures": metrics.counter_total(
+            "tpuft_heal_checksum_failures_total"
+        ),
+        "era_rejects": metrics.counter_total("tpuft_heal_era_rejects_total"),
+        "heal_exhausted_incidents": metrics.counter_total(
+            "tpuft_trace_incidents_total", kind="heal_exhausted"
+        ),
+        "stripe_bytes": metrics.counter_total("tpuft_heal_stripe_bytes_total"),
+        "wan_same_region_bytes": metrics.counter_total(
+            "tpuft_wan_heal_bytes_total", link="same_region"
+        ),
+        "wan_cross_region_bytes": metrics.counter_total(
+            "tpuft_wan_heal_bytes_total", link="cross_region"
+        ),
+    }
+
+
+# ---------------------------------------------------------------------------
+# roles (subprocesses)
+# ---------------------------------------------------------------------------
+
+
+def role_donor(total_bytes: int) -> None:
+    """One region-pinned donor: stages the seeded state once, serves with
+    per-(donor, joiner)-link pacing (TPUFT_EMULATED_REGION + link envs
+    set by the parent; the joiner's ?region= tag picks the pair)."""
+    _force_cpu()
+    from torchft_tpu.checkpointing.http_transport import HTTPTransport
+
+    state = synth_state(total_bytes)
+    donor = HTTPTransport(timeout=300.0, num_chunks=NUM_CHUNKS)
+    donor.send_checkpoint(
+        [1], step=STEP, state_dict=state, timeout=300.0, quorum_id=ERA
+    )
+    _emit({"addr": donor.metadata(), "digest": state_digest(state)})
+    sys.stdin.readline()
+    donor.shutdown()
+
+
+def _donor_info(addrs: list, regions: list) -> dict:
+    return {
+        addr: {"replica_id": f"donor{i}", "region": regions[i]}
+        for i, addr in enumerate(addrs)
+    }
+
+
+def role_joiner(addrs_csv: str, regions_csv: str, mode: str, total_bytes: int) -> None:
+    """One striping leg: a warmup attempt (cold EWMA — identical plan in
+    both modes) then the timed attempt. ``aware`` keeps the warmup's
+    per-donor bandwidth EWMA + passes donor_info (the weighted,
+    region-labeled plan); ``blind`` resets the EWMA and passes nothing
+    (byte-identical to the pre-topology planner)."""
+    _force_cpu()
+    from torchft_tpu import tracing
+    from torchft_tpu.checkpointing.http_transport import (
+        HTTPTransport,
+        donor_bandwidth,
+        donor_bw_key,
+        reset_donor_bandwidth,
+    )
+
+    addrs = addrs_csv.split(",")
+    regions = regions_csv.split(",")
+    info = _donor_info(addrs, regions) if mode == "aware" else None
+
+    def fetch(transport: "HTTPTransport") -> dict:
+        return transport.recv_checkpoint(
+            0,
+            addrs[0],
+            STEP,
+            timeout=300.0,
+            quorum_id=ERA,
+            donors=addrs[1:],
+            donor_info=info,
+        )
+
+    warm = HTTPTransport(timeout=300.0)
+    t0 = time.monotonic()
+    state = fetch(warm)
+    warmup_wall = time.monotonic() - t0
+    warm.shutdown()
+    digest = state_digest(state)
+    if mode == "blind":
+        reset_donor_bandwidth()
+
+    journal = tracing.current()
+    seen = len(journal.snapshot())
+    before = _hygiene_counters()
+    timed = HTTPTransport(timeout=300.0)
+    t0 = time.monotonic()
+    state = fetch(timed)
+    wall = time.monotonic() - t0
+    timed.shutdown()
+    after = _hygiene_counters()
+
+    per_donor: dict = {}
+    for event in journal.snapshot()[seen:]:
+        if event.get("name") != "heal_stripe":
+            continue
+        args = event.get("args", {})
+        url = args.get("donor")
+        slot = per_donor.setdefault(
+            url,
+            {
+                "region": args.get("region"),
+                "chunks": 0,
+                "bytes": 0,
+                "ewma_bytes_per_sec": None,
+            },
+        )
+        slot["chunks"] += int(args.get("chunks", 0))
+        slot["bytes"] += int(args.get("bytes", 0))
+    for i, addr in enumerate(addrs):
+        bw = donor_bandwidth(
+            donor_bw_key(f"donor{i}" if info else None, addr)
+        )
+        if addr in per_donor and bw is not None:
+            per_donor[addr]["ewma_bytes_per_sec"] = round(bw)
+
+    _emit(
+        {
+            "mode": mode,
+            "warmup_wall_s": round(warmup_wall, 3),
+            "wall_s": round(wall, 3),
+            "digest": state_digest(state),
+            "warmup_digest": digest,
+            "per_donor": per_donor,
+            "counters": {k: after[k] - before[k] for k in after},
+        }
+    )
+
+
+def role_rejoiner(
+    addrs_csv: str, regions_csv: str, num_joiners: int, total_bytes: int
+) -> None:
+    """The minority side of the partition drill: each rejoiner serves
+    quarantine (its partition ejection is on file; injected clock so the
+    recorded backoff schedule costs no wall time) and then storm-rejoins
+    via region-aware striping from the majority donors."""
+    _force_cpu()
+    from torchft_tpu.checkpointing.http_transport import HTTPTransport
+    from torchft_tpu.health import QuarantineGate
+
+    addrs = addrs_csv.split(",")
+    regions = regions_csv.split(",")
+    info = _donor_info(addrs, regions)
+    results: list = [None] * num_joiners
+    errors: list = []
+    barrier = threading.Barrier(num_joiners)
+
+    def rejoin(j: int) -> None:
+        clock = [1000.0]
+        with tempfile.TemporaryDirectory() as tmp:
+            gate = QuarantineGate(
+                f"minority{j}",
+                state_dir=tmp,
+                probe=lambda: True,  # the partition healed
+                sleep=lambda s: clock.__setitem__(0, clock[0] + s),
+                wall=lambda: clock[0],
+            )
+            gate.record_ejection("region partition: lost quorum connectivity")
+            assert gate.pending(), "ejection must gate the rejoin"
+            served = gate.serve()
+        transport = HTTPTransport(timeout=300.0)
+        try:
+            barrier.wait(timeout=60)
+            t0 = time.monotonic()
+            state = transport.recv_checkpoint(
+                0,
+                addrs[j % len(addrs)],
+                STEP,
+                timeout=300.0,
+                quorum_id=ERA,
+                donors=[a for a in addrs if a != addrs[j % len(addrs)]],
+                stripe_rotation=j,
+                donor_info=info,
+            )
+            results[j] = {
+                "wall_s": round(time.monotonic() - t0, 3),
+                "digest": state_digest(state),
+                "quarantine_backoff_s": round(served["waited_s"], 3)
+                if "waited_s" in served
+                else served,
+                "quarantine_attempts": served.get("attempts"),
+            }
+        except Exception as e:  # noqa: BLE001 — reported, not swallowed
+            errors.append(f"rejoiner {j}: {type(e).__name__}: {e}")
+        finally:
+            transport.shutdown()
+
+    before = _hygiene_counters()
+    t0 = time.monotonic()
+    threads = [
+        threading.Thread(target=rejoin, args=(j,), name=f"rejoiner-{j}")
+        for j in range(num_joiners)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    ttfs = time.monotonic() - t0
+    after = _hygiene_counters()
+    _emit(
+        {
+            "ttfs_s": round(ttfs, 3),
+            "rejoiners": results,
+            "errors": errors,
+            "counters": {k: after[k] - before[k] for k in after},
+        }
+    )
+
+
+# ---------------------------------------------------------------------------
+# orchestration
+# ---------------------------------------------------------------------------
+
+
+def _spawn(role: str, *args: str, env: dict | None = None) -> subprocess.Popen:
+    child_env = dict(os.environ)
+    child_env["JAX_PLATFORMS"] = "cpu"
+    child_env.update(env or {})
+    return subprocess.Popen(
+        [sys.executable, str(Path(__file__).resolve()), "--role", role, *args],
+        stdin=subprocess.PIPE,
+        stdout=subprocess.PIPE,
+        text=True,
+        env=child_env,
+    )
+
+
+def _read_json(proc: subprocess.Popen, deadline: float) -> dict:
+    line = [None]
+
+    def read() -> None:
+        assert proc.stdout is not None
+        line[0] = proc.stdout.readline()
+
+    t = threading.Thread(target=read, daemon=True)
+    t.start()
+    t.join(timeout=deadline)
+    if line[0] is None or not line[0].strip():
+        raise TimeoutError(f"child produced no JSON within {deadline}s")
+    return json.loads(line[0])
+
+
+def _shutdown_donors(donors: list) -> None:
+    for d in donors:
+        if d.poll() is None:
+            try:
+                assert d.stdin is not None
+                d.stdin.write("done\n")
+                d.stdin.flush()
+            except OSError:
+                pass
+    time.sleep(0.2)
+    for d in donors:
+        if d.poll() is None:
+            d.kill()
+
+
+def _run_matrix(name: str, spec: dict, total_bytes: int, deadline: float) -> dict:
+    joiner_region = spec["joiner_region"]
+    donor_regions = spec["donor_regions"]
+    links = spec["links"]
+    joiner_env = {
+        "TPUFT_EMULATED_REGION": joiner_region,
+        "TPUFT_TRACE": "1",
+        **links,
+    }
+    donors = [
+        _spawn(
+            "donor",
+            str(total_bytes),
+            env={"TPUFT_EMULATED_REGION": reg, **links},
+        )
+        for reg in donor_regions
+    ]
+    out: dict = {
+        "joiner_region": joiner_region,
+        "donor_regions": donor_regions,
+        "links": links,
+        "legs": {},
+    }
+    try:
+        staged = [_read_json(d, deadline) for d in donors]
+        digest = staged[0]["digest"]
+        assert all(s["digest"] == digest for s in staged), "donors disagree"
+        addrs = ",".join(s["addr"] for s in staged)
+        regions_csv = ",".join(donor_regions)
+
+        for mode in ("blind", "aware"):
+            leg = _spawn(
+                "joiner",
+                addrs,
+                regions_csv,
+                mode,
+                str(total_bytes),
+                env=joiner_env,
+            )
+            result = _read_json(leg, deadline)
+            leg.wait(timeout=60)
+            assert result["digest"] == digest, f"{mode}: wrong adoption"
+            assert result["warmup_digest"] == digest, f"{mode}: warmup wrong"
+            counters = result["counters"]
+            assert counters["checksum_failures"] == 0, counters
+            assert counters["era_rejects"] == 0, counters
+            assert counters["heal_exhausted_incidents"] == 0, counters
+            out["legs"][mode] = {
+                "wall_s": result["wall_s"],
+                "warmup_wall_s": result["warmup_wall_s"],
+                "per_donor": result["per_donor"],
+                "counters": counters,
+            }
+            print(
+                f"[wan:{name}] {mode}: {result['wall_s']}s "
+                f"(warmup {result['warmup_wall_s']}s)",
+                file=sys.stderr,
+            )
+    finally:
+        _shutdown_donors(donors)
+
+    blind, aware = out["legs"]["blind"], out["legs"]["aware"]
+    out["speedup"] = round(blind["wall_s"] / max(aware["wall_s"], 1e-9), 2)
+    intra, cross = spec["intra_gbps"], spec["cross_gbps"]
+    out["link_bandwidth_ratio"] = round(intra / cross, 1)
+    per_donor_gbps = [
+        intra if r == joiner_region else cross for r in donor_regions
+    ]
+    out["ideal_lpt_speedup"] = round(
+        sum(per_donor_gbps) / (len(per_donor_gbps) * min(per_donor_gbps)), 2
+    )
+    out["aware_beats_blind"] = out["speedup"] >= 2.0
+    # Counter-exact attribution: the aware plan must have moved the byte
+    # majority onto same-region donors (the blind plan splits ~evenly).
+    same = aware["counters"]["wan_same_region_bytes"]
+    cross_b = aware["counters"]["wan_cross_region_bytes"]
+    out["aware_same_region_byte_share"] = round(
+        same / max(same + cross_b, 1), 3
+    )
+    return out
+
+
+def _run_partition_drill(
+    spec: dict, total_bytes: int, deadline: float, minority: int = 2
+) -> dict:
+    """Majority donors keep serving (keep training) while the minority
+    serves quarantine and storm-rejoins cross-region."""
+    joiner_region = spec["joiner_region"]
+    majority_regions = [r for r in spec["donor_regions"] if r == joiner_region]
+    links = spec["links"]
+    donors = [
+        _spawn(
+            "donor",
+            str(total_bytes),
+            env={"TPUFT_EMULATED_REGION": reg, **links},
+        )
+        for reg in majority_regions
+    ]
+    try:
+        staged = [_read_json(d, deadline) for d in donors]
+        digest = staged[0]["digest"]
+        assert all(s["digest"] == digest for s in staged), "donors disagree"
+        addrs = ",".join(s["addr"] for s in staged)
+        # The rejoiners sit in the minority region: every heal byte rides
+        # the cross-region link.
+        minority_region = next(
+            r for r in spec["donor_regions"] if r != joiner_region
+        )
+        leg = _spawn(
+            "rejoiner",
+            addrs,
+            ",".join(majority_regions),
+            str(minority),
+            str(total_bytes),
+            env={
+                "TPUFT_EMULATED_REGION": minority_region,
+                "TPUFT_TRACE": "1",
+                **links,
+            },
+        )
+        result = _read_json(leg, deadline)
+        leg.wait(timeout=60)
+    finally:
+        _shutdown_donors(donors)
+
+    assert not result["errors"], result["errors"]
+    rejoiners = result["rejoiners"]
+    assert all(r and r["digest"] == digest for r in rejoiners), (
+        "wrong adoption after partition"
+    )
+    counters = result["counters"]
+    return {
+        "minority_size": minority,
+        "majority_donors": len(majority_regions),
+        "minority_region": minority_region,
+        "ttfs_s": result["ttfs_s"],
+        "rejoiners": rejoiners,
+        "counters": counters,
+        "bitwise_identical": True,
+        "zero_wrong_adoption": (
+            counters["checksum_failures"] == 0
+            and counters["era_rejects"] == 0
+            and counters["heal_exhausted_incidents"] == 0
+        ),
+    }
+
+
+def main() -> None:
+    if "--role" in sys.argv:
+        i = sys.argv.index("--role")
+        role = sys.argv[i + 1]
+        if role == "donor":
+            role_donor(int(sys.argv[i + 2]))
+        elif role == "joiner":
+            role_joiner(
+                sys.argv[i + 2],
+                sys.argv[i + 3],
+                sys.argv[i + 4],
+                int(sys.argv[i + 5]),
+            )
+        elif role == "rejoiner":
+            role_rejoiner(
+                sys.argv[i + 2],
+                sys.argv[i + 3],
+                int(sys.argv[i + 4]),
+                int(sys.argv[i + 5]),
+            )
+        else:
+            raise SystemExit(f"unknown role {role}")
+        return
+
+    payload_mb = float(os.environ.get("TPUFT_WAN_BENCH_MB", "8"))
+    deadline = float(os.environ.get("TPUFT_WAN_BENCH_DEADLINE", "300"))
+    total_bytes = int(payload_mb * (1 << 20))
+
+    out: dict = {
+        "payload_mb": payload_mb,
+        "num_donors": 4,
+        "num_chunks": NUM_CHUNKS,
+        "matrices": {},
+    }
+    for name, spec in MATRICES.items():
+        out["matrices"][name] = _run_matrix(name, spec, total_bytes, deadline)
+    out["partition_drill"] = _run_partition_drill(
+        MATRICES["regions_2"], total_bytes, deadline
+    )
+    out["aware_beats_blind_everywhere"] = all(
+        m["aware_beats_blind"] for m in out["matrices"].values()
+    )
+
+    artifact = Path(__file__).resolve().parents[1] / "WAN_TOPOLOGY_BENCH.json"
+    artifact.write_text(json.dumps(out, indent=2) + "\n")
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -301,6 +301,9 @@ def collect(lighthouse_addr: str, prev: Optional[Dict[str, Any]] = None) -> Dict
                 joiners = _gauge(snap, "tpuft_heal_storm_joiners")
                 row.update(
                     step=snap.get("step"),
+                    # WAN topology: the region the replica's netem map
+                    # assigns it (None on a topology-less fleet -> "-").
+                    region=snap.get("region"),
                     batches_committed=snap.get("batches_committed"),
                     healing=bool(snap.get("healing"))
                     or _gauge(snap, "tpuft_healing") == 1,
@@ -354,6 +357,7 @@ def collect(lighthouse_addr: str, prev: Optional[Dict[str, Any]] = None) -> Dict
 _COLUMNS = (
     ("replica_id", "REPLICA"),
     ("rank", "RANK"),
+    ("region", "REGION"),
     ("step", "STEP"),
     ("steps_per_sec", "STEP/S"),
     ("commits", "COMMITS"),

@@ -639,14 +639,37 @@ def explain_step(merged: List[Dict[str, Any]], step: int) -> str:
     # much), one per reassignment (which donor's stripe moved and why),
     # one for the delta-rejoin savings.
     for e in at_step:
+        if e["name"] != "heal_stripe_plan":
+            continue
+        args = e.get("args") or {}
+        weights = args.get("weights")
+        if weights:
+            # Bandwidth-weighted plan: the per-donor EWMA bytes/sec the
+            # LPT partition balanced against (regions ride alongside so
+            # a cross-region donor's low weight explains itself).
+            regions = args.get("regions") or []
+            pairs = []
+            for idx, w in enumerate(weights):
+                reg = regions[idx] if idx < len(regions) and regions[idx] else "?"
+                pairs.append(f"d{idx}[{reg}]={_fmt_mb(w)}/s")
+            lines.append(
+                f"stripe weights: {proc_label(proc_key(e))} planned "
+                f"{args.get('chunks', 0)} chunk(s) over "
+                f"{args.get('donors', 0)} donor(s) by measured bandwidth: "
+                + " ".join(pairs)
+            )
+    for e in at_step:
         if e["name"] != "heal_stripe":
             continue
         args = e.get("args") or {}
         fenced = " [FENCED]" if args.get("fenced") in (True, "True") else ""
+        region = args.get("region")
+        region_txt = f" [{region}]" if region else ""
         lines.append(
             f"heal stripe: {proc_label(proc_key(e))} fetched "
             f"{args.get('chunks', 0)} chunk(s) "
-            f"({_fmt_mb(args.get('bytes', 0))}) from {args.get('donor', '?')} "
+            f"({_fmt_mb(args.get('bytes', 0))}) from "
+            f"{args.get('donor', '?')}{region_txt} "
             f"in {float(args.get('duration_s', 0.0)):.2f}s{fenced}"
         )
     for e in at_step:

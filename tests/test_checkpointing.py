@@ -125,14 +125,15 @@ def test_http_transport_wrong_step_404s() -> None:
     donor = HTTPTransport(timeout=1.0)
     try:
         donor.send_checkpoint([1], step=3, state_dict={"x": np.ones(1)}, timeout=10)
-        # timeout=2 keeps each bounded-retry window short: the property is
-        # "fails once the window expires", which 2 s proves as well as 5.
+        # timeout=1 keeps each bounded-retry window short: the property is
+        # "fails once the window expires", which 1 s proves as well as 5
+        # (the donor answers 404 instantly; the window is pure retry wait).
         with pytest.raises(Exception):
-            donor.recv_checkpoint(0, donor.metadata(), step=99, timeout=2)
+            donor.recv_checkpoint(0, donor.metadata(), step=99, timeout=1)
         # disallow stops serving entirely.
         donor.disallow_checkpoint()
         with pytest.raises(Exception):
-            donor.recv_checkpoint(0, donor.metadata(), step=3, timeout=2)
+            donor.recv_checkpoint(0, donor.metadata(), step=3, timeout=1)
     finally:
         donor.shutdown()
 

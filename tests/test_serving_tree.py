@@ -480,9 +480,9 @@ def test_netem_server_declared_pacing_not_double_billed(monkeypatch) -> None:
         _wire.netem, "pace", lambda n: calls.__setitem__("pace", calls["pace"] + 1)
     )
 
-    def latency() -> None:
+    def latency(peer_region=None) -> None:
         calls["latency"] += 1
-        real_latency()
+        real_latency(peer_region)
 
     monkeypatch.setattr(_wire.netem, "pace_latency", latency)
 

@@ -72,6 +72,7 @@ Serve modes (``$TPUFT_HEAL_SERVE_MODE`` / the ``serve_mode`` ctor arg):
 
 from __future__ import annotations
 
+import functools
 import hashlib
 import logging
 import os
@@ -138,6 +139,9 @@ ENV_HEAL_DELTA = "TPUFT_HEAL_DELTA"
 # per heal attempt bounds the aggregate; pacer-injected sleep is credited
 # back to the watchdog so self-pacing can never read as a gray donor.
 ENV_HEAL_INGRESS = "TPUFT_HEAL_INGRESS_GBPS"
+# Smoothing factor for the per-donor bandwidth EWMA that weights the
+# stripe plan (0 < alpha <= 1; higher = favor the latest observation).
+ENV_HEAL_BW_ALPHA = "TPUFT_HEAL_BW_EWMA_ALPHA"
 
 
 def _env_flag(env: str, default: bool = True) -> bool:
@@ -173,6 +177,79 @@ def heal_ingress_gbps(default: float = 0.0) -> float:
         return float(os.environ.get(ENV_HEAL_INGRESS, str(default)))
     except ValueError:
         return default
+
+
+def heal_bw_alpha(default: float = 0.3) -> float:
+    """Per-donor bandwidth EWMA smoothing (``$TPUFT_HEAL_BW_EWMA_ALPHA``)."""
+    try:
+        alpha = float(os.environ.get(ENV_HEAL_BW_ALPHA, str(default)))
+    except ValueError:
+        return default
+    return alpha if 0.0 < alpha <= 1.0 else default
+
+
+# ---------------------------------------------------------------------------
+# Per-donor bandwidth EWMA: the stripe workers already measure bytes/sec per
+# verified chunk; persisting it per STABLE donor id (the replica-id prefix
+# before the first ':', so a donor restart keeps its history — falls back to
+# the donor URL when the manager did not resolve an id) lets the NEXT stripe
+# plan weight each donor by what it actually delivered. Process-local and
+# advisory: a cold cache (weights all None) degrades to the byte-balanced
+# plan, never a stall.
+# ---------------------------------------------------------------------------
+
+_donor_bw_lock = threading.Lock()
+_donor_bw: Dict[str, float] = {}  # stable donor key -> bytes/sec EWMA
+
+
+def donor_bw_key(replica_id: Optional[str], url: str) -> str:
+    """Stable EWMA key: replica-id prefix when known, else the donor URL."""
+    if replica_id:
+        return replica_id.split(":", 1)[0] or url
+    return url
+
+
+def observe_donor_bandwidth(key: str, bytes_per_sec: float) -> float:
+    """Folds one bytes/sec observation into the donor's EWMA; returns the
+    updated estimate (also exported as ``tpuft_heal_donor_bw_bytes_per_sec``)."""
+    if bytes_per_sec <= 0.0:
+        with _donor_bw_lock:
+            return _donor_bw.get(key, 0.0)
+    alpha = heal_bw_alpha()
+    with _donor_bw_lock:
+        prev = _donor_bw.get(key)
+        est = bytes_per_sec if prev is None else prev + alpha * (bytes_per_sec - prev)
+        _donor_bw[key] = est
+    metrics.set_gauge("tpuft_heal_donor_bw_bytes_per_sec", est, donor=key)
+    return est
+
+
+def donor_bandwidth(key: str) -> Optional[float]:
+    with _donor_bw_lock:
+        return _donor_bw.get(key)
+
+
+def reset_donor_bandwidth() -> None:
+    """Drop all per-donor EWMA state (tests/benches between legs)."""
+    with _donor_bw_lock:
+        _donor_bw.clear()
+
+
+def _donor_weights(keys: List[str]) -> Optional[List[float]]:
+    """Relative stripe weights from the EWMA store: donors with no history
+    get the mean of the known ones (neutral, not penalized). All-unknown
+    (or degenerate) -> None, which keeps the plan byte-balanced."""
+    with _donor_bw_lock:
+        known = [_donor_bw[k] for k in keys if k in _donor_bw and _donor_bw[k] > 0]
+        if not known:
+            return None
+        mean = sum(known) / len(known)
+        weights = [
+            _donor_bw[k] if _donor_bw.get(k, 0) > 0 else mean for k in keys
+        ]
+    if min(weights) <= 0.0:
+        return None
+    return weights
 
 logger = logging.getLogger(__name__)
 
@@ -630,6 +707,7 @@ def _plan_stripes(
     sizes: Optional[List[int]],
     num_donors: int,
     rotation: int = 0,
+    weights: Optional[List[float]] = None,
 ) -> List[List[int]]:
     """Partitions chunk indices across ``num_donors`` stripes, byte-balanced
     when ``sizes`` is known (greedy longest-processing-time: biggest chunk
@@ -644,7 +722,15 @@ def _plan_stripes(
     (the manager derives each from its joiner ordinal / group rank /
     quorum id — a pure function, never negotiated) so they seed their
     plans at DIFFERENT donors instead of all hammering donor 0's first
-    stripe at the same instant."""
+    stripe at the same instant.
+
+    ``weights`` (per-donor relative bandwidth, from the per-donor EWMA)
+    turns byte balance into TIME balance: each chunk goes to the donor
+    whose finish time (load + chunk) / weight is smallest, so a donor
+    twice as fast takes ~twice the bytes. Equal weights produce exactly
+    the unweighted plan (argmin of load+c equals argmin of load when c
+    is common), so a cold or uniform EWMA changes nothing; ignored
+    without ``sizes``."""
     num_donors = max(1, num_donors)
     rotation = rotation % num_donors
     stripes: List[List[int]] = [[] for _ in range(num_donors)]
@@ -652,13 +738,26 @@ def _plan_stripes(
         for slot, index in enumerate(chunks):
             stripes[(slot + rotation) % num_donors].append(index)
         return stripes
+    if weights is not None and (
+        len(weights) != num_donors or min(weights) <= 0.0
+    ):
+        weights = None
     loads = [0] * num_donors
     by_weight = sorted(chunks, key=lambda i: (-sizes[i], i))
     for index in by_weight:
-        slot = min(
-            range(num_donors),
-            key=lambda d: (loads[d], (d - rotation) % num_donors),
-        )
+        if weights is None:
+            slot = min(
+                range(num_donors),
+                key=lambda d: (loads[d], (d - rotation) % num_donors),
+            )
+        else:
+            slot = min(
+                range(num_donors),
+                key=lambda d: (
+                    (loads[d] + sizes[index]) / weights[d],
+                    (d - rotation) % num_donors,
+                ),
+            )
         stripes[slot].append(index)
         loads[slot] += sizes[index]
     for stripe in stripes:
@@ -905,6 +1004,11 @@ class HTTPTransport(CheckpointTransport[Any]):
                     self.end_headers()
                     self.wfile.write(body)
                 elif parts[2] == "full":
+                    # WAN topology: the joiner tags its region on the URL
+                    # so this donor paces the DIRECTED (donor, joiner)
+                    # link; untagged requests ride the global single link.
+                    peer_reg = urllib.parse.parse_qs(split.query).get("region")
+                    peer_region = peer_reg[0] if peer_reg else None
                     total = sum(8 + c.total_size for c in staged.chunks)
                     self.send_response(200)
                     self.send_header("Content-Type", "application/octet-stream")
@@ -914,8 +1018,8 @@ class HTTPTransport(CheckpointTransport[Any]):
                     self.end_headers()
                     out = self.wfile
                     if netem.enabled():  # emulated-DCN heal path
-                        netem.pace_latency()
-                        out = netem.PacingWriter(out)
+                        netem.pace_latency(peer_region)
+                        out = netem.PacingWriter(out, peer_region=peer_region)
                     if tenant is not None:
                         out = maybe_pace_serve(out, cls="serving", tenant=tenant)
                     else:
@@ -943,6 +1047,10 @@ class HTTPTransport(CheckpointTransport[Any]):
                         # (or instead of) the body.
                         self.close_connection = True
                         return
+                    # WAN topology: pace the directed (donor, joiner) link
+                    # when the joiner tagged its region on the chunk URL.
+                    peer_reg = urllib.parse.parse_qs(split.query).get("region")
+                    peer_region = peer_reg[0] if peer_reg else None
                     self.send_response(200)
                     self.send_header("Content-Type", "application/octet-stream")
                     self.send_header("Content-Length", str(chunk.total_size))
@@ -951,11 +1059,11 @@ class HTTPTransport(CheckpointTransport[Any]):
                     self.end_headers()
                     out = self.wfile
                     if netem.enabled():  # emulated-DCN heal path
-                        netem.pace_latency()
+                        netem.pace_latency(peer_region)
                         # Serialization time interleaves with the writes —
                         # one up-front sleep would hold the wire silent
                         # past the joiner's per-recv inactivity timeout.
-                        out = netem.PacingWriter(out)
+                        out = netem.PacingWriter(out, peer_region=peer_region)
                     if tenant is not None:
                         out = maybe_pace_serve(out, cls="serving", tenant=tenant)
                     else:
@@ -994,7 +1102,7 @@ class HTTPTransport(CheckpointTransport[Any]):
 
         self._server = DualStackServer(("::", 0), Handler)
         self._thread = threading.Thread(
-            target=self._server.serve_forever, daemon=True, name="tpuft-http-ckpt"
+            target=functools.partial(self._server.serve_forever, poll_interval=0.05), daemon=True, name="tpuft-http-ckpt"
         )
         self._thread.start()
 
@@ -1292,11 +1400,18 @@ class HTTPTransport(CheckpointTransport[Any]):
         donors: Optional[List[str]] = None,
         local_state: Optional[Any] = None,
         stripe_rotation: int = 0,
+        donor_info: Optional[Dict[str, Dict[str, Any]]] = None,
     ) -> Any:
         # Donor set: the assigned donor first (it is the one the quorum
         # proved holds max_step state), then every other advertised donor,
         # deduped and capped. The digest is donor-independent by design,
-        # so any of them can serve any chunk.
+        # so any of them can serve any chunk. ``donor_info`` (url ->
+        # {"replica_id", "region"}, from the manager's quorum view) is
+        # advisory: it keys the bandwidth EWMA by stable id and labels
+        # same- vs cross-region bytes; absent entries degrade to
+        # URL-keyed, region-less accounting.
+        donor_info = donor_info or {}
+        local_reg = netem.local_region()
         donor_urls = [metadata]
         if donors and heal_stripe_enabled():
             for url in donors:
@@ -1386,10 +1501,14 @@ class HTTPTransport(CheckpointTransport[Any]):
         ]
 
         # Chunk-URL query: the era fence plus this joiner's fairness tag
-        # (the donor's pacer keys its per-joiner sub-bucket on it).
+        # (the donor's pacer keys its per-joiner sub-bucket on it) plus —
+        # under a WAN topology — the joiner's region, so the donor's
+        # emulated-link shim can charge the (donor, joiner) pair's link.
         query: Dict[str, Any] = {"peer": self._peer_tag}
         if quorum_id is not None:
             query["quorum_id"] = quorum_id
+        if local_reg is not None:
+            query["region"] = local_reg
         era_tag = "?" + urllib.parse.urlencode(query)
         min_bps = _heal_min_bps()
         ingress_gbps = heal_ingress_gbps()
@@ -1459,10 +1578,30 @@ class HTTPTransport(CheckpointTransport[Any]):
                     )
                 elapsed = time.perf_counter() - t0
                 if elapsed > 0:
+                    bps = reader.total / elapsed
                     metrics.histogram(
                         "tpuft_heal_stream_bytes_per_sec",
                         buckets=metrics.DEFAULT_BYTES_PER_SEC_BUCKETS,
-                    ).observe(reader.total / elapsed)
+                    ).observe(bps)
+                    # Feed the per-donor EWMA the stripe planner weights by.
+                    info = donor_info.get(base, {})
+                    observe_donor_bandwidth(
+                        donor_bw_key(info.get("replica_id"), base), bps
+                    )
+                # Same- vs cross-region byte attribution: only when both
+                # sides' regions are known (a region-less fleet emits
+                # neither label, keeping pre-topology dashboards exact).
+                donor_reg = donor_info.get(base, {}).get("region")
+                if local_reg is not None and donor_reg is not None:
+                    metrics.inc(
+                        "tpuft_wan_heal_bytes_total",
+                        reader.total,
+                        link=(
+                            "same_region"
+                            if donor_reg == local_reg
+                            else "cross_region"
+                        ),
+                    )
                 return chunk, reader.total
 
             # Same bounded retry as the meta fetch — the donor's serve
@@ -1491,6 +1630,7 @@ class HTTPTransport(CheckpointTransport[Any]):
                 bytes=int(verified[1]),
                 total_chunks=num_chunks,
                 donor=base,
+                region=donor_info.get(base, {}).get("region"),
             )
             return int(verified[1])
 
@@ -1507,6 +1647,7 @@ class HTTPTransport(CheckpointTransport[Any]):
                 fetch_chunk=fetch_chunk,
                 step=step,
                 rotation=stripe_rotation,
+                donor_info=donor_info,
             )
         elif len(missing) <= 1:
             for i in missing:
@@ -1742,6 +1883,7 @@ class HTTPTransport(CheckpointTransport[Any]):
         fetch_chunk: Callable[..., int],
         step: int,
         rotation: int = 0,
+        donor_info: Optional[Dict[str, Dict[str, Any]]] = None,
     ) -> None:
         """Fetches ``missing`` striped across ``donor_urls``: one worker per
         donor walks its byte-balanced stripe; each chunk verifies through
@@ -1750,20 +1892,38 @@ class HTTPTransport(CheckpointTransport[Any]):
         stripe has its unfetched chunks reassigned round-robin to the
         surviving donors; when the last donor dies the remaining error
         raises to the caller (the resume cache keeps everything already
-        verified)."""
+        verified). When the per-donor bandwidth EWMA has history for this
+        donor set, the plan is TIME-balanced (bytes proportional to
+        measured bandwidth); a cold cache keeps the byte-balanced plan."""
         cond = threading.Condition()
+        donor_info = donor_info or {}
+        donor_keys = [
+            donor_bw_key(donor_info.get(u, {}).get("replica_id"), u)
+            for u in donor_urls
+        ]
+        weights = _donor_weights(donor_keys)
         stripes = _plan_stripes(
-            missing, chunk_sizes, len(donor_urls), rotation=rotation
+            missing,
+            chunk_sizes,
+            len(donor_urls),
+            rotation=rotation,
+            weights=weights,
         )
         # The plan in the fleet timeline: which rotation this joiner
-        # derived and how wide its donor set is — --explain-step pairs
-        # concurrent joiners' plans to show a storm's donor spread.
+        # derived, how wide its donor set is, and (when the EWMA had
+        # history) the per-donor bandwidth weights + regions the plan
+        # used — --explain-step pairs concurrent joiners' plans to show
+        # a storm's donor spread and WHY the byte split is skewed.
         tracing.record(
             "heal_stripe_plan",
             step=step,
             donors=len(donor_urls),
             rotation=rotation % max(len(donor_urls), 1),
             chunks=len(missing),
+            weights=(
+                [round(w, 1) for w in weights] if weights is not None else None
+            ),
+            regions=[donor_info.get(u, {}).get("region") for u in donor_urls],
         )
         queues: Dict[str, deque] = {
             url: deque(stripe) for url, stripe in zip(donor_urls, stripes)
@@ -1869,6 +2029,7 @@ class HTTPTransport(CheckpointTransport[Any]):
                 bytes=fetched_bytes,
                 duration_s=round(time.perf_counter() - t0, 6),
                 fenced=url not in live,
+                region=donor_info.get(url, {}).get("region"),
             )
 
         with ThreadPoolExecutor(

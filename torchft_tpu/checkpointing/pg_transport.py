@@ -104,11 +104,14 @@ class PGTransport(CheckpointTransport[Any]):
         skip_parts: Optional[Any] = None,
         donors: Optional[Any] = None,
         local_state: Optional[Any] = None,
+        stripe_rotation: int = 0,
+        donor_info: Optional[Any] = None,
     ) -> Any:
-        # skip_parts / donors / local_state ignored: the PG stream is
-        # positional, so parts are not independently addressable, there is
-        # exactly one sender, and a delta diff has nothing to key on —
-        # fetch everything (the ABC-documented degradation).
+        # skip_parts / donors / local_state / stripe_rotation / donor_info
+        # ignored: the PG stream is positional, so parts are not
+        # independently addressable, there is exactly one sender, and a
+        # delta diff has nothing to key on — fetch everything (the
+        # ABC-documented degradation).
         (length_arr,) = self._pg.recv([np.empty(1, dtype=np.int64)], src_rank).wait(timeout)
         (meta_buf,) = self._pg.recv(
             [np.empty(int(length_arr[0]), dtype=np.uint8)], src_rank

@@ -55,6 +55,7 @@ Prime's collective communications library).
 from __future__ import annotations
 
 import base64
+import functools
 import importlib.util
 import json
 import logging
@@ -711,6 +712,12 @@ def _child_main(argv: Optional[List[str]] = None) -> int:
             peer = urllib.parse.parse_qs(split.query).get(
                 "peer", [str(self.client_address[0])]
             )[0]
+            # WAN topology parity with the inline handler: the joiner's
+            # ?region= tag selects the directed (donor, joiner) emulated
+            # link (the child inherits the topology envs; its own region
+            # comes from TPUFT_EMULATED_REGION or the replica-id map).
+            peer_reg = urllib.parse.parse_qs(split.query).get("region")
+            peer_region = peer_reg[0] if peer_reg else None
             # Tenant/auth parity with the inline handler: a bearer token
             # marks serving-class read traffic (per-tenant sub-bucket);
             # an unknown token is refused in-child too.
@@ -755,8 +762,8 @@ def _child_main(argv: Optional[List[str]] = None) -> int:
                 self.end_headers()
                 out = self.wfile
                 if netem.enabled():
-                    netem.pace_latency()
-                    out = netem.PacingWriter(out)
+                    netem.pace_latency(peer_region)
+                    out = netem.PacingWriter(out, peer_region=peer_region)
                 if tenant is not None:
                     out = maybe_pace_serve(out, cls="serving", tenant=tenant)
                 else:
@@ -797,8 +804,8 @@ def _child_main(argv: Optional[List[str]] = None) -> int:
             self.end_headers()
             out = self.wfile
             if netem.enabled():
-                netem.pace_latency()
-                out = netem.PacingWriter(out)
+                netem.pace_latency(peer_region)
+                out = netem.PacingWriter(out, peer_region=peer_region)
             if tenant is not None:
                 out = maybe_pace_serve(out, cls="serving", tenant=tenant)
             else:
@@ -834,7 +841,7 @@ def _child_main(argv: Optional[List[str]] = None) -> int:
 
     server = DualStackServer(("::", 0), Handler)
     server_thread = threading.Thread(
-        target=server.serve_forever, daemon=True, name="tpuft-serve-child-http"
+        target=functools.partial(server.serve_forever, poll_interval=0.05), daemon=True, name="tpuft-serve-child-http"
     )
     server_thread.start()
     sys.stdout.write(

@@ -66,6 +66,7 @@ class CheckpointTransport(ABC, Generic[T]):
         donors: Optional[List[str]] = None,
         local_state: Optional[T] = None,
         stripe_rotation: int = 0,
+        donor_info: Optional[dict] = None,
     ) -> T:
         """Fetches the state for ``step`` from ``src_rank``.
 
@@ -93,7 +94,13 @@ class CheckpointTransport(ABC, Generic[T]):
         rank, quorum id) so N simultaneous joiners seed their stripe
         plans at different donors. Stripe-capable transports fold it
         into their chunk partition; others MUST ignore it (it never
-        changes WHAT is fetched, only the donor ordering)."""
+        changes WHAT is fetched, only the donor ordering).
+
+        ``donor_info``: advisory per-donor identity map (donor URL ->
+        {"replica_id", "region"}) from the manager's quorum view; a
+        topology-aware transport uses it to key bandwidth estimates and
+        label same- vs cross-region bytes, others MUST ignore it (it
+        never changes what is fetched or verified)."""
 
     def disallow_checkpoint(self) -> None:
         """Stops serving the staged checkpoint (called at commit)."""

@@ -83,6 +83,13 @@ KNOWN_ENV = {
     "TPUFT_BENCH_CPU_DEADLINE", "TPUFT_BENCH_CPU_FULL_DEADLINE",
     "TPUFT_BENCH_NO_PROBE",
     "TPUFT_EMULATED_RTT_MS", "TPUFT_EMULATED_GBPS",
+    # WAN topology matrix (utils/netem.py): replica-id -> region map,
+    # explicit self-region override, relay-tier region pin, and the heal
+    # plane's per-donor bandwidth EWMA smoothing factor. Per-pair link
+    # envs (TPUFT_EMULATED_LINK_<SRC>_<DST> / _LOCAL / _CROSS) are
+    # prefix-matched in _check_env rather than enumerated here.
+    "TPUFT_EMULATED_TOPOLOGY", "TPUFT_EMULATED_REGION",
+    "TPUFT_SERVING_REGION", "TPUFT_HEAL_BW_EWMA_ALPHA",
     # Correctness tooling: runtime lock-order detector + static analyzer
     # (python -m torchft_tpu.analysis; docs/static_analysis.md).
     "TPUFT_LOCK_CHECK", "TPUFT_ANALYSIS_REFERENCE", "TPUFT_ANALYSIS_BASELINE",
@@ -113,6 +120,10 @@ KNOWN_ENV = {
     "TPUFT_TRANSPORT_BENCH_DEADLINE", "TPUFT_TRANSPORT_RSS_BOUND",
     "TPUFT_TRANSPORT_BENCH_PACE_GBPS", "TPUFT_TRANSPORT_BENCH_STRIPE_GBPS",
     "TPUFT_CPS_REPLICAS", "TPUFT_CPS_ROUNDS", "TPUFT_CPS_GROUP_WORLD_SIZE",
+    "TPUFT_STORM_BENCH_MB", "TPUFT_STORM_BENCH_GBPS",
+    "TPUFT_STORM_BENCH_INGRESS_GBPS", "TPUFT_STORM_BENCH_DEADLINE",
+    "TPUFT_WAN_BENCH_MB", "TPUFT_WAN_BENCH_DEADLINE",
+    "TPUFT_QUANT_BENCH_BYTES",
 }
 
 Check = Tuple[str, Callable[[], Tuple[str, str]]]  # name -> (status, detail)
@@ -863,6 +874,54 @@ def _check_health(lighthouse: str) -> Tuple[str, str]:
     )
 
 
+def _check_topology() -> Tuple[str, str]:
+    """WAN topology matrix state. WARN, never FAIL: a malformed topology
+    env degrades to the global single link at runtime (heals still work,
+    just region-blind), so the doctor's job is to make that visible."""
+    from torchft_tpu.utils import netem
+
+    desc = netem.describe_topology()
+    if not desc.get("configured"):
+        return (
+            "PASS",
+            "no WAN topology (TPUFT_EMULATED_TOPOLOGY unset; wire planes "
+            "region-blind, single global link applies)",
+        )
+    errors = desc.get("errors") or []
+    if errors:
+        return (
+            "WARN",
+            "topology configured but partially malformed (falls back to "
+            f"the global link where unparsable): {'; '.join(errors)}",
+        )
+    names = desc.get("region_names") or []
+    if desc.get("single_region"):
+        return (
+            "WARN",
+            f"topology maps every replica to one region ({names[0] if names else '?'}) "
+            "— degenerate case: region-aware striping/relay/DiLoCo routing "
+            "all reduce to the region-blind path (is a region missing?)",
+        )
+    pieces = [
+        f"{len(names)} regions ({', '.join(names)})",
+        f"{desc.get('num_links', 0)} per-pair links",
+    ]
+    if desc.get("has_intra_default") or desc.get("has_cross_default"):
+        pieces.append(
+            "defaults: "
+            + "/".join(
+                n for n, on in (
+                    ("intra", desc.get("has_intra_default")),
+                    ("cross", desc.get("has_cross_default")),
+                ) if on
+            )
+        )
+    self_region = desc.get("self_region")
+    if self_region:
+        pieces.append(f"self={self_region}")
+    return "PASS", "WAN topology: " + ", ".join(pieces)
+
+
 def _check_env() -> Tuple[str, str]:
     # Value validation first — a fatal misconfig must FAIL even when a
     # typo'd var would also WARN.
@@ -872,6 +931,9 @@ def _check_env() -> Tuple[str, str]:
     unknown = sorted(
         name for name in os.environ
         if name.startswith("TPUFT_") and name not in KNOWN_ENV
+        # Per-pair WAN link envs embed region names, so they can't be
+        # enumerated in KNOWN_ENV — the topology check validates them.
+        and not name.startswith("TPUFT_EMULATED_LINK_")
     )
     if unknown:
         return "WARN", f"unrecognized TPUFT_* vars (typo?): {', '.join(unknown)}"
@@ -886,6 +948,7 @@ def run_checks(lighthouse: str, skip_device: bool = False) -> int:
         ("wire codecs", _check_kernels),
         ("codec negotiation", _check_wire_codec_negotiation),
         ("env vars", _check_env),
+        ("wan topology", _check_topology),
         ("commit pipeline", _check_commit_pipeline),
         ("weight history", _check_history),
         ("metrics", _check_metrics),

@@ -28,12 +28,35 @@ import jax
 import numpy as np
 
 from torchft_tpu.manager import Manager
+from torchft_tpu.utils import netem
 from torchft_tpu.utils.transfer import prefetch_to_host
 from torchft_tpu.work import Work
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["LocalSGD", "DiLoCo"]
+__all__ = ["LocalSGD", "DiLoCo", "cross_region_fleet", "region_split"]
+
+
+def region_split(replica_ids: Sequence[str]) -> Dict[str, List[str]]:
+    """Groups replica ids by their WAN topology region (region name ->
+    ids; ``None``-region ids group under ``""``). Pure bookkeeping over
+    the netem region map — the replica axis stays OUTSIDE the jax Mesh,
+    so a membership change in any region never recompiles a program.
+    With no topology configured every id lands in the ``""`` group (the
+    single-region degenerate case)."""
+    split: Dict[str, List[str]] = {}
+    for rid in replica_ids:
+        split.setdefault(netem.region_of(rid) or "", []).append(rid)
+    return split
+
+
+def cross_region_fleet() -> bool:
+    """True when the configured WAN topology names more than one region —
+    the signal DiLoCo uses to default its outer-sync wire to the
+    quantized codec (outer syncs are the cross-region traffic; per-step
+    DDP inside a region never leaves the cheap links)."""
+    topo = netem.describe_topology()
+    return bool(topo.get("configured")) and not topo.get("single_region", True)
 
 
 def _to_device_like(host: np.ndarray, like: Any) -> Any:
@@ -497,6 +520,16 @@ class DiLoCo:
             launch and its blocking sync (tau in the Streaming DiLoCo paper).
         fragment_update_alpha: local/global mix after a sync (0 = take the
             global params, 1 = keep local).
+        should_quantize: quantize the outer-sync wire (fp8 allreduce).
+            ``None`` (the default) auto-resolves from the WAN topology
+            map: a fleet spanning >1 region quantizes its outer syncs
+            (they are the traffic that crosses the expensive inter-region
+            links — per-step DDP stays intra-region by construction),
+            a single-region or topology-less fleet keeps the full-
+            precision wire, exactly the pre-topology default. The split
+            comes from the same netem region map as everything else and
+            NEVER becomes a jax Mesh axis — membership changes must not
+            recompile.
     """
 
     def __init__(
@@ -508,7 +541,7 @@ class DiLoCo:
         sync_every: int,
         n_fragments: int = 1,
         fragment_fn: Optional[Callable[[int], List[List[int]]]] = None,
-        should_quantize: bool = False,
+        should_quantize: Optional[bool] = None,
         fragment_sync_delay: int = 0,
         fragment_update_alpha: float = 0.0,
     ) -> None:
@@ -526,6 +559,15 @@ class DiLoCo:
             raise ValueError("Fragment must be synced before it is reduced again")
         if not 0.0 <= fragment_update_alpha <= 1.0:
             raise ValueError("fragment_update_alpha must be between 0 and 1")
+
+        if should_quantize is None:
+            should_quantize = cross_region_fleet()
+            if should_quantize:
+                logger.info(
+                    "DiLoCo: WAN topology spans multiple regions; outer "
+                    "syncs ride the quantized wire (pass "
+                    "should_quantize=False to override)"
+                )
 
         self._manager = manager
         self._inner_tx = inner_tx

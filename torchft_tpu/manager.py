@@ -62,7 +62,7 @@ from torchft_tpu.history import WeightHistory
 from torchft_tpu.parallel.process_group import ProcessGroup, ReduceOp
 from torchft_tpu.parallel.store import StoreClient
 from torchft_tpu.telemetry import commits_logger, errors_logger, quorums_logger
-from torchft_tpu.utils import lockcheck
+from torchft_tpu.utils import lockcheck, netem
 from torchft_tpu.utils.profiling import trace_span
 from torchft_tpu.utils.transfer import prefetch_to_host
 from torchft_tpu.work import Work, _DummyWork
@@ -548,6 +548,10 @@ class Manager:
         self._heal_attempts = 0
         self._heal_last_failed_donor: Optional[str] = None
         self._heal_failed_donors: Dict[str, bool] = {}
+        # Advisory per-donor identity map for the CURRENT heal attempt
+        # (donor url -> {"replica_id", "region"}); rebuilt by
+        # _resolve_stripe_donors each attempt.
+        self._heal_donor_info: Dict[str, Dict[str, Any]] = {}
 
         # Quorum state.
         self._quorum_id = -1
@@ -592,6 +596,10 @@ class Manager:
         replica_id_bytes = self._store.get("replica_id", timeout=self._connect_timeout)
         assert replica_id_bytes is not None
         self._replica_id = replica_id_bytes.decode()
+        # WAN topology: register who this process is with the emulated-link
+        # shim (a no-op without a configured topology) so wire seams can
+        # resolve the local region from the replica-id map.
+        netem.set_local_replica_id(self._replica_id)
         self._client = ManagerClient(addr.decode(), connect_timeout=self._connect_timeout)
 
         self._logger = _ManagerLogger(self, self._replica_id, self._group_rank)
@@ -1631,6 +1639,19 @@ class Manager:
                 "tpuft_heal_storm_rotation", rotation, **self._metric_labels
             )
             donor_urls = self._resolve_stripe_donors(quorum, rotation=rotation)
+            # The assigned donor rides the same advisory info map (its
+            # replica id comes from the quorum view by address) so the
+            # transport's bandwidth EWMA and same-/cross-region byte
+            # accounting cover the anchor donor too.
+            q = quorum.quorum
+            if q is not None:
+                for member in q.participants:
+                    if member.address == src_addr:
+                        self._heal_donor_info[checkpoint_metadata] = {
+                            "replica_id": member.replica_id,
+                            "region": netem.region_of(member.replica_id),
+                        }
+                        break
             local_state = self._delta_local_state(quorum)
             with trace_span(
                 "tpuft::manager::_checkpoint_transport::recv_checkpoint",
@@ -1658,6 +1679,7 @@ class Manager:
                     donors=donor_urls,
                     local_state=local_state,
                     stripe_rotation=rotation,
+                    donor_info=self._heal_donor_info,
                 )
             # Restore manager accounting immediately; user state is
             # applied from the main thread when safe.
@@ -1730,14 +1752,24 @@ class Manager:
         Striping is skipped entirely at ``max_step == 0``: the init_sync
         heal is a per-LOCAL-rank mosaic (state is intentionally NOT
         identical across replicas yet), so only the assigned donor is
-        valid there."""
+        valid there.
+
+        Under a WAN topology (``netem.topology_enabled``) the rotated
+        candidate order is stably re-sorted same-region-first BEFORE the
+        cap, so the stripe set saturates the cheap intra-region links and
+        cross-region donors only fill remaining slots; a region with zero
+        live same-region donors keeps its cross-region candidates — the
+        preference can narrow where bytes come from, never whether they
+        come. With no topology the sort key is uniform and the order (and
+        behavior) is byte-identical to the region-blind plan."""
+        self._heal_donor_info = {}
         if not heal_stripe_enabled() or quorum.max_step <= 0:
             return []
         q = quorum.quorum
         if q is None:
             return []
         candidates = [
-            member.address
+            (member.address, member.replica_id)
             for member in q.participants
             if member.address
             and member.address != quorum.recover_src_manager_address
@@ -1752,23 +1784,33 @@ class Manager:
         # different donor subsets, not just different orderings.
         rotate = rotation % len(candidates)
         candidates = candidates[rotate:] + candidates[:rotate]
+        my_region = netem.local_region()
+        if my_region is not None:
+            # Stable: within each region class the storm rotation's
+            # ordering survives, so concurrent joiners still spread.
+            candidates.sort(
+                key=lambda c: 0 if netem.region_of(c[1]) == my_region else 1
+            )
         # The cap minus the assigned donor; the transport re-applies it
         # after deduping, this just avoids pointless resolution RPCs.
         candidates = candidates[: max(0, heal_stripe_max_donors() - 1)]
         urls: List[str] = []
-        for addr in candidates:
+        for addr, rid in candidates:
             try:
                 client = ManagerClient(
                     addr, connect_timeout=self._connect_timeout
                 )
                 try:
-                    urls.append(
-                        client._checkpoint_metadata(
-                            self._group_rank, timeout=self._timeout
-                        )
+                    url = client._checkpoint_metadata(
+                        self._group_rank, timeout=self._timeout
                     )
                 finally:
                     client.close()
+                urls.append(url)
+                self._heal_donor_info[url] = {
+                    "replica_id": rid,
+                    "region": netem.region_of(rid),
+                }
             except Exception as e:  # noqa: BLE001 — best-effort per donor
                 self._logger.warn(
                     f"stripe donor {addr} metadata resolution failed ({e}); "
@@ -2173,6 +2215,11 @@ class Manager:
                     "step": self._step,
                     "batches_committed": self._batches_committed,
                     "healing": self._healing,
+                    # WAN topology: this replica's region (None without a
+                    # configured topology) — feeds fleet_status's REGION
+                    # column; a string, so it rides the snapshot top level
+                    # rather than the numeric metrics registry.
+                    "region": netem.local_region(),
                     "metrics": metrics.snapshot(),
                 }
             ).encode()

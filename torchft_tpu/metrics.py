@@ -31,6 +31,7 @@ Env: ``TPUFT_METRICS_PORT`` (serve /metrics on this port; 0 = ephemeral),
 
 from __future__ import annotations
 
+import functools
 import json
 import os
 import threading
@@ -517,7 +518,7 @@ class MetricsHTTPServer:
         self._server = ThreadingHTTPServer(("", port), Handler)
         self._server.daemon_threads = True
         self._thread = threading.Thread(
-            target=self._server.serve_forever, daemon=True, name="tpuft-metrics"
+            target=functools.partial(self._server.serve_forever, poll_interval=0.05), daemon=True, name="tpuft-metrics"
         )
         self._thread.start()
 

@@ -11,6 +11,7 @@ wedged client only costs its own session.
 
 from __future__ import annotations
 
+import functools
 import json
 import os
 import socket
@@ -87,7 +88,7 @@ class ParameterServer(ABC):
 
         self._http = DualStack(("::", bind_port), Handler)
         self._http_thread = threading.Thread(
-            target=self._http.serve_forever, daemon=True, name="tpuft-ps-http"
+            target=functools.partial(self._http.serve_forever, poll_interval=0.05), daemon=True, name="tpuft-ps-http"
         )
         self._http_thread.start()
 

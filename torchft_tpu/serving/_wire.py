@@ -31,10 +31,13 @@ the multi-tenant egress fairness identity, checked at every serve seam.
 
 from __future__ import annotations
 
+import http.client
 import json
 import os
 import random
+import socket
 import threading
+import urllib.error
 import urllib.parse
 import urllib.request
 from typing import Any, Callable, Dict, List, Optional
@@ -105,23 +108,101 @@ def notify_hold_sec(default: float = 25.0) -> float:
         return default
 
 
-def _fetch(url: str, timeout: float, token: Optional[str]) -> Any:
+class CancelScope:
+    """Cross-thread abort for a parked long-poll GET. A notify request
+    blocks in ``resp.read()`` for up to the server-side hold; a relay
+    shutting down cannot wait that out, so its shutdown closes the scope
+    and the in-flight socket is torn down from under the read (which
+    raises immediately into the caller's failover path). One-shot:
+    attaching to a closed scope aborts the response on the spot."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._resp: Any = None
+        self._closed = False
+
+    def _abort(self, obj: Any) -> None:
+        # socket.shutdown is the only call that reliably unblocks a recv()
+        # parked in another thread; close() alone may not.
+        sock = getattr(obj, "sock", None)  # http.client.HTTPConnection
+        if sock is None:
+            fp = getattr(obj, "fp", None)  # http.client.HTTPResponse
+            sock = getattr(getattr(fp, "raw", None), "_sock", None)
+        try:
+            if sock is not None:
+                sock.shutdown(socket.SHUT_RDWR)
+        except Exception:  # noqa: BLE001 — already closed / exotic transport
+            pass
+        try:
+            obj.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+    def attach(self, obj: Any) -> None:
+        with self._lock:
+            self._resp = obj
+            if self._closed:
+                self._abort(obj)
+
+    def detach(self) -> None:
+        with self._lock:
+            self._resp = None
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            if self._resp is not None:
+                self._abort(self._resp)
+                self._resp = None
+
+
+def _fetch(
+    url: str,
+    timeout: float,
+    token: Optional[str],
+    cancel: Optional[CancelScope] = None,
+) -> Any:
     """One GET with the netem link charged at this CLIENT seam: request
     leg up front, response leg (latency + serialization) after the read —
-    unless the server declared it already paced the body."""
-    request = urllib.request.Request(url)
-    if token:
-        request.add_header("Authorization", f"Bearer {token}")
+    unless the server declared it already paced the body.
+
+    With ``cancel``, the connection itself goes through http.client so the
+    scope owns it BEFORE any byte arrives — a long-poll server parks the
+    whole response (status line included), so aborting only a response
+    object obtained from urlopen would be too late."""
     link = netem.enabled()
     if link:
         netem.pace_latency()  # request leg
-    resp = urllib.request.urlopen(request, timeout=timeout)
-    try:
-        body = resp.read()
-        server_paced = resp.headers.get(netem.PACED_HEADER) == "1"
-        status = resp.status
-    finally:
-        resp.close()
+    if cancel is not None:
+        parsed = urllib.parse.urlsplit(url)
+        conn = http.client.HTTPConnection(
+            parsed.hostname, parsed.port, timeout=timeout
+        )
+        cancel.attach(conn)
+        try:
+            path = parsed.path + (f"?{parsed.query}" if parsed.query else "")
+            headers = {"Authorization": f"Bearer {token}"} if token else {}
+            conn.request("GET", path, headers=headers)
+            resp = conn.getresponse()
+            body = resp.read()
+            server_paced = resp.headers.get(netem.PACED_HEADER) == "1"
+            status = resp.status
+        finally:
+            cancel.detach()
+            conn.close()
+        if status >= 400:
+            raise urllib.error.HTTPError(url, status, "fetch failed", None, None)
+    else:
+        request = urllib.request.Request(url)
+        if token:
+            request.add_header("Authorization", f"Bearer {token}")
+        resp = urllib.request.urlopen(request, timeout=timeout)
+        try:
+            body = resp.read()
+            server_paced = resp.headers.get(netem.PACED_HEADER) == "1"
+            status = resp.status
+        finally:
+            resp.close()
     if link and not server_paced:
         netem.pace(len(body))  # response leg: RTT/2 + bytes/bandwidth
     return body, status
@@ -150,6 +231,7 @@ def fetch_notify(
     hold: Optional[float] = None,
     after_seq: Optional[int] = None,
     after_pub: Optional[str] = None,
+    cancel: Optional[CancelScope] = None,
 ) -> Optional[Dict[str, Any]]:
     """One long-poll round against ``base``: parks server-side until a
     version newer than ``after`` is announced (bounded by ``hold``) and
@@ -167,7 +249,7 @@ def fetch_notify(
     if after_pub:
         url += f"&after_pub={urllib.parse.quote(str(after_pub))}"
     # The socket timeout must outlive the server-side hold.
-    body, status = _fetch(url, hold + timeout, token)
+    body, status = _fetch(url, hold + timeout, token, cancel=cancel)
     if status == 204 or not body:
         return None
     data = json.loads(body)
@@ -184,6 +266,7 @@ def latest_descriptor(
     origin_ts: Optional[float] = None,
     pub_seq: Optional[int] = None,
     pub_id: Optional[str] = None,
+    region: Optional[str] = None,
 ) -> Dict[str, Any]:
     """The ``/serving/latest`` body: the staging manifest
     (http_transport._stage_manifest) plus where to fetch the chunks from
@@ -191,13 +274,18 @@ def latest_descriptor(
     tier went live (``published_ts``), the serving node's tree depth
     (publisher = 0, each relay tier +1 — fleet_status's RELAY column),
     and the ORIGIN publication time (``origin_ts``, preserved across
-    tiers so publish-to-edge propagation is measurable end to end)."""
+    tiers so publish-to-edge propagation is measurable end to end).
+    ``region`` advertises which WAN region this tier serves FROM (an edge
+    relay's readers use it to pick the nearest tier) — advisory routing
+    metadata only, never part of the verify-then-swap integrity chain."""
     descriptor = dict(manifest)
     descriptor["format"] = 1
     descriptor["base"] = base
     descriptor["published_ts"] = published_ts
     descriptor["depth"] = depth
     descriptor["origin_ts"] = origin_ts if origin_ts is not None else published_ts
+    if region is not None:
+        descriptor["region"] = region
     if pub_seq is not None:
         # Publication sequence: monotone over publishes AND retractions,
         # preserved across relay tiers. It is what lets a deliberate

@@ -47,6 +47,7 @@ drill's deterministic trigger.
 
 from __future__ import annotations
 
+import functools
 import json
 import logging
 import os
@@ -74,7 +75,7 @@ from torchft_tpu.serving._wire import (
     latest_descriptor,
     serve_notify,
 )
-from torchft_tpu.utils import faultinject
+from torchft_tpu.utils import faultinject, netem
 
 __all__ = [
     "WeightPublisher",
@@ -240,7 +241,7 @@ class WeightPublisher:
 
         self._server = DualStack(("::", bind_port), Handler)
         self._thread = threading.Thread(
-            target=self._server.serve_forever,
+            target=functools.partial(self._server.serve_forever, poll_interval=0.05),
             daemon=True,
             name="tpuft-publish-announce",
         )
@@ -396,6 +397,9 @@ class WeightPublisher:
                 depth=0,
                 pub_seq=self._pub_seq,
                 pub_id=self._pub_id,
+                # WAN topology: the root tier's region (None without one) —
+                # regional relays use it to order their upstream sets.
+                region=netem.local_region(),
             )
             self._latest = latest
             self._retracted.discard(step)

@@ -35,6 +35,7 @@ Resilience properties (the heal plane's, applied to serving):
 
 from __future__ import annotations
 
+import functools
 import json
 import logging
 import os
@@ -57,6 +58,7 @@ from torchft_tpu.serving._wire import (
     LATEST_ROUTE,
     NOTIFY_ROUTE,
     VERSION_ROUTE_PREFIX,
+    CancelScope,
     NotifyHub,
     PollPacer,
     chunk_crc,
@@ -70,11 +72,15 @@ from torchft_tpu.serving._wire import (
     serve_notify,
     validate_latest,
 )
-from torchft_tpu.utils import faultinject
+from torchft_tpu.utils import faultinject, netem
 
 __all__ = ["CachingRelay", "ENV_SERVING_POLL_SEC", "serving_poll_sec"]
 
 ENV_SERVING_POLL_SEC = "TPUFT_SERVING_POLL_SEC"
+# WAN topology: the region this serving node advertises on its
+# descriptors (readers/child relays prefer same-region tiers). Falls back
+# to the netem topology map's view of this process.
+ENV_SERVING_REGION = "TPUFT_SERVING_REGION"
 
 logger = logging.getLogger(__name__)
 
@@ -188,10 +194,22 @@ class CachingRelay:
         notify: Optional[bool] = None,
         token: Optional[str] = None,
         jitter_seed: Optional[int] = None,
+        region: Optional[str] = None,
     ) -> None:
         if not upstreams:
             raise ValueError("CachingRelay needs at least one upstream")
         self._upstreams = list(upstreams)
+        # WAN topology: the region this tier serves FROM (advertised on
+        # descriptors) — explicit ctor arg > $TPUFT_SERVING_REGION > the
+        # netem topology map. Upstream regions are LEARNED from their
+        # descriptors during discovery; same-region upstreams are then
+        # preferred (stable order otherwise) so the root→regional-edge
+        # link is crossed once per version, not once per reader.
+        env_region = os.environ.get(ENV_SERVING_REGION, "").strip()
+        self._region = (region or env_region or netem.local_region() or None)
+        if self._region is not None:
+            self._region = self._region.lower()
+        self._upstream_regions: Dict[str, Optional[str]] = {}
         self._timeout = timeout
         self._poll_interval = (
             poll_interval if poll_interval is not None else serving_poll_sec()
@@ -211,6 +229,9 @@ class CachingRelay:
             max_versions=DEFAULT_SERVING_VERSIONS, ring="relay"
         )
         self._stop = threading.Event()
+        # Aborts the poll thread's parked upstream notify GET at shutdown
+        # (the server-side hold can be ~25 s; a teardown must not wait it out).
+        self._notify_cancel = CancelScope()
         self.dead = False
         # Downstream long-poll edge: subscribers/child relays park here.
         self._hub = NotifyHub()
@@ -350,7 +371,7 @@ class CachingRelay:
 
         self._server = DualStack(("::", bind_port), Handler)
         self._serve_thread = threading.Thread(
-            target=self._server.serve_forever, daemon=True, name="tpuft-relay-http"
+            target=functools.partial(self._server.serve_forever, poll_interval=0.05), daemon=True, name="tpuft-relay-http"
         )
         self._serve_thread.start()
         self._poll_thread: Optional[threading.Thread] = None
@@ -412,6 +433,19 @@ class CachingRelay:
             origin_ts=version.origin_ts,
             pub_seq=version.pub_seq,
             pub_id=version.pub_id,
+            region=self._region,
+        )
+
+    def _ordered_upstreams(self) -> List[str]:
+        """The upstream set, same-region tiers first (stable within each
+        class, so the configured order still breaks ties). Region-less
+        relays — or upstreams that never advertised one — keep the exact
+        configured order; preference can only reorder, never drop."""
+        if self._region is None:
+            return list(self._upstreams)
+        return sorted(
+            self._upstreams,
+            key=lambda u: 0 if self._upstream_regions.get(u) == self._region else 1,
         )
 
     def _consume_fault(self) -> bool:
@@ -497,13 +531,14 @@ class CachingRelay:
         hold expires (False — re-arm), or every upstream failed (None —
         the caller falls back to the jittered poll cadence; a tier that
         cannot push degrades to polling, never to silence)."""
-        for upstream in list(self._upstreams):
+        for upstream in self._ordered_upstreams():
             if self._stop.is_set():
                 return False
             try:
                 woke = fetch_notify(
                     upstream, after, self._timeout, token=self._token,
                     after_seq=after_seq, after_pub=after_pub,
+                    cancel=self._notify_cancel,
                 )
             except Exception:  # noqa: BLE001 — old/dead upstream: next one
                 metrics.inc("tpuft_serving_upstream_failovers_total")
@@ -536,7 +571,7 @@ class CachingRelay:
                 return False
             best = descriptor
         else:
-            for upstream in self._upstreams:
+            for upstream in self._ordered_upstreams():
                 try:
                     latest = fetch_json(
                         f"{upstream}{LATEST_ROUTE}", self._timeout, token=self._token
@@ -549,14 +584,19 @@ class CachingRelay:
                     metrics.inc("tpuft_serving_integrity_rejects_total")
                     logger.warning("upstream %s rejected: %s", upstream, reason)
                     continue
+                # Learn this tier's advertised region for the next round's
+                # nearest-tier ordering (advisory routing metadata only).
+                self._upstream_regions[upstream] = latest.get("region")
                 if best is None or _newer(latest, best):
                     best = latest
             if best is None:
                 return False
             # Every upstream announcing the SAME digest serves
             # interchangeable bytes (committed state is bitwise
-            # identical) — they form this pull's failover set.
-            for upstream in self._upstreams:
+            # identical) — they form this pull's failover set, same-region
+            # sources first so failover crosses regions only when the
+            # near tier is gone.
+            for upstream in self._ordered_upstreams():
                 try:
                     latest = fetch_json(
                         f"{upstream}{LATEST_ROUTE}", self._timeout, token=self._token
@@ -680,6 +720,15 @@ class CachingRelay:
         # reads the fully verified version.
         self._hub.announce(step, seq=latest.get("pub_seq"))
         metrics.inc("tpuft_serving_pulls_total")
+        # WAN accounting: a pull whose source tier advertised a different
+        # region crossed the expensive link — the evidence that the
+        # root→regional edge is crossed once per version, not per reader.
+        src_region = latest.get("region")
+        if self._region is not None and src_region is not None:
+            if src_region != self._region:
+                metrics.inc("tpuft_wan_serving_cross_region_pulls_total")
+            else:
+                metrics.inc("tpuft_wan_serving_same_region_pulls_total")
         if reused:
             metrics.inc("tpuft_serving_delta_chunks_reused_total", reused)
             metrics.inc("tpuft_serving_delta_bytes_saved_total", saved)
@@ -736,6 +785,7 @@ class CachingRelay:
 
     def shutdown(self, wait: bool = True) -> None:
         self._stop.set()
+        self._notify_cancel.close()
         self._hub.close()
         if not self.dead:
             self._server.shutdown()
