@@ -192,6 +192,33 @@ def _ft_phase_fields() -> dict:
     return fields
 
 
+def _ft_goodput_fields(t0: float, t1: float) -> dict:
+    """Goodput attribution over the steady-state measurement window: the
+    same conservation-exact trace-ring fold the fleet ledger runs
+    (torchft_tpu.goodput.fold_events), reduced to the headline
+    ``goodput_fraction`` plus the top-2 badput buckets. Additive like
+    ``_ft_phase_fields``; empty when the trace plane is off or the window
+    collapsed, so every pre-existing bench key is untouched."""
+    from torchft_tpu import goodput, tracing
+
+    journal = tracing.default()
+    if not journal.enabled or t1 <= t0:
+        return {}
+    seconds = goodput.fold_events(journal._copy_ring(), t0, t1)
+    wall = sum(seconds.values())
+    if wall <= 0:
+        return {}
+    fields: dict = {
+        "goodput_fraction": round(
+            seconds.get("committed_compute", 0.0) / wall, 4
+        )
+    }
+    for i, (bucket, secs) in enumerate(goodput.top_badput(seconds, n=2)):
+        fields[f"badput_{i + 1}_bucket"] = bucket
+        fields[f"badput_{i + 1}_share"] = round(secs / wall, 4)
+    return fields
+
+
 STEPS = int(os.environ.get("TPUFT_BENCH_STEPS", "20"))
 WARMUP = 3
 BATCH = int(os.environ.get("TPUFT_BENCH_BATCH", "8"))
@@ -455,6 +482,7 @@ def main() -> None:
         from torchft_tpu import metrics as ft_metrics
 
         ft_metrics.REGISTRY.reset()
+        goodput_window_t0 = time.monotonic()
 
         def run_plain() -> None:
             nonlocal p, opt_state
@@ -520,6 +548,7 @@ def main() -> None:
     # and kill-recovery commits belong to the drill's own fields, not to
     # the steady-state step decomposition measured above.
     ft_phase = _ft_phase_fields()
+    ft_goodput = _ft_goodput_fields(goodput_window_t0, time.monotonic())
 
     # ---- 2-replica-group drill: wire sync cost + kill recovery ----
     two_group = _two_group_drill()
@@ -643,6 +672,7 @@ def main() -> None:
                 "ft_ddp_pipelined_step_overhead_ms": ft_ddp_pipelined_step_overhead_ms,
                 "device_sync_rtt_ms": device_sync_rtt_ms,
                 **ft_phase,
+                **ft_goodput,
                 **({"cpu_full_reference": cpu_full_ref} if cpu_full_ref else {}),
                 **two_group,
             }

@@ -1,0 +1,354 @@
+#!/usr/bin/env python
+"""Goodput ledger benchmark: conservation-exact badput attribution under chaos.
+
+The goodput plane's acceptance evidence (ISSUE 17): every second of every
+replica's wall-clock must land in exactly ONE bucket — under kill/heal,
+rollback, and straggler-ejection chaos, not just in the happy path — and
+the windowed SLO burn-rate alerting must page exactly once per sustained
+burn and never on a blip.
+
+Topology: pure Python, no native plane — N threads-as-replicas, each
+owning its own ``tracing.TraceJournal`` + ``goodput.GoodputLedger`` (the
+REAL fold/window/SLO machinery, nothing mocked), driven on per-replica
+VIRTUAL clocks (TraceJournal's injectable ``mono``/``wall``): the plan
+advances virtual seconds and records the exact span/instant shapes the
+Manager/optim/heal/health planes emit (``quorum``, ``commit_barrier``,
+``commit``, ``heal_send``/``heal_recv``, ``commit_failed``/``rollback``,
+``health_quarantine``), so every attribution assertion is deterministic
+and the whole run takes ~1 s wall for ~minutes of simulated fleet time.
+
+Legs:
+
+- **baseline**: healthy fleet — goodput must be >= 0.97 (the quorum +
+  barrier tax is the only badput).
+- **kill_heal**: one replica dies (silent journal -> idle), rejoins
+  through a striped heal (``heal_recv``) served by a donor
+  (``heal_send``); heal tax must land in heal_joiner/heal_donor.
+- **rollback**: a refused commit discards a speculative suffix — the
+  wasted compute must read rollback_recompute, the replay's commit
+  re-earns committed_compute.
+- **straggler_ejection**: a gray replica is ejected and sits out a
+  quarantine (``health_quarantine`` span) — degraded time, then rejoins.
+- **slo_drill**: a single-window blip trips NOTHING; K consecutive
+  burning windows latch exactly ONE breach (counter-exact:
+  ``tpuft_slo_breaches_total``, one ``slo_breach`` event, one
+  ``slo_goodput`` incident).
+
+Every leg asserts conservation: per closed window,
+``|sum(buckets) - (t1 - t0)| <= 1e-4`` (the payload rounds buckets to
+1 us; the raw fold is exact to float epsilon — tests/test_goodput.py).
+
+Usage: ``python benchmarks/goodput_bench.py`` -> one JSON line on stdout
++ GOODPUT_BENCH.json in the repo root (~1 s wall). Exit 1 on any failed
+check, straggler_bench.py style.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+from pathlib import Path
+from typing import Any, Callable, Dict, List
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from torchft_tpu import goodput, metrics, tracing  # noqa: E402
+
+NUM_REPLICAS = 4
+WINDOW_SEC = 5.0
+STEP_COMPUTE_S = 1.0
+QUORUM_S = 0.010
+BARRIER_S = 0.005
+STEPS = 100
+
+
+class SimReplica(threading.Thread):
+    """One replica: its own journal, virtual clock, and ledger. ``plan``
+    scripts each step (advance clock + record the real event shapes)."""
+
+    def __init__(
+        self, index: int, plan: Callable[["SimReplica", int], None], steps: int
+    ) -> None:
+        super().__init__(name=f"replica{index}", daemon=True)
+        self.index = index
+        self.plan = plan
+        self.steps = steps
+        self.t = 0.0  # virtual monotonic seconds
+        self.journal = tracing.TraceJournal(
+            maxlen=1 << 15,
+            wall=lambda: 1.7e9 + self.t,
+            mono=lambda: self.t,
+            enabled=True,
+        )
+        self.journal.configure(
+            job_id="goodput-bench", replica_id=f"r{index}", group_rank=0
+        )
+        self.ledger = goodput.GoodputLedger(
+            journal=self.journal,
+            window_sec=WINDOW_SEC,
+            labels={"replica_id": f"r{index}", "group_rank": "0"},
+        )
+
+    # -- event vocabulary (the shapes the real planes record) --------------
+
+    def span(self, name: str, dur: float, **args: Any) -> None:
+        self.t += dur
+        self.journal.record(name, ph="X", dur=dur, **args)
+
+    def instant(self, name: str, **args: Any) -> None:
+        self.journal.record(name, ph="i", **args)
+
+    def healthy_step(self, step: int) -> None:
+        self.span("quorum", QUORUM_S)
+        self.t += STEP_COMPUTE_S  # ambient compute: dispatch + device time
+        self.span("commit_barrier", BARRIER_S)
+        self.instant("commit", step=step)
+
+    def idle_for(self, seconds: float) -> None:
+        self.t += seconds  # dead replica: nothing recorded
+
+    def run(self) -> None:
+        for step in range(self.steps):
+            self.journal.set_step(step=step)
+            self.plan(self, step)
+            self.ledger.collect(step=step)
+        self.ledger.collect(force=True)
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "replica_id": f"r{self.index}",
+            "region": "us" if self.index < NUM_REPLICAS // 2 else "eu",
+            "goodput": self.ledger.payload(max_windows=1000),
+        }
+
+
+def run_leg(
+    plans: List[Callable[[SimReplica, int], None]], steps: int = STEPS
+) -> List[SimReplica]:
+    replicas = [SimReplica(i, plan, steps) for i, plan in enumerate(plans)]
+    for r in replicas:
+        r.start()
+    for r in replicas:
+        r.join(timeout=60.0)
+        assert not r.is_alive(), f"replica{r.index} wedged"
+    return replicas
+
+
+def conservation_err(replicas: List[SimReplica]) -> float:
+    """Worst |sum(buckets) - window width| across every closed window."""
+    worst = 0.0
+    for r in replicas:
+        for window in r.ledger.series.windows():
+            width = window["t1"] - window["t0"]
+            total = sum((window.get("seconds") or {}).values())
+            worst = max(worst, abs(total - width))
+    return worst
+
+
+def fleet_report(replicas: List[SimReplica]) -> Dict[str, Any]:
+    return goodput.merge_windows([r.snapshot() for r in replicas])
+
+
+def main() -> None:
+    checks: List[Dict[str, Any]] = []
+
+    def check(name: str, ok: bool, detail: str = "") -> None:
+        checks.append({"check": name, "ok": bool(ok), "detail": detail})
+        if not ok:
+            print(f"CHECK FAILED: {name}: {detail}", file=sys.stderr)
+
+    out: Dict[str, Any] = {"metric": "goodput_bench", "legs": {}}
+
+    # -- leg 1: healthy baseline -------------------------------------------
+    replicas = run_leg([lambda r, s: r.healthy_step(s)] * NUM_REPLICAS)
+    err = conservation_err(replicas)
+    report = fleet_report(replicas)
+    out["legs"]["baseline"] = {
+        "fleet_goodput": report["goodput"],
+        "wall_seconds": report["wall_seconds"],
+        "windows": sum(len(r.ledger.series) for r in replicas),
+        "conservation_max_abs_err_s": round(err, 9),
+        "badput": report["badput"][:2],
+    }
+    check("baseline_conservation", err <= 1e-4, f"max err {err:.2e}s")
+    check(
+        "baseline_goodput_ge_0.97",
+        report["goodput"] is not None and report["goodput"] >= 0.97,
+        f"goodput {report['goodput']}",
+    )
+
+    # -- leg 2: kill one replica, heal it back -----------------------------
+    DEAD_S, HEAL_S, KILL_STEP = 20.0, 8.0, 30
+
+    def victim_plan(r: SimReplica, step: int) -> None:
+        if step == KILL_STEP:
+            r.idle_for(DEAD_S)  # SIGKILL: the journal goes silent
+            r.span("heal_recv", HEAL_S, stripe_workers=NUM_REPLICAS - 1)
+        r.healthy_step(step)
+
+    def donor_plan(r: SimReplica, step: int) -> None:
+        if step == KILL_STEP:
+            r.span("heal_send", HEAL_S)
+        r.healthy_step(step)
+
+    plans: List[Callable[[SimReplica, int], None]] = [
+        donor_plan,
+        lambda r, s: r.healthy_step(s),
+        lambda r, s: r.healthy_step(s),
+        victim_plan,
+    ]
+    replicas = run_leg(plans)
+    err = conservation_err(replicas)
+    report = fleet_report(replicas)
+    victim = report["per_replica"]["r3"]["seconds"]
+    donor = report["per_replica"]["r0"]["seconds"]
+    out["legs"]["kill_heal"] = {
+        "fleet_goodput": report["goodput"],
+        "victim_idle_s": victim.get("idle", 0.0),
+        "victim_heal_joiner_s": victim.get("heal_joiner", 0.0),
+        "donor_heal_donor_s": donor.get("heal_donor", 0.0),
+        "conservation_max_abs_err_s": round(err, 9),
+        "badput": report["badput"][:3],
+    }
+    check("kill_heal_conservation", err <= 1e-4, f"max err {err:.2e}s")
+    check(
+        "kill_heal_attribution",
+        abs(victim.get("idle", 0.0) - DEAD_S) < 0.01
+        and abs(victim.get("heal_joiner", 0.0) - HEAL_S) < 0.01
+        and abs(donor.get("heal_donor", 0.0) - HEAL_S) < 0.01,
+        f"victim idle {victim.get('idle')} heal {victim.get('heal_joiner')} "
+        f"donor {donor.get('heal_donor')}",
+    )
+
+    # -- leg 3: refused commit discards a speculative suffix ---------------
+    SPEC_STEPS, FAIL_STEP = 5, 50
+
+    def rollback_plan(r: SimReplica, step: int) -> None:
+        if FAIL_STEP <= step < FAIL_STEP + SPEC_STEPS:
+            # speculative compute whose vote will be refused: ambient time
+            # with no commit — the refusal instants classify it
+            r.span("quorum", QUORUM_S)
+            r.t += STEP_COMPUTE_S
+            if step == FAIL_STEP + SPEC_STEPS - 1:
+                r.instant("commit_failed", step=step)
+                r.instant("rollback", step=step, unwind_depth=SPEC_STEPS)
+            return
+        r.healthy_step(step)
+
+    replicas = run_leg([rollback_plan] * NUM_REPLICAS)
+    err = conservation_err(replicas)
+    report = fleet_report(replicas)
+    recompute = report["seconds"].get("rollback_recompute", 0.0)
+    expected = NUM_REPLICAS * SPEC_STEPS * STEP_COMPUTE_S
+    out["legs"]["rollback"] = {
+        "fleet_goodput": report["goodput"],
+        "rollback_recompute_s": recompute,
+        "expected_discarded_s": expected,
+        "conservation_max_abs_err_s": round(err, 9),
+    }
+    check("rollback_conservation", err <= 1e-4, f"max err {err:.2e}s")
+    check(
+        "rollback_attribution",
+        abs(recompute - expected) < 0.5,
+        f"rollback_recompute {recompute} vs discarded compute {expected}",
+    )
+
+    # -- leg 4: straggler ejected, quarantined, re-admitted ----------------
+    QUAR_S, EJECT_STEP = 15.0, 30
+
+    def ejected_plan(r: SimReplica, step: int) -> None:
+        if step == EJECT_STEP:
+            # the quarantine gate's serve span (health.QuarantineGate)
+            r.span(
+                "health_quarantine", QUAR_S, phase="served",
+                waited_s=QUAR_S, attempts=2, parked=False,
+            )
+        r.healthy_step(step)
+
+    plans = [lambda r, s: r.healthy_step(s)] * (NUM_REPLICAS - 1) + [ejected_plan]
+    replicas = run_leg(plans)
+    err = conservation_err(replicas)
+    report = fleet_report(replicas)
+    degraded = report["per_replica"]["r3"]["seconds"].get("degraded", 0.0)
+    out["legs"]["straggler_ejection"] = {
+        "fleet_goodput": report["goodput"],
+        "ejected_degraded_s": degraded,
+        "conservation_max_abs_err_s": round(err, 9),
+        "badput": report["badput"][:2],
+    }
+    check("ejection_conservation", err <= 1e-4, f"max err {err:.2e}s")
+    check(
+        "ejection_attribution",
+        abs(degraded - QUAR_S) < 0.01,
+        f"degraded {degraded} vs quarantine {QUAR_S}",
+    )
+
+    # -- leg 5: SLO drill — blip never pages, sustained pages ONCE ---------
+    breaches_before = metrics.counter_total("tpuft_slo_breaches_total")
+    drill = SimReplica(9, lambda r, s: None, steps=0)
+    slo = goodput.SloEvaluator(target=0.95, windows=3)
+    ledger = goodput.GoodputLedger(
+        journal=drill.journal, window_sec=WINDOW_SEC, slo=slo,
+        labels={"replica_id": "r9", "group_rank": "0"},
+    )
+
+    def window(healthy: bool) -> None:
+        if healthy:
+            for _ in range(5):
+                drill.t += 1.0
+                drill.instant("commit")
+        else:
+            drill.idle_for(5.0)  # all badput: burn 1/0.05 = 20x
+        ledger.collect(force=True)
+
+    window(False)  # single-window blip...
+    window(True)  # ...healthy again: hysteresis must hold
+    blip_breaches = slo.breaches
+    for _ in range(5):  # sustained burn: latch at K=3, page exactly once
+        window(False)
+    sustained_breaches = slo.breaches
+    window(True)  # healthy window re-arms
+    for _ in range(3):
+        window(False)
+    events = drill.journal._copy_ring()
+    breach_events = [e for e in events if e["name"] == "slo_breach"]
+    incidents = [
+        e for e in events
+        if e["name"] == "incident"
+        and (e.get("args") or {}).get("kind") == "slo_goodput"
+    ]
+    counter_delta = metrics.counter_total("tpuft_slo_breaches_total") - breaches_before
+    out["legs"]["slo_drill"] = {
+        "target": 0.95,
+        "k_windows": 3,
+        "blip_breaches": blip_breaches,
+        "sustained_breaches": sustained_breaches,
+        "rearmed_breaches": slo.breaches,
+        "breach_events": len(breach_events),
+        "incidents": len(incidents),
+        "counter_delta": counter_delta,
+    }
+    check("slo_blip_never_pages", blip_breaches == 0, f"{blip_breaches} breaches")
+    check(
+        "slo_sustained_pages_once",
+        sustained_breaches == 1 and len(breach_events) == 2,
+        f"{sustained_breaches} breaches after 5 burning windows, "
+        f"{len(breach_events)} events total",
+    )
+    check(
+        "slo_counter_exact",
+        slo.breaches == 2 and counter_delta == 2 and len(incidents) == 2,
+        f"breaches {slo.breaches} counter {counter_delta} incidents {len(incidents)}",
+    )
+
+    out["checks"] = checks
+    out["ok"] = all(c["ok"] for c in checks)
+    artifact = Path(__file__).resolve().parents[1] / "GOODPUT_BENCH.json"
+    artifact.write_text(json.dumps(out, indent=2) + "\n")
+    print(json.dumps(out))
+    sys.exit(0 if out["ok"] else 1)
+
+
+if __name__ == "__main__":
+    main()

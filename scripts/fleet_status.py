@@ -14,7 +14,9 @@ the serving tier's relay position (the RELAY column —
 depth/upstreams/parked long-poll subscribers from the relay gauges),
 the gray-failure verdict/quarantine state plus any advisory straggler
 accusation (the HEALTH column — ``tpuft_health_*`` gauges),
-heartbeat age. The LAG column derives
+the rolling goodput fraction + top badput cause from each replica's
+pushed ledger payload (the GOODPUT column — torchft_tpu/goodput.py;
+"!" = a latched SLO breach), heartbeat age. The LAG column derives
 straggler attribution from the trace plane's pushed per-step phase
 durations (``trace/<replica_id>/<rank>``): at the latest shared step, the
 rank that waited least in the commit barrier entered it last — its lag is
@@ -251,6 +253,39 @@ def _health_state(snapshot: Dict[str, Any]) -> Optional[str]:
     return cell
 
 
+def _goodput_state(snapshot: Dict[str, Any]) -> Optional[str]:
+    """Goodput ledger state from the pushed payload: the rolling goodput
+    fraction as a percentage plus the top badput cause ("97.2% heal" =
+    97.2% of recent wall-clock committed, the biggest loss was heal
+    time), "off" when the trace plane is disabled (the ledger degrades
+    with it), or None before the first window closes / on pre-ledger
+    replicas. A low cell names which subsystem to page about —
+    ``scripts/goodput_report.py`` has the fleet-wide breakdown and
+    ``fleet_trace --explain-step`` the per-step story."""
+    payload = snapshot.get("goodput")
+    if not isinstance(payload, dict):
+        return None
+    if not payload.get("enabled", True):
+        return "off"
+    fraction = payload.get("goodput")
+    if fraction is None:
+        return None
+    cell = f"{float(fraction) * 100:.1f}%"
+    seconds = payload.get("seconds") or {}
+    worst = [
+        (bucket, value)
+        for bucket, value in seconds.items()
+        if bucket != "committed_compute" and value > 0
+    ]
+    if worst:
+        worst.sort(key=lambda kv: -kv[1])
+        cell += f" {worst[0][0].split('_')[0]}"
+    slo = payload.get("slo") or {}
+    if slo.get("latched"):
+        cell += "!"
+    return cell
+
+
 def _publish_state(snapshot: Dict[str, Any], now: float) -> Optional[str]:
     """Serving-plane publication state from the pushed gauges: the last
     published step and how stale it is ("s12@3s"), or None when the
@@ -319,6 +354,7 @@ def collect(lighthouse_addr: str, prev: Optional[Dict[str, Any]] = None) -> Dict
                     heals=_counter_total(snap, "tpuft_heals_total"),
                     serve=_serve_state(snap),
                     health=_health_state(snap),
+                    goodput=_goodput_state(snap),
                     shard=_shard_state(snap),
                     wire=_wire_state(snap),
                     publish=_publish_state(snap, now),
@@ -365,6 +401,7 @@ _COLUMNS = (
     ("heals", "HEALS"),
     ("serve", "SERVE"),
     ("health", "HEALTH"),
+    ("goodput", "GOODPUT"),
     ("shard", "SHARD"),
     ("wire", "WIRE"),
     ("publish", "PUBLISH"),

@@ -415,6 +415,40 @@ def explain_step(merged: List[Dict[str, Any]], step: int) -> str:
         )
         lines.append(f"  {name:16s} {cells}")
 
+    # Goodput attribution: fold each replica's OWN step events over its
+    # own monotonic bounds (per-process clocks — no alignment needed), so
+    # the postmortem answers "what did step N's wall-clock buy" in the
+    # ledger's currency (torchft_tpu/goodput.py bucket rules).
+    from torchft_tpu import goodput as goodput_plane
+
+    attribution_lines: List[str] = []
+    by_proc: Dict[ProcKey, List[Dict[str, Any]]] = {}
+    for event in at_step:
+        if event.get("t_mono") is not None:
+            by_proc.setdefault(proc_key(event), []).append(event)
+    for proc, events in sorted(by_proc.items()):
+        lo = min(float(e["t_mono"]) for e in events)
+        hi = max(
+            float(e["t_mono"]) + float(e.get("dur") or 0.0) for e in events
+        )
+        if hi <= lo:
+            continue
+        folded = goodput_plane.fold_events(events, lo, hi)
+        total = sum(folded.values())
+        if total <= 0:
+            continue
+        cells = " ".join(
+            f"{bucket}={folded[bucket] / total * 100:.0f}%"
+            for bucket in goodput_plane.BUCKETS
+            if folded[bucket] / total >= 0.005
+        )
+        attribution_lines.append(
+            f"  {proc_label(proc)} ({_fmt_ms(total)}): {cells}"
+        )
+    if attribution_lines:
+        lines.append("goodput attribution (share of this step's wall-clock):")
+        lines.extend(attribution_lines)
+
     # Straggler attribution at the commit barrier: the barrier releases
     # everyone together, so enter_lag = (longest wait) - (my wait); the
     # replica with the largest lag entered LAST and held everyone up.
@@ -781,6 +815,19 @@ def explain_step(merged: List[Dict[str, Any]], step: int) -> str:
             f"quorum transition: q{args.get('old_quorum_id')} -> "
             f"q{e.get('quorum_id')} observed by {proc_label(proc_key(e))} "
             f"at step {e.get('step')} ({args.get('participants')} participants)"
+        )
+
+    # Goodput SLO breaches latched at this step (alerting only — the
+    # burn-rate plane never actuates; torchft_tpu/goodput.py).
+    for e in at_step:
+        if e["name"] != "slo_breach":
+            continue
+        args = e.get("args") or {}
+        lines.append(
+            f"slo BREACH: {proc_label(proc_key(e))} goodput "
+            f"{args.get('goodput', '?')} below target "
+            f"{args.get('target', '?')} for {args.get('windows', '?')} "
+            f"consecutive window(s) (burn rate {args.get('burn_rate', '?')})"
         )
 
     incidents = sorted(

@@ -1,0 +1,179 @@
+#!/usr/bin/env python
+"""Fleet goodput report: merge every replica's pushed ledger windows into
+one fleet goodput number + per-cause and per-region badput breakdowns.
+
+Each Manager folds its trace ring into goodput windows
+(torchft_tpu/goodput.py) and pushes the payload inside its metrics
+snapshot (``metrics/<replica_id>/<rank>``, Manager._push_metrics). This
+script reads those snapshots — live via the lighthouse, or offline from
+saved snapshot/payload JSON files — and answers the question a fleet is
+judged by: what fraction of paid wall-clock became committed training
+progress, and which subsystem ate the rest. Regions ride the PR-16
+topology labels (the snapshot's ``region`` field), so a WAN fleet's
+report splits per region for free.
+
+Sources (any mix):
+
+- ``--lighthouse host:port``: discover members, read each group store's
+  pushed metrics snapshots (scripts/fleet_status.py's feed);
+- ``--file a.json [b.json ...]``: offline snapshot dicts or bare ledger
+  payloads, one JSON object per file (or a JSON list of them).
+
+Usage::
+
+    python scripts/goodput_report.py --lighthouse host:port
+    python scripts/goodput_report.py --file snap0.json snap1.json --json
+
+Related: ``fleet_status`` GOODPUT column (live per-replica cell),
+``fleet_trace --explain-step`` (per-step attribution), docs/observability.md
+section 0 (the pager walkthrough).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from pathlib import Path
+from typing import Any, Dict, List
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from torchft_tpu import goodput
+
+
+def load_lighthouse(lighthouse_addr: str) -> List[Dict[str, Any]]:
+    """Every member rank's pushed metrics snapshot (never raises per-rank:
+    a dead group's store refusing connections is itself fleet state)."""
+    from torchft_tpu.coordination import LighthouseClient
+    from torchft_tpu.parallel.store import create_store_client
+
+    client = LighthouseClient(lighthouse_addr, connect_timeout=5.0)
+    try:
+        status = client.status(timeout=5.0)
+    finally:
+        client.close()
+    snapshots: List[Dict[str, Any]] = []
+    for member_status in status.members:
+        member = member_status.member
+        if not member.store_address:
+            continue
+        for rank in range(max(1, member.world_size)):
+            try:
+                store = create_store_client(
+                    member.store_address, connect_timeout=2.0
+                )
+            except Exception:  # noqa: BLE001 — dead store = no snapshot
+                continue
+            try:
+                raw = store.get(
+                    f"metrics/{member.replica_id}/{rank}",
+                    timeout=2.0,
+                    wait=False,
+                )
+                if raw is not None:
+                    snapshots.append(json.loads(raw.decode()))
+            except Exception:  # noqa: BLE001
+                pass
+            finally:
+                try:
+                    store.close()
+                except Exception:  # noqa: BLE001
+                    pass
+    return snapshots
+
+
+def load_files(paths: List[str]) -> List[Dict[str, Any]]:
+    snapshots: List[Dict[str, Any]] = []
+    for path in paths:
+        with open(path) as f:
+            payload = json.load(f)
+        if isinstance(payload, list):
+            snapshots.extend(p for p in payload if isinstance(p, dict))
+        elif isinstance(payload, dict):
+            snapshots.append(payload)
+    return snapshots
+
+
+def render(report: Dict[str, Any]) -> str:
+    lines: List[str] = []
+    goodput_txt = (
+        f"{report['goodput'] * 100:.2f}%"
+        if report.get("goodput") is not None
+        else "n/a (no closed windows)"
+    )
+    lines.append(
+        f"fleet goodput: {goodput_txt} over {report['wall_seconds']:.1f} "
+        f"replica-seconds ({report['replicas']} replica(s) reporting)"
+    )
+    if report.get("badput"):
+        lines.append("badput by cause (largest first):")
+        for item in report["badput"]:
+            lines.append(
+                f"  {item['bucket']:18s} {item['seconds']:10.2f}s  "
+                f"{item['fraction'] * 100:6.2f}%"
+            )
+    if report.get("regions") and len(report["regions"]) > 1:
+        lines.append("per-region:")
+        for region, entry in report["regions"].items():
+            region_txt = (
+                f"{entry['goodput'] * 100:.2f}%"
+                if entry.get("goodput") is not None
+                else "n/a"
+            )
+            lines.append(f"  {region:12s} goodput {region_txt}")
+    lines.append("per-replica:")
+    for replica_id, entry in sorted(report.get("per_replica", {}).items()):
+        replica_txt = (
+            f"{entry['goodput'] * 100:.2f}%"
+            if entry.get("goodput") is not None
+            else "n/a"
+        )
+        worst = [
+            (b, s)
+            for b, s in (entry.get("seconds") or {}).items()
+            if b != "committed_compute"
+        ]
+        worst.sort(key=lambda kv: -kv[1])
+        worst_txt = f"  (worst: {worst[0][0]})" if worst else ""
+        lines.append(
+            f"  {replica_id:24s} [{entry.get('region', '-'):8s}] "
+            f"goodput {replica_txt}{worst_txt}"
+        )
+    return "\n".join(lines)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
+    parser.add_argument(
+        "--lighthouse",
+        default=os.environ.get("TPUFT_LIGHTHOUSE", ""),
+        help="lighthouse address (default: $TPUFT_LIGHTHOUSE)",
+    )
+    parser.add_argument(
+        "--file", nargs="*", default=[],
+        help="offline snapshot/payload JSON files",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="print the merged report as JSON"
+    )
+    args = parser.parse_args()
+
+    snapshots: List[Dict[str, Any]] = []
+    if args.file:
+        snapshots.extend(load_files(args.file))
+    if args.lighthouse and not args.file:
+        snapshots.extend(load_lighthouse(args.lighthouse))
+    if not snapshots:
+        parser.error("no snapshots loaded; pass --lighthouse or --file")
+
+    report = goodput.merge_windows(snapshots)
+    if args.json:
+        print(json.dumps(report))
+    else:
+        print(render(report))
+
+
+if __name__ == "__main__":
+    main()

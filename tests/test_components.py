@@ -772,35 +772,31 @@ def test_doctor_checks_pass_and_catch_problems(monkeypatch, capsys) -> None:
 
 
 def test_metric_names_match_registry_table() -> None:
-    """METRICS.md is the canonical metric registry: every name the
-    package emits (metrics.inc/observe/set_gauge/timer/counter/gauge/
-    histogram call sites) must have a table row, and every table row must
-    correspond to a live emission site — else dashboards and the bench's
-    ft_phase_* fields silently drift from the code."""
-    import re
-    from pathlib import Path
+    """METRICS.md drift is now analyzer rule R8 `metric-doc-drift` (part
+    of the exit-nonzero `python -m torchft_tpu.analysis` gate); this test
+    wraps the rule so the suite still fails fast on drift, and pins that
+    the rule actually scans (an empty emitted-set would mean the grep
+    pattern rotted, which R8 would misread as "nothing to document")."""
+    from torchft_tpu.analysis import core, rules
 
-    from torchft_tpu import doctor
-
-    repo = Path(doctor.__file__).parent.parent
-    emit_call = re.compile(
-        r"metrics\.(?:inc|observe|set_gauge|timer|counter|gauge|histogram)\(\s*"
-        r'"(tpuft_[a-z0-9_]+)"'
+    metrics_py = core.PACKAGE_ROOT / "metrics.py"
+    module = core.load_module(metrics_py)
+    findings = rules.RULES_BY_ID["metric-doc-drift"].checker(module)
+    assert findings == [], "\n".join(
+        f"{f.file}:{f.line} {f.message}" for f in findings
     )
+    # Anchor guard: the rule only fires from metrics.py — any other module
+    # must yield nothing, or the repo-wide scan would run once per file.
+    other = core.load_module(core.PACKAGE_ROOT / "doctor.py")
+    assert rules.RULES_BY_ID["metric-doc-drift"].checker(other) == []
+    # Scan-health guard: the emission grep still finds real call sites.
     emitted = set()
-    for py in (repo / "torchft_tpu").rglob("*.py"):
-        emitted |= set(emit_call.findall(py.read_text()))
-    assert emitted, "no emission sites found — did the grep pattern rot?"
-
-    table = set(
-        re.findall(r"\| `(tpuft_[a-z0-9_]+)` \|", (repo / "METRICS.md").read_text())
-    )
-    assert emitted - table == set(), (
-        f"emitted but missing a METRICS.md row: {sorted(emitted - table)}"
-    )
-    assert table - emitted == set(), (
-        f"tabulated in METRICS.md but never emitted: {sorted(table - emitted)}"
-    )
+    for py in core.PACKAGE_ROOT.rglob("*.py"):
+        if "__pycache__" in py.parts or py.name == "tpuft_pb2.py":
+            continue
+        emitted |= set(rules._R8_EMIT_RE.findall(py.read_text()))
+    assert "tpuft_goodput_seconds_total" in emitted
+    assert len(emitted) > 50, f"emission grep rotted? only {len(emitted)} names"
 
 
 def test_netem_shim_pacing() -> None:

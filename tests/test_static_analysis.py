@@ -262,8 +262,8 @@ def test_package_scans_clean() -> None:
     assert findings == [], "\n".join(f.format() for f in findings)
 
 
-def test_rule_registry_covers_r1_to_r7() -> None:
-    assert len(ALL_RULES) == 7
+def test_rule_registry_covers_r1_to_r8() -> None:
+    assert len(ALL_RULES) == 8
     assert set(RULES_BY_ID) == {
         "step-boundary-escape",
         "op-worker-self-wait",
@@ -272,6 +272,7 @@ def test_rule_registry_covers_r1_to_r7() -> None:
         "replica-axis-in-mesh",
         "citation-lint",
         "speculation-discipline",
+        "metric-doc-drift",
     }
 
 

@@ -1,0 +1,147 @@
+"""Telemetry export edge cases: exporter failures must never reach the
+step boundary.
+
+The telemetry plane narrates (telemetry.py); the invariant under test is
+that a broken NARRATOR cannot break TRAINING: a sink raising mid-record,
+an unattachable OTLP exporter, or a handler raising inside emit must all
+degrade to lost/partial telemetry — never to an exception crossing
+``logger.info(...)`` call sites on the train/quorum threads (CLAUDE.md:
+nothing may raise past the step boundary except quorum timeouts).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import logging
+
+import pytest
+
+from torchft_tpu import goodput, telemetry, tracing
+
+
+@pytest.fixture
+def detached_slo_logger():
+    """Run each test against a clean tpuft_slo logger; restore after."""
+    logger = telemetry.slo_logger
+    saved = list(logger.handlers)
+    for h in saved:
+        logger.removeHandler(h)
+    logger.setLevel(logging.INFO)
+    yield logger
+    for h in list(logger.handlers):
+        logger.removeHandler(h)
+    for h in saved:
+        logger.addHandler(h)
+
+
+class _BrokenStream:
+    """A sink that dies mid-record after N good writes (disk full, closed
+    pipe, rotated file) — the classic silent telemetry failure."""
+
+    def __init__(self, good_writes: int = 0) -> None:
+        self.good = good_writes
+        self.lines: list[str] = []
+
+    def write(self, data: str) -> None:
+        if self.good <= 0:
+            raise OSError("sink gone: no space left on device")
+        self.good -= 1
+        self.lines.append(data)
+
+    def flush(self) -> None:
+        if self.good < 0:
+            raise OSError("sink gone")
+
+
+def test_sink_raising_mid_record_never_raises(detached_slo_logger, capsys):
+    """_JsonLinesHandler funnels stream failures into logging.handleError
+    (stderr note), never up through the logging call on the train thread."""
+    stream = _BrokenStream(good_writes=1)
+    handler = telemetry._JsonLinesHandler(stream)
+    detached_slo_logger.addHandler(handler)
+    # First record lands...
+    detached_slo_logger.info("slo_breach", extra={"slo": "goodput"})
+    assert len(stream.lines) == 1
+    # ...then the sink dies. The logging call must still return cleanly.
+    detached_slo_logger.info("slo_breach", extra={"slo": "goodput"})
+    detached_slo_logger.info("slo_breach", extra={"slo": "goodput"})
+    assert len(stream.lines) == 1  # lost, not raised
+
+
+def test_slo_breach_record_shape(detached_slo_logger):
+    """The SLO-breach record type flows through the JSON-lines exporter
+    with every goodput field _EVENT_FIELDS names (a field the exporter
+    drops is a field no pager can route on)."""
+    sink = io.StringIO()
+    detached_slo_logger.addHandler(telemetry._JsonLinesHandler(sink))
+    detached_slo_logger.info(
+        "slo_breach",
+        extra={
+            "slo": "goodput",
+            "slo_target": 0.95,
+            "burn_rate": 3.2,
+            "goodput": 0.84,
+            "windows": 3,
+            "replica_id": "r0",
+            "step": 41,
+            "quorum_id": 7,
+        },
+    )
+    event = json.loads(sink.getvalue())
+    assert event["event"] == "tpuft_slo"
+    assert event["message"] == "slo_breach"
+    assert event["slo"] == "goodput"
+    assert event["slo_target"] == 0.95
+    assert event["burn_rate"] == 3.2
+    assert event["goodput"] == 0.84
+    assert event["windows"] == 3
+    assert event["replica_id"] == "r0"
+    assert event["step"] == 41 and event["quorum_id"] == 7
+
+
+def test_slo_fire_survives_raising_handler(detached_slo_logger):
+    """SloEvaluator._fire wraps its telemetry emit: a handler raising
+    inside emit (the one failure _JsonLinesHandler's own try/except cannot
+    see) still latches the breach, bumps the counter, and returns."""
+
+    class _ExplodingHandler(logging.Handler):
+        def emit(self, record: logging.LogRecord) -> None:
+            raise RuntimeError("exporter wedged")
+
+    detached_slo_logger.addHandler(_ExplodingHandler())
+    journal = tracing.TraceJournal(maxlen=64, enabled=True)
+    slo = goodput.SloEvaluator(target=0.95, windows=1)
+    latched = slo.observe(0.5, step=3, quorum_id=1, journal=journal)
+    assert latched is True
+    assert slo.breaches == 1 and slo.latched
+
+
+def test_otlp_attach_failure_leaves_loggers_clean(detached_slo_logger):
+    """configure_telemetry('otlp') with the SDK absent raises the guidance
+    RuntimeError and attaches NOTHING — a failed exporter must not leave
+    half the event loggers wired to a dead handler."""
+    try:
+        import opentelemetry.sdk  # noqa: F401
+
+        pytest.skip("opentelemetry-sdk installed; attach would succeed")
+    except ImportError:
+        pass
+    before = {
+        logger.name: list(logger.handlers)
+        for logger in (
+            telemetry.quorums_logger,
+            telemetry.commits_logger,
+            telemetry.errors_logger,
+            telemetry.slo_logger,
+        )
+    }
+    with pytest.raises(RuntimeError, match="opentelemetry-sdk"):
+        telemetry.configure_telemetry("otlp")
+    for logger in (
+        telemetry.quorums_logger,
+        telemetry.commits_logger,
+        telemetry.errors_logger,
+        telemetry.slo_logger,
+    ):
+        assert logger.handlers == before[logger.name]

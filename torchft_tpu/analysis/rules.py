@@ -1,4 +1,4 @@
-"""tpuft_check rules R1–R7: CLAUDE.md invariants as AST properties.
+"""tpuft_check rules R1–R8: CLAUDE.md invariants as AST properties.
 
 Each rule is deliberately *lexical*: it proves what can be proven from one
 function's source order and flags the rest, so a clean run is a real
@@ -25,6 +25,8 @@ how the per-rule fixture tests drive them.
 | speculation-        | no pg.configure / send_checkpoint / sidecar staging |
 | discipline          | / serving publish reachable inside an undrained     |
 |                     | speculative window                                  |
+| metric-doc-drift    | every emitted tpuft_* metric name has a METRICS.md  |
+|                     | table row and every row a live emission site        |
 """
 
 from __future__ import annotations
@@ -776,6 +778,106 @@ def _check_r7(module: Module, reference_root: Optional[Path] = None) -> List[Fin
     return findings
 
 
+# --- R8: metric-doc-drift ---------------------------------------------------
+# METRICS.md is the canonical metric registry (metrics.py module docstring):
+# every metric name the package emits must have a table row, and every table
+# row must correspond to a live emission site — else dashboards, the bench's
+# ft_phase_* fields, and fleet_status cells silently drift from the code.
+# Anchored at torchft_tpu/metrics.py so the repo-wide scan runs exactly once
+# per analysis (the rule is a whole-tree property, not a per-module one);
+# findings anchor at the offending emission site / METRICS.md row, so the
+# baseline is the sanctioned escape hatch for legacy gaps.
+_R8_SCOPE_FILE = "torchft_tpu/metrics.py"
+_R8_DOC_FILE = "METRICS.md"
+_R8_EMIT_RE = re.compile(
+    r"metrics\.(?:inc|observe|set_gauge|timer|counter|gauge|histogram)\(\s*"
+    r'"(tpuft_[a-z0-9_]+)"'
+)
+_R8_ROW_RE = re.compile(r"\| `(tpuft_[a-z0-9_]+)` \|")
+
+
+def _check_r8(module: Module, reference_root: Optional[Path] = None) -> List[Finding]:
+    if module.rel != _R8_SCOPE_FILE:
+        return []
+    from torchft_tpu.analysis import core
+
+    repo = core.REPO_ROOT
+    findings: List[Finding] = []
+    emitted: Dict[str, Tuple[str, int, str]] = {}
+    for py in sorted((repo / "torchft_tpu").rglob("*.py")):
+        if "__pycache__" in py.parts or py.name == "tpuft_pb2.py":
+            continue
+        try:
+            text = py.read_text(encoding="utf-8")
+        except OSError:
+            continue
+        names = set(_R8_EMIT_RE.findall(text))
+        if not names:
+            continue
+        rel = py.relative_to(repo).as_posix()
+        file_lines = text.splitlines()
+        for name in names:
+            if name in emitted:
+                continue
+            anchor, context = 1, ""
+            for lineno, line in enumerate(file_lines, start=1):
+                if f'"{name}"' in line:
+                    anchor, context = lineno, line.strip()
+                    break
+            emitted[name] = (rel, anchor, context)
+
+    doc_path = repo / _R8_DOC_FILE
+    if not doc_path.exists():
+        return [
+            Finding(
+                rule="metric-doc-drift",
+                file=_R8_DOC_FILE,
+                line=1,
+                message=(
+                    f"{_R8_DOC_FILE} is missing: it is the canonical metric "
+                    f"registry for {len(emitted)} emitted metric name(s)"
+                ),
+                context=_R8_DOC_FILE,
+            )
+        ]
+    table: Dict[str, Tuple[int, str]] = {}
+    for lineno, line in enumerate(doc_path.read_text().splitlines(), start=1):
+        for name in _R8_ROW_RE.findall(line):
+            table.setdefault(name, (lineno, line.strip()))
+
+    for name in sorted(set(emitted) - set(table)):
+        rel, lineno, context = emitted[name]
+        findings.append(
+            Finding(
+                rule="metric-doc-drift",
+                file=rel,
+                line=lineno,
+                message=(
+                    f"metric {name} is emitted here but has no METRICS.md "
+                    "row — document it (name, kind, labels, emitted-from, "
+                    "meaning) or dashboards silently drift from the code"
+                ),
+                context=context or name,
+            )
+        )
+    for name in sorted(set(table) - set(emitted)):
+        lineno, context = table[name]
+        findings.append(
+            Finding(
+                rule="metric-doc-drift",
+                file=_R8_DOC_FILE,
+                line=lineno,
+                message=(
+                    f"METRICS.md documents {name} but no live emission site "
+                    "remains in torchft_tpu/ — delete the row or restore the "
+                    "metric"
+                ),
+                context=context or name,
+            )
+        )
+    return findings
+
+
 # ---------------------------------------------------------------------------
 # registry
 # ---------------------------------------------------------------------------
@@ -822,6 +924,12 @@ ALL_RULES: Sequence[Rule] = (
         summary="no pg.configure / donor send / heal staging / serving publish inside an undrained speculative window",
         anchor="CLAUDE.md 'quorum membership changes drain the FULL window ... BEFORE pg.configure / any donor send'",
         checker=_check_r7,
+    ),
+    Rule(
+        id="metric-doc-drift",
+        summary="every emitted tpuft_* metric has a METRICS.md row and vice versa",
+        anchor="metrics.py module docstring ('canonical metric names ... tabulated in METRICS.md')",
+        checker=_check_r8,
     ),
 )
 

@@ -96,6 +96,12 @@ KNOWN_ENV = {
     # Fleet trace plane (torchft_tpu/tracing.py): recording switch, journal
     # ring size, store clock-beacon sampling switch.
     "TPUFT_TRACE", "TPUFT_TRACE_SIZE", "TPUFT_TRACE_CLOCK",
+    # Goodput ledger + SLO plane (torchft_tpu/goodput.py): ledger window
+    # width, retained-window count + byte budget, and the declarative
+    # goodput SLO (target fraction, K-consecutive-windows hysteresis,
+    # burn-rate trip multiplier).
+    "TPUFT_GOODPUT_WINDOW_SEC", "TPUFT_GOODPUT_WINDOWS", "TPUFT_GOODPUT_BYTES",
+    "TPUFT_SLO_GOODPUT", "TPUFT_SLO_WINDOWS", "TPUFT_SLO_BURN_RATE",
     # Gray-failure ejection plane (torchft_tpu/health.py): master switch,
     # verdict knobs (fleet-relative threshold / hysteresis windows / peer
     # freshness / absolute gap floor), board push cadence, wedge watchdog
@@ -366,6 +372,61 @@ def _check_trace() -> Tuple[str, str]:
         f"/trace.json on :{port} serving {n_events} journal events "
         f"(replica {payload.get('replica_id')}/{payload.get('group_rank')})",
     )
+
+
+def _check_goodput() -> Tuple[str, str]:
+    """Goodput ledger + SLO plane preflight: names any unparsable
+    ``TPUFT_SLO_*`` / ledger-budget env, and warns when the trace plane is
+    disabled (the ledger is a fold over the trace ring, so it degrades
+    with it). WARN, never FAIL: accounting and alerting are observability
+    — a bad knob must not block a launch."""
+    from torchft_tpu import goodput, tracing
+
+    problems: List[str] = []
+    for name, floor in (
+        (goodput.ENV_WINDOW_SEC, 1e-3),
+        (goodput.ENV_SLO_BURN_RATE, 1e-9),
+    ):
+        raw = os.environ.get(name)
+        if raw is None:
+            continue
+        try:
+            if float(raw) < floor:
+                raise ValueError
+        except ValueError:
+            problems.append(f"{name}={raw!r} is not a float >= {floor:g}")
+    for name in (goodput.ENV_WINDOWS, goodput.ENV_BYTES, goodput.ENV_SLO_WINDOWS):
+        raw = os.environ.get(name)
+        if raw is None:
+            continue
+        try:
+            if int(raw) < 1:
+                raise ValueError
+        except ValueError:
+            problems.append(f"{name}={raw!r} is not a positive int")
+    slo_raw = os.environ.get(goodput.ENV_SLO_GOODPUT)
+    slo_state = "unset (SLO alerting off)"
+    if slo_raw is not None:
+        try:
+            target = float(slo_raw)
+            if not 0.0 < target <= 1.0:
+                raise ValueError
+            slo_state = f"target {target:g}"
+        except ValueError:
+            problems.append(
+                f"{goodput.ENV_SLO_GOODPUT}={slo_raw!r} is not a fraction in "
+                "(0, 1] — SLO alerting stays OFF"
+            )
+    if problems:
+        return "WARN", "; ".join(problems)
+    if os.environ.get(tracing.ENV_TRACE, "1") == "0":
+        return (
+            "WARN",
+            f"trace plane off ({tracing.ENV_TRACE}=0): the goodput ledger "
+            "is a fold over the trace ring, so windows degrade to "
+            "{'enabled': False} and SLO alerting never evaluates",
+        )
+    return "PASS", f"ledger armed; SLO {slo_state}"
 
 
 def _check_heal_serve() -> Tuple[str, str]:
@@ -953,6 +1014,7 @@ def run_checks(lighthouse: str, skip_device: bool = False) -> int:
         ("weight history", _check_history),
         ("metrics", _check_metrics),
         ("trace plane", _check_trace),
+        ("goodput/slo", _check_goodput),
         ("heal serving", _check_heal_serve),
         ("weights serving", _check_serving),
         ("heal striping", lambda: _check_heal_stripe(lighthouse)),

@@ -722,7 +722,12 @@ class QuarantineGate:
             "waited_s": round(waited, 3),
             "parked": parked,
         }
-        journal.record("health_quarantine", phase="served", **record)
+        # A real span (start backdated by the wait), not an instant: the
+        # goodput ledger folds it into the `degraded` bucket; fleet_trace
+        # keeps reading the same args off the served record.
+        journal.record(
+            "health_quarantine", ph="X", dur=waited, phase="served", **record
+        )
         return record
 
 

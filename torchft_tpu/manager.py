@@ -47,6 +47,7 @@ from typing import Any, Callable, Dict, List, Optional, TypeVar, cast
 
 import numpy as np
 
+from torchft_tpu import goodput as goodput_plane
 from torchft_tpu import health as health_plane
 from torchft_tpu import metrics, tracing
 from torchft_tpu.checkpointing import (
@@ -639,6 +640,14 @@ class Manager:
             self._trace,
             owner_key=f"{self._metric_labels['replica_id']}/{self._group_rank}",
             claim=self._group_rank == 0,
+        )
+        # Goodput ledger: a fold over this replica's trace ring, closed on
+        # the metrics-push cadence; its payload rides the metrics snapshot
+        # so fleet_status/goodput_report can account fleet wall-clock
+        # without journal access. SLO burn-rate alerting (TPUFT_SLO_*)
+        # lives inside the ledger — alerting only, never actuation.
+        self._goodput = goodput_plane.GoodputLedger(
+            journal=self._trace, labels=self._metric_labels
         )
 
         # Health plane wiring that needs the full identity: the monitor
@@ -2221,6 +2230,11 @@ class Manager:
                     # rather than the numeric metrics registry.
                     "region": netem.local_region(),
                     "metrics": metrics.snapshot(),
+                    # Goodput accounting: closing a due ledger window here
+                    # also scores the SLO — both ride this push cadence.
+                    "goodput": self._goodput.collect(
+                        step=self._step, quorum_id=self._quorum_id
+                    ),
                 }
             ).encode()
             self._store.set(

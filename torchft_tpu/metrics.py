@@ -45,6 +45,7 @@ __all__ = [
     "Histogram",
     "Registry",
     "REGISTRY",
+    "WindowedSeries",
     "DEFAULT_TIME_BUCKETS",
     "DEFAULT_BYTES_PER_SEC_BUCKETS",
     "ENV_PORT",
@@ -332,6 +333,82 @@ class Registry:
 
 
 REGISTRY = Registry()
+
+
+class WindowedSeries:
+    """Fixed-size, byte-budgeted ring of per-window aggregate dicts.
+
+    The registry above is cumulative-only; this is the windowed time-series
+    layer on top (history.py's budgeting discipline applied to metrics):
+    each appended window is a JSON-safe dict, its retained cost is its
+    compact-JSON encoding size, and the ring evicts oldest-first past
+    EITHER bound (``max_windows`` windows or ``max_bytes`` bytes, always
+    keeping the newest window) — so rates and percentiles over recent
+    windows stay queryable live without unbounded growth. First consumer:
+    the goodput ledger (torchft_tpu/goodput.py); the class is generic so
+    future planes can ring their own windows.
+    """
+
+    def __init__(self, max_windows: int = 60, max_bytes: int = 262144) -> None:
+        self.max_windows = max(1, int(max_windows))
+        self.max_bytes = max(1, int(max_bytes))
+        self._ring: List[Tuple[Dict[str, Any], int]] = []
+        self._bytes = 0
+        self._lock = threading.Lock()
+        self._evicted = 0
+
+    def append(self, window: Dict[str, Any]) -> None:
+        size = len(json.dumps(window, separators=(",", ":"), default=str))
+        with self._lock:
+            self._ring.append((window, size))
+            self._bytes += size
+            while len(self._ring) > 1 and (
+                len(self._ring) > self.max_windows or self._bytes > self.max_bytes
+            ):
+                _, evicted_size = self._ring.pop(0)
+                self._bytes -= evicted_size
+                self._evicted += 1
+
+    def windows(self) -> List[Dict[str, Any]]:
+        """Retained windows, oldest first."""
+        with self._lock:
+            return [window for window, _ in self._ring]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    def total_bytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def evicted(self) -> int:
+        """Windows dropped by either budget so far."""
+        with self._lock:
+            return self._evicted
+
+    def values(self, key: str) -> List[float]:
+        """Numeric ``window[key]`` values across retained windows (windows
+        without the key, or with a non-numeric value, are skipped)."""
+        out: List[float] = []
+        for window in self.windows():
+            value = window.get(key)
+            if isinstance(value, (int, float)) and not isinstance(value, bool):
+                out.append(float(value))
+        return out
+
+    def rate(self, key: str) -> Optional[float]:
+        """Mean of ``window[key]`` over retained windows (None when empty)."""
+        values = self.values(key)
+        return sum(values) / len(values) if values else None
+
+    def percentile(self, key: str, q: float) -> Optional[float]:
+        """Nearest-rank percentile of ``window[key]`` (``q`` in [0, 100])."""
+        values = sorted(self.values(key))
+        if not values:
+            return None
+        rank = min(len(values) - 1, max(0, int(round(q / 100.0 * (len(values) - 1)))))
+        return values[rank]
 
 
 # -- module-level conveniences bound to the default registry ----------------

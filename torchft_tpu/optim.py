@@ -868,9 +868,16 @@ class Optimizer:
         on its own thread."""
         if self._pipeline is None:
             return
-        for rec in self._pipeline.pending():
-            self._resolve_pipelined_record(rec)
-            rec.bound_device(raise_on_error=False)
+        pending = self._pipeline.pending()
+        if not pending:
+            return
+        # The goodput ledger attributes this span to its `drain` bucket —
+        # window-resolution time spent on the quorum thread is neither
+        # quorum wait nor committed compute.
+        with _trace_of(self.manager).span("pipeline_drain", depth=len(pending)):
+            for rec in pending:
+                self._resolve_pipelined_record(rec)
+                rec.bound_device(raise_on_error=False)
 
 
     def make_step_fn(

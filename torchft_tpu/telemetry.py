@@ -30,11 +30,20 @@ import sys
 import time
 from typing import Any, Dict
 
-__all__ = ["quorums_logger", "commits_logger", "errors_logger", "configure_telemetry"]
+__all__ = [
+    "quorums_logger",
+    "commits_logger",
+    "errors_logger",
+    "slo_logger",
+    "configure_telemetry",
+]
 
 quorums_logger = logging.getLogger("tpuft_quorums")
 commits_logger = logging.getLogger("tpuft_commits")
 errors_logger = logging.getLogger("tpuft_errors")
+# SLO-breach records (goodput burn-rate alerts, torchft_tpu/goodput.py):
+# one record per latched breach, carrying the slo/burn/goodput fields below.
+slo_logger = logging.getLogger("tpuft_slo")
 
 _EVENT_FIELDS = (
     "job_id",
@@ -44,6 +53,11 @@ _EVENT_FIELDS = (
     "step",
     "commit_result",
     "error",
+    "slo",
+    "slo_target",
+    "burn_rate",
+    "goodput",
+    "windows",
 )
 
 
@@ -81,7 +95,7 @@ def configure_telemetry(mode: str | None = None) -> None:
         handler = _make_otlp_handler()
     else:
         raise ValueError(f"unknown TPUFT_TELEMETRY mode: {mode}")
-    for event_logger in (quorums_logger, commits_logger, errors_logger):
+    for event_logger in (quorums_logger, commits_logger, errors_logger, slo_logger):
         event_logger.addHandler(handler)
         event_logger.setLevel(logging.INFO)
 

@@ -102,6 +102,7 @@ PHASE_SPANS = (
     "heal_send",
     "heal_recv",
     "zero_rebalance",
+    "pipeline_drain",
 )
 
 
