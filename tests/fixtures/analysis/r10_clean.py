@@ -1,0 +1,29 @@
+"""R10 fixture: the shipped handler shapes — a checkpoint route behind
+the staged quorum_id/era 409 fence, and a non-checkpoint handler the
+rule must not bind at all."""
+
+import urllib.parse
+
+
+class FencedHandler:
+    def do_GET(self):
+        split = urllib.parse.urlsplit(self.path)
+        if split.path.startswith("/checkpoint/"):
+            want_era = urllib.parse.parse_qs(split.query).get("quorum_id")
+            if want_era and int(want_era[0]) != self.server.staged_era:
+                self.send_response(409)
+                self.end_headers()
+                return
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(self.server.staged[split.path])
+        else:
+            self.send_response(404)
+            self.end_headers()
+
+
+class StatusHandler:
+    def do_GET(self):
+        self.send_response(200)
+        self.end_headers()
+        self.wfile.write(b"ok\n")

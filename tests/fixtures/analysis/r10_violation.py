@@ -1,0 +1,14 @@
+"""R10 fixture: a checkpoint-serving route handler with no era fence —
+stale-era requests would be answered with bytes instead of a 409."""
+
+
+class UnfencedHandler:
+    def do_GET(self):
+        if self.path.startswith("/checkpoint/"):
+            payload = self.server.staged[self.path]
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(payload)
+        else:
+            self.send_response(404)
+            self.end_headers()

@@ -1,0 +1,8 @@
+"""R11 fixture: a live, justified suppression — its rule still fires at
+the covered line, so the comment is earning its keep."""
+
+
+def justified(devices, Mesh):
+    # tpuft: allow(replica-axis-in-mesh): fixture — deliberately names the replica axis so this suppression stays live
+    mesh = Mesh(devices, ("replica", "tp"))
+    return mesh

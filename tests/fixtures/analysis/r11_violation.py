@@ -1,0 +1,12 @@
+"""R11 fixture: rotted suppressions — one whose rule no longer fires at
+the covered site, and one naming a rule id that does not exist."""
+
+
+def stale_site(devices, Mesh):
+    # tpuft: allow(replica-axis-in-mesh): the Mesh below used to name the replica axis
+    mesh = Mesh(devices, ("fsdp", "tp"))
+    return mesh
+
+
+# tpuft: allow(no-such-rule): a typo'd rule id can never fire
+FLAG = True

@@ -1,0 +1,35 @@
+"""R9 fixture: the shipped verify-then-adopt shapes scan clean.
+
+Chunk CRC + size compares, the meta digest fence, the verifying-fetch
+kwarg idiom (``expect_crc=crcs[i]``), and the wire codec's
+self-verifying decode all cleanse the taint before the swap."""
+
+import io
+
+
+class GoodSubscriber:
+    def adopt_chunk(self, base, step, timeout, sizes, crcs, algo):
+        data = fetch_bytes(f"{base}/checkpoint/{step}/0", timeout)
+        if len(data) != sizes[0] or chunk_crc(data, algo) != crcs[0]:
+            raise ValueError("chunk mismatch")
+        state = load_state_dict(io.BytesIO(data))
+        self._version = state
+
+    def adopt_meta(self, base, step, timeout, latest):
+        meta = safe_loads(
+            fetch_bytes(f"{base}/checkpoint/{step}/meta", timeout)
+        )
+        if not isinstance(meta, dict) or meta.get("digest") != latest["digest"]:
+            return None
+        self._current = meta
+        return meta
+
+    def verifying_fetch(self, live, step, crcs, sizes):
+        data = self._fetch_failover(
+            live, f"/checkpoint/{step}/0", expect_crc=crcs[0], expect_size=sizes[0]
+        )
+        self._current = data
+
+    def codec_decode(self, base, timeout):
+        data = fetch_bytes(base, timeout)
+        self._current = decode_state(data)

@@ -1,0 +1,22 @@
+"""R9 fixture: wire bytes reaching adoption sinks unverified.
+
+The relay-shaped meta pull (``expect_crc=None`` — the verifying-fetch
+kwarg explicitly disabled — adopted into ``self._current``) and a raw
+fetch that is deserialized and swapped in without any CRC/digest/era
+comparison on the path."""
+
+import io
+
+
+class BadRelay:
+    def pull_meta(self, live, step, latest):
+        meta_bytes = self._fetch_failover(
+            live, f"/checkpoint/{step}/meta", expect_crc=None, algo="crc32c"
+        )
+        version = Version(step=step, meta=meta_bytes)
+        self._current = version
+
+    def adopt_raw(self, base, step, timeout):
+        data = fetch_bytes(f"{base}/checkpoint/{step}/0", timeout)
+        state = load_state_dict(io.BytesIO(data))
+        self._version = state

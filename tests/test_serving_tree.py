@@ -227,6 +227,57 @@ def test_notify_path_still_rejects_era_regression() -> None:
         pub.shutdown()
 
 
+def test_relay_refuses_meta_digest_mismatch() -> None:
+    """The relay's /meta fetch is digest-bound to the validated descriptor
+    BEFORE adoption (tpuft_check R9 verify-before-adopt): a corrupt or
+    torn upstream meta is a counted pull failure — the relay keeps serving
+    its held version and never caches the bad bytes."""
+    import pickle
+
+    pub = WeightPublisher(num_chunks=2, timeout=5.0)
+    relay = CachingRelay([pub.address()], timeout=5.0, start=False)
+    try:
+        pub.publish(step=1, quorum_id=0, state=state_for(1))
+        assert relay.poll_once()
+        assert_version_is_cached(relay, 1)
+
+        orig = relay._fetch_failover
+
+        def corrupt_meta(live, route, expect_crc, algo, expect_size=None):
+            data = orig(
+                live, route, expect_crc, algo, expect_size=expect_size
+            )
+            if route.endswith("/meta"):
+                return pickle.dumps({"step": -1, "digest": "bogus"})
+            return data
+
+        relay._fetch_failover = corrupt_meta
+        rejects_before = metrics.counter_total(
+            "tpuft_serving_meta_digest_rejects_total"
+        )
+        pub.publish(step=2, quorum_id=0, state=state_for(2))
+        with pytest.raises(Exception, match="descriptor digest"):
+            relay.poll_once()
+        # Held state untouched; the refusal is visible on the dashboard.
+        assert relay.current().step == 1
+        assert (
+            metrics.counter_total("tpuft_serving_meta_digest_rejects_total")
+            > rejects_before
+        )
+        # A healed upstream converges normally on the next poll.
+        relay._fetch_failover = orig
+        assert relay.poll_once()
+        assert relay.current().step == 2
+    finally:
+        relay.shutdown(wait=False)
+        pub.shutdown()
+
+
+def assert_version_is_cached(relay, step: int) -> None:
+    current = relay.current()
+    assert current is not None and current.step == step
+
+
 def test_relay_wait_notify_every_upstream_dead_falls_back() -> None:
     relay = CachingRelay(["http://127.0.0.1:9"], timeout=0.5, start=False)
     try:

@@ -217,6 +217,75 @@ def test_r6_clean_fixture(tmp_path) -> None:
     assert scan("r6_clean.py", reference_root=ref) == []
 
 
+def test_r9_violation_fixture() -> None:
+    # The taint pass: a relay-shaped meta pull with expect_crc=None adopted
+    # into self._current, a raw fetch deserialized unverified, and the
+    # derived state swapped in — three findings, each naming its source.
+    findings = scan("r9_violation.py", rules=["verify-before-adopt"])
+    assert len(findings) == 3
+    assert rules_of(findings) == ["verify-before-adopt"]
+    assert sorted(f.line for f in findings) == [17, 21, 22]
+    messages = sorted(f.message for f in findings)
+    assert sum("self._current" in m for m in messages) == 1
+    assert sum("load_state_dict" in m for m in messages) == 1
+    assert sum("self._version" in m for m in messages) == 1
+    assert all("_fetch_failover" in m or "fetch_bytes" in m for m in messages)
+
+
+def test_r9_clean_fixture() -> None:
+    # CRC+size compare, digest fence, verifying-fetch kwarg, and codec
+    # decode_state all cleanse before the swap — clean under ALL rules.
+    assert scan("r9_clean.py") == []
+
+
+def test_r10_violation_fixture() -> None:
+    findings = scan("r10_violation.py", rules=["era-fence"])
+    assert len(findings) == 1
+    assert findings[0].line == 6
+    assert "quorum_id" in findings[0].message
+
+
+def test_r10_clean_fixture() -> None:
+    # The fenced handler passes; the non-checkpoint handler is out of the
+    # rule's bind entirely — clean under ALL rules.
+    assert scan("r10_clean.py") == []
+
+
+def test_r11_violation_fixture() -> None:
+    findings = scan("r11_violation.py", rules=["stale-suppression"])
+    assert len(findings) == 2
+    assert {f.line for f in findings} == {6, 11}
+    messages = sorted(f.message for f in findings)
+    assert sum("no longer matches" in m for m in messages) == 1
+    assert sum("unknown rule" in m for m in messages) == 1
+
+
+def test_r11_clean_fixture() -> None:
+    # A live suppression: its rule still fires at the covered line, so
+    # the whole-file scan (R5 suppressed, R11 satisfied) is empty.
+    assert scan("r11_clean.py") == []
+
+
+def test_module_cache_shares_ast_and_invalidates_on_edit(tmp_path) -> None:
+    """Satellite: one parse per (file, mtime) shared across rules and
+    re-scans; an edited file re-parses rather than serving stale findings."""
+    import os
+
+    from torchft_tpu.analysis.core import load_module
+
+    target = tmp_path / "cached.py"
+    target.write_text("x = 1\n")
+    first = load_module(target)
+    assert first is not None and load_module(target) is first
+    # Same content, bumped mtime: the cache key is (mtime, size), so this
+    # re-parses — correctness over micro-optimality.
+    target.write_text("y = 2\n")
+    os.utime(target, (1, 1))
+    second = load_module(target)
+    assert second is not None and second is not first
+    assert "y = 2" in second.source
+
+
 # ---------------------------------------------------------------------------
 # suppressions + baseline
 # ---------------------------------------------------------------------------
@@ -262,8 +331,8 @@ def test_package_scans_clean() -> None:
     assert findings == [], "\n".join(f.format() for f in findings)
 
 
-def test_rule_registry_covers_r1_to_r8() -> None:
-    assert len(ALL_RULES) == 8
+def test_rule_registry_covers_r1_to_r11() -> None:
+    assert len(ALL_RULES) == 11
     assert set(RULES_BY_ID) == {
         "step-boundary-escape",
         "op-worker-self-wait",
@@ -273,6 +342,9 @@ def test_rule_registry_covers_r1_to_r8() -> None:
         "citation-lint",
         "speculation-discipline",
         "metric-doc-drift",
+        "verify-before-adopt",
+        "era-fence",
+        "stale-suppression",
     }
 
 

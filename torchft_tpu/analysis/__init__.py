@@ -1,18 +1,27 @@
-"""tpuft_check: static invariant analyzer for the Python coordination plane.
+"""tpuft_check: semantic invariant plane for the Python coordination code.
 
 The native plane has TSAN; this package is the Python side's mechanical
-check — six AST rules that turn CLAUDE.md's concurrency/architecture
-invariants into enforced properties (see docs/static_analysis.md for the
-rule table and suppression syntax). Runs in tier-1
-(tests/test_static_analysis.py) and as a CLI::
+check — eleven rules (R1-R11) that turn CLAUDE.md's concurrency/
+architecture invariants into enforced properties: R1-R8 are lexical AST
+rules, R9-R11 ride the intraprocedural taint pass in
+:mod:`torchft_tpu.analysis.dataflow` (verify-before-adopt, era-fence,
+stale-suppression). See docs/static_analysis.md for the rule table and
+suppression syntax. Runs in tier-1 (tests/test_static_analysis.py) and
+as a CLI::
 
     python -m torchft_tpu.analysis            # scan the package, exit != 0
                                               # on unbaselined findings
     python -m torchft_tpu.analysis --list-rules
     python -m torchft_tpu.analysis path/...   # scan explicit files/dirs
+    python -m torchft_tpu.analysis --explore  # interleaving explorer (below)
 
-Runtime counterpart: :mod:`torchft_tpu.utils.lockcheck`
-(``TPUFT_LOCK_CHECK=1``; default-on in the ft_harness drills).
+Dynamic counterparts: :mod:`torchft_tpu.utils.lockcheck`
+(``TPUFT_LOCK_CHECK=1``; default-on in the ft_harness drills) and the
+deterministic interleaving explorer :mod:`torchft_tpu.analysis.explore`
+(``--explore``): the real commit/quorum protocol under the controlled
+scheduler in :mod:`torchft_tpu.utils.schedules`, every explored schedule
+asserting the invariants the static rules can only pin lexically, with a
+replay token printed for any violating interleaving.
 """
 
 from torchft_tpu.analysis.core import (

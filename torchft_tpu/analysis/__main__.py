@@ -2,10 +2,20 @@
 
     python -m torchft_tpu.analysis [paths...] [--rules id,id] [--list-rules]
         [--baseline FILE] [--write-baseline] [--no-baseline]
+    python -m torchft_tpu.analysis --explore [scenario ...]
+    python -m torchft_tpu.analysis --explore SCENARIO --replay TOKEN
+
+The first form runs the static rules (R1-R11); ``--explore`` runs the
+deterministic interleaving explorer over the named commit/quorum
+scenarios (default: every real-protocol one) and exits 1 if any
+schedule violates an invariant, printing the replay token; ``--replay``
+re-runs one scenario under a previously printed token.
 
 Env: ``TPUFT_ANALYSIS_REFERENCE`` (reference snapshot root, default
-/root/reference; citation resolution skips cleanly when absent) and
-``TPUFT_ANALYSIS_BASELINE`` (baseline path override).
+/root/reference; citation resolution skips cleanly when absent),
+``TPUFT_ANALYSIS_BASELINE`` (baseline path override), and the
+``TPUFT_EXPLORE_*`` budget knobs (see
+``torchft_tpu.utils.schedules.explore_defaults``).
 """
 
 from __future__ import annotations
@@ -41,7 +51,37 @@ def main(argv=None) -> int:
         help="reference snapshot root for citation-lint (default: "
         f"${core.REFERENCE_ENV} or /root/reference)",
     )
+    parser.add_argument(
+        "--explore",
+        action="store_true",
+        help="run the interleaving explorer over the named scenarios "
+        "(positional args; default: all real-protocol scenarios)",
+    )
+    parser.add_argument(
+        "--replay",
+        default=None,
+        metavar="TOKEN",
+        help="with --explore and exactly one scenario: replay this "
+        "tpuft-sched: token instead of exploring",
+    )
     args = parser.parse_args(argv)
+
+    if args.replay and not args.explore:
+        print("--replay requires --explore", file=sys.stderr)
+        return 2
+
+    if args.explore:
+        # Lazy import: the explorer pulls in jax + the manager plane,
+        # which the pure static-analysis legs must not pay for.
+        from torchft_tpu.analysis import explore
+
+        try:
+            return explore.run_explore_cli(
+                args.paths, replay_token=args.replay
+            )
+        except KeyError as e:
+            print(str(e.args[0]) if e.args else str(e), file=sys.stderr)
+            return 2
 
     if args.list_rules:
         for rule in rules.ALL_RULES:

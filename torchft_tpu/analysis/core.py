@@ -53,7 +53,7 @@ BASELINE_ENV = "TPUFT_ANALYSIS_BASELINE"
 _DEFAULT_REFERENCE = "/root/reference"
 _DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
 
-# ``# tpuft: allow(rule-id): reason`` — the reason is mandatory.
+# ``# tpuft: allow(<rule-id>): <reason>`` — the reason is mandatory.
 _SUPPRESS_RE = re.compile(r"#\s*tpuft:\s*allow\(([\w-]+)\)\s*(?::\s*(\S.*))?")
 
 # Generated / vendored files the package scan never visits.
@@ -137,10 +137,27 @@ def _collect_suppressions(module: Module) -> None:
         module.suppressions.setdefault(idx, []).append((rule, reason))
 
 
+# Shared-AST cache: every rule (and every re-scan in one process — the
+# tier-1 suite runs the full package scan more than once, and R8/R11
+# re-load modules from inside their checkers) reuses one parsed Module
+# per file, keyed by (mtime_ns, size) so an edited file re-parses.
+# Rules treat Modules as read-only, which is what makes sharing safe.
+_MODULE_CACHE: Dict[Path, Tuple[Tuple[int, int], "Module"]] = {}
+
+
 def load_module(path: Path) -> Optional[Module]:
     """Parses one file; returns None when it isn't valid Python (a syntax
-    error is a build problem, not an analysis finding)."""
+    error is a build problem, not an analysis finding). Parsed modules are
+    cached process-wide keyed by (path, mtime, size)."""
     path = Path(path).resolve()
+    try:
+        stat = path.stat()
+    except OSError:
+        return None
+    key = (stat.st_mtime_ns, stat.st_size)
+    cached = _MODULE_CACHE.get(path)
+    if cached is not None and cached[0] == key:
+        return cached[1]
     try:
         source = path.read_text(encoding="utf-8")
         tree = ast.parse(source, filename=str(path))
@@ -174,6 +191,7 @@ def load_module(path: Path) -> Optional[Module]:
                         module.span_suppressions.append(
                             (node.lineno, getattr(node, "end_lineno", node.lineno), rid)
                         )
+    _MODULE_CACHE[path] = (key, module)
     return module
 
 

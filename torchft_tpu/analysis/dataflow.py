@@ -1,0 +1,519 @@
+"""tpuft_check semantic rules R9–R11: intraprocedural dataflow over the
+shared per-file ASTs.
+
+Unlike R1–R8 (purely lexical), these rules track how values FLOW inside a
+function:
+
+- **R9 verify-before-adopt** — wire bytes (HTTP/socket chunk reads in the
+  heal/serving transports) are *tainted* until a sanitizer touches them
+  (a CRC/size/digest/era comparison, ``validate_latest``, or the wire
+  codec's self-verifying ``decode_state``); a tainted value reaching an
+  adoption sink (``_apply_pending_state_dict``, a ``self._current`` /
+  ``self._version`` swap, history-ring ``note_state``, deserialization
+  via ``load_state_dict``) is a finding. This is CLAUDE.md's "corrupt /
+  stale / stalled donors funnel into report_error — never adopted state"
+  made structural.
+- **R10 era-fence** — every HTTP route handler that serves checkpoint
+  bytes (heal chunks, serving chunks, /meta) must consult the staged
+  quorum_id/era somewhere in its body; a new route cannot silently skip
+  the 409 fence the shipped handlers all implement.
+- **R11 stale-suppression** — a ``# tpuft: allow(<rule>)`` comment whose
+  rule no longer fires at the covered site is itself a finding, so the
+  suppression inventory cannot rot as the code under it changes.
+
+The taint pass is deliberately *lexical-order* flow ("on the source-order
+path", not a full CFG): a sanitizer cleanses every line after it, and a
+finding means no sanitizer appeared between the fetch and the sink in
+source order. That is the same granularity bar R7 sets for drain-before-
+reconfigure, and it is exactly how the shipped verify-then-adopt sites
+are written (fetch → compare → raise → adopt).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from torchft_tpu.analysis.core import Finding, Module
+
+__all__ = [
+    "check_verify_before_adopt",
+    "check_era_fence",
+    "check_stale_suppression",
+]
+
+
+# ---------------------------------------------------------------------------
+# shared helpers (kept in sync with rules.py's lexical pass)
+# ---------------------------------------------------------------------------
+
+
+def _finding(module: Module, rule: str, node_line: int, message: str) -> Finding:
+    return Finding(
+        rule=rule,
+        file=module.rel,
+        line=node_line,
+        message=message,
+        context=module.line_at(node_line),
+    )
+
+
+def _terminal_name(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def _func_defs(tree: ast.AST) -> Iterable[ast.AST]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _innermost_def(module: Module, node: ast.AST) -> Optional[ast.AST]:
+    cursor = module.parents.get(node)
+    while cursor is not None:
+        if isinstance(cursor, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return cursor
+        cursor = module.parents.get(cursor)
+    return None
+
+
+def _own_statements(module: Module, fn: ast.AST) -> List[ast.stmt]:
+    """``fn``'s statements in source order, excluding statements that
+    belong to a nested def (each def gets its own taint pass)."""
+    out: List[ast.stmt] = []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.stmt) and node is not fn:
+            if _innermost_def(module, node) is fn:
+                out.append(node)
+    out.sort(key=lambda n: (n.lineno, n.col_offset))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# R9 verify-before-adopt
+# ---------------------------------------------------------------------------
+
+_R9_SCOPE_FILES = (
+    "torchft_tpu/checkpointing/http_transport.py",
+    "torchft_tpu/serving/_wire.py",
+    "torchft_tpu/serving/relay.py",
+    "torchft_tpu/serving/subscriber.py",
+    "torchft_tpu/manager.py",
+    "torchft_tpu/history.py",
+    "torchft_tpu/zero.py",
+)
+
+# Calls that produce unverified wire bytes.
+_R9_SOURCE_CALLS = {
+    "fetch_bytes",
+    "fetch_json",
+    "fetch_notify",
+    "urlopen",
+    "_fetch",
+    "_fetch_failover",
+    "_fetch_retry",
+}
+
+# A source call parameterized by a verifier is the *verifying-fetch*
+# idiom (``expect_crc=crcs[i]``, ``consume=<crc-checking closure>``) and
+# yields verified bytes; the same kwarg explicitly set to None does not.
+_R9_VERIFY_KWARG_MARKERS = ("crc", "digest", "era", "quorum", "consume", "verify")
+
+# Function params that ARE wire receivers (the ``consume(resp)`` shape).
+_R9_TAINTED_PARAMS = {"resp", "response", "sock", "conn", "rfile"}
+
+# Tokens whose presence in a Compare marks it as a verification of the
+# tainted value it mentions (CRC check, size check, digest binding,
+# era/quorum-id fence).
+_R9_VERIFY_TOKENS = ("crc", "digest", "era", "quorum", "size")
+
+# Calls that verify their argument (or return self-verified data).
+_R9_SANITIZER_CALLS = {"validate_latest", "decode_state"}
+
+# Adoption sinks: committed-state swaps and deserialization of raw bytes.
+_R9_SINK_CALLS = {"_apply_pending_state_dict", "note_state", "load_state_dict"}
+_R9_SINK_ATTRS = {"_version", "_current", "params", "opt_state", "_state"}
+
+
+def _expr_names(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _call_is_verifying_source(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg is None:
+            continue
+        low = kw.arg.lower()
+        if any(marker in low for marker in _R9_VERIFY_KWARG_MARKERS):
+            if not (isinstance(kw.value, ast.Constant) and kw.value.value is None):
+                return True
+    return False
+
+
+def _source_calls(node: ast.AST) -> List[ast.Call]:
+    """Unverified source calls anywhere under ``node``."""
+    out = []
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            name = _terminal_name(n.func)
+            if name in _R9_SOURCE_CALLS and not _call_is_verifying_source(n):
+                out.append(n)
+    return out
+
+
+class _Taint:
+    """Per-function taint state: tainted names, their derivation closure
+    (so verifying a parsed value also cleanses the bytes it was parsed
+    from), and the source each taint originated at (for messages)."""
+
+    def __init__(self) -> None:
+        self.names: Set[str] = set()
+        self.deriv: Dict[str, Set[str]] = {}
+        self.origin: Dict[str, Tuple[int, str]] = {}
+
+    def taint(self, name: str, via: Set[str], line: int, what: str) -> None:
+        closure: Set[str] = set()
+        for v in via:
+            closure.add(v)
+            closure |= self.deriv.get(v, set())
+        self.deriv[name] = closure
+        self.names.add(name)
+        src = next(
+            (self.origin[v] for v in via if v in self.origin), (line, what)
+        )
+        self.origin[name] = src
+
+    def cleanse(self, name: str) -> None:
+        self.names.discard(name)
+        for other in self.deriv.get(name, ()):  # verified-derived → origin too
+            self.names.discard(other)
+
+    def tainted_in(self, node: ast.AST) -> Set[str]:
+        return _expr_names(node) & self.names
+
+
+def _compare_is_sanitizer(node: ast.Compare) -> bool:
+    for n in ast.walk(node):
+        tok = None
+        if isinstance(n, ast.Name):
+            tok = n.id
+        elif isinstance(n, ast.Attribute):
+            tok = n.attr
+        elif isinstance(n, ast.Constant) and isinstance(n.value, str):
+            tok = n.value
+        if tok and any(v in tok.lower() for v in _R9_VERIFY_TOKENS):
+            return True
+    return False
+
+
+def _apply_sanitizers(taint: _Taint, expr: ast.AST) -> None:
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Compare) and _compare_is_sanitizer(n):
+            for name in taint.tainted_in(n):
+                taint.cleanse(name)
+        elif isinstance(n, ast.Call):
+            if _terminal_name(n.func) in _R9_SANITIZER_CALLS:
+                for arg in n.args:
+                    for name in taint.tainted_in(arg):
+                        taint.cleanse(name)
+
+
+def _value_taints(taint: _Taint, value: ast.AST) -> Tuple[Set[str], Optional[Tuple[int, str]]]:
+    """(tainted names the value mentions, fresh-source origin if the value
+    itself contains an unverified source call). A value whose outermost
+    producer is a sanitizer call is clean."""
+    if isinstance(value, ast.Call) and _terminal_name(value.func) in _R9_SANITIZER_CALLS:
+        return set(), None
+    via = taint.tainted_in(value)
+    fresh = _source_calls(value)
+    origin = None
+    if fresh:
+        call = fresh[0]
+        origin = (call.lineno, _terminal_name(call.func) or "fetch")
+    return via, origin
+
+
+def _sink_findings(module: Module, taint: _Taint, stmt: ast.stmt) -> List[Finding]:
+    out: List[Finding] = []
+    for n in ast.walk(stmt):
+        if isinstance(n, ast.Call):
+            name = _terminal_name(n.func)
+            if name in _R9_SINK_CALLS:
+                tainted = set()
+                for arg in list(n.args) + [kw.value for kw in n.keywords]:
+                    tainted |= taint.tainted_in(arg)
+                    if _source_calls(arg):
+                        tainted.add("<fetch result>")
+                if tainted:
+                    first = sorted(tainted)[0]
+                    src_line, src_what = taint.origin.get(
+                        first, (n.lineno, "fetch")
+                    )
+                    out.append(
+                        _finding(
+                            module,
+                            "verify-before-adopt",
+                            n.lineno,
+                            f"unverified wire bytes ({first!s}, from "
+                            f"{src_what} at line {src_line}) reach "
+                            f"{name}() without a CRC/digest/era check on "
+                            "the path",
+                        )
+                    )
+    return out
+
+
+def _assign_targets(stmt: ast.stmt) -> Tuple[List[ast.expr], Optional[ast.expr]]:
+    if isinstance(stmt, ast.Assign):
+        return stmt.targets, stmt.value
+    if isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+        return ([stmt.target], stmt.value) if stmt.value is not None else ([], None)
+    return [], None
+
+
+def _own_exprs(stmt: ast.stmt) -> List[ast.expr]:
+    """The expressions evaluated AT ``stmt`` itself — compound statements
+    contribute only their headers (test / iter / context managers), never
+    their bodies, which appear separately in source order. This is what
+    keeps the pass flow-sensitive: a CRC check at the bottom of a ``try``
+    must not cleanse a decode at its top."""
+    if isinstance(stmt, ast.Assign):
+        return [stmt.value, *stmt.targets]
+    if isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+        return [e for e in (stmt.value, stmt.target) if e is not None]
+    if isinstance(stmt, (ast.Expr, ast.Return)):
+        return [stmt.value] if stmt.value is not None else []
+    if isinstance(stmt, ast.Raise):
+        return [e for e in (stmt.exc, stmt.cause) if e is not None]
+    if isinstance(stmt, ast.Assert):
+        return [stmt.test] + ([stmt.msg] if stmt.msg is not None else [])
+    if isinstance(stmt, (ast.If, ast.While)):
+        return [stmt.test]
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return [stmt.iter]
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return [item.context_expr for item in stmt.items]
+    return []
+
+
+def _taint_function(module: Module, fn: ast.AST) -> List[Finding]:
+    taint = _Taint()
+    args = getattr(fn, "args", None)
+    if args is not None:
+        for a in list(args.args) + list(args.kwonlyargs):
+            if a.arg in _R9_TAINTED_PARAMS:
+                taint.taint(a.arg, set(), fn.lineno, f"wire receiver param {a.arg!r}")
+
+    findings: List[Finding] = []
+    for stmt in _own_statements(module, fn):
+        exprs = _own_exprs(stmt)
+        # 1) sanitizers in THIS statement's own expressions cleanse first
+        #    (the fetch-compare-raise-adopt idiom has the compare in an If
+        #    test lexically before the adoption statement).
+        for expr in exprs:
+            _apply_sanitizers(taint, expr)
+        # 2) sinks see the post-sanitize taint state.
+        for expr in exprs:
+            findings.extend(_sink_findings(module, taint, expr))
+        # 3) assignments propagate (or introduce) taint.
+        targets, value = _assign_targets(stmt)
+        if value is None:
+            # for-loop targets derive from the iterable
+            if isinstance(stmt, ast.For):
+                via = taint.tainted_in(stmt.iter)
+                if via:
+                    for name in _expr_names(stmt.target):
+                        taint.taint(name, via, stmt.lineno, "loop over tainted")
+            continue
+        via, fresh_origin = _value_taints(taint, value)
+        is_tainted = bool(via) or fresh_origin is not None
+        for target in targets:
+            if isinstance(target, ast.Name):
+                if is_tainted:
+                    line, what = fresh_origin or (stmt.lineno, "derived")
+                    taint.taint(target.id, via, line, what)
+                    if fresh_origin is not None:
+                        taint.origin[target.id] = fresh_origin
+                else:
+                    taint.cleanse(target.id)
+            elif isinstance(target, ast.Tuple):
+                for elt in target.elts:
+                    if isinstance(elt, ast.Name):
+                        if is_tainted:
+                            line, what = fresh_origin or (stmt.lineno, "derived")
+                            taint.taint(elt.id, via, line, what)
+                        else:
+                            taint.cleanse(elt.id)
+            elif isinstance(target, ast.Attribute):
+                if is_tainted and target.attr in _R9_SINK_ATTRS:
+                    name = sorted(via)[0] if via else "<fetch result>"
+                    line, what = fresh_origin or taint.origin.get(
+                        name, (stmt.lineno, "fetch")
+                    )
+                    findings.append(
+                        _finding(
+                            module,
+                            "verify-before-adopt",
+                            stmt.lineno,
+                            f"unverified wire bytes ({name}, from {what} at "
+                            f"line {line}) adopted into "
+                            f"self.{target.attr} without a CRC/digest/era "
+                            "check on the path",
+                        )
+                    )
+            elif isinstance(target, ast.Subscript) and is_tainted:
+                base = target.value
+                if isinstance(base, ast.Name):
+                    line, what = fresh_origin or (stmt.lineno, "derived")
+                    taint.taint(base.id, via, line, what)
+    return findings
+
+
+def check_verify_before_adopt(
+    module: Module, reference_root: Optional[Path] = None
+) -> List[Finding]:
+    del reference_root
+    if module.in_package and module.rel not in _R9_SCOPE_FILES:
+        return []
+    findings: List[Finding] = []
+    for fn in _func_defs(module.tree):
+        findings.extend(_taint_function(module, fn))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# R10 era-fence
+# ---------------------------------------------------------------------------
+
+_R10_HANDLER_NAMES = {"do_GET", "do_POST"}
+_R10_ERA_RE = re.compile(r"(^|_)era($|_)")
+
+
+def _r10_tokens(fn: ast.AST) -> Iterable[str]:
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Name):
+            yield n.id
+        elif isinstance(n, ast.Attribute):
+            yield n.attr
+        elif isinstance(n, ast.Constant) and isinstance(n.value, str):
+            yield n.value
+
+
+def check_era_fence(
+    module: Module, reference_root: Optional[Path] = None
+) -> List[Finding]:
+    del reference_root
+    findings: List[Finding] = []
+    for fn in _func_defs(module.tree):
+        if fn.name not in _R10_HANDLER_NAMES:  # type: ignore[union-attr]
+            continue
+        serves_checkpoint = any(
+            isinstance(n, ast.Constant)
+            and isinstance(n.value, str)
+            and "checkpoint" in n.value
+            for n in ast.walk(fn)
+        )
+        if not serves_checkpoint:
+            continue
+        fenced = any(
+            "quorum_id" in tok.lower() or _R10_ERA_RE.search(tok.lower())
+            for tok in _r10_tokens(fn)
+        )
+        if not fenced:
+            findings.append(
+                _finding(
+                    module,
+                    "era-fence",
+                    fn.lineno,
+                    f"route handler {fn.name} serves checkpoint bytes "
+                    "without consulting the staged quorum_id/era (stale-era "
+                    "requests must be refused, http_transport.py do_GET "
+                    "fence)",
+                )
+            )
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# R11 stale-suppression
+# ---------------------------------------------------------------------------
+
+_R11_SELF = {"stale-suppression", "suppression"}
+
+
+def _suppression_covers(module: Module, comment_line: int, finding_line: int) -> bool:
+    """Mirrors Module.is_suppressed coverage for ONE specific comment:
+    its own line (end-of-line form), the next line (comment-only form),
+    and the span of any def whose header sits on a covered line."""
+    comment_only = module.line_at(comment_line).startswith("#")
+    direct = {comment_line}
+    if comment_only:
+        direct.add(comment_line + 1)
+    if finding_line in direct:
+        return True
+    for node in _func_defs(module.tree):
+        if node.lineno in direct:
+            end = getattr(node, "end_lineno", node.lineno)
+            if node.lineno <= finding_line <= end:
+                return True
+    return False
+
+
+def check_stale_suppression(
+    module: Module, reference_root: Optional[Path] = None
+) -> List[Finding]:
+    if not module.suppressions:
+        return []
+    # Late import: rules.py registers THIS checker, so the registry import
+    # must not run at module import time.
+    from torchft_tpu.analysis.rules import RULES_BY_ID
+
+    findings: List[Finding] = []
+    live_cache: Dict[str, List[Finding]] = {}
+    for comment_line in sorted(module.suppressions):
+        for rule_id, _reason in module.suppressions[comment_line]:
+            if rule_id in _R11_SELF:
+                continue
+            rule = RULES_BY_ID.get(rule_id)
+            if rule is None:
+                findings.append(
+                    _finding(
+                        module,
+                        "stale-suppression",
+                        comment_line,
+                        f"suppression names unknown rule {rule_id!r} — it "
+                        "can never fire; fix the rule id or delete the "
+                        "comment",
+                    )
+                )
+                continue
+            if rule_id not in live_cache:
+                # Checkers are suppression-blind (run_analysis filters after
+                # they return), so this re-run sees the pre-suppression
+                # findings the comment claims to cover.
+                live_cache[rule_id] = rule.check(
+                    module, reference_root=reference_root
+                )
+            covered = any(
+                _suppression_covers(module, comment_line, f.line)
+                for f in live_cache[rule_id]
+            )
+            if not covered:
+                findings.append(
+                    _finding(
+                        module,
+                        "stale-suppression",
+                        comment_line,
+                        f"suppression for {rule_id!r} no longer matches a "
+                        "finding at this site — the code it excused has "
+                        "changed; delete the comment (or re-justify it at "
+                        "the new site)",
+                    )
+                )
+    return findings

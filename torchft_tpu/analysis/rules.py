@@ -1,12 +1,15 @@
-"""tpuft_check rules R1–R8: CLAUDE.md invariants as AST properties.
+"""tpuft_check rules: CLAUDE.md invariants as AST properties.
 
-Each rule is deliberately *lexical*: it proves what can be proven from one
+R1–R8 are deliberately *lexical*: each proves what can be proven from one
 function's source order and flags the rest, so a clean run is a real
 guarantee at the granularity the rule states (and the runtime lockcheck
-covers the interleavings the AST cannot see). Scoping: rules whose
-invariant binds specific layers consult ``Module.rel``; files outside the
-package (test fixtures, explicit CLI paths) are always in scope, which is
-how the per-rule fixture tests drive them.
+covers the interleavings the AST cannot see). R9–R11 (registered here,
+implemented in :mod:`torchft_tpu.analysis.dataflow`) add an
+intraprocedural dataflow layer over the same shared per-file ASTs.
+Scoping: rules whose invariant binds specific layers consult
+``Module.rel``; files outside the package (test fixtures, explicit CLI
+paths) are always in scope, which is how the per-rule fixture tests
+drive them.
 
 | id                  | invariant (CLAUDE.md anchor)                        |
 |---------------------|-----------------------------------------------------|
@@ -27,6 +30,12 @@ how the per-rule fixture tests drive them.
 |                     | speculative window                                  |
 | metric-doc-drift    | every emitted tpuft_* metric name has a METRICS.md  |
 |                     | table row and every row a live emission site        |
+| verify-before-adopt | wire bytes pass a CRC/digest/era sanitizer before   |
+|                     | any adoption sink (taint pass, dataflow.py)         |
+| era-fence           | checkpoint-serving route handlers consult the       |
+|                     | staged quorum_id/era before answering               |
+| stale-suppression   | every ``tpuft: allow`` comment still covers a live  |
+|                     | finding of its rule                                 |
 """
 
 from __future__ import annotations
@@ -37,6 +46,7 @@ from dataclasses import dataclass
 from pathlib import Path
 from typing import Callable, Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
+from torchft_tpu.analysis import dataflow
 from torchft_tpu.analysis.core import Finding, Module
 
 __all__ = ["Rule", "ALL_RULES", "RULES_BY_ID"]
@@ -930,6 +940,24 @@ ALL_RULES: Sequence[Rule] = (
         summary="every emitted tpuft_* metric has a METRICS.md row and vice versa",
         anchor="metrics.py module docstring ('canonical metric names ... tabulated in METRICS.md')",
         checker=_check_r8,
+    ),
+    Rule(
+        id="verify-before-adopt",
+        summary="wire bytes pass a CRC/digest/era sanitizer before any adoption sink",
+        anchor="CLAUDE.md 'Corrupt/stale/stalled donors funnel into report_error — never adopted state'",
+        checker=dataflow.check_verify_before_adopt,
+    ),
+    Rule(
+        id="era-fence",
+        summary="checkpoint-serving route handlers consult the staged quorum_id/era",
+        anchor="CLAUDE.md 'quorum-era tags on meta and chunk URLs' (http_transport do_GET 409 fence)",
+        checker=dataflow.check_era_fence,
+    ),
+    Rule(
+        id="stale-suppression",
+        summary="every tpuft allow comment still covers a live finding of its rule",
+        anchor="core.py suppression contract (the inventory must not rot)",
+        checker=dataflow.check_stale_suppression,
     ),
 )
 

@@ -1543,6 +1543,7 @@ class HTTPTransport(CheckpointTransport[Any]):
                 )
                 t0 = time.perf_counter()
                 try:
+                    # tpuft: allow(verify-before-adopt): stream-decode into discardable buffers — reader.crc is compared against the manifest CRC below before the chunk can be returned, and a mismatch raises HealChecksumError (the decoded object never escapes)
                     chunk = _serialization.load_state_dict(reader)
                 except (HealStalledError, EOFError, ConnectionError):
                     # Fence and truncation classify themselves; the retry

@@ -93,6 +93,11 @@ KNOWN_ENV = {
     # Correctness tooling: runtime lock-order detector + static analyzer
     # (python -m torchft_tpu.analysis; docs/static_analysis.md).
     "TPUFT_LOCK_CHECK", "TPUFT_ANALYSIS_REFERENCE", "TPUFT_ANALYSIS_BASELINE",
+    # Interleaving explorer budgets (python -m torchft_tpu.analysis
+    # --explore; utils/schedules.explore_defaults): schedule budget, RNG
+    # seed, max preemption bound, random long-tail count.
+    "TPUFT_EXPLORE_BUDGET", "TPUFT_EXPLORE_SEED", "TPUFT_EXPLORE_PREEMPTIONS",
+    "TPUFT_EXPLORE_RANDOM",
     # Fleet trace plane (torchft_tpu/tracing.py): recording switch, journal
     # ring size, store clock-beacon sampling switch.
     "TPUFT_TRACE", "TPUFT_TRACE_SIZE", "TPUFT_TRACE_CLOCK",
@@ -983,6 +988,39 @@ def _check_topology() -> Tuple[str, str]:
     return "PASS", "WAN topology: " + ", ".join(pieces)
 
 
+def _check_explore() -> Tuple[str, str]:
+    """Interleaving-explorer budget knobs. WARN, never FAIL: an
+    unparsable TPUFT_EXPLORE_* value silently falls back to its default
+    at runtime (schedules.explore_defaults), so the operator should hear
+    about the typo without the preflight going red."""
+    from torchft_tpu.utils.schedules import explore_defaults
+
+    bad = []
+    for env in (
+        "TPUFT_EXPLORE_BUDGET", "TPUFT_EXPLORE_SEED",
+        "TPUFT_EXPLORE_PREEMPTIONS", "TPUFT_EXPLORE_RANDOM",
+    ):
+        raw = os.environ.get(env, "")
+        if not raw:
+            continue
+        try:
+            int(raw)
+        except ValueError:
+            bad.append(f"{env}={raw!r}")
+    d = explore_defaults()
+    budgets = (
+        f"budget={d['budget']} preemptions<={d['preemptions']} "
+        f"random={d['random']} seed={d['seed']}"
+    )
+    if bad:
+        return (
+            "WARN",
+            "unparsable TPUFT_EXPLORE_* value(s) ignored (defaults "
+            f"apply): {', '.join(bad)}; effective {budgets}",
+        )
+    return "PASS", f"explorer budgets: {budgets}"
+
+
 def _check_env() -> Tuple[str, str]:
     # Value validation first — a fatal misconfig must FAIL even when a
     # typo'd var would also WARN.
@@ -1014,6 +1052,7 @@ def run_checks(lighthouse: str, skip_device: bool = False) -> int:
         ("weight history", _check_history),
         ("metrics", _check_metrics),
         ("trace plane", _check_trace),
+        ("interleaving explorer", _check_explore),
         ("goodput/slo", _check_goodput),
         ("heal serving", _check_heal_serve),
         ("weights serving", _check_serving),

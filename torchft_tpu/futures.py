@@ -240,6 +240,9 @@ class CommitPipeline:
         record before pushing past ``depth`` — exceeding it means a step
         was dispatched with more than ``depth`` commits unaccounted, which
         the bounded envelope forbids."""
+        from torchft_tpu.utils import schedules
+
+        schedules.point("pipeline.push")
         with self._lock:
             if len(self._records) >= self._depth:
                 raise RuntimeError(
@@ -271,6 +274,9 @@ class CommitPipeline:
         """Pops every pending record (oldest first); the caller resolves
         them. Used at step-loop boundaries: flush, shutdown, switching
         step protocols."""
+        from torchft_tpu.utils import schedules
+
+        schedules.point("pipeline.drain")
         with self._lock:
             records, self._records = tuple(self._records), []
             self._note_occupancy()

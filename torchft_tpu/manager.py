@@ -63,7 +63,7 @@ from torchft_tpu.history import WeightHistory
 from torchft_tpu.parallel.process_group import ProcessGroup, ReduceOp
 from torchft_tpu.parallel.store import StoreClient
 from torchft_tpu.telemetry import commits_logger, errors_logger, quorums_logger
-from torchft_tpu.utils import lockcheck, netem
+from torchft_tpu.utils import lockcheck, netem, schedules
 from torchft_tpu.utils.profiling import trace_span
 from torchft_tpu.utils.transfer import prefetch_to_host
 from torchft_tpu.work import Work, _DummyWork
@@ -848,6 +848,7 @@ class Manager:
         structural (tpuft_check rule R7 pins the ordering lexically).
         Hook errors funnel into :meth:`report_error` (the step will not
         commit) rather than aborting the reconfigure or the serve."""
+        schedules.point("manager.quorum_drain_hooks")
         for hook in self._quorum_change_hooks:
             try:
                 hook()
@@ -901,6 +902,7 @@ class Manager:
         publisher = self._publisher
         if publisher is None or not publisher.due():
             return
+        schedules.point("manager.maybe_publish")
         try:
             # Publication must never sample speculative-window state:
             # resolve the full window before touching params (R7).
@@ -1230,6 +1232,7 @@ class Manager:
     ) -> None:
         """Starts a (possibly async) quorum and readies the manager for a new
         step (reference: manager.py:534-589). Call before the forward pass."""
+        schedules.point("manager.start_quorum")
         if self._quorum_future is not None:
             self._quorum_future.result()
 
@@ -1851,6 +1854,7 @@ class Manager:
             return None
 
     def _apply_pending_state_dict(self) -> None:
+        schedules.point("manager.apply_pending_state")
         assert self._healing, "must be in healing state"
         assert self._quorum_future is not None, "must call start_quorum first"
         self._quorum_future.result()

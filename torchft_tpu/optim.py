@@ -37,6 +37,7 @@ import numpy as np
 
 from torchft_tpu import health, metrics, tracing
 from torchft_tpu.manager import Manager
+from torchft_tpu.utils import schedules
 from torchft_tpu.utils.profiling import trace_span
 
 logger = logging.getLogger(__name__)
@@ -707,6 +708,7 @@ class Optimizer:
         oldest, replaying the whole window's grads onto the healed state.
         Idempotent: the quorum-change drain and the train loop may both
         reach it."""
+        schedules.point("optim.resolve_record")
         with rec._lock:
             if rec.committed is not None:
                 return rec.committed
@@ -866,6 +868,7 @@ class Optimizer:
         Records stay in the pipeline (resolved in place, both phases
         idempotent) so the train loop still observes each step's verdict
         on its own thread."""
+        schedules.point("optim.window_drain")
         if self._pipeline is None:
             return
         pending = self._pipeline.pending()
@@ -1215,6 +1218,7 @@ class Optimizer:
             # Tentative adoption — one more slot of the uncommitted
             # window. Write-locked so a concurrent donor capture never
             # reads a torn pair.
+            schedules.point("optim.speculate_adopt")
             manager.disallow_state_dict_read()
             try:
                 self.params, self.opt_state = spec

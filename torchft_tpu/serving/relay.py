@@ -47,6 +47,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
 
 from torchft_tpu import metrics, tracing
+from torchft_tpu._safe_pickle import safe_loads
 from torchft_tpu.checkpointing.serve_child import (
     UnknownTenantToken,
     maybe_pace_serve,
@@ -657,6 +658,23 @@ class CachingRelay:
         meta_bytes = self._fetch_failover(
             live, f"/checkpoint/{step}/meta", expect_crc=None, algo=algo
         )
+        # Bind the fetched meta to the validated descriptor BEFORE it can
+        # be cached or re-served: a stale/corrupt upstream must produce a
+        # counted pull failure (readers stay on the previous version),
+        # never poisoned relay state — the subscriber's torn-read fence,
+        # applied at the tier that would otherwise amplify the bad bytes.
+        # tpuft_check rule R9 (verify-before-adopt) pins this path.
+        meta = safe_loads(meta_bytes)
+        if (
+            not isinstance(meta, dict)
+            or meta.get("step") != step
+            or meta.get("digest") != latest["digest"]
+        ):
+            metrics.inc("tpuft_serving_meta_digest_rejects_total")
+            raise _PullFailed(
+                f"meta for step {step} does not match the validated "
+                "descriptor digest (torn read or corrupt upstream)"
+            )
         depth = int(latest.get("depth", 0)) + 1
         chunks: List[Optional[bytes]] = [None] * len(crcs)
         reused = 0

@@ -35,6 +35,8 @@ import sys
 import threading
 from typing import Dict, List, Optional, Set
 
+from torchft_tpu.utils import schedules
+
 __all__ = [
     "ENV",
     "LockOrderError",
@@ -63,6 +65,7 @@ _violations: List[str] = []
 _tls = threading.local()
 
 _THIS_FILE = os.path.abspath(__file__)
+_SCHEDULES_FILE = os.path.abspath(schedules.__file__)
 _REPO_MARKERS = ("torchft_tpu", os.sep + "tests" + os.sep)
 
 
@@ -106,13 +109,21 @@ def creation_site(skip: int = 1) -> str:
 
 def _is_instrumented_frame(skip: int = 2) -> bool:
     """True when the lock being created belongs to torchft_tpu or the test
-    suite (stdlib/third-party creation sites stay uninstrumented)."""
+    suite (stdlib/third-party creation sites stay uninstrumented).
+
+    The schedule plane (utils/schedules.py) is explicitly EXCLUDED: the
+    detector's note_* hooks are themselves schedule points, so an
+    instrumented scheduler-internal condition would re-enter
+    ``schedules.point`` while holding its own non-reentrant inner lock —
+    a self-deadlock, not a finding."""
     frame = sys._getframe(skip)
     while frame is not None and os.path.abspath(frame.f_code.co_filename) == _THIS_FILE:
         frame = frame.f_back
     if frame is None:
         return False
     fname = frame.f_code.co_filename
+    if os.path.abspath(fname) == _SCHEDULES_FILE:
+        return False
     return any(marker in fname for marker in _REPO_MARKERS)
 
 
@@ -138,6 +149,9 @@ def note_acquired(obj: object, site: str, raise_on_cycle: bool = True) -> None:
     edge would close a cycle. No-op when the detector is disabled."""
     if not _enabled:
         return
+    # Lock acquisitions double as interleaving-explorer schedule points
+    # (torchft_tpu.utils.schedules): free when no scheduler is active.
+    schedules.point(f"lock.acquire:{site}")
     held = _held_stack()
     for rec in held:
         if rec.obj is obj:
@@ -172,6 +186,14 @@ def note_acquired(obj: object, site: str, raise_on_cycle: bool = True) -> None:
 def note_released(obj: object) -> None:
     """Drops ``obj`` from the calling thread's held set (reentrant-aware).
     Unknown objects are ignored: the lock may predate enable()."""
+    if _enabled:
+        # Mirrors note_acquired's gate: releases double as schedule points
+        # only while the detector is live.  Instrumented locks outlive
+        # disable(), and their releases must not keep inflating the
+        # explorer's schedule space after it (the held-set cleanup below
+        # stays unconditional so a disable with locks held cannot strand
+        # stale entries).
+        schedules.point("lock.release")
     held = getattr(_tls, "held", None)
     if not held:
         return
@@ -190,6 +212,9 @@ def check_barrier(label: str) -> None:
     dict, and peer serve threads need the state-dict read lock meanwhile;
     holding a lock here is a cross-replica deadlock waiting for the right
     interleaving)."""
+    # Commit-barrier entry is a schedule point even with the lock detector
+    # off — it is the highest-value preemption site the explorer has.
+    schedules.point(f"lock.barrier:{label}")
     if not _enabled:
         return
     held = getattr(_tls, "held", None)

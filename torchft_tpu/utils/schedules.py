@@ -1,0 +1,581 @@
+"""Deterministic interleaving control plane for the commit/quorum protocol.
+
+The GIL only ever shows a handful of thread interleavings; the schedules
+plane makes the rest reachable ON DEMAND.  Instrumented seams in the
+coordination plane call :func:`point` ("I am at a schedule point named X");
+when no :class:`Scheduler` is active — the shipped default — that call is a
+few nanoseconds of module-global check and the production code path is
+untouched.  When a scheduler IS active (only inside
+``torchft_tpu.analysis.explore`` scenarios and their tests), every
+*registered* thread parks at each point and a single controller decides,
+deterministically, which parked thread runs next.  Foreign threads (manager
+executors, watchdogs, heartbeats) pass through unscheduled: scenarios drive
+the protocol from the threads they spawn, the same way the
+threads-as-replicas integration tests do.
+
+Exploration (:func:`explore`) enumerates schedules by DFS over recorded
+decision points with iterative preemption bounding (the CHESS insight:
+most concurrency bugs need very few preemptions), then a seeded-random
+long tail.  Every run — passing or failing — has a one-line *replay
+token* (``tpuft-sched:`` + base64 of the decision list) that
+:func:`replay` turns back into the exact same interleaving.
+
+Determinism caveat: a registered thread that blocks on a *real* lock held
+by another registered thread cannot reach its next point; the controller
+detects the stall (``stall_timeout``) and schedules someone else.  Those
+fallback decisions depend on wall-clock time, so replay tokens are exact
+for schedules whose points never straddle a real-lock wait and best-effort
+otherwise — the explorer's scenarios keep their invariant checks
+schedule-independent so a replayed token still reproduces the *violation*
+even if the literal decision list re-records differently.
+
+Env knobs (read by :func:`explore_defaults`, surfaced by doctor):
+  TPUFT_EXPLORE_BUDGET       max schedules per scenario (default 64)
+  TPUFT_EXPLORE_SEED         seed for the random long tail (default 0)
+  TPUFT_EXPLORE_PREEMPTIONS  max preemption bound for the DFS legs (default 2)
+  TPUFT_EXPLORE_RANDOM       random-schedule count after DFS (default 8)
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import random
+import threading
+import traceback
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "point",
+    "Scheduler",
+    "ScheduleTrace",
+    "ScheduleDeadlock",
+    "ScheduleViolation",
+    "ExploreResult",
+    "run_schedule",
+    "explore",
+    "replay",
+    "encode_token",
+    "decode_token",
+    "explore_defaults",
+]
+
+TOKEN_PREFIX = "tpuft-sched:"
+
+# The active scheduler.  Reads are deliberately lock-free: `point` is on
+# hot production paths (lockcheck acquire/release, pipeline push) and must
+# cost one global load + one `is None` branch when exploration is off.
+_active: Optional["Scheduler"] = None
+
+
+def point(name: str, until: Optional[Callable[[], bool]] = None) -> None:
+    """Schedule point.  No-op unless a Scheduler is active AND the calling
+    thread is one the scheduler spawned.
+
+    ``until`` makes the park *guarded*: the controller will not grant the
+    thread until the predicate returns True (evaluated under the
+    controller lock — keep it a cheap flag/Event check).  Scenarios use
+    guards to encode protocol ordering contracts (e.g. "the quorum-change
+    drain never overlaps new dispatches") without wall-clock waits or
+    spin livelock; an unscheduled caller passes through and must enforce
+    the same ordering with its own real synchronization."""
+    sched = _active
+    if sched is not None:
+        sched._visit(name, until)
+
+
+class ScheduleDeadlock(RuntimeError):
+    """Every scheduled thread is blocked on a real lock and none arrives at
+    a point within the deadlock timeout."""
+
+
+@dataclass
+class Decision:
+    """One controller choice: which of the parked threads ran next."""
+
+    options: Tuple[str, ...]  # sorted thread names that were runnable
+    chosen: int  # index into options
+    preempted: bool  # a different runnable thread was descheduled
+
+
+@dataclass
+class ScheduleTrace:
+    decisions: List[Decision] = field(default_factory=list)
+    points: List[Tuple[str, str]] = field(default_factory=list)  # (thread, point)
+
+    @property
+    def preemptions(self) -> int:
+        return sum(1 for d in self.decisions if d.preempted)
+
+    @property
+    def token(self) -> str:
+        return encode_token([d.chosen for d in self.decisions])
+
+
+def encode_token(choices: Sequence[int]) -> str:
+    raw = json.dumps(list(choices), separators=(",", ":")).encode()
+    return TOKEN_PREFIX + base64.urlsafe_b64encode(raw).decode()
+
+
+def decode_token(token: str) -> List[int]:
+    if not token.startswith(TOKEN_PREFIX):
+        raise ValueError(f"not a schedule token: {token!r}")
+    raw = base64.urlsafe_b64decode(token[len(TOKEN_PREFIX):].encode())
+    choices = json.loads(raw)
+    if not isinstance(choices, list) or not all(
+        isinstance(c, int) for c in choices
+    ):
+        raise ValueError(f"malformed schedule token payload: {token!r}")
+    return choices
+
+
+_NEW, _RUNNING, _WAITING, _BLOCKED, _DONE = range(5)
+
+
+class _ThreadState:
+    def __init__(self, name: str, index: int) -> None:
+        self.name = name
+        self.index = index
+        self.status = _NEW
+        self.granted = False
+        self.at: Optional[str] = None
+        self.guard: Optional[Callable[[], bool]] = None
+        self.thread: Optional[threading.Thread] = None
+        self.error: Optional[BaseException] = None
+
+    def runnable(self) -> bool:
+        if self.status != _WAITING:
+            return False
+        if self.guard is None:
+            return True
+        try:
+            return bool(self.guard())
+        except Exception:  # noqa: BLE001 — a broken guard must not wedge
+            return True
+
+
+class Scheduler:
+    """Single-controller cooperative scheduler over spawned threads.
+
+    Usage (normally via :func:`run_schedule`)::
+
+        sched = Scheduler(choices=[0, 1, 0])
+        sched.spawn("train", train_body)
+        sched.spawn("quorum", drain_body)
+        trace = sched.run()   # joins everything, re-raises thread errors
+    """
+
+    def __init__(
+        self,
+        choices: Optional[Sequence[int]] = None,
+        rng: Optional[random.Random] = None,
+        stall_timeout: float = 0.75,
+        deadlock_timeout: float = 20.0,
+    ) -> None:
+        self._choices = list(choices or ())
+        self._rng = rng
+        self._stall_timeout = stall_timeout
+        self._deadlock_timeout = deadlock_timeout
+        self._cv = threading.Condition()
+        self._tls = threading.local()
+        self._threads: Dict[int, _ThreadState] = {}  # ident -> state
+        self._states: List[_ThreadState] = []
+        self._last: Optional[_ThreadState] = None
+        self._decision_idx = 0
+        self._draining = False  # True once run() finished: points pass through
+        self.trace = ScheduleTrace()
+
+    # -- thread side -------------------------------------------------------
+
+    def spawn(self, name: str, fn: Callable[[], Any]) -> None:
+        st = _ThreadState(name, len(self._states))
+        self._states.append(st)
+
+        def body() -> None:
+            # Register under our own ident BEFORE the first point so the
+            # start point is always scheduled (no start()-time race).
+            with self._cv:
+                self._threads[threading.get_ident()] = st
+            try:
+                self._visit(f"start:{name}")
+                fn()
+            except BaseException as e:  # noqa: BLE001 — reported by run()
+                st.error = e
+            finally:
+                with self._cv:
+                    # Unregister the ident: the OS reuses thread idents, so a
+                    # foreign thread spawned after this one exits could
+                    # otherwise be mistaken for it and parked forever.
+                    self._threads.pop(threading.get_ident(), None)
+                    st.status = _DONE
+                    self._cv.notify_all()
+
+        t = threading.Thread(target=body, name=f"sched-{name}", daemon=True)
+        st.thread = t
+        t.start()
+
+    def _visit(
+        self, name: str, until: Optional[Callable[[], bool]] = None
+    ) -> None:
+        if self._draining:
+            return
+        st = self._threads.get(threading.get_ident())
+        if st is None:
+            return  # foreign thread: pass through unscheduled
+        # Reentrancy guard: instrumented primitives (lockcheck) fire points
+        # from inside their own acquire/release hooks; a nested point while
+        # this thread is already parked in scheduler machinery must pass
+        # through, or it would re-enter self._cv and self-deadlock.
+        if getattr(self._tls, "in_visit", False):
+            return
+        self._tls.in_visit = True
+        try:
+            self._visit_inner(st, name, until)
+        finally:
+            self._tls.in_visit = False
+
+    def _visit_inner(
+        self,
+        st: "_ThreadState",
+        name: str,
+        until: Optional[Callable[[], bool]],
+    ) -> None:
+        with self._cv:
+            if self._draining:
+                return
+            st.status = _WAITING
+            st.at = name
+            st.guard = until
+            self._cv.notify_all()
+            while not st.granted and not self._draining:
+                self._cv.wait(0.5)
+            st.granted = False
+            st.guard = None
+            st.status = _RUNNING
+            self.trace.points.append((st.name, name))
+
+    # -- controller side ---------------------------------------------------
+
+    def _choose(self, runnable: List[_ThreadState]) -> _ThreadState:
+        names = tuple(s.name for s in runnable)
+        if self._decision_idx < len(self._choices):
+            chosen = self._choices[self._decision_idx] % len(runnable)
+        elif self._rng is not None:
+            chosen = self._rng.randrange(len(runnable))
+        elif self._last is not None and self._last in runnable:
+            chosen = runnable.index(self._last)  # run-to-completion default
+        else:
+            chosen = 0
+        self._decision_idx += 1
+        preempted = (
+            self._last is not None
+            and self._last in runnable
+            and runnable[chosen] is not self._last
+        )
+        self.trace.decisions.append(Decision(names, chosen, preempted))
+        return runnable[chosen]
+
+    def run(self) -> ScheduleTrace:
+        """Drives the schedule to completion, joins every spawned thread,
+        and re-raises the first thread error (annotated with the replay
+        token)."""
+        import time
+
+        try:
+            with self._cv:
+                while True:
+                    live = [s for s in self._states if s.status != _DONE]
+                    if not live:
+                        break
+                    # Wait until nothing is RUNNING (or it stalls on a real
+                    # lock), so decisions serialize the scheduled threads.
+                    deadline = time.monotonic() + self._stall_timeout
+                    while any(s.status == _RUNNING for s in live):
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            for s in live:
+                                if s.status == _RUNNING:
+                                    s.status = _BLOCKED
+                            break
+                        self._cv.wait(remaining)
+                        live = [s for s in self._states if s.status != _DONE]
+                    live = [s for s in self._states if s.status != _DONE]
+                    if not live:
+                        break
+                    # A BLOCKED thread that reached a point is WAITING
+                    # again; a guarded park only becomes runnable once its
+                    # predicate holds.
+                    runnable = sorted(
+                        (s for s in live if s.runnable()),
+                        key=lambda s: s.name,
+                    )
+                    if not runnable:
+                        # Everyone live is blocked on real locks or guarded
+                        # parks; poll for a grantable arrival or full
+                        # completion, bounded by the deadlock timeout.
+                        # (Counting an already-_DONE thread as progress here
+                        # would spin forever; polling, not a bare wait_for,
+                        # because a guard can flip without a _cv notify.)
+                        deadline = time.monotonic() + self._deadlock_timeout
+                        ok = False
+                        while time.monotonic() < deadline:
+                            if any(
+                                s.runnable() for s in self._states
+                            ) or all(
+                                s.status == _DONE for s in self._states
+                            ):
+                                ok = True
+                                break
+                            self._cv.wait(0.2)
+                        if not ok:
+                            raise ScheduleDeadlock(
+                                "no scheduled thread became grantable within "
+                                f"{self._deadlock_timeout}s; parked at: "
+                                + ", ".join(
+                                    f"{s.name}@{s.at}"
+                                    for s in self._states
+                                    if s.status in (_BLOCKED, _WAITING)
+                                )
+                            )
+                        continue
+                    chosen = self._choose(runnable)
+                    chosen.granted = True
+                    chosen.status = _RUNNING
+                    self._last = chosen
+                    self._cv.notify_all()
+        finally:
+            # Release everything still parked so join() can't hang.
+            with self._cv:
+                self._draining = True
+                for s in self._states:
+                    s.granted = True
+                self._cv.notify_all()
+            for s in self._states:
+                if s.thread is not None:
+                    s.thread.join(timeout=self._deadlock_timeout)
+        for s in self._states:
+            if s.error is not None:
+                raise s.error
+        return self.trace
+
+
+# ---------------------------------------------------------------------------
+# exploration
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ScheduleViolation:
+    """A schedule under which the scenario's invariants broke."""
+
+    token: str
+    error: str
+    error_type: str
+    decisions: List[int]
+
+    def format(self) -> str:
+        return (
+            f"schedule violation [{self.error_type}]: {self.error}\n"
+            f"  replay: {self.token}"
+        )
+
+
+@dataclass
+class ExploreResult:
+    scenario: str
+    schedules_run: int
+    violation: Optional[ScheduleViolation]
+    tokens_seen: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return self.violation is None
+
+
+# A scenario is a callable taking the Scheduler (spawn threads on it, the
+# caller runs them) and optionally returning a post-check callable that
+# asserts invariants after all threads joined.  A ``cleanup`` attribute on
+# the returned check, when present, always runs after the schedule —
+# violation or not — so real-protocol scenarios can shut their manager
+# down without leaking executor threads across hundreds of runs.
+Scenario = Callable[[Scheduler], Optional[Callable[[], None]]]
+
+
+def run_schedule(
+    scenario: Scenario,
+    choices: Optional[Sequence[int]] = None,
+    rng: Optional[random.Random] = None,
+    stall_timeout: float = 0.75,
+) -> Tuple[ScheduleTrace, Optional[BaseException]]:
+    """Runs ``scenario`` once under a fresh Scheduler.  Returns the trace
+    and the first violation (thread error or post-check failure), if any.
+    The scheduler is installed as the process-global active scheduler for
+    the duration — scenarios must not run concurrently."""
+    global _active
+    sched = Scheduler(choices=choices, rng=rng, stall_timeout=stall_timeout)
+    error: Optional[BaseException] = None
+    check: Optional[Callable[[], None]] = None
+    _active = sched
+    try:
+        check = scenario(sched)
+        sched.run()
+        if check is not None:
+            check()
+    except BaseException as e:  # noqa: BLE001 — classified by caller
+        error = e
+    finally:
+        cleanup = getattr(check, "cleanup", None)
+        if cleanup is not None:
+            try:
+                cleanup()
+            except Exception:  # noqa: BLE001 — teardown must not mask the run
+                pass
+        _active = None
+    return sched.trace, error
+
+
+def _violation_from(
+    trace: ScheduleTrace, error: BaseException
+) -> ScheduleViolation:
+    return ScheduleViolation(
+        token=trace.token,
+        error="".join(
+            traceback.format_exception_only(type(error), error)
+        ).strip(),
+        error_type=type(error).__name__,
+        decisions=[d.chosen for d in trace.decisions],
+    )
+
+
+def _prefix_preemptions(trace: ScheduleTrace, prefix_len: int, alt: int) -> int:
+    """Preemption count of ``trace``'s decision prefix with the decision at
+    ``prefix_len - 1`` replaced by option ``alt`` (an a-priori bound used to
+    filter DFS branches before running them)."""
+    count = 0
+    last: Optional[str] = None
+    for i, d in enumerate(trace.decisions[:prefix_len]):
+        chosen = alt if i == prefix_len - 1 else d.chosen
+        chosen %= len(d.options)
+        name = d.options[chosen]
+        if last is not None and last in d.options and name != last:
+            count += 1
+        last = name
+    return count
+
+
+def explore(
+    scenario: Scenario,
+    name: str = "scenario",
+    budget: Optional[int] = None,
+    preemption_bounds: Optional[Sequence[int]] = None,
+    random_runs: Optional[int] = None,
+    seed: Optional[int] = None,
+    stall_timeout: float = 0.75,
+    on_violation: Optional[Callable[[ScheduleViolation], None]] = None,
+) -> ExploreResult:
+    """Systematically explores ``scenario``'s interleavings.
+
+    DFS over recorded decision points with iterative preemption bounding
+    (bound 0 first, then 1, ...), then ``random_runs`` seeded-random
+    schedules.  Stops at the first violation (returned with its replay
+    token) or when ``budget`` schedules have run."""
+    defaults = explore_defaults()
+    budget = defaults["budget"] if budget is None else budget
+    random_runs = defaults["random"] if random_runs is None else random_runs
+    seed = defaults["seed"] if seed is None else seed
+    if preemption_bounds is None:
+        preemption_bounds = tuple(range(defaults["preemptions"] + 1))
+
+    runs = 0
+    # Prefix -> recorded trace.  Re-visiting a prefix at a higher
+    # preemption bound reuses the cached trace for expansion instead of
+    # re-running it (and instead of skipping it entirely, which would
+    # leave every later bound with nothing to expand).
+    cache: Dict[Tuple[int, ...], ScheduleTrace] = {}
+
+    def one(choices=None, rng=None):
+        nonlocal runs
+        runs += 1
+        trace, error = run_schedule(
+            scenario, choices=choices, rng=rng, stall_timeout=stall_timeout
+        )
+        if error is not None:
+            v = _violation_from(trace, error)
+            if on_violation is not None:
+                on_violation(v)
+            return trace, v
+        return trace, None
+
+    for bound in preemption_bounds:
+        frontier: List[List[int]] = [[]]
+        queued = {()}
+        while frontier and runs < budget:
+            prefix = frontier.pop()
+            key = tuple(prefix)
+            trace = cache.get(key)
+            if trace is None:
+                trace, violation = one(choices=prefix)
+                if violation is not None:
+                    return ExploreResult(name, runs, violation, len(cache))
+                cache[key] = trace
+            # Expand alternatives at and beyond the prefix; the recorded
+            # options at each decision tell us the branching factor.
+            for i in range(len(prefix), len(trace.decisions)):
+                d = trace.decisions[i]
+                for alt in range(len(d.options)):
+                    if alt == d.chosen % len(d.options):
+                        continue
+                    branch = tuple(
+                        [x.chosen for x in trace.decisions[:i]] + [alt]
+                    )
+                    if _prefix_preemptions(trace, i + 1, alt) > bound:
+                        continue
+                    # Queue even cached branches: they won't re-run, but
+                    # their recorded traces must be re-expanded under the
+                    # current (higher) preemption bound.
+                    if branch not in queued:
+                        queued.add(branch)
+                        frontier.append(list(branch))
+
+    for j in range(random_runs):
+        if runs >= budget:
+            break
+        trace, violation = one(rng=random.Random(seed + j))
+        if violation is not None:
+            return ExploreResult(name, runs, violation, len(cache))
+
+    return ExploreResult(name, runs, None, len(cache))
+
+
+def replay(
+    scenario: Scenario, token: str, stall_timeout: float = 0.75
+) -> Optional[ScheduleViolation]:
+    """Re-runs ``scenario`` under the schedule encoded in ``token``.
+    Returns the violation it reproduces, or None if the run passes."""
+    choices = decode_token(token)
+    trace, error = run_schedule(
+        scenario, choices=choices, stall_timeout=stall_timeout
+    )
+    if error is None:
+        return None
+    return _violation_from(trace, error)
+
+
+def explore_defaults() -> Dict[str, int]:
+    """The TPUFT_EXPLORE_* env knobs with defaults (doctor probes these)."""
+
+    def _int(env: str, default: int) -> int:
+        raw = os.environ.get(env, "")
+        try:
+            return int(raw) if raw else default
+        except ValueError:
+            return default
+
+    return {
+        "budget": _int("TPUFT_EXPLORE_BUDGET", 64),
+        "seed": _int("TPUFT_EXPLORE_SEED", 0),
+        "preemptions": _int("TPUFT_EXPLORE_PREEMPTIONS", 2),
+        "random": _int("TPUFT_EXPLORE_RANDOM", 8),
+    }
