@@ -34,6 +34,14 @@ stepping and the punisher kills things. Four legs:
   moving strictly fewer bytes than a full refetch
   (``tpuft_history_delta_chain_hops_total`` +
   ``tpuft_serving_delta_bytes_saved_total``).
+- ``canary``: progressive delivery under churn — mixed
+  stable/canary/pinned/shadow/percent-cohort tenants read through their
+  rollout-policy views while canary waves publish, auto-promote, ride
+  out a transient bad evidence window (the blip: ZERO auto-retractions),
+  and a punisher-armed ``poison_canary`` wave is auto-retracted by the
+  verdict loop — fleet-wide counter-exact via the ``tpuft_rollout_*``
+  family, with ZERO wrong-version adoptions (a stable or pinned reader
+  never holds a canary-wave or retracted version).
 
 Pure Python; runs in the toolchain-less container.
 
@@ -633,6 +641,195 @@ def leg_delta_chain(args) -> Dict:
         pub.shutdown(wait=False)
 
 
+def leg_canary(args, fault_file: str) -> Dict:
+    """Progressive delivery under churn: stable/canary/pinned/shadow/
+    percent-cohort tenants poll through their policy views while canary
+    waves publish and the verdict loop runs (the same tick the manager's
+    step boundary drives). A healthy wave auto-promotes; one transient
+    bad evidence window (the blip) must NOT retract; the punisher-armed
+    poisoned wave must auto-retract — counter-exact, zero wrong-version
+    adoptions."""
+    import os
+
+    from torchft_tpu import punisher
+    from torchft_tpu.serving import rollout
+
+    env = {
+        "TPUFT_SERVING_TENANT_TOKENS": (
+            "tok-stable:team-stable,tok-canary:team-canary,"
+            "tok-pin:team-pin,tok-shadow:team-shadow,tok-cohort:team-cohort"
+        ),
+        rollout.ENV_POLICY: (
+            "team-stable:stable,team-canary:canary,"
+            "team-pin:pin@2,team-shadow:shadow"
+        ),
+        rollout.ENV_CANARY_PERCENT: "25",
+    }
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    pub = WeightPublisher(num_chunks=args.chunks, timeout=5.0, keep_versions=8)
+    relay = CachingRelay([pub.address()], poll_interval=0.02, timeout=5.0)
+    director = rollout.RolloutDirector(
+        pub,
+        evaluator=rollout.RolloutEvaluator(consecutive=2, min_samples=1),
+        mode="actuate",
+    )
+    stop = threading.Event()
+    observations: Dict[str, List] = {}
+    lock = threading.Lock()
+
+    def reader(name: str, endpoints: List[str], token: str, pin=None) -> None:
+        sub = WeightSubscriber(
+            endpoints, timeout=5.0, token=token, pin=pin, notify=False
+        )
+        while not stop.is_set():
+            v = sub.poll()
+            if v is None:
+                time.sleep(0.01)
+                continue
+            clean = bool(np.all(np.asarray(v.params["w0"]) == float(v.step)))
+            with lock:
+                observations.setdefault(name, []).append((v.step, clean))
+
+    names = {
+        "rollout_retractions": "tpuft_rollout_retractions_total",
+        "promotions": "tpuft_rollout_promotions_total",
+        "poisoned": "tpuft_rollout_poisoned_publishes_total",
+        "shadow_reads": "tpuft_rollout_shadow_reads_total",
+        "shadow_failures": "tpuft_rollout_shadow_failures_total",
+        "refused": "tpuft_rollout_verdicts_refused_total",
+    }
+    before = {k: counter(n) for k, n in names.items()}
+    retract_verdicts0 = counter_labeled(
+        "tpuft_rollout_verdicts_total", action="retract"
+    )
+    threads = [
+        threading.Thread(
+            target=reader,
+            args=("stable", [relay.address(), pub.address()], "tok-stable"),
+        ),
+        threading.Thread(target=reader, args=("canary", [pub.address()], "tok-canary")),
+        threading.Thread(target=reader, args=("shadow", [relay.address()], "tok-shadow")),
+        threading.Thread(
+            target=reader, args=("pin", [pub.address()], "tok-pin"), kwargs={"pin": 2}
+        ),
+        threading.Thread(target=reader, args=("cohort", [pub.address()], "tok-cohort")),
+    ]
+    try:
+        for t in threads:
+            t.start()
+
+        def publish_and_tick(step: int) -> None:
+            pub.publish(
+                step=step, quorum_id=0,
+                state=state_for(step, args.leaves, args.leaf_kb),
+            )
+            director.tick()
+            time.sleep(args.bump_interval)
+
+        # Phase A — a healthy wave auto-promotes after K=2 windows.
+        publish_and_tick(1)
+        publish_and_tick(2)
+        promoted_healthy = counter(names["promotions"]) - before["promotions"]
+
+        # Phase B — the blip: one transient bad evidence window mid-wave
+        # (fed through the external-evidence seam fleets scraping
+        # counters centrally use), then healthy windows. Hysteresis must
+        # ride it out: ZERO auto-retractions.
+        blip_retract0 = counter(names["rollout_retractions"])
+        publish_and_tick(3)
+        director.evaluator.observe_window(canary_reads=4, canary_failures=4)
+        publish_and_tick(4)
+        director.tick()  # second healthy window -> the wave promotes
+        blip_retractions = int(counter(names["rollout_retractions"]) - blip_retract0)
+
+        # Phase C — the armed bad-canary drill: the poisoned wave (a
+        # younger healthy canary joins it) is auto-retracted fleet-wide
+        # and the canary hold stops the wave re-shipping itself.
+        punisher.arm_stream_fault("poison_canary", fault_file)
+        publish_and_tick(5)  # poisoned canary: bad window 1
+        publish_and_tick(6)  # healthy canary joins the suspect wave: bad 2 -> retract
+        retracted = [s for s in range(1, 9) if pub.is_retracted(s)]
+        # Post-retraction churn publishes STABLE (the hold).
+        publish_and_tick(7)
+        publish_and_tick(8)
+        survivor = pub.latest()["step"]
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            with lock:
+                stable_steps = {s for s, _ in observations.get("stable", ())}
+            if survivor in stable_steps:
+                break
+            time.sleep(0.05)
+        stop.set()
+        for t in threads:
+            t.join(timeout=15)
+        after = {k: counter(n) for k, n in names.items()}
+        delta = {k: int(after[k] - before[k]) for k in names}
+        torn = [
+            (name, s)
+            for name, obs in observations.items()
+            for s, clean in obs
+            if not clean
+        ]
+        stable_held = {s for s, _ in observations.get("stable", ())}
+        pin_held = {s for s, _ in observations.get("pin", ())}
+        wrong = (
+            sorted(stable_held & set(retracted))
+            + sorted(pin_held - {2})
+            + torn
+        )
+        assert not wrong, wrong[:5]
+        assert blip_retractions == 0, "a transient blip auto-retracted"
+        assert delta["rollout_retractions"] == 1 and delta["poisoned"] == 1
+        assert survivor not in retracted
+        cohort_in = rollout.in_canary_cohort("team-cohort", 25.0)
+        return {
+            "tenants": {
+                "stable": "policy stable",
+                "canary": "policy canary",
+                "pin": "policy pin@2",
+                "shadow": "policy shadow (served stable, teed to canary)",
+                "cohort": (
+                    f"25% percent cohort -> bucket "
+                    f"{rollout.cohort_bucket('team-cohort')} -> "
+                    + ("canary" if cohort_in else "stable")
+                ),
+            },
+            "versions_published": 8,
+            "retracted_versions": retracted,
+            "survivor_version": survivor,
+            "healthy_wave_promotions": int(promoted_healthy),
+            "blip_auto_retractions": blip_retractions,
+            "promotions_counter": delta["promotions"],
+            "auto_retractions_counter": delta["rollout_retractions"],
+            "retract_verdicts_counter": int(
+                counter_labeled(
+                    "tpuft_rollout_verdicts_total", action="retract"
+                )
+                - retract_verdicts0
+            ),
+            "poisoned_publishes_counter": delta["poisoned"],
+            "shadow_reads_counter": delta["shadow_reads"],
+            "shadow_failures_counter": delta["shadow_failures"],
+            "verdicts_refused_counter": delta["refused"],
+            "adoptions": {
+                name: len(obs) for name, obs in sorted(observations.items())
+            },
+            "wrong_version_adoptions": 0,
+            "torn_reads": 0,
+        }
+    finally:
+        stop.set()
+        relay.shutdown(wait=False)
+        pub.shutdown(wait=False)
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 _READER_DRIVER = r"""
 import json, os, sys, time
 sys.path.insert(0, {repo!r})
@@ -850,6 +1047,7 @@ def main() -> None:
         "delta": leg_delta(args),
         "pinned": leg_pinned(args),
         "rollback": leg_rollback(args, fault_file),
+        "canary": leg_canary(args, fault_file),
         "delta_chain": leg_delta_chain(args),
         "chaos": leg_chaos(args, fault_file),
         "publish_stall": leg_publish_stall(args),

@@ -16,7 +16,10 @@ the gray-failure verdict/quarantine state plus any advisory straggler
 accusation (the HEALTH column — ``tpuft_health_*`` gauges),
 the rolling goodput fraction + top badput cause from each replica's
 pushed ledger payload (the GOODPUT column — torchft_tpu/goodput.py;
-"!" = a latched SLO breach), heartbeat age. The LAG column derives
+"!" = a latched SLO breach), the progressive-delivery verdict loop's
+state + live canary step (the ROLLOUT column — ``tpuft_rollout_*``
+gauges; "!" = verdicts suppressed in alerting-only mode), heartbeat
+age. The LAG column derives
 straggler attribution from the trace plane's pushed per-step phase
 durations (``trace/<replica_id>/<rank>``): at the latest shared step, the
 rank that waited least in the commit barrier entered it last — its lag is
@@ -286,6 +289,32 @@ def _goodput_state(snapshot: Dict[str, Any]) -> Optional[str]:
     return cell
 
 
+def _rollout_state(snapshot: Dict[str, Any]) -> Optional[str]:
+    """Progressive-delivery verdict-loop state from the pushed
+    ``tpuft_rollout_*`` gauges (serving/rollout.py STATE_CODES): the
+    state name, ``@s<step>`` when a canary wave is live, ``/r<n>`` after
+    n auto-retractions, and ``!`` when verdicts were reached but
+    suppressed (`TPUFT_ROLLOUT_MODE=alert` — the alerting-only mode).
+    None when the replica runs no rollout director. A row stuck at
+    "suspect" is a bad streak that has not yet met the K-window
+    hysteresis; "retracted" means the canary hold is on and new waves
+    wait for an operator resume."""
+    state = _gauge(snapshot, "tpuft_rollout_state")
+    if state is None:
+        return None
+    names = {0: "idle", 1: "watch", 2: "suspect", 3: "retracted", 4: "promoted"}
+    cell = names.get(int(state), "?")
+    step = _gauge(snapshot, "tpuft_rollout_canary_step")
+    if step is not None and step >= 0:
+        cell += f"@s{int(step)}"
+    retractions = _counter_total(snapshot, "tpuft_rollout_retractions_total")
+    if retractions:
+        cell += f"/r{int(retractions)}"
+    if _counter_total(snapshot, "tpuft_rollout_alert_suppressed_total"):
+        cell += "!"
+    return cell
+
+
 def _publish_state(snapshot: Dict[str, Any], now: float) -> Optional[str]:
     """Serving-plane publication state from the pushed gauges: the last
     published step and how stale it is ("s12@3s"), or None when the
@@ -358,6 +387,7 @@ def collect(lighthouse_addr: str, prev: Optional[Dict[str, Any]] = None) -> Dict
                     shard=_shard_state(snap),
                     wire=_wire_state(snap),
                     publish=_publish_state(snap, now),
+                    rollout=_rollout_state(snap),
                     hist=_history_state(snap),
                     relay=_relay_state(snap),
                     push_age_s=round(now - snap["ts"], 1) if "ts" in snap else None,
@@ -405,6 +435,7 @@ _COLUMNS = (
     ("shard", "SHARD"),
     ("wire", "WIRE"),
     ("publish", "PUBLISH"),
+    ("rollout", "ROLLOUT"),
     ("hist", "HIST"),
     ("relay", "RELAY"),
     ("lag_s", "LAG"),

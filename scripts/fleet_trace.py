@@ -794,6 +794,55 @@ def explain_step(merged: List[Dict[str, Any]], step: int) -> str:
             f"version RETRACTED: {proc_label(proc_key(e))} withdrew published "
             f"step {e.get('step')} from the history ring{tail}"
         )
+    # Progressive delivery: canary promotions/retractions (the rollout
+    # verdict loop's actuations), suppressed alerting-only verdicts, and
+    # shadow-tenant divergence probes at this step.
+    for e in at_step:
+        if e["name"] != "canary_promoted":
+            continue
+        lines.append(
+            f"canary PROMOTED: {proc_label(proc_key(e))} flipped canary wave "
+            f"step {e.get('step')} to the stable stream (same bytes, "
+            "seq-newer re-announce — stable tenants converge with zero "
+            "chunk traffic)"
+        )
+    for e in at_step:
+        if e["name"] != "canary_retracted":
+            continue
+        args = e.get("args") or {}
+        lines.append(
+            f"canary RETRACTED: {proc_label(proc_key(e))} auto-retracted "
+            f"canary wave step {e.get('step')} after "
+            f"{args.get('bad_streak', '?')} consecutive bad evidence windows "
+            f"(canary failure rate {args.get('canary_rate', '?')}) — stable "
+            "tenants never observed it; new waves hold for an operator"
+        )
+    for e in at_step:
+        if e["name"] != "rollout_alert":
+            continue
+        args = e.get("args") or {}
+        lines.append(
+            f"rollout ALERT: {proc_label(proc_key(e))} reached a "
+            f"{args.get('action', '?')} verdict for canary step "
+            f"{e.get('step')} but TPUFT_ROLLOUT_MODE=alert suppressed the "
+            "actuation (alerting-only; the publisher was not touched)"
+        )
+    for e in at_step:
+        if e["name"] != "shadow_divergence":
+            continue
+        args = e.get("args") or {}
+        divergence = args.get("divergence")
+        frac = (
+            f"{float(divergence) * 100:.0f}% of chunk CRCs differ"
+            if divergence is not None and float(divergence) >= 0
+            else "divergence unknown"
+        )
+        lines.append(
+            f"shadow probe: {proc_label(proc_key(e))} teed a shadow tenant's "
+            f"read to canary step {e.get('step')} (vs stable step "
+            f"{args.get('stable_step', '?')}): verified through the full "
+            f"pipeline, {frac} — observed, never served"
+        )
     fails = [e for e in at_step if e["name"] == "heal_attempt_failed"]
     for e in fails:
         args = e.get("args") or {}

@@ -3,7 +3,10 @@
 kill_donor_mid_heal / corrupt_stream / stall_donor + the serving-plane
 rollback storm retract_version — each group publishes every commit, so
 the arm is consumed by a real publication and the retraction/history
-path runs under the same chaos — + the GRAY-failure arms slow_replica /
+path runs under the same chaos — + the progressive-delivery arm
+poison_canary (an active rollout policy makes every publish a canary,
+so the poisoned-wave marker rides a real announce chain mid-soak) + the
+GRAY-failure arms slow_replica /
 wedge_device / drip_wire: the job runs with the health plane armed
 (TPUFT_HEALTH=1, fast verdict knobs), so a grayed group must self-eject
 at a step boundary, relaunch through the quarantine gate, and rejoin —
@@ -263,6 +266,17 @@ def test_chaos_soak_full_fault_menu(tmp_path) -> None:
                 "TPUFT_QUARANTINE_CAP_SEC": "1",
                 "TPUFT_QUARANTINE_WINDOW_SEC": "30",
                 "TPUFT_QUARANTINE_PARK_SEC": "2",
+                # Progressive delivery armed: with an active rollout
+                # policy every publish ships as a canary, so the
+                # punisher's poison_canary arm (site publisher_canary)
+                # is actually consumable mid-soak — the poisoned
+                # descriptor rides the announce chain under the full
+                # fault menu while stable tenants keep the pre-canary
+                # view. The verdict loop stays in alerting-only mode
+                # here: the soak asserts training invariants, not
+                # rollout actuation (tests/test_rollout.py owns that).
+                "TPUFT_ROLLOUT_POLICY": "*:stable",
+                "TPUFT_ROLLOUT_MODE": "alert",
             },
         )
     finally:

@@ -613,7 +613,8 @@ def test_fleet_status_render_and_extractors() -> None:
     assert "quorum_id=3" in lines[0] and "replicas=2" in lines[0]
     assert lines[1].split() == [
         "REPLICA", "RANK", "REGION", "STEP", "STEP/S", "COMMITS", "FAILED", "HEALS",
-        "SERVE", "HEALTH", "GOODPUT", "SHARD", "WIRE", "PUBLISH", "HIST", "RELAY",
+        "SERVE", "HEALTH", "GOODPUT", "SHARD", "WIRE", "PUBLISH", "ROLLOUT",
+        "HIST", "RELAY",
         "LAG", "LAST", "COMMIT", "HEALING", "JOINERS", "HB", "AGE", "MS",
         "PUSH", "AGE",
     ]

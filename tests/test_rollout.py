@@ -1,0 +1,814 @@
+"""Progressive-delivery plane drills (pure Python — carries tier-1 in a
+container without the native toolchain):
+
+- cohort purity: sha256 percent cohorts are a pure function of the
+  tenant name — bitwise identical across processes (a subprocess
+  re-derives every bucket), with EXACT percent boundaries;
+- policy table: malformed entries degrade one entry, never the table
+  (the serving_tenant_tokens discipline); precedence is explicit >
+  ``*`` default > percent cohort > stable; shadow tenants are SERVED
+  stable;
+- wrong-stream refusal at every seam: the publisher announce (403 +
+  seam="announce"), the relay (seam="relay"), and the reader's own
+  client-side fence (seam="reader") — a misrouted canary descriptor is
+  refused before the verification pipeline starts; tokenless chunk
+  fetches (heal plane, relay-tree pulls) are never gated;
+- shadow reads: the relay tees a shadow tenant's fetch to the resident
+  canary, verifies the full integrity pipeline and reports divergence /
+  failure counters WITHOUT serving it — a poisoned canary is evidence,
+  never an error on the stable path;
+- the verdict loop: RolloutEvaluator hysteresis is unit-pinned (the
+  HealthScorer discipline — K consecutive windows past a multiplicative
+  threshold AND an absolute gap floor; refusal on insufficient
+  evidence; a transient blip can never retract), and RolloutDirector
+  actuates at exactly one seam — auto-promotion after K healthy
+  windows, auto-retraction (+ canary hold) on a poisoned wave,
+  alerting-only suppression;
+- the flagship churn drill in strict AND pipelined depth-2 orderings:
+  a training manager publishes canary waves under an active policy
+  while stable/canary/pinned readers poll; a punisher-armed
+  poison_canary fires mid-run and the verdict loop auto-retracts the
+  wave — stable readers never observe a canary or retracted version;
+- observability goldens: the fleet_status ROLLOUT column and the
+  fleet_trace --explain-step canary lines.
+"""
+
+import importlib.util
+import json
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from test_ddp import scripted_manager
+from test_serving import assert_version_is, state_for
+
+from torchft_tpu import metrics, punisher
+from torchft_tpu.optim import Optimizer
+from torchft_tpu.serving import CachingRelay, WeightPublisher, WeightSubscriber
+from torchft_tpu.serving import rollout
+from torchft_tpu.utils import faultinject
+
+TOKENS = (
+    "tok-stable:team-stable,tok-canary:team-canary,"
+    "tok-shadow:team-shadow,tok-pin:team-pin"
+)
+
+_ROLLOUT_COUNTERS = {
+    "shadow_reads": "tpuft_rollout_shadow_reads_total",
+    "shadow_failures": "tpuft_rollout_shadow_failures_total",
+    "refused": "tpuft_rollout_verdicts_refused_total",
+    "retractions": "tpuft_rollout_retractions_total",
+    "promotions": "tpuft_rollout_promotions_total",
+    "suppressed": "tpuft_rollout_alert_suppressed_total",
+    "poisoned": "tpuft_rollout_poisoned_publishes_total",
+    "auth_rejects": "tpuft_serving_auth_rejects_total",
+}
+
+
+def rollout_counters() -> dict:
+    out = {k: metrics.counter_total(n) for k, n in _ROLLOUT_COUNTERS.items()}
+    for seam in ("announce", "relay", "transport", "child", "reader"):
+        out[f"wrong_{seam}"] = metrics.counter_total(
+            "tpuft_rollout_wrong_stream_rejects_total", seam=seam
+        )
+    for action in ("retract", "promote"):
+        out[f"verdict_{action}"] = metrics.counter_total(
+            "tpuft_rollout_verdicts_total", action=action
+        )
+    return out
+
+
+def wait_rollout_counters(predicate, deadline_s: float = 10.0) -> dict:
+    """Gate on OBSERVED counters, never a sleep: the shadow tee runs on
+    the relay handler thread strictly AFTER the stable response is on
+    the wire, so its counters can land a beat after the client's poll
+    returns."""
+    deadline = time.monotonic() + deadline_s
+    while True:
+        counters = rollout_counters()
+        if predicate(counters) or time.monotonic() >= deadline:
+            return counters
+        time.sleep(0.01)
+
+
+def _loss_fn(p, b):
+    return jnp.sum((p["w"] - b) ** 2)
+
+
+def _get(url: str, token: str = None):
+    req = urllib.request.Request(url)
+    if token is not None:
+        req.add_header("Authorization", f"Bearer {token}")
+    return urllib.request.urlopen(req, timeout=5)
+
+
+def _http_status(url: str, token: str = None) -> int:
+    try:
+        with _get(url, token) as resp:
+            return resp.status
+    except urllib.error.HTTPError as e:
+        return e.code
+
+
+# ---------------------------------------------------------------------------
+# cohorts: a pure function of the tenant name
+# ---------------------------------------------------------------------------
+
+
+def test_cohort_bucket_deterministic_cross_process() -> None:
+    """Same tenant -> same cohort bucket in THIS process and in a fresh
+    subprocess that file-loads rollout.py (no package import, no shared
+    state): cohort membership is never negotiated, exactly the
+    zero.shard_assignment discipline applied to readers."""
+    tenants = ["team-a", "team-b", "default", "x" * 64, "Ünïcode-tenant"]
+    local = {t: rollout.cohort_bucket(t) for t in tenants}
+    assert all(0 <= b < 10000 for b in local.values())
+    # Stable within the process.
+    assert local == {t: rollout.cohort_bucket(t) for t in tenants}
+    # Tokenless pools under "default".
+    assert rollout.cohort_bucket(None) == rollout.cohort_bucket("default")
+    path = (
+        Path(__file__).resolve().parent.parent
+        / "torchft_tpu"
+        / "serving"
+        / "rollout.py"
+    )
+    code = (
+        "import importlib.util, json, sys\n"
+        "spec = importlib.util.spec_from_file_location('tpuft_rollout', sys.argv[1])\n"
+        "mod = importlib.util.module_from_spec(spec)\n"
+        "spec.loader.exec_module(mod)\n"
+        "print(json.dumps({t: mod.cohort_bucket(t) for t in json.loads(sys.argv[2])}))\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code, str(path), json.dumps(tenants)],
+        capture_output=True,
+        text=True,
+        timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert json.loads(proc.stdout) == local
+
+
+def test_cohort_percent_boundary_exact() -> None:
+    """The percent boundary is exact: a tenant in bucket b joins the
+    cohort at percent (b+1)/100 and not at b/100 — no float drift at
+    the edge; 0% admits nobody, 100% everybody."""
+    for tenant in ("team-a", "team-b", "edge-case", "default"):
+        b = rollout.cohort_bucket(tenant)
+        assert not rollout.in_canary_cohort(tenant, b / 100.0)
+        assert rollout.in_canary_cohort(tenant, (b + 1) / 100.0)
+        assert not rollout.in_canary_cohort(tenant, 0.0)
+        assert rollout.in_canary_cohort(tenant, 100.0)
+    # The documented example: 12.34% admits buckets [0, 1234).
+    assert rollout.in_canary_cohort("t", 12.34) == (
+        rollout.cohort_bucket("t") < 1234
+    )
+
+
+def test_parse_policy_skips_malformed_entries() -> None:
+    entries, errors = rollout.parse_policy(
+        "a:stable, junk ,b:pin@7,c:bogus,d:canary,e:shadow,:stable,f:"
+    )
+    assert entries == {
+        "a": "stable",
+        "b": "pin@7",
+        "d": "canary",
+        "e": "shadow",
+    }
+    assert len(errors) == 4  # junk, c:bogus, :stable, f:
+    assert rollout.parse_pin("pin@7") == 7
+    assert rollout.parse_pin("pin@x") is None
+    assert rollout.parse_pin("stable") is None
+
+
+def test_policy_precedence_and_shadow_resolves_stable() -> None:
+    policy = rollout.RolloutPolicy(
+        entries={"a": "canary", "s": "shadow", "*": "pin@3"},
+        percent=100.0,
+        shadows=frozenset({"teed"}),
+    )
+    assert policy.active()
+    # Explicit entry beats the * default and the percent cohort.
+    assert policy.resolve("a") == rollout.STREAM_CANARY
+    # Shadow tenants are SERVED stable (tee is relay-side, never bytes).
+    assert policy.resolve("s") == rollout.STREAM_STABLE
+    assert policy.is_shadow("s") and policy.is_shadow("teed")
+    # * default beats the percent cohort for unlisted tenants.
+    assert policy.resolve("unlisted") == "pin@3"
+    # Percent cohort is the fallback with no entry at all.
+    cohort_only = rollout.RolloutPolicy(percent=100.0)
+    assert cohort_only.resolve("anyone") == rollout.STREAM_CANARY
+    assert rollout.RolloutPolicy(percent=0.0).resolve("anyone") == (
+        rollout.STREAM_STABLE
+    )
+    assert not rollout.RolloutPolicy().active()
+
+
+def test_resolve_view_semantics() -> None:
+    inactive = rollout.RolloutPolicy()
+    # Inactive plane: every request resolves to the full view — the
+    # exact pre-rollout wire.
+    assert rollout.resolve_view("anyone", None, inactive) == rollout.VIEW_ALL
+    assert rollout.resolve_view(None, "canary", inactive) == rollout.VIEW_ALL
+    policy = rollout.RolloutPolicy(
+        entries={"a": "stable", "b": "canary", "p": "pin@5"}
+    )
+    # Tokenless infra pulls requesting the full view are never gated.
+    assert (
+        rollout.resolve_view(None, rollout.VIEW_ALL, policy) == rollout.VIEW_ALL
+    )
+    assert rollout.resolve_view("a", None, policy) == rollout.STREAM_STABLE
+    with pytest.raises(rollout.WrongStreamError):
+        rollout.resolve_view("a", "canary", policy)
+    with pytest.raises(rollout.WrongStreamError):
+        rollout.resolve_view("a", rollout.VIEW_ALL, policy)
+    # Canary tenants may read any view (latest-1 baseline comparisons).
+    assert rollout.resolve_view("b", None, policy) == rollout.STREAM_CANARY
+    assert rollout.resolve_view("b", "stable", policy) == rollout.STREAM_STABLE
+    assert rollout.resolve_view("p", None, policy) == "pin@5"
+    with pytest.raises(rollout.WrongStreamError):
+        rollout.resolve_view("p", "stable", policy)
+
+
+def test_wrong_stream_chunk_reason_tokenless_never_gated() -> None:
+    policy = rollout.RolloutPolicy(entries={"a": "stable", "p": "pin@5"})
+    # Tokenless = the heal plane and relay-tree pulls: never gated.
+    assert (
+        rollout.wrong_stream_chunk_reason(
+            None, 9, rollout.STREAM_CANARY, policy
+        )
+        is None
+    )
+    assert rollout.wrong_stream_chunk_reason(
+        "a", 9, rollout.STREAM_CANARY, policy
+    )
+    assert (
+        rollout.wrong_stream_chunk_reason("a", 9, rollout.STREAM_STABLE, policy)
+        is None
+    )
+    assert rollout.wrong_stream_chunk_reason("p", 9, None, policy)
+    assert rollout.wrong_stream_chunk_reason("p", 5, None, policy) is None
+
+
+# ---------------------------------------------------------------------------
+# evaluator: unit-pinned hysteresis
+# ---------------------------------------------------------------------------
+
+
+def test_evaluator_refuses_insufficient_evidence() -> None:
+    ev = rollout.RolloutEvaluator(
+        threshold=3.0, consecutive=2, min_samples=3, min_gap=0.05
+    )
+    before = rollout_counters()["refused"]
+    verdict = ev.observe_window(canary_reads=2, canary_failures=2)
+    assert verdict["judgeable"] is False and verdict["action"] is None
+    assert ev.refusals == 1
+    assert rollout_counters()["refused"] - before == 1
+    # Streaks do not advance on evidence that is not there.
+    assert ev.bad_streak == 0 and ev.good_streak == 0
+
+
+def test_evaluator_blip_never_retracts() -> None:
+    ev = rollout.RolloutEvaluator(
+        threshold=3.0, consecutive=2, min_samples=1, min_gap=0.05
+    )
+    assert ev.observe_window(4, 4)["bad"] is True
+    assert ev.bad_streak == 1
+    # One healthy window resets the streak: a transient blip can never
+    # reach the K-window latch.
+    verdict = ev.observe_window(4, 0)
+    assert verdict["bad"] is False and verdict["action"] is None
+    assert ev.bad_streak == 0 and ev.good_streak == 1
+    assert ev.observe_window(4, 4)["action"] is None  # bad_streak back to 1
+
+
+def test_evaluator_requires_threshold_and_gap() -> None:
+    ev = rollout.RolloutEvaluator(
+        threshold=3.0, consecutive=1, min_samples=1, min_gap=0.05
+    )
+    # Multiplicative bound cleared, absolute gap NOT: 3x a per-mille
+    # noise rate is not a verdict.
+    v = ev.observe_window(100, 4)  # canary 4%, stable 0% -> gap 0.04 < 0.05
+    assert v["bad"] is False
+    # Gap cleared, multiplicative NOT: a uniformly failing fleet never
+    # blames its canary.
+    v = ev.observe_window(10, 5, stable_reads=10, stable_failures=4)
+    assert v["bad"] is False
+    # Both cleared -> bad, and consecutive=1 latches immediately.
+    v = ev.observe_window(10, 5, stable_reads=10, stable_failures=0)
+    assert v["bad"] is True and v["action"] == "retract"
+
+
+def test_evaluator_k_windows_latch_both_verdicts() -> None:
+    ev = rollout.RolloutEvaluator(
+        threshold=3.0, consecutive=3, min_samples=1, min_gap=0.05
+    )
+    assert ev.observe_window(4, 4)["action"] is None
+    assert ev.observe_window(4, 4)["action"] is None
+    assert ev.observe_window(4, 4)["action"] == "retract"
+    ev.reset()
+    assert ev.observe_window(4, 0)["action"] is None
+    assert ev.observe_window(4, 0)["action"] is None
+    assert ev.observe_window(4, 0)["action"] == "promote"
+
+
+# ---------------------------------------------------------------------------
+# director: promote, poisoned retract + hold, alerting-only
+# ---------------------------------------------------------------------------
+
+
+def test_director_lifecycle_promote_then_poisoned_wave_retracts(
+    tmp_path, monkeypatch
+) -> None:
+    """The full deterministic lifecycle against a real publisher: a
+    healthy wave auto-promotes after K windows; the punisher-armed
+    poisoned wave auto-retracts (the whole wave, younger healthy canary
+    included), sets the canary hold, and readers converge to the
+    surviving stable version."""
+    fault_file = tmp_path / "fault"
+    monkeypatch.setenv(faultinject.ENV_FAULT_FILE, str(fault_file))
+    monkeypatch.setenv(rollout.ENV_POLICY, "*:stable")
+    pub = WeightPublisher(num_chunks=2, timeout=5.0, keep_versions=8)
+    director = rollout.RolloutDirector(
+        pub,
+        evaluator=rollout.RolloutEvaluator(consecutive=2, min_samples=1),
+        mode="actuate",
+    )
+    try:
+        before = rollout_counters()
+        # Healthy wave: publishes under an active policy ship canary.
+        pub.publish(step=1, quorum_id=0, state=state_for(1))
+        assert pub.stream_of(1) == rollout.STREAM_CANARY
+        assert director.tick()["judgeable"]
+        assert director.state == "watch"
+        pub.publish(step=2, quorum_id=0, state=state_for(2))
+        # The second canary JOINS the wave (oldest step = the wave
+        # identity) — it must not reset the evidence streak.
+        director.tick()
+        assert director.state == "promoted"
+        assert pub.stream_of(1) == rollout.STREAM_STABLE
+        assert pub.stream_of(2) == rollout.STREAM_STABLE
+        assert rollout_counters()["promotions"] - before["promotions"] == 1
+        assert (
+            rollout_counters()["verdict_promote"] - before["verdict_promote"]
+            == 1
+        )
+
+        # Poisoned wave: CRC-valid bytes, bad-quality marker — only the
+        # verdict loop reacts, the integrity chain stays green.
+        assert punisher.arm_stream_fault("poison_canary", str(fault_file))
+        pub.publish(step=3, quorum_id=0, state=state_for(3))
+        assert rollout_counters()["poisoned"] - before["poisoned"] == 1
+        assert pub.version_descriptor(3).get("poisoned")
+        director.tick()
+        assert director.state == "suspect"
+        # A younger HEALTHY canary joins the suspect wave; the poisoned
+        # member stays visible to the probe (whole-wave self-probe).
+        pub.publish(step=4, quorum_id=0, state=state_for(4))
+        director.tick()
+        assert director.state == "retracted"
+        assert pub.is_retracted(3) and pub.is_retracted(4)
+        assert rollout_counters()["retractions"] - before["retractions"] == 1
+        assert (
+            rollout_counters()["verdict_retract"] - before["verdict_retract"]
+            == 1
+        )
+        assert pub.latest()["step"] == 2
+        assert metrics.gauge_value("tpuft_rollout_state") == (
+            rollout.STATE_CODES["retracted"]
+        )
+        # The hold: the failed wave must not immediately re-ship itself.
+        pub.publish(step=5, quorum_id=0, state=state_for(5))
+        assert pub.stream_of(5) == rollout.STREAM_STABLE
+        # A stable (tokenless -> default tenant) reader converges to the
+        # surviving stream and never held a retracted version.
+        sub = WeightSubscriber([pub.address()], timeout=5.0, notify=False)
+        assert_version_is(sub.poll(), 5)
+    finally:
+        pub.shutdown()
+
+
+def test_director_alert_mode_suppresses_actuation(tmp_path, monkeypatch) -> None:
+    fault_file = tmp_path / "fault"
+    monkeypatch.setenv(faultinject.ENV_FAULT_FILE, str(fault_file))
+    monkeypatch.setenv(rollout.ENV_POLICY, "*:stable")
+    pub = WeightPublisher(num_chunks=2, timeout=5.0)
+    director = rollout.RolloutDirector(
+        pub,
+        evaluator=rollout.RolloutEvaluator(consecutive=2, min_samples=1),
+        mode="alert",
+    )
+    try:
+        before = rollout_counters()
+        assert punisher.arm_stream_fault("poison_canary", str(fault_file))
+        pub.publish(step=1, quorum_id=0, state=state_for(1))
+        director.tick()
+        director.tick()  # bad streak 2 -> verdict latches, actuation suppressed
+        after = rollout_counters()
+        assert after["suppressed"] - before["suppressed"] == 1
+        assert after["verdict_retract"] - before["verdict_retract"] == 1
+        # The publisher was not touched: canary live, nothing retracted.
+        assert after["retractions"] - before["retractions"] == 0
+        assert not pub.is_retracted(1)
+        assert pub.canary_steps() == [1]
+    finally:
+        pub.shutdown()
+
+
+def test_director_refuses_on_starved_evidence(monkeypatch) -> None:
+    """min_samples above what a window can supply: every window is
+    REFUSED (counted), streaks never advance, nothing actuates."""
+    monkeypatch.setenv(rollout.ENV_POLICY, "*:stable")
+    pub = WeightPublisher(num_chunks=2, timeout=5.0)
+    director = rollout.RolloutDirector(
+        pub,
+        evaluator=rollout.RolloutEvaluator(consecutive=1, min_samples=50),
+        mode="actuate",
+    )
+    try:
+        before = rollout_counters()
+        pub.publish(step=1, quorum_id=0, state=state_for(1))
+        for _ in range(3):
+            verdict = director.tick()
+            assert verdict["judgeable"] is False and verdict["action"] is None
+        after = rollout_counters()
+        assert after["refused"] - before["refused"] == 3
+        assert after["retractions"] - before["retractions"] == 0
+        assert after["promotions"] - before["promotions"] == 0
+        assert pub.canary_steps() == [1]
+    finally:
+        pub.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# wrong-stream refusal at every seam
+# ---------------------------------------------------------------------------
+
+
+def test_announce_seam_refuses_wrong_stream(monkeypatch) -> None:
+    monkeypatch.setenv("TPUFT_SERVING_TENANT_TOKENS", TOKENS)
+    monkeypatch.setenv(
+        rollout.ENV_POLICY,
+        "team-stable:stable,team-canary:canary,team-pin:pin@1",
+    )
+    pub = WeightPublisher(num_chunks=2, timeout=5.0)
+    try:
+        pub.publish(step=1, quorum_id=0, state=state_for(1))
+        base = pub.address()
+        before = rollout_counters()
+        # A stable tenant requesting the canary (or full) view: 403.
+        assert _http_status(f"{base}/serving/latest?stream=canary", "tok-stable") == 403
+        assert _http_status(f"{base}/serving/latest?stream=all", "tok-stable") == 403
+        # A pinned tenant requesting any other stream: 403.
+        assert _http_status(f"{base}/serving/latest?stream=stable", "tok-pin") == 403
+        after = rollout_counters()
+        assert after["wrong_announce"] - before["wrong_announce"] == 3
+        # The PR-12 discipline: unknown tokens are 401, not 403.
+        assert _http_status(f"{base}/serving/latest", "tok-bogus") == 401
+        assert after["auth_rejects"] <= rollout_counters()["auth_rejects"]
+        # A canary tenant reads its own stream fine.
+        with _get(f"{base}/serving/latest?stream=canary", "tok-canary") as resp:
+            assert json.loads(resp.read())["step"] == 1
+    finally:
+        pub.shutdown()
+
+
+def test_relay_seam_refuses_wrong_stream_and_subscriber_surfaces(
+    monkeypatch,
+) -> None:
+    monkeypatch.setenv("TPUFT_SERVING_TENANT_TOKENS", TOKENS)
+    monkeypatch.setenv(
+        rollout.ENV_POLICY, "team-stable:stable,team-canary:canary"
+    )
+    pub = WeightPublisher(num_chunks=2, timeout=5.0)
+    relay = CachingRelay([pub.address()], timeout=5.0, start=False)
+    try:
+        pub.publish(step=1, quorum_id=0, state=state_for(1))  # canary wave
+        assert relay.poll_once() is True
+        before = rollout_counters()
+        # Direct 403 at the relay seam.
+        assert (
+            _http_status(
+                f"{relay.address()}/serving/latest?stream=canary", "tok-stable"
+            )
+            == 403
+        )
+        assert rollout_counters()["wrong_announce"] == before["wrong_announce"]
+        assert rollout_counters()["wrong_relay"] - before["wrong_relay"] == 1
+        # A stable-tenant subscriber asking for the canary stream: the
+        # 403 surfaces as a failed poll (None), never an adoption.
+        sub = WeightSubscriber(
+            [relay.address()],
+            timeout=5.0,
+            token="tok-stable",
+            stream="canary",
+            notify=False,
+        )
+        assert sub.poll() is None
+        assert sub.current() is None
+        assert rollout_counters()["wrong_relay"] - before["wrong_relay"] >= 2
+        # The same tenant on its OWN stream adopts fine.
+        ok = WeightSubscriber(
+            [relay.address()],
+            timeout=5.0,
+            token="tok-canary",
+            stream="canary",
+            notify=False,
+        )
+        assert_version_is(ok.poll(), 1)
+    finally:
+        relay.shutdown()
+        pub.shutdown()
+
+
+def test_reader_side_fence_refuses_misrouted_canary(monkeypatch) -> None:
+    """A stable-stream reader refuses a canary-tagged descriptor
+    CLIENT-side, before the verification pipeline starts — a misrouted
+    or compromised tier cannot push a canary onto a stable reader."""
+    monkeypatch.setenv(rollout.ENV_POLICY, "*:stable")
+    pub = WeightPublisher(num_chunks=2, timeout=5.0)
+    try:
+        descriptor = pub.publish(step=1, quorum_id=0, state=state_for(1))
+        assert descriptor.get("stream") == rollout.STREAM_CANARY
+        sub = WeightSubscriber(
+            [pub.address()], timeout=5.0, stream="stable", notify=False
+        )
+        before = rollout_counters()
+        assert sub._poll(latest=descriptor) is None
+        assert sub.current() is None
+        assert rollout_counters()["wrong_reader"] - before["wrong_reader"] == 1
+    finally:
+        pub.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# shadow reads: observed, never served
+# ---------------------------------------------------------------------------
+
+
+def test_shadow_tee_reports_divergence_and_isolates_failures(
+    tmp_path, monkeypatch
+) -> None:
+    fault_file = tmp_path / "fault"
+    monkeypatch.setenv(faultinject.ENV_FAULT_FILE, str(fault_file))
+    monkeypatch.setenv("TPUFT_SERVING_TENANT_TOKENS", TOKENS)
+    monkeypatch.setenv(
+        rollout.ENV_POLICY, "team-shadow:shadow,*:stable"
+    )
+    pub = WeightPublisher(num_chunks=4, timeout=5.0, keep_versions=8)
+    relay = CachingRelay([pub.address()], timeout=5.0, start=False)
+    try:
+        # A promoted stable baseline + a live canary with different bytes.
+        pub.publish(step=1, quorum_id=0, state=state_for(1))
+        pub.promote_version(1)
+        assert relay.poll_once() is True
+        pub.publish(step=2, quorum_id=0, state=state_for(2))
+        assert relay.poll_once() is True
+        before = rollout_counters()
+        sub = WeightSubscriber(
+            [relay.address()], timeout=5.0, token="tok-shadow", notify=False
+        )
+        # The shadow tenant is SERVED the stable version...
+        assert_version_is(sub.poll(), 1)
+        after = wait_rollout_counters(
+            lambda c: c["shadow_reads"] - before["shadow_reads"] >= 1
+        )
+        # ...while its fetch teed a verified canary observation: every
+        # chunk differs between step-1 and step-2 states.
+        assert after["shadow_reads"] - before["shadow_reads"] >= 1
+        assert after["shadow_failures"] == before["shadow_failures"]
+        assert metrics.gauge_value("tpuft_rollout_shadow_divergence") == 1.0
+
+        # A poisoned canary: the tee FAILS (counted evidence), the
+        # stable path is unharmed.
+        assert punisher.arm_stream_fault("poison_canary", str(fault_file))
+        pub.publish(step=3, quorum_id=0, state=state_for(3))
+        assert relay.poll_once() is True
+        mid = rollout_counters()
+        assert sub.poll() is None  # nothing new on the stable stream
+        assert sub.current().step == 1
+        after = wait_rollout_counters(
+            lambda c: c["shadow_reads"] - mid["shadow_reads"] >= 1
+            and c["shadow_failures"] - mid["shadow_failures"] >= 1
+        )
+        assert after["shadow_reads"] - mid["shadow_reads"] >= 1
+        assert after["shadow_failures"] - mid["shadow_failures"] >= 1
+    finally:
+        relay.shutdown()
+        pub.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# flagship: mixed pinned/canary/stable churn + auto-retraction
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("depth", [0, 2], ids=["strict", "pipelined2"])
+def test_progressive_delivery_churn_drill(depth, tmp_path, monkeypatch) -> None:
+    """The progressive-delivery chaos drill in strict AND pipelined
+    depth-2 orderings: a training manager publishes canary waves under
+    an active rollout policy while stable/canary/pinned readers poll; a
+    punisher-armed poison_canary fires mid-run and the verdict loop
+    auto-retracts the wave fleet-wide. Stable readers must never observe
+    a canary-stream or retracted version; the pinned reader never drifts
+    off its pin; every reader on a live stream converges to the
+    surviving stable version."""
+    fault_file = tmp_path / "fault"
+    monkeypatch.setenv(faultinject.ENV_FAULT_FILE, str(fault_file))
+    monkeypatch.setenv("TPUFT_SERVING_TENANT_TOKENS", TOKENS)
+    monkeypatch.setenv(
+        rollout.ENV_POLICY,
+        "team-stable:stable,team-canary:canary,team-pin:pin@2",
+    )
+    monkeypatch.setenv(rollout.ENV_WINDOWS, "2")
+    manager = scripted_manager(commit_pipeline_depth=depth)
+    pub = WeightPublisher(every=1, num_chunks=2, timeout=5.0, keep_versions=8)
+    director = rollout.RolloutDirector(pub, mode="actuate")
+    opt = Optimizer(
+        manager, optax.sgd(0.1), {"w": jnp.array([1.0, 1.0], jnp.float32)}
+    )
+    manager.attach_publisher(pub, lambda: {"params": opt.params})
+
+    stop = threading.Event()
+    observed: list = []  # (reader, step)
+
+    def reader(name: str, **sub_kwargs) -> None:
+        sub = WeightSubscriber(
+            [pub.address()], timeout=5.0, notify=False, **sub_kwargs
+        )
+        while not stop.is_set():
+            version = sub.poll()
+            if version is None:
+                time.sleep(0.005)
+                continue
+            observed.append((name, version.step))
+
+    threads = [
+        threading.Thread(target=reader, args=("stable",), kwargs={"token": "tok-stable"}),
+        threading.Thread(target=reader, args=("canary",), kwargs={"token": "tok-canary"}),
+        threading.Thread(
+            target=reader, args=("pin",), kwargs={"token": "tok-pin", "pin": 2}
+        ),
+    ]
+    for t in threads:
+        t.start()
+    try:
+        step_fn = opt.make_step_fn(_loss_fn)
+        before = rollout_counters()
+        for i in range(6):
+            if i == 3:
+                # Pin the drill's shape across orderings: make sure a
+                # stable baseline exists before the poisoned wave ships
+                # (auto-promotion may already have done this), then arm.
+                if pub.canary_steps():
+                    pub.promote_version(max(pub.canary_steps()))
+                punisher.arm_stream_fault("poison_canary", str(fault_file))
+            step_fn(jnp.full((2,), float(i), jnp.float32))
+        opt.flush_pipeline()
+        manager.start_quorum()
+        manager.wait_quorum()
+        # The poisoned wave may have shipped on the last boundary: give
+        # the verdict loop the windows it needs (the same tick the
+        # manager's step boundary drives).
+        for _ in range(4):
+            if rollout_counters()["retractions"] > before["retractions"]:
+                break
+            director.tick()
+        after = rollout_counters()
+        assert after["poisoned"] - before["poisoned"] == 1
+        assert after["retractions"] - before["retractions"] == 1
+        assert after["verdict_retract"] - before["verdict_retract"] == 1
+        retracted = [s for s in range(1, 8) if pub.is_retracted(s)]
+        assert retracted, "the poisoned wave was never retracted"
+        survivor = pub.latest()["step"]
+        assert survivor not in retracted
+        assert pub.stream_of(survivor) == rollout.STREAM_STABLE
+        # Post-retraction hold: no canary is live.
+        assert pub.canary_steps() == []
+        # Stable + canary readers converge to the survivor.
+        deadline = time.monotonic() + 10.0
+        converged: set = set()
+        while time.monotonic() < deadline and len(converged) < 2:
+            converged = {
+                name
+                for name, step in observed
+                if step == survivor and name in ("stable", "canary")
+            }
+            time.sleep(0.05)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        assert converged == {"stable", "canary"}, (converged, survivor)
+        # Zero wrong-version adoptions: the stable reader never observed
+        # a retracted (canary-wave) version; the pinned reader never
+        # drifted off its pin.
+        stable_steps = {s for n, s in observed if n == "stable"}
+        assert not (stable_steps & set(retracted)), (stable_steps, retracted)
+        pin_steps = {s for n, s in observed if n == "pin"}
+        assert pin_steps <= {2}, pin_steps
+    finally:
+        stop.set()
+        manager.shutdown(wait=False)
+        pub.shutdown(wait=False)
+
+
+# ---------------------------------------------------------------------------
+# observability goldens: fleet_status ROLLOUT column, fleet_trace lines
+# ---------------------------------------------------------------------------
+
+
+def _load_script(name: str):
+    spec = importlib.util.spec_from_file_location(
+        name,
+        Path(__file__).resolve().parent.parent / "scripts" / f"{name}.py",
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_fleet_status_rollout_column() -> None:
+    fleet_status = _load_script("fleet_status")
+    snap = {
+        "metrics": {
+            "gauges": {
+                "tpuft_rollout_state": [{"value": 3.0}],
+                "tpuft_rollout_canary_step": [{"value": 7.0}],
+            },
+            "counters": {
+                "tpuft_rollout_retractions_total": [{"value": 1.0}],
+            },
+        }
+    }
+    assert fleet_status._rollout_state(snap) == "retracted@s7/r1"
+    suspect = {
+        "metrics": {
+            "gauges": {
+                "tpuft_rollout_state": [{"value": 2.0}],
+                "tpuft_rollout_canary_step": [{"value": -1.0}],
+            },
+            "counters": {
+                "tpuft_rollout_alert_suppressed_total": [{"value": 2.0}],
+            },
+        }
+    }
+    assert fleet_status._rollout_state(suspect) == "suspect!"
+    # No rollout director on the replica: no column noise.
+    assert fleet_status._rollout_state({"metrics": {"gauges": {}}}) is None
+    assert ("rollout", "ROLLOUT") in fleet_status._COLUMNS
+
+
+def test_fleet_trace_explain_prints_canary_lines() -> None:
+    fleet_trace = _load_script("fleet_trace")
+
+    def event(seq, name, **kw):
+        base = {
+            "seq": seq, "name": name, "ph": "i", "cat": "ft",
+            "t_wall": 100.0 + seq, "t_mono": float(seq),
+            "replica_id": "train_0", "group_rank": 0,
+            "step": 7, "quorum_id": 2, "args": {},
+        }
+        base.update(kw)
+        return base
+
+    merged = fleet_trace.merge_events(
+        [
+            event(1, "canary_promoted"),
+            event(
+                2,
+                "canary_retracted",
+                args={"bad_streak": 2, "canary_rate": 0.5},
+            ),
+            event(3, "rollout_alert", args={"action": "retract", "bad_streak": 2}),
+            event(
+                4,
+                "shadow_divergence",
+                args={"stable_step": 6, "divergence": 0.25},
+            ),
+            event(
+                5,
+                "shadow_divergence",
+                args={"stable_step": -1, "divergence": -1.0},
+            ),
+        ]
+    )
+    text = fleet_trace.explain_step(merged, 7)
+    assert "canary PROMOTED: train_0/0 flipped canary wave step 7" in text
+    assert (
+        "canary RETRACTED: train_0/0 auto-retracted canary wave step 7 "
+        "after 2 consecutive bad evidence windows" in text
+    )
+    assert "rollout ALERT: train_0/0 reached a retract verdict" in text
+    assert "suppressed the actuation" in text
+    assert "25% of chunk CRCs differ" in text
+    assert "divergence unknown" in text

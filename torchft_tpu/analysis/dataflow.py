@@ -104,6 +104,7 @@ _R9_SCOPE_FILES = (
     "torchft_tpu/serving/_wire.py",
     "torchft_tpu/serving/relay.py",
     "torchft_tpu/serving/subscriber.py",
+    "torchft_tpu/serving/rollout.py",
     "torchft_tpu/manager.py",
     "torchft_tpu/history.py",
     "torchft_tpu/zero.py",

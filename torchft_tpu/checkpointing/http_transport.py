@@ -113,6 +113,7 @@ from torchft_tpu.checkpointing.transport import (
     CheckpointTransport,
 )
 from torchft_tpu.history import StagedVersionStore
+from torchft_tpu.serving import rollout
 
 __all__ = [
     "HTTPTransport",
@@ -871,6 +872,11 @@ class HTTPTransport(CheckpointTransport[Any]):
         # inject donor-side stream faults deterministically; when unset the
         # punisher's file-armed faults apply (faultinject.consume).
         self._fault_hook: Optional[Callable[[int, int], Optional[str]]] = None
+        # Progressive delivery: stream tag per staged step ("canary" /
+        # "stable"), recorded by the publisher BEFORE it announces the
+        # version so a stable tenant can never race canary chunks in the
+        # announce window. Untagged steps (heal stages) are ungated.
+        self._step_streams: Dict[int, str] = {}
 
         transport = self
 
@@ -977,6 +983,22 @@ class HTTPTransport(CheckpointTransport[Any]):
                     metrics.inc("tpuft_serving_auth_rejects_total")
                     self.send_error(401, f"unknown serving tenant: {e}")
                     return
+                # Progressive-delivery seam: a tenant whose rollout policy
+                # does not cover this version's stream is refused BEFORE
+                # any bytes move (the PR-12 401 discipline, answering 403).
+                # Tokenless fetches stay ungated — they are the heal plane
+                # and relay-tree pulls, which must see every stream.
+                if tenant is not None:
+                    deny = rollout.wrong_stream_chunk_reason(
+                        tenant, step, transport._step_streams.get(step)
+                    )
+                    if deny is not None:
+                        metrics.inc(
+                            "tpuft_rollout_wrong_stream_rejects_total",
+                            seam="transport",
+                        )
+                        self.send_error(403, deny)
+                        return
                 if parts[2] == "meta":
                     body = staged.meta_bytes()
                     self.send_response(200)
@@ -1160,6 +1182,20 @@ class HTTPTransport(CheckpointTransport[Any]):
         with self._cond:
             return [self._staged.step] if self._staged is not None else []
 
+    def mark_stream(self, step: int, stream: str) -> None:
+        """Progressive delivery: tags a staged version's stream
+        ("canary"/"stable") for the wrong-stream chunk gate. The
+        publisher calls this BEFORE announcing the version (and again on
+        promotion), and forwards the tag in-child when a serve child owns
+        the bytes — policy enforcement must hold at EVERY seam."""
+        with self._cond:
+            self._step_streams[int(step)] = str(stream)
+        if self._serve_child is not None:
+            try:
+                self._serve_child.mark_stream(step, stream)
+            except (ServeChildUnavailable, OSError):
+                pass  # degraded child = inline serving, gated above
+
     def drop_staged(self, step: int, retracted: bool = True) -> None:
         """Retraction: removes one resident staged version (inline ring
         AND the child's /dev/shm ring) so it can never be served again;
@@ -1172,6 +1208,7 @@ class HTTPTransport(CheckpointTransport[Any]):
         with self._cond:
             if self._staged is not None and self._staged.step == step:
                 self._staged = None
+            self._step_streams.pop(step, None)
 
     # -- serve-child plumbing ----------------------------------------------
 

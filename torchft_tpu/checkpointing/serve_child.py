@@ -130,6 +130,7 @@ def _load_by_path(name: str, path: Path):
 if __package__:
     from torchft_tpu import metrics, tracing
     from torchft_tpu.utils import faultinject, netem
+    from torchft_tpu.serving import rollout
 else:  # pragma: no cover - exercised only inside the spawned child
     _PKG = Path(__file__).resolve().parent.parent
     metrics = _load_by_path("tpuft_serve_metrics", _PKG / "metrics.py")
@@ -137,6 +138,9 @@ else:  # pragma: no cover - exercised only inside the spawned child
         "tpuft_serve_faultinject", _PKG / "utils" / "faultinject.py"
     )
     netem = _load_by_path("tpuft_serve_netem", _PKG / "utils" / "netem.py")
+    # rollout reuses the already-loaded tpuft_serve_metrics module (its own
+    # dual-context header checks sys.modules), staying jax-free in-child.
+    rollout = _load_by_path("tpuft_serve_rollout", _PKG / "serving" / "rollout.py")
 
 
 class ServeChildCrashed(RuntimeError):
@@ -598,6 +602,11 @@ class _FileStaged:
         self.chunk_crcs: Optional[List[int]] = cmd.get("crcs")
         self.digest: Optional[str] = cmd.get("digest")
         self.chunk_codecs: Optional[List[str]] = cmd.get("chunk_codecs")
+        # Progressive delivery: the version's stream tag ("canary"/
+        # "stable"; None = heal stage, ungated). Mutated by the "stream"
+        # control op on promotion — the ONE mutable field, policy routing
+        # only, never integrity metadata.
+        self.stream: Optional[str] = cmd.get("stream")
 
     def delete(self) -> None:
         shutil.rmtree(self.dir, ignore_errors=True)
@@ -727,6 +736,21 @@ def _child_main(argv: Optional[List[str]] = None) -> int:
                 metrics.inc("tpuft_serving_auth_rejects_total")
                 self.send_error(401, f"unknown serving tenant: {e}")
                 return
+            # Progressive-delivery seam, enforced IN-CHILD like the era
+            # fence above: a tenant whose rollout policy does not cover
+            # this version's stream is refused 403 before any bytes move;
+            # tokenless fetches (heal plane, relay tree) stay ungated.
+            if tenant is not None:
+                deny = rollout.wrong_stream_chunk_reason(
+                    tenant, step, staged.stream
+                )
+                if deny is not None:
+                    metrics.inc(
+                        "tpuft_rollout_wrong_stream_rejects_total",
+                        seam="child",
+                    )
+                    self.send_error(403, deny)
+                    return
             if route == "meta":
                 body = staged.meta_bytes
                 self.send_response(200)
@@ -906,6 +930,16 @@ def _child_main(argv: Optional[List[str]] = None) -> int:
                 if dropped is not None:
                     dropped.delete()
                 _emit({"event": "dropped", "step": cmd.get("step")})
+            elif op == "stream":
+                # Promotion/tagging: re-labels a resident version's stream
+                # (routing metadata only — bytes, CRCs, and the era tag
+                # are immutable).
+                with cond:
+                    resident = state["history"].get(int(cmd.get("step", -1)))
+                    if resident is not None:
+                        resident.stream = cmd.get("stream")
+                    cond.notify_all()
+                _emit({"event": "stream", "step": cmd.get("step")})
             elif op == "disallow":
                 with cond:
                     doomed = list(state["history"].values())
@@ -1175,6 +1209,12 @@ class ServeChild:
             self._send({"cmd": "drop", "step": int(step)})
         except (OSError, ServeChildUnavailable):
             pass  # child death is the watcher's to report
+
+    def mark_stream(self, step: int, stream: str) -> None:
+        """Progressive delivery: tags (or, on promotion, re-labels) a
+        resident version's stream so the in-child wrong-stream gate
+        matches the donor's — policy enforcement holds at every seam."""
+        self._send({"cmd": "stream", "step": int(step), "stream": str(stream)})
 
     def disallow(self) -> None:
         if self._staged_epoch is None:

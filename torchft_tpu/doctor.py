@@ -123,6 +123,15 @@ KNOWN_ENV = {
     "TPUFT_QUARANTINE_CAP_SEC", "TPUFT_QUARANTINE_MAX_EJECTS",
     "TPUFT_QUARANTINE_WINDOW_SEC", "TPUFT_QUARANTINE_PARK_SEC",
     "TPUFT_QUARANTINE_DIR",
+    # Progressive delivery (torchft_tpu/serving/rollout.py): per-tenant
+    # stream policy table, sha256 canary-cohort width, shadow-tenant
+    # list, verdict actuation mode (actuate|alert), and the rollout
+    # evaluator's hysteresis knobs (multiplicative threshold /
+    # K-consecutive windows / absolute gap floor / evidence floor).
+    "TPUFT_ROLLOUT_POLICY", "TPUFT_ROLLOUT_CANARY_PERCENT",
+    "TPUFT_ROLLOUT_SHADOW_TENANTS", "TPUFT_ROLLOUT_MODE",
+    "TPUFT_ROLLOUT_THRESHOLD", "TPUFT_ROLLOUT_WINDOWS",
+    "TPUFT_ROLLOUT_MIN_GAP", "TPUFT_ROLLOUT_MIN_SAMPLES",
     # Repo tooling outside the package (tests/benchmarks/sentinel) — real
     # knobs a user may have exported; not typos.
     "TPUFT_SOAK_SECONDS", "TPUFT_SOAK_SEED",
@@ -720,6 +729,84 @@ def _check_serving() -> Tuple[str, str]:
             pub.shutdown(wait=False)
 
 
+def _check_rollout() -> Tuple[str, str]:
+    """Progressive-delivery preflight (serving/rollout.py). WARN, never
+    FAIL: rollout is serving-plane policy — a broken table means readers
+    see the wrong stream view (or the full pre-rollout view), never that
+    training is wrong. Validates the policy table + cohort/hysteresis
+    knobs and names the two intentional degenerate modes: no policy at
+    all (the exact pre-rollout wire — every publish is stream-less) and
+    alerting-only actuation (verdicts counted + traced, publisher never
+    touched)."""
+    from torchft_tpu.serving import rollout
+
+    policy = rollout.RolloutPolicy.from_env()
+    if policy.errors:
+        return (
+            "WARN",
+            f"{rollout.ENV_POLICY} has malformed entries "
+            f"({'; '.join(policy.errors)}) — the skipped tenants silently "
+            "fall back to the percent-cohort/stable default",
+        )
+    problems = []
+    for env, floor in (
+        (rollout.ENV_THRESHOLD, 1.01),
+        (rollout.ENV_MIN_GAP, 0.0),
+    ):
+        raw = os.environ.get(env)
+        if raw is None:
+            continue
+        try:
+            if float(raw) < floor:
+                raise ValueError
+        except ValueError:
+            problems.append(f"{env}={raw!r} is not a float >= {floor:g}")
+    for env in (rollout.ENV_WINDOWS, rollout.ENV_MIN_SAMPLES):
+        raw = os.environ.get(env)
+        if raw is None:
+            continue
+        try:
+            if int(raw) < 1:
+                raise ValueError
+        except ValueError:
+            problems.append(f"{env}={raw!r} is not a positive int")
+    percent_raw = os.environ.get(rollout.ENV_CANARY_PERCENT)
+    if percent_raw is not None:
+        try:
+            if not 0.0 <= float(percent_raw) <= 100.0:
+                raise ValueError
+        except ValueError:
+            problems.append(
+                f"{rollout.ENV_CANARY_PERCENT}={percent_raw!r} is not a "
+                "percentage in [0, 100]"
+            )
+    mode = os.environ.get(rollout.ENV_MODE, "actuate").strip().lower()
+    if mode not in ("actuate", "alert"):
+        problems.append(
+            f"{rollout.ENV_MODE}={mode!r} is not actuate|alert "
+            "(falls back to actuate)"
+        )
+    if problems:
+        return "WARN", "; ".join(problems)
+    if not policy.active():
+        return (
+            "PASS",
+            "no rollout policy configured — publishes are stream-less and "
+            "every tenant sees the full view (the exact pre-rollout wire)",
+        )
+    pieces = [
+        f"{len(policy.entries)} explicit tenant entr(y/ies)",
+        f"{policy.percent:g}% sha256 canary cohort",
+        f"{len(policy.shadows)} shadow tenant(s)",
+    ]
+    if mode == "alert":
+        pieces.append(
+            "ALERTING-ONLY verdicts (bad canaries are counted + traced "
+            "but never auto-retracted)"
+        )
+    return "PASS", "rollout policy active: " + "; ".join(pieces)
+
+
 def _check_commit_pipeline() -> Tuple[str, str]:
     """Commit-pipeline window preflight. WARN, never FAIL: any depth
     trains correctly — but the snapshot ring holds one full
@@ -1056,6 +1143,7 @@ def run_checks(lighthouse: str, skip_device: bool = False) -> int:
         ("goodput/slo", _check_goodput),
         ("heal serving", _check_heal_serve),
         ("weights serving", _check_serving),
+        ("rollout policy", _check_rollout),
         ("heal striping", lambda: _check_heal_stripe(lighthouse)),
         ("health plane", lambda: _check_health(lighthouse)),
         ("rejoin storm", lambda: _check_rejoin_storm(lighthouse)),

@@ -900,34 +900,43 @@ class Manager:
         rides the state-dict read lock like a checkpoint serve. Failures
         are counted and logged (serving lags; training is unaffected)."""
         publisher = self._publisher
-        if publisher is None or not publisher.due():
+        if publisher is None:
             return
-        schedules.point("manager.maybe_publish")
-        try:
-            # Publication must never sample speculative-window state:
-            # resolve the full window before touching params (R7).
-            self._run_quorum_drain_hooks()
-            with self._state_dict_lock.r_lock(timeout=self._timeout):
-                if self._publisher_state_fn is not None:
-                    state = self._publisher_state_fn()
-                else:
-                    state = {
-                        key: fn() for key, fn in self._user_state_dicts.items()
-                    }
-            with metrics.timer(
-                "tpuft_publish_seconds", **self._metric_labels
-            ), self._trace.span(
-                "publish", step=self._step, quorum_id=self._quorum_id
-            ):
-                publisher.publish(
-                    step=self._step, quorum_id=self._quorum_id, state=state
+        if publisher.due():
+            schedules.point("manager.maybe_publish")
+            try:
+                # Publication must never sample speculative-window state:
+                # resolve the full window before touching params (R7).
+                self._run_quorum_drain_hooks()
+                with self._state_dict_lock.r_lock(timeout=self._timeout):
+                    if self._publisher_state_fn is not None:
+                        state = self._publisher_state_fn()
+                    else:
+                        state = {
+                            key: fn() for key, fn in self._user_state_dicts.items()
+                        }
+                with metrics.timer(
+                    "tpuft_publish_seconds", **self._metric_labels
+                ), self._trace.span(
+                    "publish", step=self._step, quorum_id=self._quorum_id
+                ):
+                    publisher.publish(
+                        step=self._step, quorum_id=self._quorum_id, state=state
+                    )
+            except Exception as e:  # noqa: BLE001 — publication is best-effort
+                metrics.inc("tpuft_publish_failures_total", **self._metric_labels)
+                self._logger.exception(
+                    f"publish failed (readers lag one cadence; training "
+                    f"unaffected): {e}"
                 )
-        except Exception as e:  # noqa: BLE001 — publication is best-effort
-            metrics.inc("tpuft_publish_failures_total", **self._metric_labels)
-            self._logger.exception(
-                f"publish failed (readers lag one cadence; training "
-                f"unaffected): {e}"
-            )
+        # Progressive delivery: one rollout-verdict evidence window per
+        # STEP BOUNDARY, not per publication — a canary wave must keep
+        # accumulating evidence between publishes or a slow cadence would
+        # starve the verdict loop (serving/rollout.py RolloutDirector).
+        # on_commit never raises — verdicts are advisory to the step loop.
+        director = getattr(publisher, "rollout_director", None)
+        if director is not None:
+            director.on_commit(self._step, self._quorum_id)
 
     def register_heal_parts_filter(self, fn: Callable[[], Any]) -> None:
         """Registers a callable returning the set of heal-part names

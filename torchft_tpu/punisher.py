@@ -47,6 +47,15 @@ Heal-path modes target the recovery plane itself:
   relays and subscribers converge to V-1 with zero torn / stale-era /
   wrong-version adoptions (tests/test_serving.py rollback-storm drill,
   strict AND pipelined orderings; SERVING_BENCH.json rollback leg).
+- ``poison_canary``: armed at the ``publisher_canary`` site; the
+  targeted publisher's NEXT canary publish consumes it and ships with a
+  synthetic bad-quality marker — CRC-valid bytes, integrity chain stays
+  green — so only the rollout verdict loop
+  (serving/rollout.py RolloutDirector) reacts: shadow evidence turns
+  bad, K consecutive windows past the threshold, and the wave is
+  auto-retracted fleet-wide (stable tenants never observed it). The
+  progressive-delivery drill's deterministic trigger
+  (tests/test_rollout.py; SERVING_BENCH.json canary leg).
 - ``slow_replica`` / ``wedge_device`` / ``drip_wire``: the GRAY-failure
   arms (torchft_tpu/health.py seams). One arm is consumed by the next
   matching phase — ``slow_replica``/``wedge_device`` at the device-sync
@@ -127,7 +136,7 @@ HEAL_FAULT_MODES = (
     "kill_half_fleet",
 )
 # Serving-plane modes (the committed-weights fan-out tier).
-SERVING_FAULT_MODES = ("kill_relay", "retract_version")
+SERVING_FAULT_MODES = ("kill_relay", "retract_version", "poison_canary")
 # Gray-failure modes (the health plane's slow-is-the-new-dead drills):
 # file-armed persistent stalls/wedges at the device-sync and wire seams.
 HEALTH_FAULT_MODES = ("slow_replica", "wedge_device", "drip_wire")
@@ -282,6 +291,12 @@ def arm_stream_fault(
         # and retracts that version fleet-wide (readers converge to V-1).
         site = "publisher_retract"
         armed_mode = "retract"
+    elif mode == "poison_canary":
+        # The publisher consumes "poison" at its next canary publish and
+        # ships it with a synthetic bad-quality marker (CRC-valid); the
+        # rollout verdict loop — not the integrity chain — must retract.
+        site = "publisher_canary"
+        armed_mode = "poison"
     elif mode in ("slow_replica", "wedge_device"):
         # Consumed by the next device sync anywhere in the fleet; the
         # consumer installs a persistent per-replica gray fault
@@ -327,6 +342,7 @@ def inject_fault(
         "corrupt_quantized_chunk",
         "kill_relay",
         "retract_version",
+        "poison_canary",
     ) or mode in HEALTH_FAULT_MODES:
         return arm_stream_fault(mode, fault_file)
     raise ValueError(f"unknown fault mode {mode!r}")

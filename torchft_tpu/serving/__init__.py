@@ -15,45 +15,53 @@ Roles:
   cache, upstream failover mid-pull, stackable.
 - :class:`WeightSubscriber` (subscriber.py) — verify-then-swap reader;
   torn, stale-era, or rolled-back versions are structurally unobservable.
+- ``rollout`` (rollout.py) — progressive-delivery policy plane: tenant →
+  stream resolution, shadow reads, and the quality-gated verdict loop.
+
+Exports resolve lazily (PEP 562): ``rollout`` is jax-free and importable
+from the serve child and from ``checkpointing.http_transport`` without
+dragging in the publisher→transport or subscriber→jax import chains.
 
 docs/serving.md has the architecture, version lifecycle, and failure
 rows; benchmarks/serving_bench.py measures reader throughput under
 fleet chaos.
 """
 
-from torchft_tpu.serving._wire import (
-    ENV_NOTIFY,
-    ENV_NOTIFY_HOLD_SEC,
-    PollPacer,
-    notify_enabled,
-    notify_hold_sec,
-)
-from torchft_tpu.serving.publisher import (
-    ENV_PUBLISH_CHUNKS,
-    ENV_PUBLISH_EVERY,
-    WeightPublisher,
-    publish_every,
-)
-from torchft_tpu.serving.relay import (
-    ENV_SERVING_POLL_SEC,
-    CachingRelay,
-    serving_poll_sec,
-)
-from torchft_tpu.serving.subscriber import ServingVersion, WeightSubscriber
+import importlib
 
-__all__ = [
-    "WeightPublisher",
-    "CachingRelay",
-    "WeightSubscriber",
-    "ServingVersion",
-    "PollPacer",
-    "ENV_PUBLISH_EVERY",
-    "ENV_PUBLISH_CHUNKS",
-    "ENV_SERVING_POLL_SEC",
-    "ENV_NOTIFY",
-    "ENV_NOTIFY_HOLD_SEC",
-    "publish_every",
-    "serving_poll_sec",
-    "notify_enabled",
-    "notify_hold_sec",
-]
+# name -> submodule holding it; resolved on first attribute access so that
+# `from torchft_tpu.serving import rollout` (used by the jax-free serve
+# child and by http_transport, which publisher itself imports) never
+# executes the heavier publisher/relay/subscriber module bodies.
+_EXPORTS = {
+    "ENV_NOTIFY": "_wire",
+    "ENV_NOTIFY_HOLD_SEC": "_wire",
+    "PollPacer": "_wire",
+    "notify_enabled": "_wire",
+    "notify_hold_sec": "_wire",
+    "ENV_PUBLISH_CHUNKS": "publisher",
+    "ENV_PUBLISH_EVERY": "publisher",
+    "WeightPublisher": "publisher",
+    "publish_every": "publisher",
+    "ENV_SERVING_POLL_SEC": "relay",
+    "CachingRelay": "relay",
+    "serving_poll_sec": "relay",
+    "ServingVersion": "subscriber",
+    "WeightSubscriber": "subscriber",
+}
+
+__all__ = list(_EXPORTS)
+
+
+def __getattr__(name: str):
+    submodule = _EXPORTS.get(name)
+    if submodule is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    module = importlib.import_module(f"{__name__}.{submodule}")
+    value = getattr(module, name)
+    globals()[name] = value
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

@@ -232,6 +232,7 @@ def fetch_notify(
     after_seq: Optional[int] = None,
     after_pub: Optional[str] = None,
     cancel: Optional[CancelScope] = None,
+    stream: Optional[str] = None,
 ) -> Optional[Dict[str, Any]]:
     """One long-poll round against ``base``: parks server-side until a
     version newer than ``after`` is announced (bounded by ``hold``) and
@@ -239,15 +240,20 @@ def fetch_notify(
     new (the caller re-arms). ``after_seq`` is the held version's
     publication sequence — against a seq-aware server it makes a
     RETRACTION (lower step, higher pub_seq) wake the waiter too, which
-    step watermarks alone cannot express. The descriptor is NOT trusted
-    — callers run it through the same validation a polled
-    ``/serving/latest`` body gets."""
+    step watermarks alone cannot express. ``stream`` requests a rollout
+    view (``stable``/``canary``/``all`` — serving/rollout.py); the
+    server resolves it against the token's tenant policy exactly like a
+    polled discovery route. The descriptor is NOT trusted — callers run
+    it through the same validation a polled ``/serving/latest`` body
+    gets."""
     hold = hold if hold is not None else notify_hold_sec()
     url = f"{base}{NOTIFY_ROUTE}?after={int(after)}&hold={hold:g}"
     if after_seq is not None:
         url += f"&after_seq={int(after_seq)}"
     if after_pub:
         url += f"&after_pub={urllib.parse.quote(str(after_pub))}"
+    if stream:
+        url += f"&stream={urllib.parse.quote(str(stream))}"
     # The socket timeout must outlive the server-side hold.
     body, status = _fetch(url, hold + timeout, token, cancel=cancel)
     if status == 204 or not body:
@@ -267,6 +273,8 @@ def latest_descriptor(
     pub_seq: Optional[int] = None,
     pub_id: Optional[str] = None,
     region: Optional[str] = None,
+    stream: Optional[str] = None,
+    poisoned: bool = False,
 ) -> Dict[str, Any]:
     """The ``/serving/latest`` body: the staging manifest
     (http_transport._stage_manifest) plus where to fetch the chunks from
@@ -298,6 +306,19 @@ def latest_descriptor(
         descriptor["pub_seq"] = int(pub_seq)
     if pub_id is not None:
         descriptor["pub_id"] = str(pub_id)
+    if stream is not None:
+        # Progressive delivery (serving/rollout.py): which rollout
+        # stream this version belongs to ("canary" until promoted).
+        # Publication-plane metadata like pub_seq — it rides the
+        # announce chain and relay tiers verbatim, and is never part of
+        # the digest/CRC integrity binding; stream ENFORCEMENT happens
+        # at the serve seams and reader-side, both before verification.
+        descriptor["stream"] = str(stream)
+    if poisoned:
+        # Punisher poison_canary marker: synthetic "this canary is bad"
+        # quality evidence — CRC-valid bytes, so only the rollout
+        # verdict loop (never the integrity chain) reacts to it.
+        descriptor["poisoned"] = True
     return descriptor
 
 

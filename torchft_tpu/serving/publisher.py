@@ -54,6 +54,7 @@ import os
 import socket
 import threading
 import time
+import urllib.parse
 import uuid
 from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -66,6 +67,7 @@ from torchft_tpu.checkpointing.serve_child import (
     tenant_of_authorization,
 )
 from torchft_tpu.history import DEFAULT_SERVING_VERSIONS, history_max_versions
+from torchft_tpu.serving import rollout
 from torchft_tpu.serving._wire import (
     LATEST_PREV_ROUTE,
     LATEST_ROUTE,
@@ -160,6 +162,15 @@ class WeightPublisher:
         # never outlives its chunks.
         self._versions: "OrderedDict[int, Dict[str, Any]]" = OrderedDict()
         self._retracted: set = set()
+        # Progressive delivery (serving/rollout.py): resident steps still
+        # in the canary stream (promotion flips them stable; retraction
+        # drops them), and the post-retraction hold — a retracted wave
+        # stops tagging new publishes canary until an operator resumes.
+        self._canary: set = set()
+        self._canary_hold = False
+        # A RolloutDirector attaches itself here (director.attach);
+        # Manager._maybe_publish drives its per-publish verdict window.
+        self.rollout_director: Optional[Any] = None
         # Publication stream identity + sequence: the sequence is
         # monotone over publishes AND retractions; the id scopes it (two
         # publishers' counters are incomparable — readers fall back to
@@ -195,35 +206,78 @@ class WeightPublisher:
                 # bearer token is refused at discovery too, so a
                 # misconfigured credential surfaces on the FIRST fetch.
                 try:
-                    tenant_of_authorization(self.headers.get("Authorization"))
+                    tenant = tenant_of_authorization(
+                        self.headers.get("Authorization")
+                    )
                 except UnknownTenantToken as e:
                     metrics.inc("tpuft_serving_auth_rejects_total")
                     self.send_error(401, f"unknown serving tenant: {e}")
                     return
+                # Progressive delivery: the tenant's rollout policy (plus
+                # an explicit ?stream= request) picks which stream view
+                # this discovery request sees — and a request conflicting
+                # with the policy is refused here, the 401 discipline's
+                # 403 sibling. Inactive policy = the full view, exactly
+                # the pre-rollout wire.
+                requested = urllib.parse.parse_qs(query).get("stream", [None])[0]
+                try:
+                    view = rollout.resolve_view(tenant, requested)
+                except rollout.WrongStreamError as e:
+                    metrics.inc(
+                        "tpuft_rollout_wrong_stream_rejects_total", seam="announce"
+                    )
+                    self.send_error(403, f"wrong rollout stream: {e}")
+                    return
+                pin_step = rollout.parse_pin(view)
                 if route == NOTIFY_ROUTE:
                     serve_notify(
                         self,
                         query,
                         publisher._hub,
-                        publisher.latest,
+                        functools.partial(publisher.latest_for_view, view),
                         manifest_at=publisher.version_descriptor,
                     )
                     return
                 if route == LATEST_ROUTE:
-                    latest, label = publisher.latest(), "latest"
+                    latest, label = publisher.latest_for_view(view), "latest"
                 elif route == LATEST_PREV_ROUTE:
-                    latest, label = publisher.latest_prev(), "latest-1"
+                    latest, label = (
+                        publisher.latest_for_view(view, offset=1),
+                        "latest-1",
+                    )
                 else:
                     try:
                         step = int(route[len(VERSION_ROUTE_PREFIX):])
                     except ValueError:
                         self.send_error(400, "bad version step")
                         return
+                    if (pin_step is not None and step != pin_step) or (
+                        view == rollout.STREAM_STABLE
+                        and publisher.stream_of(step) == rollout.STREAM_CANARY
+                    ):
+                        metrics.inc(
+                            "tpuft_rollout_wrong_stream_rejects_total",
+                            seam="announce",
+                        )
+                        self.send_error(
+                            403, f"version {step} is outside this tenant's stream"
+                        )
+                        return
                     if publisher.is_retracted(step):
                         metrics.inc("tpuft_history_retracted_reads_total")
                         self.send_error(410, f"version {step} was retracted")
                         return
                     latest, label = publisher.version_descriptor(step), "version"
+                if (
+                    latest is None
+                    and pin_step is not None
+                    and publisher.is_retracted(pin_step)
+                ):
+                    # A policy-pinned tenant whose pin was retracted gets
+                    # the same 410 answer a route-pinned reader gets.
+                    metrics.inc("tpuft_history_retracted_reads_total")
+                    self.send_error(410, f"version {pin_step} was retracted")
+                    return
                 if latest is None:
                     self.send_error(404, "no such version published")
                     return
@@ -271,6 +325,51 @@ class WeightPublisher:
         never published; retraction answers 410 at the route)."""
         with self._lock:
             return self._versions.get(step)
+
+    def latest_for_view(
+        self, view: str = rollout.VIEW_ALL, offset: int = 0
+    ) -> Optional[Dict[str, Any]]:
+        """The newest resident descriptor visible to a rollout ``view``
+        (``offset=1`` = that view's latest-1): ``stable`` skips canary
+        versions, ``canary``/``all`` see the full stream, ``pin@N`` sees
+        exactly N."""
+        pin = rollout.parse_pin(view)
+        with self._lock:
+            if pin is not None:
+                return self._versions.get(pin) if offset == 0 else None
+            steps = list(self._versions)
+            if view == rollout.STREAM_STABLE:
+                steps = [s for s in steps if s not in self._canary]
+            if len(steps) < offset + 1:
+                return None
+            return self._versions[steps[-1 - offset]]
+
+    def stream_of(self, step: int) -> str:
+        """Which rollout stream resident version ``step`` is in."""
+        with self._lock:
+            return (
+                rollout.STREAM_CANARY
+                if step in self._canary
+                else rollout.STREAM_STABLE
+            )
+
+    def canary_steps(self) -> List[int]:
+        with self._lock:
+            return sorted(self._canary & set(self._versions))
+
+    def current_canary(self) -> Optional[int]:
+        """The newest resident canary step (the verdict loop's subject),
+        or None when no canary is live."""
+        steps = self.canary_steps()
+        return steps[-1] if steps else None
+
+    def set_canary_hold(self, hold: bool) -> None:
+        """Pauses (True) / resumes (False) canary tagging of new
+        publishes. The director sets the hold after an auto-retraction —
+        a failed wave must not immediately re-ship itself; resuming is an
+        operator decision."""
+        with self._lock:
+            self._canary_hold = bool(hold)
 
     def resident_versions(self) -> List[int]:
         with self._lock:
@@ -332,6 +431,7 @@ class WeightPublisher:
             for s in doomed:
                 del self._versions[s]
                 self._retracted.add(s)
+                self._canary.discard(s)
                 metrics.inc("tpuft_history_retractions_total")
             self._pub_seq += 1
             survivor: Optional[Dict[str, Any]] = None
@@ -361,6 +461,47 @@ class WeightPublisher:
             self._hub.announce(int(survivor["step"]), seq=seq)
         return True
 
+    def promote_version(self, step: int) -> bool:
+        """Promotes canary version ``step`` — and any older resident
+        canary, one rollout wave — to the stable stream: the forward
+        analogue of :meth:`retract_version`'s survivor re-announce. Same
+        bytes, same digest, same era; only the publication identity
+        moves (``stream`` flips, ``pub_seq`` bumps), so relays and
+        stream-aware readers converge to it through the existing
+        seq-ordering gates with zero chunk traffic (every ``(crc,
+        size)`` matches — the delta path reuses everything). Returns
+        whether anything was actually promoted."""
+        with self._lock:
+            waved = sorted(
+                s for s in self._canary if s <= step and s in self._versions
+            )
+            if not waved:
+                return False
+            for s in waved:
+                self._canary.discard(s)
+                promoted = dict(self._versions[s])
+                promoted["stream"] = rollout.STREAM_STABLE
+                # Promotion asserts the verdict loop found the wave
+                # healthy; a chaos poison marker does not outlive it.
+                promoted.pop("poisoned", None)
+                self._versions[s] = promoted
+            newest = waved[-1]
+            self._pub_seq += 1
+            announced = dict(self._versions[newest])
+            announced["pub_seq"] = self._pub_seq
+            announced["published_ts"] = time.time()
+            self._versions[newest] = announced
+            if self._latest is not None and int(self._latest["step"]) == newest:
+                self._latest = announced
+            seq = self._pub_seq
+        for s in waved:
+            self._transport.mark_stream(s, rollout.STREAM_STABLE)
+        self._hub.announce(newest, seq=seq)
+        metrics.inc("tpuft_rollout_promotions_total")
+        tracing.record("canary_promoted", step=newest)
+        logger.info("promoted canary version(s) %s to stable", waved)
+        return True
+
     # -- publication -------------------------------------------------------
 
     def publish(
@@ -388,6 +529,36 @@ class WeightPublisher:
                 "WeightPublisher needs a manifest-returning transport "
                 "(HTTPTransport); got None from send_checkpoint"
             )
+        # Progressive delivery: under an active rollout policy every new
+        # publish ships as a CANARY (until the verdict loop promotes it)
+        # unless a retraction put the wave on hold. Inactive policy =
+        # stream-less descriptors, the exact pre-rollout wire.
+        policy = rollout.RolloutPolicy.from_env()
+        with self._lock:
+            canary_wave = policy.active() and not self._canary_hold
+        stream = None
+        if policy.active():
+            stream = (
+                rollout.STREAM_CANARY if canary_wave else rollout.STREAM_STABLE
+            )
+        poisoned = False
+        if canary_wave and faultinject.consume("publisher_canary") == "poison":
+            # Chaos seam (punisher ``poison_canary``): the NEXT canary
+            # publish carries a synthetic bad-quality marker — CRC-valid
+            # bytes, so only the rollout verdict loop reacts; the
+            # integrity chain must stay green through the whole drill.
+            poisoned = True
+            metrics.inc("tpuft_rollout_poisoned_publishes_total")
+            logger.warning(
+                "punisher poison_canary armed: canary version %d publishes "
+                "with synthetic bad-quality evidence",
+                step,
+            )
+        if stream is not None:
+            # Mark the chunk seams BEFORE the descriptor flip/announce: a
+            # stable tenant must never win a race for canary chunks in
+            # the announce window.
+            self._transport.mark_stream(step, stream)
         with self._lock:
             self._pub_seq += 1
             latest = latest_descriptor(
@@ -400,9 +571,15 @@ class WeightPublisher:
                 # WAN topology: the root tier's region (None without one) —
                 # regional relays use it to order their upstream sets.
                 region=netem.local_region(),
+                stream=stream,
+                poisoned=poisoned,
             )
             self._latest = latest
             self._retracted.discard(step)
+            if stream == rollout.STREAM_CANARY:
+                self._canary.add(step)
+            else:
+                self._canary.discard(step)
             self._versions[step] = latest
             if list(self._versions) != sorted(self._versions):
                 self._versions = OrderedDict(sorted(self._versions.items()))

@@ -73,6 +73,7 @@ from torchft_tpu.serving._wire import (
     serve_notify,
     validate_latest,
 )
+from torchft_tpu.serving import rollout
 from torchft_tpu.utils import faultinject, netem
 
 __all__ = ["CachingRelay", "ENV_SERVING_POLL_SEC", "serving_poll_sec"]
@@ -113,6 +114,8 @@ class _RelayVersion:
         "pub_id",
         "tree_token",
         "chunk_codecs",
+        "stream",
+        "poisoned",
     )
 
     def __init__(
@@ -132,6 +135,8 @@ class _RelayVersion:
         pub_id: Optional[str] = None,
         tree_token: Optional[str] = None,
         chunk_codecs: Optional[List[str]] = None,
+        stream: Optional[str] = None,
+        poisoned: bool = False,
     ) -> None:
         self.step = step
         self.quorum_id = quorum_id
@@ -158,6 +163,13 @@ class _RelayVersion:
         # tags ride the tree verbatim (they are digest-bound; the relay
         # itself never decodes).
         self.chunk_codecs = chunk_codecs
+        # Progressive delivery (serving/rollout.py): the origin stream
+        # tag ("canary"/"stable"; None = pre-rollout publisher) and the
+        # punisher's poison marker — publication-plane metadata like
+        # pub_seq, preserved verbatim across tiers, never part of the
+        # digest/CRC integrity binding.
+        self.stream = stream
+        self.poisoned = poisoned
 
     def manifest(self) -> Dict[str, Any]:
         manifest: Dict[str, Any] = {
@@ -266,13 +278,32 @@ class CachingRelay:
                     metrics.inc("tpuft_serving_auth_rejects_total")
                     self.send_error(401, f"unknown serving tenant: {e}")
                     return
-                version = relay.current()
+                # Progressive-delivery view (serving/rollout.py): the
+                # ``?stream=`` request resolved against this tenant's
+                # rollout policy — tokenless readers pool under the
+                # default tenant at these DESCRIPTOR seams, and a request
+                # the policy does not cover is refused 403 before any
+                # body (the PR-12 401 discipline).
+                policy = rollout.RolloutPolicy.from_env()
+                requested = urllib.parse.parse_qs(split.query).get(
+                    "stream", [None]
+                )[0]
+                try:
+                    view = rollout.resolve_view(tenant, requested, policy)
+                except rollout.WrongStreamError as e:
+                    metrics.inc(
+                        "tpuft_rollout_wrong_stream_rejects_total", seam="relay"
+                    )
+                    self.send_error(403, str(e))
+                    return
+                pin_step = rollout.parse_pin(view)
+                version = relay._latest_for_view(view)
                 if split.path == NOTIFY_ROUTE:
                     serve_notify(
                         self,
                         split.query,
                         relay._hub,
-                        relay._descriptor,
+                        functools.partial(relay._descriptor_for_view, view),
                         manifest_at=relay._manifest_at,
                     )
                     return
@@ -283,7 +314,7 @@ class CachingRelay:
                         label = "latest"
                     elif split.path == LATEST_PREV_ROUTE:
                         label = "latest-1"
-                        version = relay.latest_prev()
+                        version = relay._latest_for_view(view, offset=1)
                     else:
                         label = "version"
                         try:
@@ -291,13 +322,29 @@ class CachingRelay:
                         except ValueError:
                             self.send_error(400, "bad version step")
                             return
+                        version = relay._version_for(want)
+                        if (pin_step is not None and want != pin_step) or (
+                            view == rollout.STREAM_STABLE
+                            and version is not None
+                            and (version.stream or rollout.STREAM_STABLE)
+                            == rollout.STREAM_CANARY
+                        ):
+                            metrics.inc(
+                                "tpuft_rollout_wrong_stream_rejects_total",
+                                seam="relay",
+                            )
+                            self.send_error(
+                                403,
+                                f"version {want} is outside this tenant's "
+                                "rollout stream",
+                            )
+                            return
                         if relay._versions.is_retracted(want):
                             metrics.inc("tpuft_history_retracted_reads_total")
                             self.send_error(
                                 410, f"version {want} was retracted"
                             )
                             return
-                        version = relay._version_for(want)
                     if version is None:
                         self.send_error(404, "no such version cached")
                         return
@@ -308,6 +355,11 @@ class CachingRelay:
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
+                    if label == "latest" and policy.is_shadow(tenant):
+                        # Shadow tee: STRICTLY after the stable response
+                        # is on the wire — a tee failure is a counter,
+                        # never a slow or failed stable read.
+                        relay._shadow_tee(version)
                     return
                 parts = split.path.strip("/").split("/")
                 if len(parts) != 3 or parts[0] != "checkpoint":
@@ -344,6 +396,20 @@ class CachingRelay:
                         f"reader wants {want_era[0]}",
                     )
                     return
+                # Wrong-stream chunk gate: a KNOWN tenant outside this
+                # version's stream is refused; tokenless fetches (child
+                # relays, heal-plane pulls) are never gated here.
+                if tenant is not None:
+                    deny = rollout.wrong_stream_chunk_reason(
+                        tenant, step, version.stream, policy
+                    )
+                    if deny is not None:
+                        metrics.inc(
+                            "tpuft_rollout_wrong_stream_rejects_total",
+                            seam="relay",
+                        )
+                        self.send_error(403, deny)
+                        return
                 if parts[2] == "meta":
                     body = version.meta_bytes
                     route = "meta"
@@ -435,7 +501,109 @@ class CachingRelay:
             pub_seq=version.pub_seq,
             pub_id=version.pub_id,
             region=self._region,
+            stream=version.stream,
+            poisoned=version.poisoned,
         )
+
+    # -- progressive delivery (serving/rollout.py) --------------------------
+
+    def _stream_versions(self, want: str) -> List[_RelayVersion]:
+        """Resident versions of stream ``want``, newest first. Untagged
+        versions (a pre-rollout publisher) count as stable — the exact
+        degenerate behavior."""
+        seen: Dict[int, _RelayVersion] = {}
+        current = self.current()
+        if current is not None:
+            seen[current.step] = current
+        for step in self._versions.latest_steps(DEFAULT_SERVING_VERSIONS):
+            payload = self._versions.get(step)
+            if isinstance(payload, _RelayVersion):
+                seen.setdefault(step, payload)
+        return [
+            v
+            for _, v in sorted(seen.items(), reverse=True)
+            if (v.stream or rollout.STREAM_STABLE) == want
+        ]
+
+    def _latest_for_view(
+        self, view: str = rollout.VIEW_ALL, offset: int = 0
+    ) -> Optional[_RelayVersion]:
+        """The newest resident version a ``view`` may observe (``offset``
+        = 1 is the view's latest-1). Pin views see exactly their pinned
+        step; the stable view filters canary-tagged versions out; canary
+        and full views see the newest overall (canary cohorts read the
+        stable baseline too — latest-1 comparisons)."""
+        pin = rollout.parse_pin(view)
+        if pin is not None:
+            return self._version_for(pin) if offset == 0 else None
+        if view == rollout.STREAM_STABLE:
+            stable = self._stream_versions(rollout.STREAM_STABLE)
+            return stable[offset] if len(stable) > offset else None
+        return self.current() if offset == 0 else self.latest_prev()
+
+    def _descriptor_for_view(
+        self, view: str = rollout.VIEW_ALL
+    ) -> Optional[Dict[str, Any]]:
+        version = self._latest_for_view(view)
+        # None must stay None: _descriptor(None) falls back to current(),
+        # which would leak the full view to a filtered one.
+        return self._descriptor(version) if version is not None else None
+
+    def _shadow_tee(self, stable: Optional[_RelayVersion]) -> None:
+        """Shadow read: verify the resident canary version through the
+        full integrity pipeline (every chunk CRC + the meta/digest
+        binding + the poison marker) WITHOUT serving it, and report
+        divergence vs the stable version the shadow tenant was actually
+        answered from. Every attempt is a counted observation — the
+        verdict loop's evidence — and every failure is a counter, never
+        an error on the stable path (the publish-failure-only-makes-
+        readers-stale invariant, extended)."""
+        canary = None
+        try:
+            versions = self._stream_versions(rollout.STREAM_CANARY)
+            canary = versions[0] if versions else None
+            if canary is None:
+                return  # no live canary: nothing to observe
+            metrics.inc("tpuft_rollout_shadow_reads_total")
+            for i, data in enumerate(canary.chunks):
+                if len(data) != canary.chunk_sizes[i]:
+                    raise ValueError(f"shadow canary chunk {i} size mismatch")
+                if chunk_crc(data, canary.crc_algo) != canary.chunk_crcs[i]:
+                    raise ValueError(f"shadow canary chunk {i} checksum mismatch")
+            meta = safe_loads(canary.meta_bytes)
+            if not isinstance(meta, dict) or meta.get("digest") != canary.digest:
+                raise ValueError("shadow canary meta does not bind its digest")
+            if canary.poisoned:
+                # The punisher's CRC-valid bad-quality marker: integrity
+                # holds, quality does not — exactly what only a shadow
+                # read (never a stable reader) is allowed to observe.
+                raise ValueError("shadow canary carries the poisoned marker")
+            divergence = None
+            if (
+                stable is not None
+                and stable.crc_algo == canary.crc_algo
+                and len(stable.chunk_crcs) == len(canary.chunk_crcs)
+            ):
+                changed = sum(
+                    1
+                    for a, b in zip(stable.chunk_crcs, canary.chunk_crcs)
+                    if a != b
+                )
+                divergence = changed / max(len(canary.chunk_crcs), 1)
+                metrics.set_gauge("tpuft_rollout_shadow_divergence", divergence)
+            tracing.record(
+                "shadow_divergence",
+                step=canary.step,
+                stable_step=stable.step if stable is not None else -1,
+                divergence=divergence if divergence is not None else -1.0,
+            )
+        except Exception as e:  # noqa: BLE001 — shadow failures are evidence
+            metrics.inc("tpuft_rollout_shadow_failures_total")
+            logger.warning(
+                "shadow read of canary step %s failed: %s",
+                canary.step if canary is not None else "?",
+                e,
+            )
 
     def _ordered_upstreams(self) -> List[str]:
         """The upstream set, same-region tiers first (stable within each
@@ -536,10 +704,14 @@ class CachingRelay:
             if self._stop.is_set():
                 return False
             try:
+                # Tokenless tiers park on the full-stream view (canary
+                # versions must propagate down the tree); a token-scoped
+                # relay parks on its tenant's own policy view.
                 woke = fetch_notify(
                     upstream, after, self._timeout, token=self._token,
                     after_seq=after_seq, after_pub=after_pub,
                     cancel=self._notify_cancel,
+                    stream=rollout.VIEW_ALL if self._token is None else None,
                 )
             except Exception:  # noqa: BLE001 — old/dead upstream: next one
                 metrics.inc("tpuft_serving_upstream_failovers_total")
@@ -572,10 +744,16 @@ class CachingRelay:
                 return False
             best = descriptor
         else:
+            # Tokenless tiers discover the FULL stream (?stream=all — the
+            # infra view, never policy-gated) so canary versions ride the
+            # tree; a token-scoped relay discovers its tenant's own view.
+            view_qs = f"?stream={rollout.VIEW_ALL}" if self._token is None else ""
             for upstream in self._ordered_upstreams():
                 try:
                     latest = fetch_json(
-                        f"{upstream}{LATEST_ROUTE}", self._timeout, token=self._token
+                        f"{upstream}{LATEST_ROUTE}{view_qs}",
+                        self._timeout,
+                        token=self._token,
                     )
                 except Exception:  # noqa: BLE001 — a dead upstream is routine
                     metrics.inc("tpuft_serving_upstream_failovers_total")
@@ -600,7 +778,9 @@ class CachingRelay:
             for upstream in self._ordered_upstreams():
                 try:
                     latest = fetch_json(
-                        f"{upstream}{LATEST_ROUTE}", self._timeout, token=self._token
+                        f"{upstream}{LATEST_ROUTE}{view_qs}",
+                        self._timeout,
+                        token=self._token,
                     )
                 except Exception:  # noqa: BLE001
                     continue
@@ -720,8 +900,13 @@ class CachingRelay:
             pub_id=latest.get("pub_id"),
             tree_token=latest.get("tree_token"),
             chunk_codecs=latest.get("chunk_codecs"),
+            stream=latest.get("stream"),
+            poisoned=bool(latest.get("poisoned")),
         )
-        retraction = previous is not None and step <= previous.step
+        # Strictly LOWER step = retraction; a seq-newer re-announce at
+        # the SAME step is a canary PROMOTION (the stream tag flipped to
+        # stable) and must not drop ring versions.
+        retraction = previous is not None and step < previous.step
         with self._lock:
             self._current = version
         self._versions.put(step, version, sum(sizes))

@@ -1,0 +1,652 @@
+"""Progressive delivery for the committed-weights serving plane.
+
+Three pieces, composing the primitives the serving plane already has
+(per-tenant bearer identity, pinned versions, sanctioned ``pub_seq``
+retraction, hysteresis verdict discipline) into canary rollouts:
+
+- **Per-tenant version policies** (:class:`RolloutPolicy`): a tenant
+  resolves — as a PURE FUNCTION, the ``zero.shard_assignment`` spirit:
+  never negotiated, identical in every process — to a stream:
+  ``stable``, ``canary``, or ``pin@<step>``. Explicit entries come from
+  ``$TPUFT_ROLLOUT_POLICY`` (``tenant:stream`` pairs, ``*`` as the
+  default); unlisted tenants fall into the canary cohort by a
+  sha256-derived percent bucket (``$TPUFT_ROLLOUT_CANARY_PERCENT``) —
+  sha256, NOT Python's salted ``hash()``, so the same token lands in the
+  same cohort in every process and every run, with exact percent
+  boundaries. The policy is enforced at every serving seam (publisher
+  announce, relay, inline transport, serve-child IN-child — the PR-12
+  401 discipline, now answering 403 on a wrong-stream request) and
+  tokenless readers pool under the ``default`` tenant at descriptor
+  seams, exactly like the egress-fairness plane. Tokenless CHUNK
+  fetches stay ungated: they are the heal plane and relay-tree pulls,
+  which must see every stream.
+
+- **Shadow reads**: a relay tees a shadow tenant's discovery fetches to
+  the resident canary version and verifies it through the full
+  CRC/digest pipeline WITHOUT serving it — the shadow tenant is always
+  answered from the stable view, the tee runs strictly after the stable
+  response is written, and every tee failure is a counted observation
+  (``tpuft_rollout_shadow_failures_total``), never an error on the
+  stable path: the publish-failure-only-makes-readers-stale invariant,
+  extended to the canary plane.
+
+- **The rollout verdict loop** (:class:`RolloutEvaluator` +
+  :class:`RolloutDirector`): health.py's HealthScorer discipline applied
+  to model VERSIONS — a window is "bad" only when the canary failure
+  rate clears BOTH a multiplicative threshold against the stable
+  baseline AND an absolute gap floor; K consecutive bad windows latch a
+  ``retract`` verdict, K consecutive healthy windows a ``promote``
+  verdict, one opposing window resets the streak — a transient blip can
+  never retract. Windows with insufficient canary evidence are REFUSED
+  (counted), never judged. Actuation happens at exactly one seam
+  (:meth:`RolloutDirector._actuate`), through the existing
+  ``retract_version`` / ``promote_version`` paths, and
+  ``$TPUFT_ROLLOUT_MODE=alert`` turns the loop advisory: verdicts latch
+  and count, nothing actuates.
+
+Canary descriptors ride the existing ``pub_seq``/``pub_id`` +
+digest/CRC/era verify-then-swap chain unchanged — the ``stream`` tag is
+publication-plane metadata like ``pub_seq`` (announce-chain routing,
+never part of the integrity binding), so a wrong-stream or torn adoption
+stays structurally impossible: stream refusal happens server-side at
+every seam AND reader-side before the verification pipeline even runs.
+
+This module is deliberately jax-free (the serve child imports it).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import time
+from typing import Any, Callable, Dict, FrozenSet, List, Optional, Sequence, Tuple
+
+# Dual-context import (the serve_child discipline): in-process this is the
+# normal package import; file-loaded into the jax-free serve child (where
+# ``__package__`` is empty and importing torchft_tpu would drag in jax) it
+# reuses the child's already-file-loaded metrics module.
+if __package__ and __package__.startswith("torchft_tpu"):
+    from torchft_tpu import metrics
+else:  # pragma: no cover - exercised only inside the spawned serve child
+    import importlib.util as _ilu
+    import sys as _sys
+    from pathlib import Path as _Path
+
+    metrics = _sys.modules.get("tpuft_serve_metrics")
+    if metrics is None:
+        _spec = _ilu.spec_from_file_location(
+            "tpuft_serve_metrics", _Path(__file__).resolve().parent.parent / "metrics.py"
+        )
+        assert _spec is not None and _spec.loader is not None
+        metrics = _ilu.module_from_spec(_spec)
+        _sys.modules["tpuft_serve_metrics"] = metrics
+        _spec.loader.exec_module(metrics)
+
+__all__ = [
+    "ENV_POLICY",
+    "ENV_CANARY_PERCENT",
+    "ENV_SHADOW_TENANTS",
+    "ENV_MODE",
+    "ENV_THRESHOLD",
+    "ENV_WINDOWS",
+    "ENV_MIN_GAP",
+    "ENV_MIN_SAMPLES",
+    "STREAM_STABLE",
+    "STREAM_CANARY",
+    "VIEW_ALL",
+    "WrongStreamError",
+    "cohort_bucket",
+    "in_canary_cohort",
+    "parse_policy",
+    "parse_pin",
+    "RolloutPolicy",
+    "resolve_view",
+    "wrong_stream_chunk_reason",
+    "RolloutEvaluator",
+    "RolloutDirector",
+    "STATE_CODES",
+]
+
+ENV_POLICY = "TPUFT_ROLLOUT_POLICY"
+ENV_CANARY_PERCENT = "TPUFT_ROLLOUT_CANARY_PERCENT"
+ENV_SHADOW_TENANTS = "TPUFT_ROLLOUT_SHADOW_TENANTS"
+ENV_MODE = "TPUFT_ROLLOUT_MODE"
+ENV_THRESHOLD = "TPUFT_ROLLOUT_THRESHOLD"
+ENV_WINDOWS = "TPUFT_ROLLOUT_WINDOWS"
+ENV_MIN_GAP = "TPUFT_ROLLOUT_MIN_GAP"
+ENV_MIN_SAMPLES = "TPUFT_ROLLOUT_MIN_SAMPLES"
+
+STREAM_STABLE = "stable"
+STREAM_CANARY = "canary"
+# The "shadow" policy token: the tenant is SERVED stable; its discovery
+# fetches additionally tee a canary verification at the relay.
+_STREAM_SHADOW = "shadow"
+# The infra view: full-stream discovery (relay-tree pulls) — never a
+# tenant policy value, only a requested ``?stream=all`` view.
+VIEW_ALL = "all"
+
+# fleet_status / the tpuft_rollout_state gauge: verdict-loop posture.
+STATE_CODES = {
+    "idle": 0,  # no live canary (rollout inactive or between waves)
+    "watch": 1,  # canary live, evidence healthy so far
+    "suspect": 2,  # bad streak open, below the K-window latch
+    "retracted": 3,  # last verdict retracted the canary
+    "promoted": 4,  # last verdict promoted the canary
+}
+
+
+class WrongStreamError(Exception):
+    """A request conflicts with the requesting tenant's rollout policy
+    (403 at the seam — the stream analogue of UnknownTenantToken's 401)."""
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, str(default)))
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, str(default)))
+    except ValueError:
+        return default
+
+
+# ---------------------------------------------------------------------------
+# cohort assignment: a pure function of the tenant name
+# ---------------------------------------------------------------------------
+
+
+def cohort_bucket(tenant: Optional[str]) -> int:
+    """Deterministic bucket in [0, 10000) for ``tenant`` (tokenless
+    readers pool under ``default``, mirroring the egress-fairness plane).
+    sha256-derived — bitwise identical across processes, machines, and
+    runs (Python's ``hash()`` is per-process salted and MUST NOT be used
+    here) — so cohort membership is a pure function, never negotiated:
+    the ``zero.shard_assignment`` discipline applied to readers."""
+    name = tenant if tenant else "default"
+    return int.from_bytes(hashlib.sha256(name.encode()).digest()[:8], "big") % 10000
+
+
+def in_canary_cohort(tenant: Optional[str], percent: float) -> bool:
+    """Whether ``tenant`` falls in a ``percent``-sized canary cohort.
+    The boundary is exact: ``percent`` maps to ``round(percent * 100)``
+    buckets of the 10000, so 12.34% admits buckets [0, 1234) — no float
+    drift at the edge."""
+    return cohort_bucket(tenant) < int(round(max(0.0, min(100.0, percent)) * 100))
+
+
+# ---------------------------------------------------------------------------
+# policy table
+# ---------------------------------------------------------------------------
+
+
+def parse_pin(stream: str) -> Optional[int]:
+    """The pinned step of a ``pin@<step>`` stream token, else None."""
+    if stream.startswith("pin@"):
+        try:
+            return int(stream[4:])
+        except ValueError:
+            return None
+    return None
+
+
+def parse_policy(raw: Optional[str] = None) -> Tuple[Dict[str, str], List[str]]:
+    """Parses ``$TPUFT_ROLLOUT_POLICY`` (``tenant:stream`` pairs,
+    comma-separated; ``*`` = the default for unlisted tenants; stream in
+    {stable, canary, shadow, pin@<step>}). Malformed entries are skipped
+    and returned in the error list (the serving_tenant_tokens
+    discipline: a typo degrades one entry, never the table) — doctor's
+    rollout probe surfaces them as WARN."""
+    raw = os.environ.get(ENV_POLICY, "") if raw is None else raw
+    entries: Dict[str, str] = {}
+    errors: List[str] = []
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        tenant, sep, stream = part.partition(":")
+        tenant, stream = tenant.strip(), stream.strip().lower()
+        if not sep or not tenant or not stream:
+            errors.append(f"malformed policy entry {part!r} (want tenant:stream)")
+            continue
+        if stream not in (STREAM_STABLE, STREAM_CANARY, _STREAM_SHADOW):
+            if parse_pin(stream) is None:
+                errors.append(
+                    f"policy entry {part!r}: unknown stream {stream!r} "
+                    "(want stable|canary|shadow|pin@<step>)"
+                )
+                continue
+        entries[tenant] = stream
+    return entries, errors
+
+
+def _canary_percent(raw: Optional[str] = None) -> float:
+    raw = os.environ.get(ENV_CANARY_PERCENT, "0") if raw is None else raw
+    try:
+        return max(0.0, min(100.0, float(raw)))
+    except ValueError:
+        return 0.0
+
+
+def _shadow_tenants(raw: Optional[str] = None) -> FrozenSet[str]:
+    raw = os.environ.get(ENV_SHADOW_TENANTS, "") if raw is None else raw
+    return frozenset(t.strip() for t in raw.split(",") if t.strip())
+
+
+class RolloutPolicy:
+    """An immutable resolved snapshot of the rollout policy: explicit
+    entries > ``*`` default > percent cohort > stable. Re-read from the
+    environment at each seam (``from_env``) so every process — donors,
+    relays, the serve child — resolves identically from the same
+    fleet-wide env agreement, with zero shared state."""
+
+    def __init__(
+        self,
+        entries: Optional[Dict[str, str]] = None,
+        percent: Optional[float] = None,
+        shadows: Optional[FrozenSet[str]] = None,
+        errors: Optional[List[str]] = None,
+    ) -> None:
+        self.entries = dict(entries or {})
+        self.percent = _canary_percent(None if percent is None else str(percent))
+        self.shadows = frozenset(shadows or ())
+        self.errors = list(errors or ())
+
+    @classmethod
+    def from_env(cls) -> "RolloutPolicy":
+        entries, errors = parse_policy()
+        return cls(
+            entries=entries,
+            percent=_canary_percent(),
+            shadows=_shadow_tenants(),
+            errors=errors,
+        )
+
+    def active(self) -> bool:
+        """Whether the rollout plane is configured at all. False is the
+        degenerate case: every seam behaves exactly as before this plane
+        existed (full-view descriptors, no stream gating)."""
+        return bool(self.entries) or self.percent > 0 or bool(self.shadows)
+
+    def is_shadow(self, tenant: Optional[str]) -> bool:
+        name = tenant if tenant else "default"
+        return name in self.shadows or self.entries.get(name) == _STREAM_SHADOW
+
+    def resolve(self, tenant: Optional[str]) -> str:
+        """The stream ``tenant`` reads: ``stable``, ``canary``, or
+        ``pin@<step>``. Shadow tenants resolve STABLE — their canary
+        exposure is the relay tee, never their served bytes."""
+        name = tenant if tenant else "default"
+        entry = self.entries.get(name)
+        if entry is None:
+            entry = self.entries.get("*")
+        if entry == _STREAM_SHADOW:
+            return STREAM_STABLE
+        if entry is not None:
+            return entry
+        if self.percent > 0 and in_canary_cohort(name, self.percent):
+            return STREAM_CANARY
+        return STREAM_STABLE
+
+
+def resolve_view(
+    tenant: Optional[str],
+    requested: Optional[str],
+    policy: Optional[RolloutPolicy] = None,
+) -> str:
+    """The descriptor view a discovery request gets: ``all`` (full
+    stream — relay-tree pulls), ``stable``, ``canary``, or ``pin@N``.
+
+    ``requested`` is the explicit ``?stream=`` query value; the tenant's
+    policy caps it — a stable/pinned tenant asking for the canary (or
+    full) view is a wrong-stream request and raises
+    :class:`WrongStreamError` (403 at the seam, counted). With the
+    rollout plane unconfigured every request resolves to ``all``: the
+    exact pre-rollout wire behavior."""
+    policy = policy if policy is not None else RolloutPolicy.from_env()
+    if not policy.active():
+        return VIEW_ALL
+    # Tokenless infra pulls (relay-tree discovery/notify) request the
+    # full-stream view explicitly; like tokenless chunk fetches they are
+    # never policy-gated — a relay must see every stream to serve its
+    # mixed reader population.
+    if tenant is None and requested == VIEW_ALL:
+        return VIEW_ALL
+    stream = policy.resolve(tenant)
+    pin = parse_pin(stream)
+    if pin is not None:
+        if requested is not None and requested != stream:
+            raise WrongStreamError(
+                f"tenant is pinned to version {pin}; requested {requested!r}"
+            )
+        return stream
+    if stream == STREAM_STABLE:
+        if requested in (STREAM_CANARY, VIEW_ALL):
+            raise WrongStreamError(
+                f"tenant reads the stable stream; requested {requested!r}"
+            )
+        return STREAM_STABLE
+    # Canary-cohort tenants may read any view (the stable baseline
+    # included — latest-1 comparisons).
+    return requested if requested is not None else STREAM_CANARY
+
+
+def wrong_stream_chunk_reason(
+    tenant: Optional[str],
+    step: int,
+    step_stream: Optional[str],
+    policy: Optional[RolloutPolicy] = None,
+) -> Optional[str]:
+    """Chunk-seam enforcement: the refusal reason (403) when ``tenant``
+    must not read version ``step`` whose stream tag is ``step_stream``,
+    else None. Tokenless fetches are NEVER gated here — they are the
+    heal plane and relay-tree pulls, which must see every stream (the
+    caller applies the default-tenant pooling only at descriptor
+    seams)."""
+    if tenant is None:
+        return None
+    policy = policy if policy is not None else RolloutPolicy.from_env()
+    if not policy.active():
+        return None
+    stream = policy.resolve(tenant)
+    pin = parse_pin(stream)
+    if pin is not None:
+        if step != pin:
+            return f"tenant is pinned to version {pin}, not {step}"
+        return None
+    if stream == STREAM_STABLE and step_stream == STREAM_CANARY:
+        return f"version {step} is a canary; tenant reads the stable stream"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# verdict loop
+# ---------------------------------------------------------------------------
+
+
+class RolloutEvaluator:
+    """Pure verdict logic over per-window canary evidence — the
+    HealthScorer discipline applied to model versions. No I/O, no
+    threads; the director owns plumbing, unit tests drive this directly.
+
+    A window is "bad" only when the canary failure rate clears BOTH
+    bounds against the stable baseline: ``canary_rate > threshold *
+    stable_rate`` (multiplicative — a uniformly failing fleet never
+    blames its canary) AND ``canary_rate - stable_rate > min_gap`` (the
+    absolute floor — 3x a per-mille noise rate is not a verdict).
+    ``consecutive`` bad windows latch ``retract``; ``consecutive``
+    healthy (judgeable, not bad) windows latch ``promote``; one opposing
+    window resets the streak — a transient blip can never retract. A
+    window with fewer than ``min_samples`` canary observations is
+    REFUSED (``tpuft_rollout_verdicts_refused_total``): streaks do not
+    advance on evidence that is not there."""
+
+    def __init__(
+        self,
+        threshold: Optional[float] = None,
+        consecutive: Optional[int] = None,
+        min_samples: Optional[int] = None,
+        min_gap: Optional[float] = None,
+    ) -> None:
+        self.threshold = max(
+            1.01,
+            threshold if threshold is not None else _env_float(ENV_THRESHOLD, 3.0),
+        )
+        self.consecutive = max(
+            1,
+            consecutive if consecutive is not None else _env_int(ENV_WINDOWS, 3),
+        )
+        self.min_samples = max(
+            1,
+            min_samples if min_samples is not None else _env_int(ENV_MIN_SAMPLES, 1),
+        )
+        self.min_gap = max(
+            0.0, min_gap if min_gap is not None else _env_float(ENV_MIN_GAP, 0.05)
+        )
+        self.bad_streak = 0
+        self.good_streak = 0
+        self.refusals = 0
+
+    def reset(self) -> None:
+        """A new canary wave starts its evidence from zero."""
+        self.bad_streak = 0
+        self.good_streak = 0
+
+    def observe_window(
+        self,
+        canary_reads: int,
+        canary_failures: int,
+        stable_reads: int = 0,
+        stable_failures: int = 0,
+        divergence: Optional[float] = None,
+    ) -> Dict[str, Any]:
+        """One evidence window. Returns the verdict dict; hysteresis
+        state advances only on judgeable windows."""
+        verdict: Dict[str, Any] = {
+            "judgeable": False,
+            "bad": False,
+            "action": None,
+            "bad_streak": self.bad_streak,
+            "good_streak": self.good_streak,
+            "canary_rate": None,
+            "stable_rate": None,
+            "divergence": divergence,
+        }
+        if canary_reads < self.min_samples:
+            self.refusals += 1
+            metrics.inc("tpuft_rollout_verdicts_refused_total")
+            return verdict
+        canary_rate = canary_failures / max(canary_reads, 1)
+        stable_rate = (
+            stable_failures / max(stable_reads, 1) if stable_reads > 0 else 0.0
+        )
+        bad = (
+            canary_rate > self.threshold * max(stable_rate, 1e-9)
+            and (canary_rate - stable_rate) > self.min_gap
+        )
+        if bad:
+            self.bad_streak += 1
+            self.good_streak = 0
+        else:
+            self.good_streak += 1
+            self.bad_streak = 0
+        action = None
+        if self.bad_streak >= self.consecutive:
+            action = "retract"
+        elif self.good_streak >= self.consecutive:
+            action = "promote"
+        verdict.update(
+            judgeable=True,
+            bad=bad,
+            action=action,
+            bad_streak=self.bad_streak,
+            good_streak=self.good_streak,
+            canary_rate=round(canary_rate, 6),
+            stable_rate=round(stable_rate, 6),
+        )
+        return verdict
+
+
+class RolloutDirector:
+    """Drives the verdict loop against one publisher: collects a
+    per-commit evidence window (process-local ``tpuft_rollout_shadow_*``
+    counter deltas — relay tees land there — plus its own cheap canary
+    self-probe of the publisher's resident descriptor), feeds the
+    evaluator, and actuates the latched verdict at EXACTLY one seam
+    (:meth:`_actuate`): ``publisher.retract_version`` for a bad canary
+    (the sanctioned pub_seq rollback every tier already follows),
+    ``publisher.promote_version`` for a surviving one. A retraction also
+    holds further canary tagging (``publisher.set_canary_hold``) — the
+    wave is over until an operator resumes it. ``mode="alert"``
+    (``$TPUFT_ROLLOUT_MODE``) suppresses actuation: verdicts latch,
+    count, and trace, nothing moves.
+
+    Fleet deployments that scrape counters centrally can bypass the
+    process-local collection and feed :meth:`RolloutEvaluator
+    .observe_window` directly; the actuation seam is unchanged."""
+
+    _WINDOW_COUNTERS = (
+        "tpuft_rollout_shadow_reads_total",
+        "tpuft_rollout_shadow_failures_total",
+    )
+
+    def __init__(
+        self,
+        publisher: Any = None,
+        evaluator: Optional[RolloutEvaluator] = None,
+        mode: Optional[str] = None,
+        wall: Callable[[], float] = time.time,
+    ) -> None:
+        self.evaluator = evaluator if evaluator is not None else RolloutEvaluator()
+        raw_mode = (
+            mode if mode is not None else os.environ.get(ENV_MODE, "actuate")
+        )
+        self.mode = "alert" if str(raw_mode).strip().lower() == "alert" else "actuate"
+        self.state = "idle"
+        self._wall = wall
+        self._publisher = None
+        self._last: Dict[str, float] = {}
+        self._watched: Optional[int] = None
+        if publisher is not None:
+            self.attach(publisher)
+
+    def attach(self, publisher: Any) -> None:
+        self._publisher = publisher
+        publisher.rollout_director = self
+
+    # -- evidence ------------------------------------------------------------
+
+    def _counter_deltas(self) -> Dict[str, int]:
+        deltas: Dict[str, int] = {}
+        for name in self._WINDOW_COUNTERS:
+            total = metrics.counter_total(name)
+            deltas[name] = int(total - self._last.get(name, 0.0))
+            self._last[name] = total
+        return deltas
+
+    def _self_probe(self, canary_steps: Sequence[int]) -> Tuple[int, int]:
+        """One cheap in-process observation of EVERY resident canary in
+        the wave per window: each descriptor must exist, validate, and
+        carry no poison marker. No network, no payload — it guarantees
+        every window has at least one sample per wave member so an
+        unread canary still converges to a verdict instead of starving
+        on refusals, and a bad wave member stays visible after younger
+        healthy canaries join the wave."""
+        from torchft_tpu.serving._wire import validate_latest
+
+        reads = 0
+        failures = 0
+        for step in canary_steps:
+            reads += 1
+            descriptor = self._publisher.version_descriptor(step)
+            if descriptor is None:
+                failures += 1
+            elif (
+                validate_latest(descriptor) is not None
+                or descriptor.get("poisoned")
+            ):
+                failures += 1
+        return reads, failures
+
+    # -- the loop ------------------------------------------------------------
+
+    def on_commit(self, step: int, quorum_id: Optional[int] = None) -> None:
+        """Manager step-boundary hook (``Manager._maybe_publish`` tail):
+        one evidence window per committed step — windows keep elapsing
+        between publishes so a live canary wave converges to a verdict
+        regardless of the publish cadence. Never raises — the train loop
+        must not pay for a verdict bug."""
+        try:
+            self.tick()
+        except Exception:  # noqa: BLE001 — verdicts are advisory to the step loop
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "rollout verdict tick failed", exc_info=True
+            )
+
+    def tick(self) -> Optional[Dict[str, Any]]:
+        """One verdict window; returns the evaluator's verdict (None when
+        no canary is live)."""
+        pub = self._publisher
+        if pub is None:
+            return None
+        steps = sorted(pub.canary_steps())
+        if not steps:
+            if self.state not in ("retracted", "promoted"):
+                self.state = "idle"
+            self._watched = None
+            self._emit_gauges(-1)
+            return None
+        # The wave identity is the OLDEST resident canary step: later
+        # canary publishes JOIN the wave (a publish-every-commit cadence
+        # must not reset the evaluator each window or verdicts starve);
+        # only a genuinely new wave — after a promote/retract emptied the
+        # set — gets fresh hysteresis and fresh counters.
+        wave = steps[0]
+        canary = steps[-1]
+        if wave != self._watched:
+            self.evaluator.reset()
+            self._counter_deltas()
+            self._watched = wave
+            self.state = "watch"
+        deltas = self._counter_deltas()
+        probe_reads, probe_failures = self._self_probe(steps)
+        verdict = self.evaluator.observe_window(
+            canary_reads=deltas["tpuft_rollout_shadow_reads_total"] + probe_reads,
+            canary_failures=(
+                deltas["tpuft_rollout_shadow_failures_total"] + probe_failures
+            ),
+            divergence=metrics.gauge_value("tpuft_rollout_shadow_divergence"),
+        )
+        if verdict["judgeable"]:
+            self.state = "suspect" if verdict["bad_streak"] > 0 else "watch"
+        if verdict["action"] is not None:
+            metrics.inc("tpuft_rollout_verdicts_total", action=verdict["action"])
+            self._actuate(verdict["action"], canary, verdict)
+        self._emit_gauges(canary if self._watched is not None else -1)
+        return verdict
+
+    def _emit_gauges(self, canary_step: int) -> None:
+        metrics.set_gauge("tpuft_rollout_state", STATE_CODES[self.state])
+        metrics.set_gauge("tpuft_rollout_canary_step", canary_step)
+        metrics.set_gauge(
+            "tpuft_rollout_canary_percent", RolloutPolicy.from_env().percent
+        )
+
+    # -- actuation: exactly one seam ----------------------------------------
+
+    def _actuate(self, action: str, canary_step: int, verdict: Dict[str, Any]) -> None:
+        from torchft_tpu import tracing
+
+        if self.mode != "actuate":
+            metrics.inc("tpuft_rollout_alert_suppressed_total")
+            tracing.record(
+                "rollout_alert",
+                step=canary_step,
+                action=action,
+                bad_streak=verdict["bad_streak"],
+            )
+            self.evaluator.reset()
+            return
+        if action == "retract":
+            self._publisher.set_canary_hold(True)
+            oldest = min(self._publisher.canary_steps(), default=canary_step)
+            retracted = self._publisher.retract_version(oldest)
+            if retracted:
+                metrics.inc("tpuft_rollout_retractions_total")
+            tracing.record(
+                "canary_retracted",
+                step=canary_step,
+                bad_streak=verdict["bad_streak"],
+                canary_rate=verdict["canary_rate"],
+            )
+            self.state = "retracted"
+        else:
+            self._publisher.promote_version(canary_step)
+            self.state = "promoted"
+        self._watched = None
+        self.evaluator.reset()

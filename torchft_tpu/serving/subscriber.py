@@ -55,6 +55,15 @@ as the fallback — the fallback path must not thundering-herd either).
 Multi-tenant: a reader constructed with a bearer ``token`` sends it on
 every serving fetch; the serve seams charge its bytes to its tenant's
 egress sub-bucket (TPUFT_SERVING_TENANT_TOKENS / _GBPS).
+
+Progressive delivery: ``stream=`` requests a rollout view on every
+discovery/notify fetch (the server resolves it against the token's
+tenant policy — serving/rollout.py), and a ``stream="stable"`` reader
+additionally refuses a canary-tagged descriptor CLIENT-side before the
+verification pipeline even starts
+(``tpuft_rollout_wrong_stream_rejects_total{seam="reader"}``) — a
+misrouted or compromised tier cannot push a canary onto a stable
+reader.
 """
 
 from __future__ import annotations
@@ -86,6 +95,7 @@ from torchft_tpu.serving._wire import (
     same_stream,
     validate_latest,
 )
+from torchft_tpu.serving import rollout
 from torchft_tpu.serving.relay import serving_poll_sec
 
 __all__ = ["WeightSubscriber", "ServingVersion"]
@@ -131,6 +141,7 @@ class WeightSubscriber:
         poll_interval: Optional[float] = None,
         jitter_seed: Optional[int] = None,
         pin: Optional[Union[int, str]] = None,
+        stream: Optional[str] = None,
     ) -> None:
         if not endpoints:
             raise ValueError("WeightSubscriber needs at least one endpoint")
@@ -140,10 +151,21 @@ class WeightSubscriber:
             raise ValueError(
                 f"pin must be a step (int) or 'latest-1', got {pin!r}"
             )
+        if stream is not None and stream not in (
+            rollout.STREAM_STABLE,
+            rollout.STREAM_CANARY,
+            rollout.VIEW_ALL,
+        ):
+            raise ValueError(
+                f"stream must be stable|canary|all, got {stream!r}"
+            )
         self._endpoints = list(endpoints)
         self._timeout = timeout
         self._token = token
         self._pin = pin
+        # Requested rollout view, sent on every discovery/notify fetch
+        # (None = pre-rollout behavior: no query, no client-side fence).
+        self._stream = stream
         # Pinned-step readers have a FIXED target: push notifications
         # announce newer versions, which is exactly what a pin ignores.
         self._notify = (
@@ -208,6 +230,7 @@ class WeightSubscriber:
                 descriptor = fetch_notify(
                     endpoint, after, self._timeout, token=self._token,
                     hold=hold, after_seq=after_seq, after_pub=after_pub,
+                    stream=self._stream,
                 )
             except Exception:  # noqa: BLE001 — endpoint dead or notify-less
                 self._endpoints.append(self._endpoints.pop(0))
@@ -260,10 +283,14 @@ class WeightSubscriber:
 
     def _discovery_route(self) -> str:
         if isinstance(self._pin, int):
-            return f"{VERSION_ROUTE_PREFIX}{self._pin}"
-        if self._pin == "latest-1":
-            return LATEST_PREV_ROUTE
-        return LATEST_ROUTE
+            route = f"{VERSION_ROUTE_PREFIX}{self._pin}"
+        elif self._pin == "latest-1":
+            route = LATEST_PREV_ROUTE
+        else:
+            route = LATEST_ROUTE
+        if self._stream is not None:
+            route += f"?stream={self._stream}"
+        return route
 
     def _fetch_latest(self) -> Optional[Dict[str, Any]]:
         route = self._discovery_route()
@@ -303,6 +330,23 @@ class WeightSubscriber:
         if reason is not None:
             metrics.inc("tpuft_serving_integrity_rejects_total")
             logger.warning("serving descriptor rejected: %s", reason)
+            return None
+        if (
+            self._stream == rollout.STREAM_STABLE
+            and latest.get("stream") == rollout.STREAM_CANARY
+        ):
+            # Reader-side wrong-stream fence: a stable reader refuses a
+            # canary-tagged descriptor BEFORE the verification pipeline
+            # starts — a misrouted or compromised tier cannot push a
+            # canary onto a stable reader (server-side gating is the
+            # routing; this is the belt-and-braces refusal).
+            metrics.inc(
+                "tpuft_rollout_wrong_stream_rejects_total", seam="reader"
+            )
+            logger.warning(
+                "refusing canary version %s on a stable-stream reader",
+                latest.get("step"),
+            )
             return None
         held = self._version
         step = int(latest["step"])
